@@ -1,0 +1,101 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capabilities (reference: /root/reference, see SURVEY.md).
+
+Public namespace mirrors `paddle.*`: tensor ops at the top level, `nn`,
+`optimizer`, `amp`, `io`, `distributed`, `vision`, `jit`, `static`-less —
+but the engine underneath is jax/XLA/PJRT, designed TPU-first (SURVEY.md §7).
+"""
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    # dtypes
+    DType,
+    bool_ as bool,  # noqa: A001 — paddle exposes paddle.bool
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+    set_default_dtype,
+    get_default_dtype,
+    # device
+    CPUPlace,
+    TPUPlace,
+    set_device,
+    get_device,
+    device_count,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    # tensor & autograd
+    Tensor,
+    to_tensor,
+    no_grad,
+    enable_grad,
+    is_grad_enabled,
+    set_grad_enabled,
+    # rng
+    seed,
+    get_rng_state,
+    set_rng_state,
+    Generator,
+)
+
+from .ops import *  # noqa: F401,F403  — paddle.* tensor ops
+from . import ops  # noqa: F401
+
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import device  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+
+from .hapi import Model  # noqa: E402,F401
+from .framework.io import save, load  # noqa: E402,F401
+from .nn import ParamAttr  # noqa: E402,F401
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad parity (python/paddle/autograd/__init__.py; C++
+    general_grad.h partial-graph path)."""
+    from .framework import run_backward
+    from .framework.tensor import Tensor as _T
+    from .ops import zeros_like
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    capture = {id(t): t for t in inputs}
+    captured = run_backward(
+        list(outputs),
+        list(grad_outputs) if grad_outputs is not None else None,
+        retain_graph=bool(retain_graph) if retain_graph is not None else create_graph,
+        capture=capture,
+        accumulate_leaf=False,
+    )
+    results = []
+    for t in inputs:
+        g = captured.get(id(t))
+        if g is None:
+            if allow_unused:
+                results.append(None)
+            else:
+                results.append(zeros_like(t))
+        else:
+            results.append(_T._wrap(g))
+    return results
+
+
+def flops(*args, **kwargs):  # pragma: no cover - placeholder parity stub
+    return 0

@@ -1,0 +1,22 @@
+"""Automatic mixed precision.
+
+Reference parity: python/paddle/amp/ (auto_cast :1012, amp_guard :457,
+GradScaler grad_scaler.py:645, lists amp_lists.py) + the C++ state machine
+paddle/fluid/imperative/amp_auto_cast.cc. TPU-first: bf16 is the primary AMP
+dtype (native MXU input type, no loss scaling required); fp16 is supported
+with the reference's dynamic loss scaling.
+"""
+from .auto_cast import (  # noqa: F401
+    auto_cast,
+    amp_guard,
+    amp_state,
+    decorate,
+    amp_decorate,
+    is_auto_cast_enabled,
+    get_amp_dtype,
+    get_amp_level,
+    white_list,
+    black_list,
+)
+from .grad_scaler import GradScaler, AmpScaler, OptimizerState  # noqa: F401
+from . import debugging  # noqa: F401

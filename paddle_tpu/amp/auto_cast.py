@@ -1,0 +1,155 @@
+"""AMP autocast state machine.
+
+Reference: the global AMP level/dtype + per-op allow/block lists
+(python/paddle/amp/auto_cast.py, amp_lists.py; C++ GetAmpDestDtype in
+paddle/fluid/imperative/amp_auto_cast.cc). The cast hook runs inside
+`apply_op`'s caller layer: layers consult `amp_state()` and cast inputs for
+white-list ops (matmul/conv) to the AMP dtype.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..framework.dtype import convert_dtype
+from ..framework.tensor import Tensor
+
+_state = threading.local()
+
+# mirrors python/paddle/amp/amp_lists.py (fp16 white/black lists)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d", "einsum",
+    "scaled_dot_product_attention", "flash_attention", "mv",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "mean", "sum", "softmax", "log_softmax",
+    "cross_entropy", "layer_norm", "batch_norm", "group_norm", "norm",
+    "cumsum", "logsumexp", "pow", "square", "reciprocal", "rsqrt",
+}
+
+white_list = WHITE_LIST
+black_list = BLACK_LIST
+
+
+class AmpAttrs:
+    def __init__(self):
+        self.enable = False
+        self.dtype = "float16"
+        self.level = "O0"
+        self.custom_white_list = set()
+        self.custom_black_list = set()
+
+
+def amp_state() -> AmpAttrs:
+    st = getattr(_state, "amp", None)
+    if st is None:
+        st = AmpAttrs()
+        _state.amp = st
+    return st
+
+
+def is_auto_cast_enabled():
+    return amp_state().enable
+
+
+def get_amp_dtype():
+    st = amp_state()
+    return st.dtype if st.enable else "float32"
+
+
+def get_amp_level():
+    return amp_state().level
+
+
+def amp_dest_dtype(op_name: str):
+    """GetAmpDestDtype parity: None means keep input dtype."""
+    st = amp_state()
+    if not st.enable:
+        return None
+    if op_name in st.custom_black_list:
+        return "float32"
+    if st.level == "O2":
+        if op_name in BLACK_LIST and op_name not in st.custom_white_list:
+            return "float32"
+        return st.dtype
+    # O1: cast only white-list ops
+    if op_name in WHITE_LIST or op_name in st.custom_white_list:
+        return st.dtype
+    if op_name in BLACK_LIST:
+        return "float32"
+    return None
+
+
+def amp_cast(x: Tensor, op_name: str) -> Tensor:
+    dst = amp_dest_dtype(op_name)
+    if dst is None or not isinstance(x, Tensor):
+        return x
+    if not x.dtype.is_floating_point:
+        return x
+    if x.dtype.name == dst:
+        return x
+    return x.astype(dst)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity. Default dtype here is bfloat16 — the
+    TPU-native AMP dtype (the reference defaults to float16 for CUDA)."""
+    st = amp_state()
+    prev = (st.enable, st.dtype, st.level, st.custom_white_list, st.custom_black_list)
+    st.enable = enable
+    st.dtype = convert_dtype(dtype).name if enable else st.dtype
+    st.level = level if enable else "O0"
+    st.custom_white_list = set(custom_white_list or ())
+    st.custom_black_list = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.enable, st.dtype, st.level, st.custom_white_list,
+         st.custom_black_list) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """paddle.amp.decorate parity: O2 casts model params to the AMP dtype and
+    turns on optimizer master weights."""
+    from ..nn import Layer
+    from ..optimizer.optimizer import Optimizer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        excluded = excluded_layers or ()
+        from ..nn.layer.norm import _BatchNormBase, LayerNorm
+
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, (_BatchNormBase, LayerNorm)):
+                    continue
+                if excluded and isinstance(layer, tuple(excluded)):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and p.dtype.is_floating_point and p.dtype.name == "float32":
+                        p._data = p._data.astype(
+                            __import__("paddle_tpu").framework.to_jax_dtype(dtype)
+                        )
+    if optimizers is not None:
+        single_opt = isinstance(optimizers, Optimizer)
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            if master_weight is not False:
+                opt._multi_precision = True
+        if single_model and single_opt:
+            return model_list[0], opt_list[0]
+        return model_list if not single_model else model_list[0], (
+            opt_list if not single_opt else opt_list[0]
+        )
+    return model_list[0] if single_model else model_list
+
+
+amp_decorate = decorate

@@ -1,0 +1,94 @@
+"""NaN/Inf debugging utilities.
+
+Reference parity: python/paddle/amp/debugging.py + FLAGS_check_nan_inf
+(paddle/common/flags.cc:79, egr::CheckTensorHasNanOrInf in
+paddle/fluid/eager/nan_inf_utils.cc). When enabled via
+paddle_tpu.utils.flags, every op output is swept for non-finite values.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """check_numerics kernel parity: raise on NaN/Inf."""
+    data = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        return tensor
+    finite = bool(jnp.all(jnp.isfinite(data)))
+    if not finite:
+        n_nan = int(jnp.sum(jnp.isnan(data)))
+        n_inf = int(jnp.sum(jnp.isinf(data)))
+        msg = (f"numerics check failed for op={op_type or '?'} var={var_name or '?'}: "
+               f"{n_nan} NaN, {n_inf} Inf in tensor of shape {list(data.shape)}")
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print(f"[paddle_tpu.amp.debugging] {msg}")
+    return tensor
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Collects per-op dtype stats during the block (reference:
+    paddle/amp/debugging.py enable_operator_stats_collection)."""
+    from ..framework import autograd as ag
+
+    stats = {}
+    orig = ag.apply_op
+
+    def wrapped(fn, inputs, attrs=None, name="", num_outputs=None):
+        key = name or getattr(fn, "__name__", "op")
+        dtypes = tuple(str(t._data.dtype) for t in inputs)
+        stats.setdefault(key, {}).setdefault(dtypes, 0)
+        stats[key][dtypes] += 1
+        return orig(fn, inputs, attrs=attrs, name=name, num_outputs=num_outputs)
+
+    ag.apply_op = wrapped
+    try:
+        yield stats
+    finally:
+        ag.apply_op = orig
+        _print_stats(stats)
+
+
+def _print_stats(stats):
+    print(f"{'op':<30} {'dtype signature':<40} count")
+    for op, sigs in sorted(stats.items()):
+        for sig, n in sigs.items():
+            print(f"{op:<30} {str(sig):<40} {n}")
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=False, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+
+
+def enable_tensor_checker(config):
+    from ..utils import flags
+
+    flags.set_flags({"FLAGS_check_nan_inf": config.enable})
+
+
+def disable_tensor_checker():
+    from ..utils import flags
+
+    flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError("accuracy-compare tooling lands in a later round")

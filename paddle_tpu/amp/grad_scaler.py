@@ -1,0 +1,139 @@
+"""Dynamic loss scaling.
+
+Reference parity: AmpScaler / GradScaler (python/paddle/amp/grad_scaler.py:62,
+645): scale -> backward -> unscale (found_inf via check_finite_and_unscale
+kernel) -> conditional step -> scale update. The found_inf device->host sync
+is batched into a single scalar readback per step (SURVEY.md §7 hard-parts).
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+class OptimizerState(enum.Enum):
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._opt_states = {}
+
+    def is_enable(self):
+        return self._enable
+
+    is_use_dynamic_loss_scaling = is_enable
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def _unscale(self, optimizer):
+        """check_finite_and_unscale parity: one fused pass over grads computing
+        a single found_inf flag and dividing by the scale."""
+        if not self._enable:
+            return
+        if self._opt_states.get(id(optimizer)) == OptimizerState.UNSCALED:
+            return
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = jnp.asarray(False)
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._data.astype(jnp.float32) * inv
+            found = found | ~jnp.all(jnp.isfinite(g))
+            p.grad._data = g.astype(p.grad._data.dtype) if p.grad._data.dtype != jnp.float32 else g
+        self._found_inf = bool(found)  # single device->host sync
+        self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
+
+    def unscale_(self, optimizer):
+        return self._unscale(optimizer)
+
+    def minimize(self, optimizer, loss, *args, **kwargs):
+        self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+        self._opt_states.pop(id(optimizer), None)
+        optimizer.clear_grad()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._opt_states[id(optimizer)] = OptimizerState.STEPPED
+
+    def update(self):
+        if not self._enable:
+            return
+        self._update()
+        self._opt_states.clear()
+
+    def _update(self):
+        if not self._use_dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    # -- introspection ---------------------------------------------------
+    def get_loss_scaling(self):
+        return Tensor(self._scale)
+
+    def set_init_loss_scaling(self, value):
+        self._scale = float(value)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+        self._use_dynamic = state.get("use_dynamic_loss_scaling", self._use_dynamic)
+
+
+class GradScaler(AmpScaler):
+    """Public API (grad_scaler.py:645)."""
+
+    pass

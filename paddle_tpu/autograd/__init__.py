@@ -1,0 +1,124 @@
+"""paddle.autograd parity: PyLayer, backward, no_grad."""
+from __future__ import annotations
+
+from ..framework import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from ..framework.autograd import GradNode, run_backward
+from ..framework.tensor import Tensor
+
+import jax.numpy as jnp
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """ctx object (reference: paddle/fluid/eager/pylayer/py_layer_node.h)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd (python/paddle/autograd/py_layer.py parity).
+
+    Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework import autograd as ag
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = ag.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        outs_tuple = (outputs,) if single else tuple(outputs)
+        tensor_outputs = [o for o in outs_tuple if isinstance(o, Tensor)]
+
+        if needs_grad and tensor_outputs:
+            meta = [(o._data.shape, o._data.dtype) for o in tensor_outputs]
+
+            def vjp(cotangents):
+                if not isinstance(cotangents, tuple):
+                    cotangents = (cotangents,)
+                grad_ins = cls.backward(
+                    ctx, *[Tensor._wrap(c) for c in cotangents]
+                )
+                if not isinstance(grad_ins, (tuple, list)):
+                    grad_ins = (grad_ins,)
+                # map returned grads (per tensor input) to jax arrays
+                result = []
+                gi = 0
+                for t in tensor_inputs:
+                    if gi < len(grad_ins) and grad_ins[gi] is not None:
+                        g = grad_ins[gi]
+                        result.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+                    else:
+                        import numpy as np
+                        import jax
+
+                        result.append(np.zeros(t._data.shape, jax.dtypes.float0))
+                    gi += 1
+                return tuple(result)
+
+            if len(tensor_outputs) == 1:
+                node = GradNode(lambda c: vjp(c), tensor_inputs, meta, name=cls.__name__)
+            else:
+                node = GradNode(vjp, tensor_inputs, meta, name=cls.__name__)
+            wrapped = []
+            idx = 0
+            for o in outs_tuple:
+                if isinstance(o, Tensor):
+                    wrapped.append(
+                        Tensor._wrap(o._data, stop_gradient=False, grad_node=node,
+                                     out_index=idx)
+                    )
+                    idx += 1
+                else:
+                    wrapped.append(o)
+            outs_tuple = tuple(wrapped)
+
+        return outs_tuple[0] if single else outs_tuple
+
+
+# paddle.autograd.py_layer compat namespace
+class py_layer:
+    PyLayer = PyLayer
+    PyLayerContext = PyLayerContext
+
+
+def hessian(func, xs, batch_axis=None):
+    raise NotImplementedError("higher-order autograd lands in a later round")
+
+
+def jacobian(func, xs, batch_axis=None):
+    raise NotImplementedError("higher-order autograd lands in a later round")

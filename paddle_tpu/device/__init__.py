@@ -1,0 +1,94 @@
+"""paddle.device parity namespace + memory stats.
+
+Reference: python/paddle/device/__init__.py and the memory stat counters
+(paddle/phi/core/memory/stats.h -> paddle.device.cuda.max_memory_allocated).
+On TPU, PJRT owns HBM; stats come from jax device memory profiling.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.device import (  # noqa: F401
+    set_device,
+    get_device,
+    current_place,
+    device_count,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    Place,
+    CPUPlace,
+    TPUPlace,
+)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device completes (reference:
+    paddle.device.synchronize / cudaDeviceSynchronize). PJRT equivalent:
+    block_until_ready on a trivial transfer."""
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
+def memory_stats(device=None):
+    dev = jax.devices()[0] if device is None else device
+    try:
+        return dev.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def max_memory_allocated(device=None):
+    return memory_stats(device).get("peak_bytes_in_use", 0)
+
+
+def memory_allocated(device=None):
+    return memory_stats(device).get("bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    return memory_stats(device).get("peak_bytes_in_use", 0)
+
+
+def memory_reserved(device=None):
+    return memory_stats(device).get("bytes_in_use", 0)
+
+
+class cuda:
+    """Alias namespace so reference scripts using paddle.device.cuda.* run."""
+
+    synchronize = staticmethod(synchronize)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    memory_reserved = staticmethod(memory_reserved)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+
+class tpu:
+    synchronize = staticmethod(synchronize)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_allocated = staticmethod(memory_allocated)
+
+    @staticmethod
+    def device_count():
+        return device_count()

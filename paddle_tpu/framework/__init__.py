@@ -1,0 +1,63 @@
+"""Core framework: dtype, device, Tensor, autograd, RNG.
+
+The TPU-native analog of paddle/phi/core + paddle/fluid/eager.
+"""
+import jax as _jax
+
+# float64 / int64 support (Paddle defaults python ints to int64); TPU code
+# paths stay bf16/f32 by construction (creation ops default to float32).
+_jax.config.update("jax_enable_x64", True)
+
+# True-f32 dot/conv accumulation: jax's "default" precision lowers f32 matmul
+# to one-pass bf16 on MXU-class hardware, which breaks Paddle f32 semantics.
+# bf16 inputs (the AMP/bench path) are unaffected by this setting.
+_jax.config.update("jax_default_matmul_precision", "float32")
+
+from .dtype import (  # noqa: E402
+    DType,
+    bool_,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+    convert_dtype,
+    to_jax_dtype,
+    set_default_dtype,
+    get_default_dtype,
+)
+from .device import (  # noqa: E402
+    Place,
+    CPUPlace,
+    TPUPlace,
+    set_device,
+    get_device,
+    current_place,
+    default_jax_device,
+    device_count,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from .tensor import Tensor, to_tensor  # noqa: E402
+from .autograd import (  # noqa: E402
+    no_grad,
+    enable_grad,
+    is_grad_enabled,
+    set_grad_enabled,
+    run_backward,
+    apply_op,
+    GradNode,
+)
+from .random import (  # noqa: E402
+    Generator,
+    seed,
+    get_rng_state,
+    set_rng_state,
+    default_generator,
+)

@@ -1,0 +1,140 @@
+"""Device / place management.
+
+Reference parity: `paddle.set_device` / `paddle.get_device` and the Place
+hierarchy (paddle/phi/common/place.h; python/paddle/device/__init__.py).
+TPU-first design: a "place" names a jax.Device; `set_device('tpu')` selects the
+PJRT TPU client. There are no streams — XLA's async dispatch plays that role
+(SURVEY.md §7 stage 1).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+class Place:
+    """A device place: ('tpu', 0) / ('cpu', 0)."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def jax_device(self):
+        return _jax_device_for(self.device_type, self.device_id)
+
+
+class CPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("cpu", device_id)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+# `axon` is the experimental tunnel platform name for the real chip in this
+# environment; treat it as TPU.
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def _available_platforms():
+    plats = set()
+    for d in jax.devices():
+        plats.add(d.platform.lower())
+    return plats
+
+
+def _jax_device_for(device_type: str, device_id: int = 0):
+    if device_type == "tpu":
+        for plat in _TPU_PLATFORMS:
+            try:
+                devs = jax.devices(plat)
+            except RuntimeError:
+                continue
+            if devs:
+                return devs[min(device_id, len(devs) - 1)]
+        # graceful fallback (tests run with JAX_PLATFORMS=cpu)
+        return jax.devices()[min(device_id, len(jax.devices()) - 1)]
+    if device_type == "cpu":
+        try:
+            devs = jax.devices("cpu")
+            return devs[min(device_id, len(devs) - 1)]
+        except RuntimeError:
+            return jax.devices()[0]
+    raise ValueError(f"unknown device type {device_type!r}")
+
+
+def set_device(device: str) -> Place:
+    """paddle.set_device parity: 'tpu', 'tpu:0', 'cpu'."""
+    if ":" in device:
+        dev_type, _, idx = device.partition(":")
+        device_id = int(idx)
+    else:
+        dev_type, device_id = device, 0
+    if dev_type == "gpu":
+        # the reference's CUDA place; on this framework it aliases tpu
+        dev_type = "tpu"
+    if dev_type not in ("tpu", "cpu"):
+        raise ValueError(
+            f"device must be 'tpu' or 'cpu', got {device!r}"
+        )
+    place = TPUPlace(device_id) if dev_type == "tpu" else CPUPlace(device_id)
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    place = current_place()
+    return f"{place.device_type}:{place.device_id}"
+
+
+def current_place() -> Place:
+    place = getattr(_state, "place", None)
+    if place is None:
+        # default: tpu if a TPU/axon platform is present, else cpu
+        plats = _available_platforms()
+        if plats & set(_TPU_PLATFORMS):
+            place = TPUPlace(0)
+        else:
+            place = CPUPlace(0)
+        _state.place = place
+    return place
+
+
+def default_jax_device():
+    return current_place().jax_device()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return bool(_available_platforms() & set(_TPU_PLATFORMS))
+
+
+def device_count() -> int:
+    return len(jax.devices())

@@ -1,0 +1,131 @@
+"""Dtype system.
+
+Mirrors the reference's dtype surface (paddle/phi/common/data_type.h; Python
+`paddle.float32` etc.) as thin named wrappers over numpy/jax dtypes. TPU-first:
+bfloat16 is a first-class citizen (native MXU dtype), float64 is supported but
+discouraged (TPU emulates it slowly).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class DType:
+    """A framework dtype: a name plus the underlying numpy dtype object."""
+
+    _registry: dict[str, "DType"] = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if name != "bfloat16" else jnp.bfloat16
+        DType._registry[name] = self
+
+    # jax/numpy interop -------------------------------------------------
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        try:
+            return convert_dtype(other) is self
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def is_floating_point(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_integer(self):
+        return self.name in ("int8", "uint8", "int16", "int32", "int64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def itemsize(self):
+        if self.name == "bfloat16":
+            return 2
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", None)  # handled specially
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_NP_TO_DTYPE = {
+    np.dtype(np.bool_): bool_,
+    np.dtype(np.uint8): uint8,
+    np.dtype(np.int8): int8,
+    np.dtype(np.int16): int16,
+    np.dtype(np.int32): int32,
+    np.dtype(np.int64): int64,
+    np.dtype(np.float16): float16,
+    np.dtype(np.float32): float32,
+    np.dtype(np.float64): float64,
+    np.dtype(np.complex64): complex64,
+    np.dtype(np.complex128): complex128,
+}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize str / numpy dtype / jax dtype / DType to a DType."""
+    if dtype is None:
+        raise TypeError("dtype must not be None")
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = dtype
+        if name in DType._registry:
+            return DType._registry[name]
+        raise ValueError(f"unknown dtype name: {dtype!r}")
+    # jnp.bfloat16 is an ml_dtypes scalar type
+    if dtype == jnp.bfloat16 or getattr(dtype, "name", None) == "bfloat16":
+        return bfloat16
+    npd = np.dtype(dtype)
+    if npd in _NP_TO_DTYPE:
+        return _NP_TO_DTYPE[npd]
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d is bfloat16:
+        return jnp.bfloat16
+    return d.np_dtype
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype).is_floating_point
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype parity (float16/bfloat16/float32/float64)."""
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not d.is_floating_point:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+    return d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name

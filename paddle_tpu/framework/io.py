@@ -1,0 +1,72 @@
+"""Single-process checkpoint: paddle.save / paddle.load.
+
+Reference parity: python/paddle/framework/io.py:773 (save) / :1020 (load) —
+pickled nested state_dicts. Tensors serialize as numpy arrays (bfloat16 via
+ml_dtypes survives the round-trip); the distributed sharded checkpoint lives
+in paddle_tpu.distributed.checkpoint.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable tensor container (bfloat16 stored as uint16 raw)."""
+
+    def __init__(self, array):
+        dtype_name = array.dtype.name if hasattr(array.dtype, "name") else str(array.dtype)
+        self.dtype_name = dtype_name
+        if dtype_name == "bfloat16":
+            self.raw = np.asarray(array).view(np.uint16)
+        else:
+            self.raw = np.asarray(array)
+        self.shape = tuple(array.shape)
+
+    def to_array(self):
+        if self.dtype_name == "bfloat16":
+            import jax.numpy as jnp
+
+            return self.raw.view(jnp.bfloat16)
+        return self.raw
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return type(obj)(packed) if not isinstance(obj, tuple) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        arr = obj.to_array()
+        return arr if return_numpy else Tensor(arr)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        vals = [_unpack(v, return_numpy) for v in obj]
+        return tuple(vals) if isinstance(obj, tuple) else vals
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

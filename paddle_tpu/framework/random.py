@@ -1,0 +1,69 @@
+"""RNG state.
+
+Reference parity: phi::Generator (paddle/phi/core/generator.h) — a per-device
+(seed, offset) state consumed by dropout/init kernels. TPU-first: JAX's
+counter-based PRNG; the Generator folds a monotonically increasing offset into
+the base seed, so each eager consumer draws a fresh, reproducible key. The MP
+RNGStatesTracker (fleet/layers/mpu/random.py:34 in the reference) builds on
+this in paddle_tpu.distributed.mpu.random.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._offset = 0
+        return self
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._offset)
+
+    def set_state(self, state):
+        self._seed, self._offset = int(state[0]), int(state[1])
+
+    def next_key(self):
+        with self._lock:
+            off = self._offset
+            self._offset += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), off)
+
+    def split_key(self, n: int):
+        return jax.random.split(self.next_key(), n)
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int):
+    """paddle.seed parity (python/paddle/framework/random.py)."""
+    _default_generator.manual_seed(value)
+    return _default_generator
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+def next_key():
+    return _default_generator.next_key()

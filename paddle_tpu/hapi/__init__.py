@@ -1,0 +1,4 @@
+"""High-level API (python/paddle/hapi/ parity)."""
+from .model import Model, InputSpec  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import Callback  # noqa: F401

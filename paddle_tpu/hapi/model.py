@@ -1,0 +1,268 @@
+"""paddle.Model — the Keras-like high-level API.
+
+Reference parity: python/paddle/hapi/model.py:1082 (fit/evaluate/predict/
+save/load, dygraph adapter :369). The dygraph adapter is the only backend —
+to_static acceleration comes from wrapping train_batch in paddle_tpu.jit.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework import no_grad
+from ..framework.io import save as _save, load as _load
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+
+
+class InputSpec:
+    """Static input description (python/paddle/static/input.py parity)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._scaler = None
+        self.stop_training = False
+        self.mode = "train"
+
+    # -- setup ------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), "metrics must be paddle_tpu.metric.Metric"
+        if amp_configs:
+            from ..amp import GradScaler
+
+            level = amp_configs.get("level", "O1") if isinstance(amp_configs, dict) else amp_configs
+            self._amp_level = level
+            if isinstance(amp_configs, dict) and amp_configs.get("dtype", "bfloat16") == "float16":
+                self._scaler = GradScaler(
+                    init_loss_scaling=amp_configs.get("init_loss_scaling", 2.0**15)
+                )
+        return self
+
+    # -- single-batch ops --------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        lbls = _to_list(labels)
+        if self._loss is None:
+            return outs[0]
+        loss = self._loss(*(outs + lbls))
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        self.mode = "train"
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        inputs = [x if isinstance(x, Tensor) else Tensor(np.asarray(x)) for x in inputs]
+        labels = [y if isinstance(y, Tensor) else Tensor(np.asarray(y)) for y in labels]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        if self._scaler is not None:
+            self._scaler.scale(loss).backward()
+            if update:
+                self._scaler.minimize(self._optimizer, loss)
+        else:
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(*( _to_list(outputs) + labels))))
+            metrics.append(m.accumulate())
+        out = [float(loss)]
+        return (out, metrics) if metrics else out
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        self.mode = "eval"
+        inputs = [x if isinstance(x, Tensor) else Tensor(np.asarray(x)) for x in _to_list(inputs)]
+        labels = [y if isinstance(y, Tensor) else Tensor(np.asarray(y)) for y in _to_list(labels)]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(*( _to_list(outputs) + labels))))
+            metrics.append(m.accumulate())
+        out = [float(loss)]
+        return (out, metrics) if metrics else out
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        self.mode = "predict"
+        inputs = [x if isinstance(x, Tensor) else Tensor(np.asarray(x)) for x in _to_list(inputs)]
+        outputs = self.network(*inputs)
+        return [np.asarray(o._data) for o in _to_list(outputs)]
+
+    # -- loops -------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # assume iterable of batches
+
+    def _split_batch(self, batch):
+        n_in = len(self._inputs) if self._inputs else 1
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            inputs, labels = batch[:n_in], batch[n_in:]
+        else:
+            inputs, labels = [batch], []
+        return inputs, labels
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+
+        cbks = _to_list(callbacks)
+        if verbose:
+            cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbk_list = CallbackList(cbks)
+        cbk_list.set_model(self)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbk_list.set_params({
+            "epochs": epochs, "steps": steps, "verbose": verbose,
+            "metrics": ["loss"] + [n for m in self._metrics for n in _to_list(m.name())],
+        })
+
+        self.stop_training = False
+        cbk_list.on_train_begin()
+        global_step = 0
+        for epoch in range(epochs):
+            cbk_list.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbk_list.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                result = self.train_batch(inputs, labels, update=update)
+                logs = self._result_to_logs(result)
+                cbk_list.on_train_batch_end(step, logs)
+                global_step += 1
+                if num_iters is not None and global_step >= num_iters:
+                    self.stop_training = True
+                    break
+            cbk_list.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size, verbose=0,
+                              callbacks=cbks, num_workers=num_workers)
+            if self.stop_training:
+                break
+        cbk_list.on_train_end(logs)
+        return self
+
+    def _result_to_logs(self, result):
+        logs = {}
+        if isinstance(result, tuple):
+            losses, metrics = result
+            logs["loss"] = losses
+            for m, v in zip(self._metrics, metrics):
+                names = _to_list(m.name())
+                vals = _to_list(v)
+                for n, val in zip(names, vals):
+                    logs[n] = val
+        else:
+            logs["loss"] = result
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = CallbackList(_to_list(callbacks))
+        cbks.set_model(self)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            result = self.eval_batch(inputs, labels)
+            logs = self._result_to_logs(result)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        trainable = 0
+        lines = []
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            if p.trainable:
+                trainable += n
+            lines.append(f"  {name:<50} {str(p.shape):<24} {n}")
+        report = "\n".join(lines)
+        print(f"{'Layer (param)':<52} {'Shape':<24} Param #\n{report}")
+        print(f"Total params: {total}\nTrainable params: {trainable}")
+        return {"total_params": total, "trainable_params": trainable}

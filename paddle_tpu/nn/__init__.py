@@ -1,0 +1,155 @@
+"""paddle.nn parity surface."""
+from .layer.layers import Layer, Parameter, ParamAttr  # noqa: F401
+from .layer.container import (  # noqa: F401
+    Sequential,
+    LayerList,
+    ParameterList,
+    LayerDict,
+)
+from .layer.common import (  # noqa: F401
+    Identity,
+    Linear,
+    Embedding,
+    Dropout,
+    Dropout2D,
+    Dropout3D,
+    AlphaDropout,
+    Flatten,
+    Unflatten,
+    Upsample,
+    UpsamplingBilinear2D,
+    UpsamplingNearest2D,
+    Pad1D,
+    Pad2D,
+    Pad3D,
+    ZeroPad2D,
+    PixelShuffle,
+    PixelUnshuffle,
+    ChannelShuffle,
+    CosineSimilarity,
+    Bilinear,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D,
+    Conv2D,
+    Conv3D,
+    Conv1DTranspose,
+    Conv2DTranspose,
+    Conv3DTranspose,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm,
+    BatchNorm1D,
+    BatchNorm2D,
+    BatchNorm3D,
+    SyncBatchNorm,
+    LayerNorm,
+    RMSNorm,
+    GroupNorm,
+    InstanceNorm1D,
+    InstanceNorm2D,
+    InstanceNorm3D,
+    LocalResponseNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D,
+    MaxPool2D,
+    MaxPool3D,
+    AvgPool1D,
+    AvgPool2D,
+    AvgPool3D,
+    AdaptiveAvgPool1D,
+    AdaptiveAvgPool2D,
+    AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D,
+    AdaptiveMaxPool3D,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU,
+    ReLU6,
+    GELU,
+    Sigmoid,
+    Tanh,
+    Silu,
+    Swish,
+    Mish,
+    Hardswish,
+    Hardsigmoid,
+    Hardtanh,
+    LeakyReLU,
+    ELU,
+    SELU,
+    CELU,
+    PReLU,
+    RReLU,
+    Softplus,
+    Softsign,
+    Softshrink,
+    Hardshrink,
+    Tanhshrink,
+    ThresholdedReLU,
+    LogSigmoid,
+    Softmax,
+    LogSoftmax,
+    Maxout,
+    GLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss,
+    MSELoss,
+    L1Loss,
+    SmoothL1Loss,
+    NLLLoss,
+    BCELoss,
+    BCEWithLogitsLoss,
+    KLDivLoss,
+    HingeEmbeddingLoss,
+    MarginRankingLoss,
+    CosineEmbeddingLoss,
+    TripletMarginLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention,
+    TransformerEncoderLayer,
+    TransformerEncoder,
+    TransformerDecoderLayer,
+    TransformerDecoder,
+    Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    SimpleRNN,
+    LSTM,
+    GRU,
+    LSTMCell,
+    GRUCell,
+    SimpleRNNCell,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue,
+    ClipGradByNorm,
+    ClipGradByGlobalNorm,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+
+from .clip import clip_grad_norm_  # noqa: F401
+
+
+class utils:  # namespace parity: paddle.nn.utils
+    from .clip import clip_grad_norm_  # noqa: F401
+
+    @staticmethod
+    def parameters_to_vector(parameters, name=None):
+        from ..ops import concat, reshape
+
+        return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+    @staticmethod
+    def vector_to_parameters(vec, parameters, name=None):
+        offset = 0
+        for p in parameters:
+            n = p.size
+            chunk = vec[offset : offset + n]
+            p.set_value(chunk.reshape(p.shape))
+            offset += n

@@ -1,0 +1,202 @@
+"""Activation functionals (python/paddle/nn/functional/activation.py parity;
+reference kernels paddle/phi/kernels/activation_kernel.h).
+
+All are single-HLO elementwise ops that XLA fuses into surrounding matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import unary, binary, ensure_tensor
+
+
+def relu(x, name=None):
+    return unary(jax.nn.relu, x, "relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._inplace_from(out)
+    return x
+
+
+def relu6(x, name=None):
+    return unary(jax.nn.relu6, x, "relu6")
+
+
+def sigmoid(x, name=None):
+    return unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def tanh(x, name=None):
+    return unary(jnp.tanh, x, "tanh")
+
+
+def gelu(x, approximate=False, name=None):
+    return unary(lambda v: jax.nn.gelu(v, approximate=approximate), x, "gelu")
+
+
+def silu(x, name=None):
+    return unary(jax.nn.silu, x, "silu")
+
+
+def swish(x, name=None):
+    return unary(jax.nn.silu, x, "swish")
+
+
+def mish(x, name=None):
+    return unary(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x, "mish")
+
+
+def hardswish(x, name=None):
+    return unary(lambda v: v * jnp.clip(v + 3, 0, 6) / 6, x, "hardswish")
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return unary(lambda v: jnp.clip(slope * v + offset, 0, 1), x, "hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return unary(lambda v: jnp.clip(v, min, max), x, "hardtanh")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return unary(lambda v: jnp.where(v >= 0, v, negative_slope * v), x, "leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return unary(lambda v: jax.nn.elu(v, alpha=alpha), x, "elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return unary(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x, "selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return unary(lambda v: jax.nn.celu(v, alpha=alpha), x, "celu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            wv = w.reshape(())
+        else:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape[ch_axis] = w.size
+            wv = w.reshape(shape)
+        return jnp.where(v >= 0, v, wv * v)
+
+    return binary(f, x, ensure_tensor(weight), "prelu")
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, name=None):
+    from ...framework.random import next_key
+
+    if training:
+        key = next_key()
+        x = ensure_tensor(x)
+
+        def f(v):
+            slope = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, slope * v)
+
+        return unary(f, x, "rrelu")
+    mid = (lower + upper) / 2
+    return leaky_relu(x, mid)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return unary(
+        lambda v: jnp.where(beta * v > threshold, v, jax.nn.softplus(beta * v) / beta),
+        x, "softplus",
+    )
+
+
+def softsign(x, name=None):
+    return unary(jax.nn.soft_sign, x, "softsign")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return unary(
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        x, "softshrink",
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return unary(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x, "hardshrink")
+
+
+def tanhshrink(x, name=None):
+    return unary(lambda v: v - jnp.tanh(v), x, "tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return unary(lambda v: jnp.where(v > threshold, v, value), x, "thresholded_relu")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+
+    d = to_jax_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+
+    return unary(f, x, "softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+
+    d = to_jax_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return unary(f, x, "log_softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._inplace_from(out)
+    return x
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+
+    key = next_key()
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False) if hasattr(jnp, "put_along_axis") else jax.nn.one_hot(jnp.squeeze(idx, axis), v.shape[axis], axis=axis, dtype=v.dtype)
+            y = y_hard + jax.lax.stop_gradient(-y) + y  # straight-through
+        return y
+
+    return unary(f, ensure_tensor(x), "gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        c = v.shape[axis]
+        new_shape = list(v.shape)
+        new_shape[axis] = c // groups
+        new_shape.insert(axis + 1, groups)
+        return jnp.max(v.reshape(new_shape), axis=axis + 1)
+
+    return unary(f, x, "maxout")
+
+
+def glu(x, axis=-1, name=None):
+    return unary(lambda v: jax.nn.glu(v, axis=axis), x, "glu")

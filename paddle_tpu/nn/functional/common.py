@@ -1,0 +1,273 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, etc.
+
+Reference parity: python/paddle/nn/functional/common.py + input.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...framework.random import next_key
+from ...ops._dispatch import unary, binary, nary, ensure_tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. Reference: phi FC; weight layout [in, out] like paddle."""
+    if bias is not None:
+        return nary(
+            lambda v, w, b: jnp.matmul(v, w) + b,
+            [ensure_tensor(x), ensure_tensor(weight), ensure_tensor(bias)],
+            "linear",
+        )
+    return binary(jnp.matmul, ensure_tensor(x), ensure_tensor(weight), "linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """Reference: phi dropout kernel; TPU: stateless jax PRNG key per call
+    (key drawn eagerly so the recorded vjp is deterministic)."""
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x.clone()
+    if p == 1.0:
+        from ...ops import zeros_like
+
+        return zeros_like(x)
+    key = next_key()
+
+    def f(v):
+        if axis is None:
+            mask_shape = v.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(v.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return unary(f, x, "dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x.clone()
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / ((1 - p) * (1 + p * alpha_p**2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return unary(f, x, "alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: phi embedding kernel; gather rows of the table. The TP
+    variant lives in distributed.mpu (VocabParallelEmbedding)."""
+
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return binary(lambda idx, w: f(idx, w), ensure_tensor(x), ensure_tensor(weight), "embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return unary(
+        lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes, dtype=jnp.float32),
+        ensure_tensor(x), "one_hot",
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(v):
+        k = v.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1 - epsilon) * v + epsilon * pd
+        return (1 - epsilon) * v + epsilon / k
+
+    return unary(f, ensure_tensor(label), "label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    channel_last = data_format[-1] == "C"
+    spatial = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    in_sizes = [x.shape[i] for i in spatial]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sizes = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(in_sizes)
+        out_sizes = [int(s * f) for s, f in zip(in_sizes, sf)]
+
+    method = {"nearest": "nearest", "bilinear": "bilinear", "trilinear": "trilinear",
+              "bicubic": "bicubic", "linear": "linear", "area": "linear"}[mode]
+
+    def f(v):
+        shape = list(v.shape)
+        for ax, s in zip(spatial, out_sizes):
+            shape[ax] = s
+        return jax.image.resize(v, shape, method=method).astype(v.dtype)
+
+    return unary(f, x, "interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(v):
+        n, c, h, w = v.shape
+        vp = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])))
+        oh = (vp.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (vp.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(
+                    vp[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]]
+                )
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return unary(f, x, "unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[1], os_[1] + pd[2] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        vv = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]].add(
+                    vv[:, :, i, j]
+                )
+        return out[:, :, pd[0] : ph - pd[1], pd[2] : pw - pd[3]]
+
+    return unary(f, x, "fold")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return unary(f, x, "pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        v = v.transpose(0, 1, 3, 5, 2, 4)
+        return v.reshape(n, c * r * r, h // r, w // r)
+
+    return unary(f, x, "pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, groups, c // groups, h, w)
+        return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return unary(f, x, "channel_shuffle")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return binary(f, ensure_tensor(x1), ensure_tensor(x2), "cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True), 1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return unary(f, x, "normalize")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    tensors = [ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)]
+
+    def f(a, b, w, bb=None):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb is not None:
+            out = out + bb
+        return out
+
+    if bias is not None:
+        return nary(lambda a, b, w, bb: f(a, b, w, bb), tensors + [ensure_tensor(bias)], "bilinear")
+    return nary(f, tensors, "bilinear")

@@ -1,0 +1,170 @@
+"""Convolution functionals.
+
+Reference parity: python/paddle/nn/functional/conv.py (conv2d etc., backed by
+phi conv kernels / cuDNN). TPU-first: `jax.lax.conv_general_dilated` lowers to
+XLA convolution, which the TPU compiler maps onto the MXU; NCHW in/out layouts
+match the reference while XLA is free to pick internal layouts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import nary, ensure_tensor
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # paddle [lo, hi] pairs
+            return tuple(v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding_arg(padding, n, stride, dilation, kernel):
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "SAME":
+            return "SAME"
+        if p == "VALID":
+            return "VALID"
+        raise ValueError(padding)
+    if isinstance(padding, (list, tuple)) and len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    if isinstance(padding, (list, tuple)) and len(padding) == n and isinstance(padding[0], (list, tuple)):
+        return [tuple(p) for p in padding]
+    pads = _tuplize(padding, n)
+    return [(p, p) for p in pads]
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NCH", "OIH", "NCH") if not channel_last else ("NHC", "OIH", "NHC")
+    if n == 2:
+        return ("NCHW", "OIHW", "NCHW") if not channel_last else ("NHWC", "OIHW", "NHWC")
+    return ("NCDHW", "OIDHW", "NCDHW") if not channel_last else ("NDHWC", "OIDHW", "NDHWC")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format[-1] == "C"
+    strides = _tuplize(stride, n)
+    dilations = _tuplize(dilation, n)
+    kernel = None
+    pad_arg = _padding_arg(padding, n, strides, dilations, kernel)
+    dn = _dim_numbers(n, channel_last)
+
+    def f(v, w, *maybe_bias):
+        out = jax.lax.conv_general_dilated(
+            v, w,
+            window_strides=strides,
+            padding=pad_arg,
+            rhs_dilation=dilations,
+            feature_group_count=groups,
+            dimension_numbers=dn,
+            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None,
+        )
+        if out.dtype != v.dtype:
+            out = out.astype(v.dtype)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    inputs = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return nary(f, inputs, f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    channel_last = data_format[-1] == "C"
+    strides = _tuplize(stride, n)
+    dilations = _tuplize(dilation, n)
+    pads = _padding_arg(padding, n, strides, dilations, None)
+    opads = _tuplize(output_padding, n)
+    dn = _dim_numbers(n, channel_last)
+
+    def f(v, w, *maybe_bias):
+        # paddle/torch weight layout for transpose conv: [in, out/groups, *k]
+        # jax transpose conv via conv_general_dilated with lhs_dilation
+        kshape = w.shape[2:]
+        if isinstance(pads, str):
+            pad_list = None
+        else:
+            pad_list = pads
+        # effective padding for fractionally-strided conv
+        tpads = []
+        for i in range(n):
+            k = (kshape[i] - 1) * dilations[i]
+            if pad_list is None:
+                lo = hi = 0
+            else:
+                lo, hi = pad_list[i]
+            tpads.append((k - lo, k - hi + opads[i]))
+        # flip spatial dims and swap in/out channels
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        wt = jnp.swapaxes(wt, 0, 1)  # [out/groups, in, *k]
+        if groups > 1:
+            ci = w.shape[0]
+            co_g = w.shape[1]
+            wt = w.reshape(groups, ci // groups, co_g, *kshape)
+            wt = jnp.flip(wt, axis=tuple(range(3, 3 + n)))
+            wt = jnp.swapaxes(wt, 1, 2).reshape(groups * co_g, ci // groups, *kshape)
+        out = jax.lax.conv_general_dilated(
+            v, wt,
+            window_strides=(1,) * n,
+            padding=tpads,
+            lhs_dilation=strides,
+            rhs_dilation=dilations,
+            feature_group_count=groups,
+            dimension_numbers=dn,
+        )
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    inputs = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return nary(f, inputs, f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

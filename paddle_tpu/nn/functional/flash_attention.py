@@ -1,0 +1,108 @@
+"""Attention functionals.
+
+Reference parity: python/paddle/nn/functional/flash_attention.py
+(flash_attention :195, scaled_dot_product_attention :976) backed by the CUDA
+flash-attn kernel (paddle/phi/kernels/gpu/flash_attn_kernel.cu). TPU-first:
+the default path is XLA dot-softmax-dot (which XLA already pipelines well at
+moderate seq len); a Pallas splash/flash kernel is used for long sequences
+when available (paddle_tpu.ops.pallas.flash_attention).
+
+Layouts follow the reference: q/k/v are [batch, seqlen, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...framework.random import next_key
+from ...ops._dispatch import nary, ensure_tensor
+
+_PALLAS_MIN_SEQ = 1024  # below this, plain XLA attention is already optimal
+
+
+def _sdpa_ref(q, k, v, mask, scale, causal, dropout_p, key):
+    # q,k,v: [b, s, h, d] — compute in fp32, output in input dtype
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cmask, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    return out.astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    query = ensure_tensor(query)
+    key_t = ensure_tensor(key)
+    value = ensure_tensor(value)
+    head_dim = query.shape[-1]
+    scale = 1.0 / (head_dim ** 0.5)
+    drop = dropout_p if training else 0.0
+    rng = next_key() if drop > 0.0 else None
+
+    seqlen = query.shape[1]
+    use_pallas = False
+    if seqlen >= _PALLAS_MIN_SEQ and attn_mask is None and drop == 0.0:
+        try:
+            from ...ops.pallas import flash_attention as pallas_flash  # noqa: F401
+
+            use_pallas = pallas_flash.is_available()
+        except Exception:
+            use_pallas = False
+
+    if use_pallas:
+        from ...ops.pallas import flash_attention as pallas_flash
+
+        inputs = [query, key_t, value]
+        return nary(
+            lambda q, k, v: pallas_flash.flash_attention(q, k, v, causal=is_causal, scale=scale),
+            inputs, "flash_attention_pallas",
+        )
+
+    inputs = [query, key_t, value]
+    if attn_mask is not None:
+        inputs.append(ensure_tensor(attn_mask))
+
+        def f(q, k, v, m):
+            return _sdpa_ref(q, k, v, m, scale, is_causal, drop, rng)
+    else:
+
+        def f(q, k, v):
+            return _sdpa_ref(q, k, v, None, scale, is_causal, drop, rng)
+
+    return nary(f, inputs, "scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """flash_attention parity (reference :195). Returns (out, softmax or None)."""
+    out = scaled_dot_product_attention(
+        query, key, value, attn_mask=None, dropout_p=dropout,
+        is_causal=causal, training=training,
+    )
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    raise NotImplementedError("varlen flash attention lands with the pallas kernel pack")
+
+
+def sparse_attention(*args, **kwargs):
+    raise NotImplementedError("sparse attention is not in the TPU v1 op set")

@@ -1,0 +1,265 @@
+"""Loss functionals (python/paddle/nn/functional/loss.py parity;
+reference kernels cross_entropy (softmax_with_cross_entropy), bce, mse...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops._dispatch import unary, binary, nary, ensure_tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """softmax_with_cross_entropy parity. Computed in fp32 via log_softmax
+    (numerically-stable fused form — XLA fuses the exp/sum/sub chain)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def f(logits, lbl, *maybe_w):
+        x32 = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(x32, axis=axis) if use_softmax else jnp.log(jnp.maximum(x32, 1e-30))
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape[axis] == logits.shape[axis] and jnp.issubdtype(lbl.dtype, jnp.floating)):
+            soft = lbl.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                soft = soft * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            idx = lbl.astype(jnp.int32)
+            if idx.ndim == logits.ndim:
+                idx = jnp.squeeze(idx, axis=axis)
+            k = logits.shape[axis]
+            safe_idx = jnp.where(idx == ignore_index, 0, idx)
+            picked = jnp.take_along_axis(
+                jnp.moveaxis(logp, axis, -1),
+                safe_idx[..., None],
+                axis=-1,
+            )[..., 0]
+            if label_smoothing > 0:
+                smooth_term = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth_term
+            loss = -picked
+            valid = idx != ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+            if maybe_w:
+                w = maybe_w[0].astype(jnp.float32)[safe_idx]
+                loss = loss * jnp.where(valid, w, 0.0)
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, w, 0.0))
+                    return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(jnp.float32))
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce(loss, reduction)
+
+    inputs = [input, label]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    return nary(f, inputs, "cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from .activation import softmax
+
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def f(logp, lbl, *maybe_w):
+        idx = lbl.astype(jnp.int32)
+        safe_idx = jnp.where(idx == ignore_index, 0, idx)
+        picked = jnp.take_along_axis(logp, safe_idx[..., None], axis=-1)[..., 0]
+        loss = -picked
+        valid = idx != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if maybe_w:
+            w = maybe_w[0][safe_idx]
+            loss = loss * jnp.where(valid, w, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    inputs = [input, label]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    return nary(f, inputs, "nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return binary(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                  ensure_tensor(input), ensure_tensor(label), "mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return binary(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                  ensure_tensor(input), ensure_tensor(label), "l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        # paddle smooth_l1 = huber with delta scaling; keep huber form
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return binary(f, ensure_tensor(input), ensure_tensor(label), "smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *maybe_w):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-7)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log(1 - p32))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+
+    inputs = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    return nary(f, inputs, "bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *rest):
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        i = 0
+        pw = None
+        w = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        # log(1+exp(-|z|)) stable form
+        max_val = jnp.maximum(-z32, 0)
+        if pw is not None:
+            log_w = (pw - 1) * y32 + 1
+            loss = (1 - y32) * z32 + log_w * (jnp.log(jnp.exp(-max_val) + jnp.exp(-z32 - max_val)) + max_val)
+        else:
+            loss = (1 - y32) * z32 + max_val + jnp.log(jnp.exp(-max_val) + jnp.exp(-z32 - max_val))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    inputs = [ensure_tensor(logit), ensure_tensor(label)]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if pos_weight is not None:
+        inputs.append(ensure_tensor(pos_weight))
+    return nary(f, inputs, "bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - lp)
+        else:
+            loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return binary(f, ensure_tensor(input), ensure_tensor(label), "kl_div")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+
+    return binary(f, ensure_tensor(input), ensure_tensor(label), "hinge_embedding")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return nary(
+        lambda x1, x2, y: _reduce(jnp.maximum(0.0, -y * (x1 - x2) + margin), reduction),
+        [ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)],
+        "margin_ranking",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return nary(f, [ensure_tensor(input1), ensure_tensor(input2), ensure_tensor(label)],
+                "cosine_embedding")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2, eps=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + eps, p), axis=-1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + eps, p), axis=-1), 1 / p)
+        if swap:
+            dn2 = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + eps, p), axis=-1), 1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return nary(f, [ensure_tensor(input), ensure_tensor(positive), ensure_tensor(negative)],
+                "triplet_margin")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *maybe_norm):
+        p = jax.nn.sigmoid(z.astype(jnp.float32))
+        ce = binary_ce_logits_raw(z.astype(jnp.float32), y.astype(jnp.float32))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if maybe_norm:
+            loss = loss / maybe_norm[0]
+        return _reduce(loss, reduction)
+
+    inputs = [ensure_tensor(logit), ensure_tensor(label)]
+    if normalizer is not None:
+        inputs.append(ensure_tensor(normalizer))
+    return nary(f, inputs, "sigmoid_focal")
+
+
+def binary_ce_logits_raw(z, y):
+    max_val = jnp.maximum(-z, 0)
+    return (1 - y) * z + max_val + jnp.log(jnp.exp(-max_val) + jnp.exp(-z - max_val))
+
+
+def square_error_cost(input, label):
+    return binary(lambda a, b: jnp.square(a - b), ensure_tensor(input), ensure_tensor(label),
+                  "square_error_cost")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss lands with the audio op pack")

@@ -1,0 +1,219 @@
+"""Normalization functionals (python/paddle/nn/functional/norm.py parity;
+reference kernels paddle/phi/kernels/{batch_norm,layer_norm,group_norm}_kernel.h).
+
+Stats are computed in float32 regardless of input dtype (bf16-safe on TPU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops._dispatch import nary, ensure_tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        v32 = v.astype(jnp.float32)
+        mean = jnp.mean(v32, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v32 - mean), axis=axes, keepdims=True)
+        out = (v32 - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    inputs = [ensure_tensor(x)]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return nary(f, inputs, "layer_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """Running stats are updated in-place on the passed tensors (reference
+    batch_norm kernel semantics, momentum as paddle: new = m*old + (1-m)*batch)."""
+    x = ensure_tensor(x)
+    channel_axis = 1 if data_format[1] == "C" else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch_stats = training and not use_global_stats
+
+    bshape = [1] * x.ndim
+    bshape[channel_axis] = x.shape[channel_axis]
+
+    if use_batch_stats:
+        x32 = x._data.astype(jnp.float32)
+        batch_mean = jnp.mean(x32, axis=reduce_axes)
+        batch_var = jnp.var(x32, axis=reduce_axes)
+        # update running stats eagerly (host-side state, like the reference)
+        if running_mean is not None:
+            rm = ensure_tensor(running_mean)
+            rm._data = (momentum * rm._data + (1 - momentum) * batch_mean).astype(rm._data.dtype)
+        if running_var is not None:
+            n = 1
+            for ax in reduce_axes:
+                n *= x.shape[ax]
+            unbiased = batch_var * (n / max(n - 1, 1))
+            rv = ensure_tensor(running_var)
+            rv._data = (momentum * rv._data + (1 - momentum) * unbiased).astype(rv._data.dtype)
+
+        def f(v, *wb):
+            v32 = v.astype(jnp.float32)
+            mean = jnp.mean(v32, axis=reduce_axes).reshape(bshape)
+            var = jnp.var(v32, axis=reduce_axes).reshape(bshape)
+            out = (v32 - mean) / jnp.sqrt(var + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(bshape).astype(jnp.float32)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(bshape).astype(jnp.float32)
+            return out.astype(v.dtype)
+
+        inputs = [x]
+    else:
+        def f(v, m, var_, *wb):
+            v32 = v.astype(jnp.float32)
+            out = (v32 - m.reshape(bshape)) / jnp.sqrt(var_.reshape(bshape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(bshape).astype(jnp.float32)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(bshape).astype(jnp.float32)
+            return out.astype(v.dtype)
+
+        inputs = [x, ensure_tensor(running_mean), ensure_tensor(running_var)]
+
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return nary(f, inputs, "batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    x = ensure_tensor(x)
+    spatial_axes = tuple(range(2, x.ndim))
+    bshape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+
+    def f(v, *wb):
+        v32 = v.astype(jnp.float32)
+        mean = jnp.mean(v32, axis=spatial_axes, keepdims=True)
+        var = jnp.var(v32, axis=spatial_axes, keepdims=True)
+        out = (v32 - mean) / jnp.sqrt(var + eps)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape).astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    inputs = [x]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return nary(f, inputs, "instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def f(v, *wb):
+        n, c = v.shape[0], v.shape[1]
+        rest = v.shape[2:]
+        v32 = v.astype(jnp.float32).reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, v32.ndim))
+        mean = jnp.mean(v32, axis=axes, keepdims=True)
+        var = jnp.var(v32, axis=axes, keepdims=True)
+        out = ((v32 - mean) / jnp.sqrt(var + epsilon)).reshape(n, c, *rest)
+        bshape = [1, c] + [1] * len(rest)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape).astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    inputs = [x]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return nary(f, inputs, "group_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (the LLaMA norm; the reference ships it as a fused kernel in
+    paddle/phi/kernels/fusion/). Stats in fp32, output in input dtype."""
+
+    def f(v, *wb):
+        v32 = v.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(v32), axis=-1, keepdims=True)
+        out = v32 * jax_rsqrt(ms + epsilon)
+        if wb:
+            out = out * wb[0].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    inputs = [ensure_tensor(x)]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    return nary(f, inputs, "rms_norm")
+
+
+def jax_rsqrt(v):
+    import jax
+
+    return jax.lax.rsqrt(v)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        sq = jnp.square(v.astype(jnp.float32))
+        c = v.shape[1]
+        half = size // 2
+        pad = jnp.pad(sq, [(0, 0), (half, size - half - 1)] + [(0, 0)] * (v.ndim - 2))
+        acc = jnp.zeros_like(sq)
+        for i in range(size):
+            acc = acc + pad[:, i : i + c]
+        div = jnp.power(k + alpha * acc / size, beta)
+        return (v.astype(jnp.float32) / div).astype(v.dtype)
+
+    return nary(f, [x], "local_response_norm")
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    w = ensure_tensor(weight)
+
+    def f(v):
+        wm = jnp.moveaxis(v, dim, 0).reshape(v.shape[dim], -1).astype(jnp.float32)
+        u = jnp.ones((wm.shape[0],), jnp.float32)
+        vv = jnp.ones((wm.shape[1],), jnp.float32)
+        for _ in range(power_iters):
+            vv = wm.T @ u
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            u = wm @ vv
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ vv
+        return (v.astype(jnp.float32) / sigma).astype(v.dtype)
+
+    return nary(f, [w], "spectral_norm")

@@ -1,0 +1,160 @@
+"""Pooling functionals (python/paddle/nn/functional/pooling.py parity;
+reference kernels paddle/phi/kernels/pool_kernel.h). XLA reduce_window maps
+these to efficient TPU windowed reductions.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import unary, ensure_tensor
+from .conv import _tuplize
+
+
+def _pool_nd(x, kernel, stride, padding, n, reducer, init, ceil_mode=False,
+             data_format="NCHW", count_include_pad=True, average=False):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    ks = _tuplize(kernel, n)
+    st = _tuplize(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        p = _tuplize(padding, n)
+        pads = [(int(pi), int(pi)) for pi in p]
+
+    if channel_last:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pad_full = [(0, 0)] + pads + [(0, 0)] if isinstance(pads, list) else pads
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pad_full = [(0, 0), (0, 0)] + pads if isinstance(pads, list) else pads
+
+    def f(v):
+        if average:
+            zero = jnp.zeros((), v.dtype)
+            summed = jax.lax.reduce_window(
+                v, zero, jax.lax.add, window, strides, padding=pad_full
+            )
+            if count_include_pad or not isinstance(pad_full, list) or all(p == (0, 0) for p in pad_full):
+                denom = np.prod(ks)
+                return (summed / denom).astype(v.dtype)
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(
+                ones, zero, jax.lax.add, window, strides, padding=pad_full
+            )
+            return (summed / counts).astype(v.dtype)
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            init_v = jnp.array(-jnp.inf, v.dtype)
+        else:
+            init_v = jnp.array(jnp.iinfo(v.dtype).min, v.dtype)
+        return jax.lax.reduce_window(
+            v, init_v, reducer, window, strides, padding=pad_full
+        )
+
+    return unary(f, x, "pool")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
+                   lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                   ceil_mode, data_format)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
+                   lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                   ceil_mode, data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
+                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    ceil_mode, data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.add, lambda d: 0,
+                    ceil_mode, data_format, count_include_pad=not exclusive, average=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.add, lambda d: 0,
+                    ceil_mode, data_format, count_include_pad=not exclusive, average=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.add, lambda d: 0,
+                    ceil_mode, data_format, count_include_pad=not exclusive, average=True)
+
+
+def _adaptive_sizes(in_size, out_size):
+    # start/end indices per output cell (paddle adaptive pooling semantics)
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, average, data_format):
+    x = ensure_tensor(x)
+    channel_last = data_format[-1] == "C"
+    spatial_axes = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+    out_sizes = _tuplize(output_size, n)
+
+    def f(v):
+        out = v
+        for ax, osz in zip(spatial_axes, out_sizes):
+            isz = out.shape[ax]
+            if isz % osz == 0:
+                # uniform: reshape + reduce (fast path)
+                k = isz // osz
+                new_shape = list(out.shape)
+                new_shape[ax : ax + 1] = [osz, k]
+                r = out.reshape(new_shape)
+                out = jnp.mean(r, axis=ax + 1) if average else jnp.max(r, axis=ax + 1)
+            else:
+                starts, ends = _adaptive_sizes(isz, osz)
+                slices = []
+                for s, e in zip(starts, ends):
+                    sl = jax.lax.slice_in_dim(out, s, e, axis=ax)
+                    red = jnp.mean(sl, axis=ax, keepdims=True) if average else jnp.max(sl, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return unary(f, x, "adaptive_pool")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, True, "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, True, data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, True, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, "NCDHW")

@@ -1,0 +1,352 @@
+"""Layer base class + Parameter.
+
+Reference parity: python/paddle/nn/layer/layers.py (paddle.nn.Layer) and
+EagerParamBase (python/paddle/base/framework.py). The parameter store is a
+flat dict per layer, recursively composed — which doubles as the functional
+pytree view used by the jit/pjit bridge (paddle_tpu.jit): state_dict() in,
+updated state out.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...framework.dtype import convert_dtype, get_default_dtype
+
+_param_counter = itertools.count()
+
+
+class Parameter(Tensor):
+    """A trainable leaf tensor (reference: EagerParamBase)."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable)
+        self.name = name or f"param_{next(_param_counter)}"
+        self.persistable = True
+        self.trainable = trainable
+
+    @property
+    def is_parameter(self):
+        return True
+
+
+class ParamAttr:
+    """paddle.ParamAttr parity (python/paddle/base/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        # an initializer instance
+        return ParamAttr(initializer=attr)
+
+
+class Layer:
+    """Base building block (paddle.nn.Layer parity)."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute interception -----------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            for store in (layers, buffers):
+                if store is not None and name in store:
+                    del store[name]
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            for store in (params, buffers):
+                if store is not None and name in store:
+                    del store[name]
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            else:
+                raise TypeError(f"cannot assign non-Parameter to parameter {name!r}")
+        elif buffers is not None and name in buffers:
+            buffers[name] = value if (value is None or isinstance(value, Tensor)) else Tensor(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store_name in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(store_name)
+            if store is not None and name in store:
+                return store[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store_name in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(store_name)
+            if store is not None and name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- parameter creation ---------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from ..initializer import Constant, XavierUniform
+
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype or get_default_dtype()
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        data = init(shape, dtype)
+        p = Parameter(data, dtype=dtype, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        p.is_distributed = False
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- traversal -------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+            if not include_sublayers:
+                break
+
+    def children(self):
+        return [l for _, l in self.named_children()]
+
+    def named_children(self):
+        for name, layer in self._sub_layers.items():
+            if layer is not None:
+                yield name, layer
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(prefix=sub_prefix, include_self=True)
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- modes -----------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict ------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        dest = OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, layer in self.named_sublayers(include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                dest[f"{name}.{bname}" if name else bname] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, value in state_dict.items():
+            if name not in own:
+                unexpected.append(name)
+                continue
+            target = own[name]
+            v = value if isinstance(value, Tensor) else Tensor(np.asarray(value))
+            if list(v.shape) != list(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: loaded {v.shape} vs model {target.shape}"
+                )
+            target.set_value(v.astype(target.dtype))
+        for name in own:
+            if name not in state_dict:
+                missing.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- dtype / device movement ----------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        def move(t):
+            if t is None:
+                return t
+            out = t
+            if dtype is not None and out.dtype.is_floating_point:
+                out_data = out._data.astype(
+                    __import__("paddle_tpu").framework.to_jax_dtype(dtype)
+                )
+                t._data = out_data
+            if device is not None:
+                import jax
+                from ...framework.device import CPUPlace, TPUPlace
+
+                place = device
+                if isinstance(device, str):
+                    place = CPUPlace() if device.startswith("cpu") else TPUPlace()
+                t._data = jax.device_put(t._data, place.jax_device())
+            return t
+
+        for layer in self.sublayers(include_self=True):
+            for p in layer._parameters.values():
+                move(p)
+            for b in layer._buffers.values():
+                move(b)
+        if dtype is not None:
+            self._dtype = convert_dtype(dtype).name
+        return self
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    # -- hooks ------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call -------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).split("\n")
+            rep = [rep[0]] + ["  " + l for l in rep[1:]]
+            lines.append(f"  ({name}): " + "\n".join(rep))
+        main = f"{self.__class__.__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+class _HookHandle:
+    _counter = itertools.count()
+
+    def __init__(self, store):
+        self.id = next(_HookHandle._counter)
+        self._store = store
+
+    def remove(self):
+        self._store.pop(self.id, None)

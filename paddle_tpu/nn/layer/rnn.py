@@ -1,0 +1,309 @@
+"""Recurrent layers (python/paddle/nn/layer/rnn.py parity).
+
+TPU-first: the time loop is a `jax.lax.scan` inside one recorded op — a single
+compiled XLA while-loop instead of the reference's per-step kernel launches
+(paddle/phi/kernels/gpu/rnn_kernel.cu).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops._dispatch import nary, ensure_tensor
+from .layers import Layer
+from ..initializer import Uniform
+
+
+def _lstm_step(carry, x_t, wi, wh, bi, bh):
+    h, c = carry
+    gates = x_t @ wi.T + h @ wh.T
+    if bi is not None:
+        gates = gates + bi + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def _gru_step(carry, x_t, wi, wh, bi, bh):
+    h = carry
+    gi = x_t @ wi.T + (bi if bi is not None else 0)
+    gh = h @ wh.T + (bh if bh is not None else 0)
+    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(ic + r * hc)
+    h_new = (1 - z) * n + z * h
+    return h_new, h_new
+
+
+def _rnn_step(carry, x_t, wi, wh, bi, bh, act):
+    h = carry
+    out = x_t @ wi.T + h @ wh.T
+    if bi is not None:
+        out = out + bi + bh
+    h_new = jnp.tanh(out) if act == "tanh" else jax.nn.relu(out)
+    return h_new, h_new
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, activation="tanh"):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.num_directions = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+
+        std = 1.0 / math.sqrt(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction_i in range(self.num_directions):
+                in_size = input_size if layer == 0 else hidden_size * self.num_directions
+                suffix = "_reverse" if direction_i else ""
+                wi = self.create_parameter(
+                    [gate_mult * hidden_size, in_size], attr=weight_ih_attr,
+                    default_initializer=Uniform(-std, std))
+                wh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], attr=weight_hh_attr,
+                    default_initializer=Uniform(-std, std))
+                bi = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_ih_attr, is_bias=True,
+                    default_initializer=Uniform(-std, std))
+                bh = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_hh_attr, is_bias=True,
+                    default_initializer=Uniform(-std, std))
+                names = [f"weight_ih_l{layer}{suffix}", f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}", f"bias_hh_l{layer}{suffix}"]
+                for n, p in zip(names, (wi, wh, bi, bh)):
+                    self.add_parameter(n, p)
+                self._all_weights.append(names)
+
+    def _run_layer(self, x, wi, wh, bi, bh, init, reverse=False):
+        # x: [seq, batch, in]; returns outputs [seq, batch, hidden], final state
+        step = {"LSTM": _lstm_step, "GRU": _gru_step, "RNN": _rnn_step}[self.mode]
+
+        def scan_fn(carry, x_t):
+            if self.mode == "RNN":
+                return step(carry, x_t, wi, wh, bi, bh, self.activation)
+            return step(carry, x_t, wi, wh, bi, bh)
+
+        xs = jnp.flip(x, 0) if reverse else x
+        final, ys = jax.lax.scan(scan_fn, init, xs)
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        return ys, final
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        batch_axis = 1 if self.time_major else 0
+        batch = inputs.shape[batch_axis]
+        D, L, H = self.num_directions, self.num_layers, self.hidden_size
+
+        params = []
+        for names in self._all_weights:
+            params.extend(self._parameters[n] for n in names)
+
+        has_lstm_state = self.mode == "LSTM"
+        if initial_states is None:
+            from ...ops import zeros
+
+            if has_lstm_state:
+                initial_states = (zeros([L * D, batch, H], dtype=inputs.dtype),
+                                  zeros([L * D, batch, H], dtype=inputs.dtype))
+            else:
+                initial_states = zeros([L * D, batch, H], dtype=inputs.dtype)
+        state_tensors = list(initial_states) if has_lstm_state else [initial_states]
+
+        n_per = 4
+
+        def f(x, *flat):
+            ps = flat[: len(params)]
+            states = flat[len(params):]
+            h0 = states[0]
+            c0 = states[1] if has_lstm_state else None
+            xs = x if self.time_major else jnp.swapaxes(x, 0, 1)
+            layer_in = xs
+            h_finals, c_finals = [], []
+            for layer in range(L):
+                outs_dir = []
+                for d in range(D):
+                    idx = (layer * D + d) * n_per
+                    wi, wh, bi, bh = ps[idx : idx + 4]
+                    sidx = layer * D + d
+                    if has_lstm_state:
+                        init = (h0[sidx], c0[sidx])
+                    else:
+                        init = h0[sidx]
+                    ys, final = self._run_layer(layer_in, wi, wh, bi, bh, init, reverse=d == 1)
+                    outs_dir.append(ys)
+                    if has_lstm_state:
+                        h_finals.append(final[0])
+                        c_finals.append(final[1])
+                    else:
+                        h_finals.append(final)
+                layer_in = jnp.concatenate(outs_dir, axis=-1) if D == 2 else outs_dir[0]
+            out = layer_in if self.time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_n = jnp.stack(h_finals, 0)
+            if has_lstm_state:
+                c_n = jnp.stack(c_finals, 0)
+                return out, h_n, c_n
+            return out, h_n
+
+        results = nary(f, [inputs] + params + state_tensors, self.mode.lower())
+        if has_lstm_state:
+            out, h_n, c_n = results
+            return out, (h_n, c_n)
+        out, h_n = results
+        return out, h_n
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kwargs)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               attr=weight_ih_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            from ...ops import zeros
+
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size], dtype=inputs.dtype),
+                      zeros([b, self.hidden_size], dtype=inputs.dtype))
+        h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            (h_new, c_new), _ = _lstm_step((hh, cc), x, wi, wh, bi, bh)
+            return h_new, c_new
+
+        h_new, c_new = nary(
+            f, [inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh],
+            "lstm_cell",
+        )
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               attr=weight_ih_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            from ...ops import zeros
+
+            states = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+
+        def f(x, h, wi, wh, bi, bh):
+            h_new, _ = _gru_step(h, x, wi, wh, bi, bh)
+            return h_new
+
+        h_new = nary(
+            f, [inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh],
+            "gru_cell",
+        )
+        return h_new, h_new
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               attr=weight_ih_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               attr=weight_hh_attr,
+                                               default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        inputs = ensure_tensor(inputs)
+        if states is None:
+            from ...ops import zeros
+
+            states = zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+
+        def f(x, h, wi, wh, bi, bh):
+            h_new, _ = _rnn_step(h, x, wi, wh, bi, bh, self.activation)
+            return h_new
+
+        h_new = nary(
+            f, [inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh],
+            "rnn_cell",
+        )
+        return h_new, h_new

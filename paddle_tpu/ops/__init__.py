@@ -1,0 +1,102 @@
+"""The op library (the Phi-kernel-surface analog, SURVEY.md §2.2).
+
+Aggregates creation / math / logic / reduction / linalg / manipulation ops and
+installs the Tensor method surface (reference: pybind eager_method.cc +
+python/paddle/tensor/__init__.py tensor-method registration).
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+
+from . import creation, math, logic, reduction, linalg, manipulation  # noqa: E402
+from ..framework.tensor import Tensor
+
+
+_TENSOR_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "exp", "expm1", "log",
+    "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "abs", "sign", "neg",
+    "reciprocal", "floor", "ceil", "round", "trunc", "frac", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "erf", "erfinv", "digamma", "lgamma", "sigmoid", "logit", "clip",
+    "nan_to_num", "isnan", "isinf", "isfinite", "lerp", "scale", "cumsum",
+    "cumprod", "logsumexp", "logcumsumexp", "trace", "kron", "diff", "inner",
+    "outer", "heaviside", "addmm",
+    # inplace
+    "add_", "subtract_", "multiply_", "divide_", "scale_", "clip_", "exp_",
+    "sqrt_", "rsqrt_", "reciprocal_", "floor_", "ceil_", "round_", "abs_",
+    "sin_", "cos_", "tanh_", "sigmoid_", "neg_",
+    # logic
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all",
+    "allclose", "isclose", "where",
+    # reduction
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "all", "any", "argmax",
+    "argmin", "std", "var", "median", "quantile", "nanmean", "nansum",
+    "count_nonzero",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "mv", "t", "transpose", "norm", "dist",
+    "cross", "cholesky", "inv", "matrix_power",
+    # manipulation
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "split", "chunk", "unbind", "tile", "expand", "expand_as",
+    "broadcast_to", "flip", "roll", "rot90", "moveaxis", "gather", "gather_nd",
+    "take", "take_along_axis", "put_along_axis", "scatter", "scatter_",
+    "scatter_nd_add", "index_select", "index_sample", "index_add",
+    "masked_select", "masked_fill", "masked_fill_", "repeat_interleave", "pad",
+    "topk", "sort", "argsort", "nonzero", "unique", "unique_consecutive",
+    "searchsorted", "bucketize", "cast",
+]
+
+
+def _install_tensor_methods():
+    g = globals()
+    for name in _TENSOR_METHODS:
+        fn = g.get(name)
+        if fn is None or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+    # arithmetic dunders
+    Tensor.__add__ = lambda self, other: add(self, other)
+    Tensor.__radd__ = lambda self, other: add(other, self)
+    Tensor.__sub__ = lambda self, other: subtract(self, other)
+    Tensor.__rsub__ = lambda self, other: subtract(other, self)
+    Tensor.__mul__ = lambda self, other: multiply(self, other)
+    Tensor.__rmul__ = lambda self, other: multiply(other, self)
+    Tensor.__truediv__ = lambda self, other: divide(self, other)
+    Tensor.__rtruediv__ = lambda self, other: divide(other, self)
+    Tensor.__floordiv__ = lambda self, other: floor_divide(self, other)
+    Tensor.__rfloordiv__ = lambda self, other: floor_divide(other, self)
+    Tensor.__mod__ = lambda self, other: remainder(self, other)
+    Tensor.__rmod__ = lambda self, other: remainder(other, self)
+    Tensor.__pow__ = lambda self, other: pow(self, other)
+    Tensor.__rpow__ = lambda self, other: pow(other, self)
+    Tensor.__neg__ = lambda self: neg(self)
+    Tensor.__abs__ = lambda self: abs(self)
+    Tensor.__matmul__ = lambda self, other: matmul(self, other)
+    Tensor.__rmatmul__ = lambda self, other: matmul(other, self)
+    Tensor.__eq__ = lambda self, other: equal(self, other)
+    Tensor.__ne__ = lambda self, other: not_equal(self, other)
+    Tensor.__lt__ = lambda self, other: less_than(self, other)
+    Tensor.__le__ = lambda self, other: less_equal(self, other)
+    Tensor.__gt__ = lambda self, other: greater_than(self, other)
+    Tensor.__ge__ = lambda self, other: greater_equal(self, other)
+    Tensor.__invert__ = lambda self: logical_not(self)
+    Tensor.__and__ = lambda self, other: (
+        logical_and(self, other) if self.dtype.name == "bool" else bitwise_and(self, other)
+    )
+    Tensor.__or__ = lambda self, other: (
+        logical_or(self, other) if self.dtype.name == "bool" else bitwise_or(self, other)
+    )
+    Tensor.__xor__ = lambda self, other: (
+        logical_xor(self, other) if self.dtype.name == "bool" else bitwise_xor(self, other)
+    )
+
+
+_install_tensor_methods()

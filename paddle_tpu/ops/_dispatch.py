@@ -1,0 +1,54 @@
+"""Op dispatch helpers.
+
+The TPU-native analog of the Phi kernel dispatch layer
+(paddle/phi/core/kernel_factory.h:316, paddle/phi/api/lib/kernel_dispatch.h):
+every op funnels through `apply_op`, which executes the jax computation and
+records the autograd node. Scalars ride along as closure constants (the
+reference's attribute path), tensors as traced operands.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.autograd import apply_op
+from ..framework.dtype import to_jax_dtype, get_default_dtype
+
+
+def ensure_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def unary(fn, x, name="", **attrs):
+    x = ensure_tensor(x)
+    return apply_op(fn, [x], attrs=attrs, name=name)
+
+
+def binary(fn, x, y, name=""):
+    xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+    if xt and yt:
+        return apply_op(fn, [x, y], name=name)
+    if xt:
+        yv = y._data if isinstance(y, Tensor) else y
+        return apply_op(lambda a: fn(a, yv), [x], name=name)
+    if yt:
+        xv = x
+        return apply_op(lambda b: fn(xv, b), [y], name=name)
+    return Tensor._wrap(fn(jnp.asarray(x), jnp.asarray(y)))
+
+
+def nary(fn, tensors, name="", **attrs):
+    tensors = [ensure_tensor(t) for t in tensors]
+    return apply_op(fn, tensors, attrs=attrs, name=name)
+
+
+def default_float():
+    return to_jax_dtype(get_default_dtype())
+
+
+def resolve_dtype(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else default_float()
+    return to_jax_dtype(dtype)

@@ -1,0 +1,238 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py + the nullary/fill kernels
+(paddle/phi/kernels/full_kernel.h, empty_kernel.h, arange kernel).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, to_tensor  # noqa: F401  (re-export)
+from ..framework.dtype import to_jax_dtype
+from ..framework.random import default_generator
+from ._dispatch import ensure_tensor, resolve_dtype
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None):
+    return Tensor._wrap(jnp.zeros(_shape_tuple(shape), resolve_dtype(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor._wrap(jnp.ones(_shape_tuple(shape), resolve_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype_j = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype_j = jnp.int64
+        else:
+            dtype_j = resolve_dtype(None)
+    else:
+        dtype_j = to_jax_dtype(dtype)
+    return Tensor._wrap(jnp.full(_shape_tuple(shape), fill_value, dtype_j))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None):
+    x = ensure_tensor(x)
+    d = to_jax_dtype(dtype) if dtype is not None else x._data.dtype
+    return Tensor._wrap(jnp.zeros(x._data.shape, d))
+
+
+def ones_like(x, dtype=None):
+    x = ensure_tensor(x)
+    d = to_jax_dtype(dtype) if dtype is not None else x._data.dtype
+    return Tensor._wrap(jnp.ones(x._data.shape, d))
+
+
+def full_like(x, fill_value, dtype=None):
+    x = ensure_tensor(x)
+    d = to_jax_dtype(dtype) if dtype is not None else x._data.dtype
+    return Tensor._wrap(jnp.full(x._data.shape, fill_value, d))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            v = v.item()
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = jnp.int64
+        else:
+            d = resolve_dtype(None)
+    else:
+        d = to_jax_dtype(dtype)
+    return Tensor._wrap(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    return Tensor._wrap(jnp.linspace(start, stop, int(num), dtype=resolve_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor._wrap(
+        jnp.logspace(float(start), float(stop), int(num), base=base, dtype=resolve_dtype(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor._wrap(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=resolve_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0):
+    x = ensure_tensor(x)
+    from ..framework.autograd import apply_op
+
+    if x.ndim == 1 and padding_value != 0:
+        def f(v):
+            n = v.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, v.dtype)
+            return out + jnp.diag(v, k=offset) - jnp.diag(jnp.full(v.shape, padding_value, v.dtype), k=offset)
+        return apply_op(f, [x], name="diag")
+    return apply_op(lambda v: jnp.diag(v, k=offset), [x], name="diag")
+
+
+def diagflat(x, offset=0):
+    from ..framework.autograd import apply_op
+
+    return apply_op(lambda v: jnp.diagflat(v, k=offset), [ensure_tensor(x)], name="diagflat")
+
+
+def tril(x, diagonal=0):
+    from ..framework.autograd import apply_op
+
+    return apply_op(lambda v: jnp.tril(v, k=diagonal), [ensure_tensor(x)], name="tril")
+
+
+def triu(x, diagonal=0):
+    from ..framework.autograd import apply_op
+
+    return apply_op(lambda v: jnp.triu(v, k=diagonal), [ensure_tensor(x)], name="triu")
+
+
+def meshgrid(*args):
+    tensors = [ensure_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    from ..framework.autograd import apply_op
+
+    return list(apply_op(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), tensors, name="meshgrid"))
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x)
+    from ..framework.autograd import apply_op
+
+    out = apply_op(lambda v: v + 0, [x], name="assign")
+    if output is not None:
+        output._inplace_from(out)
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+def numel(x):
+    return Tensor._wrap(jnp.asarray(ensure_tensor(x)._data.size, jnp.int64))
+
+
+# -- random creation --------------------------------------------------------
+
+def rand(shape, dtype=None):
+    key = default_generator().next_key()
+    return Tensor._wrap(jax.random.uniform(key, _shape_tuple(shape), resolve_dtype(dtype)))
+
+
+def randn(shape, dtype=None):
+    key = default_generator().next_key()
+    return Tensor._wrap(jax.random.normal(key, _shape_tuple(shape), resolve_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.PRNGKey(seed) if seed else default_generator().next_key()
+    return Tensor._wrap(
+        jax.random.uniform(key, _shape_tuple(shape), resolve_dtype(dtype), minval=min, maxval=max)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        if isinstance(mean, Tensor):
+            shape = mean.shape
+        elif isinstance(std, Tensor):
+            shape = std.shape
+        else:
+            shape = []
+    key = default_generator().next_key()
+    base = jax.random.normal(key, _shape_tuple(shape), resolve_dtype(None))
+    mean_v = mean._data if isinstance(mean, Tensor) else mean
+    std_v = std._data if isinstance(std, Tensor) else std
+    return Tensor._wrap(base * std_v + mean_v)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    key = default_generator().next_key()
+    d = to_jax_dtype(dtype) if dtype is not None else jnp.int64
+    return Tensor._wrap(jax.random.randint(key, _shape_tuple(shape), low, high, dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    x = ensure_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype=None):
+    key = default_generator().next_key()
+    d = to_jax_dtype(dtype) if dtype is not None else jnp.int64
+    return Tensor._wrap(jax.random.permutation(key, int(n)).astype(d))
+
+
+def bernoulli(x):
+    x = ensure_tensor(x)
+    key = default_generator().next_key()
+    return Tensor._wrap(
+        jax.random.bernoulli(key, np.asarray(x._data)).astype(x._data.dtype)
+        if False
+        else (jax.random.uniform(key, x._data.shape) < x._data).astype(x._data.dtype)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    x = ensure_tensor(x)
+    key = default_generator().next_key()
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if replacement or num_samples == 1:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(*x._data.shape[:-1], num_samples) if x.ndim > 1 else (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor._wrap(out.astype(jnp.int64))

@@ -1,0 +1,253 @@
+"""Linear algebra ops.
+
+Reference parity: python/paddle/tensor/linalg.py (matmul at :191) backed by
+phi::MatmulKernel (paddle/phi/kernels/impl/matmul_kernel_impl.h). On TPU these
+are the MXU ops — jnp.matmul/einsum lower straight to XLA dot_general, which
+the compiler tiles onto the systolic array.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.autograd import apply_op
+from ._dispatch import binary, unary, ensure_tensor, nary
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return binary(f, x, y, "matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return binary(jnp.matmul, x, y, "bmm")
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        if a.ndim == 2:
+            return jnp.sum(a * b, axis=-1)
+        return jnp.dot(a, b)
+
+    return binary(f, x, y, "dot")
+
+
+def mv(x, vec, name=None):
+    return binary(jnp.matmul, x, vec, "mv")
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim <= 1:
+        return x.clone()
+    return unary(lambda v: v.T, x, "t")
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return unary(lambda v: jnp.transpose(v, perm), x, "transpose")
+
+
+def einsum(equation, *operands):
+    return nary(lambda *xs: jnp.einsum(equation, *xs), list(operands), "einsum")
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return binary(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y, "tensordot")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def f(v):
+        if ax is None:
+            flat = v.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if p == jnp.inf or p == float("inf"):
+                return jnp.max(jnp.abs(flat))
+            if p == -jnp.inf or p == float("-inf"):
+                return jnp.min(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum(flat != 0).astype(v.dtype)
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0), axis=ax, keepdims=keepdim).astype(v.dtype)
+        if p == 1:
+            return jnp.sum(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 2:
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(v), p), axis=ax, keepdims=keepdim), 1.0 / p
+        )
+
+    return unary(f, x, "norm")
+
+
+def dist(x, y, p=2, name=None):
+    return norm(ensure_tensor(x) - ensure_tensor(y), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return binary(f, x, y, "cross")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    w = weights._data if isinstance(weights, Tensor) else weights
+    return Tensor._wrap(jnp.bincount(x._data, weights=w, minlength=minlength))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = ensure_tensor(input)
+    lo, hi = min, max
+    if lo == 0 and hi == 0:
+        lo, hi = float(jnp.min(input._data)), float(jnp.max(input._data))
+    hist, _ = jnp.histogram(input._data, bins=bins, range=(lo, hi))
+    return Tensor._wrap(hist.astype(jnp.int64))
+
+
+# -- decompositions (XLA/LAPACK backed) -------------------------------------
+
+def inv(x, name=None):
+    return unary(jnp.linalg.inv, x, "inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return unary(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x, "pinv")
+
+
+def det(x, name=None):
+    return unary(jnp.linalg.det, x, "det")
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    out = apply_op(lambda v: tuple(jnp.linalg.slogdet(v)), [x], name="slogdet")
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    return apply_op(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), [x], name="svd"
+    )
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    return apply_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), [x], name="qr")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return unary(f, x, "cholesky")
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    w, v = jnp.linalg.eig(x._data)
+    return Tensor._wrap(w), Tensor._wrap(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return apply_op(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), [x], name="eigh")
+
+
+def eigvals(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor._wrap(jnp.linalg.eigvals(x._data))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return unary(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x, "eigvalsh")
+
+
+def solve(x, y, name=None):
+    return binary(jnp.linalg.solve, x, y, "solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    import jax
+
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        )
+
+    return binary(f, x, y, "triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax
+
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return binary(f, x, y, "cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return (Tensor._wrap(sol), Tensor._wrap(res), Tensor._wrap(rank), Tensor._wrap(sv))
+
+
+def matrix_power(x, n, name=None):
+    return unary(lambda v: jnp.linalg.matrix_power(v, n), x, "matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return Tensor._wrap(jnp.linalg.matrix_rank(x._data, tol=tol))
+
+
+def cond(x, p=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor._wrap(jnp.linalg.cond(x._data, p=p))
+
+
+def multi_dot(x, name=None):
+    return nary(lambda *xs: jnp.linalg.multi_dot(xs), list(x), "multi_dot")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return unary(
+        lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), x, "cov"
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return unary(lambda v: jnp.corrcoef(v, rowvar=rowvar), x, "corrcoef")

@@ -1,0 +1,105 @@
+"""Comparison & logical ops (python/paddle/tensor/logic.py parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ._dispatch import binary, unary, ensure_tensor, nary
+
+
+def equal(x, y, name=None):
+    return binary(jnp.equal, x, y, "equal")
+
+
+def not_equal(x, y, name=None):
+    return binary(jnp.not_equal, x, y, "not_equal")
+
+
+def less_than(x, y, name=None):
+    return binary(jnp.less, x, y, "less_than")
+
+
+def less_equal(x, y, name=None):
+    return binary(jnp.less_equal, x, y, "less_equal")
+
+
+def greater_than(x, y, name=None):
+    return binary(jnp.greater, x, y, "greater_than")
+
+
+def greater_equal(x, y, name=None):
+    return binary(jnp.greater_equal, x, y, "greater_equal")
+
+
+def logical_and(x, y, out=None, name=None):
+    return binary(jnp.logical_and, x, y, "logical_and")
+
+
+def logical_or(x, y, out=None, name=None):
+    return binary(jnp.logical_or, x, y, "logical_or")
+
+
+def logical_xor(x, y, out=None, name=None):
+    return binary(jnp.logical_xor, x, y, "logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return unary(jnp.logical_not, x, "logical_not")
+
+
+def bitwise_and(x, y, name=None):
+    return binary(jnp.bitwise_and, x, y, "bitwise_and")
+
+
+def bitwise_or(x, y, name=None):
+    return binary(jnp.bitwise_or, x, y, "bitwise_or")
+
+
+def bitwise_xor(x, y, name=None):
+    return binary(jnp.bitwise_xor, x, y, "bitwise_xor")
+
+
+def bitwise_not(x, name=None):
+    return unary(jnp.bitwise_not, x, "bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if x.shape != y.shape:
+        return Tensor._wrap(jnp.asarray(False))
+    return binary(lambda a, b: jnp.all(a == b), x, y, "equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return binary(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        x, y, "allclose",
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return binary(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        x, y, "isclose",
+    )
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return tuple(
+            Tensor._wrap(i) for i in jnp.nonzero(condition._data)
+        )
+    return nary(
+        lambda c, a, b: jnp.where(c.astype(bool), a, b),
+        [condition, x, y],
+        "where",
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor._wrap(jnp.asarray(ensure_tensor(x)._data.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,589 @@
+"""Shape / layout manipulation ops.
+
+Reference parity: python/paddle/tensor/manipulation.py (reshape, concat,
+gather/scatter, split...) + the stride/view kernels
+(paddle/phi/kernels/stride/). On XLA these are metadata ops or cheap copies
+that fuse; static shapes keep them MXU/tiling friendly.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.autograd import apply_op
+from ._dispatch import unary, binary, ensure_tensor, nary
+
+
+def _resolve_shape(shape, cur_shape):
+    """Paddle reshape semantics: -1 infers, 0 copies the input dim."""
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = list(int(s) for s in shape)
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(cur_shape[i])
+        else:
+            out.append(s)
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    tgt = _resolve_shape(shape, x.shape)
+    return unary(lambda v: v.reshape(tgt), x, "reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._inplace_from(out)
+    return x
+
+
+view = reshape
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def f(v):
+        shp = v.shape
+        mid = 1
+        for d in shp[s : e + 1]:
+            mid *= d
+        return v.reshape(shp[:s] + (mid,) + shp[e + 1 :])
+
+    return unary(f, x, "flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return unary(f, x, "squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._inplace_from(out)
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a) for a in axes]
+
+    def f(v):
+        out = v
+        for a in sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return unary(f, x, "unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._inplace_from(out)
+    return x
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return nary(lambda *xs: jnp.concatenate(xs, axis=axis), tensors, "concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return nary(lambda *xs: jnp.stack(xs, axis=axis), tensors, "stack")
+
+
+def hstack(x, name=None):
+    return nary(lambda *xs: jnp.hstack(xs), [ensure_tensor(t) for t in x], "hstack")
+
+
+def vstack(x, name=None):
+    return nary(lambda *xs: jnp.vstack(xs), [ensure_tensor(t) for t in x], "vstack")
+
+
+def dstack(x, name=None):
+    return nary(lambda *xs: jnp.dstack(xs), [ensure_tensor(t) for t in x], "dstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        n_unknown = builtins_sum(1 for s in sections if s in (-1,))
+        if n_unknown:
+            known = builtins_sum(s for s in sections if s != -1)
+            sections = [dim - known if s == -1 else s for s in sections]
+    offsets = np.cumsum(sections)[:-1].tolist()
+    outs = apply_op(
+        lambda v: tuple(jnp.split(v, offsets, axis=axis)), [x], name="split"
+    )
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[axis]
+    outs = apply_op(
+        lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)), [x], name="unbind"
+    )
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = tuple(int(r) for r in repeat_times)
+    return unary(lambda v: jnp.tile(v, reps), x, "tile")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    tgt = _expand_shape(shape, x.shape)
+    return unary(lambda v: jnp.broadcast_to(v, tgt), x, "expand")
+
+
+def _expand_shape(shape, cur):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s) for s in shape]
+    ndiff = len(shape) - len(cur)
+    out = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            out.append(cur[i - ndiff] if i >= ndiff else 1)
+        else:
+            out.append(s)
+    return tuple(out)
+
+
+def expand_as(x, y, name=None):
+    y = ensure_tensor(y)
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [ensure_tensor(t) for t in inputs]
+    outs = apply_op(
+        lambda *xs: tuple(jnp.broadcast_arrays(*xs)), tensors, name="broadcast_tensors"
+    )
+    return list(outs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return unary(lambda v: jnp.flip(v, axis=tuple(axes)), x, "flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return unary(lambda v: jnp.roll(v, shifts, axis=axis), x, "roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return unary(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x, "rot90")
+
+
+def moveaxis(x, source, destination, name=None):
+    return unary(lambda v: jnp.moveaxis(v, source, destination), x, "moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return unary(lambda v: jnp.swapaxes(v, axis0, axis1), x, "swapaxes")
+
+
+def as_real(x, name=None):
+    return unary(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x, "as_real")
+
+
+def as_complex(x, name=None):
+    return unary(lambda v: jax_complex(v), x, "as_complex")
+
+
+def jax_complex(v):
+    return v[..., 0] + 1j * v[..., 1]
+
+
+# -- gather / scatter -------------------------------------------------------
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return binary(lambda v, idx: jnp.take(v, idx.astype(jnp.int32), axis=axis), x, ensure_tensor(index), "gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return binary(f, x, ensure_tensor(index), "gather_nd")
+
+
+def take(x, index, mode="raise", name=None):
+    def f(v, idx):
+        return jnp.take(v.reshape(-1), idx.astype(jnp.int32), mode="clip" if mode != "wrap" else "wrap")
+
+    return binary(f, x, ensure_tensor(index), "take")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def f(v, idx):
+        return jnp.take_along_axis(v, idx.astype(jnp.int32), axis=axis)
+
+    return binary(f, arr, ensure_tensor(indices), "take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr = ensure_tensor(arr)
+    indices = ensure_tensor(indices)
+    values = values if isinstance(values, Tensor) else Tensor(values, dtype=arr.dtype)
+
+    def f(v, idx, val):
+        idx = idx.astype(jnp.int32)
+        val = jnp.broadcast_to(val, idx.shape).astype(v.dtype)
+        if reduce == "add":
+            return jnp_put_along_axis(v, idx, val, axis, "add")
+        if reduce in ("mul", "multiply"):
+            return jnp_put_along_axis(v, idx, val, axis, "multiply")
+        return jnp_put_along_axis(v, idx, val, axis, "assign")
+
+    return nary(f, [arr, indices, values], "put_along_axis")
+
+
+def jnp_put_along_axis(v, idx, val, axis, mode):
+    # build full index grid
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    grids[axis] = idx
+    loc = tuple(grids)
+    ref = v.at[loc]
+    if mode == "add":
+        return ref.add(val)
+    if mode == "multiply":
+        return ref.multiply(val)
+    return ref.set(val)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, idx, upd):
+        idx = idx.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return v.at[idx].set(upd.astype(v.dtype))
+        # accumulate mode: zero target rows then add
+        zeroed = v.at[idx].set(jnp.zeros_like(upd, v.dtype))
+        return zeroed.at[idx].add(upd.astype(v.dtype))
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)], "scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._inplace_from(out)
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, idx, upd):
+        idx = idx.astype(jnp.int32)
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd.astype(v.dtype))
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)], "scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    updates = ensure_tensor(updates)
+    base = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    def f(v, idx):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, idx.astype(jnp.int32)]
+
+    return binary(f, x, ensure_tensor(index), "index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(v, idx, val):
+        idx = idx.astype(jnp.int32)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = vmoved.at[idx].add(jnp.moveaxis(val, axis, 0).astype(v.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)], "index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    idx = tuple(i._data if isinstance(i, Tensor) else i for i in indices)
+    value = ensure_tensor(value)
+
+    def f(v, val):
+        ref = v.at[idx]
+        return ref.add(val.astype(v.dtype)) if accumulate else ref.set(val.astype(v.dtype))
+
+    return nary(f, [x, value], "index_put")
+
+
+def masked_select(x, mask, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    # dynamic output shape: materialize on host (matches reference CPU behavior)
+    data = np.asarray(x._data)[np.asarray(mask._data).astype(bool)]
+    return Tensor._wrap(jnp.asarray(data))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    return binary(lambda a, m: jnp.where(m.astype(bool), jnp.asarray(v, a.dtype), a), x, ensure_tensor(mask), "masked_fill")
+
+
+def masked_fill_(x, mask, value, name=None):
+    out = masked_fill(x, mask, value)
+    x._inplace_from(out)
+    return x
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+# -- slicing ----------------------------------------------------------------
+
+def slice(input, axes, starts, ends):
+    input = ensure_tensor(input)
+    idx = [builtins.slice(None)] * input.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st.item()) if isinstance(st, Tensor) else int(st)
+        en = int(en.item()) if isinstance(en, Tensor) else int(en)
+        idx[ax] = builtins.slice(st, en)
+    idx = tuple(idx)
+    return unary(lambda v: v[idx], input, "slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(st), int(en), int(sd))
+    idx = tuple(idx)
+    return unary(lambda v: v[idx], x, "strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shape = _resolve_shape(shape, x.shape) if shape is not None else tuple(x.shape)
+    offsets = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    idx = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
+    return unary(lambda v: v[idx], x, "crop")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return unary(lambda v: jnp.repeat(v, r, axis=axis), x, "repeat_interleave")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle full-rank form: [d0_lo, d0_hi, d1_lo, d1_hi, ...]
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial form applies to trailing spatial dims, torch-style reversed
+        npad = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        spatial = spatial[-npad:] if npad <= len(spatial) else spatial
+        for i in range(npad):
+            # pad list is ordered last-dim-first
+            dim = spatial[len(spatial) - 1 - i] if i < len(spatial) else nd - 1 - i
+            widths[dim] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(v):
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return unary(f, x, "pad")
+
+
+# -- sorting / search -------------------------------------------------------
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(v):
+        ax = axis % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return (
+            jnp.moveaxis(vals, -1, ax),
+            jnp.moveaxis(idx.astype(jnp.int64), -1, ax),
+        )
+
+    return apply_op(f, [ensure_tensor(x)], name="topk")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return unary(f, x, "sort")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    idx = jnp.argsort(x._data, axis=axis)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return Tensor._wrap(idx.astype(jnp.int64))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = ensure_tensor(x)
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor._wrap(jnp.asarray(i[:, None].astype(np.int64))) for i in nz)
+    return Tensor._wrap(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = np.unique(
+        np.asarray(x._data),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not (return_index or return_inverse or return_counts):
+        return Tensor._wrap(jnp.asarray(res))
+    outs = [Tensor._wrap(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.ones(arr.shape[0], bool)
+        keep[1:] = arr[1:] != arr[:-1]
+        out = arr[keep]
+        outs = [Tensor._wrap(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor._wrap(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, arr.shape[0]))
+            outs.append(Tensor._wrap(jnp.asarray(counts.astype(np.int64))))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    sorted_sequence = ensure_tensor(sorted_sequence)
+    values = ensure_tensor(values)
+    side = "right" if right else "left"
+
+    def f(s, v):
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax_vmap_searchsorted(s, v, side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return Tensor._wrap(f(sorted_sequence._data, values._data))
+
+
+def jax_vmap_searchsorted(s, v, side):
+    import jax as _jax
+
+    flat_s = s.reshape(-1, s.shape[-1])
+    flat_v = v.reshape(-1, v.shape[-1])
+    out = _jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(flat_s, flat_v)
+    return out.reshape(v.shape)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        in_shard = (v >= lo) & (v < hi)
+        return jnp.where(in_shard, v - lo, ignore_value)
+
+    return unary(f, input, "shard_index")
+
+
+def cast(x, dtype):
+    return ensure_tensor(x).astype(dtype)

@@ -1,0 +1,436 @@
+"""Elementwise & scalar math ops.
+
+Reference parity: python/paddle/tensor/math.py backed by
+paddle/phi/kernels/elementwise_*_kernel.h, activation_kernel.h, scale_kernel.h.
+All lower to single XLA HLO ops that fuse freely around matmuls (HBM-bandwidth
+friendly — SURVEY.md build-plan stage 2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.autograd import apply_op
+from ._dispatch import unary, binary, ensure_tensor
+
+# -- binary -----------------------------------------------------------------
+
+def add(x, y, name=None):
+    return binary(jnp.add, x, y, "add")
+
+
+def subtract(x, y, name=None):
+    return binary(jnp.subtract, x, y, "subtract")
+
+
+def multiply(x, y, name=None):
+    return binary(jnp.multiply, x, y, "multiply")
+
+
+def divide(x, y, name=None):
+    return binary(jnp.true_divide, x, y, "divide")
+
+
+def floor_divide(x, y, name=None):
+    return binary(jnp.floor_divide, x, y, "floor_divide")
+
+
+def remainder(x, y, name=None):
+    return binary(jnp.remainder, x, y, "remainder")
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):
+    return binary(jnp.power, x, y, "pow")
+
+
+def maximum(x, y, name=None):
+    return binary(jnp.maximum, x, y, "maximum")
+
+
+def minimum(x, y, name=None):
+    return binary(jnp.minimum, x, y, "minimum")
+
+
+def fmax(x, y, name=None):
+    return binary(jnp.fmax, x, y, "fmax")
+
+
+def fmin(x, y, name=None):
+    return binary(jnp.fmin, x, y, "fmin")
+
+
+def atan2(x, y, name=None):
+    return binary(jnp.arctan2, x, y, "atan2")
+
+
+def hypot(x, y, name=None):
+    return binary(jnp.hypot, x, y, "hypot")
+
+
+def heaviside(x, y, name=None):
+    return binary(jnp.heaviside, x, y, "heaviside")
+
+
+def gcd(x, y, name=None):
+    return binary(jnp.gcd, x, y, "gcd")
+
+
+def lcm(x, y, name=None):
+    return binary(jnp.lcm, x, y, "lcm")
+
+
+def ldexp(x, y, name=None):
+    return binary(jnp.ldexp, x, y, "ldexp")
+
+
+def copysign(x, y, name=None):
+    return binary(jnp.copysign, x, y, "copysign")
+
+
+def nextafter(x, y, name=None):
+    return binary(jnp.nextafter, x, y, "nextafter")
+
+
+def logaddexp(x, y, name=None):
+    return binary(jnp.logaddexp, x, y, "logaddexp")
+
+
+def inner(x, y, name=None):
+    return binary(jnp.inner, x, y, "inner")
+
+
+def outer(x, y, name=None):
+    return binary(lambda a, b: jnp.outer(a, b), x, y, "outer")
+
+
+# -- unary ------------------------------------------------------------------
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale._data if isinstance(scale, Tensor) else scale
+
+    def f(v):
+        out = v * s + bias if bias_after_scale else (v + bias) * s
+        return out
+
+    out = unary(f, x, "scale")
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def exp(x, name=None):
+    return unary(jnp.exp, x, "exp")
+
+
+def expm1(x, name=None):
+    return unary(jnp.expm1, x, "expm1")
+
+
+def log(x, name=None):
+    return unary(jnp.log, x, "log")
+
+
+def log2(x, name=None):
+    return unary(jnp.log2, x, "log2")
+
+
+def log10(x, name=None):
+    return unary(jnp.log10, x, "log10")
+
+
+def log1p(x, name=None):
+    return unary(jnp.log1p, x, "log1p")
+
+
+def sqrt(x, name=None):
+    return unary(jnp.sqrt, x, "sqrt")
+
+
+def rsqrt(x, name=None):
+    return unary(jax.lax.rsqrt, x, "rsqrt")
+
+
+def square(x, name=None):
+    return unary(jnp.square, x, "square")
+
+
+def abs(x, name=None):
+    return unary(jnp.abs, x, "abs")
+
+
+def sign(x, name=None):
+    return unary(jnp.sign, x, "sign")
+
+
+def neg(x, name=None):
+    return unary(jnp.negative, x, "neg")
+
+
+def reciprocal(x, name=None):
+    return unary(jnp.reciprocal, x, "reciprocal")
+
+
+def floor(x, name=None):
+    return unary(jnp.floor, x, "floor")
+
+
+def ceil(x, name=None):
+    return unary(jnp.ceil, x, "ceil")
+
+
+def round(x, name=None):
+    return unary(jnp.round, x, "round")
+
+
+def trunc(x, name=None):
+    return unary(jnp.trunc, x, "trunc")
+
+
+def frac(x, name=None):
+    return unary(lambda v: v - jnp.trunc(v), x, "frac")
+
+
+def sin(x, name=None):
+    return unary(jnp.sin, x, "sin")
+
+
+def cos(x, name=None):
+    return unary(jnp.cos, x, "cos")
+
+
+def tan(x, name=None):
+    return unary(jnp.tan, x, "tan")
+
+
+def asin(x, name=None):
+    return unary(jnp.arcsin, x, "asin")
+
+
+def acos(x, name=None):
+    return unary(jnp.arccos, x, "acos")
+
+
+def atan(x, name=None):
+    return unary(jnp.arctan, x, "atan")
+
+
+def sinh(x, name=None):
+    return unary(jnp.sinh, x, "sinh")
+
+
+def cosh(x, name=None):
+    return unary(jnp.cosh, x, "cosh")
+
+
+def tanh(x, name=None):
+    return unary(jnp.tanh, x, "tanh")
+
+
+def asinh(x, name=None):
+    return unary(jnp.arcsinh, x, "asinh")
+
+
+def acosh(x, name=None):
+    return unary(jnp.arccosh, x, "acosh")
+
+
+def atanh(x, name=None):
+    return unary(jnp.arctanh, x, "atanh")
+
+
+def erf(x, name=None):
+    return unary(jax.scipy.special.erf, x, "erf")
+
+
+def erfinv(x, name=None):
+    return unary(jax.scipy.special.erfinv, x, "erfinv")
+
+
+def digamma(x, name=None):
+    return unary(jax.scipy.special.digamma, x, "digamma")
+
+
+def lgamma(x, name=None):
+    return unary(jax.scipy.special.gammaln, x, "lgamma")
+
+
+def sigmoid(x, name=None):
+    return unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def logit(x, eps=None, name=None):
+    def f(v):
+        vv = jnp.clip(v, eps, 1 - eps) if eps else v
+        return jnp.log(vv / (1 - vv))
+
+    return unary(f, x, "logit")
+
+
+def clip(x, min=None, max=None, name=None):
+    min_v = min._data if isinstance(min, Tensor) else min
+    max_v = max._data if isinstance(max, Tensor) else max
+    return unary(lambda v: jnp.clip(v, min_v, max_v), x, "clip")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return unary(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x, "nan_to_num")
+
+
+def isnan(x, name=None):
+    return unary(jnp.isnan, x, "isnan")
+
+
+def isinf(x, name=None):
+    return unary(jnp.isinf, x, "isinf")
+
+
+def isfinite(x, name=None):
+    return unary(jnp.isfinite, x, "isfinite")
+
+
+def lerp(x, y, weight, name=None):
+    from ._dispatch import nary
+
+    w = weight if isinstance(weight, Tensor) else None
+    if w is not None:
+        return nary(lambda a, b, t: a + t * (b - a), [x, y, weight], "lerp")
+    return binary(lambda a, b: a + weight * (b - a), x, y, "lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return unary(lambda v: scale_b * jnp.tanh(scale_a * v), x, "stanh")
+
+
+def rad2deg(x, name=None):
+    return unary(jnp.rad2deg, x, "rad2deg")
+
+
+def deg2rad(x, name=None):
+    return unary(jnp.deg2rad, x, "deg2rad")
+
+
+def angle(x, name=None):
+    return unary(jnp.angle, x, "angle")
+
+
+def conj(x, name=None):
+    return unary(jnp.conj, x, "conj")
+
+
+def real(x, name=None):
+    return unary(jnp.real, x, "real")
+
+
+def imag(x, name=None):
+    return unary(jnp.imag, x, "imag")
+
+
+# -- scans / special --------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v)
+        return jnp.cumsum(v, axis=axis)
+
+    return unary(f, x, "cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def f(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1))
+        return jnp.cumprod(v, axis=dim)
+
+    return unary(f, x, "cumprod")
+
+
+def cummax(x, axis=None, name=None):
+    def f(v):
+        a = axis if axis is not None else 0
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=a if axis is not None else 0)
+        return vals
+
+    return unary(f, x, "cummax")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        a = 0 if axis is None else axis
+        return jax.lax.cumlogsumexp(vv, axis=a)
+
+    return unary(f, x, "logcumsumexp")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return unary(
+        lambda v: jax.scipy.special.logsumexp(v, axis=axis, keepdims=keepdim), x, "logsumexp"
+    )
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x, "trace")
+
+
+def kron(x, y, name=None):
+    return binary(jnp.kron, x, y, "kron")
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return unary(lambda v: jnp.diff(v, n=n, axis=axis), x, "diff")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    from ._dispatch import nary
+
+    return nary(
+        lambda i, a, b: beta * i + alpha * (a @ b), [input, x, y], "addmm"
+    )
+
+
+def increment(x, value=1.0, name=None):
+    out = unary(lambda v: v + value, x, "increment")
+    ensure_tensor(x)._inplace_from(out)
+    return x
+
+
+# -- in-place variants ------------------------------------------------------
+
+def _make_inplace(fn):
+    def inplace(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._inplace_from(out)
+        return x
+
+    return inplace
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+divide_ = _make_inplace(divide)
+scale_ = _make_inplace(scale)
+clip_ = _make_inplace(clip)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+reciprocal_ = _make_inplace(reciprocal)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+round_ = _make_inplace(round)
+abs_ = _make_inplace(abs)
+sin_ = _make_inplace(sin)
+cos_ = _make_inplace(cos)
+tanh_ = _make_inplace(tanh)
+sigmoid_ = _make_inplace(sigmoid)
+neg_ = _make_inplace(neg)

@@ -1,0 +1,136 @@
+"""Reduction ops (python/paddle/tensor/math.py + stat.py parity;
+reference kernels paddle/phi/kernels/reduce_*_kernel.h).
+
+XLA maps these to efficient tiled reductions; keepdim semantics match the
+reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ._dispatch import unary, ensure_tensor
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    from ..framework.dtype import to_jax_dtype
+
+    d = to_jax_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        out = jnp.sum(v, axis=axis, keepdims=keepdim)
+        return out.astype(d) if d is not None else out
+
+    return unary(f, x, "sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.mean(v, axis=axis, keepdims=keepdim), x, "mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.max(v, axis=axis, keepdims=keepdim), x, "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.min(v, axis=axis, keepdims=keepdim), x, "min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.prod(v, axis=axis, keepdims=keepdim), x, "prod")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.all(v, axis=axis, keepdims=keepdim), x, "all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.any(v, axis=axis, keepdims=keepdim), x, "any")
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    axis = _norm_axis(axis)
+
+    def f(v):
+        if axis is None:
+            return jnp.argmax(v.reshape(-1)).astype(jnp.int64)
+        return jnp.argmax(v, axis=axis, keepdims=keepdim).astype(jnp.int64)
+
+    return unary(f, x, "argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    axis = _norm_axis(axis)
+
+    def f(v):
+        if axis is None:
+            return jnp.argmin(v.reshape(-1)).astype(jnp.int64)
+        return jnp.argmin(v, axis=axis, keepdims=keepdim).astype(jnp.int64)
+
+    return unary(f, x, "argmin")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return unary(lambda v: jnp.std(v, axis=axis, ddof=ddof, keepdims=keepdim), x, "std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return unary(lambda v: jnp.var(v, axis=axis, ddof=ddof, keepdims=keepdim), x, "var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.median(v, axis=axis, keepdims=keepdim), x, "median")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.quantile(v, q, axis=axis, keepdims=keepdim), x, "quantile")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.nanmean(v, axis=axis, keepdims=keepdim), x, "nanmean")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.nansum(v, axis=axis, keepdims=keepdim), x, "nansum")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    return unary(lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim), x, "nanmedian")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    axis = _norm_axis(axis)
+    x = ensure_tensor(x)
+    return Tensor._wrap(
+        jnp.count_nonzero(x._data, axis=axis, keepdims=keepdim).astype(jnp.int64)
+    )

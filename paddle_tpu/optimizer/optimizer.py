@@ -1,0 +1,162 @@
+"""Optimizer base class.
+
+Reference parity: python/paddle/optimizer/optimizer.py (grad clip, regularizer,
+multi-precision master weights) with fused phi kernels
+(paddle/phi/kernels/gpu/adamw_kernel.cu) replaced by jnp update rules that XLA
+fuses into one kernel per parameter; the jit train-step path fuses across
+parameters too.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import no_grad
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:
+            self._weight_decay = weight_decay  # None or regularizer-like
+        self._accumulators = {}  # name -> {param_name: jax array}
+        self._master_weights = {}  # param_name -> fp32 jax array
+        self._step_count = 0
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.get_lr()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- accumulators -------------------------------------------------------
+    def _get_accumulator(self, name, param, init=None, dtype=None):
+        store = self._accumulators.setdefault(name, {})
+        key = param.name or str(id(param))
+        if key not in store:
+            d = dtype or (jnp.float32 if self._use_master(param) else param._data.dtype)
+            store[key] = jnp.zeros(param._data.shape, d) if init is None else init
+        return store[key]
+
+    def _set_accumulator(self, name, param, value):
+        key = param.name or str(id(param))
+        self._accumulators[name][key] = value
+
+    def _use_master(self, param):
+        return self._multi_precision and param._data.dtype in (jnp.float16, jnp.bfloat16)
+
+    def _master_weight(self, param):
+        key = param.name or str(id(param))
+        if key not in self._master_weights:
+            self._master_weights[key] = param._data.astype(jnp.float32)
+        return self._master_weights[key]
+
+    def _write_param(self, param, new_value_f32_or_native):
+        key = param.name or str(id(param))
+        if self._use_master(param):
+            self._master_weights[key] = new_value_f32_or_native
+            param._data = new_value_f32_or_native.astype(param._data.dtype)
+        else:
+            param._data = new_value_f32_or_native.astype(param._data.dtype)
+
+    def _param_value(self, param):
+        if self._use_master(param):
+            return self._master_weight(param)
+        return param._data
+
+    # -- step ----------------------------------------------------------------
+    def _collect_params_grads(self):
+        if self._parameter_list is None:
+            raise ValueError(
+                "optimizer was created without a parameter list; pass parameters="
+            )
+        pgs = []
+        for p in self._parameter_list:
+            if isinstance(p, dict):
+                raise NotImplementedError("param groups not yet supported")
+            if p.stop_gradient or p.grad is None:
+                continue
+            pgs.append((p, p.grad))
+        return pgs
+
+    def _apply_decay(self, param, grad_data):
+        """L2 regularization folded into the gradient (reference: the
+        regularizer path in optimizer.py; AdamW overrides with decoupled decay)."""
+        wd = self._weight_decay
+        if wd is None:
+            return grad_data
+        coeff = wd if isinstance(wd, float) else getattr(wd, "_coeff", 0.0)
+        if coeff == 0.0 or getattr(param, "regularizer", None) is not None:
+            return grad_data
+        return grad_data + coeff * self._param_value(param).astype(grad_data.dtype)
+
+    @no_grad()
+    def step(self):
+        params_grads = self._collect_params_grads()
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        for p, g in params_grads:
+            g_data = g._data if isinstance(g, Tensor) else g
+            if self._use_master(p):
+                g_data = g_data.astype(jnp.float32)
+            g_data = self._apply_decay(p, g_data)
+            self._append_optimize_op(p, g_data)
+
+    def _append_optimize_op(self, param, grad_data):
+        raise NotImplementedError
+
+    @no_grad()
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list is not None:
+            for p in self._parameter_list:
+                if isinstance(p, Tensor):
+                    p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- state dict -----------------------------------------------------------
+    def state_dict(self):
+        import numpy as np
+
+        state = {"accumulators": {}, "master_weights": {}, "step": self._step_count}
+        for name, store in self._accumulators.items():
+            state["accumulators"][name] = {k: np.asarray(v) for k, v in store.items()}
+        state["master_weights"] = {
+            k: np.asarray(v) for k, v in self._master_weights.items()
+        }
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        return state
+
+    def set_state_dict(self, state_dict):
+        for name, store in state_dict.get("accumulators", {}).items():
+            tgt = self._accumulators.setdefault(name, {})
+            for k, v in store.items():
+                tgt[k] = jnp.asarray(v)
+        for k, v in state_dict.get("master_weights", {}).items():
+            self._master_weights[k] = jnp.asarray(v)
+        self._step_count = state_dict.get("step", 0)
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    load_state_dict = set_state_dict

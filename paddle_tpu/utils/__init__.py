@@ -1,0 +1,91 @@
+"""Utility namespace (paddle.utils parity: flags, deprecated, download stub,
+layers_utils map_structure/flatten)."""
+from . import flags  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"required module {module_name} not found") from e
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(fn):
+        return fn
+
+    return decorator
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the install can compute."""
+    import jax
+
+    import paddle_tpu as pt
+
+    x = pt.ones([2, 2])
+    y = (x @ x).sum()
+    assert float(y) == 8.0
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! devices: {devs}")
+    return True
+
+
+# -- nested-structure helpers (python/paddle/utils/layers_utils.py parity) ---
+
+def flatten(nest):
+    out = []
+
+    def _walk(x):
+        if isinstance(x, (list, tuple)):
+            for v in x:
+                _walk(v)
+        elif isinstance(x, dict):
+            for k in sorted(x):
+                _walk(x[k])
+        else:
+            out.append(x)
+
+    _walk(nest)
+    return out
+
+
+def pack_sequence_as(structure, flat):
+    it = iter(flat)
+
+    def _pack(s):
+        if isinstance(s, (list, tuple)):
+            return type(s)(_pack(v) for v in s)
+        if isinstance(s, dict):
+            return {k: _pack(s[k]) for k in sorted(s)}
+        return next(it)
+
+    return _pack(structure)
+
+
+def map_structure(func, *structures):
+    flats = [flatten(s) for s in structures]
+    mapped = [func(*vals) for vals in zip(*flats)]
+    return pack_sequence_as(structures[0], mapped)
+
+
+class download:
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        raise RuntimeError(
+            "no network egress in this environment; pass local weight paths"
+        )
+
+
+class cpp_extension:
+    """Stub of paddle.utils.cpp_extension; custom native ops use the
+    csrc/ ctypes toolchain instead (see csrc/README)."""
+
+    @staticmethod
+    def load(name, sources, **kwargs):
+        raise NotImplementedError(
+            "use paddle_tpu.utils.cpp_build.build_extension (ctypes-based)"
+        )

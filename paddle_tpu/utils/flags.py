@@ -1,0 +1,71 @@
+"""Env-flag registry.
+
+Reference parity: paddle/common/flags.h:38-68 (PHI_DEFINE_EXPORTED_*) +
+paddle.set_flags/get_flags (pybind global_value_getter_setter.cc). Flags are
+overridable via environment variables of the same name.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_registry: dict[str, dict] = {}
+
+
+def _coerce(value, default):
+    if isinstance(default, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    """PHI_DEFINE_EXPORTED_* parity; env var overrides default at definition."""
+    with _lock:
+        env = os.environ.get(name)
+        value = _coerce(env, default) if env is not None else default
+        _registry[name] = {"value": value, "default": default, "help": help_str}
+    return value
+
+
+def get_flags(flags):
+    single = isinstance(flags, str)
+    names = [flags] if single else list(flags)
+    out = {}
+    for n in names:
+        if n not in _registry:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _registry[n]["value"]
+    return out
+
+
+def set_flags(flags: dict):
+    with _lock:
+        for n, v in flags.items():
+            if n not in _registry:
+                # auto-register unknown flags (reference tolerates phase-in flags)
+                _registry[n] = {"value": v, "default": v, "help": ""}
+            else:
+                _registry[n]["value"] = _coerce(v, _registry[n]["default"])
+
+
+def get_flag(name: str):
+    return _registry[name]["value"] if name in _registry else None
+
+
+# -- core flag set (subset of paddle/common/flags.cc) ------------------------
+define_flag("FLAGS_check_nan_inf", False, "sweep every op output for NaN/Inf")
+define_flag("FLAGS_benchmark", False, "sync after each op for benchmarking")
+define_flag("FLAGS_low_precision_op_list", 0, "collect AMP op stats")
+define_flag("FLAGS_set_to_1d", True, "0-d to 1-d tensor compat")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "allocator strategy (XLA-managed on TPU)")
+define_flag("FLAGS_init_allocated_mem", False, "")
+define_flag("FLAGS_use_stream_safe_cuda_allocator", True, "no-op on TPU (PJRT-managed)")
+define_flag("FLAGS_distributed_timeout_sec", 1800, "collective watchdog timeout")
+define_flag("FLAGS_log_level", 0, "VLOG level")

@@ -1,0 +1,176 @@
+"""Autograd engine tests (reference: test/legacy_test grad checks +
+test/cpp/eager)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Finite differences, the reference OpTest check_grad method
+    (op_test.py:3114)."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = fn(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        fm = fn(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_branching(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        a = x * 2
+        b = x * 3
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_grad_accumulation_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient=True
+        z = (x * y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * 2
+        z = y.detach() * x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 5).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+    def test_double_backward_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 5).sum()
+        y.backward()
+        try:
+            y.backward()
+            raised = False
+        except RuntimeError:
+            raised = True
+        assert raised
+
+    def test_matmul_grad_matches_numeric(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(3, 4).astype(np.float32)
+        b = rng.rand(4, 2).astype(np.float32)
+        x = paddle.to_tensor(a, stop_gradient=False)
+        w = paddle.to_tensor(b, stop_gradient=False)
+        (paddle.matmul(x, w) ** 2).sum().backward()
+        num = numeric_grad(lambda v: float(((v @ b) ** 2).sum()), a.astype(np.float64))
+        np.testing.assert_allclose(x.grad.numpy(), num, rtol=1e-2, atol=1e-2)
+
+    def test_softmax_ce_grad(self):
+        rng = np.random.RandomState(1)
+        logits = rng.randn(4, 5).astype(np.float32)
+        labels = rng.randint(0, 5, (4,))
+        x = paddle.to_tensor(logits, stop_gradient=False)
+        loss = paddle.nn.functional.cross_entropy(x, paddle.to_tensor(labels))
+        loss.backward()
+
+        def ref(v):
+            e = np.exp(v - v.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            return float(-np.log(p[np.arange(4), labels]).mean())
+
+        num = numeric_grad(ref, logits.astype(np.float64))
+        np.testing.assert_allclose(x.grad.numpy(), num, rtol=1e-2, atol=1e-3)
+
+    def test_broadcast_grad(self):
+        x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        (x + b).sum().backward()
+        np.testing.assert_allclose(b.grad.numpy(), [3.0] * 4)
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        y = x * 3
+        y.register_hook(hook)
+        y.sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_multi_output_op(self):
+        a = np.random.RandomState(2).rand(3, 5).astype(np.float32)
+        x = paddle.to_tensor(a, stop_gradient=False)
+        vals, idx = paddle.topk(x, 2, axis=1)
+        vals.sum().backward()
+        expect = np.zeros_like(a)
+        top2 = np.argsort(-a, 1)[:, :2]
+        for i in range(3):
+            expect[i, top2[i]] = 1
+        np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+class TestPaddleGrad:
+    def test_grad_api(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+        np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+class TestPyLayer:
+    def test_custom_fwd_bwd(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])

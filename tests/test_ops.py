@@ -1,0 +1,224 @@
+"""Op unit tests, modeled on the reference OpTest pattern
+(test/legacy_test/op_test.py:418): run the framework op, compare to a numpy
+reference, and check analytic grads against expectations."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def allclose(t, ref, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(t.numpy(), np.float64), ref, rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int64").dtype == paddle.int64
+        allclose(paddle.full([2, 2], 3.5), np.full((2, 2), 3.5))
+
+    def test_arange_linspace(self):
+        allclose(paddle.arange(0, 10, 2).astype("float32"), np.arange(0, 10, 2))
+        allclose(paddle.linspace(0, 1, 5), np.linspace(0, 1, 5))
+
+    def test_like_variants(self):
+        x = paddle.ones([3, 4])
+        assert paddle.zeros_like(x).shape == [3, 4]
+        assert paddle.full_like(x, 7).numpy()[0, 0] == 7
+
+    def test_eye_tril_triu(self):
+        allclose(paddle.eye(3), np.eye(3))
+        a = np.arange(9, dtype=np.float32).reshape(3, 3)
+        allclose(paddle.tril(paddle.to_tensor(a)), np.tril(a))
+        allclose(paddle.triu(paddle.to_tensor(a)), np.triu(a))
+
+    def test_rand_seeded(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 4])
+        paddle.seed(42)
+        b = paddle.randn([4, 4])
+        allclose(a, b.numpy())
+
+
+class TestMath:
+    def setup_method(self, _):
+        self.a = np.random.RandomState(0).rand(3, 4).astype(np.float32) + 0.5
+        self.b = np.random.RandomState(1).rand(3, 4).astype(np.float32) + 0.5
+
+    def test_binary(self):
+        x, y = paddle.to_tensor(self.a), paddle.to_tensor(self.b)
+        allclose(x + y, self.a + self.b)
+        allclose(x - y, self.a - self.b)
+        allclose(x * y, self.a * self.b)
+        allclose(x / y, self.a / self.b, rtol=1e-5)
+        allclose(x ** 2, self.a ** 2)
+        allclose(paddle.maximum(x, y), np.maximum(self.a, self.b))
+
+    def test_scalar_broadcast(self):
+        x = paddle.to_tensor(self.a)
+        allclose(x + 1, self.a + 1)
+        allclose(2 * x, 2 * self.a)
+        allclose(1 / x, 1 / self.a, rtol=1e-5)
+        allclose(3 - x, 3 - self.a)
+
+    def test_unary(self):
+        # XLA-CPU transcendentals use fast polynomial approximations; 1e-3
+        # relative is the right f32 tolerance (the reference whitelists
+        # per-op tolerances the same way, test/white_list/).
+        x = paddle.to_tensor(self.a)
+        allclose(paddle.exp(x), np.exp(self.a), rtol=1e-3)
+        allclose(paddle.log(x), np.log(self.a), rtol=1e-3, atol=1e-4)
+        allclose(paddle.sqrt(x), np.sqrt(self.a), rtol=1e-3)
+        allclose(paddle.tanh(x), np.tanh(self.a), rtol=1e-3)
+        allclose(paddle.abs(-x), self.a)
+        allclose(paddle.sigmoid(x), 1 / (1 + np.exp(-self.a)), rtol=1e-3)
+
+    def test_clip_scale(self):
+        x = paddle.to_tensor(self.a)
+        allclose(paddle.clip(x, 0.6, 1.0), np.clip(self.a, 0.6, 1.0))
+        allclose(paddle.scale(x, 2.0, 1.0), self.a * 2 + 1)
+
+    def test_cumsum(self):
+        x = paddle.to_tensor(self.a)
+        allclose(paddle.cumsum(x, axis=1), np.cumsum(self.a, 1), rtol=1e-5)
+
+    def test_inplace(self):
+        x = paddle.to_tensor(self.a.copy())
+        x.add_(paddle.to_tensor(self.b))
+        allclose(x, self.a + self.b)
+
+
+class TestReduction:
+    def setup_method(self, _):
+        self.a = np.random.RandomState(2).randn(3, 4, 5).astype(np.float32)
+
+    def test_sum_mean(self):
+        x = paddle.to_tensor(self.a)
+        allclose(paddle.sum(x), self.a.sum(), rtol=1e-4)
+        allclose(paddle.mean(x, axis=1), self.a.mean(1), rtol=1e-5)
+        allclose(paddle.sum(x, axis=[0, 2], keepdim=True),
+                 self.a.sum((0, 2), keepdims=True), rtol=1e-4)
+
+    def test_max_min_argmax(self):
+        x = paddle.to_tensor(self.a)
+        allclose(paddle.max(x, axis=2), self.a.max(2))
+        allclose(paddle.min(x), self.a.min())
+        assert np.array_equal(paddle.argmax(x, axis=1).numpy(), self.a.argmax(1))
+
+    def test_std_var(self):
+        x = paddle.to_tensor(self.a)
+        allclose(paddle.std(x), self.a.std(ddof=1), rtol=1e-4)
+        allclose(paddle.var(x, unbiased=False), self.a.var(), rtol=1e-4)
+
+    def test_all_any(self):
+        m = self.a > 0
+        x = paddle.to_tensor(m)
+        assert paddle.all(x).item() == m.all()
+        assert paddle.any(x, axis=0).numpy().tolist() == m.any(0).tolist()
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        b = np.random.rand(2, 4, 5).astype(np.float32)
+        allclose(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)),
+                 a @ b, rtol=1e-4)
+
+    def test_matmul_transpose(self):
+        a = np.random.rand(4, 3).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        allclose(paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                               transpose_x=True), a.T @ b, rtol=1e-4)
+
+    def test_norm_einsum(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        allclose(paddle.norm(paddle.to_tensor(a)), np.linalg.norm(a), rtol=1e-5)
+        allclose(paddle.einsum("ij,kj->ik", paddle.to_tensor(a), paddle.to_tensor(a)),
+                 a @ a.T, rtol=1e-4)
+
+    def test_solve_inv(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        allclose(paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)),
+                 np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+        allclose(paddle.linalg.inv(paddle.to_tensor(a)), np.linalg.inv(a),
+                 rtol=1e-3, atol=1e-4)
+
+
+class TestManipulation:
+    def setup_method(self, _):
+        self.a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+    def test_reshape_paddle_semantics(self):
+        x = paddle.to_tensor(self.a)
+        assert paddle.reshape(x, [0, -1]).shape == [2, 12]
+        assert paddle.reshape(x, [-1]).shape == [24]
+
+    def test_transpose_concat_split(self):
+        x = paddle.to_tensor(self.a)
+        allclose(paddle.transpose(x, [2, 0, 1]), self.a.transpose(2, 0, 1))
+        c = paddle.concat([x, x], axis=1)
+        assert c.shape == [2, 6, 4]
+        parts = paddle.split(c, 2, axis=1)
+        assert len(parts) == 2 and parts[0].shape == [2, 3, 4]
+        parts = paddle.split(c, [2, -1], axis=1)
+        assert parts[1].shape == [2, 4, 4]
+
+    def test_squeeze_unsqueeze_stack(self):
+        x = paddle.to_tensor(self.a)
+        assert paddle.unsqueeze(x, [0, 2]).shape == [1, 2, 1, 3, 4]
+        assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [2, 3, 4]
+        s = paddle.stack([x, x], axis=1)
+        assert s.shape == [2, 2, 3, 4]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        idx = paddle.to_tensor(np.array([0, 2]))
+        allclose(paddle.gather(x, idx, axis=0), x.numpy()[[0, 2]])
+        upd = paddle.ones([2, 3])
+        out = paddle.scatter(x, idx, upd)
+        expect = x.numpy().copy()
+        expect[[0, 2]] = 1
+        allclose(out, expect)
+
+    def test_topk_sort(self):
+        a = np.random.RandomState(3).rand(4, 6).astype(np.float32)
+        vals, idx = paddle.topk(paddle.to_tensor(a), 3, axis=1)
+        expect = np.sort(a, 1)[:, ::-1][:, :3]
+        allclose(vals, expect)
+        allclose(paddle.sort(paddle.to_tensor(a), axis=1), np.sort(a, 1))
+
+    def test_indexing(self):
+        x = paddle.to_tensor(self.a)
+        allclose(x[0], self.a[0])
+        allclose(x[:, 1:3], self.a[:, 1:3])
+        allclose(x[..., -1], self.a[..., -1])
+
+    def test_setitem(self):
+        x = paddle.to_tensor(self.a.copy())
+        x[0, 0] = 100.0
+        assert x.numpy()[0, 0, 0] == 100.0
+
+    def test_pad_tile(self):
+        x = paddle.to_tensor(np.ones((1, 2, 2, 2), np.float32))
+        p = paddle.nn.functional.pad(x, [1, 1, 1, 1])
+        assert p.shape == [1, 2, 4, 4]
+        t = paddle.tile(paddle.to_tensor(self.a), [2, 1, 1])
+        assert t.shape == [4, 3, 4]
+
+    def test_where_masked(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        x = paddle.to_tensor(a)
+        allclose(paddle.where(x > 0, x, paddle.zeros_like(x)), np.where(a > 0, a, 0))
+        allclose(paddle.masked_fill(x, x < 0, 0.0), np.where(a < 0, 0, a))
+
+
+class TestLogic:
+    def test_compare(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        assert (x < y).numpy().tolist() == [True, False, False]
+        assert (x == y).numpy().tolist() == [False, True, False]
+        assert paddle.equal_all(x, x).item()
+        assert paddle.allclose(x, x + 1e-9).item()
