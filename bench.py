@@ -1,0 +1,75 @@
+"""Driver benchmark: flagship GPT training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-tree numbers (BASELINE.md), so vs_baseline is
+reported against the north-star target qualitatively as null.
+
+Runs a bf16 GPT (350M-class by default; override with BENCH_MODEL/BENCH_BS/
+BENCH_SEQ env vars) through the whole-step-compiled TrainStep (one fused XLA
+program per step: forward + backward + AdamW with fp32 master weights).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_config,
+    )
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt3-350m")
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    batch = int(os.environ.get("BENCH_BS", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    cfg = gpt_config(model_name, max_position_embeddings=seq,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     use_recompute=os.environ.get("BENCH_RECOMPUTE", "1") == "1")
+    model = GPTForCausalLM(cfg)
+    # bf16 params + fp32 master weights — the TPU-native AMP O2 layout
+    model.bfloat16()
+    crit = GPTPretrainingCriterion()
+    opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                     multi_precision=True)
+
+    def loss_fn(m, ids, labels):
+        return crit(m(ids), labels)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)), dtype="int64")
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)), dtype="int64")
+
+    # warmup/compile
+    loss = step(ids, labels)
+    _ = float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": f"{model_name}_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,8 +70,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     general_grad.h partial-graph path)."""
     from .framework import run_backward
     from .framework.tensor import Tensor as _T
-    from .ops import zeros_like
 
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double backward) is not supported yet by the "
+            "tape engine; higher-order grads land with the functional "
+            "autograd transform"
+        )
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
@@ -91,7 +96,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
             if allow_unused:
                 results.append(None)
             else:
-                results.append(zeros_like(t))
+                raise ValueError(
+                    "one of the input tensors was not used in the graph; set "
+                    "allow_unused=True to return None for it (reference "
+                    "general_grad.h unused-input check)"
+                )
         else:
             results.append(_T._wrap(g))
     return results

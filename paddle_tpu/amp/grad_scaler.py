@@ -39,7 +39,8 @@ class AmpScaler:
     def is_enable(self):
         return self._enable
 
-    is_use_dynamic_loss_scaling = is_enable
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
 
     def scale(self, var):
         if not self._enable:

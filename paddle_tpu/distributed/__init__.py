@@ -1,0 +1,8 @@
+"""paddle.distributed parity — TPU-native distributed stack.
+
+The reference's rank-per-process NCCL world (SURVEY.md §2.5-2.6, §5.8) maps
+to a single-controller jax.sharding world: a global device Mesh, named axes
+per parallelism kind, NamedSharding placements, and XLA GSPMD/shard_map
+collectives over ICI.
+"""
+from . import fleet  # noqa: F401

@@ -1,0 +1,6 @@
+"""paddle.distributed.fleet parity (reference python/paddle/distributed/fleet/).
+
+Strategy layers over the collective core: topology/HCG, distributed_model
+wrappers, hybrid optimizer, sharding stages, recompute.
+"""
+from .recompute import recompute, recompute_sequential  # noqa: F401

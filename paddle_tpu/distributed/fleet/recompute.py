@@ -1,0 +1,123 @@
+"""Activation recomputation.
+
+Reference parity: RecomputeFunction / recompute / recompute_sequential
+(python/paddle/distributed/fleet/recompute/recompute.py:124,438,602) — a
+PyLayer that reruns the forward during backward instead of saving
+activations. TPU-first: `jax.checkpoint` (remat) expresses exactly this to
+XLA, which then schedules the recompute inside the fused step program; no
+manual RNG state save/restore is needed because dropout keys are traced
+values threaded through the step state (framework/random.py).
+
+Grads must flow to the segment's parameters, not only its inputs, so the
+segment's Layer parameters are lifted to explicit tape inputs before
+wrapping in jax.checkpoint.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework.tensor import Tensor
+from ...framework.autograd import apply_op
+
+
+def _collect_layer(function):
+    """Find the Layer whose params the segment uses (bound method or Layer)."""
+    from ...nn.layer.layers import Layer
+
+    if isinstance(function, Layer):
+        return function
+    owner = getattr(function, "__self__", None)
+    if isinstance(owner, Layer):
+        return owner
+    return None
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` without saving its intermediates; recompute them
+    during backward (reference recompute.py:438)."""
+    use_reentrant = kwargs.pop("use_reentrant", True)  # API parity; unused
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # traced RNG
+    if kwargs:
+        raise TypeError(f"unsupported recompute kwargs: {sorted(kwargs)}")
+
+    layer = _collect_layer(function)
+    params = [p for p in layer.parameters()] if layer is not None else []
+    buffers = list(layer.buffers()) if layer is not None else []
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    n_p, n_b, n_t = len(params), len(buffers), len(tensor_args)
+
+    def pure(*datas):
+        p_datas = datas[:n_p]
+        b_datas = datas[n_p:n_p + n_b]
+        a_datas = datas[n_p + n_b:]
+        saved_p = [p._data for p in params]
+        saved_b = [b._data for b in buffers]
+        for p, d in zip(params, p_datas):
+            p._data = d
+        for b, d in zip(buffers, b_datas):
+            b._data = d
+        it = iter(a_datas)
+        call_args = [Tensor._wrap(next(it)) if isinstance(a, Tensor) else a
+                     for a in args]
+        try:
+            out = function(*call_args)
+        finally:
+            for p, d in zip(params, saved_p):
+                p._data = d
+            for b, d in zip(buffers, saved_b):
+                b._data = d
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    ckpt = jax.checkpoint(pure)
+    return apply_op(ckpt, params + buffers + tensor_args, name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segment-wise recompute over a Sequential (reference recompute.py:602).
+
+    ctx: {"segments": N} — split `functions` into N recomputed chunks.
+    """
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else int(ctx)
+    from ...nn.layer.container import Sequential
+
+    if isinstance(functions, Sequential):
+        layers = list(functions)
+    else:
+        layers = list(functions)
+    if segments <= 0:
+        segments = 1
+    per = max(1, len(layers) // segments)
+    out = args
+    i = 0
+    while i < len(layers):
+        chunk = layers[i:i + per]
+
+        class _Seg:
+            def __init__(self, mods):
+                self.mods = mods
+
+            def __call__(self, *xs):
+                y = xs
+                for m in self.mods:
+                    y = m(*y) if isinstance(y, tuple) else m(y)
+                    y = y if isinstance(y, tuple) else (y,)
+                return y if len(y) > 1 else y[0]
+
+        seg = _Seg(chunk)
+        # lift all params of the chunk
+        from ...nn.layer.layers import Layer
+
+        class _Holder(Layer):
+            def __init__(self, mods):
+                super().__init__()
+                for j, m in enumerate(mods):
+                    self.add_sublayer(str(j), m)
+
+        holder = _Holder(chunk)
+        seg.__self__ = holder  # route _collect_layer to the chunk's params
+        out = recompute(seg, *(out if isinstance(out, tuple) else (out,)))
+        out = out if isinstance(out, tuple) else (out,)
+        i += per
+    return out if len(out) > 1 else out[0]

@@ -69,7 +69,8 @@ class GradNode:
     cotangents onward / accumulate into leaves).
     """
 
-    __slots__ = ("vjp", "inputs", "outputs_meta", "num_outputs", "name", "__weakref__")
+    __slots__ = ("vjp", "inputs", "outputs_meta", "num_outputs", "name",
+                 "outputs", "__weakref__")
 
     def __init__(self, vjp, inputs, outputs_meta, name=""):
         self.vjp = vjp
@@ -78,6 +79,9 @@ class GradNode:
         self.outputs_meta = outputs_meta
         self.num_outputs = len(outputs_meta)
         self.name = name
+        # weakrefs to output Tensors, set by apply_op — used to run grad
+        # hooks / retain_grads on the *accumulated* output cotangent
+        self.outputs = [None] * self.num_outputs
 
     def release(self):
         self.vjp = None
@@ -146,13 +150,32 @@ def run_backward(
 
     # node -> list of cotangents (one slot per output)
     cotangents: dict[int, list] = {}
-    node_by_id: dict[int, GradNode] = {}
+    # leaf accumulation buffer: id -> [tensor, cotangent]. Leaves accumulate
+    # here so their hooks run ONCE on the total gradient (reference:
+    # GradNodeAccumulation fires hooks on the accumulated grad).
+    leaf_acc: dict[int, list] = {}
     captured = {} if capture is not None else None
 
     def seed(node, idx, value):
-        node_by_id[id(node)] = node
         slots = cotangents.setdefault(id(node), [None] * node.num_outputs)
         slots[idx] = value if slots[idx] is None else slots[idx] + value
+
+    def route(t, g):
+        """Send a cotangent toward tensor t (accumulates at t's node slot or
+        the leaf buffer; hooks fire later, on the total)."""
+        child = t._grad_node
+        if child is None:
+            ent = leaf_acc.setdefault(id(t), [t, None])
+            ent[1] = g if ent[1] is None else ent[1] + g
+        else:
+            seed(child, t._out_index, g)
+
+    def apply_hooks(t, g):
+        for hook in t._backward_hooks:
+            out = hook(Tensor._wrap(g))
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        return g
 
     root_nodes = []
     for i, t in enumerate(tensors):
@@ -166,12 +189,12 @@ def run_backward(
                     f"got shape {list(t._data.shape)}"
                 )
             g = jnp.ones_like(t._data)
-        if capture is not None and id(t) in capture:
-            captured[id(t)] = g
         node = t._grad_node
         if node is None:
-            if accumulate_leaf and not t.stop_gradient:
-                t._accumulate_grad(g)
+            if not t.stop_gradient:
+                route(t, g)
+            elif capture is not None and id(t) in capture:
+                captured[id(t)] = g
             continue
         root_nodes.append(node)
         seed(node, t._out_index, g)
@@ -187,34 +210,40 @@ def run_backward(
                 "trying to backward through the graph a second time; "
                 "specify retain_graph=True if needed"
             )
-        full = tuple(
+        full = [
             s if s is not None else _zero_cotangent(m)
             for s, m in zip(slots, node.outputs_meta)
-        )
+        ]
+        # each slot now holds the TOTAL cotangent of that output tensor:
+        # run its hooks / capture / retain_grads here
+        for i in range(node.num_outputs):
+            ref = node.outputs[i]
+            t = ref() if ref is not None else None
+            if t is None:
+                continue
+            if t._backward_hooks and slots[i] is not None:
+                full[i] = apply_hooks(t, full[i])
+            if captured is not None and id(t) in capture:
+                captured[id(t)] = full[i]
+            if accumulate_leaf and t._retain_grads:
+                t._accumulate_grad(full[i])
         if node.num_outputs == 1:
             in_cots = node.vjp(full[0])
         else:
-            in_cots = node.vjp(full)
+            in_cots = node.vjp(tuple(full))
         for t, g in zip(node.inputs, in_cots):
             if _is_float0(g) or t.stop_gradient:
                 continue
-            for hook in t._backward_hooks:
-                out = hook(Tensor._wrap(g))
-                if out is not None:
-                    g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
-            if captured is not None and id(t) in capture:
-                prev = captured.get(id(t))
-                captured[id(t)] = g if prev is None else prev + g
-            child = t._grad_node
-            if child is None:
-                if accumulate_leaf:
-                    t._accumulate_grad(g)
-            else:
-                if accumulate_leaf and t._retain_grads:
-                    t._accumulate_grad(g)
-                seed(child, t._out_index, g)
+            route(t, g)
         if not retain_graph:
             node.release()
+
+    for tid, (t, g) in leaf_acc.items():
+        g = apply_hooks(t, g)
+        if captured is not None and tid in capture:
+            captured[tid] = g
+        if accumulate_leaf:
+            t._accumulate_grad(g)
 
     return captured
 
@@ -244,12 +273,15 @@ def apply_op(fn, inputs, attrs=None, name="", num_outputs=None):
     outs_tuple = (outs,) if single else tuple(outs)
 
     if needs_grad:
+        import weakref
+
         meta = [(o.shape, o.dtype) for o in outs_tuple]
         node = GradNode(vjp, list(inputs), meta, name=name)
         wrapped = tuple(
             Tensor._wrap(o, stop_gradient=False, grad_node=node, out_index=i)
             for i, o in enumerate(outs_tuple)
         )
+        node.outputs = [weakref.ref(t) for t in wrapped]
     else:
         wrapped = tuple(Tensor._wrap(o, stop_gradient=True) for o in outs_tuple)
 

@@ -1,0 +1,138 @@
+"""paddle.jit parity — whole-graph compilation.
+
+Reference: python/paddle/jit/api.py:195 `to_static` with two frontends (AST
+rewrite in jit/dy2static/, SOT bytecode capture in jit/sot/ via the
+eval-frame hook paddle/fluid/pybind/eval_frame.c). The TPU-native frontend is
+`jax.jit` tracing: the eager engine's ops are jnp calls, so tracing a dygraph
+callable directly yields the whole graph — no bytecode interception needed,
+and guards/recompiles are jax.jit's shape-keyed executable cache.
+
+`TrainStep` extends this to the full forward+backward+optimizer step
+(see train_step.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..framework.tensor import Tensor
+from .train_step import TrainStep, _tree_data, _tree_wrap
+
+__all__ = ["to_static", "TrainStep", "not_to_static", "ignore_module", "save", "load"]
+
+
+class StaticFunction:
+    """A compiled callable over a Layer or plain function.
+
+    For a Layer, parameters and buffers are threaded as traced inputs so the
+    compiled program follows in-place param updates (e.g. optimizer steps
+    between inference calls) without retracing.
+    """
+
+    def __init__(self, fn, layer=None, full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._jitted = None
+        functools.update_wrapper(self, fn)
+
+    def _build(self):
+        layer = self._layer
+
+        if layer is None:
+            def pure(batch):
+                out = self._fn(*_tree_wrap(batch))
+                return _tree_data(out)
+        else:
+            params = list(layer.parameters())
+            buffers = list(layer.buffers())
+
+            def pure(state, batch):
+                saved_p = [p._data for p in params]
+                saved_b = [b._data for b in buffers]
+                for p, d in zip(params, state[0]):
+                    p._data = d
+                for b, d in zip(buffers, state[1]):
+                    b._data = d
+                try:
+                    out = self._fn(*_tree_wrap(batch))
+                finally:
+                    for p, d in zip(params, saved_p):
+                        p._data = d
+                    for b, d in zip(buffers, saved_b):
+                        b._data = d
+                return _tree_data(out)
+
+        self._jitted = jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            raise TypeError("to_static-compiled callables take positional "
+                            "Tensor args only")
+        if self._jitted is None:
+            self._build()
+        batch = _tree_data(list(args))
+        if self._layer is None:
+            out = self._jitted(batch)
+        else:
+            state = ([p._data for p in self._layer.parameters()],
+                     [b._data for b in self._layer.buffers()])
+            out = self._jitted(state, batch)
+        return _tree_wrap(out)
+
+    @property
+    def code(self):  # reference API parity (dy2static exposes rewritten code)
+        import inspect
+
+        try:
+            return inspect.getsource(self._fn)
+        except OSError:
+            return "<source unavailable>"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static parity (python/paddle/jit/api.py:195).
+
+    Decorates a function or Layer; returns a compiled callable backed by
+    jax.jit. `input_spec`/`build_strategy`/`backend` are accepted for API
+    compatibility (XLA needs none of them — shapes specialize at call time).
+    """
+    def wrap(f):
+        from ..nn.layer.layers import Layer
+
+        if isinstance(f, Layer):
+            sf = StaticFunction(f.forward, layer=f)
+            f.forward = sf
+            return f
+        return StaticFunction(f)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn):
+    """Marker: exclude from compilation (reference python/paddle/jit/api.py)."""
+    fn._paddle_tpu_not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def save(layer, path, input_spec=None, **config):
+    """paddle.jit.save parity — persists params + config; on TPU the program
+    itself is re-derived by tracing at load (XLA recompiles per backend, so
+    serializing HLO would pin the wrong target)."""
+    from ..framework import io as fio
+
+    fio.save(layer.state_dict(), path + ".pdparams")
+
+
+def load(path, **config):
+    raise NotImplementedError(
+        "paddle_tpu.jit.load requires the model class; use paddle_tpu.load for "
+        "state dicts and re-trace with to_static"
+    )

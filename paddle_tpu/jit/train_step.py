@@ -1,0 +1,190 @@
+"""Whole-step compilation of the eager dygraph tape.
+
+This is the TPU answer to the reference's per-op dispatch hot loop
+(SURVEY.md §3.1, §7 "hard parts: eager-on-XLA latency"): instead of launching
+one XLA computation per op like Paddle launches one CUDA kernel per op, the
+entire train step — forward, tape backward, grad clip, optimizer update —
+is traced ONCE into a single jitted function over a state pytree, then
+executed as one fused XLA program per step with donated buffers.
+
+It works because the eager engine is already trace-transparent: `Tensor._data`
+is a jax value, every op is a jnp call recorded through `jax.vjp`, and the
+optimizer's update rules are jnp expressions. We thread all mutable state
+(parameters, buffers, optimizer accumulators, master weights, step count, RNG
+offset) through the traced function as explicit inputs/outputs, temporarily
+binding tracers into the live objects during tracing.
+
+Reference parity: replaces the roles of StandaloneExecutor/PirInterpreter
+(paddle/fluid/framework/new_executor/pir_interpreter.h:32) and the CINN
+compiler entry (paddle/fluid/pir/transforms/build_cinn_pass.cc) — XLA is the
+compiler, PJRT the executor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import random as _random
+
+
+def _tree_data(x):
+    """Map Tensors (possibly nested in lists/tuples/dicts) to jax arrays."""
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_tree_data(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tree_data(v) for k, v in x.items()}
+    return x
+
+
+def _tree_wrap(x):
+    if isinstance(x, jax.Array):
+        return Tensor._wrap(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_tree_wrap(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tree_wrap(v) for k, v in x.items()}
+    return x
+
+
+class _OptimizerState:
+    """Snapshot/inject the mutable numeric state of an Optimizer."""
+
+    def __init__(self, optimizer):
+        self.opt = optimizer
+
+    def extract(self):
+        opt = self.opt
+        accum = {
+            name: {k: v for k, v in per.items()}
+            for name, per in opt._accumulators.items()
+        }
+        master = dict(opt._master_weights)
+        return {
+            "accumulators": accum,
+            "master_weights": master,
+            "step": jnp.asarray(opt._step_count, jnp.int32),
+        }
+
+    def inject(self, state):
+        opt = self.opt
+        for name, per in state["accumulators"].items():
+            opt._accumulators.setdefault(name, {}).update(per)
+        opt._master_weights.update(state["master_weights"])
+        opt._step_count = state["step"]
+
+    def restore_host(self, state):
+        """Re-inject concrete state after a jitted step (device arrays)."""
+        self.inject(state)
+
+
+class TrainStep:
+    """Compile `(batch) -> loss` + backward + optimizer into one XLA program.
+
+    Usage::
+
+        step = TrainStep(model, loss_fn, optimizer)     # loss_fn(model, *batch)
+        for batch in loader:
+            loss = step(*batch)                          # one fused XLA launch
+
+    `loss_fn(model, *batch_tensors)` must return a scalar loss Tensor. All
+    batch entries with a given set of shapes/dtypes compile once (shape-keyed
+    executable cache — jax.jit's own).
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._opt_state = _OptimizerState(optimizer)
+        self._params = None   # resolved lazily: optimizer may create accums on 1st step
+        self._buffers = None
+        self._jitted = None
+        self._donate = donate
+
+    # -- state plumbing -------------------------------------------------
+    def _resolve_slots(self):
+        self._params = [p for p in self.model.parameters() if p.trainable]
+        self._buffers = list(self.model.buffers())
+
+    def _extract_state(self):
+        return {
+            "params": [p._data for p in self._params],
+            "buffers": [b._data for b in self._buffers],
+            "opt": self._opt_state.extract(),
+            "rng_offset": jnp.asarray(_random.default_generator()._offset, jnp.int64
+                                      if jax.config.jax_enable_x64 else jnp.int32),
+        }
+
+    def _inject_state(self, state):
+        for p, d in zip(self._params, state["params"]):
+            p._data = d
+        for b, d in zip(self._buffers, state["buffers"]):
+            b._data = d
+        self._opt_state.inject(state["opt"])
+        _random.default_generator()._offset = state["rng_offset"]
+
+    # -- the traced step ------------------------------------------------
+    def _build(self, example_batch):
+        self._resolve_slots()
+        opt = self.optimizer
+
+        def step_fn(state, lr, batch):
+            self._inject_state(state)
+            batch_t = _tree_wrap(batch)
+            loss = self.loss_fn(self.model, *batch_t)
+            loss.backward()
+            # freeze lr at the traced scalar for this step
+            prev_get_lr = opt.get_lr
+            opt.get_lr = lambda: lr
+            try:
+                opt.step()
+            finally:
+                opt.get_lr = prev_get_lr
+            opt.clear_grad()
+            new_state = self._extract_state()
+            return loss._data, new_state
+
+        donate = (0,) if self._donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        batch_data = _tree_data(list(batch))
+        if self._jitted is None:
+            # run optimizer accumulator creation eagerly once so the state
+            # pytree is complete before tracing
+            self._warmup_accumulators()
+            self._build(batch_data)
+        state = self._extract_state()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss_data, new_state = self._jitted(state, lr, batch_data)
+        self._inject_state(new_state)
+        # advance host-side schedulers
+        sched = getattr(self.optimizer, "_learning_rate", None)
+        if hasattr(sched, "step"):
+            sched.step()
+        return Tensor._wrap(loss_data)
+
+    def _warmup_accumulators(self):
+        """Create optimizer accumulators at their init values without mutating
+        anything: run each param's update op once with writes patched out, so
+        `_get_accumulator` creation fires but no state changes."""
+        self._resolve_slots()
+        opt = self.optimizer
+        for p in self._params:
+            if opt._use_master(p):
+                opt._master_weight(p)
+        saved_set = opt._set_accumulator
+        saved_write = opt._write_param
+        opt._set_accumulator = lambda *a, **k: None
+        opt._write_param = lambda *a, **k: None
+        try:
+            for p in self._params:
+                pv = opt._param_value(p)
+                g = jnp.zeros(pv.shape, pv.dtype)
+                opt._append_optimize_op(p, g)
+        finally:
+            opt._set_accumulator = saved_set
+            opt._write_param = saved_write

@@ -1,0 +1,13 @@
+"""Model families matching the BASELINE capability configs (BASELINE.md):
+GPT (config 4 flagship), BERT (config 3), LLaMA (config 5); vision models
+(configs 1–2) live in paddle_tpu.vision.models.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForCausalLM,
+    GPTPretrainingCriterion,
+    gpt_config,
+    gpt_sharding_rules,
+    match_sharding,
+)

@@ -1,0 +1,246 @@
+"""GPT model family — the flagship pretraining model (BASELINE config 4:
+GPT-3 1.3B, sharding stage 2/3 + recompute).
+
+Reference parity: the GPT nets used by Paddle's Fleet examples
+(python/paddle/incubate/ layers + nn/layer/transformer.py building blocks).
+TPU-first: the model is plain dygraph Layers whose params carry stable names;
+`sharding_rules()` maps those names to `jax.sharding.PartitionSpec`s so the
+same model runs single-chip, tensor-parallel (Megatron layout over the "mp"
+mesh axis), fully-sharded ("fsdp"/dp axis) or both — XLA GSPMD inserts the
+collectives (SURVEY.md §5.8 north star).
+
+Megatron TP layout (reference fleet/layers/mpu/mp_layers.py:47,334,541):
+  - qkv / fc1: column-parallel — weight [in, out] sharded on out → "mp"
+  - out-proj / fc2: row-parallel — weight sharded on in → "mp"
+  - token embedding: vocab-parallel — sharded on vocab dim
+"""
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+from .. import nn
+from ..nn import functional as F
+from ..framework.tensor import Tensor
+from ..ops import creation as C
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 0          # 0 → 4 * hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.0
+    attention_dropout_prob: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    use_recompute: bool = False
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+# Named configs (sizes follow the GPT-3 paper table; 1.3B is the BASELINE
+# north-star pretrain config).
+GPT_CONFIGS = {
+    "gpt3-125m": dict(hidden_size=768, num_layers=12, num_attention_heads=12),
+    "gpt3-350m": dict(hidden_size=1024, num_layers=24, num_attention_heads=16),
+    "gpt3-1.3b": dict(hidden_size=2048, num_layers=24, num_attention_heads=32),
+    "gpt3-2.7b": dict(hidden_size=2560, num_layers=32, num_attention_heads=32),
+    "gpt3-6.7b": dict(hidden_size=4096, num_layers=32, num_attention_heads=32),
+    "gpt3-13b": dict(hidden_size=5120, num_layers=40, num_attention_heads=40),
+}
+
+
+def gpt_config(name: str, **overrides) -> GPTConfig:
+    kw = dict(GPT_CONFIGS[name])
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv = nn.Linear(h, 3 * h)
+        self.out_proj = nn.Linear(h, h)
+        self.dropout_p = config.attention_dropout_prob
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x)                              # [b, s, 3h]
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]                               # [b, s, nh, hd]
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout_p,
+            training=self.training,
+        )                                               # [b, s, nh, hd]
+        out = out.reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc1 = nn.Linear(config.hidden_size, config.intermediate_size)
+        self.fc2 = nn.Linear(config.intermediate_size, config.hidden_size)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    """Pre-LN transformer decoder block."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self._use_recompute = config.use_recompute
+
+    def _inner(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return x
+
+    def forward(self, x):
+        if self._use_recompute and self.training:
+            from ..distributed.fleet import recompute
+
+            return recompute(self._inner, x)
+        return self._inner(x)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.blocks = nn.LayerList([GPTBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self._init_weights(config)
+
+    def _init_weights(self, config):
+        import jax
+
+        from ..framework.random import next_key
+        import jax.numpy as jnp
+
+        std = config.initializer_range
+        for name, p in self.named_parameters():
+            if p.ndim >= 2:
+                p._data = std * jax.random.normal(next_key(), p._data.shape,
+                                                  jnp.float32)
+                if re.search(r"(out_proj|fc2)\.weight$", name):
+                    # GPT-2 residual-scaled init
+                    p._data = p._data / math.sqrt(2.0 * config.num_layers)
+
+    def forward(self, input_ids, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = C.arange(0, s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.blocks:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """GPT + LM head; forward returns logits, `loss()` the CE training loss."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        if self.lm_head is None:
+            from .. import ops
+
+            logits = ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
+        return logits
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Shifted-token cross entropy (mean over non-masked positions)."""
+
+    def forward(self, logits, labels, loss_mask=None):
+        from .. import ops
+
+        vocab = logits.shape[-1]
+        loss = F.cross_entropy(
+            logits.reshape([-1, vocab]), labels.reshape([-1]),
+            reduction="none",
+        )
+        if loss_mask is not None:
+            m = loss_mask.reshape([-1]).astype(loss.dtype)
+            return ops.sum(loss * m) / ops.clip(ops.sum(m), min=1.0)
+        return ops.mean(loss)
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules: param-name regex → PartitionSpec axes per dim.
+# Axis names: "dp" (data/fsdp), "mp" (tensor), "pp" (pipeline — handled by
+# the pipeline module, not these specs).
+# ---------------------------------------------------------------------------
+
+def gpt_sharding_rules(tp_axis="mp", fsdp_axis=None):
+    """Megatron TP placement (+optional ZeRO-3 sharding of the other dim).
+
+    Returns list of (regex, spec) where spec is a tuple of mesh-axis names
+    (or None) per tensor dim. First match wins; unmatched params replicate.
+    """
+    def spec(*axes):
+        return tuple(axes)
+
+    rules = [
+        # column-parallel: [in, out] → shard out on mp, in on fsdp
+        (r"\.qkv\.weight$", spec(fsdp_axis, tp_axis)),
+        (r"\.fc1\.weight$", spec(fsdp_axis, tp_axis)),
+        (r"\.qkv\.bias$", spec(tp_axis)),
+        (r"\.fc1\.bias$", spec(tp_axis)),
+        # row-parallel: [in, out] → shard in on mp, out on fsdp
+        (r"\.out_proj\.weight$", spec(tp_axis, fsdp_axis)),
+        (r"\.fc2\.weight$", spec(tp_axis, fsdp_axis)),
+        # vocab-parallel embedding: [vocab, hidden]
+        (r"\bwte\.weight$", spec(tp_axis, fsdp_axis)),
+        (r"\bwpe\.weight$", spec(None, fsdp_axis)),
+        (r"lm_head\.weight$", spec(fsdp_axis, tp_axis)),
+    ]
+    return rules
+
+
+def match_sharding(name, rules):
+    for pat, spec in rules:
+        if re.search(pat, name):
+            return spec
+    return ()

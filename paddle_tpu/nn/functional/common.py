@@ -83,8 +83,9 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
 
     def f(idx, w):
         out = jnp.take(w, idx.astype(jnp.int32), axis=0)
-        if padding_idx is not None and padding_idx >= 0:
-            mask = (idx == padding_idx)[..., None]
+        if padding_idx is not None:
+            pad = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (idx == pad)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
 

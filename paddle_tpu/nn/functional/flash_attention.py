@@ -22,25 +22,28 @@ _PALLAS_MIN_SEQ = 1024  # below this, plain XLA attention is already optimal
 
 
 def _sdpa_ref(q, k, v, mask, scale, causal, dropout_p, key):
-    # q,k,v: [b, s, h, d] — compute in fp32, output in input dtype
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    # q,k,v: [b, s, h, d] — dots run in the input dtype on the MXU with fp32
+    # accumulation (preferred_element_type); softmax in fp32.
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(cmask, logits, -jnp.inf)
+        logits = jnp.where(cmask, logits, jnp.float32(-jnp.inf))
     if mask is not None:
         if mask.dtype == jnp.bool_:
-            logits = jnp.where(mask, logits, -jnp.inf)
+            logits = jnp.where(mask, logits, jnp.float32(-jnp.inf))
         else:
             logits = logits + mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     if dropout_p > 0.0 and key is not None:
         keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return out.astype(q.dtype)
 
 

@@ -124,8 +124,6 @@ def l1_loss(input, label, reduction="mean", name=None):
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
     def f(a, b):
         d = jnp.abs(a - b)
-        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
-        # paddle smooth_l1 = huber with delta scaling; keep huber form
         loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
         return _reduce(loss, reduction)
 

@@ -267,7 +267,7 @@ def sigmoid(x, name=None):
 
 def logit(x, eps=None, name=None):
     def f(v):
-        vv = jnp.clip(v, eps, 1 - eps) if eps else v
+        vv = jnp.clip(v, eps, 1 - eps) if eps is not None else v
         return jnp.log(vv / (1 - vv))
 
     return unary(f, x, "logit")

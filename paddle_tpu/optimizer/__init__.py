@@ -60,7 +60,7 @@ class Adam(Optimizer):
         lr_v = self.get_lr()
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
-        t = self._step_count
+        t = jnp.asarray(self._step_count, jnp.float32)
         m_new = self._beta1 * m + (1 - self._beta1) * g
         v_new = self._beta2 * v + (1 - self._beta2) * g * g
         self._set_accumulator("moment1", p, m_new)
@@ -114,7 +114,7 @@ class Adamax(Optimizer):
         lr_v = self.get_lr()
         m = self._get_accumulator("moment", p)
         u = self._get_accumulator("inf_norm", p)
-        t = self._step_count
+        t = jnp.asarray(self._step_count, jnp.float32)
         m_new = self._beta1 * m + (1 - self._beta1) * g
         u_new = jnp.maximum(self._beta2 * u, jnp.abs(g))
         self._set_accumulator("moment", p, m_new)
@@ -222,7 +222,7 @@ class Lamb(Optimizer):
         lr_v = self.get_lr()
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
-        t = self._step_count
+        t = jnp.asarray(self._step_count, jnp.float32)
         m_new = self._beta1 * m + (1 - self._beta1) * g
         v_new = self._beta2 * v + (1 - self._beta2) * g * g
         self._set_accumulator("moment1", p, m_new)
@@ -246,11 +246,23 @@ class NAdam(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._psi = momentum_decay
-        self._mu_product = 1.0
+
+    @property
+    def _mu_product(self):
+        # lives in the accumulator store so it is checkpointed by
+        # state_dict and threaded through the jitted train step
+        store = self._accumulators.setdefault("nadam_mu_product", {})
+        if "_global" not in store:
+            store["_global"] = jnp.ones((), jnp.float32)
+        return store["_global"]
+
+    @_mu_product.setter
+    def _mu_product(self, value):
+        self._accumulators.setdefault("nadam_mu_product", {})["_global"] = value
 
     def _append_optimize_op(self, p, g):
         lr_v = self.get_lr()
-        t = self._step_count
+        t = jnp.asarray(self._step_count, jnp.float32)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
         mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
@@ -269,7 +281,7 @@ class NAdam(Optimizer):
 
     def step(self):
         super().step()
-        t = self._step_count
+        t = jnp.asarray(self._step_count, jnp.float32)
         mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
         self._mu_product *= mu_t
 
@@ -282,7 +294,7 @@ class RAdam(Optimizer):
 
     def _append_optimize_op(self, p, g):
         lr_v = self.get_lr()
-        t = self._step_count
+        t = jnp.asarray(self._step_count, jnp.float32)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
         m_new = self._beta1 * m + (1 - self._beta1) * g
@@ -292,15 +304,13 @@ class RAdam(Optimizer):
         m_hat = m_new / (1 - self._beta1 ** t)
         rho_inf = 2 / (1 - self._beta2) - 1
         rho_t = rho_inf - 2 * t * self._beta2 ** t / (1 - self._beta2 ** t)
-        if rho_t > 5:
-            v_hat = jnp.sqrt(v_new / (1 - self._beta2 ** t))
-            r = (
-                ((rho_t - 4) * (rho_t - 2) * rho_inf)
-                / ((rho_inf - 4) * (rho_inf - 2) * rho_t)
-            ) ** 0.5
-            update = r * m_hat / (v_hat + self._epsilon)
-        else:
-            update = m_hat
+        # branchless: t may be a traced value inside the jitted train step
+        v_hat = jnp.sqrt(v_new / (1 - self._beta2 ** t))
+        r_sq = ((rho_t - 4) * (rho_t - 2) * rho_inf) / (
+            (rho_inf - 4) * (rho_inf - 2) * rho_t
+        )
+        r = jnp.sqrt(jnp.maximum(r_sq, 0.0))
+        update = jnp.where(rho_t > 5.0, r * m_hat / (v_hat + self._epsilon), m_hat)
         self._write_param(p, self._param_value(p) - lr_v * update)
 
 
