@@ -60,6 +60,9 @@ from . import device  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 
 from .hapi import Model  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import models  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .nn import ParamAttr  # noqa: E402,F401
 

@@ -2,7 +2,67 @@
 
 The reference's rank-per-process NCCL world (SURVEY.md §2.5-2.6, §5.8) maps
 to a single-controller jax.sharding world: a global device Mesh, named axes
-per parallelism kind, NamedSharding placements, and XLA GSPMD/shard_map
-collectives over ICI.
+per parallelism kind ([pp, dp, sharding, sep, mp]), NamedSharding
+placements, and XLA GSPMD/shard_map collectives over ICI.
 """
+from .env import (  # noqa: F401
+    init_parallel_env,
+    get_mesh,
+    set_mesh,
+    build_mesh,
+    is_initialized as parallel_env_initialized,
+)
+from .collective import (  # noqa: F401
+    ReduceOp,
+    Group,
+    new_group,
+    get_group,
+    all_reduce,
+    reduce,
+    all_gather,
+    all_gather_concat,
+    all_gather_object,
+    reduce_scatter,
+    broadcast,
+    broadcast_object_list,
+    scatter,
+    alltoall,
+    alltoall_single,
+    send,
+    recv,
+    isend,
+    irecv,
+    p2p_permute,
+    barrier,
+    get_rank,
+    get_world_size,
+    is_initialized,
+    destroy_process_group,
+)
+from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from . import sharding  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh,
+    Shard,
+    Replicate,
+    Partial,
+    shard_tensor,
+    dtensor_from_local,
+    dtensor_to_local,
+    reshard,
+    shard_layer,
+)
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Reference parallel.py spawn — single-controller: run inline (all
+    devices are already visible to this process)."""
+    func(*args)
+
+
+def launch():
+    from .launch.main import main
+
+    main()

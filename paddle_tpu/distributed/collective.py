@@ -1,0 +1,399 @@
+"""Collective communication API.
+
+Reference parity: python/paddle/distributed/communication/ (15 files) +
+Group management (python/paddle/distributed/collective.py:151-180) over
+ProcessGroupNCCL (paddle/phi/core/distributed/collective/process_group.h:48).
+
+TPU-first: a Group is a set of named mesh axes on the global Mesh. Each
+collective has two modes:
+
+- **traced** (inside `shard_map`/pjit): lowers directly to the XLA
+  collective (`lax.psum` / `all_gather` / `psum_scatter` / `all_to_all` /
+  `ppermute`) over ICI with replica groups from the axis — the
+  ProcessGroupXLA north star of SURVEY.md §5.8.
+- **eager** (single-controller): wraps the same lax op in a `shard_map` over
+  the group's axes. A replicated input behaves like "every rank holds this
+  value" (reference per-rank semantics); an input sharded over the group
+  axis uses its true per-device shards.
+
+All collectives record on the autograd tape (they are jax-differentiable),
+matching the reference's PyLayer comm ops (fleet/layers/mpu/mp_ops.py:91-341).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+from ..framework.tensor import Tensor
+from ..framework.autograd import apply_op
+from . import env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = named axes of the global mesh (reference
+    Group, python/paddle/distributed/communication/group.py)."""
+
+    _next_id = 0
+
+    def __init__(self, mesh: Mesh, axes, name=None):
+        self.mesh = mesh
+        self.axes = tuple(axes) if not isinstance(axes, str) else (axes,)
+        for a in self.axes:
+            if a not in mesh.axis_names:
+                raise ValueError(f"axis {a!r} not in mesh {mesh.axis_names}")
+        Group._next_id += 1
+        self.id = Group._next_id
+        self.name = name or f"group_{self.id}"
+
+    @property
+    def nranks(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.axes]))
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        # single-controller: the controller addresses all ranks; 0 by parity
+        return 0
+
+    @property
+    def process_ids(self):
+        return list(range(self.nranks))
+
+    ranks = process_ids
+
+    def get_group_rank(self, rank):
+        return rank if 0 <= rank < self.nranks else -1
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self.nranks})"
+
+
+_default_group = None
+
+
+def _world_group() -> Group:
+    global _default_group
+    mesh = env.get_mesh()
+    if _default_group is None or _default_group.mesh is not mesh:
+        _default_group = Group(mesh, mesh.axis_names, name="world")
+    return _default_group
+
+
+def get_group(gid=None) -> Group:
+    return _world_group()
+
+
+def new_group(ranks=None, backend=None, timeout=None, axes=None, mesh=None) -> Group:
+    """Reference collective.py:151 new_group. TPU-native extension: pass
+    `axes=` to bind the group to mesh axes (the common case via topology);
+    explicit `ranks` builds a 1-axis sub-mesh over those devices."""
+    mesh = mesh or env.get_mesh()
+    if axes is not None:
+        return Group(mesh, axes)
+    flat = list(mesh.devices.flat)
+    if ranks is None or len(ranks) == len(flat):
+        return _world_group()
+    sub = np.asarray([flat[r] for r in ranks])
+    return Group(Mesh(sub, ("sub",)), ("sub",))
+
+
+def _axis_bound(axis: str) -> bool:
+    """True when called inside a shard_map/pmap context binding `axis`."""
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def _group_axes(group) -> tuple:
+    group = group or _world_group()
+    return group.axes if isinstance(group, Group) else tuple(group)
+
+
+def _input_spec(data, mesh) -> P:
+    sh = getattr(data, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh.axis_names == mesh.axis_names:
+        return sh.spec
+    return P()
+
+
+def _run(group, data, traced_fn, out_spec=None):
+    """Execute traced_fn (using lax collectives over group.axes) on `data`:
+    directly if the axes are bound (already inside shard_map), else wrapped
+    in an eager shard_map over the group's mesh."""
+    group = group or _world_group()
+    axes = group.axes
+    if isinstance(data, jax.core.Tracer) and _axis_bound(axes[0]):
+        return traced_fn(data)
+    mesh = group.mesh
+    in_spec = _input_spec(data, mesh)
+    o_spec = out_spec if out_spec is not None else in_spec
+    fn = shard_map(traced_fn, mesh=mesh, in_specs=(in_spec,),
+                   out_specs=o_spec, check_vma=False)
+    return fn(data)
+
+
+def _axis_arg(axes):
+    return axes if len(axes) > 1 else axes[0]
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def _reduce_traced(axes, op):
+    ax = _axis_arg(axes)
+    if op in (ReduceOp.SUM, "sum"):
+        return lambda s: jax.lax.psum(s, ax)
+    if op in (ReduceOp.MAX, "max"):
+        return lambda s: jax.lax.pmax(s, ax)
+    if op in (ReduceOp.MIN, "min"):
+        return lambda s: jax.lax.pmin(s, ax)
+    if op in (ReduceOp.AVG, "avg"):
+        return lambda s: jax.lax.pmean(s, ax)
+    if op in (ReduceOp.PROD, "prod"):
+        return lambda s: jnp.exp(jax.lax.psum(jnp.log(s), ax))
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference communication/all_reduce.py; in-place on `tensor`."""
+    group = group or _world_group()
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    fn = _reduce_traced(group.axes, op)
+    out = apply_op(lambda x: _run(group, x, fn), [t], name="all_reduce")
+    t._inplace_from(out)
+    return t
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # with a single controller, reduce == all_reduce (dst holds the value;
+    # every device materializes it — XLA replicates for free)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """Reference communication/all_gather.py: gathers per-rank tensors into
+    tensor_list (stack on a new leading dim per rank)."""
+    group = group or _world_group()
+    ax = _axis_arg(group.axes)
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+
+    def traced(s):
+        return jax.lax.all_gather(s, ax, axis=0, tiled=False)
+
+    out = apply_op(lambda x: _run(group, x, traced, out_spec=P()), [t],
+                   name="all_gather")
+    if tensor_list is not None:
+        del tensor_list[:]
+        for i in range(group.nranks):
+            tensor_list.append(out[i])
+        return tensor_list
+    return out
+
+
+def all_gather_concat(tensor, group=None, axis=0):
+    """TPU-native helper: gather and concat along `axis` (tiled all-gather —
+    what SP/mp layers actually want)."""
+    group = group or _world_group()
+    ax = _axis_arg(group.axes)
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+
+    def traced(s):
+        return jax.lax.all_gather(s, ax, axis=axis, tiled=True)
+
+    return apply_op(lambda x: _run(group, x, traced, out_spec=P()), [t],
+                    name="all_gather_concat")
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True, axis=0):
+    """Reference communication/reduce_scatter.py: sum across ranks, then
+    scatter slices along dim `axis`; returns this rank's slice (sharded)."""
+    group = group or _world_group()
+    ax = _axis_arg(group.axes)
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    t = src if isinstance(src, Tensor) else Tensor(src)
+
+    def traced(s):
+        return jax.lax.psum_scatter(s, ax, scatter_dimension=axis, tiled=True)
+
+    spec_axes = [None] * t.ndim
+    spec_axes[axis] = ax
+    out = apply_op(
+        lambda x: _run(group, x, traced, out_spec=P(*spec_axes)), [t],
+        name="reduce_scatter",
+    )
+    if tensor_or_tensor_list is not None and isinstance(tensor, Tensor):
+        tensor._inplace_from(out)
+        return tensor
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Reference communication/broadcast.py: every rank gets src's value."""
+    group = group or _world_group()
+    ax = _axis_arg(group.axes)
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+
+    def traced(s):
+        idx = jax.lax.axis_index(ax)
+        contrib = jnp.where(idx == src, s, jnp.zeros_like(s))
+        return jax.lax.psum(contrib, ax)
+
+    out = apply_op(lambda x: _run(group, x, traced), [t], name="broadcast")
+    t._inplace_from(out)
+    return t
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """src's list entry i goes to rank i. Global view: returns the stacked
+    [nranks, ...] tensor laid out so device i holds row i (the DTensor form
+    of "each rank has its row"). Traced context: takes this rank's row."""
+    group = group or _world_group()
+    ax = _axis_arg(group.axes)
+    if tensor_list is not None:
+        stacked = Tensor(jnp.stack([x._data if isinstance(x, Tensor) else x
+                                    for x in tensor_list]))
+    else:
+        stacked = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+
+    if isinstance(stacked._data, jax.core.Tracer) and _axis_bound(ax):
+        def pick(s):
+            return jnp.take(s, jax.lax.axis_index(ax), axis=0)
+
+        return apply_op(pick, [stacked], name="scatter")
+
+    spec = P(ax, *([None] * (stacked.ndim - 1)))
+    sharding = NamedSharding(group.mesh, spec)
+    out = apply_op(lambda x: jax.device_put(x, sharding), [stacked],
+                   name="scatter")
+    if isinstance(tensor, Tensor):
+        tensor._inplace_from(out)
+        return tensor
+    return out
+
+
+def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    """Reference communication/all_to_all.py."""
+    group = group or _world_group()
+    ax = _axis_arg(group.axes)
+    if in_tensor_list is None:
+        in_tensor_list = out_tensor_list
+    stacked = Tensor(jnp.stack([x._data if isinstance(x, Tensor) else x
+                                for x in in_tensor_list]))
+
+    def traced(s):
+        # s: [nranks, ...] rows destined per rank
+        return jax.lax.all_to_all(s, ax, split_axis=0, concat_axis=0,
+                                  tiled=False)
+
+    out = apply_op(lambda x: _run(group, x, traced, out_spec=P()), [stacked],
+                   name="alltoall")
+    if out_tensor_list is not None:
+        del out_tensor_list[:]
+        for i in range(group.nranks):
+            out_tensor_list.append(out[i])
+        return out_tensor_list
+    return out
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    group = group or _world_group()
+    ax = _axis_arg(group.axes)
+    t = in_tensor if isinstance(in_tensor, Tensor) else Tensor(in_tensor)
+
+    def traced(s):
+        return jax.lax.all_to_all(s, ax, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    out = apply_op(lambda x: _run(group, x, traced), [t],
+                   name="alltoall_single")
+    if isinstance(out_tensor, Tensor):
+        out_tensor._inplace_from(out)
+        return out_tensor
+    return out
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv only exist inside traced pipeline stages "
+        "on TPU (lax.ppermute over the pp axis); use "
+        "paddle_tpu.distributed.fleet.PipelineParallel or p2p_permute()"
+    )
+
+
+recv = send
+isend = send
+irecv = send
+
+
+def p2p_permute(tensor, perm, group=None):
+    """Traced-context point-to-point: permute values across the group axis.
+    perm: list of (src, dst) pairs (reference P2pHelper's send/recv pattern,
+    fleet/meta_parallel/pp_utils/p2p_communication.py:570)."""
+    group = group or _world_group()
+    ax = _axis_arg(group.axes)
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+
+    def traced(s):
+        return jax.lax.ppermute(s, ax, perm)
+
+    return apply_op(lambda x: _run(group, x, traced), [t], name="p2p_permute")
+
+
+def barrier(group=None):
+    """Synchronize: a tiny psum forced to completion."""
+    group = group or _world_group()
+    fn = _reduce_traced(group.axes, ReduceOp.SUM)
+    out = _run(group, jnp.zeros((), jnp.int32), fn)
+    jax.block_until_ready(out)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Host-side object gather; single-controller: every rank is this
+    process, so the list is nranks copies (parity with references tests)."""
+    group = group or _world_group()
+    del object_list[:]
+    object_list.extend([obj] * group.nranks)
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return env.get_world_size()
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return env.get_rank()
+
+
+def is_initialized() -> bool:
+    return env.is_initialized()
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None

@@ -1,0 +1,127 @@
+"""Distributed environment: global device mesh + rendezvous.
+
+Reference parity: init_parallel_env / env contract
+(python/paddle/distributed/parallel.py:978,1098-1131 — PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, MASTER_ADDR/PORT) and the
+CommContextManager store-based bring-up
+(paddle/phi/core/distributed/comm_context_manager.h:43).
+
+TPU-first: one *controller per host*, all devices visible through jax. The
+"world" is a `jax.sharding.Mesh` with named axes (SURVEY.md §5.8 north star);
+multi-host joins via `jax.distributed.initialize` (PJRT coordination service
+plays the TCPStore role). Collectives ride ICI within a slice and DCN across
+slices — XLA picks per the mesh topology from `mesh_utils`.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_lock = threading.Lock()
+_state = {
+    "initialized": False,
+    "mesh": None,          # the global Mesh
+    "axis_degrees": {},    # axis name -> size
+}
+
+# canonical axis order mirrors the reference topology order
+# [pipe, data, sharding, sep, model] (fleet/base/topology.py:66)
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+
+
+def _detect_devices():
+    devs = jax.devices()
+    if len(devs) == 1 and jax.default_backend() != "cpu":
+        # single accelerator; allow virtual CPU expansion for tests
+        return devs
+    return devs
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env parity (parallel.py:978).
+
+    Multi-host: reads MASTER_ADDR/MASTER_PORT (or PADDLE_MASTER) +
+    PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM and joins the jax coordination
+    service. Single-host: no-op beyond building the default 1-axis mesh.
+    """
+    with _lock:
+        if _state["initialized"]:
+            return
+        n_hosts = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if n_hosts > 1 and not jax.distributed.is_initialized():
+            addr = os.environ.get("MASTER_ADDR")
+            port = os.environ.get("MASTER_PORT")
+            coord = (
+                f"{addr}:{port}" if addr and port
+                else os.environ.get("PADDLE_MASTER")
+            )
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=n_hosts,
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            )
+        devs = _detect_devices()
+        _state["mesh"] = Mesh(np.asarray(devs), ("dp",))
+        _state["axis_degrees"] = {"dp": len(devs)}
+        _state["initialized"] = True
+
+
+def is_initialized() -> bool:
+    return _state["initialized"]
+
+
+def set_mesh(mesh: Mesh):
+    """Install a custom global mesh (built by fleet.init or user code)."""
+    with _lock:
+        _state["mesh"] = mesh
+        _state["axis_degrees"] = dict(zip(mesh.axis_names,
+                                          (int(s) for s in mesh.devices.shape)))
+        _state["initialized"] = True
+
+
+def get_mesh() -> Mesh:
+    if _state["mesh"] is None:
+        init_parallel_env()
+    return _state["mesh"]
+
+
+def build_mesh(degrees: dict, devices=None) -> Mesh:
+    """Build a mesh from axis-name → degree, ordered per AXIS_ORDER with
+    unknown axes appended; degree-1 axes are kept so sharding specs can
+    reference them uniformly."""
+    names = [a for a in AXIS_ORDER if a in degrees]
+    names += [a for a in degrees if a not in names]
+    sizes = [int(degrees[a]) for a in names]
+    total = int(np.prod(sizes)) if sizes else 1
+    if devices is None:
+        devices = jax.devices()
+        if len(devices) < total:
+            cpus = jax.devices("cpu")
+            if len(cpus) >= total:
+                devices = cpus
+    if len(devices) < total:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def get_world_size() -> int:
+    return int(np.prod(get_mesh().devices.shape))
+
+
+def get_rank() -> int:
+    """Process index × local size + ... — in single-controller mode the
+    controller acts as rank 0 (the reference's per-process ranks become mesh
+    coordinates; see collective.Group for per-axis ranks)."""
+    return jax.process_index() * max(1, get_world_size() // jax.process_count())
+
+
+def device_count() -> int:
+    return len(jax.devices())

@@ -4,3 +4,40 @@ Strategy layers over the collective core: topology/HCG, distributed_model
 wrappers, hybrid optimizer, sharding stages, recompute.
 """
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .fleet import (  # noqa: F401
+    Fleet,
+    DistributedStrategy,
+    fleet,
+    init,
+    distributed_model,
+    distributed_optimizer,
+)
+from . import layers  # noqa: F401
+from . import utils  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    LayerDesc,
+    SharedLayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    TensorParallel,
+    SegmentParallel,
+    ShardingParallel,
+)
+from .meta_optimizers import (  # noqa: F401
+    HybridParallelOptimizer,
+    DygraphShardingOptimizer,
+)
+
+
+def get_rng_state_tracker():
+    from .layers.mpu.random import get_rng_state_tracker as _g
+
+    return _g()

@@ -1,0 +1,165 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference parity: fleet/layers/mpu/mp_layers.py — VocabParallelEmbedding
+(:47), ColumnParallelLinear (:334), RowParallelLinear (:541),
+ParallelCrossEntropy (:742), and the comm PyLayers _c_identity/_c_split/
+_c_concat/_mp_allreduce (mp_ops.py:91-341).
+
+TPU-first: weights carry a NamedSharding over the "mp" mesh axis; the
+forward is a plain matmul/gather with sharding constraints on activations.
+XLA GSPMD then inserts the identity/allreduce/allgather collectives the
+reference writes by hand — including the backward-pass transposes. The comm
+PyLayers therefore reduce to sharding-constraint helpers (`_constrain`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..... import nn
+from .....framework.tensor import Tensor
+from .....framework.autograd import apply_op
+from .....nn import functional as F
+from .....nn.initializer import XavierUniform, Constant
+from .... import env
+from ...topology import get_hybrid_communicate_group
+
+
+def _mp_axis_and_mesh(mp_group=None):
+    if mp_group is not None:
+        return mp_group.axes[0], mp_group.mesh
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return "mp", hcg.mesh
+    mesh = env.get_mesh()
+    ax = "mp" if "mp" in mesh.axis_names else mesh.axis_names[-1]
+    return ax, mesh
+
+
+def _constrain(t: Tensor, mesh, spec: P) -> Tensor:
+    """Sharding constraint: with_sharding_constraint under trace, device_put
+    in eager (the TPU equivalent of the reference's _c_identity markers)."""
+    sharding = NamedSharding(mesh, spec)
+
+    def f(x):
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, sharding)
+        return jax.device_put(x, sharding)
+
+    return apply_op(f, [t], name="sharding_constraint")
+
+
+def _shard_param(param, mesh, spec: P):
+    param._data = jax.device_put(param._data, NamedSharding(mesh, spec))
+    param.split_axis = next((i for i, a in enumerate(spec) if a is not None),
+                            None)
+    return param
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Reference mp_layers.py:47 — embedding table sharded on the vocab dim."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._axis, self._mesh = _mp_axis_and_mesh(mp_group)
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierUniform(),
+        )
+        if num_embeddings % self._mesh.shape[self._axis] == 0:
+            _shard_param(self.weight, self._mesh, P(self._axis, None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, self._mesh, P())
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Reference mp_layers.py:334 — weight [in, out] sharded on out."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis, self._mesh = _mp_axis_and_mesh(mp_group)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = self._mesh.shape[self._axis] > 1
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform(),
+        )
+        if out_features % self._mesh.shape[self._axis] == 0:
+            _shard_param(self.weight, self._mesh, P(None, self._axis))
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True,
+                default_initializer=Constant(0.0),
+            )
+            if out_features % self._mesh.shape[self._axis] == 0:
+                _shard_param(self.bias, self._mesh, P(self._axis))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(out, self._mesh, P())
+        spec = P(*([None] * (out.ndim - 1) + [self._axis]))
+        return _constrain(out, self._mesh, spec)
+
+
+class RowParallelLinear(nn.Layer):
+    """Reference mp_layers.py:541 — weight [in, out] sharded on in; partial
+    results all-reduced (GSPMD emits the psum from the contraction)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis, self._mesh = _mp_axis_and_mesh(mp_group)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform(),
+        )
+        if in_features % self._mesh.shape[self._axis] == 0:
+            _shard_param(self.weight, self._mesh, P(self._axis, None))
+        if has_bias:
+            # bias is applied after the reduce — replicated
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True,
+                default_initializer=Constant(0.0),
+            )
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            spec = P(*([None] * (x.ndim - 1) + [self._axis]))
+            x = _constrain(x, self._mesh, spec)
+        out = F.linear(x, self.weight, None)
+        out = _constrain(out, self._mesh, P())
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Reference mp_layers.py:742 — CE over vocab-sharded logits. GSPMD
+    computes the sharded logsumexp + gather with its own collectives."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self._ignore_index)

@@ -1,0 +1,76 @@
+"""Tensor-parallel RNG state tracking.
+
+Reference parity: RNGStatesTracker (fleet/layers/mpu/random.py:34) and
+get_rng_state_tracker (:99) — separate RNG streams so that dropout inside TP
+regions is either identical across mp ranks (replicated activations) or
+distinct (sharded activations), and reproducible under recompute.
+
+TPU-first: streams are independent Generators (counter-based fold_in keys,
+framework/random.py); under the jitted train step the offsets are traced
+state, so recompute replays the same keys without explicit save/restore.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .....framework.random import Generator
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {name: gen.get_state() for name, gen in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for name, st in states.items():
+            if name in self.states_:
+                self.states_[name].set_state(st)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        from .....framework import random as _random
+
+        gen = self.states_[name]
+        prev = _random._default_generator
+        _random._default_generator = gen
+        try:
+            yield
+        finally:
+            _random._default_generator = prev
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """Reference random.py — derive distinct seeds per mp rank. In the
+    single-controller world one tracker serves all ranks; sharded dropout
+    masks differ per device because the key folds in traced positions."""
+    import random as pyrandom
+
+    seed = seed if seed is not None else pyrandom.randint(0, 2 ** 31 - 1)
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, seed + 1024)

@@ -1,0 +1,53 @@
+"""Hybrid-parallel optimizer wrapper.
+
+Reference parity: HybridParallelOptimizer
+(fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:255)
+with HybridParallelClipGrad (:41) — global-norm clip across all parallel
+groups — and the sharding-stage-1 hookup.
+
+TPU-first: grads under the single controller are already global values
+(GSPMD reduced them), so the cross-group clip-norm allreduces of the
+reference collapse into a plain global-norm computation; sharding stage 1
+activates by sharding the inner optimizer's accumulators over the
+"sharding" axis (DygraphShardingOptimizer).
+"""
+from __future__ import annotations
+
+from ....optimizer.optimizer import Optimizer
+from .dygraph_sharding_optimizer import DygraphShardingOptimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg, strategy=None):
+        self._hcg = hcg
+        self._strategy = strategy
+        sharding_degree = (hcg.get_sharding_parallel_world_size()
+                           if hcg is not None else 1)
+        if sharding_degree > 1 and not isinstance(
+            optimizer, DygraphShardingOptimizer
+        ):
+            optimizer = DygraphShardingOptimizer(optimizer, hcg)
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self._inner_opt.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)

@@ -1,0 +1,95 @@
+"""Model wrappers per parallelism axis.
+
+Reference parity: fleet/meta_parallel/ — TensorParallel
+(tensor_parallel.py), SegmentParallel (segment_parallel.py:26),
+ShardingParallel, PipelineParallel (pipeline_parallel.py:231).
+
+TPU-first: wrappers are thin — parameter placement/sharding happens in the
+layers (mpu) or the sharded optimizer; inputs get sharding constraints for
+the relevant axis. The reference's param-broadcast/input-broadcast steps are
+unnecessary (single controller: there is one copy of truth).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....framework.tensor import Tensor
+from ....nn.layer.layers import Layer
+from ...parallel import _shard_batch
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class TensorParallel(MetaParallelBase):
+    """Reference tensor_parallel.py — mpu layers already shard their own
+    weights; batch additionally shards on dp if present."""
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._hcg.mesh
+        inputs = tuple(
+            _shard_batch(x, mesh, "dp") if isinstance(x, Tensor) else x
+            for x in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+
+class SegmentParallel(MetaParallelBase):
+    """Reference segment_parallel.py:26 — sequence dim sharded over sep."""
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._hcg.mesh
+
+        def shard_seq(t):
+            if not isinstance(t, Tensor) or t.ndim < 2:
+                return t
+            if t.shape[1] % mesh.shape["sep"] != 0:
+                return t
+            spec = P(None, "sep", *([None] * (t.ndim - 2)))
+            from ....framework.autograd import apply_op
+
+            return apply_op(
+                lambda x: jax.device_put(x, NamedSharding(mesh, spec)), [t],
+                name="shard_seq")
+
+        inputs = tuple(shard_seq(x) for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+
+class ShardingParallel(MetaParallelBase):
+    """Reference sharding_parallel.py — param sharding is done by the
+    GroupSharded optimizer/stage wrappers; batch shards on sharding axis
+    (which doubles as a data axis in ZeRO)."""
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._hcg.mesh
+        inputs = tuple(
+            _shard_batch(x, mesh, "sharding") if isinstance(x, Tensor) else x
+            for x in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: E402,F401
+from .pipeline_parallel import PipelineParallel  # noqa: E402,F401

@@ -1,0 +1,130 @@
+"""Pipeline model description.
+
+Reference parity: fleet/meta_parallel/parallel_layers/pp_layers.py —
+LayerDesc (:56), SharedLayerDesc (:76), PipelineLayer (:92) with
+segmentation by layer count ("uniform") or parameter-count cost.
+
+TPU-first: the single controller holds every stage; segmentation assigns
+layers to pp-stage indices, and each stage's parameters are placed on its
+stage's device slice of the mesh (NamedSharding over the non-pp axes of the
+stage submesh). Activations cross stages as device transfers that XLA
+schedules inside the compiled step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList
+
+
+class LayerDesc:
+    """Reference pp_layers.py:56 — deferred layer construction."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Reference pp_layers.py:76 — layer shared between stages (e.g. tied
+    embeddings). Single controller: naturally one instance, no grad
+    all-reduce between copies needed."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Reference pp_layers.py:92."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self.descs = list(layers)
+        self._shared = {}
+
+        built = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    inst = self._shared[d.layer_name]
+                else:
+                    inst = d.build_layer()
+                    self._shared[d.layer_name] = inst
+                built.append((inst, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+        self.run_function = built
+        self._layers_list = LayerList(
+            [m for m, _ in built if isinstance(m, Layer)])
+        self.segment_parts = self._segment(seg_method)
+
+    def _segment(self, method):
+        """Stage boundaries (reference SegmentLayers, pp_layers.py)."""
+        n = len(self.run_function)
+        stages = self._num_stages
+        if method == "uniform" or not method.startswith("layer:"):
+            # proportional split by layer count
+            bounds = [int(round(i * n / stages)) for i in range(stages + 1)]
+        else:
+            # "layer:ClassName" — split evenly over layers of that class
+            cls_name = method.split(":", 1)[1]
+            idxs = [i for i, (m, _) in enumerate(self.run_function)
+                    if type(m).__name__ == cls_name]
+            per = max(1, len(idxs) // stages)
+            bounds = [0]
+            for s in range(1, stages):
+                bounds.append(idxs[min(s * per, len(idxs) - 1)])
+            bounds.append(n)
+        return bounds
+
+    def stage_of_layer(self, i) -> int:
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= i < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def forward(self, *args):
+        x = args if len(args) > 1 else args[0]
+        for m, fwd in self.run_function:
+            if fwd is not None:
+                x = fwd(m, *(x if isinstance(x, tuple) else (x,)))
+            elif isinstance(x, tuple):
+                x = m(*x)
+            else:
+                x = m(x)
+        return x
+
+    def allreduce_shared_weight_gradients(self):
+        # single controller: one shared instance, nothing to reduce
+        pass

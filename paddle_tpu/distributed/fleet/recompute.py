@@ -20,16 +20,77 @@ from ...framework.tensor import Tensor
 from ...framework.autograd import apply_op
 
 
-def _collect_layer(function):
-    """Find the Layer whose params the segment uses (bound method or Layer)."""
-    from ...nn.layer.layers import Layer
+def _collect_params(function, args):
+    """Find the Parameters the segment can reach: Layers/bound methods,
+    functools.partial targets, closure cells, and Layer args. Grads must
+    flow to these, so they are lifted to explicit tape inputs."""
+    import functools
 
-    if isinstance(function, Layer):
-        return function
-    owner = getattr(function, "__self__", None)
-    if isinstance(owner, Layer):
-        return owner
-    return None
+    from ...nn.layer.layers import Layer, Parameter
+
+    layers, params, seen = [], [], set()
+
+    def visit(obj):
+        if id(obj) in seen:
+            return
+        seen.add(id(obj))
+        if isinstance(obj, Layer):
+            layers.append(obj)
+        elif isinstance(obj, Parameter):
+            params.append(obj)
+        elif isinstance(obj, functools.partial):
+            visit(obj.func)
+            for a in obj.args:
+                visit(a)
+            for v in obj.keywords.values():
+                visit(v)
+        elif callable(obj):
+            owner = getattr(obj, "__self__", None)
+            if owner is not None:
+                visit(owner)
+            closure = getattr(obj, "__closure__", None)
+            if closure:
+                for cell in closure:
+                    try:
+                        visit(cell.cell_contents)
+                    except ValueError:
+                        pass
+            # globals referenced by name from the function body (co_names
+            # covers `lambda a: lin(a)` with module-level `lin`)
+            code = getattr(obj, "__code__", None)
+            glb = getattr(obj, "__globals__", None)
+            if code is not None and glb is not None:
+                for name in code.co_names:
+                    if name in glb:
+                        target = glb[name]
+                        from ...nn.layer.layers import Layer as _L
+
+                        if isinstance(target, _L) or isinstance(
+                            target, functools.partial
+                        ) or (callable(target)
+                              and getattr(target, "__self__", None)):
+                            visit(target)
+
+    visit(function)
+    for a in args:
+        if isinstance(a, Layer):
+            visit(a)
+
+    out, pseen = [], set()
+    for lyr in layers:
+        for p in lyr.parameters():
+            if id(p) not in pseen:
+                pseen.add(id(p))
+                out.append(p)
+    for p in params:
+        if id(p) not in pseen:
+            pseen.add(id(p))
+            out.append(p)
+    buffers = []
+    for lyr in layers:
+        for b in lyr.buffers():
+            buffers.append(b)
+    return out, buffers
 
 
 def recompute(function, *args, **kwargs):
@@ -40,9 +101,7 @@ def recompute(function, *args, **kwargs):
     if kwargs:
         raise TypeError(f"unsupported recompute kwargs: {sorted(kwargs)}")
 
-    layer = _collect_layer(function)
-    params = [p for p in layer.parameters()] if layer is not None else []
-    buffers = list(layer.buffers()) if layer is not None else []
+    params, buffers = _collect_params(function, args)
     tensor_args = [a for a in args if isinstance(a, Tensor)]
     n_p, n_b, n_t = len(params), len(buffers), len(tensor_args)
 
@@ -80,12 +139,7 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     ctx: {"segments": N} — split `functions` into N recomputed chunks.
     """
     segments = ctx.get("segments", 1) if isinstance(ctx, dict) else int(ctx)
-    from ...nn.layer.container import Sequential
-
-    if isinstance(functions, Sequential):
-        layers = list(functions)
-    else:
-        layers = list(functions)
+    layers = list(functions)
     if segments <= 0:
         segments = 1
     per = max(1, len(layers) // segments)

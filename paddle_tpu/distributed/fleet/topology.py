@@ -1,0 +1,226 @@
+"""Hybrid-parallel topology.
+
+Reference parity: CommunicateTopology (fleet/base/topology.py:66) and
+HybridCommunicateGroup (:178, group creation :201-226) — an N-D process grid
+in order [pipe, data, sharding, sep, model], with a communication group per
+axis plus fused groups (dp+sep "check" groups).
+
+TPU-first: the grid IS a jax.sharding.Mesh with named axes; a "comm group"
+is a Group bound to one or more mesh axes (collective.Group). Instead of
+creating NCCL communicators per axis, replica groups fall out of the mesh
+axis structure when XLA lowers the collectives.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from .. import env
+from ..collective import Group
+
+_AXIS_NAME = {"pipe": "pp", "data": "dp", "sharding": "sharding",
+              "sep": "sep", "model": "mp"}
+_NAME_AXIS = {v: k for k, v in _AXIS_NAME.items()}
+
+
+class CommunicateTopology:
+    """Reference topology.py:66 — coordinate math over the hybrid grid."""
+
+    def __init__(self, hybrid_group_names=("pipe", "data", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = itertools.product(*(range(d) for d in dims))
+        self._world_size = int(np.prod(dims))
+        self._coord2rank = {c: i for i, c in enumerate(
+            itertools.product(*(range(d) for d in dims)))}
+        self._rank2coord = {v: k for k, v in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """Groups of ranks that communicate along `axis_name` (vary that
+        coordinate, fix the others)."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        comm_list = []
+        for other in itertools.product(*(range(d) for d in other_dims)):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                ranks.append(self._coord2rank[tuple(coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for name, v in kwargs.items():
+            coord[self._parallel_names.index(name)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:178. Exposes per-axis degrees, this-rank
+    coordinates (single-controller: rank 0's coordinates), and per-axis
+    Groups bound to the global mesh."""
+
+    def __init__(self, topology: CommunicateTopology = None, mesh=None):
+        if mesh is None:
+            if topology is None:
+                raise ValueError("need a topology or a mesh")
+            degrees = {
+                _AXIS_NAME[n]: topology.get_dim(n)
+                for n in topology.get_hybrid_group_names()
+            }
+            mesh = env.build_mesh(degrees)
+        self._mesh = mesh
+        env.set_mesh(mesh)
+        if topology is None:
+            dims = [mesh.shape.get(_AXIS_NAME[n], 1)
+                    for n in ("pipe", "data", "sharding", "sep", "model")]
+            topology = CommunicateTopology(dims=dims)
+        self._topo = topology
+
+        def deg(ax):
+            return int(self._mesh.shape.get(ax, 1))
+
+        self._dp_degree = deg("dp")
+        self._mp_degree = deg("mp")
+        self._pp_degree = deg("pp")
+        self._sharding_degree = deg("sharding")
+        self._sep_degree = deg("sep")
+
+        self.global_rank = env.get_rank()
+
+        # per-axis groups (reference _set_comm_group per axis, :201-226)
+        self._dp_group = self._make_group(("dp",))
+        self._mp_group = self._make_group(("mp",))
+        self._pp_group = self._make_group(("pp",))
+        self._sharding_group = self._make_group(("sharding",))
+        self._sep_group = self._make_group(("sep",)) if self._sep_degree > 1 \
+            else None
+        # fused dp+sep group for grad sync (hybrid_parallel_util.py:254-269)
+        if self._sep_degree > 1:
+            self._dp_sep_group = self._make_group(("dp", "sep"))
+        else:
+            self._dp_sep_group = self._dp_group
+
+    def _make_group(self, axes):
+        axes = tuple(a for a in axes if a in self._mesh.axis_names)
+        if not axes:
+            axes = (self._mesh.axis_names[0],)
+        return Group(self._mesh, axes)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_hybrid_group_names(self):
+        return self._topo.get_hybrid_group_names()
+
+    # -- degrees / ranks (single-controller: coordinate of rank 0) ---------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # -- groups ------------------------------------------------------------
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_dp_sep_parallel_group(self):
+        return self._dp_sep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._make_group(("pp", "mp"))
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline neighbors (used by P2P; traced ppermute handles the actual
+    # transfer, these are for schedule bookkeeping)
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+
+_hcg = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group():
+    return _hcg

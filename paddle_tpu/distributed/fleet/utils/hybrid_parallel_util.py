@@ -1,0 +1,48 @@
+"""Hybrid-parallel gradient/param sync helpers.
+
+Reference parity: fleet/utils/hybrid_parallel_util.py —
+fused_allreduce_gradients (grads over dp or dp×sep group :254-269),
+broadcast_*_parameters (:287).
+
+TPU-first: under the single controller grads come out of the compiled step
+already reduced (GSPMD) and there is exactly one copy of each param, so
+these are correctness no-ops kept for 1:1 porting of reference training
+scripts; fused_allreduce_gradients still performs a real allreduce when
+handed explicitly sharded per-rank grads.
+"""
+from __future__ import annotations
+
+from ...collective import all_reduce, ReduceOp
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None, group=None):
+    group = group or (hcg.get_data_parallel_group() if hcg is not None
+                      else None)
+    for p in parameter_list:
+        g = getattr(p, "grad", None)
+        if g is None:
+            continue
+        sh = getattr(g._data, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if spec and any(s is not None for s in spec):
+            all_reduce(g, op=ReduceOp.SUM, group=group)
+
+
+def broadcast_dp_parameters(model, hcg):
+    return None
+
+
+def broadcast_mp_parameters(model, hcg):
+    return None
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return None
+
+
+def broadcast_sep_parameters(model, hcg):
+    return None
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    return inputs if not kwargs else (inputs, kwargs)

@@ -1,0 +1,156 @@
+"""Megatron sequence parallelism.
+
+Reference parity: fleet/utils/sequence_parallel_utils.py — ScatterOp /
+GatherOp / AllGatherOp / ReduceScatterOp PyLayers (:85-137),
+ColumnSequenceParallelLinear (:427) with comm/compute overlap
+(SPInnerOverlapLinear :255), RowSequenceParallelLinear,
+register_sequence_parallel_allreduce_hooks (:192).
+
+TPU-first: SP is a layout discipline — activations outside TP blocks are
+sharded on the sequence dim over the mp axis; the column linear's input is
+all-gathered and the row linear's output reduce-scattered. With GSPMD these
+are sharding constraints and XLA inserts (and overlaps) the collectives;
+the explicit PyLayers map to constraint helpers with identical names so
+reference code ports 1:1.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .... import nn
+from ....framework.tensor import Tensor
+from ....framework.autograd import apply_op
+from ....nn import functional as F
+from ..layers.mpu.mp_layers import _mp_axis_and_mesh, _constrain, _shard_param
+from ....nn.initializer import XavierUniform, Constant
+
+
+def _seq_spec(ndim, axis):
+    # activations are [s, b, h] in the reference SP utils; shard dim 0
+    return P(axis, *([None] * (ndim - 1)))
+
+
+class ScatterOp:
+    """Reference :85 — split activation along seq dim onto mp ranks."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        ax, mesh = _mp_axis_and_mesh()
+        spec = P(*([None] * axis + [ax]))
+        return _constrain(x, mesh, spec)
+
+
+class GatherOp:
+    """Reference :~110 — gather seq-sharded activation to full."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        ax, mesh = _mp_axis_and_mesh()
+        return _constrain(x, mesh, P())
+
+
+class AllGatherOp:
+    """Reference :~120 — allgather along seq in fwd, reduce-scatter in bwd
+    (GSPMD derives the transpose automatically)."""
+
+    @staticmethod
+    def apply(x):
+        ax, mesh = _mp_axis_and_mesh()
+        return _constrain(x, mesh, P())
+
+
+class ReduceScatterOp:
+    """Reference :~130 — reduce-scatter along seq in fwd, allgather in bwd."""
+
+    @staticmethod
+    def apply(x):
+        ax, mesh = _mp_axis_and_mesh()
+        return _constrain(x, mesh, _seq_spec(x.ndim, ax))
+
+
+def scatter(x, axis=0):
+    return ScatterOp.apply(x, axis)
+
+
+def all_gather(x):
+    return AllGatherOp.apply(x)
+
+
+def reduce_scatter(x):
+    return ReduceScatterOp.apply(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse=False):
+    """Reference :192 — SP params (LN weights etc.) need grads allreduced
+    over mp. Under GSPMD replicated params already get reduced grads; the
+    hook registration is a no-op kept for parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear(nn.Layer):
+    """Reference :427 — input seq-sharded, all-gathered before the column
+    matmul; output stays tp-sharded on the feature dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self._axis, self._mesh = _mp_axis_and_mesh(mp_group)
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform(),
+        )
+        if out_features % self._mesh.shape[self._axis] == 0:
+            _shard_param(self.weight, self._mesh, P(None, self._axis))
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True,
+                default_initializer=Constant(0.0))
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        # x arrives seq-sharded [s/mp, b, h] (global view: constraint on s)
+        x = _constrain(x, self._mesh, P())  # all-gather seq
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(out, self._mesh, P())
+        spec = P(*([None] * (out.ndim - 1) + [self._axis]))
+        return _constrain(out, self._mesh, spec)
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    """Reference RowSequenceParallelLinear — input tp-sharded on features,
+    output reduce-scattered along seq."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._axis, self._mesh = _mp_axis_and_mesh(mp_group)
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform(),
+        )
+        if in_features % self._mesh.shape[self._axis] == 0:
+            _shard_param(self.weight, self._mesh, P(self._axis, None))
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True,
+                default_initializer=Constant(0.0))
+
+    def forward(self, x):
+        spec = P(*([None] * (x.ndim - 1) + [self._axis]))
+        x = _constrain(x, self._mesh, spec)
+        out = F.linear(x, self.weight, None)
+        # reduce + scatter along seq dim (dim 0)
+        out = _constrain(out, self._mesh, _seq_spec(out.ndim, self._axis))
+        if self.bias is not None:
+            out = out + self.bias
+        return out

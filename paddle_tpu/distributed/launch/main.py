@@ -1,0 +1,80 @@
+"""Distributed launcher CLI.
+
+Reference parity: python -m paddle.distributed.launch
+(python/paddle/distributed/launch/main.py:23) — spawns one process per rank,
+sets PADDLE_TRAINER_ID/ENDPOINTS, runs a master rendezvous, watches and
+restarts (controllers/master.py:73,186, watcher.py:24).
+
+TPU-first: one controller process per HOST drives every local chip, so the
+launcher's unit is hosts, not devices. Single host → exec the script inline.
+Multi host (--nnodes > 1) → set the env contract
+(MASTER_ADDR/PORT, PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM) that
+init_parallel_env feeds into jax.distributed.initialize; each host runs this
+launcher with its own --rank. Process supervision/restart: the child is
+re-execed up to --max_restart times on nonzero exit (reference watcher).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a paddle_tpu training script",
+    )
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of hosts (or range lo:hi for elastic)")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""),
+                   help="host:port of rank-0 coordination service")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--devices", type=str, default=None,
+                   help="accepted for parity; TPU visibility is set by the "
+                        "runtime")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    env = dict(os.environ)
+    env["PADDLE_TRAINERS_NUM"] = str(nnodes)
+    env["PADDLE_TRAINER_ID"] = str(args.rank)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        host, _, port = args.master.partition(":")
+        env.setdefault("MASTER_ADDR", host)
+        env.setdefault("MASTER_PORT", port or "8765")
+
+    if nnodes <= 1 and args.max_restart == 0:
+        os.environ.update(env)
+        sys.argv = [args.script] + list(args.script_args)
+        runpy.run_path(args.script, run_name="__main__")
+        return 0
+
+    restarts = 0
+    while True:
+        proc = subprocess.Popen([sys.executable, args.script]
+                                + list(args.script_args), env=env)
+        code = proc.wait()
+        if code == 0:
+            return 0
+        restarts += 1
+        if restarts > args.max_restart:
+            return code
+        print(f"[launch] rank {args.rank} exited {code}; restart "
+              f"{restarts}/{args.max_restart}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

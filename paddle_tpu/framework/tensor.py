@@ -155,11 +155,27 @@ class Tensor:
 
     clear_gradient = clear_grad
 
+    def _pin_to_node(self):
+        """Keep this output tensor alive from its grad node so hooks /
+        retain_grads fire even if user code drops the reference (the node's
+        weakref would otherwise die with it)."""
+        if self._grad_node is not None:
+            node = self._grad_node
+            me = self
+
+            class _Strong:
+                def __call__(self):
+                    return me
+
+            node.outputs[self._out_index] = _Strong()
+
     def retain_grads(self):
         self._retain_grads = True
+        self._pin_to_node()
 
     def register_hook(self, hook):
         self._backward_hooks.append(hook)
+        self._pin_to_node()
 
         class _Handle:
             def remove(handle_self):
@@ -247,9 +263,37 @@ class Tensor:
         return self
 
     def _inplace_from(self, result: "Tensor"):
-        """Adopt the data+autograd identity of `result` (functional in-place)."""
+        """Adopt the data+autograd identity of `result` (functional in-place).
+
+        If `result`'s grad node recorded `self` as an input, that input slot
+        must keep pointing at the PRE-op identity (old grad_node), not the
+        rebound tensor — otherwise the node cycles onto itself and the
+        upstream graph is dropped (reference: inplace version counting,
+        paddle/fluid/eager/tensor_wrapper.h).
+        """
+        import weakref
+
+        node = result._grad_node
+        if node is not None:
+            if self._grad_node is None and not self.stop_gradient:
+                raise RuntimeError(
+                    "a leaf Tensor that requires grad is being used in an "
+                    "in-place operation; wrap it in paddle.no_grad() or "
+                    "detach() first"
+                )
+            for i, t in enumerate(node.inputs):
+                if t is self:
+                    alias = Tensor._wrap(
+                        self._data, stop_gradient=self.stop_gradient,
+                        grad_node=self._grad_node, out_index=self._out_index,
+                    )
+                    node.inputs[i] = alias
+            # the op's output is now this tensor: repoint the weakref so
+            # hooks/retain_grads fire on it
+            if node.outputs[result._out_index] is not None:
+                node.outputs[result._out_index] = weakref.ref(self)
         self._data = result._data
-        self._grad_node = result._grad_node
+        self._grad_node = node
         self._out_index = result._out_index
         self.stop_gradient = result.stop_gradient
         return self

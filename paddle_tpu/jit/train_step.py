@@ -49,6 +49,16 @@ def _tree_wrap(x):
     return x
 
 
+def _unwrap_optimizer(opt):
+    """Follow wrapper chains (HybridParallelOptimizer, sharding wrappers) to
+    the Optimizer that owns the state dicts."""
+    seen = set()
+    while hasattr(opt, "_inner_opt") and id(opt) not in seen:
+        seen.add(id(opt))
+        opt = opt._inner_opt
+    return opt
+
+
 class _OptimizerState:
     """Snapshot/inject the mutable numeric state of an Optimizer."""
 
@@ -75,9 +85,6 @@ class _OptimizerState:
         opt._master_weights.update(state["master_weights"])
         opt._step_count = state["step"]
 
-    def restore_host(self, state):
-        """Re-inject concrete state after a jitted step (device arrays)."""
-        self.inject(state)
 
 
 class TrainStep:
@@ -97,8 +104,9 @@ class TrainStep:
     def __init__(self, model, loss_fn, optimizer, donate=True):
         self.model = model
         self.loss_fn = loss_fn
-        self.optimizer = optimizer
-        self._opt_state = _OptimizerState(optimizer)
+        self.optimizer = optimizer             # outer (may be a wrapper)
+        self._opt = _unwrap_optimizer(optimizer)  # state owner
+        self._opt_state = _OptimizerState(self._opt)
         self._params = None   # resolved lazily: optimizer may create accums on 1st step
         self._buffers = None
         self._jitted = None
@@ -129,7 +137,8 @@ class TrainStep:
     # -- the traced step ------------------------------------------------
     def _build(self, example_batch):
         self._resolve_slots()
-        opt = self.optimizer
+        opt = self.optimizer        # outer wrapper drives the step
+        inner = self._opt           # state owner gets the lr patch
 
         def step_fn(state, lr, batch):
             self._inject_state(state)
@@ -137,12 +146,12 @@ class TrainStep:
             loss = self.loss_fn(self.model, *batch_t)
             loss.backward()
             # freeze lr at the traced scalar for this step
-            prev_get_lr = opt.get_lr
-            opt.get_lr = lambda: lr
+            prev_get_lr = inner.get_lr
+            inner.get_lr = lambda: lr
             try:
                 opt.step()
             finally:
-                opt.get_lr = prev_get_lr
+                inner.get_lr = prev_get_lr
             opt.clear_grad()
             new_state = self._extract_state()
             return loss._data, new_state
@@ -158,11 +167,17 @@ class TrainStep:
             self._warmup_accumulators()
             self._build(batch_data)
         state = self._extract_state()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss_data, new_state = self._jitted(state, lr, batch_data)
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        try:
+            loss_data, new_state = self._jitted(state, lr, batch_data)
+        except Exception:
+            # a tracing error leaves tracers bound in the live objects;
+            # restore the concrete state so the model stays usable
+            self._inject_state(state)
+            raise
         self._inject_state(new_state)
         # advance host-side schedulers
-        sched = getattr(self.optimizer, "_learning_rate", None)
+        sched = getattr(self._opt, "_learning_rate", None)
         if hasattr(sched, "step"):
             sched.step()
         return Tensor._wrap(loss_data)
@@ -172,7 +187,7 @@ class TrainStep:
         anything: run each param's update op once with writes patched out, so
         `_get_accumulator` creation fires but no state changes."""
         self._resolve_slots()
-        opt = self.optimizer
+        opt = self._opt
         for p in self._params:
             if opt._use_master(p):
                 opt._master_weight(p)
@@ -188,3 +203,10 @@ class TrainStep:
         finally:
             opt._set_accumulator = saved_set
             opt._write_param = saved_write
+        # sharded-optimizer wrappers place their state layouts now so the
+        # first compile already sees them (ZeRO-1 as sharding annotations)
+        outer = self.optimizer
+        while outer is not opt:
+            if hasattr(outer, "reshard_state"):
+                outer.reshard_state()
+            outer = getattr(outer, "_inner_opt", opt)
