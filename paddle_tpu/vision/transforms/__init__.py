@@ -1,0 +1,209 @@
+"""Image transforms.
+
+Reference parity: python/paddle/vision/transforms/ (transforms.py +
+functional.py). Numpy/ndarray implementations (HWC uint8 in, as the
+reference's 'backend=cv2/pil' paths); ToTensor produces CHW float32.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+class ToTensor(BaseTransform):
+    """HWC [0,255] → CHW float32 [0,1] (reference functional.to_tensor)."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = _as_hwc(img).astype(np.float32)
+        if img.dtype == np.float32 and img.max() > 1.0:
+            img = img / 255.0
+        if self.data_format == "CHW":
+            img = img.transpose(2, 0, 1)
+        return Tensor(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if isinstance(img, Tensor):
+            arr = np.asarray(img._data)
+        else:
+            arr = np.asarray(img, np.float32)
+        n = self.mean.shape[0]
+        if self.data_format == "CHW":
+            shape = (n,) + (1,) * (arr.ndim - 1)
+        else:
+            shape = (1,) * (arr.ndim - 1) + (n,)
+        out = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        yi = (np.arange(h) + 0.5) * ih / h - 0.5
+        xi = (np.arange(w) + 0.5) * iw / w - 0.5
+        yi = np.clip(yi, 0, ih - 1)
+        xi = np.clip(xi, 0, iw - 1)
+        y0 = np.floor(yi).astype(int)
+        x0 = np.floor(xi).astype(int)
+        y1 = np.minimum(y0 + 1, ih - 1)
+        x1 = np.minimum(x0 + 1, iw - 1)
+        wy = (yi - y0)[:, None, None]
+        wx = (xi - x0)[None, :, None]
+        img = img.astype(np.float32)
+        top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+        bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+        return (top * (1 - wy) + bot * wy).astype(img.dtype)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        top = max(0, (ih - h) // 2)
+        left = max(0, (iw - w) // 2)
+        return img[top:top + h, left:left + w]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding:
+            p = self.padding
+            p = (p, p) if isinstance(p, int) else p
+            img = np.pad(img, ((p[0], p[0]), (p[1], p[1]), (0, 0)))
+        h, w = self.size
+        ih, iw = img.shape[:2]
+        top = random.randint(0, max(0, ih - h))
+        left = random.randint(0, max(0, iw - w))
+        return img[top:top + h, left:left + w]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _as_hwc(img)[:, ::-1]
+        return _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _as_hwc(img)[::-1]
+        return _as_hwc(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        ih, iw = img.shape[:2]
+        area = ih * iw
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= iw and 0 < h <= ih:
+                top = random.randint(0, ih - h)
+                left = random.randint(0, iw - w)
+                return self._resize._apply_image(img[top:top + h,
+                                                     left:left + w])
+        return self._resize._apply_image(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
