@@ -223,7 +223,13 @@ def all_gather_concat(tensor, group=None, axis=0):
 def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
                    group=None, sync_op=True, axis=0):
     """Reference communication/reduce_scatter.py: sum across ranks, then
-    scatter slices along dim `axis`; returns this rank's slice (sharded)."""
+    scatter slices along dim `axis`.
+
+    Global-view semantics (single controller): the result keeps the GLOBAL
+    shape, laid out sharded over the group axis along `axis` — device i
+    holds slice i. Code that wants the per-rank slice shape of the
+    reference API should index the result. The in-place form therefore
+    requires `tensor` to already have the global shape (ADVICE r1)."""
     group = group or _world_group()
     ax = _axis_arg(group.axes)
     src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
@@ -239,6 +245,11 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
         name="reduce_scatter",
     )
     if tensor_or_tensor_list is not None and isinstance(tensor, Tensor):
+        if tuple(tensor.shape) != tuple(out.shape):
+            raise ValueError(
+                f"reduce_scatter out tensor has shape {tuple(tensor.shape)} "
+                f"but the global-view result has shape {tuple(out.shape)}; "
+                "pass a global-shaped out tensor or use the return value")
         tensor._inplace_from(out)
         return tensor
     return out
@@ -316,6 +327,11 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     group = group or _world_group()
     ax = _axis_arg(group.axes)
+    for splits in (in_split_sizes, out_split_sizes):
+        if splits and len(set(splits)) > 1:
+            raise NotImplementedError(
+                "alltoall_single with unequal split sizes is not supported "
+                "on the XLA all_to_all path (equal splits only)")
     t = in_tensor if isinstance(in_tensor, Tensor) else Tensor(in_tensor)
 
     def traced(s):

@@ -32,7 +32,8 @@ class CommunicateTopology:
                  dims=(1, 1, 1, 1, 1)):
         self._parallel_names = list(hybrid_group_names)
         self._dims = list(dims)
-        self.coordinate = itertools.product(*(range(d) for d in dims))
+        self.coordinate = list(
+            itertools.product(*(range(d) for d in dims)))
         self._world_size = int(np.prod(dims))
         self._coord2rank = {c: i for i, c in enumerate(
             itertools.product(*(range(d) for d in dims)))}

@@ -5,10 +5,13 @@ fused_allreduce_gradients (grads over dp or dp×sep group :254-269),
 broadcast_*_parameters (:287).
 
 TPU-first: under the single controller grads come out of the compiled step
-already reduced (GSPMD) and there is exactly one copy of each param, so
-these are correctness no-ops kept for 1:1 porting of reference training
-scripts; fused_allreduce_gradients still performs a real allreduce when
-handed explicitly sharded per-rank grads.
+already globally reduced (GSPMD inserts the dp-axis psum), so
+fused_allreduce_gradients is a correctness no-op kept for 1:1 porting of
+reference training scripts. A *layout*-sharded grad (ZeRO-3/TP param) holds
+disjoint or dp-replicated slices, not partial sums — reducing it again would
+scale it by dp_degree or sum unrelated slices, corrupting gradients
+(ADVICE r1, medium). Only grads explicitly tagged partial
+(``tensor._is_partial_grad = True`` by a per-rank producer) are reduced.
 """
 from __future__ import annotations
 
@@ -22,10 +25,9 @@ def fused_allreduce_gradients(parameter_list, hcg=None, group=None):
         g = getattr(p, "grad", None)
         if g is None:
             continue
-        sh = getattr(g._data, "sharding", None)
-        spec = getattr(sh, "spec", None)
-        if spec and any(s is not None for s in spec):
+        if getattr(g, "_is_partial_grad", False):
             all_reduce(g, op=ReduceOp.SUM, group=group)
+            g._is_partial_grad = False
 
 
 def broadcast_dp_parameters(model, hcg):

@@ -11,3 +11,7 @@ from .gpt import (  # noqa: F401
     gpt_sharding_rules,
     match_sharding,
 )
+from .gpt_pipe import (  # noqa: F401
+    GPTForCausalLMPipe,
+    gpt_pipe_sharding_rules,
+)

@@ -1,0 +1,176 @@
+"""GPT pipeline-parallel model — stage-stacked transformer over the pp axis.
+
+Reference parity: the GPTForCausalLMPipe pattern in Paddle's Fleet examples
+(PipelineLayer of LayerDescs run by
+fleet/meta_parallel/pipeline_parallel.py:231's 1F1B schedule). TPU-first:
+the decoder blocks' parameters are STACKED on a leading
+[n_stages, (num_chunks,) layers_per_stage, ...] dim sharded over the pp
+mesh axis; the forward runs them through `pipeline_spmd`'s ppermute ring
+inside the compiled step (spmd_pipeline.py). Embedding and the final
+norm/head live outside the ring (classic first/last-stage asymmetry) and
+compose with TP/ZeRO-3 through the same sharding-rule mechanism as the
+plain GPT model.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..framework.autograd import no_grad, apply_op
+from ..nn.layer.layers import Parameter
+from ..ops import creation as C
+from .gpt import GPTConfig, GPTBlock, GPTPretrainingCriterion  # noqa: F401
+from ..distributed.fleet.meta_parallel.spmd_pipeline import (
+    pipeline_spmd, microbatch, unmicrobatch,
+)
+
+
+class GPTForCausalLMPipe(nn.Layer):
+    """GPT with pipelined decoder blocks.
+
+    Args:
+      config: GPTConfig; ``num_layers`` must divide by
+        ``num_stages * num_chunks``.
+      num_stages: pp degree (mesh axis size).
+      num_micro: micro-batches per step (the batch dim must divide by it).
+      num_chunks: virtual stages per device (interleave, default 1).
+      mesh/axis: the device mesh and its pipeline axis name; taken from the
+        ambient distributed env when omitted.
+    """
+
+    def __init__(self, config: GPTConfig, num_stages, num_micro,
+                 num_chunks=1, mesh=None, axis="pp"):
+        super().__init__()
+        self.config = config
+        self.num_stages = int(num_stages)
+        self.num_micro = int(num_micro)
+        self.num_chunks = int(num_chunks)
+        self._axis = axis
+        self._mesh = mesh
+        total = self.num_stages * self.num_chunks
+        if config.num_layers % total:
+            raise ValueError(
+                f"num_layers {config.num_layers} must divide by "
+                f"num_stages*num_chunks {total}")
+        self.layers_per_stage = config.num_layers // total
+
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+        # template block: gives the param structure + the forward body; its
+        # own (per-layer-shaped) params are NOT this model's parameters —
+        # the stacked tensors below are. Stored via object.__setattr__ so
+        # Layer.__setattr__ doesn't register it as a sublayer.
+        object.__setattr__(self, "_template", GPTBlock(config))
+        self._stacked_names = []
+        lead = ((self.num_stages, self.layers_per_stage)
+                if self.num_chunks == 1 else
+                (self.num_stages, self.num_chunks, self.layers_per_stage))
+        from ..framework.random import next_key
+
+        std = config.initializer_range
+        for pname, p in self._template.named_parameters():
+            shape = lead + tuple(p.shape)
+            if p.ndim >= 2:
+                data = std * jax.random.normal(next_key(), shape, jnp.float32)
+                if re.search(r"(out_proj|fc2)\.weight$", pname):
+                    data = data / (2.0 * config.num_layers) ** 0.5
+            else:
+                data = jnp.broadcast_to(p._data, shape)
+            flat = "blocks__" + pname.replace(".", "__")
+            self.add_parameter(flat, Parameter(jnp.asarray(data)))
+            self._stacked_names.append((flat, pname))
+
+    # -- the pipelined middle -------------------------------------------
+    def _mesh_axis(self):
+        mesh = self._mesh
+        if mesh is None:
+            from ..distributed import env as denv
+
+            mesh = denv.get_mesh()
+        if mesh is None or self._axis not in mesh.axis_names:
+            raise RuntimeError(
+                f"GPTForCausalLMPipe needs a mesh with a {self._axis!r} axis")
+        return mesh, self._axis
+
+    def _block_fn(self):
+        template = self._template
+        leaves = [p for _, p in template.named_parameters()]
+        training = self.training
+
+        def one_layer(x, layer_leaves):
+            with no_grad():
+                saved = [p._data for p in leaves]
+                for p, d in zip(leaves, layer_leaves):
+                    p._data = d
+                template.training = training
+                try:
+                    y = template._inner(Tensor._wrap(x))._data
+                finally:
+                    for p, d in zip(leaves, saved):
+                        p._data = d
+            return y, None
+
+        if self.config.use_recompute:
+            one_layer = jax.checkpoint(one_layer)
+
+        def block_fn(stage_leaves, xmb):
+            y, _ = jax.lax.scan(one_layer, xmb, stage_leaves)
+            return y
+
+        return block_fn
+
+    def forward(self, input_ids, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = C.arange(0, s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+
+        mesh, axis = self._mesh_axis()
+        block_fn = self._block_fn()
+        n_micro, n_chunks = self.num_micro, self.num_chunks
+        stacked = [self._parameters[flat] for flat, _ in self._stacked_names]
+
+        def pipefn(xa, *leaves):
+            xm = microbatch(xa, n_micro)
+            out = pipeline_spmd(block_fn, list(leaves), xm, mesh=mesh,
+                                axis=axis, num_chunks=n_chunks)
+            return unmicrobatch(out)
+
+        hidden = apply_op(pipefn, [x] + stacked, name="pipeline_spmd")
+        hidden = self.ln_f(hidden)
+        from .. import ops
+
+        return ops.matmul(hidden, self.wte.weight, transpose_y=True)
+
+
+def gpt_pipe_sharding_rules(tp_axis="mp", fsdp_axis=None, num_chunks=1):
+    """Megatron TP/ZeRO-3 specs for the stacked block params + the
+    embedding/norm params outside the ring. The stacked leading dims are
+    (pp, (chunks,) layers): pp-sharded, chunks/layers replicated."""
+    lead = ("pp", None) if num_chunks == 1 else ("pp", None, None)
+
+    def spec(*axes):
+        return lead + tuple(axes)
+
+    rules = [
+        (r"blocks__attn__qkv__weight$", spec(fsdp_axis, tp_axis)),
+        (r"blocks__attn__qkv__bias$", spec(tp_axis)),
+        (r"blocks__attn__out_proj__weight$", spec(tp_axis, fsdp_axis)),
+        (r"blocks__mlp__fc1__weight$", spec(fsdp_axis, tp_axis)),
+        (r"blocks__mlp__fc1__bias$", spec(tp_axis)),
+        (r"blocks__mlp__fc2__weight$", spec(tp_axis, fsdp_axis)),
+        (r"blocks__", lead),            # remaining stacked (ln etc.)
+        (r"\bwte\.weight$", (tp_axis, fsdp_axis)),
+        (r"\bwpe\.weight$", (None, fsdp_axis)),
+    ]
+    return rules

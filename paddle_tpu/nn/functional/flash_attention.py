@@ -18,7 +18,6 @@ from ...framework.tensor import Tensor
 from ...framework.random import next_key
 from ...ops._dispatch import nary, ensure_tensor
 
-_PALLAS_MIN_SEQ = 1024  # below this, plain XLA attention is already optimal
 
 
 def _sdpa_ref(q, k, v, mask, scale, causal, dropout_p, key):
@@ -57,22 +56,24 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     drop = dropout_p if training else 0.0
     rng = next_key() if drop > 0.0 else None
 
-    seqlen = query.shape[1]
-    use_pallas = False
-    if seqlen >= _PALLAS_MIN_SEQ and attn_mask is None and drop == 0.0:
-        try:
-            from ...ops.pallas import flash_attention as pallas_flash  # noqa: F401
+    from ...ops.pallas import flash_attention as pallas_flash
+    from ...utils import flags as _flags
 
-            use_pallas = pallas_flash.is_available()
-        except Exception:
-            use_pallas = False
+    seqlen = query.shape[1]
+    min_seq = int(_flags.get_flags(["FLAGS_pallas_flash_min_seqlen"])
+                  ["FLAGS_pallas_flash_min_seqlen"])
+    use_pallas = (
+        seqlen >= min_seq and attn_mask is None and drop == 0.0
+        and query.shape == key_t.shape == value.shape
+        and pallas_flash.supports(tuple(query.shape), query._data.dtype,
+                                  is_causal)
+    )
 
     if use_pallas:
-        from ...ops.pallas import flash_attention as pallas_flash
-
         inputs = [query, key_t, value]
         return nary(
-            lambda q, k, v: pallas_flash.flash_attention(q, k, v, causal=is_causal, scale=scale),
+            lambda q, k, v: pallas_flash.flash_attention(
+                q, k, v, causal=is_causal, scale=scale),
             inputs, "flash_attention_pallas",
         )
 

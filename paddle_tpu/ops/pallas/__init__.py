@@ -1,0 +1,9 @@
+"""Pallas TPU kernel pack — the fused-kernel library.
+
+Reference parity: paddle/phi/kernels/fusion/ (~90k LoC of fused CUDA
+kernels) and the flash-attn entry paddle/phi/kernels/gpu/flash_attn_kernel.cu.
+TPU-first: the hot fused ops are hand-written Pallas kernels over the MXU
+(flash attention here; more land as profiling demands), everything else is
+left to XLA fusion.
+"""
+from . import flash_attention  # noqa: F401

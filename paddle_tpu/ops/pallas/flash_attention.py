@@ -1,0 +1,347 @@
+"""Flash attention — Pallas TPU kernels, forward + backward.
+
+Reference parity: the CUDA flash-attn kernel the reference dispatches to
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu, declared in
+paddle/phi/kernels/flash_attn_kernel.h). TPU-first design: an
+online-softmax tiled kernel over the MXU with fp32 accumulation and LSE
+residuals, plus the flash-attention-2 backward decomposition (one kernel
+for dQ, one for dK/dV), mapped onto pallas grids
+(/opt/skills/guides/pallas_guide.md). Off-TPU the same kernels run in
+pallas interpret mode, so CPU tests exercise the real kernel code.
+
+Internal layout is [batch*heads, seq, head_dim]; the public entry takes
+the reference's [batch, seq, heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover - pallas ships with jax
+    pl = None
+    pltpu = None
+    _HAS_PALLAS = False
+
+_LANES = 128
+_Z = np.int32(0)  # index-map zero: literal 0 traces as i64 under x64  # VPU lane count: scratch stats are kept lane-replicated
+
+
+def is_available() -> bool:
+    return _HAS_PALLAS
+
+
+def _on_tpu() -> bool:
+    # NOTE: under the axon TPU tunnel jax reports backend "tpu" even when
+    # JAX_PLATFORMS=cpu is set, so check the actual default device platform.
+    try:
+        return jnp.zeros(1).devices().pop().platform == "tpu"
+    except Exception:
+        return False
+
+
+def supports(q_shape, dtype, causal) -> bool:
+    """Whether the kernel can take this problem (else callers use XLA)."""
+    if not _HAS_PALLAS:
+        return False
+    if dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return False
+    b, s, h, d = q_shape
+    if d > 256:
+        return False
+    return _pick_block(s) is not None
+
+
+def _pick_block(seq: int):
+    for blk in (512, 256, 128, 64, 32, 16, 8):
+        if seq % blk == 0:
+            return blk
+    return None
+
+
+def _dot(a, b, contract):
+    """dot_general with fp32 accumulation; HIGHEST precision only for f32
+    operands. Mosaic rejects contract_precision<fp32> on bf16 vectors, and
+    the framework sets jax_default_matmul_precision="float32" globally, so
+    bf16 dots must pass an explicit DEFAULT to override that config."""
+    prec = (jax.lax.Precision.HIGHEST
+            if a.dtype == jnp.float32 and b.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+    return jax.lax.dot_general(a, b, (contract, ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=prec)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: skip blocks strictly above the diagonal band
+    active = (ki * block_k <= qi * block_q + block_q - 1) if causal else ki >= 0
+
+    @pl.when(active)
+    def _step():
+        q = q_ref[0]                                     # [bq, d]
+        k = k_ref[0]                                     # [bk, d]
+        v = v_ref[0]
+        s = _dot(q, k, ((1,), (1,))) * scale   # [bq, bk]
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, -jnp.inf)
+        m_prev = m_ref[...]                              # [bq, LANES]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)        # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        corr = jnp.exp(m_prev - m_new)                   # [bq, LANES]
+        p = jnp.exp(s - m_new[:, :1])                    # [bq, bk] fp32
+        l_new = corr * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+        pv = _dot(p.astype(v.dtype), v, ((1,), (0,)))          # [bq, d]
+        acc_ref[...] = acc_ref[...] * corr[:, :1] + pv
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        l = l_ref[...][:, :1]                            # [bq, 1]
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # lse layout [bh, sq, LANES], lane-replicated like the scratch
+        # stats (Mosaic wants full-lane tiles; jax's own flash kernel does
+        # the same with MIN_BLOCK_SIZE=128)
+        lse_ref[0] = m_ref[...] + jnp.log(l_ref[...])
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    grid = (bh, sq // block_q, sk // block_k)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _Z)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, _Z)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, _Z)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _Z)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, _Z)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: dQ kernel (grid bh × qi × ki), dK/dV kernel (grid bh × ki × qi)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    active = (ki * block_k <= qi * block_q + block_q - 1) if causal else ki >= 0
+
+    @pl.when(active)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)               # [bq, d]
+        lse = lse_ref[0][:, :1]                          # [bq, 1]
+        delta = delta_ref[0][:, :1]                      # [bq, 1]
+        s = _dot(q, k, ((1,), (1,))) * scale
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, -jnp.inf)
+        p = jnp.exp(s - lse)                             # [bq, bk]
+        dp = _dot(do.astype(v.dtype), v, ((1,), (1,)))          # [bq, bk]
+        ds = p * (dp - delta) * scale
+        acc_ref[...] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))          # [bq, d]
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    active = (qi * block_q + block_q - 1 >= ki * block_k) if causal else qi >= 0
+
+    @pl.when(active)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]                          # [bq, 1]
+        delta = delta_ref[0][:, :1]                      # [bq, 1]
+        s = _dot(q, k, ((1,), (1,))) * scale   # [bq, bk]
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, -jnp.inf)
+        p = jnp.exp(s - lse)                              # [bq, bk]
+        dv_acc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))           # [bk, d]
+        dp = _dot(do.astype(v.dtype), v, ((1,), (1,)))           # [bq, bk]
+        ds = p * (dp - delta) * scale                     # [bq, bk]
+        dk_acc[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))           # [bk, d]
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True), (bh, sq, _LANES))  # lane-replicated
+
+    q_spec_qk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _Z))
+    k_spec_qk = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, _Z))
+    row_spec_qk = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, _Z))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[q_spec_qk, k_spec_qk, k_spec_qk, q_spec_qk,
+                  row_spec_qk, row_spec_qk],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _Z)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv grid: ki outer, qi inner
+    q_spec_kq = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, _Z))
+    k_spec_kq = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, _Z))
+    row_spec_kq = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, _Z))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, sk // block_k, sq // block_q),
+        in_specs=[q_spec_kq, k_spec_kq, k_spec_kq, q_spec_kq,
+                  row_spec_kq, row_spec_kq],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, _Z)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, _Z)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper + public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, scale, causal, block_q,
+                      block_k, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
+                    block_k=None, interpret=None):
+    """q/k/v: [batch, seq, heads, head_dim] (reference layout). Returns the
+    attention output in the same layout. Differentiable (custom flash
+    backward). Requires seq % block == 0 (see `supports`)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if causal and sq != sk:
+        raise ValueError("causal flash attention needs equal q/k seq lens")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if block_q is None:
+        block_q = _pick_block(sq)
+    if block_k is None:
+        block_k = _pick_block(sk)
+    if block_q is None or block_k is None:
+        raise ValueError(f"unsupported seq lens ({sq}, {sk}) for flash blocks")
+    if interpret is None:
+        interpret = not _on_tpu()
+
+    def to_bh(x, s):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, x.shape[-1])
+
+    qb, kb, vb = to_bh(q, sq), to_bh(k, sk), to_bh(v, sk)
+    ob = _flash(qb, kb, vb, float(scale), bool(causal), int(block_q),
+                int(block_k), bool(interpret))
+    return jnp.transpose(ob.reshape(b, h, sq, d), (0, 2, 1, 3))

@@ -69,3 +69,6 @@ define_flag("FLAGS_init_allocated_mem", False, "")
 define_flag("FLAGS_use_stream_safe_cuda_allocator", True, "no-op on TPU (PJRT-managed)")
 define_flag("FLAGS_distributed_timeout_sec", 1800, "collective watchdog timeout")
 define_flag("FLAGS_log_level", 0, "VLOG level")
+define_flag("FLAGS_pallas_flash_min_seqlen", 1024,
+            "min seq len to route scaled_dot_product_attention to the "
+            "pallas flash kernel (below it plain XLA attention wins)")

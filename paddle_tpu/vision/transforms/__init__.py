@@ -43,8 +43,10 @@ class ToTensor(BaseTransform):
         self.data_format = data_format
 
     def _apply_image(self, img):
-        img = _as_hwc(img).astype(np.float32)
-        if img.dtype == np.float32 and img.max() > 1.0:
+        img = _as_hwc(img)
+        was_int = np.issubdtype(img.dtype, np.integer)
+        img = img.astype(np.float32)
+        if was_int:
             img = img / 255.0
         if self.data_format == "CHW":
             img = img.transpose(2, 0, 1)
@@ -94,10 +96,11 @@ class Resize(BaseTransform):
         x1 = np.minimum(x0 + 1, iw - 1)
         wy = (yi - y0)[:, None, None]
         wx = (xi - x0)[None, :, None]
+        orig_dtype = img.dtype
         img = img.astype(np.float32)
         top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
         bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
-        return (top * (1 - wy) + bot * wy).astype(img.dtype)
+        return (top * (1 - wy) + bot * wy).astype(orig_dtype)
 
 
 class CenterCrop(BaseTransform):

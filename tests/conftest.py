@@ -1,6 +1,13 @@
 """Test config: force an 8-device virtual CPU mesh (SURVEY.md environment
 notes) so distributed tests run without TPU hardware, mirroring the
-reference's multi-process-on-one-node test strategy (SURVEY.md §4)."""
+reference's multi-process-on-one-node test strategy (SURVEY.md §4).
+
+NOTE: under the axon TPU tunnel, JAX_PLATFORMS=cpu does NOT stop jax from
+registering the remote TPU as the default device — round 1's suite silently
+ran every eager op over the tunnel (per-op remote dispatch ≈ 20× slower).
+Pinning jax_default_device to cpu:0 keeps tests hermetic and fast; tests
+that want the real chip opt in explicitly.
+"""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -9,3 +16,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])

@@ -1,0 +1,187 @@
+"""Pipeline parallelism tests — the VERDICT r1 gap #2.
+
+The contract: pp=2 / pp=4 training is step-for-step numerically equal to
+single-device execution (reference test strategy: every strategy has a
+numeric parity test against its unsharded twin, SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import (
+    pipeline_spmd, microbatch, unmicrobatch,
+)
+from paddle_tpu.models import (
+    GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTPretrainingCriterion,
+)
+
+
+def _mesh(n, axis="pp"):
+    return Mesh(np.array(jax.devices("cpu")[:n]), (axis,))
+
+
+class TestPipelinePrimitive:
+    @pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 4), (4, 2)])
+    def test_matches_sequential(self, n_stages, n_micro):
+        mesh = _mesh(n_stages)
+        rng = np.random.default_rng(0)
+        lps, h = 2, 16
+        W = jnp.asarray(rng.standard_normal((n_stages, lps, h, h)) * 0.3,
+                        jnp.float32)
+
+        def block_fn(Ws, xmb):
+            def body(c, w):
+                return jnp.tanh(c @ w), None
+            y, _ = jax.lax.scan(body, xmb, Ws)
+            return y
+
+        def piped(W, x):
+            return unmicrobatch(pipeline_spmd(
+                block_fn, W, microbatch(x, n_micro), mesh=mesh, axis="pp"))
+
+        def seq(W, x):
+            for i in range(n_stages * lps):
+                x = jnp.tanh(x @ W.reshape(-1, h, h)[i])
+            return x
+
+        x = jnp.asarray(rng.standard_normal((n_micro * 2, h)), jnp.float32)
+        np.testing.assert_allclose(piped(W, x), seq(W, x), atol=1e-6)
+        g1 = jax.grad(lambda W, x: jnp.sum(jnp.sin(piped(W, x))), (0, 1))(W, x)
+        g2 = jax.grad(lambda W, x: jnp.sum(jnp.sin(seq(W, x))), (0, 1))(W, x)
+        np.testing.assert_allclose(g1[0], g2[0], atol=1e-5)
+        np.testing.assert_allclose(g1[1], g2[1], atol=1e-5)
+
+    def test_interleave_chunks(self):
+        """num_chunks=2 VPP round-robin placement: chunk c on stage s is
+        logical stage c*n_stages+s (reference pipeline_parallel.py:1138)."""
+        mesh = _mesh(2)
+        rng = np.random.default_rng(1)
+        ns, nc, h = 2, 2, 8
+        W = jnp.asarray(rng.standard_normal((ns, nc, 1, h, h)) * 0.3,
+                        jnp.float32)
+
+        def block_fn(Ws, xmb):
+            y, _ = jax.lax.scan(lambda c, w: (jnp.tanh(c @ w), None), xmb, Ws)
+            return y
+
+        def piped(W, x):
+            return unmicrobatch(pipeline_spmd(
+                block_fn, W, microbatch(x, 2), mesh=mesh, axis="pp",
+                num_chunks=nc))
+
+        def seq(W, x):
+            for c in range(nc):
+                for s in range(ns):
+                    x = jnp.tanh(x @ W[s, c, 0])
+            return x
+
+        x = jnp.asarray(rng.standard_normal((4, h)), jnp.float32)
+        np.testing.assert_allclose(piped(W, x), seq(W, x), atol=1e-6)
+
+
+def _tiny_cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=4,
+                num_attention_heads=4, max_position_embeddings=16,
+                hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _copy_plain_into_pipe(plain, pipe, num_stages, lps, num_chunks=1):
+    sd = dict(plain.named_parameters())
+    pipe.wte.weight._data = sd["gpt.wte.weight"]._data
+    pipe.wpe.weight._data = sd["gpt.wpe.weight"]._data
+    pipe.ln_f.weight._data = sd["gpt.ln_f.weight"]._data
+    pipe.ln_f.bias._data = sd["gpt.ln_f.bias"]._data
+    for flat, pname in pipe._stacked_names:
+        stk = pipe._parameters[flat]
+        if num_chunks == 1:
+            vals = jnp.stack([
+                jnp.stack([sd[f"gpt.blocks.{s * lps + i}.{pname}"]._data
+                           for i in range(lps)])
+                for s in range(num_stages)])
+        else:
+            vals = jnp.stack([
+                jnp.stack([
+                    jnp.stack([sd[
+                        f"gpt.blocks.{(c * num_stages + s) * lps + i}.{pname}"
+                    ]._data for i in range(lps)])
+                    for c in range(num_chunks)])
+                for s in range(num_stages)])
+        stk._data = vals
+
+
+class TestGPTPipeParity:
+    def test_loss_and_grads_match_plain(self):
+        cfg = _tiny_cfg()
+        mesh = _mesh(2)
+        plain = GPTForCausalLM(cfg)
+        pipe = GPTForCausalLMPipe(cfg, num_stages=2, num_micro=2, mesh=mesh)
+        _copy_plain_into_pipe(plain, pipe, 2, 2)
+
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(0, 64, (4, 16)), dtype="int64")
+        labels = paddle.to_tensor(rng.integers(0, 64, (4, 16)), dtype="int64")
+        crit = GPTPretrainingCriterion()
+        l_plain = crit(plain(ids), labels)
+        l_pipe = crit(pipe(ids), labels)
+        assert abs(float(l_plain) - float(l_pipe)) < 1e-5
+        l_plain.backward()
+        l_pipe.backward()
+        sd = dict(plain.named_parameters())
+        g_plain = sd["gpt.blocks.3.attn.qkv.weight"].grad._data
+        g_pipe = pipe._parameters["blocks__attn__qkv__weight"].grad._data[1, 1]
+        np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_pipe),
+                                   atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(sd["gpt.wte.weight"].grad._data),
+            np.asarray(pipe.wte.weight.grad._data), atol=1e-5)
+
+    def test_pp4_loss_matches(self):
+        cfg = _tiny_cfg()
+        mesh = _mesh(4)
+        plain = GPTForCausalLM(cfg)
+        pipe = GPTForCausalLMPipe(cfg, num_stages=4, num_micro=4, mesh=mesh)
+        _copy_plain_into_pipe(plain, pipe, 4, 1)
+        rng = np.random.default_rng(2)
+        ids = paddle.to_tensor(rng.integers(0, 64, (8, 16)), dtype="int64")
+        labels = paddle.to_tensor(rng.integers(0, 64, (8, 16)), dtype="int64")
+        crit = GPTPretrainingCriterion()
+        assert abs(float(crit(plain(ids), labels)) -
+                   float(crit(pipe(ids), labels))) < 1e-5
+
+    def test_train_step_pp_dp_mesh(self):
+        """Full fused TrainStep over a dp×pp mesh: loss decreases and the
+        jitted step does not retrace."""
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.jit import TrainStep
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.models import gpt_pipe_sharding_rules, match_sharding
+
+        cfg = _tiny_cfg()
+        mesh = Mesh(np.array(jax.devices("cpu")[:4]).reshape(2, 2),
+                    ("dp", "pp"))
+        pipe = GPTForCausalLMPipe(cfg, num_stages=2, num_micro=2, mesh=mesh)
+        rules = gpt_pipe_sharding_rules(tp_axis=None)
+        for name, p in pipe.named_parameters():
+            spec = match_sharding(name, rules)
+            axes = [a if (a and p._data.shape[i] % mesh.shape[a] == 0)
+                    else None for i, a in enumerate(spec)] if spec else []
+            p._data = jax.device_put(
+                p._data, NamedSharding(mesh, P(*axes) if axes else P()))
+        opt = popt.AdamW(learning_rate=1e-3, parameters=pipe.parameters())
+        crit = GPTPretrainingCriterion()
+        step = TrainStep(pipe, lambda m, i, l: crit(m(i), l), opt)
+        rng = np.random.default_rng(3)
+        ids = paddle.to_tensor(rng.integers(0, 64, (4, 16)), dtype="int64")
+        ids._data = jax.device_put(ids._data, NamedSharding(mesh, P("dp")))
+        labels = paddle.to_tensor(rng.integers(0, 64, (4, 16)), dtype="int64")
+        labels._data = jax.device_put(labels._data,
+                                      NamedSharding(mesh, P("dp")))
+        losses = [float(step(ids, labels)) for _ in range(3)]
+        assert losses[-1] < losses[0]
+        assert np.all(np.isfinite(losses))
