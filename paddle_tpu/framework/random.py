@@ -37,7 +37,19 @@ class Generator:
     def next_key(self):
         with self._lock:
             off = self._offset
-            self._offset += 1
+            # A compiled step (TrainStep) threads the offset through jit and
+            # rebinds it to the step's OUTPUT array — committed to that
+            # step's mesh. Folding a committed offset into the key would
+            # propagate the old mesh commitment into every tensor later
+            # created from this generator (param init, dropout), silently
+            # pinning fresh models to a stale device set. Canonicalize
+            # concrete arrays back to host ints; tracers pass through so
+            # traced consumers stay functional.
+            if isinstance(off, jax.Array) and not isinstance(
+                    off, jax.core.Tracer):
+                off = int(off)
+                self._offset = off
+            self._offset = off + 1
         return jax.random.fold_in(jax.random.PRNGKey(self._seed), off)
 
     def split_key(self, n: int):

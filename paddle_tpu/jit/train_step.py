@@ -162,6 +162,13 @@ class TrainStep:
     def __call__(self, *batch):
         batch_data = _tree_data(list(batch))
         if self._jitted is None:
+            # the global generator offset may be a device array committed to
+            # another step's mesh (jit outputs rebind it); a foreign sharding
+            # on the first call would key one extra executable, so drop the
+            # commitment before the initial trace
+            gen = _random.default_generator()
+            if isinstance(gen._offset, jax.Array):
+                gen._offset = int(gen._offset)
             # run optimizer accumulator creation eagerly once so the state
             # pytree is complete before tracing
             self._warmup_accumulators()

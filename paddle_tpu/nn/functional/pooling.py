@@ -34,8 +34,12 @@ def _pool_nd(x, kernel, stride, padding, n, reducer, init, ceil_mode=False,
         pad_full = [(0, 0), (0, 0)] + pads if isinstance(pads, list) else pads
 
     def f(v):
+        # init values must be CONCRETE numpy scalars: a jnp constant becomes
+        # a tracer under jit, defeating jax's monoid-reducer matching, and
+        # reduce_window then loses its autodiff rule (fails only inside
+        # jit-of-vjp, e.g. TrainStep over a conv net).
         if average:
-            zero = jnp.zeros((), v.dtype)
+            zero = np.zeros((), v.dtype)
             summed = jax.lax.reduce_window(
                 v, zero, jax.lax.add, window, strides, padding=pad_full
             )
@@ -48,9 +52,9 @@ def _pool_nd(x, kernel, stride, padding, n, reducer, init, ceil_mode=False,
             )
             return (summed / counts).astype(v.dtype)
         if jnp.issubdtype(v.dtype, jnp.floating):
-            init_v = jnp.array(-jnp.inf, v.dtype)
+            init_v = np.asarray(-np.inf, v.dtype)
         else:
-            init_v = jnp.array(jnp.iinfo(v.dtype).min, v.dtype)
+            init_v = np.asarray(jnp.iinfo(v.dtype).min, v.dtype)
         return jax.lax.reduce_window(
             v, init_v, reducer, window, strides, padding=pad_full
         )

@@ -103,24 +103,30 @@ class TestConfig1LeNetModel:
         assert "loss" in res
 
     def test_config2_resnet_dp_step(self):
-        """BASELINE config 2 slice: ResNet-18 under DataParallel."""
+        """BASELINE config 2 slice: ResNet-18 under DataParallel, driven
+        through TrainStep (the prescribed multi-device training path: one
+        fused XLA program with GSPMD grad sync). Eager per-op execution of
+        ResNet-sized programs over an 8-device host-platform mesh trips an
+        XLA-CPU in-process-collective rendezvous deadlock (abort in
+        rendezvous.cc); eager-DP numerics are covered by the MLP parity test
+        in test_distributed.py instead."""
         import paddle_tpu.distributed as dist
         from paddle_tpu.distributed import env as denv
+        from paddle_tpu.jit import TrainStep
 
         denv.set_mesh(denv.build_mesh({"dp": 8}))
-        m = dist.DataParallel(resnet18(num_classes=10))
-        opt = popt.Momentum(learning_rate=0.1,
-                            parameters=m.parameters())
-        loss_fn = nn.CrossEntropyLoss()
-        x = paddle.to_tensor(np.random.randn(16, 3, 32, 32).astype("float32"))
-        y = paddle.to_tensor(np.random.randint(0, 10, (16,)), dtype="int64")
-        l0 = None
-        for _ in range(3):
-            loss = loss_fn(m(x), y)
-            loss.backward()
-            opt.step()
-            opt.clear_grad()
-            l0 = l0 or float(loss)
-        assert float(loss) < l0
-        denv._state["initialized"] = False
-        denv._state["mesh"] = None
+        try:
+            m = dist.DataParallel(resnet18(num_classes=10))
+            opt = popt.Momentum(learning_rate=0.01,
+                                parameters=m.parameters())
+            loss_fn = nn.CrossEntropyLoss()
+            step = TrainStep(m, lambda mod, a, b: loss_fn(mod(a), b), opt)
+            x = paddle.to_tensor(
+                np.random.randn(16, 3, 32, 32).astype("float32"))
+            y = paddle.to_tensor(np.random.randint(0, 10, (16,)),
+                                 dtype="int64")
+            losses = [float(step(x, y)) for _ in range(3)]
+            assert losses[-1] < losses[0]
+        finally:
+            denv._state["initialized"] = False
+            denv._state["mesh"] = None
