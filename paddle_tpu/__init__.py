@@ -62,6 +62,8 @@ from . import utils  # noqa: E402,F401
 from .hapi import Model  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401

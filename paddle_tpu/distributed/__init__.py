@@ -40,6 +40,7 @@ from .collective import (  # noqa: F401
     destroy_process_group,
 )
 from .parallel import DataParallel  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import sharding  # noqa: F401

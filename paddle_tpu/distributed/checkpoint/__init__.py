@@ -1,0 +1,18 @@
+"""Distributed (sharded) checkpointing.
+
+Reference parity: python/paddle/distributed/checkpoint/ —
+``save_state_dict`` (save_state_dict.py:145) writes per-rank shard files +
+a global metadata manifest with replicated-shard dedup;
+``load_state_dict`` (load_state_dict.py:277) reshard-on-loads to any target
+mesh/layout by chunk-overlap resolution.
+"""
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .save_state_dict import save_state_dict
+from .load_state_dict import load_state_dict
+from .utils import flatten_state_dict, unflatten_state_dict
+
+__all__ = [
+    "LocalTensorIndex", "LocalTensorMetadata", "Metadata",
+    "save_state_dict", "load_state_dict",
+    "flatten_state_dict", "unflatten_state_dict",
+]

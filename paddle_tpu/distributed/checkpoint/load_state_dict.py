@@ -1,0 +1,120 @@
+"""Sharded checkpoint load with reshard-on-load.
+
+Reference parity: python/paddle/distributed/checkpoint/load_state_dict.py:277
+(chunk-overlap resolution) and :362 (cross-rank fetch). TPU-first: the
+template state_dict's arrays carry their TARGET shardings, so each process
+assembles exactly the slices its devices need via
+``jax.make_array_from_callback`` — the "which rank has my bytes"
+point-to-point dance is replaced by reading the overlapping chunks from the
+checkpoint files (storage is the transport; no collectives needed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict
+
+import numpy as np
+
+import jax
+
+from .metadata import LocalTensorIndex, Metadata
+from .utils import flatten_state_dict, to_jax_array, unpack_numpy
+
+
+class _ChunkReader:
+    """Lazy per-file chunk cache."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._files: Dict[str, dict] = {}
+
+    def chunk(self, file_name: str, key, offset):
+        if file_name not in self._files:
+            with open(os.path.join(self.path, file_name), "rb") as f:
+                self._files[file_name] = pickle.load(f)
+        return unpack_numpy(self._files[file_name][(key, offset)])
+
+
+def _assemble(key, region_index, shape, dtype, chunks, storage, reader):
+    """Fill the [region] slice of logical tensor `key` from saved chunks."""
+    starts = [sl.start or 0 for sl in region_index]
+    stops = [sl.stop if sl.stop is not None else dim
+             for sl, dim in zip(region_index, shape)]
+    region_shape = tuple(b - a for a, b in zip(starts, stops))
+    out = np.empty(region_shape, dtype)
+    filled = np.zeros(region_shape, bool) if chunks else None
+    for c in chunks:
+        c_starts = list(c.global_offset)
+        c_stops = [o + s for o, s in zip(c.global_offset, c.local_shape)]
+        lo = [max(a, ca) for a, ca in zip(starts, c_starts)]
+        hi = [min(b, cb) for b, cb in zip(stops, c_stops)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        file_name = storage[LocalTensorIndex(key, c.global_offset)]
+        data = reader.chunk(file_name, key, c.global_offset)
+        src = tuple(slice(l - ca, h - ca)
+                    for l, h, ca in zip(lo, hi, c_starts))
+        dst = tuple(slice(l - a, h - a) for l, h, a in zip(lo, hi, starts))
+        out[dst] = data[src]
+        filled[dst] = True
+    if filled is None or not filled.all():
+        raise ValueError(
+            f"checkpoint chunks do not cover tensor {key!r} region "
+            f"{region_index} (shape {shape})")
+    return out
+
+
+def load_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0) -> None:
+    """Load into the template ``state_dict`` IN PLACE, resharding saved
+    chunks to each tensor's current sharding (any mesh/layout)."""
+    meta_path = os.path.join(path, "0.metadata")
+    with open(meta_path, "rb") as f:
+        meta: Metadata = pickle.load(f)
+    flat, _ = flatten_state_dict(state_dict)
+    reader = _ChunkReader(path)
+
+    from ...framework.tensor import Tensor
+
+    for key, value in flat.items():
+        if key not in meta.state_dict_metadata:
+            raise KeyError(f"{key!r} not found in checkpoint {path!r}")
+        saved = meta.state_dict_metadata[key]
+        if not isinstance(saved, list):
+            # scalar entry: restore the saved value into the template dict
+            node = state_dict
+            parts = meta.flat_mapping.get(key) or tuple(key.split("."))
+            for p in parts[:-1]:
+                node = node[p]
+            node[parts[-1]] = saved
+            continue
+        target = to_jax_array(value)
+        shape = tuple(target.shape)
+        saved_dtype = np.dtype(saved[0].dtype) if saved else target.dtype
+        if saved_dtype.name == "bfloat16":
+            import ml_dtypes
+
+            saved_dtype = np.dtype(ml_dtypes.bfloat16)
+
+        def cb(index, _key=key, _saved=saved, _shape=shape,
+               _dtype=saved_dtype):
+            full = tuple(
+                slice(sl.start or 0,
+                      sl.stop if sl.stop is not None else dim)
+                for sl, dim in zip(index, _shape))
+            return _assemble(_key, full, _shape, _dtype, _saved,
+                             meta.storage_metadata, reader)
+
+        new = jax.make_array_from_callback(shape, target.sharding, cb)
+        if new.dtype != target.dtype:
+            new = new.astype(target.dtype)
+        if isinstance(value, Tensor):
+            value._data = new
+        else:
+            # plain-array template: rebind in the dict via the flat key path
+            node = state_dict
+            parts = meta.flat_mapping.get(key) or tuple(key.split("."))
+            for p in parts[:-1]:
+                node = node[p]
+            node[parts[-1]] = new

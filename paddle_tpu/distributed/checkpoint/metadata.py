@@ -1,0 +1,41 @@
+"""Checkpoint metadata types.
+
+Reference parity: python/paddle/distributed/checkpoint/metadata.py —
+``Metadata`` maps every logical tensor to the list of saved chunks
+(``LocalTensorMetadata``: global offset + local shape) and each chunk to the
+file that holds it (``storage_metadata``). The TPU build keys chunks by
+their global index ranges taken from ``jax.Array.addressable_shards``
+instead of process-group ranks.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One saved chunk of a logical tensor (global placement + dtype)."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Key of a chunk: (tensor name, global offset)."""
+
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    """Global checkpoint manifest (written once, by the coordinator)."""
+
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    storage_metadata: Dict[LocalTensorIndex, str] = field(
+        default_factory=dict)
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)

@@ -1,0 +1,113 @@
+"""Sharded checkpoint save.
+
+Reference parity: python/paddle/distributed/checkpoint/save_state_dict.py:145
+(save_state_dict) and its dedup of replicated shards (:107-144). TPU-first:
+chunks come from ``jax.Array.addressable_shards`` — the global index of every
+shard is known locally from the NamedSharding, so the metadata needs no
+cross-rank gather of "local shapes"; dedup keys on ``replica_id == 0``
+(exactly one device per distinct chunk writes it), which subsumes the
+reference's rank-0-wins rule for replicated placements.
+
+Layout on disk::
+
+    path/
+      0.metadata        # Metadata: tensor -> [chunks], chunk -> file
+      {proc}_0.distcp   # pickle: {(tensor_key, global_offset): payload}
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict
+
+import numpy as np
+
+import jax
+
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .utils import (
+    flatten_state_dict, offsets_of, pack_numpy, to_jax_array,
+)
+
+
+def _dtype_name(arr) -> str:
+    dt = arr.dtype
+    return dt.name if hasattr(dt, "name") else str(dt)
+
+
+def save_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0) -> None:
+    """Save a (possibly nested) state_dict of sharded tensors.
+
+    Every process writes the chunks it owns (``replica_id == 0`` shards of
+    its addressable devices); the coordinator writes the global metadata.
+    Single-process meshes (incl. virtual CPU meshes) save everything.
+    """
+    if not isinstance(state_dict, dict):
+        raise TypeError("save_state_dict expects a dict")
+    flat, mapping = flatten_state_dict(state_dict)
+
+    os.makedirs(path, exist_ok=True)
+    proc = jax.process_index()
+    meta = Metadata(flat_mapping=mapping)
+    file_name = f"{proc}_0.distcp"
+    local_chunks = {}
+
+    for key, value in flat.items():
+        if isinstance(value, (int, float)):
+            # scalars ride in the metadata file
+            meta.state_dict_metadata[key] = value
+            continue
+        arr = to_jax_array(value)
+        chunks = []
+        seen_offsets = set()
+        for shard in arr.addressable_shards:
+            off = offsets_of(shard.index, arr.shape)
+            if shard.replica_id != 0 or off in seen_offsets:
+                continue
+            seen_offsets.add(off)
+            data = np.asarray(shard.data)
+            chunks.append(LocalTensorMetadata(off, tuple(data.shape),
+                                              _dtype_name(arr)))
+            local_chunks[(key, off)] = pack_numpy(data)
+            meta.storage_metadata[LocalTensorIndex(key, off)] = file_name
+        meta.state_dict_metadata.setdefault(key, []).extend(chunks)
+
+    with open(os.path.join(path, file_name), "wb") as f:
+        pickle.dump(local_chunks, f)
+
+    if jax.process_count() > 1:
+        # every process computed the same global chunk list for the
+        # addressable part; merge via a metadata file per process and let
+        # the coordinator fold them (control plane only, tiny).
+        part = f"{proc}.metapart"
+        with open(os.path.join(path, part), "wb") as f:
+            pickle.dump(meta, f)
+        # rendezvous so the coordinator sees all parts
+        from ..collective import barrier
+
+        barrier()
+        if proc == coordinator_rank:
+            for p in range(jax.process_count()):
+                part_path = os.path.join(path, f"{p}.metapart")
+                with open(part_path, "rb") as f:
+                    other = pickle.load(f)
+                for k, v in other.state_dict_metadata.items():
+                    if isinstance(v, list):
+                        cur = meta.state_dict_metadata.setdefault(k, [])
+                        for c in v:
+                            if c not in cur:
+                                cur.append(c)
+                    else:
+                        meta.state_dict_metadata[k] = v
+                meta.storage_metadata.update(other.storage_metadata)
+                os.remove(part_path)
+            with open(os.path.join(path, "0.metadata"), "wb") as f:
+                pickle.dump(meta, f)
+        # second barrier: no process returns before the manifest exists
+        # (a non-coordinator may immediately load/validate the checkpoint)
+        barrier()
+        return
+
+    with open(os.path.join(path, "0.metadata"), "wb") as f:
+        pickle.dump(meta, f)

@@ -1,0 +1,86 @@
+"""Checkpoint helpers: state-dict flattening + array normalization.
+
+Reference parity: python/paddle/distributed/checkpoint/utils.py
+(flatten_state_dict/unflatten_state_dict).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+
+
+def _is_leaf(v) -> bool:
+    from ...framework.tensor import Tensor
+
+    return isinstance(v, (Tensor, jax.Array, np.ndarray, int, float))
+
+
+def flatten_state_dict(state_dict: Dict) -> Tuple[Dict[str, Any],
+                                                  Dict[str, Tuple[str, ...]]]:
+    """Flatten nested dicts to ``"a.b.c" -> value``; returns the flat dict
+    plus the mapping back to the original key paths."""
+    flat: Dict[str, Any] = {}
+    mapping: Dict[str, Tuple[str, ...]] = {}
+
+    def walk(prefix: Tuple[str, ...], obj):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(prefix + (str(k),), v)
+        else:
+            key = ".".join(prefix)
+            if key in flat:
+                raise ValueError(f"duplicate flattened key {key!r}")
+            flat[key] = obj
+            mapping[key] = prefix
+    walk((), state_dict)
+    return flat, mapping
+
+
+def unflatten_state_dict(flat: Dict[str, Any],
+                         mapping: Dict[str, Tuple[str, ...]]) -> Dict:
+    out: Dict = {}
+    for key, value in flat.items():
+        path = mapping[key]
+        cur = out
+        for p in path[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[path[-1]] = value
+    return out
+
+
+def to_jax_array(v) -> jax.Array:
+    from ...framework.tensor import Tensor
+
+    if isinstance(v, Tensor):
+        return v._data
+    if isinstance(v, jax.Array):
+        return v
+    import jax.numpy as jnp
+
+    return jnp.asarray(v)
+
+
+def offsets_of(shard_index, shape) -> Tuple[int, ...]:
+    """Global offset of a shard from its index (tuple of slices)."""
+    return tuple(
+        (sl.start or 0) for sl in shard_index
+    ) if shard_index else tuple(0 for _ in shape)
+
+
+def pack_numpy(arr: np.ndarray):
+    """bfloat16-safe numpy payload (raw uint16 view)."""
+    name = arr.dtype.name if hasattr(arr.dtype, "name") else str(arr.dtype)
+    if name == "bfloat16":
+        return {"dtype": "bfloat16", "raw": np.asarray(arr).view(np.uint16)}
+    return {"dtype": name, "raw": np.asarray(arr)}
+
+
+def unpack_numpy(payload) -> np.ndarray:
+    if payload["dtype"] == "bfloat16":
+        import ml_dtypes
+
+        return payload["raw"].view(ml_dtypes.bfloat16)
+    return payload["raw"]

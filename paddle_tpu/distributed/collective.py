@@ -65,8 +65,32 @@ class Group:
 
     @property
     def rank(self) -> int:
-        # single-controller: the controller addresses all ranks; 0 by parity
-        return 0
+        """Single-controller semantics: one Python process drives ALL group
+        ranks, so "my rank" is only meaningful per host process. Returns 0
+        single-host (parity with reference rank-0 driver code); multi-host
+        SPMD returns the group index of the first device this process owns,
+        so per-rank branches (logging, checkpoint writes) stay correct."""
+        import jax
+
+        if jax.process_count() == 1:
+            return 0
+        me = jax.process_index()
+        mesh_axes = list(self.mesh.axis_names)
+        group_dims = [self.mesh.shape[a] for a in self.axes]
+        it = np.nditer(self.mesh.devices, flags=["multi_index", "refs_ok"])
+        for _ in it:
+            d = self.mesh.devices[it.multi_index]
+            if d.process_index == me:
+                # project the mesh coordinate onto the GROUP's axes and
+                # linearize — a flat mesh index would exceed nranks-1 for
+                # sub-axis groups
+                coord = [it.multi_index[mesh_axes.index(a)]
+                         for a in self.axes]
+                rank = 0
+                for c, dim in zip(coord, group_dims):
+                    rank = rank * dim + int(c)
+                return rank
+        return -1  # this process owns no device of the group
 
     @property
     def process_ids(self):

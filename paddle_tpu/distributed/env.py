@@ -21,6 +21,9 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+from ..utils.log_helper import get_logger
+
+_logger = get_logger(__name__)
 _lock = threading.Lock()
 _state = {
     "initialized": False,
@@ -68,10 +71,21 @@ def init_parallel_env():
         _state["mesh"] = Mesh(np.asarray(devs), ("dp",))
         _state["axis_degrees"] = {"dp": len(devs)}
         _state["initialized"] = True
+        _logger.debug("parallel env initialized: %d device(s), mesh=%s",
+                      len(devs), _state["mesh"])
 
 
 def is_initialized() -> bool:
     return _state["initialized"]
+
+
+def pin_sharding(x, sharding):
+    """Pin a raw jax value to a sharding: `with_sharding_constraint` under
+    trace, `device_put` eager. The one shared home for this dispatch rule
+    (mpu layers, stage-2 grad hooks, MoE dispatch all use it)."""
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
 
 
 def set_mesh(mesh: Mesh):

@@ -43,9 +43,7 @@ def _constrain(t: Tensor, mesh, spec: P) -> Tensor:
     sharding = NamedSharding(mesh, spec)
 
     def f(x):
-        if isinstance(x, jax.core.Tracer):
-            return jax.lax.with_sharding_constraint(x, sharding)
-        return jax.device_put(x, sharding)
+        return env.pin_sharding(x, sharding)
 
     return apply_op(f, [t], name="sharding_constraint")
 

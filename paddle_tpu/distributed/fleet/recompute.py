@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 
 from ...framework.tensor import Tensor
-from ...framework.autograd import apply_op
+from ...framework.autograd import apply_op, no_grad
 
 
 def _collect_params(function, args):
@@ -119,7 +119,14 @@ def recompute(function, *args, **kwargs):
         call_args = [Tensor._wrap(next(it)) if isinstance(a, Tensor) else a
                      for a in args]
         try:
-            out = function(*call_args)
+            # The outer jax.vjp of the checkpointed fn owns ALL
+            # differentiation of this segment; per-op tape vjps inside it
+            # are discarded anyway, and worse, an inner jax.vjp CONSUMES
+            # custom_vjp ops (flash attention) — their fwd kernels land raw
+            # in the remat jaxpr and remat's JVP cannot differentiate them.
+            # no_grad makes inner ops bind as plain jax calls.
+            with no_grad():
+                out = function(*call_args)
         finally:
             for p, d in zip(params, saved_p):
                 p._data = d

@@ -72,8 +72,11 @@ def main(argv=None):
         restarts += 1
         if restarts > args.max_restart:
             return code
-        print(f"[launch] rank {args.rank} exited {code}; restart "
-              f"{restarts}/{args.max_restart}", file=sys.stderr)
+        from ...utils.log_helper import get_logger
+
+        get_logger("paddle_tpu.launch").warning(
+            "rank %s exited %s; restart %d/%d",
+            args.rank, code, restarts, args.max_restart)
 
 
 if __name__ == "__main__":

@@ -1,1 +1,7 @@
-from .group_sharded import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .group_sharded import (  # noqa: F401
+    GroupShardedScaler,
+    GroupShardedStage2,
+    GroupShardedStage3,
+    group_sharded_parallel,
+    save_group_sharded_model,
+)

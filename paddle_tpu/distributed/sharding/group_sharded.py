@@ -30,14 +30,52 @@ from .. import env
 
 
 class GroupShardedStage2:
-    """Model wrapper for stage 2: forward passes through; grad sharding is
-    induced by the sharded optimizer states."""
+    """Model wrapper for stage 2 ("os_g"): gradients materialize
+    reduce-scattered over the sharding axis.
+
+    The reference's post-backward grad-slice reduce-scatter
+    (group_sharded_stage2.py) becomes a per-parameter backward hook that
+    pins the accumulated grad to a sharded NamedSharding; under the fused
+    TrainStep the constraint makes GSPMD emit reduce-scatter instead of
+    all-reduce (verified by the layout asserts in tests/test_distributed).
+    """
 
     def __init__(self, layer, sharding_optimizer=None, group=None,
                  sync_buffers=False, buffer_max_size=2 ** 23,
                  auto_refresh_trainable=True, device="tpu", dp_group=None):
         self._layers = layer
         self._opt = sharding_optimizer
+        if group is not None:
+            mesh, axis = group.mesh, group.axes[0]
+        else:
+            mesh = env.get_mesh()
+            axis = ("sharding" if "sharding" in mesh.axis_names
+                    else mesh.axis_names[0])
+        self._mesh, self._axis = mesh, axis
+        self._hook_handles = []
+        self._register_grad_hooks()
+
+    def _register_grad_hooks(self):
+        degree = int(self._mesh.shape[self._axis])
+        if degree <= 1:
+            return
+        mesh, axis = self._mesh, self._axis
+
+        def make_hook(dim):
+            def hook(grad):
+                axes = [None] * grad.ndim
+                axes[dim] = axis
+                grad._data = env.pin_sharding(
+                    grad._data, NamedSharding(mesh, P(*axes)))
+                return grad
+
+            return hook
+
+        for p in self._layers.parameters():
+            dim = _shardable_dim(p.shape, degree)
+            if dim is None:
+                continue
+            self._hook_handles.append(p.register_hook(make_hook(dim)))
 
     def __call__(self, *a, **k):
         return self._layers(*a, **k)
@@ -63,6 +101,8 @@ class GroupShardedStage3:
                     else mesh.axis_names[0])
         self._mesh, self._axis = mesh, axis
         self._segment_size = segment_size
+        self._offload = offload
+        self._offloaded = False
         self._shard_params()
 
     def _shard_params(self):
@@ -70,7 +110,7 @@ class GroupShardedStage3:
         if degree <= 1:
             return
         for p in self._layers.parameters():
-            if p.size * 4 < self._segment_size:
+            if p.size * p._data.dtype.itemsize < self._segment_size:
                 continue  # small params stay replicated (reference keeps
                           # sub-segment params unsharded)
             dim = _shardable_dim(p.shape, degree)
@@ -78,8 +118,19 @@ class GroupShardedStage3:
                 continue
             axes = [None] * p.ndim
             axes[dim] = self._axis
-            p._data = jax.device_put(
-                p._data, NamedSharding(self._mesh, P(*axes)))
+            sharding = NamedSharding(self._mesh, P(*axes))
+            if self._offload:
+                # reference stage3 offload: param/optimizer master copies
+                # live in host memory; on TPU that is the pinned_host
+                # memory space and XLA streams them in per use
+                try:
+                    host = sharding.with_memory_kind("pinned_host")
+                    p._data = jax.device_put(p._data, host)
+                    self._offloaded = True
+                    continue
+                except Exception:
+                    self._offloaded = False  # backend has no host space
+            p._data = jax.device_put(p._data, sharding)
 
     def __call__(self, *a, **k):
         return self._layers(*a, **k)
@@ -88,11 +139,34 @@ class GroupShardedStage3:
         return getattr(self._layers, item)
 
     def get_all_parameters(self, convert2cpu=False):
-        """Reference stage3: re-materialize full params (all-gather)."""
+        """Reference stage3: re-materialize full params (all-gather).
+
+        ``convert2cpu=True`` returns host copies WITHOUT touching device
+        placements. The gather variant remembers each param's sharded (or
+        host-offloaded) layout so :meth:`reshard` can restore it — a
+        one-way replication would silently undo the whole p_g_os memory
+        plan for the rest of the run."""
+        if convert2cpu:
+            import numpy as _np
+
+            return [_np.asarray(p._data) for p in self._layers.parameters()]
+        self._saved_shardings = {
+            id(p): p._data.sharding for p in self._layers.parameters()}
         for p in self._layers.parameters():
             p._data = jax.device_put(
                 p._data, NamedSharding(self._mesh, P()))
         return list(self._layers.parameters())
+
+    def reshard(self):
+        """Restore the stage-3 layouts recorded by get_all_parameters()."""
+        saved = getattr(self, "_saved_shardings", None)
+        if not saved:
+            return
+        for p in self._layers.parameters():
+            sh = saved.get(id(p))
+            if sh is not None:
+                p._data = jax.device_put(p._data, sh)
+        self._saved_shardings = None
 
 
 class GroupShardedScaler:
@@ -137,8 +211,8 @@ def save_group_sharded_model(model, output, optimizer=None):
     from ...framework import io as fio
 
     layers = model._layers if hasattr(model, "_layers") else model
-    if isinstance(model, GroupShardedStage3):
-        model.get_all_parameters()
+    # no device-side gather needed: np.asarray inside paddle.save fetches
+    # sharded arrays to host directly, leaving the p_g_os layouts intact
     _os.makedirs(output, exist_ok=True)
     fio.save(layers.state_dict(), _os.path.join(output, "model.pdparams"))
     if optimizer is not None:

@@ -1,0 +1,2 @@
+"""paddle.incubate parity — experimental/advanced features."""
+from . import distributed  # noqa: F401

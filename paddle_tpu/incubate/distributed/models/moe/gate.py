@@ -1,0 +1,106 @@
+"""MoE gates — naive / switch (top-1) / gshard (top-2).
+
+Reference parity: python/paddle/incubate/distributed/models/moe/gate/
+(naive_gate.py, switch_gate.py, gshard_gate.py). TPU-first: gates emit the
+GShard-paper einsum masks (dispatch [T,E,C] one-hots + combine weights)
+instead of per-rank index lists — position-in-expert comes from a cumsum,
+capacity overflow drops fall out of a one_hot over positions >= C, and the
+whole thing is jit/GSPMD friendly (no dynamic shapes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def _positions_in_expert(expert_idx, num_experts, mask=None):
+    """Running count of tokens per expert -> each token's slot index.
+
+    expert_idx: [T] int; mask: [T] 0/1 (tokens already dropped).
+    """
+    onehot = _one_hot(expert_idx, num_experts)       # [T, E]
+    if mask is not None:
+        onehot = onehot * mask[:, None]
+    pos = jnp.cumsum(onehot, axis=0) - onehot        # tokens before me
+    return jnp.sum(pos * onehot, axis=1).astype(jnp.int32), onehot
+
+
+def _aux_load_balance(probs, sel_onehot):
+    """GShard aux loss: E * mean(me * ce), me=mean prob per expert,
+    ce=fraction of tokens routed to expert (switch tranformer eq.4)."""
+    e = probs.shape[-1]
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(sel_onehot, axis=0)
+    return e * jnp.sum(me * ce)
+
+
+def top1_gating(logits, capacity):
+    """Switch gating. Returns (combine [T,E,C], dispatch [T,E,C] bool,
+    aux_loss)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)                  # [T]
+    gate = jnp.max(probs, axis=-1)                    # [T]
+    pos, onehot = _positions_in_expert(idx, logits.shape[-1])
+    keep = (pos < capacity).astype(probs.dtype)       # [T]
+    aux = _aux_load_balance(probs, onehot)
+    pos_onehot = _one_hot(pos, capacity, probs.dtype)          # [T, C]
+    dispatch = onehot[:, :, None] * pos_onehot[:, None, :]     # [T,E,C]
+    dispatch = dispatch * keep[:, None, None]
+    combine = dispatch * gate[:, None, None]
+    return combine, dispatch.astype(bool), aux
+
+
+def top2_gating(logits, capacity):
+    """GShard top-2 gating with normalized weights."""
+    e = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = _one_hot(idx1, e)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    g2 = jnp.sum(probs2 * _one_hot(idx2, e), axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    pos1, onehot1 = _positions_in_expert(idx1, e)
+    keep1 = (pos1 < capacity).astype(probs.dtype)
+    # second choices queue BEHIND all first choices in each expert
+    count1 = jnp.sum(onehot1, axis=0)                 # [E]
+    onehot2 = _one_hot(idx2, e)
+    pos2_rel = jnp.cumsum(onehot2, axis=0) - onehot2
+    pos2 = jnp.sum((pos2_rel + count1[None, :]) * onehot2,
+                   axis=1).astype(jnp.int32)
+    keep2 = (pos2 < capacity).astype(probs.dtype)
+
+    aux = _aux_load_balance(probs, onehot1)
+
+    d1 = onehot1[:, :, None] * _one_hot(pos1, capacity, probs.dtype)[:, None, :]
+    d1 = d1 * keep1[:, None, None]
+    d2 = onehot2[:, :, None] * _one_hot(pos2, capacity, probs.dtype)[:, None, :]
+    d2 = d2 * keep2[:, None, None]
+    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+    dispatch = (d1 + d2) > 0
+    return combine, dispatch, aux
+
+
+class NaiveGate:
+    """Linear router (reference naive_gate.py). `kind` picks the gating
+    math applied to its logits."""
+
+    top_k = 2
+
+    def __init__(self, kind="gshard"):
+        if kind not in ("gshard", "switch", "naive"):
+            raise ValueError(f"unknown gate {kind!r}")
+        self.kind = kind
+        self.top_k = 1 if kind == "switch" else 2
+
+    def __call__(self, logits, capacity):
+        if self.kind == "switch":
+            return top1_gating(logits, capacity)
+        return top2_gating(logits, capacity)

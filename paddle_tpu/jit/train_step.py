@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..framework import random as _random
+from ..profiler import RecordEvent
 
 
 def _tree_data(x):
@@ -176,7 +177,8 @@ class TrainStep:
         state = self._extract_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         try:
-            loss_data, new_state = self._jitted(state, lr, batch_data)
+            with RecordEvent("TrainStep"):
+                loss_data, new_state = self._jitted(state, lr, batch_data)
         except Exception:
             # a tracing error leaves tracers bound in the live objects;
             # restore the concrete state so the model stays usable

@@ -1,0 +1,361 @@
+"""paddle.profiler parity — host event recorder + XLA device traces.
+
+Reference: python/paddle/profiler/profiler.py:358 (Profiler with
+scheduler/on_trace_ready), :227 (export_chrome_tracing), :592/:641
+(start/stop); RecordEvent annotations (python/paddle/profiler/utils.py);
+host event collection (paddle/fluid/platform/profiler/host_event_recorder.h).
+
+TPU-first split of responsibilities:
+- *Host side*: a lightweight in-process event recorder (RecordEvent spans +
+  per-step marks) — the analog of HostEventRecorder; exported as
+  chrome-trace JSON and summarized in `summary()`.
+- *Device side*: `jax.profiler` traces (XLA/TPU timeline, HLO cost, memory
+  viewer) written to the same directory when device tracing is requested —
+  CUPTI's job (cuda_tracer.cc) is done by the XLA/TSL profiler.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+__all__ = [
+    "ProfilerState", "ProfilerTarget", "Profiler", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # last RECORD step of a cycle: trace is handed off
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+@dataclass
+class _HostEvent:
+    name: str
+    start_ns: int
+    end_ns: int
+    tid: int
+    step: Optional[int]
+
+
+@dataclass
+class _ProfileResult:
+    """What on_trace_ready receives; also returned by Profiler.stop()."""
+
+    events: list = field(default_factory=list)
+    steps: list = field(default_factory=list)  # (step_idx, start_ns, end_ns)
+    device_trace_dir: Optional[str] = None
+
+    def chrome_trace(self) -> dict:
+        evts = []
+        for e in self.events:
+            evts.append({
+                "name": e.name, "ph": "X", "cat": "host",
+                "ts": e.start_ns / 1e3, "dur": (e.end_ns - e.start_ns) / 1e3,
+                "pid": 0, "tid": e.tid,
+            })
+        for idx, s, t in self.steps:
+            evts.append({
+                "name": f"ProfileStep#{idx}", "ph": "X", "cat": "step",
+                "ts": s / 1e3, "dur": (t - s) / 1e3, "pid": 0, "tid": 0,
+            })
+        return {"traceEvents": evts, "displayTimeUnit": "ms"}
+
+
+class _HostEventRecorder:
+    """Process-global span recorder (host_event_recorder.h analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: list = []
+        self.enabled = False
+        self._step: Optional[int] = None
+
+    def record(self, name, start_ns, end_ns):
+        if not self.enabled:
+            return
+        ev = _HostEvent(name, start_ns, end_ns,
+                        threading.get_ident() & 0xFFFF, self._step)
+        with self._lock:
+            self._events.append(ev)
+
+    def drain(self):
+        with self._lock:
+            out, self._events = self._events, []
+        return out
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """User annotation span (reference profiler/utils.py RecordEvent).
+
+    Usable as a context manager or begin()/end() pair. Also emits a
+    `jax.profiler.TraceAnnotation` so the span shows up inside the XLA
+    device timeline when device tracing is on.
+    """
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._jax_ctx = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        if _recorder.enabled:
+            try:
+                import jax.profiler as jp
+
+                self._jax_ctx = jp.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None
+        return self
+
+    def end(self):
+        if self._t0 is None:
+            return
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+            self._jax_ctx = None
+        _recorder.record(self.name, self._t0, time.perf_counter_ns())
+        self._t0 = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Reference profiler.py make_scheduler: per-step state machine
+    [skip_first][closed][ready][record ... RECORD_AND_RETURN], repeating."""
+    cycle = closed + ready + record
+    if record <= 0 or cycle <= 0:
+        raise ValueError("record must be > 0")
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s // cycle >= repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD  # record everything; RETURN on stop()
+
+
+def export_chrome_tracing(dir_name: str,
+                          worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready handler writing chrome://tracing JSON
+    (reference profiler.py:227)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        worker = worker_name or f"host_{os.getpid()}"
+        n = getattr(prof, "_export_count", 0)
+        prof._export_count = n + 1
+        fname = os.path.join(dir_name, f"{worker}_time_{n}.paddle_trace.json")
+        with open(fname, "w") as f:
+            json.dump(prof._last_result.chrome_trace(), f)
+        prof._last_export_path = fname
+        return fname
+
+    return handler
+
+
+def load_profiler_result(file_name: str) -> dict:
+    with open(file_name) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """Reference profiler.py:358.
+
+    Args:
+      targets: iterable of ProfilerTarget; including TPU/GPU turns on the
+        XLA device tracer (`jax.profiler.start_trace`).
+      scheduler: ``(start, end)`` tuple or a ``make_scheduler`` callable.
+      on_trace_ready: callable(prof) fired at every RECORD_AND_RETURN step
+        and at stop(); default exports chrome tracing to ./profiler_log.
+      timer_only: host step timing only — never touches the device tracer.
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 trace_dir: str = "profiler_log", timer_only: bool = False):
+        targets = list(targets) if targets is not None else [
+            ProfilerTarget.CPU]
+        self.targets = targets
+        if scheduler is None:
+            self._scheduler = _default_scheduler
+        elif callable(scheduler):
+            self._scheduler = scheduler
+        else:
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        self.on_trace_ready = on_trace_ready or export_chrome_tracing(
+            trace_dir)
+        self.timer_only = timer_only
+        self._trace_dir = trace_dir
+        self._device_on = (not timer_only) and any(
+            t in (ProfilerTarget.TPU, ProfilerTarget.GPU,
+                  ProfilerTarget.CUSTOM_DEVICE) for t in targets)
+        self.current_state = ProfilerState.CLOSED
+        self._step = 0
+        self._step_start_ns = None
+        self._steps: list = []
+        self._device_tracing = False
+        self._last_result = _ProfileResult()
+        self._last_export_path = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        self.current_state = self._scheduler(self._step)
+        self._apply_state()
+        self._step_start_ns = time.perf_counter_ns()
+        return self
+
+    def stop(self):
+        self._mark_step_end()
+        # finish only a cycle that was actually recording — otherwise a
+        # CLOSED tail (scheduler exhausted) would clobber the completed
+        # cycle's result with an empty one and double-fire on_trace_ready
+        if _recorder.enabled:
+            self._finish_cycle()
+        self._stop_device()
+        _recorder.enabled = False
+        self.current_state = ProfilerState.CLOSED
+        return self._last_result
+
+    def step(self):
+        """Advance the step counter (call once per training iteration)."""
+        self._mark_step_end()
+        prev = self.current_state
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            self._finish_cycle()
+        self._step += 1
+        self.current_state = self._scheduler(self._step)
+        self._apply_state()
+        self._step_start_ns = time.perf_counter_ns()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- internals ------------------------------------------------------
+    def _apply_state(self):
+        rec = self.current_state in (ProfilerState.RECORD,
+                                     ProfilerState.RECORD_AND_RETURN)
+        _recorder.enabled = rec
+        _recorder._step = self._step
+        if rec and self._device_on and not self._device_tracing:
+            try:
+                import jax.profiler as jp
+
+                os.makedirs(self._trace_dir, exist_ok=True)
+                jp.start_trace(self._trace_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+        elif not rec:
+            self._stop_device()
+
+    def _stop_device(self):
+        if self._device_tracing:
+            try:
+                import jax.profiler as jp
+
+                jp.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    def _mark_step_end(self):
+        if self._step_start_ns is not None:
+            self._steps.append((self._step, self._step_start_ns,
+                                time.perf_counter_ns()))
+            self._step_start_ns = None
+
+    def _finish_cycle(self):
+        self._last_result = _ProfileResult(
+            events=_recorder.drain(), steps=list(self._steps),
+            device_trace_dir=self._trace_dir if self._device_on else None)
+        self._steps = []
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    # -- reporting ------------------------------------------------------
+    def summary(self, sorted_by: str = "total", max_rows: int = 50) -> str:
+        """Host-event statistical table
+        (profiler_statistic.py's role, host side)."""
+        res = self._last_result
+        agg = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [count, total, max]
+        for e in res.events:
+            a = agg[e.name]
+            dur = (e.end_ns - e.start_ns) / 1e6
+            a[0] += 1
+            a[1] += dur
+            a[2] = max(a[2], dur)
+        col = {"total": 1, "calls": 0, "max": 2, "avg": 1}.get(sorted_by, 1)
+        if sorted_by == "avg":
+            keyf = lambda kv: -(kv[1][1] / kv[1][0])  # noqa: E731
+        else:
+            keyf = lambda kv: -kv[1][col]  # noqa: E731
+        rows = sorted(agg.items(), key=keyf)[:max_rows]
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"
+                 f"{'Avg(ms)':>12}{'Max(ms)':>12}"]
+        for name, (cnt, total, mx) in rows:
+            lines.append(f"{name[:40]:<40}{cnt:>8}{total:>12.3f}"
+                         f"{total / cnt:>12.3f}{mx:>12.3f}")
+        if res.steps:
+            durs = [(t - s) / 1e6 for _, s, t in res.steps]
+            lines.append(
+                f"\nSteps: {len(durs)}  avg {sum(durs) / len(durs):.3f} ms"
+                f"  min {min(durs):.3f}  max {max(durs):.3f}")
+        return "\n".join(lines)
+
+    @property
+    def step_times_ms(self):
+        return [(t - s) / 1e6 for _, s, t in self._last_result.steps]
+
+
+@contextlib.contextmanager
+def profile_step(name: str = "train_step"):
+    """Tiny convenience: time one span even with no Profiler active."""
+    t0 = time.perf_counter_ns()
+    yield
+    _recorder.record(name, t0, time.perf_counter_ns())

@@ -1,0 +1,30 @@
+"""Logging helper (reference python/paddle/base/log_helper.py).
+
+One shared formatter/config path so framework modules log consistently and
+user code can dial verbosity with ``GLOG_v``-style env control
+(``PADDLE_TPU_LOG_LEVEL`` here, matching the reference's glog verbosity).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+_DEFAULT_FMT = ("%(asctime)s - %(name)s - %(levelname)s: %(message)s")
+_configured = {}
+
+
+def get_logger(name: str, level=None, fmt: str = _DEFAULT_FMT
+               ) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if name in _configured:
+        return logger
+    if level is None:
+        env = os.environ.get("PADDLE_TPU_LOG_LEVEL", "INFO").upper()
+        level = getattr(logging, env, logging.INFO)
+    logger.setLevel(level)
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(fmt))
+    logger.addHandler(handler)
+    logger.propagate = False
+    _configured[name] = True
+    return logger

@@ -1,0 +1,91 @@
+"""Profiler tests (reference profiler.py:358 semantics, host side)."""
+import json
+import os
+import time
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+    export_chrome_tracing, make_scheduler, load_profiler_result,
+)
+
+
+class TestScheduler:
+    def test_state_machine(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                               skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states == [
+            ProfilerState.CLOSED,   # skip_first
+            ProfilerState.CLOSED,
+            ProfilerState.READY,
+            ProfilerState.RECORD,
+            ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.CLOSED,   # repeat exhausted
+        ]
+
+    def test_tuple_scheduler(self):
+        p = Profiler(scheduler=(1, 3), on_trace_ready=lambda prof: None)
+        p.start()
+        assert p.current_state == ProfilerState.CLOSED
+        p.step()
+        assert p.current_state == ProfilerState.RECORD
+        p.step()
+        assert p.current_state == ProfilerState.RECORD_AND_RETURN
+        p.step()
+        assert p.current_state == ProfilerState.CLOSED
+        p.stop()
+
+
+class TestRecordEvent:
+    def test_events_captured_and_summary(self, tmp_path):
+        traces = []
+        p = Profiler(on_trace_ready=lambda prof: traces.append(
+            prof._last_result))
+        p.start()
+        with RecordEvent("forward"):
+            time.sleep(0.002)
+        with RecordEvent("backward"):
+            time.sleep(0.001)
+        p.step()
+        with RecordEvent("forward"):
+            time.sleep(0.002)
+        p.stop()
+        res = traces[-1]
+        names = [e.name for e in res.events]
+        assert names.count("forward") == 2 and "backward" in names
+        s = p.summary()
+        assert "forward" in s and "Steps: 2" in s
+
+    def test_not_recorded_when_closed(self):
+        with RecordEvent("orphan"):
+            pass
+        p = Profiler(on_trace_ready=lambda prof: None)
+        p.start()
+        p.stop()
+        assert all(e.name != "orphan" for e in p._last_result.events)
+
+
+class TestChromeExport:
+    def test_export_and_load(self, tmp_path):
+        d = str(tmp_path / "trace")
+        p = Profiler(on_trace_ready=export_chrome_tracing(d))
+        p.start()
+        with RecordEvent("matmul"):
+            time.sleep(0.001)
+        p.stop()
+        assert p._last_export_path and os.path.exists(p._last_export_path)
+        data = load_profiler_result(p._last_export_path)
+        names = [e["name"] for e in data["traceEvents"]]
+        assert "matmul" in names
+        assert any(n.startswith("ProfileStep#") for n in names)
+
+    def test_step_times(self):
+        p = Profiler(on_trace_ready=lambda prof: None)
+        p.start()
+        time.sleep(0.001)
+        p.step()
+        time.sleep(0.001)
+        p.stop()
+        assert len(p.step_times_ms) == 2
+        assert all(t > 0 for t in p.step_times_ms)
