@@ -1,0 +1,107 @@
+"""Ring attention — exact long-context attention over a sequence-parallel
+mesh axis.
+
+Beyond-reference (SURVEY.md §5.7): the reference snapshot has only SEP
+data-style sequence sharding (segment_parallel.py:26) and Megatron-SP; it
+has NO ring/blockwise context parallelism. Here each device holds one
+sequence block of q/k/v; k/v blocks rotate around the ring via
+`ppermute` while an online-softmax accumulator (flash-attention math)
+folds in one block per tick — memory O(seq/n) per device, comms riding
+the ICI ring, and compute/transfer overlapped by XLA. The backward is the
+reverse ring, derived by jax AD through the scan + ppermute (no
+hand-written p2p bookkeeping).
+
+Layout contract: q/k/v are [batch, seq, heads, head_dim] global arrays
+sharded P(None, axis) on the sequence dim (SegmentParallel's layout).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30  # finite mask value: keeps exp/where AD clean vs real -inf
+
+
+def _block_attend(q, k, v, row0, col0, scale, causal):
+    """One q-block × kv-block flash step.
+
+    q: [b, sq, h, d], k/v: [b, sk, h, d]; row0/col0: global offsets of the
+    blocks on the sequence axis. Returns (scores_max m [b,h,sq], partial
+    numerator acc [b,sq,h,d], partial denominator l [b,h,sq]).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: m == NEG_INF -> p would be exp(0)=1; zero them
+    alive = (m > _NEG_INF / 2)[..., None]
+    p = jnp.where(alive, p, 0.0)
+    l = jnp.sum(p, axis=-1)                                   # [b,h,q]
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m, acc, l
+
+
+def ring_attention(q, k, v, *, mesh, axis="sep", causal=True, scale=None):
+    """Exact attention with q/k/v sequence-sharded over `axis`.
+
+    Returns [batch, seq, heads, head_dim] with the same sharding as q.
+    Differentiable (AD reverses the ring). Requires seq % mesh.shape[axis]
+    == 0.
+    """
+    b, s, h, d = q.shape
+    n = int(mesh.shape[axis])
+    if s % n:
+        raise ValueError(f"ring size {n} must divide seq {s}")
+    blk = s // n
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(qb, kb, vb):
+        # local blocks [b, blk, h, d]; manual over `axis` only
+        idx = jax.lax.axis_index(axis)
+        row0 = idx * blk
+
+        m0 = jnp.full((b, h, blk), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, blk), jnp.float32)
+        a0 = jnp.zeros((b, blk, h, d), jnp.float32)
+
+        def tick(carry, t):
+            m_run, l_run, acc_run, kv = carry
+            kt, vt = kv
+            src = (idx - t) % n             # whose block we hold this tick
+            m_b, acc_b, l_b = _block_attend(qb, kt, vt, row0, src * blk,
+                                            scale, causal)
+            m_new = jnp.maximum(m_run, m_b)
+            c_run = jnp.exp(m_run - m_new)      # [b,h,q]
+            c_b = jnp.exp(m_b - m_new)
+            l_new = l_run * c_run + l_b * c_b
+            acc_new = (acc_run * jnp.transpose(c_run, (0, 2, 1))[..., None]
+                       + acc_b * jnp.transpose(c_b, (0, 2, 1))[..., None])
+            kv = jax.lax.ppermute((kt, vt), axis, perm)
+            return (m_new, l_new, acc_new, kv), None
+
+        (m_f, l_f, acc_f, _), _ = jax.lax.scan(
+            tick, (m0, l0, a0, (kb, vb)), jnp.arange(n))
+        l_safe = jnp.maximum(l_f, 1e-30)
+        out = acc_f / jnp.transpose(l_safe, (0, 2, 1))[..., None]
+        return out.astype(qb.dtype)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )(q, k, v)
+
+
+def sep_sharding(mesh, axis="sep"):
+    """The NamedSharding ring_attention expects on q/k/v."""
+    return NamedSharding(mesh, P(None, axis, None, None))

@@ -40,6 +40,10 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     use_recompute: bool = False
+    # long-context: route attention through the sep-axis ppermute ring
+    # (meta_parallel/ring_attention.py) instead of GSPMD's k/v all-gather —
+    # O(seq/n) activation memory per device on a sep mesh
+    use_ring_attention: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -73,6 +77,28 @@ class GPTAttention(nn.Layer):
         self.qkv = nn.Linear(h, 3 * h)
         self.out_proj = nn.Linear(h, h)
         self.dropout_p = config.attention_dropout_prob
+        self._use_ring = config.use_ring_attention
+
+    def _ring_mesh(self):
+        if not self._use_ring:
+            return None
+        from ..distributed import env as denv
+
+        if not denv.is_initialized():
+            return None
+        mesh = denv.get_mesh()
+        if "sep" in mesh.axis_names and mesh.shape["sep"] > 1:
+            return mesh
+        return None
+
+    def _ring_attention(self, q, k, v, mesh):
+        from ..distributed.fleet.meta_parallel import ring_attention
+        from ..framework.autograd import apply_op
+
+        return apply_op(
+            lambda qq, kk, vv: ring_attention(qq, kk, vv, mesh=mesh,
+                                              causal=True),
+            [q, k, v], name="ring_attention")
 
     def forward(self, x):
         b, s, h = x.shape
@@ -81,10 +107,19 @@ class GPTAttention(nn.Layer):
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]                               # [b, s, nh, hd]
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.dropout_p,
-            training=self.training,
-        )                                               # [b, s, nh, hd]
+        ring_mesh = self._ring_mesh()
+        # ring requirements: seq divisible by the ring, and no attention
+        # dropout (the ring kernel has no dropout plumbing) — otherwise
+        # fall back to the dense path rather than diverge or crash
+        drop_active = self.dropout_p > 0.0 and self.training
+        if (ring_mesh is not None and not drop_active
+                and s % int(ring_mesh.shape["sep"]) == 0):
+            out = self._ring_attention(q, k, v, ring_mesh)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.dropout_p,
+                training=self.training,
+            )                                           # [b, s, nh, hd]
         out = out.reshape([b, s, h])
         return self.out_proj(out)
 

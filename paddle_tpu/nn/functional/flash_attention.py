@@ -104,8 +104,52 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
-                        causal=False, return_softmax=False, name=None):
-    raise NotImplementedError("varlen flash attention lands with the pallas kernel pack")
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen ("unpadded") attention parity (reference flash_attention.py's
+    flash_attn_unpadded over flash_attn_varlen CUDA kernels).
+
+    q/k/v: [total_tokens, num_heads, head_dim] — sequences packed back to
+    back; cu_seqlens_*: [batch+1] cumulative boundaries. TPU-first: instead
+    of ragged kernels, segment-id masking — one dense masked attention with
+    static shapes (block-diagonal over segments, causal within a segment),
+    which XLA fuses like any other attention. Returns (out, None).
+    """
+    query = ensure_tensor(query)
+    key_t = ensure_tensor(key)
+    value = ensure_tensor(value)
+    cu_q = ensure_tensor(cu_seqlens_q, dtype="int32")
+    cu_k = ensure_tensor(cu_seqlens_k, dtype="int32")
+    drop = float(dropout) if training else 0.0
+    rng = next_key() if drop > 0.0 else None
+
+    def f(q, k, v, cq, ck):
+        tq, tk = q.shape[0], k.shape[0]
+        iq = jnp.arange(tq, dtype=jnp.int32)
+        ik = jnp.arange(tk, dtype=jnp.int32)
+        seg_q = jnp.searchsorted(cq, iq, side="right")      # [tq] 1-based
+        seg_k = jnp.searchsorted(ck, ik, side="right")
+        pos_q = iq - cq[seg_q - 1]                          # pos in own seq
+        pos_k = ik - ck[seg_k - 1]
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        logits = jnp.einsum("qhd,khd->hqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask[None], logits, jnp.float32(-jnp.inf))
+        probs = jax.nn.softmax(logits, axis=-1)
+        # rows whose segment is empty (shouldn't happen) -> nan guard
+        probs = jnp.where(jnp.any(mask, axis=1)[None, :, None], probs, 0.0)
+        if drop > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - drop, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - drop), 0.0)
+        out = jnp.einsum("hqk,khd->qhd", probs.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        return out.astype(q.dtype)
+
+    out = nary(f, [query, key_t, value, cu_q, cu_k], "flash_attn_unpadded")
+    return out, None
 
 
 def sparse_attention(*args, **kwargs):

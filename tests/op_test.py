@@ -1,0 +1,142 @@
+"""OpTest harness — numpy-reference forward + finite-difference grad checks.
+
+Reference parity: test/legacy_test/op_test.py:418 (check_output :2910,
+check_grad :3114) — a declarative base: subclasses provide the op callable,
+example inputs, and a numpy reference; the harness sweeps dtypes (fp32 +
+bf16) and verifies analytic tape gradients against central differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import Tensor
+
+_DEFAULT_TOL = {
+    "float32": dict(rtol=1e-5, atol=1e-6),
+    "bfloat16": dict(rtol=2e-2, atol=2e-2),
+    "float64": dict(rtol=1e-12, atol=1e-12),
+    "int64": dict(rtol=0, atol=0),
+    "int32": dict(rtol=0, atol=0),
+    "bool": dict(rtol=0, atol=0),
+}
+
+
+def _to_numpy(t):
+    d = t._data if isinstance(t, Tensor) else t
+    if str(d.dtype) == "bfloat16":
+        return np.asarray(d.astype(jnp.float32))
+    return np.asarray(d)
+
+
+class OpTest:
+    """Subclass contract::
+
+        class TestSoftmax(OpTest):
+            def op(self, x):            # the paddle_tpu op under test
+                return paddle.nn.functional.softmax(x, axis=-1)
+            def ref(self, x):           # numpy reference
+                e = np.exp(x - x.max(-1, keepdims=True))
+                return e / e.sum(-1, keepdims=True)
+            def inputs(self, rng):      # example inputs (numpy, float32)
+                return [rng.standard_normal((4, 8)).astype("float32")]
+
+    Then ``check_output()`` sweeps fp32+bf16 and ``check_grad()`` verifies
+    tape grads vs central differences on fp32.
+    """
+
+    dtypes = ("float32", "bfloat16")
+    seed = 0
+    tols = {}
+
+    # -- subclass surface ----------------------------------------------
+    def op(self, *args):
+        raise NotImplementedError
+
+    def ref(self, *args):
+        raise NotImplementedError
+
+    def inputs(self, rng):
+        raise NotImplementedError
+
+    # -- checks ---------------------------------------------------------
+    def _tol(self, dtype):
+        base = dict(_DEFAULT_TOL.get(dtype, _DEFAULT_TOL["float32"]))
+        base.update(self.tols.get(dtype, {}))
+        return base
+
+    def check_output(self):
+        rng = np.random.default_rng(self.seed)
+        np_args = self.inputs(rng)
+        expect = self.ref(*[a.copy() for a in np_args])
+        expect = expect if isinstance(expect, (tuple, list)) else [expect]
+        for dtype in self.dtypes:
+            args = []
+            for a in np_args:
+                if np.issubdtype(a.dtype, np.floating) and dtype != "float32":
+                    args.append(paddle.to_tensor(a, dtype=dtype))
+                else:
+                    args.append(paddle.to_tensor(a))
+            got = self.op(*args)
+            got = got if isinstance(got, (tuple, list)) else [got]
+            tol = self._tol(dtype)
+            for g, e in zip(got, expect):
+                np.testing.assert_allclose(
+                    _to_numpy(g), np.asarray(e, np.float32)
+                    if np.issubdtype(np.asarray(e).dtype, np.floating)
+                    else e,
+                    err_msg=f"dtype={dtype}", **tol)
+
+    def check_grad(self, wrt=(0,), eps=1e-3, rtol=5e-3, atol=5e-4,
+                   max_probe=24):
+        """Analytic tape grad of sum(op(...)) vs central differences at
+        `max_probe` randomly sampled coordinates per input."""
+        rng = np.random.default_rng(self.seed + 1)
+        np_args = [a.astype("float64")
+                   if np.issubdtype(a.dtype, np.floating) else a
+                   for a in self.inputs(rng)]
+
+        tensors = [paddle.to_tensor(a.astype("float32"), stop_gradient=False)
+                   if np.issubdtype(a.dtype, np.floating)
+                   else paddle.to_tensor(a)
+                   for a in np_args]
+        out = self.op(*tensors)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        loss = None
+        for o in outs:
+            s = o.sum()
+            loss = s if loss is None else loss + s
+        loss.backward()
+
+        def f(args64):
+            t = [paddle.to_tensor(a.astype("float32"))
+                 if np.issubdtype(np.asarray(a).dtype, np.floating)
+                 else paddle.to_tensor(a) for a in args64]
+            with paddle.autograd.no_grad():
+                o = self.op(*t)
+            os_ = o if isinstance(o, (tuple, list)) else [o]
+            return float(sum(float(x.sum()) for x in os_))
+
+        for i in wrt:
+            g = tensors[i].grad
+            assert g is not None, f"no grad for input {i}"
+            g = _to_numpy(g)
+            a = np_args[i]
+            flat_idx = rng.choice(a.size, size=min(max_probe, a.size),
+                                  replace=False)
+            for fi in flat_idx:
+                idx = np.unravel_index(fi, a.shape)
+                orig = a[idx]
+                a[idx] = orig + eps
+                fp = f(np_args)
+                a[idx] = orig - eps
+                fm = f(np_args)
+                a[idx] = orig
+                fd = (fp - fm) / (2 * eps)
+                ana = g[idx]
+                np.testing.assert_allclose(
+                    ana, fd, rtol=rtol, atol=atol,
+                    err_msg=f"input {i} coord {idx}: analytic {ana} "
+                            f"vs finite-diff {fd}")

@@ -1,0 +1,179 @@
+"""Ring attention + SEP tests — the beyond-reference long-context path
+(SURVEY.md §5.7). Parity contract: ring == full attention, fwd and grad.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ring_attention, sep_sharding,
+)
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices("cpu")[:n]), ("sep",))
+
+
+def _full_attention(q, k, v, causal, scale=None):
+    d = q.shape[-1]
+    scale = scale or 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[2], s.shape[3]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(
+        q.dtype)
+
+
+def _qkv(b=2, s=32, h=2, d=8, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("n,causal", [(2, True), (4, True),
+                                          (2, False), (4, False)])
+    def test_matches_full(self, n, causal):
+        mesh = _mesh(n)
+        q, k, v = _qkv(seed=n)
+        sh = sep_sharding(mesh)
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        out = ring_attention(qs, ks, vs, mesh=mesh, causal=causal)
+        ref = _full_attention(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        # output keeps the seq sharding
+        assert out.sharding.spec == P(None, "sep", None, None)
+
+    def test_grads_match_full(self):
+        mesh = _mesh(4)
+        q, k, v = _qkv(seed=7)
+        sh = sep_sharding(mesh)
+
+        def loss_ring(q, k, v):
+            o = ring_attention(jax.device_put(q, sh), jax.device_put(k, sh),
+                               jax.device_put(v, sh), mesh=mesh, causal=True)
+            return jnp.sum(jnp.sin(o))
+
+        def loss_full(q, k, v):
+            return jnp.sum(jnp.sin(_full_attention(q, k, v, True)))
+
+        g_ring = jax.grad(loss_ring, (0, 1, 2))(q, k, v)
+        g_full = jax.grad(loss_full, (0, 1, 2))(q, k, v)
+        for gr, gf in zip(g_ring, g_full):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                       atol=3e-5)
+
+    def test_under_jit(self):
+        mesh = _mesh(2)
+        q, k, v = _qkv(seed=9)
+        sh = sep_sharding(mesh)
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        f = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh=mesh,
+                                                   causal=True))
+        np.testing.assert_allclose(
+            np.asarray(f(qs, ks, vs)),
+            np.asarray(_full_attention(q, k, v, True)), atol=2e-5)
+
+    def test_bad_seq_raises(self):
+        mesh = _mesh(4)
+        q, k, v = _qkv(s=30)
+        with pytest.raises(ValueError):
+            ring_attention(q, k, v, mesh=mesh)
+
+
+class TestSegmentParallel:
+    def test_sep_wrapper_parity(self):
+        """SEP-wrapped GPT forward/backward == unwrapped (GSPMD handles the
+        seq-sharded attention resharding; reference segment_parallel.py:26)."""
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.distributed.fleet.meta_parallel import SegmentParallel
+        from paddle_tpu.distributed.fleet.topology import (
+            CommunicateTopology, HybridCommunicateGroup,
+        )
+        from paddle_tpu.models import (
+            GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+        )
+
+        try:
+            cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_attention_heads=4,
+                            max_position_embeddings=32,
+                            hidden_dropout_prob=0.0,
+                            attention_dropout_prob=0.0)
+            paddle.seed(21)
+            plain = GPTForCausalLM(cfg)
+            crit = GPTPretrainingCriterion()
+            rng = np.random.default_rng(5)
+            ids = paddle.to_tensor(rng.integers(0, 64, (2, 32)),
+                                   dtype="int64")
+            labels = paddle.to_tensor(rng.integers(0, 64, (2, 32)),
+                                      dtype="int64")
+            ref_loss = crit(plain(ids), labels)
+            ref_loss.backward()
+            ref_grad = np.asarray(
+                dict(plain.named_parameters())["gpt.wte.weight"].grad._data)
+            for p in plain.parameters():
+                p.clear_grad()
+
+            topo = CommunicateTopology(
+                hybrid_group_names=["data", "pipe", "sharding", "sep",
+                                    "model"],
+                dims=[1, 1, 1, 4, 1])
+            hcg = HybridCommunicateGroup(topo)
+            denv.set_mesh(hcg.mesh)
+            sep_model = SegmentParallel(plain, hcg)
+            loss = crit(sep_model(ids), labels)
+            np.testing.assert_allclose(float(loss), float(ref_loss),
+                                       rtol=1e-5)
+            loss.backward()
+            got = dict(plain.named_parameters())["gpt.wte.weight"].grad
+            np.testing.assert_allclose(np.asarray(got._data), ref_grad,
+                                       atol=1e-5)
+        finally:
+            denv._state["initialized"] = False
+            denv._state["mesh"] = None
+
+
+class TestGPTRingAttention:
+    def test_gpt_with_ring_matches_plain(self):
+        """GPT with use_ring_attention on a sep mesh == plain GPT."""
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.models import (
+            GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+        )
+
+        try:
+            kw = dict(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_attention_heads=4, max_position_embeddings=32,
+                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+            paddle.seed(31)
+            plain = GPTForCausalLM(GPTConfig(**kw))
+            paddle.seed(31)
+            ringed = GPTForCausalLM(GPTConfig(use_ring_attention=True, **kw))
+            crit = GPTPretrainingCriterion()
+            rng = np.random.default_rng(6)
+            ids = paddle.to_tensor(rng.integers(0, 64, (2, 32)),
+                                   dtype="int64")
+            labels = paddle.to_tensor(rng.integers(0, 64, (2, 32)),
+                                      dtype="int64")
+            denv.set_mesh(denv.build_mesh({"sep": 4}))
+            l_ring = crit(ringed(ids), labels)
+            l_plain = crit(plain(ids), labels)
+            np.testing.assert_allclose(float(l_ring), float(l_plain),
+                                       rtol=1e-5)
+            l_ring.backward()
+            g = dict(ringed.named_parameters())[
+                "gpt.blocks.0.attn.qkv.weight"].grad
+            assert g is not None
+        finally:
+            denv._state["initialized"] = False
+            denv._state["mesh"] = None
