@@ -34,7 +34,9 @@ def main():
 
     cfg = gpt_config(model_name, max_position_embeddings=seq,
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                     use_recompute=os.environ.get("BENCH_RECOMPUTE", "1") == "1")
+                     use_recompute=os.environ.get("BENCH_RECOMPUTE", "1") == "1",
+                     recompute_policy=os.environ.get("BENCH_REMAT_POLICY",
+                                                     "dots") or None)
     model = GPTForCausalLM(cfg)
     # bf16 params + fp32 master weights — the TPU-native AMP O2 layout
     model.bfloat16()
@@ -63,11 +65,26 @@ def main():
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt
+
+    # MFU: model flops per token = 6N (fwd+bwd matmuls) + attention
+    # 12*L*h*s (QK^T + PV, fwd+bwd, causal ~halves but count full per
+    # PaLM-appendix convention); peak from the chip generation.
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    peaks = {"v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12,
+             "v4": 275e12, "v6e": 918e12}
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    peak = next((v for k, v in peaks.items() if gen.startswith(k)), 197e12)
+    mfu = tokens_per_sec * flops_per_token / peak
     print(json.dumps({
         "metric": f"{model_name}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": None,
+        "mfu": round(mfu, 4),
+        "config": {"batch": batch, "seq": seq, "steps": steps,
+                   "params": n_params,
+                   "recompute": cfg.use_recompute},
     }))
 
 

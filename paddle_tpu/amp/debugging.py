@@ -89,6 +89,64 @@ def disable_tensor_checker():
     flags.set_flags({"FLAGS_check_nan_inf": False})
 
 
+def dump_tensor(name, tensor, dump_path):
+    """Record a tensor for later compare_accuracy (the role of the
+    reference's workerlog tensor dumps). One .npy per name, fp32 upcast."""
+    import os
+
+    os.makedirs(dump_path, exist_ok=True)
+    data = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    safe = name.replace("/", "_").replace(".", "_")
+    np.save(os.path.join(dump_path, f"{safe}.npy"),
+            np.asarray(data.astype(jnp.float32)
+                       if jnp.issubdtype(data.dtype, jnp.floating)
+                       else data))
+
+
 def compare_accuracy(dump_path, another_dump_path, output_filename,
                      loss_scale=1, dump_all_tensors=False):
-    raise NotImplementedError("accuracy-compare tooling lands in a later round")
+    """Reference amp/debugging.py compare_accuracy: diff two runs' tensor
+    dumps (e.g. fp32 vs amp) and write a CSV report. Returns the rows.
+
+    Each dump dir holds .npy files written by `dump_tensor`; rows report
+    max abs/rel error per common name, sorted worst-first.
+    """
+    import csv
+    import os
+
+    a_files = {f[:-4]: os.path.join(dump_path, f)
+               for f in os.listdir(dump_path) if f.endswith(".npy")}
+    b_files = {f[:-4]: os.path.join(another_dump_path, f)
+               for f in os.listdir(another_dump_path) if f.endswith(".npy")}
+    rows = []
+    for name in sorted(set(a_files) & set(b_files)):
+        a = np.load(a_files[name]).astype(np.float64)
+        b = np.load(b_files[name]).astype(np.float64) / float(loss_scale)
+        if a.shape != b.shape:
+            rows.append({"name": name, "shape_a": str(a.shape),
+                         "shape_b": str(b.shape), "max_abs_err": "",
+                         "max_rel_err": "", "note": "SHAPE MISMATCH"})
+            continue
+        abs_err = np.abs(a - b)
+        denom = np.maximum(np.abs(a), 1e-12)
+        rows.append({
+            "name": name, "shape_a": str(a.shape), "shape_b": str(b.shape),
+            "max_abs_err": float(abs_err.max()) if a.size else 0.0,
+            "max_rel_err": float((abs_err / denom).max()) if a.size else 0.0,
+            "note": "",
+        })
+    rows.sort(key=lambda r: -(r["max_abs_err"] or 0)
+              if isinstance(r["max_abs_err"], float) else 1)
+    only_a = sorted(set(a_files) - set(b_files))
+    only_b = sorted(set(b_files) - set(a_files))
+    with open(output_filename, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["name", "shape_a", "shape_b",
+                                          "max_abs_err", "max_rel_err",
+                                          "note"])
+        w.writeheader()
+        w.writerows(rows)
+        for name in only_a:
+            w.writerow({"name": name, "note": "ONLY IN RUN A"})
+        for name in only_b:
+            w.writerow({"name": name, "note": "ONLY IN RUN B"})
+    return rows

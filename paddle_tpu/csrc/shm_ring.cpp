@@ -1,0 +1,199 @@
+// SPSC shared-memory ring buffer — the DataLoader worker->parent tensor
+// transport.
+//
+// Reference parity: the reference moves worker batches through its C++
+// shared-memory path (paddle/fluid/imperative/data_loader.cc +
+// python/paddle/io/dataloader/worker.py's _convert_to_tensor over shared
+// memory). TPU build: one POSIX-shm ring per worker; the worker process is
+// the single producer, the parent loader the single consumer, so a
+// lock-free head/tail pair with acquire/release ordering suffices. Records
+// are length-prefixed byte blobs (pickle-5 metadata + raw ndarray bytes).
+//
+// Build: g++ -O2 -shared -fPIC -o _shm_ring.so shm_ring.cpp -lrt
+// Loaded via ctypes (paddle_tpu/io/shm_channel.py); a pure-Python fallback
+// keeps the loader functional when the native lib is unavailable.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHeader {
+  uint64_t capacity;               // data bytes (power of two not required)
+  std::atomic<uint64_t> head;      // next write offset (monotonic)
+  std::atomic<uint64_t> tail;      // next read offset (monotonic)
+  std::atomic<uint32_t> closed;    // producer hung up
+};
+
+struct Ring {
+  RingHeader* hdr;
+  uint8_t* data;
+  uint64_t map_len;
+  int owner;                       // created (vs attached): unlink on free
+  char name[256];
+};
+
+inline uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000u + ts.tv_nsec / 1000000u;
+}
+
+// copy with wrap-around
+void ring_write(Ring* r, uint64_t pos, const uint8_t* src, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (n < cap - off) ? n : cap - off;
+  memcpy(r->data + off, src, first);
+  if (n > first) memcpy(r->data, src + first, n - first);
+}
+
+void ring_read(Ring* r, uint64_t pos, uint8_t* dst, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (n < cap - off) ? n : cap - off;
+  memcpy(dst, r->data + off, first);
+  if (n > first) memcpy(dst + first, r->data, n - first);
+}
+
+Ring* map_ring(const char* name, int create, uint64_t capacity) {
+  int flags = create ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t map_len = sizeof(RingHeader) + capacity;
+  if (create) {
+    if (ftruncate(fd, (off_t)map_len) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < sizeof(RingHeader)) {
+      close(fd);
+      return nullptr;
+    }
+    map_len = (uint64_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring();
+  r->hdr = (RingHeader*)mem;
+  r->data = (uint8_t*)mem + sizeof(RingHeader);
+  r->map_len = map_len;
+  r->owner = create;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  if (create) {
+    r->hdr->capacity = capacity;
+    r->hdr->head.store(0, std::memory_order_relaxed);
+    r->hdr->tail.store(0, std::memory_order_relaxed);
+    r->hdr->closed.store(0, std::memory_order_relaxed);
+  }
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shm_ring_create(const char* name, uint64_t capacity) {
+  return map_ring(name, 1, capacity);
+}
+
+void* shm_ring_attach(const char* name) {
+  return map_ring(name, 0, 0);
+}
+
+// Push one length-prefixed record. Blocks (yielding) until space or
+// timeout_ms elapses. Returns 0 ok, -1 timeout, -2 closed/invalid.
+int shm_ring_push(void* ring, const uint8_t* buf, uint64_t n,
+                  uint64_t timeout_ms) {
+  Ring* r = (Ring*)ring;
+  if (!r) return -2;
+  uint64_t need = n + 8;
+  uint64_t cap = r->hdr->capacity;
+  if (need > cap) return -2;  // record larger than the whole ring
+  uint64_t deadline = now_ms() + timeout_ms;
+  for (;;) {
+    uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    if (cap - (head - tail) >= need) {
+      uint64_t len_le = n;  // little-endian on all supported targets
+      ring_write(r, head, (const uint8_t*)&len_le, 8);
+      ring_write(r, head + 8, buf, n);
+      r->hdr->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (r->hdr->closed.load(std::memory_order_relaxed)) return -2;
+    if (now_ms() >= deadline) return -1;
+    sched_yield();
+  }
+}
+
+// Peek next record's size. Returns size, 0 if empty, -2 if closed+drained.
+int64_t shm_ring_next_size(void* ring) {
+  Ring* r = (Ring*)ring;
+  if (!r) return -2;
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  if (head == tail) {
+    return r->hdr->closed.load(std::memory_order_acquire) ? -2 : 0;
+  }
+  uint64_t n;
+  ring_read(r, tail, (uint8_t*)&n, 8);
+  return (int64_t)n;
+}
+
+// Pop one record into out (caller sized it via shm_ring_next_size).
+// Returns 0 ok, -1 empty after timeout, -2 closed/invalid.
+int shm_ring_pop(void* ring, uint8_t* out, uint64_t out_cap,
+                 uint64_t timeout_ms) {
+  Ring* r = (Ring*)ring;
+  if (!r) return -2;
+  uint64_t deadline = now_ms() + timeout_ms;
+  for (;;) {
+    uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+    if (head != tail) {
+      uint64_t n;
+      ring_read(r, tail, (uint8_t*)&n, 8);
+      if (n > out_cap) return -2;
+      ring_read(r, tail + 8, out, n);
+      r->hdr->tail.store(tail + 8 + n, std::memory_order_release);
+      return 0;
+    }
+    if (r->hdr->closed.load(std::memory_order_acquire)) return -2;
+    if (now_ms() >= deadline) return -1;
+    sched_yield();
+  }
+}
+
+void shm_ring_close_producer(void* ring) {
+  Ring* r = (Ring*)ring;
+  if (r) r->hdr->closed.store(1, std::memory_order_release);
+}
+
+void shm_ring_free(void* ring) {
+  Ring* r = (Ring*)ring;
+  if (!r) return;
+  int owner = r->owner;
+  char name[256];
+  snprintf(name, sizeof(name), "%s", r->name);
+  munmap((void*)r->hdr, r->map_len);
+  if (owner) shm_unlink(name);
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,309 @@
+// TCPStore — socket key-value rendezvous for multi-host bring-up.
+//
+// Reference parity: paddle/phi/core/distributed/store/tcp_store.h /
+// tcp_utils.cc — rank 0 hosts the store; every rank SET/GET/ADD/WAITs
+// through it to exchange bootstrap blobs (the reference trades NCCL unique
+// ids; the TPU build trades coordinator addresses / launcher state — data
+// plane runs over ICI/DCN, this is control plane only).
+//
+// Design: single poll()-driven server thread, request/response per
+// connection-burst; misses return MISS and the *client* retries until its
+// deadline, so the server never blocks on any one rank.
+//
+// Wire format (little-endian):
+//   request:  u8 cmd {1=SET,2=GET,3=ADD,4=DEL} u32 klen, key,
+//             SET: u32 vlen, val | ADD: i64 delta | GET/DEL: -
+//   response: u8 status {0=OK,1=MISS} u32 vlen, val
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -o _tcp_store.so tcp_store.cpp
+//        -lpthread
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread th;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::map<std::string, std::string> kv;
+  int port = 0;
+};
+
+bool read_n(int fd, void* buf, size_t n) {
+  uint8_t* p = (uint8_t*)buf;
+  while (n) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  const uint8_t* p = (const uint8_t*)buf;
+  while (n) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+void reply(int fd, uint8_t status, const std::string& val) {
+  uint32_t vlen = (uint32_t)val.size();
+  write_n(fd, &status, 1);
+  write_n(fd, &vlen, 4);
+  if (vlen) write_n(fd, val.data(), vlen);
+}
+
+// one complete request on fd; false -> close connection
+bool handle(Server* s, int fd) {
+  uint8_t cmd;
+  uint32_t klen;
+  if (!read_n(fd, &cmd, 1) || !read_n(fd, &klen, 4)) return false;
+  if (klen > (1u << 20)) return false;
+  std::string key(klen, '\0');
+  if (klen && !read_n(fd, key.data(), klen)) return false;
+  switch (cmd) {
+    case 1: {  // SET
+      uint32_t vlen;
+      if (!read_n(fd, &vlen, 4)) return false;
+      if (vlen > (64u << 20)) return false;
+      std::string val(vlen, '\0');
+      if (vlen && !read_n(fd, val.data(), vlen)) return false;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->kv[key] = std::move(val);
+      }
+      reply(fd, 0, "");
+      return true;
+    }
+    case 2: {  // GET
+      std::lock_guard<std::mutex> g(s->mu);
+      auto it = s->kv.find(key);
+      if (it == s->kv.end()) {
+        reply(fd, 1, "");
+      } else {
+        reply(fd, 0, it->second);
+      }
+      return true;
+    }
+    case 3: {  // ADD
+      int64_t delta;
+      if (!read_n(fd, &delta, 8)) return false;
+      int64_t cur = 0;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        auto it = s->kv.find(key);
+        if (it != s->kv.end() && it->second.size() == 8) {
+          memcpy(&cur, it->second.data(), 8);
+        }
+        cur += delta;
+        std::string v(8, '\0');
+        memcpy(v.data(), &cur, 8);
+        s->kv[key] = v;
+      }
+      std::string out(8, '\0');
+      memcpy(out.data(), &cur, 8);
+      reply(fd, 0, out);
+      return true;
+    }
+    case 4: {  // DEL
+      std::lock_guard<std::mutex> g(s->mu);
+      s->kv.erase(key);
+      reply(fd, 0, "");
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+void serve(Server* s) {
+  std::vector<struct pollfd> fds;
+  fds.push_back({s->listen_fd, POLLIN, 0});
+  while (!s->stop.load(std::memory_order_relaxed)) {
+    int n = poll(fds.data(), fds.size(), 100);
+    if (n <= 0) continue;
+    // accept new connections
+    if (fds[0].revents & POLLIN) {
+      int c = accept(s->listen_fd, nullptr, nullptr);
+      if (c >= 0) {
+        int one = 1;
+        setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        fds.push_back({c, POLLIN, 0});
+      }
+    }
+    for (size_t i = 1; i < fds.size();) {
+      if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+        if (!handle(s, fds[i].fd)) {
+          close(fds[i].fd);
+          fds.erase(fds.begin() + i);
+          continue;
+        }
+      }
+      ++i;
+    }
+  }
+  for (size_t i = 1; i < fds.size(); ++i) close(fds[i].fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a store server on port (0 = ephemeral). Returns handle or null.
+void* tcp_store_server_start(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 128) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (struct sockaddr*)&addr, &alen);
+  Server* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->th = std::thread(serve, s);
+  return s;
+}
+
+int tcp_store_server_port(void* h) {
+  return h ? ((Server*)h)->port : -1;
+}
+
+void tcp_store_server_stop(void* h) {
+  Server* s = (Server*)h;
+  if (!s) return;
+  s->stop.store(true);
+  if (s->th.joinable()) s->th.join();
+  close(s->listen_fd);
+  delete s;
+}
+
+// ---- client: one short-lived connection per op (control plane) ----------
+
+static int client_connect(const char* host, int port, int timeout_ms) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  struct timeval tv;
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  if (connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// SET. Returns 0 ok.
+int tcp_store_set(const char* host, int port, const char* key,
+                  const uint8_t* val, uint32_t vlen, int timeout_ms) {
+  int fd = client_connect(host, port, timeout_ms);
+  if (fd < 0) return -1;
+  uint8_t cmd = 1;
+  uint32_t klen = (uint32_t)strlen(key);
+  int ok = write_n(fd, &cmd, 1) && write_n(fd, &klen, 4) &&
+           write_n(fd, key, klen) && write_n(fd, &vlen, 4) &&
+           (vlen == 0 || write_n(fd, val, vlen));
+  uint8_t status = 1;
+  uint32_t rlen = 0;
+  ok = ok && read_n(fd, &status, 1) && read_n(fd, &rlen, 4);
+  close(fd);
+  return (ok && status == 0) ? 0 : -1;
+}
+
+// GET once (no retry). Returns value length >= 0, -1 miss, -2 error.
+// Caller buffer out/out_cap; value truncated if larger (returns full len).
+int64_t tcp_store_get(const char* host, int port, const char* key,
+                      uint8_t* out, uint64_t out_cap, int timeout_ms) {
+  int fd = client_connect(host, port, timeout_ms);
+  if (fd < 0) return -2;
+  uint8_t cmd = 2;
+  uint32_t klen = (uint32_t)strlen(key);
+  int ok = write_n(fd, &cmd, 1) && write_n(fd, &klen, 4) &&
+           write_n(fd, key, klen);
+  uint8_t status = 1;
+  uint32_t vlen = 0;
+  ok = ok && read_n(fd, &status, 1) && read_n(fd, &vlen, 4);
+  if (!ok) {
+    close(fd);
+    return -2;
+  }
+  if (status == 1) {
+    close(fd);
+    return -1;
+  }
+  std::vector<uint8_t> tmp(vlen);
+  if (vlen && !read_n(fd, tmp.data(), vlen)) {
+    close(fd);
+    return -2;
+  }
+  close(fd);
+  uint64_t n = vlen < out_cap ? vlen : out_cap;
+  if (n) memcpy(out, tmp.data(), n);
+  return (int64_t)vlen;
+}
+
+// ADD delta; returns new value via *result. 0 ok.
+int tcp_store_add(const char* host, int port, const char* key, int64_t delta,
+                  int64_t* result, int timeout_ms) {
+  int fd = client_connect(host, port, timeout_ms);
+  if (fd < 0) return -1;
+  uint8_t cmd = 3;
+  uint32_t klen = (uint32_t)strlen(key);
+  int ok = write_n(fd, &cmd, 1) && write_n(fd, &klen, 4) &&
+           write_n(fd, key, klen) && write_n(fd, &delta, 8);
+  uint8_t status = 1;
+  uint32_t vlen = 0;
+  ok = ok && read_n(fd, &status, 1) && read_n(fd, &vlen, 4);
+  if (ok && status == 0 && vlen == 8) {
+    ok = read_n(fd, result, 8);
+    close(fd);
+    return ok ? 0 : -1;
+  }
+  close(fd);
+  return -1;
+}
+
+}  // extern "C"

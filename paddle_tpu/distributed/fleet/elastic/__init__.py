@@ -1,0 +1,1 @@
+from .manager import ElasticManager, parse_np_range  # noqa: F401

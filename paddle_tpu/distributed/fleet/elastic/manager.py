@@ -1,0 +1,112 @@
+"""Elastic training manager.
+
+Reference parity: fleet/elastic/manager.py:125 — nodes register with a
+leased key + heartbeat (:248-261), the manager watches the node set and on
+change rebuilds DISTRIBUTED_TRAINER_ENDPOINTS and relaunches within
+PADDLE_ELASTIC_TIMEOUT (:37,143); `--nnodes lo:hi` ranges (elastic.py:61).
+
+TPU-first: the etcd role is played by the TCPStore (control plane only —
+the data plane re-forms when jax.distributed re-initializes after
+relaunch). Generation counters namespace each incarnation so stale nodes
+from generation g never pollute generation g+1's rendezvous.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ...launch.controllers.master import Master
+from ...launch.controllers.watcher import Watcher
+
+
+def parse_np_range(np_spec) -> tuple[int, int]:
+    """'2:4' -> (2, 4); '3' -> (3, 3) (reference elastic.py:61-64)."""
+    s = str(np_spec)
+    if ":" in s:
+        lo, hi = s.split(":", 1)
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(s)
+    if lo <= 0 or hi < lo:
+        raise ValueError(f"bad nnodes range {np_spec!r}")
+    return lo, hi
+
+
+class ElasticManager:
+    """Drives register → watch → (on change) re-rendezvous cycles."""
+
+    def __init__(self, endpoint: str, rank: int, np_spec="1",
+                 elastic_timeout: float = None,
+                 heartbeat_interval: float = 2.0,
+                 stale_after: float = 10.0):
+        self.min_np, self.max_np = parse_np_range(np_spec)
+        self.rank = rank
+        self.elastic_timeout = elastic_timeout if elastic_timeout is not None \
+            else float(os.environ.get("PADDLE_ELASTIC_TIMEOUT", "120"))
+        self.master = Master(endpoint, rank, self.max_np,
+                             timeout=self.elastic_timeout)
+        self.gen = 0
+        self._watcher = None
+        self._interval = heartbeat_interval
+        self._stale = stale_after
+
+    def register_and_sync(self, my_endpoint: str) -> list[str]:
+        """Join generation `gen`: register, wait for at least min_np nodes
+        (up to elastic_timeout for more, bounded by max_np), return peers."""
+        ns = f"gen{self.gen}"
+        self.master.store.set(f"{ns}/node/{self.rank}", my_endpoint.encode())
+        self.master.store.add(f"{ns}/registered", 1)
+        import struct
+
+        deadline = time.monotonic() + self.elastic_timeout
+        best = 0
+        while time.monotonic() < deadline:
+            raw = self.master.store.get(f"{ns}/registered")
+            n = struct.unpack("<q", raw)[0] if len(raw) == 8 else 0
+            best = max(best, n)
+            if best >= self.max_np:
+                break
+            if best >= self.min_np and time.monotonic() > deadline - \
+                    self.elastic_timeout * 0.5:
+                break  # settle for a partial (elastic) world
+            time.sleep(0.1)
+        if best < self.min_np:
+            raise TimeoutError(
+                f"elastic: only {best}/{self.min_np} nodes joined")
+        peers = []
+        for r in range(self.max_np):
+            try:
+                v = self.master.store._get_once(f"{ns}/node/{r}")
+            except ConnectionError:
+                v = None
+            if v is not None:
+                peers.append(v.decode())
+        os.environ["DISTRIBUTED_TRAINER_ENDPOINTS"] = ",".join(peers)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(len(peers))
+        return peers
+
+    def start_watch(self):
+        self._watcher = Watcher(self.master, interval=self._interval,
+                                stale_after=self._stale, gen=self.gen)
+        self._watcher.start()
+        return self._watcher
+
+    def world_changed(self) -> bool:
+        return self._watcher is not None and self._watcher.peer_failed.is_set()
+
+    def mark_completed(self):
+        """Publish clean completion so peers' watchers don't read our
+        heartbeat stopping as a crash."""
+        self.master.store.set(f"gen{self.gen}/done/{self.rank}", b"1")
+
+    def next_generation(self):
+        """Close the watch and bump the namespace for re-rendezvous."""
+        if self._watcher is not None:
+            self._watcher.stop()
+            self._watcher = None
+        self.gen += 1
+
+    def shutdown(self):
+        if self._watcher is not None:
+            self._watcher.stop()
+        self.master.shutdown()

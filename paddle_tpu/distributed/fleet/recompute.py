@@ -95,9 +95,23 @@ def _collect_params(function, args):
 
 def recompute(function, *args, **kwargs):
     """Run `function(*args)` without saving its intermediates; recompute them
-    during backward (reference recompute.py:438)."""
+    during backward (reference recompute.py:438).
+
+    kwargs:
+      policy: None (full remat, reference semantics) | "dots" (save matmul
+        outputs that have no batch dims — linear/MLP activations persist,
+        attention scores are recomputed; the TPU sweet spot: attention is
+        the HBM-heavy part, linears are the FLOP-heavy part) | a jax
+        checkpoint policy callable.
+    """
     use_reentrant = kwargs.pop("use_reentrant", True)  # API parity; unused
     preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # traced RNG
+    policy = kwargs.pop("policy", None)
+    if isinstance(policy, str):
+        policy = {
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "nothing": None,
+        }[policy]
     if kwargs:
         raise TypeError(f"unsupported recompute kwargs: {sorted(kwargs)}")
 
@@ -136,7 +150,8 @@ def recompute(function, *args, **kwargs):
             return tuple(o._data for o in out)
         return out._data
 
-    ckpt = jax.checkpoint(pure)
+    ckpt = (jax.checkpoint(pure, policy=policy) if policy is not None
+            else jax.checkpoint(pure))
     return apply_op(ckpt, params + buffers + tensor_args, name="recompute")
 
 

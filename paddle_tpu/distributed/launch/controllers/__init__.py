@@ -1,0 +1,2 @@
+from .master import Master  # noqa: F401
+from .watcher import Watcher  # noqa: F401

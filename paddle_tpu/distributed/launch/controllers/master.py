@@ -1,0 +1,89 @@
+"""Master rendezvous over the TCPStore.
+
+Reference parity: launch/controllers/master.py:73 (HTTPMaster.sync_peers)
+/ :186 (ETCDMaster) — every node publishes its endpoint, rank 0 hosts the
+store, all nodes block until the full peer list is known, then read back
+identical ordered endpoints. Generation ("gen") keys let elastic restarts
+re-rendezvous with a fresh namespace.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import time
+
+from ...store import TCPStore
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class Master:
+    def __init__(self, endpoint: str, rank: int, nnodes: int,
+                 timeout: float = 300.0):
+        host, _, port = endpoint.partition(":")
+        self.rank = rank
+        self.nnodes = nnodes
+        self.store = TCPStore(host or "127.0.0.1", int(port or 8765),
+                              world_size=nnodes, is_master=(rank == 0),
+                              timeout=timeout)
+
+    def sync_peers(self, my_endpoint: str, gen: int = 0) -> list[str]:
+        """Publish my endpoint; block until all nnodes registered; return
+        the rank-ordered endpoint list (identical on every node)."""
+        ns = f"gen{gen}"
+        self.store.set(f"{ns}/node/{self.rank}", my_endpoint.encode())
+        self.store.add(f"{ns}/registered", 1)
+        deadline = time.monotonic() + self.store.timeout
+        while True:
+            # counter equality is the barrier; re-read until complete
+            import struct
+
+            raw = self.store.get(f"{ns}/registered")
+            n = struct.unpack("<q", raw)[0] if len(raw) == 8 else 0
+            if n >= self.nnodes:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: {n}/{self.nnodes} nodes after timeout")
+            time.sleep(0.05)
+        return [self.store.get(f"{ns}/node/{r}").decode()
+                for r in range(self.nnodes)]
+
+    def heartbeat(self, gen: int = 0):
+        self.store.set(f"gen{gen}/beat/{self.rank}",
+                       str(time.time()).encode())
+
+    def peer_beats(self, gen: int = 0) -> dict[int, float]:
+        out = {}
+        for r in range(self.nnodes):
+            try:
+                val = self.store._get_once(f"gen{gen}/beat/{r}")
+            except ConnectionError:
+                val = None
+            if val is not None:
+                out[r] = float(val)
+        return out
+
+    def shutdown(self):
+        self.store.shutdown()
+
+
+def rendezvous_from_env(gen: int = 0) -> list[str]:
+    """Build the env-contract peer list (reference sync_peers usage):
+    publishes this host's coordinator endpoint, returns all, and exports
+    DISTRIBUTED_TRAINER_ENDPOINTS."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    nnodes = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master = os.environ.get("PADDLE_MASTER") or (
+        f"{os.environ.get('MASTER_ADDR', '127.0.0.1')}:"
+        f"{os.environ.get('MASTER_PORT', '8765')}")
+    me = f"{socket.gethostbyname(socket.gethostname())}:{_free_port()}"
+    m = Master(master, rank, nnodes)
+    peers = m.sync_peers(me, gen=gen)
+    os.environ["DISTRIBUTED_TRAINER_ENDPOINTS"] = ",".join(peers)
+    os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(peers)
+    return peers

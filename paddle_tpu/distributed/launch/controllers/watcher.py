@@ -1,0 +1,59 @@
+"""Liveness watcher (reference launch/controllers/watcher.py:24,54).
+
+Runs in the launcher beside the training child: publishes this node's
+heartbeat through the Master's store and flags peers whose heartbeats go
+stale — the launcher then tears down and (elastic) re-rendezvouses instead
+of hanging in a dead collective (SURVEY.md §5.3 mechanism 2).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Watcher:
+    def __init__(self, master, interval: float = 2.0,
+                 stale_after: float = 10.0, gen: int = 0):
+        self.master = master
+        self.interval = interval
+        self.stale_after = stale_after
+        self.gen = gen
+        self.peer_failed = threading.Event()
+        self.failed_ranks: list[int] = []
+        self._stop = threading.Event()
+        self._th = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._th.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.master.heartbeat(self.gen)
+                beats = self.master.peer_beats(self.gen)
+                now = time.time()
+                # a peer that NEVER registered isn't failed (still
+                # starting); one that registered and stopped beating is —
+                # unless it published clean completion (gen/done/<rank>)
+                stale = []
+                for r in range(self.master.nnodes):
+                    if now - beats.get(r, now) <= self.stale_after:
+                        continue
+                    try:
+                        done = self.master.store._get_once(
+                            f"gen{self.gen}/done/{r}")
+                    except Exception:
+                        done = None
+                    if done is None:
+                        stale.append(r)
+                if stale:
+                    self.failed_ranks = stale
+                    self.peer_failed.set()
+            except Exception:
+                pass  # transient store errors must not kill the watcher
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        self._th.join(timeout=5)

@@ -62,21 +62,76 @@ def main(argv=None):
         runpy.run_path(args.script, run_name="__main__")
         return 0
 
+    from ...utils.log_helper import get_logger
+
+    log = get_logger("paddle_tpu.launch")
+    manager = None
+    if nnodes > 1 and (args.master or env.get("MASTER_ADDR")):
+        # master rendezvous + liveness watch + elastic re-rendezvous
+        # (reference controllers/master.py, watcher.py, elastic/manager.py)
+        import socket as _socket
+
+        from ...distributed.fleet.elastic import ElasticManager
+
+        master_ep = args.master or (f"{env['MASTER_ADDR']}:"
+                                    f"{env.get('MASTER_PORT', '8765')}")
+        manager = ElasticManager(master_ep, args.rank, args.nnodes)
+        my_ep = _socket.gethostbyname(_socket.gethostname())
+
     restarts = 0
     while True:
+        if manager is not None:
+            peers = manager.register_and_sync(my_ep)
+            env["DISTRIBUTED_TRAINER_ENDPOINTS"] = ",".join(peers)
+            env["PADDLE_TRAINERS_NUM"] = str(len(peers))
+            # a shrunken world must re-densify ranks: the child's process_id
+            # is its position in the surviving peer list, not its original
+            # rank (jax.distributed.initialize requires id < num_processes)
+            env["PADDLE_TRAINER_ID"] = str(peers.index(my_ep)
+                                           if my_ep in peers else args.rank)
+            watcher = manager.start_watch()
         proc = subprocess.Popen([sys.executable, args.script]
                                 + list(args.script_args), env=env)
-        code = proc.wait()
+        if manager is None:
+            code = proc.wait()
+        else:
+            while True:
+                code = proc.poll()
+                if code is not None:
+                    break
+                if manager.world_changed():
+                    log.warning("peer rank(s) %s went stale; restarting "
+                                "generation %d",
+                                manager._watcher.failed_ranks, manager.gen)
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        # the exact case the watcher exists for: a child
+                        # wedged in a dead collective ignores SIGTERM
+                        proc.kill()
+                        proc.wait()
+                    code = 1
+                    break
+                import time as _time
+
+                _time.sleep(0.5)
         if code == 0:
+            if manager is not None:
+                # peers must not read our heartbeat stopping as a crash
+                manager.mark_completed()
+                manager.next_generation()
+                manager.shutdown()
             return 0
+        if manager is not None:
+            manager.next_generation()
         restarts += 1
         if restarts > args.max_restart:
+            if manager is not None:
+                manager.shutdown()
             return code
-        from ...utils.log_helper import get_logger
-
-        get_logger("paddle_tpu.launch").warning(
-            "rank %s exited %s; restart %d/%d",
-            args.rank, code, restarts, args.max_restart)
+        log.warning("rank %s exited %s; restart %d/%d",
+                    args.rank, code, restarts, args.max_restart)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,320 @@
+"""TCPStore — control-plane KV rendezvous.
+
+Reference parity: TCPStore (paddle/phi/core/distributed/store/tcp_store.h)
++ create_or_get_global_tcp_store (python/paddle/distributed/parallel.py:1134).
+Backed by the native server/client (csrc/tcp_store.cpp, ctypes-loaded,
+lazily built with g++); a pure-Python socket fallback keeps rendezvous
+working without a toolchain. wait/get block CLIENT-side with retries — the
+server never blocks on a rank (watchdog-friendly, SURVEY.md §5.3).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import subprocess
+import threading
+import time
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "_tcp_store.so")
+_SRC = os.path.join(os.path.dirname(__file__), os.pardir, "csrc",
+                    "tcp_store.cpp")
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _load_lib():
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-o", _SO_PATH, os.path.abspath(_SRC), "-lpthread"],
+                    check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.tcp_store_server_start.restype = ctypes.c_void_p
+        lib.tcp_store_server_start.argtypes = [ctypes.c_int]
+        lib.tcp_store_server_port.restype = ctypes.c_int
+        lib.tcp_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_set.restype = ctypes.c_int
+        lib.tcp_store_set.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int]
+        lib.tcp_store_get.restype = ctypes.c_int64
+        lib.tcp_store_get.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+        lib.tcp_store_add.restype = ctypes.c_int
+        lib.tcp_store_add.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+class _PyStoreServer:
+    """Pure-Python fallback server (same wire-level semantics, dict+lock)."""
+
+    def __init__(self, port=0):
+        self._kv = {}
+        self._mu = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self._srv.listen(128)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self._th = threading.Thread(target=self._serve, daemon=True)
+        self._th.start()
+
+    def _serve(self):
+        self._srv.settimeout(0.1)
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(target=self._client, args=(conn,),
+                             daemon=True).start()
+        self._srv.close()
+
+    def _client(self, conn):
+        import struct
+
+        def read_n(n):
+            buf = b""
+            while len(buf) < n:
+                c = conn.recv(n - len(buf))
+                if not c:
+                    raise ConnectionError
+                buf += c
+            return buf
+
+        try:
+            while True:
+                cmd = read_n(1)[0]
+                klen = struct.unpack("<I", read_n(4))[0]
+                key = read_n(klen).decode()
+                if cmd == 1:
+                    vlen = struct.unpack("<I", read_n(4))[0]
+                    val = read_n(vlen)
+                    with self._mu:
+                        self._kv[key] = val
+                    conn.sendall(b"\x00" + struct.pack("<I", 0))
+                elif cmd == 2:
+                    with self._mu:
+                        val = self._kv.get(key)
+                    if val is None:
+                        conn.sendall(b"\x01" + struct.pack("<I", 0))
+                    else:
+                        conn.sendall(b"\x00" + struct.pack("<I", len(val))
+                                     + val)
+                elif cmd == 3:
+                    delta = struct.unpack("<q", read_n(8))[0]
+                    with self._mu:
+                        cur = struct.unpack(
+                            "<q", self._kv.get(key, b"\0" * 8))[0] + delta
+                        self._kv[key] = struct.pack("<q", cur)
+                    conn.sendall(b"\x00" + struct.pack("<I", 8)
+                                 + struct.pack("<q", cur))
+                elif cmd == 4:
+                    with self._mu:
+                        self._kv.pop(key, None)
+                    conn.sendall(b"\x00" + struct.pack("<I", 0))
+                else:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+
+
+class _PyStoreClient:
+    def __init__(self, host, port, timeout):
+        self.host, self.port, self.timeout = host, port, timeout
+
+    def _roundtrip(self, payload):
+        import struct
+
+        with socket.create_connection((self.host, self.port),
+                                      timeout=self.timeout) as s:
+            s.sendall(payload)
+            hdr = b""
+            while len(hdr) < 5:
+                hdr += s.recv(5 - len(hdr))
+            status = hdr[0]
+            vlen = struct.unpack("<I", hdr[1:5])[0]
+            val = b""
+            while len(val) < vlen:
+                val += s.recv(vlen - len(val))
+            return status, val
+
+    def set(self, key, val):
+        import struct
+
+        k = key.encode()
+        st, _ = self._roundtrip(b"\x01" + struct.pack("<I", len(k)) + k
+                                + struct.pack("<I", len(val)) + val)
+        if st != 0:
+            raise RuntimeError("store set failed")
+
+    def get_once(self, key):
+        import struct
+
+        k = key.encode()
+        st, val = self._roundtrip(b"\x02" + struct.pack("<I", len(k)) + k)
+        return None if st == 1 else val
+
+    def add(self, key, delta):
+        import struct
+
+        k = key.encode()
+        st, val = self._roundtrip(b"\x03" + struct.pack("<I", len(k)) + k
+                                  + struct.pack("<q", delta))
+        if st != 0 or len(val) != 8:
+            raise RuntimeError("store add failed")
+        return struct.unpack("<q", val)[0]
+
+
+class TCPStore:
+    """Reference TCPStore API: master hosts, everyone set/get/add/waits."""
+
+    def __init__(self, host: str, port: int, world_size: int = 1,
+                 is_master: bool = False, timeout: float = 300.0):
+        self.host = host
+        self.world_size = world_size
+        self.is_master = is_master
+        self.timeout = timeout
+        self._server = None
+        lib = _load_lib()
+        self._native = lib is not None
+        if is_master:
+            if self._native:
+                self._server = lib.tcp_store_server_start(port)
+                if not self._server:
+                    raise OSError(f"TCPStore bind :{port} failed")
+                self.port = lib.tcp_store_server_port(self._server)
+            else:
+                self._py_server = _PyStoreServer(port)
+                self.port = self._py_server.port
+        else:
+            self.port = port
+        if not self._native:
+            self._py_client = _PyStoreClient(host, self.port, timeout)
+        self._resolved = socket.gethostbyname(host)
+
+    # -- API ------------------------------------------------------------
+    def set(self, key: str, value: bytes):
+        value = value if isinstance(value, bytes) else str(value).encode()
+        if self._native:
+            rc = _lib.tcp_store_set(self._resolved.encode(), self.port,
+                                    key.encode(), value, len(value),
+                                    int(self.timeout * 1000))
+            if rc != 0:
+                raise RuntimeError(f"store set({key!r}) failed")
+        else:
+            self._py_client.set(key, value)
+
+    def _get_once(self, key: str):
+        if self._native:
+            # reused per-instance buffer: get() and the watcher poll this
+            # in tight loops, so per-call 64MB allocations would churn;
+            # grow only when a value overflows (tcp_store_get returns the
+            # full length even when truncating)
+            buf = getattr(self, "_get_buf", None)
+            if buf is None:
+                buf = self._get_buf = ctypes.create_string_buffer(1 << 16)
+            n = _lib.tcp_store_get(self._resolved.encode(), self.port,
+                                   key.encode(), buf, len(buf),
+                                   int(self.timeout * 1000))
+            if n > len(buf):
+                buf = self._get_buf = ctypes.create_string_buffer(int(n))
+                n = _lib.tcp_store_get(self._resolved.encode(), self.port,
+                                       key.encode(), buf, len(buf),
+                                       int(self.timeout * 1000))
+            if n == -2:
+                raise ConnectionError(f"store get({key!r}) connect failed")
+            return None if n < 0 else buf.raw[:n]
+        return self._py_client.get_once(key)
+
+    def get(self, key: str) -> bytes:
+        """Blocks (client-side retry) until the key exists or timeout."""
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                val = self._get_once(key)
+            except ConnectionError:
+                val = None
+            if val is not None:
+                return val
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"store get({key!r}) timed out")
+            time.sleep(0.05)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._native:
+            out = ctypes.c_int64(0)
+            rc = _lib.tcp_store_add(self._resolved.encode(), self.port,
+                                    key.encode(), delta,
+                                    ctypes.byref(out),
+                                    int(self.timeout * 1000))
+            if rc != 0:
+                raise RuntimeError(f"store add({key!r}) failed")
+            return out.value
+        return self._py_client.add(key, delta)
+
+    def wait(self, keys, timeout: float = None):
+        deadline = time.monotonic() + (timeout or self.timeout)
+        for key in ([keys] if isinstance(keys, str) else keys):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"store wait({key!r}) timed out")
+            saved = self.timeout
+            self.timeout = remaining
+            try:
+                self.get(key)
+            finally:
+                self.timeout = saved
+
+    def shutdown(self):
+        if self._server is not None and _lib is not None:
+            _lib.tcp_store_server_stop(self._server)
+            self._server = None
+        if getattr(self, "_py_server", None) is not None:
+            self._py_server.stop()
+
+
+_global_store = None
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """Reference parallel.py:1134 — one store per job, master on rank 0."""
+    global _global_store
+    if _global_store is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = int(os.environ.get("MASTER_PORT", "0") or 0)
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        _global_store = TCPStore(addr, port, world_size=world,
+                                 is_master=(rank == 0))
+    return _global_store

@@ -245,6 +245,35 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = epoch
 
 
+def numpy_collate_fn(batch):
+    """default_collate_fn's numpy twin — used INSIDE worker processes so
+    they never import jax (spawned workers stay lightweight; the parent
+    wraps arrays into Tensors on arrival)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [numpy_collate_fn(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: numpy_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, str):
+        return list(batch)
+    return np.asarray(batch)
+
+
+def _wrap_numpy_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, list):
+        return [_wrap_numpy_tree(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _wrap_numpy_tree(v) for k, v in obj.items()}
+    return obj
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, Tensor):
@@ -274,8 +303,12 @@ class DataLoader:
                  worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self._user_collate = collate_fn is not None
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -311,6 +344,26 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._batches()
             return
+        if not self._iterable_mode:
+            # multiprocess worker pool + shm-ring transfer (reference
+            # dataloader/worker.py + data_loader.cc); falls back to the
+            # thread pipeline ONLY if pool setup / the first batch fails
+            # (an unpicklable dataset, spawn unavailable). Mid-epoch
+            # failures must propagate — re-running the epoch from batch 0
+            # would silently train on duplicate data.
+            gen = self._iter_multiprocess()
+            try:
+                first = next(gen)
+                started = True
+            except StopIteration:
+                return
+            except (ImportError, OSError, TypeError, AttributeError,
+                    _PickleError):
+                started = False
+            if started:
+                yield first
+                yield from gen
+                return
         # thread-prefetch pipeline: overlap host batch assembly with compute
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
@@ -336,6 +389,43 @@ class DataLoader:
         if error:
             raise error[0]
 
+    def _iter_multiprocess(self):
+        from .worker import WorkerPool
 
-def get_worker_info():
-    return None
+        # workers collate to numpy (no jax import in children). A custom
+        # collate_fn runs in the workers as-is — unless it IS
+        # default_collate_fn passed explicitly, which we swap for its
+        # numpy twin (building Tensors in a child would import jax there
+        # and fight the parent for the TPU).
+        use_numpy_twin = (not self._user_collate
+                          or self.collate_fn is default_collate_fn)
+        worker_collate = numpy_collate_fn if use_numpy_twin \
+            else self.collate_fn
+        wrap = _wrap_numpy_tree if use_numpy_twin else (lambda b: b)
+        pool = WorkerPool(
+            self.dataset, worker_collate, self.num_workers,
+            self.use_shared_memory, worker_init_fn=self.worker_init_fn,
+            seed=int(default_generator().initial_seed))
+        try:
+            batches = list(self.batch_sampler)
+            inflight = 0
+            window = self.num_workers * self.prefetch_factor
+            submitted = 0
+            for submitted, idxs in enumerate(batches[:window]):
+                pool.submit(submitted, idxs)
+                inflight += 1
+            next_submit = inflight
+            for _ in range(len(batches)):
+                batch = pool.next_batch(
+                    timeout_s=self.timeout if self.timeout else 300.0)
+                if next_submit < len(batches):
+                    pool.submit(next_submit, batches[next_submit])
+                    next_submit += 1
+                yield wrap(batch)
+        finally:
+            pool.shutdown()
+
+
+from pickle import PicklingError as _PickleError  # noqa: E402
+
+from .worker import get_worker_info  # noqa: E402,F401

@@ -1,0 +1,122 @@
+"""Worker→parent tensor transport over the native SPSC shm ring.
+
+ctypes bindings for csrc/shm_ring.cpp (built lazily with g++ on first use;
+cached .so beside this file). The DataLoader falls back to plain
+multiprocessing queues with an identical flow when no native toolchain is
+available, so it works everywhere and is merely faster with the ring.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+import time
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "_shm_ring.so")
+_SRC = os.path.join(os.path.dirname(__file__), os.pardir, "csrc",
+                    "shm_ring.cpp")
+_build_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _load_lib():
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-o", _SO_PATH, os.path.abspath(_SRC), "-lrt",
+                     "-lpthread"],
+                    check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.shm_ring_create.restype = ctypes.c_void_p
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_ring_attach.restype = ctypes.c_void_p
+        lib.shm_ring_attach.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_push.restype = ctypes.c_int
+        lib.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_uint64]
+        lib.shm_ring_next_size.restype = ctypes.c_int64
+        lib.shm_ring_next_size.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_pop.restype = ctypes.c_int
+        lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_uint64]
+        lib.shm_ring_close_producer.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+class ShmRingChannel:
+    """One SPSC ring: worker process = producer, parent loader = consumer."""
+
+    def __init__(self, name: str, capacity: int = 64 << 20, create=True):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native shm ring unavailable")
+        self._lib = lib
+        self.name = name.encode()
+        if create:
+            self._ring = lib.shm_ring_create(self.name, capacity)
+        else:
+            self._ring = lib.shm_ring_attach(self.name)
+        if not self._ring:
+            raise OSError(f"shm ring {name!r} create/attach failed")
+
+    # -- producer side --------------------------------------------------
+    def send(self, obj, timeout_ms: int = 60_000):
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._lib.shm_ring_push(self._ring, payload, len(payload),
+                                     timeout_ms)
+        if rc == -1:
+            raise TimeoutError("shm ring full")
+        if rc != 0:
+            raise BrokenPipeError("shm ring closed")
+
+    def close_producer(self):
+        self._lib.shm_ring_close_producer(self._ring)
+
+    # -- consumer side --------------------------------------------------
+    def recv(self, timeout_ms: int = 60_000):
+        """Next object; EOFError once producer closed + ring drained;
+        TimeoutError on timeout."""
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            size = self._lib.shm_ring_next_size(self._ring)
+            if size == -2:
+                raise EOFError
+            if size > 0:
+                buf = ctypes.create_string_buffer(int(size))
+                rc = self._lib.shm_ring_pop(self._ring, buf, int(size),
+                                            timeout_ms)
+                if rc == -1:
+                    raise TimeoutError("shm ring empty")
+                if rc != 0:
+                    raise EOFError
+                return pickle.loads(buf.raw)
+            if time.monotonic() >= deadline:
+                raise TimeoutError("shm ring empty")
+            time.sleep(0.0005)
+
+    def free(self):
+        if self._ring:
+            self._lib.shm_ring_free(self._ring)
+            self._ring = None

@@ -1,0 +1,195 @@
+"""Multiprocess DataLoader workers.
+
+Reference parity: python/paddle/io/dataloader/worker.py (_worker_loop,
+WorkerInfo) + dataloader_iter.py's ordered reassembly, with the C++
+shared-memory transfer path (imperative/data_loader.cc) played by the
+native shm ring (csrc/shm_ring.cpp). Spawn-based so workers never inherit
+the parent's PJRT/TPU state.
+
+Flow: parent puts (batch_ordinal, indices) on a shared index queue; each
+worker builds batches and streams them back over its own SPSC ring (or a
+mp.Queue fallback); the parent reorders by ordinal so iteration order is
+deterministic regardless of worker timing.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as _queue
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+_worker_info = None
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    seed: int
+    dataset: object
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """Inside a worker process: this worker's info; None in the parent
+    (reference worker.py get_worker_info)."""
+    return _worker_info
+
+
+def _worker_loop(worker_id, num_workers, seed, dataset, collate_fn,
+                 index_queue, ring_name, result_queue, init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, seed, dataset)
+    channel = None
+    if ring_name is not None:
+        try:
+            from .shm_channel import ShmRingChannel
+
+            channel = ShmRingChannel(ring_name, create=False)
+        except Exception:
+            channel = None
+
+    def emit(item):
+        if channel is not None:
+            channel.send(item)
+        else:
+            result_queue.put(item)
+
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        import numpy as np
+
+        np.random.seed((seed + worker_id) % (2 ** 31))
+        while True:
+            job = index_queue.get()
+            if job is None:
+                break
+            ordinal, indices = job
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                emit((ordinal, batch, None))
+            except Exception as e:  # surface errors in the parent
+                emit((ordinal, None, f"{type(e).__name__}: {e}"))
+    finally:
+        if channel is not None:
+            channel.close_producer()
+        else:
+            result_queue.put(None)
+
+
+class WorkerPool:
+    """Parent-side pool with ordered batch reassembly."""
+
+    def __init__(self, dataset, collate_fn, num_workers, use_shared_memory,
+                 worker_init_fn=None, seed=0, ring_capacity=64 << 20):
+        self.num_workers = num_workers
+        ctx = mp.get_context("spawn")
+        self._index_queue = ctx.Queue()
+        self._result_queue = ctx.Queue()
+        self._channels = []
+        self._procs = []
+        ring_base = None
+        if use_shared_memory:
+            from .shm_channel import native_available
+
+            if native_available():
+                ring_base = f"/pt_dl_{os.getpid()}_{id(self)}"
+        for w in range(num_workers):
+            ring_name = None
+            if ring_base is not None:
+                from .shm_channel import ShmRingChannel
+
+                ring_name = f"{ring_base}_{w}"
+                self._channels.append(
+                    ShmRingChannel(ring_name, capacity=ring_capacity,
+                                   create=True))
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(w, num_workers, seed, dataset, collate_fn,
+                      self._index_queue, ring_name, self._result_queue,
+                      worker_init_fn),
+                daemon=True)
+            p.start()
+            self._procs.append(p)
+        self._use_rings = bool(self._channels)
+        self._buffer = {}
+        self._next_ordinal = 0
+        self._recv_lock = threading.Lock()
+
+    def submit(self, ordinal, indices):
+        self._index_queue.put((ordinal, list(indices)))
+
+    def _poll_rings(self, timeout_ms):
+        import time
+
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        live = [c for c in self._channels if c is not None]
+        while time.monotonic() < deadline and live:
+            for c in live:
+                try:
+                    return c.recv(timeout_ms=1)
+                except TimeoutError:
+                    continue
+                except EOFError:
+                    live.remove(c)
+                    break
+            time.sleep(0.0005)
+        if not live:
+            raise EOFError
+        raise TimeoutError
+
+    def _check_alive(self):
+        dead = [w for w, p in enumerate(self._procs)
+                if not p.is_alive() and p.exitcode not in (0, None)]
+        if dead:
+            codes = {w: self._procs[w].exitcode for w in dead}
+            raise RuntimeError(
+                f"DataLoader worker(s) {dead} died hard (exit codes "
+                f"{codes}) — killed by the OS (OOM?) or crashed outside "
+                "Python")
+
+    def next_batch(self, timeout_s=300.0):
+        """The next batch in submission order. Polls in 2 s slices so a
+        hard-killed worker (OOM/segfault) is reported immediately with its
+        exit code instead of an opaque timeout after `timeout_s`."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while self._next_ordinal not in self._buffer:
+            self._check_alive()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"DataLoader batch {self._next_ordinal} not produced "
+                    f"within {timeout_s}s")
+            try:
+                if self._use_rings:
+                    item = self._poll_rings(2000)
+                else:
+                    item = self._result_queue.get(timeout=2.0)
+                    if item is None:
+                        continue
+            except (TimeoutError, _queue.Empty):
+                continue
+            ordinal, batch, err = item
+            if err is not None:
+                raise RuntimeError(f"DataLoader worker failed: {err}")
+            self._buffer[ordinal] = batch
+        out = self._buffer.pop(self._next_ordinal)
+        self._next_ordinal += 1
+        return out
+
+    def shutdown(self):
+        for _ in self._procs:
+            self._index_queue.put(None)
+        for p in self._procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+        for c in self._channels:
+            try:
+                c.free()
+            except Exception:
+                pass
+        self._channels = []

@@ -123,16 +123,123 @@ def ignore_module(modules):
 
 
 def save(layer, path, input_spec=None, **config):
-    """paddle.jit.save parity — persists params + config; on TPU the program
-    itself is re-derived by tracing at load (XLA recompiles per backend, so
-    serializing HLO would pin the wrong target)."""
+    """paddle.jit.save parity (reference jit/api.py save → inference
+    program + params). TPU-first: params always persist; when `input_spec`
+    gives concrete shapes the forward is traced and serialized as a
+    portable StableHLO artifact via jax.export, so `jit.load` can run it
+    WITHOUT the model class (the reference's TranslatedLayer contract).
+
+    input_spec: list of example Tensors/arrays (shape+dtype carriers).
+    """
     from ..framework import io as fio
+    from ..framework.tensor import Tensor
 
     fio.save(layer.state_dict(), path + ".pdparams")
+    if not input_spec:
+        return
+    import jax
+    from jax import export as jexport
+    import jax.numpy as jnp
+
+    # ordering contract shared with load(): state_dict key order split into
+    # params vs buffers (the .meta sidecar records it)
+    sd_keys = list(layer.state_dict().keys())
+    named_p = dict(layer.named_parameters())
+    named_all = layer.state_dict()
+    params = [named_p[k] for k in sd_keys if k in named_p]
+    buffers = [named_all[k] for k in sd_keys if k not in named_p]
+    examples = [s._data if isinstance(s, Tensor) else jnp.asarray(s)
+                for s in input_spec]
+
+    def pure(param_datas, buffer_datas, *xs):
+        saved_p = [p._data for p in params]
+        saved_b = [b._data for b in buffers]
+        for p, d in zip(params, param_datas):
+            p._data = d
+        for b, d in zip(buffers, buffer_datas):
+            b._data = d
+        try:
+            out = layer(*[Tensor._wrap(x) for x in xs])
+        finally:
+            for p, d in zip(params, saved_p):
+                p._data = d
+            for b, d in zip(buffers, saved_b):
+                b._data = d
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        # multi-platform artifact: loadable on TPU or CPU regardless of
+        # which backend traced it
+        exported = jexport.export(jax.jit(pure),
+                                  platforms=("tpu", "cpu"))(
+            [p._data for p in params], [b._data for b in buffers],
+            *examples)
+    finally:
+        if was_training:
+            layer.train()
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    import json as _json
+
+    with open(path + ".pdmodel.meta", "w") as f:
+        _json.dump({
+            "param_keys": [k for k in sd_keys if k in named_p],
+            "buffer_keys": [k for k in sd_keys if k not in named_p],
+        }, f)
+
+
+class TranslatedLayer:
+    """What jit.load returns: a callable inference program rebound to its
+    saved params (reference TranslatedLayer role)."""
+
+    def __init__(self, exported, param_datas, buffer_datas):
+        self._exported = exported
+        self._params = param_datas
+        self._buffers = buffer_datas
+
+    def __call__(self, *xs):
+        from ..framework.tensor import Tensor
+
+        datas = [x._data if isinstance(x, Tensor) else x for x in xs]
+        out = self._exported.call(self._params, self._buffers, *datas)
+        if isinstance(out, (tuple, list)):
+            outs = tuple(Tensor._wrap(o) for o in out)
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor._wrap(out)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("a loaded inference program cannot be trained; "
+                           "rebuild the model class and load .pdparams")
 
 
 def load(path, **config):
-    raise NotImplementedError(
-        "paddle_tpu.jit.load requires the model class; use paddle_tpu.load for "
-        "state dicts and re-trace with to_static"
-    )
+    """paddle.jit.load parity: rehydrates the StableHLO artifact saved by
+    `jit.save(..., input_spec=...)` into a callable TranslatedLayer."""
+    import os as _os
+
+    from jax import export as jexport
+
+    from ..framework import io as fio
+
+    model_path = path + ".pdmodel"
+    if not _os.path.exists(model_path):
+        raise FileNotFoundError(
+            f"{model_path} not found — save with input_spec to export a "
+            "loadable program, or use paddle_tpu.load for state dicts")
+    import json as _json
+
+    with open(model_path, "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(model_path + ".meta") as f:
+        meta = _json.load(f)
+    state = fio.load(path + ".pdparams", return_numpy=True)
+    params = [state[k] for k in meta["param_keys"]]
+    buffers = [state[k] for k in meta["buffer_keys"]]
+    return TranslatedLayer(exported, params, buffers)

@@ -40,6 +40,10 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     use_recompute: bool = False
+    # remat granularity: None = full (reference semantics), "dots" = keep
+    # linear/MLP dot outputs, recompute only attention (less recompute
+    # FLOPs for a modest activation-memory cost)
+    recompute_policy: str = None
     # long-context: route attention through the sep-axis ppermute ring
     # (meta_parallel/ring_attention.py) instead of GSPMD's k/v all-gather —
     # O(seq/n) activation memory per device on a sep mesh
@@ -147,6 +151,7 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self._use_recompute = config.use_recompute
+        self._recompute_policy = config.recompute_policy
 
     def _inner(self, x):
         x = x + self.dropout(self.attn(self.ln_1(x)))
@@ -157,7 +162,8 @@ class GPTBlock(nn.Layer):
         if self._use_recompute and self.training:
             from ..distributed.fleet import recompute
 
-            return recompute(self._inner, x)
+            return recompute(self._inner, x,
+                             policy=self._recompute_policy)
         return self._inner(x)
 
 

@@ -59,7 +59,11 @@ def supports(q_shape, dtype, causal) -> bool:
 
 
 def _pick_block(seq: int):
-    for blk in (512, 256, 128, 64, 32, 16, 8):
+    # Measured on v5e (seq 4096, bf16, d=64, fwd+bwd): 1024-blocks run
+    # ~1.7x faster than 512 (fewer grid steps, better MXU occupancy);
+    # 2048 gains only ~5% more while quadrupling the fp32 score tile's
+    # VMEM, so 1024 is the default ceiling.
+    for blk in (1024, 512, 256, 128, 64, 32, 16, 8):
         if seq % blk == 0:
             return blk
     return None

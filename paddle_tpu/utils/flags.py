@@ -69,6 +69,11 @@ define_flag("FLAGS_init_allocated_mem", False, "")
 define_flag("FLAGS_use_stream_safe_cuda_allocator", True, "no-op on TPU (PJRT-managed)")
 define_flag("FLAGS_distributed_timeout_sec", 1800, "collective watchdog timeout")
 define_flag("FLAGS_log_level", 0, "VLOG level")
-define_flag("FLAGS_pallas_flash_min_seqlen", 1024,
+define_flag("FLAGS_pallas_flash_min_seqlen", 8192,
             "min seq len to route scaled_dot_product_attention to the "
-            "pallas flash kernel (below it plain XLA attention wins)")
+            "pallas flash kernel. Measured on v5e (bf16, d=64, fwd+bwd, "
+            "1024-blocks): standalone the kernel wins from ~4096 and is "
+            "3.3x at 8192, but under whole-block remat XLA attention "
+            "stays ahead through 4096 in full-model training; at 8192 "
+            "XLA's O(s^2) score materialization OOMs 16G HBM outright "
+            "while the flash kernel trains (gpt3-350m bs1: 2464 tok/s)")

@@ -1,0 +1,70 @@
+"""jit.save/load (StableHLO export round trip) + amp accuracy-compare
+tooling tests.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import load as jit_load, save as jit_save
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+        self.bn = nn.BatchNorm1D(16)
+
+    def forward(self, x):
+        return self.fc2(self.bn(paddle.tanh(self.fc1(x))))
+
+
+class TestJitSaveLoad:
+    def test_round_trip_without_model_class(self, tmp_path):
+        paddle.seed(0)
+        net = TinyNet()
+        net.eval()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((3, 8))
+            .astype("float32"))
+        ref = net(x).numpy()
+        path = str(tmp_path / "model")
+        jit_save(net, path, input_spec=[x])
+        assert os.path.exists(path + ".pdmodel")
+        assert os.path.exists(path + ".pdparams")
+
+        loaded = jit_load(path)
+        out = loaded(x).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        with pytest.raises(RuntimeError):
+            loaded.train()
+
+    def test_params_only_save(self, tmp_path):
+        net = TinyNet()
+        path = str(tmp_path / "m2")
+        jit_save(net, path)          # no input_spec: params only
+        assert os.path.exists(path + ".pdparams")
+        assert not os.path.exists(path + ".pdmodel")
+        with pytest.raises(FileNotFoundError):
+            jit_load(path)
+
+
+class TestCompareAccuracy:
+    def test_dump_and_compare(self, tmp_path):
+        from paddle_tpu.amp.debugging import compare_accuracy, dump_tensor
+
+        a_dir, b_dir = str(tmp_path / "a"), str(tmp_path / "b")
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        y = paddle.to_tensor(np.ones((4, 4), np.float32) * 1.001)
+        dump_tensor("layer1.out", x, a_dir)
+        dump_tensor("layer1.out", y, b_dir)
+        dump_tensor("only_a", x, a_dir)
+        out_csv = str(tmp_path / "report.csv")
+        rows = compare_accuracy(a_dir, b_dir, out_csv)
+        assert len(rows) == 1
+        assert abs(rows[0]["max_abs_err"] - 0.001) < 1e-6
+        text = open(out_csv).read()
+        assert "ONLY IN RUN A" in text

@@ -141,6 +141,7 @@ class TestGPTPipeParity:
             np.asarray(sd["gpt.wte.weight"].grad._data),
             np.asarray(pipe.wte.weight.grad._data), atol=1e-5)
 
+    @pytest.mark.slow
     def test_pp4_loss_matches(self):
         cfg = _tiny_cfg()
         mesh = _mesh(4)

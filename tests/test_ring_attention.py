@@ -144,6 +144,7 @@ class TestSegmentParallel:
 
 
 class TestGPTRingAttention:
+    @pytest.mark.slow
     def test_gpt_with_ring_matches_plain(self):
         """GPT with use_ring_attention on a sep mesh == plain GPT."""
         from paddle_tpu.distributed import env as denv

@@ -1,0 +1,134 @@
+"""TCPStore / master rendezvous / watcher / elastic tests
+(reference tcp_store.h, controllers/master.py:73, watcher.py:24,
+elastic/manager.py:125 roles).
+"""
+import struct
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, native_available
+from paddle_tpu.distributed.launch.controllers.master import Master
+from paddle_tpu.distributed.launch.controllers.watcher import Watcher
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, parse_np_range,
+)
+
+
+class TestTCPStore:
+    def _roundtrip(self, store):
+        store.set("alpha", b"hello")
+        assert store.get("alpha") == b"hello"
+        assert store.add("ctr", 3) == 3
+        assert store.add("ctr", 2) == 5
+        raw = store.get("ctr")
+        assert struct.unpack("<q", raw)[0] == 5
+        store.wait(["alpha", "ctr"], timeout=2)
+        with pytest.raises(TimeoutError):
+            s2 = TCPStore("127.0.0.1", store.port, is_master=False,
+                          timeout=0.3)
+            s2.get("missing-key")
+
+    def test_native_store(self):
+        if not native_available():
+            pytest.skip("no native toolchain")
+        store = TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                         timeout=5)
+        try:
+            self._roundtrip(store)
+        finally:
+            store.shutdown()
+
+    def test_python_fallback_store(self, monkeypatch):
+        import paddle_tpu.distributed.store as st
+
+        monkeypatch.setattr(st, "_lib", None)
+        monkeypatch.setattr(st, "_lib_tried", True)
+        store = st.TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                            timeout=5)
+        try:
+            self._roundtrip(store)
+        finally:
+            store.shutdown()
+
+    def test_concurrent_clients(self):
+        store = TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                         timeout=10)
+        try:
+            def worker(i):
+                c = TCPStore("127.0.0.1", store.port, is_master=False,
+                             timeout=10)
+                c.add("total", i)
+                c.set(f"k{i}", str(i).encode())
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(1, 9)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert struct.unpack("<q", store.get("total"))[0] == sum(
+                range(1, 9))
+            for i in range(1, 9):
+                assert store.get(f"k{i}") == str(i).encode()
+        finally:
+            store.shutdown()
+
+
+class TestMasterRendezvous:
+    def test_two_node_sync_peers(self):
+        m0 = Master("127.0.0.1:0", rank=0, nnodes=2, timeout=15)
+        port = m0.store.port
+        results = {}
+
+        def node1():
+            m1 = Master(f"127.0.0.1:{port}", rank=1, nnodes=2, timeout=15)
+            results[1] = m1.sync_peers("10.0.0.2:9000")
+
+        t = threading.Thread(target=node1)
+        t.start()
+        results[0] = m0.sync_peers("10.0.0.1:9000")
+        t.join()
+        try:
+            assert results[0] == results[1] == ["10.0.0.1:9000",
+                                                "10.0.0.2:9000"]
+        finally:
+            m0.shutdown()
+
+
+class TestWatcher:
+    def test_stale_peer_detected(self):
+        m0 = Master("127.0.0.1:0", rank=0, nnodes=2, timeout=10)
+        port = m0.store.port
+        m1 = Master(f"127.0.0.1:{port}", rank=1, nnodes=2, timeout=10)
+        try:
+            m1.heartbeat()  # rank 1 beats once, then "dies"
+            time.sleep(0.2)
+            w = Watcher(m0, interval=0.1, stale_after=0.5).start()
+            assert w.peer_failed.wait(timeout=10)
+            assert 1 in w.failed_ranks
+            w.stop()
+        finally:
+            m0.shutdown()
+
+
+class TestElastic:
+    def test_parse_np_range(self):
+        assert parse_np_range("2:4") == (2, 4)
+        assert parse_np_range(3) == (3, 3)
+        with pytest.raises(ValueError):
+            parse_np_range("4:2")
+
+    def test_partial_world_rendezvous(self):
+        """min 1, max 3: a single node proceeds once the timeout window
+        allows a partial world (reference elastic scale-in)."""
+        em = ElasticManager("127.0.0.1:0", rank=0, np_spec="1:3",
+                            elastic_timeout=1.0)
+        try:
+            peers = em.register_and_sync("10.0.0.1:9000")
+            assert peers == ["10.0.0.1:9000"]
+            em.next_generation()
+            assert em.gen == 1
+        finally:
+            em.shutdown()

@@ -20,6 +20,7 @@ class TestModels:
         out = m(x)
         assert out.shape == [2, 10]
 
+    @pytest.mark.slow
     def test_resnet18_forward_backward(self):
         m = resnet18(num_classes=10)
         x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"),
@@ -34,11 +35,13 @@ class TestModels:
         n = sum(p.size for p in m.parameters())
         assert abs(n - 25_557_032) < 60_000, n  # torchvision resnet50 ≈25.6M
 
+    @pytest.mark.slow
     def test_vgg11_forward(self):
         m = vgg11(num_classes=7)
         x = paddle.to_tensor(np.random.randn(1, 3, 224, 224).astype("float32"))
         assert m(x).shape == [1, 7]
 
+    @pytest.mark.slow
     def test_mobilenetv2_forward(self):
         m = mobilenet_v2(num_classes=5)
         x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
