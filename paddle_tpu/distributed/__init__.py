@@ -67,3 +67,5 @@ def launch():
     from .launch.main import main
 
     main()
+from . import auto_tuner  # noqa: E402,F401
+from .auto_parallel import DistModel, Strategy, to_static  # noqa: E402,F401

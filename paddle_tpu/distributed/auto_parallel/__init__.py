@@ -234,3 +234,6 @@ def get_placements(tensor) -> list:
 
 def moe_global_mesh_tensor(*args, **kwargs):
     raise NotImplementedError("MoE mesh tensors land with the EP module")
+
+
+from .engine import DistModel, Strategy, to_static  # noqa: E402,F401

@@ -1,0 +1,169 @@
+"""Auto-parallel engine-lite: Strategy / to_static / DistModel.
+
+Reference parity: python/paddle/distributed/auto_parallel/api.py —
+``Strategy`` (:1685), ``to_static`` (:2446), ``DistModel`` (:1966). The
+reference's static pipeline (engine.py, parallelizer_v2, partitioner,
+completion passes — 49k LoC) re-plans a ProgramDesc; on TPU the plan IS
+the sharding layout already carried by the params (NamedSharding +
+GSPMD completion), so to_static reduces to: apply strategy wrappers
+(ZeRO stage, AMP level, gradient accumulation), then compile train/eval/
+predict steps through the fused TrainStep/jit machinery.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class _Config(dict):
+    """Attribute-style config node (reference Strategy sub-configs)."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class Strategy:
+    """Reference api.py:1685 — knobs the engine honors."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = _Config(enable=False, degree=-1, stage=1)
+        self.amp = _Config(enable=False, level="O2", dtype="bfloat16")
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                accumulate_steps=1, micro_batch_size=-1)
+        self.gradient_merge = _Config(enable=False, k_steps=1)
+        self.recompute = _Config(enable=False, policy=None)
+        for k, v in config.items():
+            getattr(self, k).update(v)
+
+
+class DistModel:
+    """Reference api.py:1966 — a mode-switchable compiled model.
+
+    train(): __call__(*batch) runs ONE fused optimizer step, returns loss.
+    eval(): __call__ returns the loss with no state mutation.
+    predict(): __call__ returns the network outputs.
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy: Optional[Strategy] = None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._strategy = strategy or Strategy()
+        self._mode = None
+        self._train_step = None
+        self._eval_fn = None
+        self._predict_fn = None
+        self._optimizer = self._apply_strategy(layer, optimizer)
+        if optimizer is not None and loss is not None:
+            self.train()
+        elif loss is not None:
+            self.eval()
+        else:
+            self.predict()
+
+    # -- strategy application -------------------------------------------
+    def _apply_strategy(self, layer, optimizer):
+        s = self._strategy
+        if s.amp.enable and optimizer is not None:
+            from ...amp import decorate
+
+            layer, optimizer = decorate(models=layer, optimizers=optimizer,
+                                        level=s.amp.level,
+                                        dtype=s.amp.dtype)
+            self.network = layer
+        if s.recompute.enable:
+            for sub in layer.sublayers(include_self=True):
+                if hasattr(sub, "_use_recompute"):
+                    sub._use_recompute = True
+                    if hasattr(sub, "_recompute_policy"):
+                        sub._recompute_policy = s.recompute.policy
+        if s.sharding.enable and optimizer is not None:
+            from ...distributed.fleet import DygraphShardingOptimizer
+
+            if not isinstance(optimizer, DygraphShardingOptimizer):
+                optimizer = DygraphShardingOptimizer(optimizer)
+            if s.sharding.stage >= 3:
+                from ...distributed.sharding import GroupShardedStage3
+
+                self.network = GroupShardedStage3(layer, optimizer)
+            elif s.sharding.stage == 2:
+                from ...distributed.sharding import GroupShardedStage2
+
+                self.network = GroupShardedStage2(layer, optimizer)
+        return optimizer
+
+    def _accumulate_steps(self):
+        s = self._strategy
+        if s.pipeline.enable:
+            return max(int(s.pipeline.accumulate_steps), 1)
+        if s.gradient_merge.enable:
+            return max(int(s.gradient_merge.k_steps), 1)
+        return 1
+
+    # -- modes -----------------------------------------------------------
+    def train(self):
+        if self._loss is None or self._optimizer is None:
+            raise ValueError("train mode needs loss and optimizer")
+        self.network.train()
+        if self._train_step is None:
+            from ...jit import TrainStep
+
+            loss_fn = self._loss
+
+            def wrapped(model, *batch):
+                out = model(*batch[:-1])
+                return loss_fn(out, batch[-1])
+
+            self._train_step = TrainStep(
+                self.network, wrapped, self._optimizer,
+                accumulate_steps=self._accumulate_steps())
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise ValueError("eval mode needs a loss")
+        self.network.eval()
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self.network.eval()
+        self._mode = "predict"
+        return self
+
+    # -- execution --------------------------------------------------------
+    def __call__(self, *batch):
+        if self._mode == "train":
+            return self._train_step(*batch)
+        from ...framework.autograd import no_grad
+
+        with no_grad():
+            if self._mode == "eval":
+                out = self.network(*batch[:-1])
+                return self._loss(out, batch[-1])
+            return self.network(*batch)
+
+    # -- parity helpers ---------------------------------------------------
+    def dist_loader(self):
+        return self._loader
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self.network.set_state_dict(sd, *a, **k)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """Reference api.py:2446 — build the compiled DistModel."""
+    return DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy)

@@ -1,0 +1,6 @@
+"""Parallel-config auto-tuner
+(reference python/paddle/distributed/auto_tuner/).
+"""
+from .tuner import AutoTuner, Candidate, estimate_memory_gb  # noqa: F401
+from .prune import prune_candidates  # noqa: F401
+from .search import grid_candidates  # noqa: F401

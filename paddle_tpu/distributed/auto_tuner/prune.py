@@ -1,0 +1,31 @@
+"""Feasibility pruning (reference auto_tuner/prune.py rules)."""
+from __future__ import annotations
+
+
+def prune_candidates(cands, spec, hbm_gb):
+    from .tuner import estimate_memory_gb
+
+    for c in cands:
+        if spec.num_heads % c.mp:
+            c.pruned_reason = f"heads {spec.num_heads} % mp {c.mp}"
+            continue
+        if spec.num_layers % c.pp:
+            c.pruned_reason = f"layers {spec.num_layers} % pp {c.pp}"
+            continue
+        if spec.hidden_size % c.mp:
+            c.pruned_reason = f"hidden {spec.hidden_size} % mp {c.mp}"
+            continue
+        if spec.global_batch % max(c.dp, 1):
+            c.pruned_reason = f"batch {spec.global_batch} % dp {c.dp}"
+            continue
+        per_dp = spec.global_batch // max(c.dp, 1)
+        if per_dp % max(c.micro_batch, 1):
+            c.pruned_reason = (f"per-dp batch {per_dp} % micro "
+                               f"{c.micro_batch}")
+            continue
+        mem = estimate_memory_gb(spec, c)
+        if mem > hbm_gb:
+            c.pruned_reason = f"OOM estimate {mem:.1f}GB > {hbm_gb}GB"
+            continue
+        c.pruned_reason = None
+    return cands

@@ -1,0 +1,165 @@
+"""Auto-tuner over hybrid-parallel configurations.
+
+Reference parity: python/paddle/distributed/auto_tuner/tuner.py — generate
+candidate (dp, mp, pp, sharding, micro_batch) configs, prune infeasible
+ones, rank by a cost model, optionally measure the survivors. TPU-first
+cost model: the scaling-book decomposition — per-step compute
+flops/(chips*peak), TP activation collectives over ICI per layer, PP
+bubble (pp-1)/micro, ZeRO gather/scatter traffic — with an HBM-fit
+estimator doing the hard pruning (OOM is the expensive failure the
+reference tuner exists to avoid).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .prune import prune_candidates
+from .search import grid_candidates
+
+
+@dataclass
+class Candidate:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding_stage: int = 0      # 0=none, 1/2=state/grad shard, 3=param
+    micro_batch: int = 1
+    estimated_step_ms: float = 0.0
+    estimated_mem_gb: float = 0.0
+    measured_step_ms: Optional[float] = None
+    pruned_reason: Optional[str] = None
+
+    @property
+    def degree(self):
+        return self.dp * self.mp * self.pp
+
+    def hybrid_configs(self):
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp,
+                "sharding_degree": self.dp if self.sharding_stage else 1}
+
+
+@dataclass
+class ModelSpec:
+    """What the cost/memory model needs to know about the workload."""
+
+    params: int                      # total parameter count
+    num_layers: int
+    hidden_size: int
+    num_heads: int
+    vocab_size: int
+    seq_len: int
+    global_batch: int
+    param_bytes: int = 2             # bf16 params
+    master_bytes: int = 12           # fp32 master + 2 adam moments
+    use_recompute: bool = True
+
+
+def estimate_memory_gb(spec: ModelSpec, c: Candidate) -> float:
+    """Per-chip HBM estimate (the pruner's core).
+
+    params shard over mp*pp (+ dp when stage 3); optimizer state over
+    mp*pp (* dp when stage>=1); activations over dp (batch) and pp
+    (layers), ~2 bytes/elem with remat keeping ~4 tensors/layer live.
+    """
+    p_shard = c.mp * c.pp * (c.dp if c.sharding_stage == 3 else 1)
+    o_shard = c.mp * c.pp * (c.dp if c.sharding_stage >= 1 else 1)
+    param_gb = spec.params * spec.param_bytes / p_shard / 1e9
+    opt_gb = spec.params * spec.master_bytes / o_shard / 1e9
+    mb = max(1, spec.global_batch // max(c.dp, 1) // max(c.micro_batch, 1))
+    live_per_layer = 4 if spec.use_recompute else 34
+    act_gb = (mb * spec.seq_len * spec.hidden_size
+              * (spec.num_layers // c.pp) * live_per_layer * 2 / c.mp) / 1e9
+    logits_gb = mb * spec.seq_len * spec.vocab_size * 4 / c.mp / 1e9
+    return param_gb + opt_gb + act_gb + logits_gb
+
+
+def estimate_step_ms(spec: ModelSpec, c: Candidate, *,
+                     peak_flops=197e12, ici_gbps=400e9,
+                     hbm_gbps=819e9) -> float:
+    """Scaling-book style step-time decomposition (coarse, for RANKING --
+    absolute numbers come from measured trials)."""
+    tokens = spec.global_batch * spec.seq_len
+    flops = 6 * spec.params * tokens * (4 / 3 if spec.use_recompute else 1)
+    compute_ms = flops / (c.degree * peak_flops) * 1e3
+    # TP: 2 allreduces of activations per layer (fwd+bwd doubles)
+    if c.mp > 1:
+        act_bytes = (spec.global_batch // c.dp) * spec.seq_len \
+            * spec.hidden_size * 2
+        tp_ms = (4 * act_bytes * (c.mp - 1) / c.mp / ici_gbps) \
+            * spec.num_layers / c.pp * 1e3
+    else:
+        tp_ms = 0.0
+    # PP bubble inflates compute by (pp-1)/micro
+    bubble = (c.pp - 1) / max(c.micro_batch, 1)
+    # DP/ZeRO grad sync: each replica allreduces only ITS param shard
+    # (params / (mp*pp)) around the dp ring
+    if c.dp > 1:
+        local_params = spec.params / (c.mp * c.pp)
+        dp_ms = 2 * local_params * spec.param_bytes * (c.dp - 1) / c.dp \
+            / ici_gbps * 1e3
+    else:
+        dp_ms = 0.0
+    # HBM floor: optimizer sweep
+    hbm_ms = spec.params * spec.master_bytes / (
+        c.mp * c.pp * (c.dp if c.sharding_stage >= 1 else 1)) / hbm_gbps * 1e3
+    return compute_ms * (1 + bubble) + tp_ms + dp_ms + hbm_ms
+
+
+class AutoTuner:
+    """Reference tuner.py role: propose -> prune -> rank -> (measure).
+
+    Args:
+      spec: ModelSpec of the workload.
+      n_devices: chips available.
+      hbm_gb: per-chip HBM budget.
+      runner: optional callable(Candidate) -> measured step ms; called by
+        `measure(top_k)` on the best-ranked survivors (the reference
+        launches real trials; here the caller decides how to run one).
+    """
+
+    def __init__(self, spec: ModelSpec, n_devices: int, hbm_gb: float = 16.0,
+                 runner: Optional[Callable] = None,
+                 sharding_stages=(0, 1, 3), max_micro=64):
+        self.spec = spec
+        self.n_devices = n_devices
+        self.hbm_gb = hbm_gb
+        self.runner = runner
+        self.sharding_stages = sharding_stages
+        self.max_micro = max_micro
+        self.history: list[Candidate] = []
+
+    def candidates(self) -> list[Candidate]:
+        cands = grid_candidates(self.n_devices, self.sharding_stages,
+                                self.max_micro, self.spec.global_batch)
+        cands = prune_candidates(cands, self.spec, self.hbm_gb)
+        for c in cands:
+            if c.pruned_reason is None:
+                c.estimated_mem_gb = estimate_memory_gb(self.spec, c)
+                c.estimated_step_ms = estimate_step_ms(self.spec, c)
+        live = [c for c in cands if c.pruned_reason is None]
+        live.sort(key=lambda c: c.estimated_step_ms)
+        self.history = cands
+        return live
+
+    def search_once(self) -> Optional[Candidate]:
+        """Best candidate by the cost model (reference search_once)."""
+        live = self.candidates()
+        return live[0] if live else None
+
+    def measure(self, top_k: int = 3) -> Optional[Candidate]:
+        """Run the runner on the top_k model-ranked candidates; returns the
+        fastest measured one."""
+        if self.runner is None:
+            raise ValueError("no runner provided")
+        best = None
+        for c in self.candidates()[:top_k]:
+            try:
+                c.measured_step_ms = float(self.runner(c))
+            except Exception as e:       # OOM'd trial = pruned, keep going
+                c.pruned_reason = f"trial failed: {type(e).__name__}"
+                continue
+            if best is None or c.measured_step_ms < best.measured_step_ms:
+                best = c
+        return best

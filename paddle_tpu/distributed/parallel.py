@@ -70,9 +70,19 @@ class DataParallel(Layer):
 
     @contextlib.contextmanager
     def no_sync(self):
-        """Gradient-accumulation guard (reference parallel.py no_sync). With
-        GSPMD the sync happens inside the compiled step regardless; the guard
-        is kept for API parity and is a no-op."""
+        """Gradient-accumulation guard (reference parallel.py no_sync).
+
+        Under GSPMD the dp gradient reduction happens INSIDE each compiled
+        backward (the loss reduces over the globally-sharded batch), so
+        there is no standalone all-reduce this context could elide: jax's
+        `unreduced` partial placement, which would express a deferred
+        reduction, exists only in the Explicit-sharding mode, not the Auto
+        mode this framework compiles with. Eagerly this guard is therefore
+        semantically complete but saves no communication. For efficient
+        accumulation use ``TrainStep(..., accumulate_steps=N)`` — the
+        micro-batch loop compiles into ONE program where XLA schedules and
+        fuses the reductions.
+        """
         self._grad_need_sync = False
         try:
             yield

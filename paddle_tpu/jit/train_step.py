@@ -102,7 +102,8 @@ class TrainStep:
     executable cache — jax.jit's own).
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True):
+    def __init__(self, model, loss_fn, optimizer, donate=True,
+                 accumulate_steps=1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer             # outer (may be a wrapper)
@@ -112,6 +113,11 @@ class TrainStep:
         self._buffers = None
         self._jitted = None
         self._donate = donate
+        # gradient accumulation INSIDE the fused program (the reference's
+        # no_sync/gradient-merge loop, compiled): the batch's dim 0 splits
+        # into `accumulate_steps` micro-batches; micro backwards accumulate
+        # on the tape's leaf grads and the optimizer steps once.
+        self.accumulate_steps = int(accumulate_steps)
 
     # -- state plumbing -------------------------------------------------
     def _resolve_slots(self):
@@ -141,11 +147,91 @@ class TrainStep:
         opt = self.optimizer        # outer wrapper drives the step
         inner = self._opt           # state owner gets the lr patch
 
+        # pin state OUTPUT layouts to the input layouts: without this,
+        # GSPMD may choose a different sharding for an updated param than
+        # the one the user placed, so call 2 sees new input layouts and
+        # recompiles (one stray executable per divergent layout)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # ... and canonicalize the INPUT layouts first: on a mesh program
+        # every output lands mesh-committed, so any state leaf that starts
+        # uncommitted/single-device (fresh optimizer scalars, rng offset)
+        # would key one extra executable on call 2. Replicate those onto
+        # the params' mesh up front.
+        mesh = next((p._data.sharding.mesh for p in self._params
+                     if isinstance(getattr(p._data, "sharding", None),
+                                   NamedSharding)), None)
+        if mesh is not None:
+            def _canon(leaf):
+                if not isinstance(leaf, jax.Array):
+                    return leaf
+                sh = getattr(leaf, "sharding", None)
+                if not isinstance(sh, NamedSharding):
+                    return jax.device_put(leaf, NamedSharding(
+                        mesh, PartitionSpec()))
+                # normalize trailing Nones: P('mp', None) and P('mp')
+                # are the same placement but UNEQUAL jit cache keys, and
+                # compiled outputs come back in the stripped form
+                axes = list(sh.spec)
+                while axes and axes[-1] is None:
+                    axes.pop()
+                norm = PartitionSpec(*axes)
+                if norm != sh.spec:
+                    return jax.device_put(leaf,
+                                          NamedSharding(sh.mesh, norm))
+                return leaf
+
+            canon_state = jax.tree_util.tree_map(_canon,
+                                                 self._extract_state())
+            self._inject_state(canon_state)
+
+        ref_state = self._extract_state()
+        ref_shardings = jax.tree_util.tree_map(
+            lambda leaf: leaf.sharding
+            if isinstance(leaf, jax.Array)
+            and isinstance(getattr(leaf, "sharding", None), NamedSharding)
+            else None, ref_state)
+
+        def _repin(new_state):
+            return jax.tree_util.tree_map(
+                lambda leaf, sh: jax.lax.with_sharding_constraint(leaf, sh)
+                if sh is not None else leaf,
+                new_state, ref_shardings)
+
+        acc = self.accumulate_steps
+        if acc > 1:
+            # every top-level batch Tensor splits along dim 0; a mixed bag
+            # of batch-major tensors and e.g. [seq, seq] masks would be
+            # silently mis-sliced, so insist on one shared batch size
+            sizes = {d.shape[0] for d in example_batch
+                     if hasattr(d, "shape") and d.ndim > 0}
+            if len(sizes) > 1:
+                raise ValueError(
+                    f"accumulate_steps={acc} needs all batch tensors "
+                    f"batch-major with one shared dim-0 size; got {sizes}")
+            if sizes and next(iter(sizes)) % acc:
+                raise ValueError(
+                    f"batch size {next(iter(sizes))} is not divisible by "
+                    f"accumulate_steps={acc}")
+
         def step_fn(state, lr, batch):
             self._inject_state(state)
             batch_t = _tree_wrap(batch)
-            loss = self.loss_fn(self.model, *batch_t)
-            loss.backward()
+            if acc > 1:
+                losses = []
+                for m in range(acc):
+                    micro = [
+                        Tensor._wrap(t._data.reshape(
+                            (acc, t._data.shape[0] // acc)
+                            + tuple(t._data.shape[1:]))[m])
+                        if isinstance(t, Tensor) else t for t in batch_t]
+                    ml = self.loss_fn(self.model, *micro) * (1.0 / acc)
+                    ml.backward()
+                    losses.append(ml._data)
+                loss = Tensor._wrap(sum(losses))
+            else:
+                loss = self.loss_fn(self.model, *batch_t)
+                loss.backward()
             # freeze lr at the traced scalar for this step
             prev_get_lr = inner.get_lr
             inner.get_lr = lambda: lr
@@ -154,7 +240,7 @@ class TrainStep:
             finally:
                 inner.get_lr = prev_get_lr
             opt.clear_grad()
-            new_state = self._extract_state()
+            new_state = _repin(self._extract_state())
             return loss._data, new_state
 
         donate = (0,) if self._donate else ()

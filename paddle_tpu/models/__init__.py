@@ -15,3 +15,18 @@ from .gpt_pipe import (  # noqa: F401
     GPTForCausalLMPipe,
     gpt_pipe_sharding_rules,
 )
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertModel,
+    BertForPretraining,
+    BertForSequenceClassification,
+    bert_config,
+)
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaModel,
+    LlamaForCausalLM,
+    LlamaPretrainingCriterion,
+    llama_config,
+    llama_sharding_rules,
+)

@@ -1,0 +1,207 @@
+"""BERT model family — the BASELINE config-3 target
+(BERT-base fine-tune, dygraph AMP O2 + sharding stage 1).
+
+Reference parity: the reference fine-tunes BERT through its dygraph AMP
+path (GradScaler, amp/grad_scaler.py:645) + DygraphShardingOptimizer.
+TPU-first: a plain pre-softmax-masked encoder in jnp; AMP O2 is the
+bf16-param + fp32-master layout the optimizer already implements.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops import creation as C
+
+__all__ = [
+    "BertConfig", "BertModel", "BertForSequenceClassification",
+    "BertForPretraining", "bert_config",
+]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 0          # 0 -> 4*hidden
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+BERT_CONFIGS = {
+    "bert-base": dict(hidden_size=768, num_layers=12,
+                      num_attention_heads=12),
+    "bert-large": dict(hidden_size=1024, num_layers=24,
+                       num_attention_heads=16),
+}
+
+
+def bert_config(name: str, **overrides) -> BertConfig:
+    kw = dict(BERT_CONFIGS[name])
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = C.arange(0, s, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = C.zeros([b, s], dtype="int64")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv = nn.Linear(h, 3 * h)
+        self.out = nn.Linear(h, h)
+        self.dropout_p = config.attention_dropout_prob
+
+    def forward(self, x, attention_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask, is_causal=False,
+            dropout_p=self.dropout_p, training=self.training)
+        return self.out(out.reshape([b, s, h]))
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = nn.LayerNorm(config.hidden_size,
+                                      epsilon=config.layer_norm_eps)
+        self.fc1 = nn.Linear(config.hidden_size, config.intermediate_size)
+        self.fc2 = nn.Linear(config.intermediate_size, config.hidden_size)
+        self.out_norm = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        # post-LN (original BERT)
+        x = self.attn_norm(x + self.dropout(
+            self.attention(x, attention_mask)))
+        x = self.out_norm(x + self.dropout(
+            self.fc2(F.gelu(self.fc1(x)))))
+        return x
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        from .. import ops
+
+        return ops.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList([BertLayer(config)
+                                     for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+        self._init_weights(config)
+
+    def _init_weights(self, config):
+        from ..framework.random import next_key
+
+        std = config.initializer_range
+        for _, p in self.named_parameters():
+            if p.ndim >= 2:
+                p._data = std * jax.random.normal(next_key(), p._data.shape,
+                                                  jnp.float32)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [b, s] 1/0 padding mask -> additive [b, 1, 1, s]
+            from ..ops._dispatch import unary
+
+            attention_mask = unary(
+                lambda m: (1.0 - m.astype(jnp.float32))[:, None, None, :]
+                * jnp.float32(-1e9), attention_mask, "bert_mask")
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        return x, self.pooler(x)
+
+
+class BertForSequenceClassification(nn.Layer):
+    """config-3 fine-tune head."""
+
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (reference BertForPretraining)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size,
+                                       config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_eps)
+        self.nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        hidden, pooled = self.bert(input_ids, token_type_ids,
+                                   attention_mask=attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(hidden)))
+        from .. import ops
+
+        mlm_logits = ops.matmul(
+            h, self.bert.embeddings.word_embeddings.weight,
+            transpose_y=True)
+        return mlm_logits, self.nsp(pooled)

@@ -1,0 +1,293 @@
+"""LLaMA model family — the BASELINE config-5 flagship
+(LLaMA-7B HybridParallel tp=4 pp=2 + sequence parallel).
+
+Reference parity: the reference trains LLaMA through its Fleet stack
+(fleet meta-parallel wrappers over mpu layers; fused kernels
+fused_rms_norm / fused_rope in paddle/phi/kernels/fusion/). TPU-first:
+RMSNorm/RoPE/SwiGLU are jnp expressions XLA fuses on its own; GQA K/V
+heads broadcast inside the einsum; TP/SP/ZeRO placement comes from
+`llama_sharding_rules` regexes consumed by the same GSPMD mechanism as
+the GPT family (match_sharding + NamedSharding), so every fleet wrapper
+(TrainStep, sharding stages, SegmentParallel, pipeline) composes
+unchanged.
+"""
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = [
+    "LlamaConfig", "LlamaForCausalLM", "LlamaModel",
+    "LlamaPretrainingCriterion", "llama_config", "llama_sharding_rules",
+]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 0            # 0 -> llama's 8/3 * hidden rule
+    num_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 0          # 0 -> MHA (= num heads); <n -> GQA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    hidden_dropout_prob: float = 0.0
+    use_recompute: bool = False
+    recompute_policy: str = None
+    use_ring_attention: bool = False
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            # llama rounds 8/3*h up to a multiple of 256
+            target = int(8 * self.hidden_size / 3)
+            self.intermediate_size = 256 * ((target + 255) // 256)
+        if not self.num_key_value_heads:
+            self.num_key_value_heads = self.num_attention_heads
+
+
+LLAMA_CONFIGS = {
+    "llama-7b": dict(hidden_size=4096, num_layers=32,
+                     num_attention_heads=32, intermediate_size=11008),
+    "llama-13b": dict(hidden_size=5120, num_layers=40,
+                      num_attention_heads=40, intermediate_size=13824),
+    "llama2-70b": dict(hidden_size=8192, num_layers=80,
+                       num_attention_heads=64, num_key_value_heads=8,
+                       intermediate_size=28672),
+    "tinyllama-1.1b": dict(hidden_size=2048, num_layers=22,
+                           num_attention_heads=32, num_key_value_heads=4,
+                           intermediate_size=5632),
+}
+
+
+def llama_config(name: str, **overrides) -> LlamaConfig:
+    kw = dict(LLAMA_CONFIGS[name])
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+class LlamaRMSNorm(nn.Layer):
+    def __init__(self, hidden_size, epsilon=1e-5):
+        super().__init__()
+        from ..nn.initializer import Constant
+
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=Constant(1.0))
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        return F.rms_norm(x, weight=self.weight, epsilon=self.epsilon)
+
+
+def _rope_tables(seq, dim, theta, dtype=jnp.float32):
+    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                     # [s, dim/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary_pos_emb(x, cos, sin):
+    """x: [b, s, h, d]; rotate-half convention (llama)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+class LlamaAttention(nn.Layer):
+    """GQA attention with RoPE. K/V heads repeat across query groups
+    inside the score einsum (no materialized repeat on HBM)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = h // self.num_heads
+        self.q_proj = nn.Linear(h, self.num_heads * self.head_dim,
+                                bias_attr=False)
+        self.k_proj = nn.Linear(h, self.num_kv_heads * self.head_dim,
+                                bias_attr=False)
+        self.v_proj = nn.Linear(h, self.num_kv_heads * self.head_dim,
+                                bias_attr=False)
+        self.o_proj = nn.Linear(self.num_heads * self.head_dim, h,
+                                bias_attr=False)
+        self.rope_theta = config.rope_theta
+        self._use_ring = config.use_ring_attention
+
+    def _ring_mesh(self, s):
+        if not self._use_ring:
+            return None
+        from ..distributed import env as denv
+
+        if not denv.is_initialized():
+            return None
+        mesh = denv.get_mesh()
+        if ("sep" in mesh.axis_names and mesh.shape["sep"] > 1
+                and s % int(mesh.shape["sep"]) == 0):
+            return mesh
+        return None
+
+    def forward(self, x):
+        b, s, h = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+
+        from ..ops._dispatch import nary
+
+        theta = self.rope_theta
+        hd = self.head_dim
+        groups = self.num_heads // self.num_kv_heads
+        ring_mesh = self._ring_mesh(s)
+
+        def attn(qd, kd, vd):
+            cos, sin = _rope_tables(s, hd, theta, jnp.float32)
+            qr = apply_rotary_pos_emb(qd.astype(jnp.float32), cos, sin
+                                      ).astype(qd.dtype)
+            kr = apply_rotary_pos_emb(kd.astype(jnp.float32), cos, sin
+                                      ).astype(kd.dtype)
+            if ring_mesh is not None:
+                from ..distributed.fleet.meta_parallel import ring_attention
+
+                kv_rep = jnp.repeat(kr, groups, axis=2)
+                vv_rep = jnp.repeat(vd, groups, axis=2)
+                return ring_attention(qr, kv_rep, vv_rep, mesh=ring_mesh,
+                                      axis="sep", causal=True)
+            # grouped scores: fold query groups, broadcast kv heads
+            qg = qr.reshape(b, s, self.num_kv_heads, groups, hd)
+            logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kr,
+                                preferred_element_type=jnp.float32)
+            logits = logits / math.sqrt(hd)
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask[None, None, None], logits,
+                               jnp.float32(-jnp.inf))
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(vd.dtype),
+                             vd, preferred_element_type=jnp.float32)
+            return out.reshape(b, s, self.num_heads, hd).astype(qd.dtype)
+
+        out = nary(attn, [q, k, v], "llama_attention")
+        return self.o_proj(out.reshape([b, s,
+                                        self.num_heads * self.head_dim]))
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = nn.Linear(h, m, bias_attr=False)
+        self.up_proj = nn.Linear(h, m, bias_attr=False)
+        self.down_proj = nn.Linear(m, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size,
+                                            config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
+                                                     config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+        self._use_recompute = config.use_recompute
+        self._recompute_policy = config.recompute_policy
+
+    def _inner(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+    def forward(self, x):
+        if self._use_recompute and self.training:
+            from ..distributed.fleet import recompute
+
+            return recompute(self._inner, x, policy=self._recompute_policy)
+        return self._inner(x)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(config)
+                                    for _ in range(config.num_layers)])
+        self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+        self._init_weights(config)
+
+    def _init_weights(self, config):
+        from ..framework.random import next_key
+
+        std = config.initializer_range
+        for name, p in self.named_parameters():
+            if p.ndim >= 2:
+                p._data = std * jax.random.normal(next_key(), p._data.shape,
+                                                  jnp.float32)
+                if re.search(r"(o_proj|down_proj)\.weight$", name):
+                    p._data = p._data / math.sqrt(2.0 * config.num_layers)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids):
+        hidden = self.llama(input_ids)
+        if self.lm_head is not None:
+            return self.lm_head(hidden)
+        from .. import ops
+
+        return ops.matmul(hidden, self.llama.embed_tokens.weight,
+                          transpose_y=True)
+
+
+# the GPT criterion is architecture-agnostic CE over shifted tokens
+from .gpt import GPTPretrainingCriterion as LlamaPretrainingCriterion  # noqa: E402
+
+
+def llama_sharding_rules(tp_axis="mp", fsdp_axis=None):
+    """Megatron TP placement for llama weights (+ optional ZeRO-3 dim).
+
+    Column-parallel: q/k/v/gate/up (out-features on tp);
+    row-parallel: o/down (in-features on tp); embeddings vocab-sharded.
+    """
+    return [
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight$",
+         (fsdp_axis, tp_axis)),
+        (r"(o_proj|down_proj)\.weight$", (tp_axis, fsdp_axis)),
+        (r"embed_tokens\.weight$", (tp_axis, fsdp_axis)),
+        (r"lm_head\.weight$", (fsdp_axis, tp_axis)),
+        (r"(layernorm|norm)\.weight$", (None,)),
+    ]

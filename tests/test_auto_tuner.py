@@ -1,0 +1,132 @@
+"""Auto-tuner tests (reference auto_tuner/ role: propose-prune-rank)."""
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner, estimate_memory_gb,
+)
+from paddle_tpu.distributed.auto_tuner.tuner import Candidate, ModelSpec
+
+
+def gpt13b_spec(batch=256):
+    return ModelSpec(params=13_000_000_000, num_layers=40, hidden_size=5120,
+                     num_heads=40, vocab_size=50304, seq_len=2048,
+                     global_batch=batch)
+
+
+def tiny_spec(batch=32):
+    return ModelSpec(params=350_000_000, num_layers=24, hidden_size=1024,
+                     num_heads=16, vocab_size=50304, seq_len=1024,
+                     global_batch=batch)
+
+
+class TestAutoTuner:
+    def test_prunes_oom_and_indivisible(self):
+        tuner = AutoTuner(gpt13b_spec(), n_devices=8, hbm_gb=16.0)
+        live = tuner.candidates()
+        # 13B on 8 chips: pure DP cannot fit (13B * 14B/param = 182GB)
+        assert all(not (c.dp == 8 and c.sharding_stage == 0) for c in live)
+        pruned = [c for c in tuner.history if c.pruned_reason]
+        assert any("OOM" in c.pruned_reason for c in pruned)
+        # indivisible mp pruned (heads=40 % mp 16 != 0 never generated on 8
+        # chips; hidden 5120 % 8 == 0 so check heads rule with mp=8: 40%8=0
+        # -> use a 3-head-hostile mesh instead)
+        for c in live:
+            assert 40 % c.mp == 0 and 40 % c.pp == 0
+
+    def test_ranking_prefers_fitting_configs(self):
+        tuner = AutoTuner(tiny_spec(), n_devices=8, hbm_gb=16.0)
+        best = tuner.search_once()
+        assert best is not None
+        assert best.estimated_mem_gb < 16.0
+        # 350M fits easily: expect no model parallel in the winner
+        assert best.mp * best.pp <= 2
+        assert best.degree == 8
+
+    def test_memory_model_monotone_in_sharding(self):
+        spec = gpt13b_spec()
+        base = Candidate(dp=8, mp=1, pp=1, sharding_stage=0, micro_batch=4)
+        z1 = Candidate(dp=8, mp=1, pp=1, sharding_stage=1, micro_batch=4)
+        z3 = Candidate(dp=8, mp=1, pp=1, sharding_stage=3, micro_batch=4)
+        m0 = estimate_memory_gb(spec, base)
+        m1 = estimate_memory_gb(spec, z1)
+        m3 = estimate_memory_gb(spec, z3)
+        assert m0 > m1 > m3
+
+    def test_measured_trials_pick_fastest(self):
+        calls = []
+
+        def runner(c):
+            calls.append(c)
+            return 100.0 / c.degree + 10 * c.pp  # fake: dp fastest
+
+        tuner = AutoTuner(tiny_spec(), n_devices=8, hbm_gb=16.0,
+                          runner=runner)
+        best = tuner.measure(top_k=3)
+        assert best is not None and best.measured_step_ms is not None
+        assert len(calls) == 3
+
+    def test_hybrid_configs_export(self):
+        c = Candidate(dp=2, mp=2, pp=2, sharding_stage=1, micro_batch=4)
+        hc = c.hybrid_configs()
+        assert hc == {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                      "sharding_degree": 2}
+
+
+class TestEngineToStatic:
+    def test_dist_model_train_eval_predict(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.distributed import Strategy, to_static
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.models import (
+            GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+        )
+
+        try:
+            denv.set_mesh(denv.build_mesh({"sharding": 8}))
+            paddle.seed(50)
+            cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_attention_heads=4,
+                            max_position_embeddings=16,
+                            hidden_dropout_prob=0.0,
+                            attention_dropout_prob=0.0)
+            model = GPTForCausalLM(cfg)
+            opt = popt.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+            strategy = Strategy({"sharding": {"enable": True, "stage": 1},
+                                 "gradient_merge": {"enable": True,
+                                                    "k_steps": 2}})
+            crit = GPTPretrainingCriterion()
+            dist_model = to_static(model, loss=crit, optimizer=opt,
+                                   strategy=strategy)
+            rng = np.random.default_rng(51)
+            ids = paddle.to_tensor(rng.integers(0, 64, (4, 16)),
+                                   dtype="int64")
+            labels = paddle.to_tensor(rng.integers(0, 64, (4, 16)),
+                                      dtype="int64")
+            losses = [float(dist_model(ids, labels)) for _ in range(3)]
+            assert losses[-1] < losses[0]
+            # ZeRO-1 came from the strategy: moments sharded
+            from jax.sharding import NamedSharding
+
+            mom = dist_model._optimizer._inner_opt._accumulators["moment1"]
+            assert any(
+                isinstance(v.sharding, NamedSharding)
+                and any(s is not None for s in (v.sharding.spec or ()))
+                for v in mom.values())
+            # eval: loss without state mutation
+            dist_model.eval()
+            before = np.asarray(model.parameters()[0]._data).copy()
+            l_eval = float(dist_model(ids, labels))
+            assert np.isfinite(l_eval)
+            np.testing.assert_array_equal(
+                np.asarray(model.parameters()[0]._data), before)
+            # predict: logits
+            dist_model.predict()
+            out = dist_model(ids)
+            assert out.shape == [4, 16, 64]
+        finally:
+            denv._state["initialized"] = False
+            denv._state["mesh"] = None

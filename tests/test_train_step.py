@@ -135,3 +135,38 @@ class TestToStatic:
         layer.bias.set_value(layer.bias.numpy() + 1.0)
         y2 = layer_static(x).numpy()
         np.testing.assert_allclose(y2, y1 * 2.0 + 1.0, rtol=1e-6)
+
+
+class TestAccumulateSteps:
+    def test_accumulation_matches_full_batch(self):
+        """TrainStep(accumulate_steps=N) == one full-batch step (same total
+        gradient; mean-loss scaling)."""
+        cfg = tiny_cfg(hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        paddle.seed(41)
+        m1, o1, s1 = build_step(cfg)
+        paddle.seed(41)
+        m2 = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        o2 = popt.AdamW(learning_rate=1e-3, parameters=m2.parameters())
+        s2 = TrainStep(m2, lambda m, i, l: crit(m(i), l), o2,
+                       accumulate_steps=2)
+        ids, labels = make_batch(cfg, b=4)
+        l1 = s1(ids, labels)
+        l2 = s2(ids, labels)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(
+                np.asarray(p1._data), np.asarray(p2._data),
+                rtol=2e-4, atol=1e-6)
+
+    def test_single_executable(self):
+        cfg = tiny_cfg()
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = TrainStep(model, lambda m, i, l: crit(m(i), l), opt,
+                         accumulate_steps=4)
+        ids, labels = make_batch(cfg, b=8)
+        for _ in range(3):
+            step(ids, labels)
+        assert step._jitted._cache_size() == 1
