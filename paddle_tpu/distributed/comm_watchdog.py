@@ -1,0 +1,133 @@
+"""Collective watchdog.
+
+Reference parity: CommTask/CommTaskManager
+(paddle/phi/core/distributed/comm_task_manager.h:37, IsTimeout
+comm_task.h:127, NCCL abort in nccl_comm_task.cc): a background thread
+tracks outstanding collectives and errors out instead of hanging forever.
+
+TPU-first: collectives live inside compiled programs, so the watchable
+unit is a *blocking device wait* (a step's result fetch, a barrier). The
+manager tracks entered waits; when one exceeds its deadline it logs the
+stuck tag loudly and — like the reference's abort-on-timeout mode —
+interrupts the main thread. A Python-level interrupt only lands at the
+next bytecode boundary, which a wait stuck INSIDE a PJRT C++ call never
+reaches; so like the reference's comm-abort, a second deadline
+(``hard_exit_grace``) escalates to ``os._exit`` — killing the process is
+the only reliable way out of a dead collective, and the launcher's
+restart/elastic machinery then takes over. Timeout default comes from
+FLAGS_distributed_timeout_sec.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from ..utils.log_helper import get_logger
+
+_logger = get_logger(__name__)
+
+
+class CommTaskManager:
+    def __init__(self, interval: float = 1.0, hard_exit_grace: float = None):
+        self._tasks = {}           # id -> (tag, start, deadline)
+        self._lock = threading.Lock()
+        self._interval = interval
+        self._thread = None
+        self._stop = threading.Event()
+        self.abort_on_timeout = True
+        # after interrupting, wait this long for the wait to unwind; a wait
+        # stuck in C++ never sees the interrupt, so then os._exit (None =
+        # never hard-exit; default 30s when aborting)
+        self.hard_exit_grace = hard_exit_grace
+        self._interrupted_at = None
+        self.timed_out: list[str] = []
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for tid, (tag, start, deadline) in list(self._tasks.items()):
+                    if now > deadline:
+                        expired.append((tid, tag, now - start))
+                        del self._tasks[tid]
+            for tid, tag, waited in expired:
+                self.timed_out.append(tag)
+                _logger.error(
+                    "comm watchdog: %r stuck for %.1fs (peer down or "
+                    "deadlocked collective)%s", tag, waited,
+                    " — interrupting main thread" if self.abort_on_timeout
+                    else "")
+                if self.abort_on_timeout:
+                    import _thread
+
+                    _thread.interrupt_main()
+                    if self._interrupted_at is None:
+                        self._interrupted_at = now
+            with self._lock:
+                if not self._tasks:
+                    # every guarded wait unwound (the interrupt landed);
+                    # stand down the escalation
+                    self._interrupted_at = None
+            # escalation: the interrupt only lands at a Python bytecode
+            # boundary; if the stuck wait is inside PJRT it never unwinds,
+            # so exit the process (reference: NCCL comm abort)
+            if (self._interrupted_at is not None
+                    and self.hard_exit_grace is not None
+                    and now - self._interrupted_at > self.hard_exit_grace):
+                _logger.error("comm watchdog: interrupt did not unwind "
+                              "within %.0fs — hard exit",
+                              self.hard_exit_grace)
+                import os
+
+                os._exit(6)
+
+    @contextlib.contextmanager
+    def watch(self, tag: str, timeout: float = None):
+        """Guard a blocking wait. Exits normally cancel the task; overruns
+        are reported (and interrupt the main thread when
+        abort_on_timeout)."""
+        if timeout is None:
+            from ..utils import flags
+
+            timeout = float(flags.get_flags(
+                ["FLAGS_distributed_timeout_sec"]
+            )["FLAGS_distributed_timeout_sec"])
+        self._ensure_thread()
+        tid = object()
+        start = time.monotonic()
+        with self._lock:
+            self._tasks[id(tid)] = (tag, start, start + timeout)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._tasks.pop(id(tid), None)
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_manager = None
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    global _manager
+    if _manager is None:
+        _manager = CommTaskManager()
+    return _manager
+
+
+def watch(tag: str, timeout: float = None):
+    """`with paddle_tpu.distributed.comm_watchdog.watch("step 12"): ...`"""
+    return get_comm_task_manager().watch(tag, timeout)

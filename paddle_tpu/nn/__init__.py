@@ -136,20 +136,5 @@ from . import initializer  # noqa: F401
 from .clip import clip_grad_norm_  # noqa: F401
 
 
-class utils:  # namespace parity: paddle.nn.utils
-    from .clip import clip_grad_norm_  # noqa: F401
-
-    @staticmethod
-    def parameters_to_vector(parameters, name=None):
-        from ..ops import concat, reshape
-
-        return concat([reshape(p, [-1]) for p in parameters], axis=0)
-
-    @staticmethod
-    def vector_to_parameters(vec, parameters, name=None):
-        offset = 0
-        for p in parameters:
-            n = p.size
-            chunk = vec[offset : offset + n]
-            p.set_value(chunk.reshape(p.shape))
-            offset += n
+from . import utils  # noqa: E402,F401  (spectral/weight norm, param vectors)
+from .layer.common import Unfold, Fold  # noqa: E402,F401

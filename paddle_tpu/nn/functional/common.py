@@ -272,3 +272,86 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is not None:
         return nary(lambda a, b, w, bb: f(a, b, w, bb), tensors + [ensure_tensor(bias)], "bilinear")
     return nary(f, tensors, "bilinear")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Reference nn/functional/vision.py affine_grid: theta [N,2,3] ->
+    sampling grid [N,H,W,2] in normalized [-1,1] coords."""
+    theta = ensure_tensor(theta)
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def f(th):
+        def lin(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+        ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # [H,W,3]
+        return jnp.einsum("hwk,njk->nhwj", base, th.astype(jnp.float32)
+                          ).astype(th.dtype)
+
+    return unary(f, theta, "affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Reference nn/functional/vision.py grid_sample (GPU kernel
+    paddle/phi/kernels/gpu/grid_sample_kernel.cu): sample x [N,C,H,W] at
+    grid [N,Ho,Wo,2] normalized coords. bilinear/nearest;
+    zeros/border/reflection padding."""
+    from ...ops._dispatch import nary
+
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode {mode!r} not supported")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"grid_sample padding_mode {padding_mode!r} not supported")
+
+    def _reflect(coord, size):
+        # triangular fold of the continuous coordinate into [0, size-1]
+        if size == 1:
+            return jnp.zeros_like(coord)
+        period = 2.0 * (size - 1)
+        c = jnp.mod(jnp.abs(coord), period)
+        return jnp.where(c > size - 1, period - c, c)
+
+    def f(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0].astype(jnp.float32), g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1.0) * (w - 1) / 2.0
+            fy = (gy + 1.0) * (h - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * w - 1.0) / 2.0
+            fy = ((gy + 1.0) * h - 1.0) / 2.0
+        if padding_mode == "reflection":
+            fx = _reflect(fx, w)
+            fy = _reflect(fy, h)
+
+        def fetch(ix, iy):
+            inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            cx = jnp.clip(ix, 0, w - 1)
+            cy = jnp.clip(iy, 0, h - 1)
+            val = v[jnp.arange(n)[:, None, None], :, cy, cx]  # [N,Ho,Wo,C]
+            if padding_mode == "zeros":
+                val = jnp.where(inb[..., None], val, 0.0)
+            return val
+
+        if mode == "nearest":
+            out = fetch(jnp.round(fx).astype(jnp.int32),
+                        jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = (fx - x0)[..., None]
+            wy = (fy - y0)[..., None]
+            out = (fetch(x0, y0) * (1 - wx) * (1 - wy)
+                   + fetch(x1, y0) * wx * (1 - wy)
+                   + fetch(x0, y1) * (1 - wx) * wy
+                   + fetch(x1, y1) * wx * wy)
+        return jnp.moveaxis(out, -1, 1).astype(v.dtype)  # [N,C,Ho,Wo]
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(grid)], "grid_sample")

@@ -1,0 +1,24 @@
+"""nn.utils parity (reference python/paddle/nn/utils/):
+spectral_norm / weight_norm wrappers, parameter vector helpers."""
+from .spectral_norm import SpectralNorm, spectral_norm  # noqa: F401
+from .weight_norm import weight_norm, remove_weight_norm  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    # built from ops so the result stays on the autograd tape (an
+    # L2-over-flattened-params loss must reach the parameters)
+    from ...ops import concat, reshape
+
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        chunk = vec._data[offset:offset + n].reshape(p._data.shape)
+        p._data = chunk.astype(p._data.dtype)   # keep the param's dtype
+        offset += n
+
+
+from ..clip import clip_grad_norm_  # noqa: E402,F401  (stub-era export)

@@ -1,0 +1,85 @@
+"""Spectral normalization.
+
+Reference parity: python/paddle/nn/utils/spectral_norm_hook.py (the
+spectral_norm wrapper) and nn.SpectralNorm — largest-singular-value
+normalization of a weight via power iteration, the u/v vectors carried as
+buffers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...framework.autograd import apply_op, no_grad
+from ...framework.random import next_key
+from ..layer.layers import Layer
+
+
+def _l2norm(v, eps):
+    return v / jnp.maximum(jnp.linalg.norm(v), eps)
+
+
+class SpectralNorm(Layer):
+    """Standalone layer: forward(weight) -> spectrally-normalized weight
+    (reference nn.SpectralNorm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        import jax
+
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.register_buffer("weight_u", Tensor(
+            _l2norm(jax.random.normal(next_key(), (h,), jnp.float32), eps)))
+        self.register_buffer("weight_v", Tensor(
+            _l2norm(jax.random.normal(next_key(), (w,), jnp.float32), eps)))
+
+    def forward(self, weight):
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def f(w, u, v):
+            mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = _l2norm(mat.T @ u, eps)
+                u = _l2norm(mat @ v, eps)
+            sigma = u @ mat @ v
+            return w / sigma, u, v
+
+        out, u, v = apply_op(f, [weight, self.weight_u, self.weight_v],
+                             name="spectral_norm")
+        with no_grad():
+            self.weight_u._data = u._data
+            self.weight_v._data = v._data
+        return out
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Wrap `layer` so `layer.weight` is spectrally normalized on every
+    forward (reference spectral_norm hook)."""
+    weight = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(weight.shape, dim=dim, power_iters=n_power_iterations,
+                      eps=eps)
+    layer.add_sublayer(f"{name}_spectral_norm", sn)
+    raw_name = f"{name}_orig"
+    layer.add_parameter(raw_name, weight)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    orig_forward = layer.forward
+
+    def hooked_forward(*args, **kwargs):
+        setattr(layer, name, sn(getattr(layer, raw_name)))
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = hooked_forward
+    return layer

@@ -1,0 +1,63 @@
+"""Weight normalization (reference python/paddle/nn/utils/weight_norm_hook.py):
+w = g * v / ||v||, with g and v as the trainable parameters.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...framework.autograd import apply_op
+from ..layer.layers import Parameter
+
+
+def _norm_except(v, dim):
+    if dim is None:
+        return jnp.linalg.norm(v)
+    dims = [d for d in range(v.ndim) if d != (dim % v.ndim)]
+    return jnp.sqrt(jnp.sum(v * v, axis=dims, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    weight = getattr(layer, name)
+    g = Parameter(_norm_except(weight._data, dim))
+    v = Parameter(weight._data)
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def compute():
+        def f(gv, vv):
+            return vv * (gv / jnp.maximum(_norm_except(vv, dim), 1e-12))
+
+        return apply_op(f, [g, v], name="weight_norm")
+
+    orig_forward = layer.forward
+
+    def hooked_forward(*args, **kwargs):
+        setattr(layer, name, compute())
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = hooked_forward
+    layer._weight_norm_name = name
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    g = getattr(layer, f"{name}_g")
+    v = getattr(layer, f"{name}_v")
+
+    def f(gv, vv):
+        return vv * (gv / jnp.maximum(_norm_except(vv, getattr(
+            layer, "_weight_norm_dim", 0)), 1e-12))
+
+    w = apply_op(f, [g, v], name="weight_norm")
+    del layer._parameters[f"{name}_g"]
+    del layer._parameters[f"{name}_v"]
+    layer.add_parameter(name, Parameter(w._data))
+    # restore the class forward (drops the hook closure)
+    try:
+        del layer.forward
+    except AttributeError:
+        pass
+    return layer

@@ -251,3 +251,46 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 
 def corrcoef(x, rowvar=True, name=None):
     return unary(lambda v: jnp.corrcoef(v, rowvar=rowvar), x, "corrcoef")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distances between row vectors
+    (reference python/paddle/tensor/linalg.py cdist;
+    kernel paddle/phi/kernels/cdist_kernel.h)."""
+    from ._dispatch import nary
+
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            d2 = jnp.sum(diff * diff, axis=-1)
+            # zero-distance pairs (the self-distance diagonal) have an
+            # infinite sqrt derivative; route them through a constant so
+            # the backward is the 0 subgradient, not NaN
+            safe = jnp.where(d2 > 0, d2, 1.0)
+            return jnp.where(d2 > 0, jnp.sqrt(safe), 0.0)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1),
+                         1.0 / p)
+
+    return nary(f, [x, y], "cdist")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each sub-tensor along `axis` to p-norm <= max_norm
+    (reference tensor/math.py renorm)."""
+    from ._dispatch import unary
+
+    def f(v):
+        dims = [d for d in range(v.ndim) if d != (axis % v.ndim)]
+        norms = jnp.power(
+            jnp.sum(jnp.power(jnp.abs(v), p), axis=dims, keepdims=True),
+            1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return v * factor
+
+    return unary(f, x, "renorm")

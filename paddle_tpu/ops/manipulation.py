@@ -587,3 +587,23 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
 
 def cast(x, dtype):
     return ensure_tensor(x).astype(dtype)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference stride kernels,
+    paddle/phi/kernels/stride/as_strided_kernel.cc). XLA arrays are not
+    strided buffers, so this materializes the gather the view describes —
+    same values, functional semantics."""
+    from ._dispatch import unary
+
+    def f(v):
+        flat = v.reshape(-1)
+        # int64 indices: int32 overflows for >=2^31-element bases or large
+        # offset/stride products (silently wrong gather results)
+        idx = jnp.full((), int(offset), jnp.int64)
+        for dim, st in zip(shape, stride):
+            ar = jnp.arange(dim, dtype=jnp.int64) * int(st)
+            idx = idx[..., None] + ar
+        return flat[idx]
+
+    return unary(f, x, "as_strided")

@@ -1,0 +1,144 @@
+"""New op/layer coverage: cdist/renorm/as_strided, Unfold/Fold,
+spectral/weight norm, grid_sample/affine_grid."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestNewOps:
+    def test_cdist_matches_scipy(self):
+        import scipy.spatial.distance as sd
+
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((4, 3)).astype("float32")
+        b = rng.standard_normal((6, 3)).astype("float32")
+        for p in (2.0, 1.0, float("inf")):
+            got = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b),
+                               p=p).numpy()
+            ref = sd.cdist(a, b, "minkowski", p=p) if p != float("inf") \
+                else sd.cdist(a, b, "chebyshev")
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_renorm(self):
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((3, 5)).astype("float32")
+                             * 4)
+        out = paddle.renorm(x, 2.0, 0, 1.0).numpy()
+        assert np.all(np.linalg.norm(out, axis=1) <= 1.0 + 1e-5)
+
+    def test_as_strided_windows(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        # sliding windows of 3, stride 1
+        out = paddle.as_strided(x, [6, 3], [1, 1]).numpy()
+        for i in range(6):
+            np.testing.assert_array_equal(out[i], np.arange(i, i + 3))
+
+
+class TestUnfoldFold:
+    def test_unfold_fold_round_trip(self):
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 6, 6))
+                             .astype("float32"))
+        u = nn.Unfold(kernel_sizes=2, strides=2)
+        cols = u(x)
+        assert cols.shape == [1, 2 * 2 * 2, 9]
+        f = nn.Fold(output_sizes=(6, 6), kernel_sizes=2, strides=2)
+        back = f(cols)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+
+
+class TestNormWrappers:
+    def test_spectral_norm_unit_sigma(self):
+        paddle.seed(3)
+        lin = nn.Linear(6, 4)
+        lin.weight._data = lin.weight._data * 10.0
+        nn.utils.spectral_norm(lin, n_power_iterations=20)
+        x = paddle.to_tensor(np.random.default_rng(4)
+                             .standard_normal((2, 6)).astype("float32"))
+        lin(x)  # runs the hook, sets lin.weight to the normalized value
+        s = np.linalg.svd(lin.weight.numpy(), compute_uv=False)
+        assert abs(s[0] - 1.0) < 1e-3
+
+    def test_weight_norm_preserves_function(self):
+        paddle.seed(5)
+        lin = nn.Linear(4, 3)
+        ref_w = lin.weight.numpy().copy()
+        x = paddle.to_tensor(np.random.default_rng(6)
+                             .standard_normal((2, 4)).astype("float32"))
+        ref = (x.numpy() @ ref_w) + lin.bias.numpy()
+        nn.utils.weight_norm(lin)
+        np.testing.assert_allclose(lin(x).numpy(), ref, rtol=1e-5)
+        # g and v are the trainable params now
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+
+
+class TestGridSample:
+    def test_identity_affine_grid_sample(self):
+        rng = np.random.default_rng(7)
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 5, 5))
+                             .astype("float32"))
+        theta = paddle.to_tensor(
+            np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 2, 5, 5], align_corners=True)
+        out = F.grid_sample(x, grid, align_corners=True)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+
+    def test_shift_out_of_bounds_zero_padded(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        theta = paddle.to_tensor(
+            np.array([[[1.0, 0, 2.0], [0, 1.0, 0]]], np.float32))  # shift x
+        grid = F.affine_grid(theta, [1, 1, 4, 4], align_corners=True)
+        out = F.grid_sample(x, grid, align_corners=True).numpy()
+        # shifted fully out on the right: half the columns are zeros
+        assert np.all(out[..., -1] == 0)
+
+    def test_nearest_mode(self):
+        x = paddle.to_tensor(
+            np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+        theta = paddle.to_tensor(
+            np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 1, 4, 4], align_corners=True)
+        out = F.grid_sample(x, grid, mode="nearest",
+                            align_corners=True)
+        np.testing.assert_array_equal(out.numpy(), x.numpy())
+
+
+class TestReviewFixes:
+    def test_cdist_zero_distance_grad_finite(self):
+        a = paddle.to_tensor(np.zeros((1, 2), np.float32),
+                             stop_gradient=False)
+        d = paddle.cdist(a, a)
+        d.sum().backward()
+        assert np.all(np.isfinite(np.asarray(a.grad._data)))
+
+    def test_vector_round_trip_keeps_dtype_and_grads(self):
+        import jax.numpy as jnp
+
+        paddle.seed(9)
+        lin = nn.Linear(3, 2)
+        lin.weight._data = lin.weight._data.astype(jnp.bfloat16)
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        assert not vec.stop_gradient          # differentiable
+        (vec * vec).sum().backward()
+        assert lin.weight.grad is not None
+        nn.utils.vector_to_parameters(
+            paddle.to_tensor(np.zeros(vec.shape, np.float32)),
+            lin.parameters())
+        assert str(lin.weight._data.dtype) == "bfloat16"  # dtype kept
+
+    def test_grid_sample_reflection_and_bad_mode(self):
+        x = paddle.to_tensor(np.arange(4, dtype="float32")
+                             .reshape(1, 1, 1, 4))
+        theta = paddle.to_tensor(
+            np.array([[[1.0, 0, 1.0], [0, 1.0, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 1, 1, 4], align_corners=True)
+        out = F.grid_sample(x, grid, padding_mode="reflection",
+                            align_corners=True).numpy()[0, 0, 0]
+        # x coords sample at [1.5, 2.5, 3.5->reflect 2.5, 4.5->reflect 1.5]
+        np.testing.assert_allclose(out, [1.5, 2.5, 2.5, 1.5], atol=1e-5)
+        with pytest.raises(ValueError):
+            F.grid_sample(x, grid, padding_mode="nope")

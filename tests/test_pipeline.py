@@ -116,6 +116,7 @@ def _copy_plain_into_pipe(plain, pipe, num_stages, lps, num_chunks=1):
 
 
 class TestGPTPipeParity:
+    @pytest.mark.slow
     def test_loss_and_grads_match_plain(self):
         cfg = _tiny_cfg()
         mesh = _mesh(2)

@@ -91,6 +91,7 @@ class TestRingAttention:
 
 
 class TestSegmentParallel:
+    @pytest.mark.slow
     def test_sep_wrapper_parity(self):
         """SEP-wrapped GPT forward/backward == unwrapped (GSPMD handles the
         seq-sharded attention resharding; reference segment_parallel.py:26)."""

@@ -132,3 +132,41 @@ class TestElastic:
             assert em.gen == 1
         finally:
             em.shutdown()
+
+
+class TestCommWatchdog:
+    def test_timeout_interrupts_main(self):
+        from paddle_tpu.distributed.comm_watchdog import CommTaskManager
+
+        mgr = CommTaskManager(interval=0.05)
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                with mgr.watch("stuck collective", timeout=0.2):
+                    time.sleep(5)   # the "hung" wait
+            assert "stuck collective" in mgr.timed_out
+        finally:
+            mgr.shutdown()
+
+    def test_fast_wait_untouched(self):
+        from paddle_tpu.distributed.comm_watchdog import CommTaskManager
+
+        mgr = CommTaskManager(interval=0.05)
+        try:
+            with mgr.watch("quick", timeout=5.0):
+                time.sleep(0.05)
+            time.sleep(0.2)
+            assert mgr.timed_out == []
+        finally:
+            mgr.shutdown()
+
+    def test_log_only_mode(self):
+        from paddle_tpu.distributed.comm_watchdog import CommTaskManager
+
+        mgr = CommTaskManager(interval=0.05)
+        mgr.abort_on_timeout = False
+        try:
+            with mgr.watch("slowpoke", timeout=0.1):
+                time.sleep(0.4)
+            assert "slowpoke" in mgr.timed_out
+        finally:
+            mgr.shutdown()
