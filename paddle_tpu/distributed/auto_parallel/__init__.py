@@ -237,3 +237,28 @@ def moe_global_mesh_tensor(*args, **kwargs):
 
 
 from .engine import DistModel, Strategy, to_static  # noqa: E402,F401
+
+
+def apply_sharding_rules(layer, rules, mesh=None):
+    """Place every parameter of `layer` per (regex, axis-spec) `rules` —
+    the generic per-layer SPMD entry (the role of the reference's 93
+    per-op spmd_rules files, applied at the weight level where GSPMD then
+    propagates). Axes are dropped per-param when the dim is absent from
+    the mesh or not divisible, so one rule set serves any mesh shape.
+
+    rules: list of (pattern, spec) where spec is a tuple of mesh-axis
+    names (or None) per dim — the format of gpt/llama_sharding_rules.
+    """
+    from ...models.gpt import match_sharding
+
+    if mesh is None:
+        mesh = env.get_mesh()
+
+    for name, p in layer.named_parameters():
+        spec = match_sharding(name, rules) or ()
+        axes = [a if (a and a in mesh.axis_names
+                      and p._data.shape[i] % mesh.shape[a] == 0) else None
+                for i, a in enumerate(spec)]
+        p._data = jax.device_put(
+            p._data, NamedSharding(mesh, P(*axes) if axes else P()))
+    return layer

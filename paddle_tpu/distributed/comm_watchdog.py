@@ -37,9 +37,10 @@ class CommTaskManager:
         self._stop = threading.Event()
         self.abort_on_timeout = True
         # after interrupting, wait this long for the wait to unwind; a wait
-        # stuck in C++ never sees the interrupt, so then os._exit (None =
-        # never hard-exit; default 30s when aborting)
-        self.hard_exit_grace = hard_exit_grace
+        # stuck in C++ never sees the interrupt, so then os._exit
+        # (None disables; 30s default)
+        self.hard_exit_grace = 30.0 if hard_exit_grace is None \
+            else hard_exit_grace
         self._interrupted_at = None
         self.timed_out: list[str] = []
 
@@ -57,7 +58,10 @@ class CommTaskManager:
                 for tid, (tag, start, deadline) in list(self._tasks.items()):
                     if now > deadline:
                         expired.append((tid, tag, now - start))
-                        del self._tasks[tid]
+                        # keep the entry (deadline -> inf) so the
+                        # escalation's "did it unwind" check still sees
+                        # the stuck wait; watch()'s finally removes it
+                        self._tasks[tid] = (tag, start, float("inf"))
             for tid, tag, waited in expired:
                 self.timed_out.append(tag)
                 _logger.error(

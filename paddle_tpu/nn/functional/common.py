@@ -310,12 +310,19 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             f"grid_sample padding_mode {padding_mode!r} not supported")
 
     def _reflect(coord, size):
-        # triangular fold of the continuous coordinate into [0, size-1]
+        # triangular fold: align_corners=True reflects about pixel CENTERS
+        # ([0, size-1]); align_corners=False about pixel BORDERS
+        # ([-0.5, size-0.5]) — reference/torch semantics
         if size == 1:
             return jnp.zeros_like(coord)
-        period = 2.0 * (size - 1)
-        c = jnp.mod(jnp.abs(coord), period)
-        return jnp.where(c > size - 1, period - c, c)
+        if align_corners:
+            period = 2.0 * (size - 1)
+            c = jnp.mod(jnp.abs(coord), period)
+            return jnp.where(c > size - 1, period - c, c)
+        period = 2.0 * size
+        c = jnp.mod(jnp.abs(coord + 0.5), period)
+        c = jnp.where(c > size, period - c, c)
+        return c - 0.5
 
     def f(v, g):
         n, c, h, w = v.shape

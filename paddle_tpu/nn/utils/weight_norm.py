@@ -40,16 +40,17 @@ def weight_norm(layer, name="weight", dim=0):
 
     layer.forward = hooked_forward
     layer._weight_norm_name = name
+    layer._weight_norm_dim = dim
     return layer
 
 
 def remove_weight_norm(layer, name="weight"):
     g = getattr(layer, f"{name}_g")
     v = getattr(layer, f"{name}_v")
+    dim = getattr(layer, "_weight_norm_dim", 0)
 
     def f(gv, vv):
-        return vv * (gv / jnp.maximum(_norm_except(vv, getattr(
-            layer, "_weight_norm_dim", 0)), 1e-12))
+        return vv * (gv / jnp.maximum(_norm_except(vv, dim), 1e-12))
 
     w = apply_op(f, [g, v], name="weight_norm")
     del layer._parameters[f"{name}_g"]

@@ -1,0 +1,138 @@
+"""Custom C++ ops.
+
+Reference parity: paddle/extension.h + python/paddle/utils/cpp_extension/
+— user-compiled C++ kernels registered as framework ops with autograd.
+TPU-first shape: the custom kernel is HOST code (the device path is XLA;
+custom device kernels would be Pallas), so a compiled function enters the
+graph through `jax.pure_callback` — it works eagerly AND inside jit/
+TrainStep programs, on CPU or as a host callback from TPU. A paired
+backward function makes the op differentiable via `jax.custom_vjp`.
+
+Contract for `load()`-built functions: `extern "C" void f(const T* in0,
+const T* in1..., T* out, int64_t n)` over flat arrays (elementwise-style;
+richer signatures can be wrapped by hand with `custom_op`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class CppExtension:
+    """Handle to a compiled .so (reference CppExtension role)."""
+
+    def __init__(self, so_path: str):
+        self.so_path = so_path
+        self.lib = ctypes.CDLL(so_path)
+
+    def elementwise(self, fn_name: str, n_inputs: int = 1,
+                    dtype=np.float32):
+        """Wrap `extern "C" void fn(const T* in..., T* out, int64_t n)` as
+        a numpy function."""
+        cfn = getattr(self.lib, fn_name)
+        ptr = np.ctypeslib.ndpointer(dtype=dtype, flags="C_CONTIGUOUS")
+        cfn.argtypes = [ptr] * n_inputs + [ptr, ctypes.c_int64]
+        cfn.restype = None
+
+        def call(*arrays):
+            arrays = [np.ascontiguousarray(a, dtype=dtype) for a in arrays]
+            out = np.empty_like(arrays[0])
+            cfn(*arrays, out, arrays[0].size)
+            return out
+
+        call.__name__ = fn_name
+        return call
+
+
+def load(name: str, sources, extra_cflags=None, build_directory=None,
+         verbose=False) -> CppExtension:
+    """Compile C++ sources into a loadable extension
+    (reference cpp_extension.load)."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in (
+        sources if isinstance(sources, (list, tuple)) else [sources])]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(so_path) or os.path.getmtime(so_path) < newest_src:
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o",
+                so_path] + srcs + (extra_cflags or []))
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True)
+        except FileNotFoundError as e:  # no toolchain: keep the contract
+            raise RuntimeError(f"cpp_extension build failed: {e}") from e
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{res.stderr}")
+        if verbose:
+            print(f"[cpp_extension] built {so_path}")
+    return CppExtension(so_path)
+
+
+def custom_op(forward, infer_meta=None, backward=None, name="custom_op"):
+    """Register a host function as a framework op.
+
+    Args:
+      forward: numpy function (arrays...) -> array.
+      infer_meta: (jax ShapeDtypeStructs...) -> output ShapeDtypeStruct;
+        default: same shape/dtype as input 0 (reference InferMeta role).
+      backward: numpy function (saved_inputs..., grad_out) -> tuple of
+        input grads; omitted = non-differentiable.
+
+    Returns a callable over paddle Tensors, usable eagerly and under jit.
+    """
+    from ..framework.tensor import Tensor
+    from ..ops._dispatch import nary
+
+    def default_meta(*avals):
+        return jax.ShapeDtypeStruct(avals[0].shape, avals[0].dtype)
+
+    meta = infer_meta or default_meta
+
+    def fwd_jax(*datas):
+        out_aval = meta(*[jax.ShapeDtypeStruct(d.shape, d.dtype)
+                          for d in datas])
+        return jax.pure_callback(
+            lambda *a: np.asarray(forward(*[np.asarray(x) for x in a]),
+                                  dtype=out_aval.dtype),
+            out_aval, *datas, vmap_method="sequential")
+
+    if backward is None:
+        op = fwd_jax
+    else:
+        @jax.custom_vjp
+        def op(*datas):
+            return fwd_jax(*datas)
+
+        def op_fwd(*datas):
+            return fwd_jax(*datas), datas
+
+        def op_bwd(saved, g):
+            avals = [jax.ShapeDtypeStruct(d.shape, d.dtype) for d in saved]
+
+            def host(*args):
+                *ins, gout = args
+                grads = backward(*[np.asarray(x) for x in ins],
+                                 np.asarray(gout))
+                grads = grads if isinstance(grads, (tuple, list)) else (
+                    grads,)
+                return tuple(np.asarray(gr, dtype=a.dtype)
+                             for gr, a in zip(grads, avals))
+            return jax.pure_callback(host, tuple(avals), *saved, g,
+                                     vmap_method="sequential")
+
+        op.defvjp(op_fwd, op_bwd)
+
+    def apply(*tensors):
+        return nary(op, [t if isinstance(t, Tensor) else Tensor(t)
+                         for t in tensors], name)
+
+    return apply
