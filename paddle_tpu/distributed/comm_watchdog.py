@@ -29,7 +29,7 @@ _logger = get_logger(__name__)
 
 
 class CommTaskManager:
-    def __init__(self, interval: float = 1.0, hard_exit_grace: float = None):
+    def __init__(self, interval: float = 1.0, hard_exit_grace: float = 30.0):
         self._tasks = {}           # id -> (tag, start, deadline)
         self._lock = threading.Lock()
         self._interval = interval
@@ -38,9 +38,8 @@ class CommTaskManager:
         self.abort_on_timeout = True
         # after interrupting, wait this long for the wait to unwind; a wait
         # stuck in C++ never sees the interrupt, so then os._exit
-        # (None disables; 30s default)
-        self.hard_exit_grace = 30.0 if hard_exit_grace is None \
-            else hard_exit_grace
+        # (pass None to disable escalation)
+        self.hard_exit_grace = hard_exit_grace
         self._interrupted_at = None
         self.timed_out: list[str] = []
 
@@ -76,10 +75,13 @@ class CommTaskManager:
                     if self._interrupted_at is None:
                         self._interrupted_at = now
             with self._lock:
-                if not self._tasks:
-                    # every guarded wait unwound (the interrupt landed);
-                    # stand down the escalation
-                    self._interrupted_at = None
+                still_stuck = any(dl == float("inf")
+                                  for _, _, dl in self._tasks.values())
+            if not still_stuck:
+                # every EXPIRED wait unwound (the interrupt landed); stand
+                # down — healthy concurrent waits must not keep the
+                # escalation armed
+                self._interrupted_at = None
             # escalation: the interrupt only lands at a Python bytecode
             # boundary; if the stuck wait is inside PJRT it never unwinds,
             # so exit the process (reference: NCCL comm abort)

@@ -84,6 +84,9 @@ class Layer:
             for store in (layers, buffers):
                 if store is not None and name in store:
                     del store[name]
+            # a prior plain assignment (e.g. `self.bias = None`) lives in
+            # the instance dict and would SHADOW the registered parameter
+            self.__dict__.pop(name, None)
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
@@ -91,6 +94,7 @@ class Layer:
             for store in (params, buffers):
                 if store is not None and name in store:
                     del store[name]
+            self.__dict__.pop(name, None)
             layers[name] = value
         elif params is not None and name in params:
             if value is None:
@@ -140,14 +144,17 @@ class Layer:
     def add_parameter(self, name, parameter):
         if parameter is not None and not isinstance(parameter, Parameter):
             raise TypeError("add_parameter expects a Parameter")
+        self.__dict__.pop(name, None)   # a prior plain attr would shadow
         self._parameters[name] = parameter
         return parameter
 
     def add_sublayer(self, name, sublayer):
+        self.__dict__.pop(str(name), None)
         self._sub_layers[str(name)] = sublayer
         return sublayer
 
     def register_buffer(self, name, tensor, persistable=True):
+        self.__dict__.pop(name, None)
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)

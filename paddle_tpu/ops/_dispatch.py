@@ -21,26 +21,74 @@ def ensure_tensor(x, dtype=None):
     return Tensor(x, dtype=dtype)
 
 
+_amp_state = None
+_amp_cast = None
+
+
+def _autocast(tensors, name):
+    """Per-op AMP casting — the role the reference's generated ad_funcs
+    play at their top (multiply_fwd_func.cc:48-70): under auto_cast,
+    white-listed ops pull float inputs to the AMP dtype, black-listed ops
+    to fp32. No-op (one attribute read) when AMP is off; the lazy import
+    keeps the hot path free of per-call module lookups."""
+    global _amp_state, _amp_cast
+    if _amp_state is None:
+        from ..amp.auto_cast import amp_cast, amp_state
+
+        _amp_state, _amp_cast = amp_state, amp_cast
+    if not _amp_state().enable:
+        return tensors
+    return [_amp_cast(t, name) if isinstance(t, Tensor) else t
+            for t in tensors]
+
+
+def _autocast_const(value, name):
+    """Cast a non-Tensor (closure-constant) float operand to the op's AMP
+    dest dtype — otherwise jnp promotion would upcast the result back to
+    fp32 and silently defeat AMP."""
+    if isinstance(value, (bool, int, float, complex)):
+        return value  # python scalars promote weakly already
+    global _amp_state
+    if _amp_state is None:
+        _autocast([], name)  # initialize the lazy imports
+    if not _amp_state().enable:
+        return value
+    from ..amp.auto_cast import amp_dest_dtype
+    from ..framework.dtype import to_jax_dtype
+
+    dst = amp_dest_dtype(name)
+    if dst is None:
+        return value
+    arr = jnp.asarray(value)
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        return arr.astype(to_jax_dtype(dst))
+    return value
+
+
 def unary(fn, x, name="", **attrs):
     x = ensure_tensor(x)
+    (x,) = _autocast([x], name)
     return apply_op(fn, [x], attrs=attrs, name=name)
 
 
 def binary(fn, x, y, name=""):
     xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
     if xt and yt:
+        x, y = _autocast([x, y], name)
         return apply_op(fn, [x, y], name=name)
     if xt:
-        yv = y._data if isinstance(y, Tensor) else y
+        (x,) = _autocast([x], name)
+        yv = _autocast_const(y, name)
         return apply_op(lambda a: fn(a, yv), [x], name=name)
     if yt:
-        xv = x
+        (y,) = _autocast([y], name)
+        xv = _autocast_const(x, name)
         return apply_op(lambda b: fn(xv, b), [y], name=name)
     return Tensor._wrap(fn(jnp.asarray(x), jnp.asarray(y)))
 
 
 def nary(fn, tensors, name="", **attrs):
-    tensors = [ensure_tensor(t) for t in tensors]
+    tensors = _autocast([ensure_tensor(t) for t in tensors], name)
     return apply_op(fn, tensors, attrs=attrs, name=name)
 
 

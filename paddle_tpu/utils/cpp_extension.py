@@ -58,7 +58,13 @@ def load(name: str, sources, extra_cflags=None, build_directory=None,
     build_dir = build_directory or os.path.join(
         tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
     os.makedirs(build_dir, exist_ok=True)
-    so_path = os.path.join(build_dir, f"{name}.so")
+    # flags are part of the cache key: a stale .so built with different
+    # cflags must not be reused (the reference hashes build options too)
+    import hashlib
+
+    tag = hashlib.sha1(
+        ("\x00".join(extra_cflags or [])).encode()).hexdigest()[:8]
+    so_path = os.path.join(build_dir, f"{name}_{tag}.so")
     srcs = [os.path.abspath(s) for s in (
         sources if isinstance(sources, (list, tuple)) else [sources])]
     newest_src = max(os.path.getmtime(s) for s in srcs)
