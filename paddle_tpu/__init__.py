@@ -60,6 +60,9 @@ from . import device  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 
 from .hapi import Model  # noqa: E402,F401
+from .hapi.model_summary import summary  # noqa: E402,F401
+from .utils.flags import get_flags, set_flags  # noqa: E402,F401
+from .distributed import DataParallel  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
@@ -112,5 +115,62 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     return results
 
 
-def flops(*args, **kwargs):  # pragma: no cover - placeholder parity stub
-    return 0
+from .hapi.model_summary import flops  # noqa: E402,F401
+
+
+# -- dtype info + mode-switch parity shims ---------------------------------
+from .framework.dtype import DType as dtype  # noqa: E402,F401
+
+
+def iinfo(t):
+    """paddle.iinfo parity over framework dtypes."""
+    import numpy as _np
+
+    from .framework.dtype import to_jax_dtype as _tj
+
+    return _np.iinfo(_np.dtype(_tj(t)))
+
+
+def finfo(t):
+    """paddle.finfo parity (bfloat16 via ml_dtypes)."""
+    import ml_dtypes as _ml
+    import numpy as _np
+
+    from .framework.dtype import to_jax_dtype as _tj
+
+    d = _np.dtype(_tj(t))
+    return _ml.finfo(d) if d.name == "bfloat16" else _np.finfo(d)
+
+
+_dynamic_mode = True
+
+
+def in_dynamic_mode():
+    return _dynamic_mode
+
+
+def disable_static():
+    """Reference paddle.disable_static — dygraph IS the default here."""
+    global _dynamic_mode
+    _dynamic_mode = True
+
+
+def enable_static():
+    """The legacy static-graph Program world has no TPU equivalent (jit/
+    to_static is the compiled path); scripts calling this get a clear
+    error instead of silently-wrong eager semantics."""
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit."
+        "to_static / TrainStep for compiled execution")
+
+
+class LazyGuard:
+    """Reference LazyGuard defers param init to the first forward; params
+    here are cheap host-side jax arrays, so eager init is fine and the
+    guard is a no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

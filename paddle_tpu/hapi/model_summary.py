@@ -1,0 +1,127 @@
+"""Model summary (reference python/paddle/hapi/model_summary.py
+paddle.summary): per-layer output shapes + parameter counts via forward
+hooks on a dry-run forward."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Returns {"total_params": int, "trainable_params": int} and prints
+    the table (reference summary contract)."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                else outputs
+            shape = list(out.shape) if hasattr(out, "shape") else "?"
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr._parameters.values()
+                           if p is not None)
+            rows.append((name or lyr.__class__.__name__,
+                         lyr.__class__.__name__, shape, n_params))
+
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:        # leaves only, like the reference
+            hooks.append(sub.register_forward_post_hook(
+                make_hook(name, sub)))
+
+    try:
+        if input is not None:
+            args = input if isinstance(input, (tuple, list)) else [input]
+            net(*args)
+        elif input_size is not None:
+            shapes = (input_size if isinstance(input_size, list)
+                      else [input_size])
+            dts = dtypes or ["float32"] * len(shapes)
+            args = [Tensor(np.zeros([d if d and d > 0 else 1
+                                     for d in shape], np.dtype(dt)
+                                    if dt != "float32" else np.float32))
+                    for shape, dt in zip(shapes, dts)]
+            net(*args)
+        else:
+            raise ValueError("summary needs input_size or input")
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if p.trainable)
+    width = max([len(r[0]) for r in rows] + [10]) + 2
+    print(f"{'Layer':<{width}}{'Type':<24}{'Output Shape':<20}{'Params':>12}")
+    print("-" * (width + 56))
+    for name, typ, shape, n in rows:
+        print(f"{name:<{width}}{typ:<24}{str(shape):<20}{n:>12,}")
+    print("-" * (width + 56))
+    print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def _layer_flops(layer, inputs, outputs):
+    """Per-layer MAC-style FLOPs (reference hapi/dynamic_flops.py rules)."""
+    import numpy as np
+
+    out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+    out_elems = int(np.prod(out.shape)) if hasattr(out, "shape") else 0
+    cls = layer.__class__.__name__
+    if cls == "Linear":
+        return out_elems * layer.in_features
+    if cls in ("Conv1D", "Conv2D", "Conv3D"):
+        w = layer.weight
+        kernel_elems = int(np.prod(w.shape[1:]))  # cin/groups * prod(k)
+        return out_elems * kernel_elems
+    if cls in ("BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "LayerNorm",
+               "GroupNorm"):
+        return 2 * out_elems
+    if cls in ("ReLU", "GELU", "Sigmoid", "Tanh", "Softmax", "SiLU"):
+        return out_elems
+    if cls in ("AvgPool2D", "MaxPool2D", "AdaptiveAvgPool2D"):
+        return out_elems
+    if cls == "Embedding":
+        return 0
+    return 0
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """paddle.flops parity (reference hapi/dynamic_flops.py): total FLOPs
+    of one forward at `input_size`, counted per leaf layer."""
+    total = [0]
+    custom_ops = custom_ops or {}
+    hooks = []
+
+    def make_hook(lyr):
+        def hook(l, ins, outs):
+            fn = custom_ops.get(type(l))
+            total[0] += int(fn(l, ins, outs) if fn
+                            else _layer_flops(l, ins, outs))
+
+        return hook
+
+    for _, sub in net.named_sublayers():
+        if not sub._sub_layers:
+            hooks.append(sub.register_forward_post_hook(make_hook(sub)))
+    try:
+        if inputs is not None:
+            args = inputs if isinstance(inputs, (tuple, list)) else [inputs]
+            net(*args)
+        else:
+            import numpy as np
+
+            shapes = (input_size if isinstance(input_size, list)
+                      else [input_size])
+            net(*[Tensor(np.zeros([d if d and d > 0 else 1 for d in s],
+                                  np.float32)) for s in shapes])
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

@@ -236,3 +236,15 @@ def multinomial(x, num_samples=1, replacement=False):
         g = jax.random.gumbel(key, logits.shape)
         _, out = jax.lax.top_k(logits + g, num_samples)
     return Tensor._wrap(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    """Per-element Poisson draws with rate x (reference tensor/random.py
+    poisson, kernel paddle/phi/kernels/poisson_kernel.h)."""
+    x = ensure_tensor(x)
+    key = default_generator().next_key()
+    rate = x._data if jnp.issubdtype(x._data.dtype, jnp.floating) \
+        else x._data.astype(jnp.float32)
+    return Tensor._wrap(
+        jax.random.poisson(key, rate, x._data.shape)
+        .astype(x._data.dtype))

@@ -434,3 +434,48 @@ cos_ = _make_inplace(cos)
 tanh_ = _make_inplace(tanh)
 sigmoid_ = _make_inplace(sigmoid)
 neg_ = _make_inplace(neg)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Reference tensor/math.py trapezoid — trapezoidal integration."""
+    from ._dispatch import nary, unary
+
+    if x is not None and dx is not None:
+        raise ValueError(
+            "Not permitted to specify both x and dx input args.")
+    if x is not None:
+        return nary(lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis),
+                    [ensure_tensor(y), ensure_tensor(x)], "trapezoid")
+    spacing = 1.0 if dx is None else dx
+    return unary(lambda yy: jnp.trapezoid(yy, dx=spacing, axis=axis),
+                 y, "trapezoid")
+
+
+def frexp(x, name=None):
+    """Reference tensor/math.py frexp — mantissa/exponent decomposition.
+    Exponent comes back in x's float dtype (reference contract)."""
+    from ._dispatch import unary
+
+    x = ensure_tensor(x)
+
+    def f(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(v.dtype)
+
+    return unary(f, x, "frexp")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Reference tensor/math.py vander — Vandermonde matrix."""
+    from ._dispatch import unary
+
+    return unary(lambda v: jnp.vander(
+        v, N=n, increasing=increasing), x, "vander")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    """Reference tensor/stat.py nanquantile."""
+    from ._dispatch import unary
+
+    return unary(lambda v: jnp.nanquantile(
+        v, q, axis=axis, keepdims=keepdim), x, "nanquantile")

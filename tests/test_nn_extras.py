@@ -142,3 +142,45 @@ class TestReviewFixes:
         np.testing.assert_allclose(out, [1.5, 2.5, 2.5, 1.5], atol=1e-5)
         with pytest.raises(ValueError):
             F.grid_sample(x, grid, padding_mode="nope")
+
+
+class TestTopLevelAPI:
+    def test_summary_and_flops(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        info = paddle.summary(m, (2, 4))
+        assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+        assert paddle.flops(m, (2, 4)) == 2 * 32 + 8 * 2 + 2 * 16
+
+    def test_dtype_info_and_modes(self):
+        assert paddle.iinfo("int32").max == 2 ** 31 - 1
+        assert paddle.finfo("bfloat16").bits == 16
+        assert paddle.in_dynamic_mode()
+        paddle.disable_static()
+        with pytest.raises(NotImplementedError):
+            paddle.enable_static()
+        with paddle.LazyGuard():
+            lin = nn.Linear(2, 2)
+        assert lin.weight is not None
+
+    def test_new_math_ops(self):
+        y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        assert abs(float(paddle.trapezoid(y)) - 4.0) < 1e-6
+        m, e = paddle.frexp(paddle.to_tensor(np.array([8.0]),
+                                              stop_gradient=False))
+        assert float(m.numpy()[0]) == 0.5 and float(e.numpy()[0]) == 4.0
+        assert str(e._data.dtype) == str(m._data.dtype)  # float exponent
+        m.sum().backward()          # grads flow (dispatch-registered)
+        with pytest.raises(ValueError):
+            paddle.trapezoid(paddle.to_tensor(np.array([1.0, 2.0])),
+                             x=paddle.to_tensor(np.array([0.0, 1.0])),
+                             dx=5.0)
+        z = paddle.trapezoid(paddle.to_tensor(np.array([1.0, 2.0])), dx=0.0)
+        assert float(z) == 0.0
+        v = paddle.vander(paddle.to_tensor(np.array([1.0, 2.0])), n=3)
+        assert v.shape == [2, 3]
+        nq = paddle.nanquantile(
+            paddle.to_tensor(np.array([1.0, np.nan, 3.0])), 0.5)
+        assert float(nq) == 2.0
+        draws = paddle.poisson(
+            paddle.to_tensor(np.full((1000,), 5.0, np.float32)))
+        assert 4.0 < float(draws.mean()) < 6.0
