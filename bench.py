@@ -32,9 +32,12 @@ def main():
     batch = int(os.environ.get("BENCH_BS", "8"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
 
+    # recompute default OFF: with bf16 score storage + the logsumexp CE the
+    # 350m/bs8/seq1024 step fits in 16G HBM without remat (35.9k tok/s vs
+    # 31.9k with it) — PERF.md round-2 sweep
     cfg = gpt_config(model_name, max_position_embeddings=seq,
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                     use_recompute=os.environ.get("BENCH_RECOMPUTE", "1") == "1",
+                     use_recompute=os.environ.get("BENCH_RECOMPUTE", "0") == "1",
                      recompute_policy=os.environ.get("BENCH_REMAT_POLICY",
                                                      "dots") or None)
     model = GPTForCausalLM(cfg)
@@ -42,10 +45,21 @@ def main():
     model.bfloat16()
     crit = GPTPretrainingCriterion()
     opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                     multi_precision=True)
+                     multi_precision=True,
+                     moment_dtype=("bfloat16"
+                                   if os.environ.get("BENCH_BF16_MOMENTS",
+                                                     "1") == "1"
+                                   else None))
 
-    def loss_fn(m, ids, labels):
-        return crit(m(ids), labels)
+    if os.environ.get("BENCH_FUSED_CE", "0") == "1":
+        # fused LM head: chunked logsumexp, no [tokens, vocab] logits at
+        # all. Measured slower than the dense lse-CE path at every config
+        # that fits (PERF.md) — opt-in for vocab/memory regimes that don't
+        def loss_fn(m, ids, labels):
+            return m.loss(ids, labels)
+    else:
+        def loss_fn(m, ids, labels):
+            return crit(m(ids), labels)
 
     step = TrainStep(model, loss_fn, opt)
     rng = np.random.default_rng(0)

@@ -115,6 +115,8 @@ def flops(net, input_size=None, inputs=None, custom_ops=None,
         else:
             import numpy as np
 
+            if input_size is None:
+                raise ValueError("flops needs input_size or inputs")
             shapes = (input_size if isinstance(input_size, list)
                       else [input_size])
             net(*[Tensor(np.zeros([d if d and d > 0 else 1 for d in s],

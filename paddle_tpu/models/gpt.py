@@ -230,22 +230,49 @@ class GPTForCausalLM(nn.Layer):
             logits = self.lm_head(hidden)
         return logits
 
+    def loss(self, input_ids, labels, loss_mask=None, position_ids=None):
+        """Training loss via the fused LM head: hidden states go straight
+        into F.fused_linear_cross_entropy, so the [tokens, vocab] logits are
+        never materialized (chunked logsumexp + recompute-in-backward).
+        Numerically equal to GPTPretrainingCriterion(self(ids), labels)."""
+        hidden = self.gpt(input_ids, position_ids)
+        if self.lm_head is None:
+            w, t_y = self.gpt.wte.weight, True
+        else:
+            w, t_y = self.lm_head.weight, False
+        if loss_mask is None:
+            return F.fused_linear_cross_entropy(hidden, w, labels,
+                                                transpose_y=t_y)
+        from .. import ops
+
+        losses = F.fused_linear_cross_entropy(hidden, w, labels,
+                                              transpose_y=t_y,
+                                              reduction="none")
+        m = loss_mask.astype(losses.dtype)
+        return ops.sum(losses * m) / ops.clip(ops.sum(m), min=1.0)
+
 
 class GPTPretrainingCriterion(nn.Layer):
-    """Shifted-token cross entropy (mean over non-masked positions)."""
+    """Shifted-token cross entropy: mean over non-masked positions (and,
+    like F.cross_entropy, over non-ignore_index labels — keeping this
+    numerically equal to the fused `model.loss()` path when labels carry
+    -100 padding)."""
 
     def forward(self, logits, labels, loss_mask=None):
         from .. import ops
 
         vocab = logits.shape[-1]
+        if loss_mask is None:
+            return F.cross_entropy(
+                logits.reshape([-1, vocab]), labels.reshape([-1]),
+                reduction="mean",
+            )
         loss = F.cross_entropy(
             logits.reshape([-1, vocab]), labels.reshape([-1]),
             reduction="none",
         )
-        if loss_mask is not None:
-            m = loss_mask.reshape([-1]).astype(loss.dtype)
-            return ops.sum(loss * m) / ops.clip(ops.sum(m), min=1.0)
-        return ops.mean(loss)
+        m = loss_mask.reshape([-1]).astype(loss.dtype)
+        return ops.sum(loss * m) / ops.clip(ops.sum(m), min=1.0)
 
 
 # ---------------------------------------------------------------------------

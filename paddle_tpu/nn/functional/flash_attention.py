@@ -22,20 +22,34 @@ from ...ops._dispatch import nary, ensure_tensor
 
 def _sdpa_ref(q, k, v, mask, scale, causal, dropout_p, key):
     # q,k,v: [b, s, h, d] — dots run in the input dtype on the MXU with fp32
-    # accumulation (preferred_element_type); softmax in fp32.
+    # accumulation (preferred_element_type); softmax math in fp32.
+    #
+    # Score storage dtype: the [b, h, s, s] score matrix is the dominant
+    # HBM traffic of non-flash attention (written fwd, re-read/rewritten
+    # under remat and in backward). With bf16/fp16 inputs we round the
+    # accumulated scores back to the input dtype for HBM residency — the
+    # same storage precision the reference's fused softmax path keeps
+    # (fp16 scores, fp32 softmax internals) — halving that traffic.
+    # FLAGS_attention_fp32_scores restores full-fp32 storage.
+    from ...utils import flags as _flags
+
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
+    if (q.dtype in (jnp.bfloat16, jnp.float16)
+            and not _flags.get_flag("FLAGS_attention_fp32_scores")):
+        logits = logits.astype(q.dtype)
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(cmask, logits, jnp.float32(-jnp.inf))
+        logits = jnp.where(cmask, logits, jnp.asarray(-jnp.inf, logits.dtype))
     if mask is not None:
         if mask.dtype == jnp.bool_:
-            logits = jnp.where(mask, logits, jnp.float32(-jnp.inf))
+            logits = jnp.where(mask, logits,
+                               jnp.asarray(-jnp.inf, logits.dtype))
         else:
-            logits = logits + mask.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     if dropout_p > 0.0 and key is not None:
         keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)

@@ -27,9 +27,34 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     label = ensure_tensor(label)
 
     def f(logits, lbl, *maybe_w):
+        is_soft = soft_label or (
+            lbl.ndim == logits.ndim and lbl.shape[axis] == logits.shape[axis]
+            and jnp.issubdtype(lbl.dtype, jnp.floating))
+        # hard-label fast path: loss = logsumexp - picked_logit. Unlike the
+        # log_softmax form this never materializes (or stores as a vjp
+        # residual) an fp32 [tokens, vocab] tensor — the fp32 upcast fuses
+        # into the reduction and backward recomputes softmax from the
+        # native-dtype logits. Same numbers, ~2x less LM-head HBM traffic
+        # in bf16 training.
+        if (use_softmax and not is_soft and label_smoothing == 0.0
+                and not maybe_w):
+            idx = lbl.astype(jnp.int32)
+            if idx.ndim == logits.ndim:
+                idx = jnp.squeeze(idx, axis=axis)
+            safe_idx = jnp.where(idx == ignore_index, 0, idx)
+            lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=axis)
+            picked = jnp.take_along_axis(
+                jnp.moveaxis(logits, axis, -1), safe_idx[..., None], axis=-1,
+            )[..., 0].astype(jnp.float32)
+            valid = idx != ignore_index
+            loss = jnp.where(valid, lse - picked, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(jnp.float32))
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+            return _reduce(loss, reduction)
         x32 = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(x32, axis=axis) if use_softmax else jnp.log(jnp.maximum(x32, 1e-30))
-        if soft_label or (lbl.ndim == logits.ndim and lbl.shape[axis] == logits.shape[axis] and jnp.issubdtype(lbl.dtype, jnp.floating)):
+        if is_soft:
             soft = lbl.astype(jnp.float32)
             if label_smoothing > 0:
                 k = logits.shape[axis]
@@ -261,3 +286,136 @@ def square_error_cost(input, label):
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     raise NotImplementedError("ctc_loss lands with the audio op pack")
+
+
+# ---------------------------------------------------------------------------
+# Fused LM head: linear projection + softmax cross entropy without ever
+# materializing the [tokens, vocab] logits matrix.
+#
+# Reference parity: the role of Paddle's fused CE stack —
+# c_softmax_with_cross_entropy (paddle/phi/kernels/gpu/
+# c_softmax_with_cross_entropy_kernel.cu) and fused_softmax_mask — which fuse
+# the softmax/CE chain to avoid logits round-trips. TPU-first: at a 50k vocab
+# the fp32 logits tensor (batch*seq x vocab) dominates the LM-head HBM traffic
+# and is held across the whole backward as a vjp residual; instead we scan
+# over token chunks, computing each chunk's logits on the MXU, reducing to
+# logsumexp + the picked logit, and discarding the chunk. The custom VJP
+# recomputes per-chunk logits in backward (flash-attention-style
+# recompute-over-store) and accumulates the weight gradient in fp32.
+# ---------------------------------------------------------------------------
+
+from functools import partial as _partial
+
+import numpy as _np
+from jax import lax as _lax
+
+
+def _chunk_logits(hc, w, transpose_y):
+    # hc [C, H]; w [V, H] when transpose_y (embedding layout) else [H, V].
+    if transpose_y:
+        return jnp.dot(hc, w.T, preferred_element_type=jnp.float32)
+    return jnp.dot(hc, w, preferred_element_type=jnp.float32)
+
+
+def _pad_chunks(x, n_chunks, pad_value):
+    n = x.shape[0]
+    c = -(-n // n_chunks)
+    pad = c * n_chunks - n
+    if pad:
+        cfg = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        x = jnp.pad(x, cfg, constant_values=pad_value)
+    return x.reshape((n_chunks, c) + x.shape[1:])
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_linear_ce(h, w, labels, transpose_y, ignore_index, n_chunks):
+    losses, _ = _fused_linear_ce_fwd(h, w, labels, transpose_y, ignore_index,
+                                     n_chunks)
+    return losses
+
+
+def _fused_linear_ce_fwd(h, w, labels, transpose_y, ignore_index, n_chunks):
+    n = h.shape[0]
+    hr = _pad_chunks(h, n_chunks, 0)
+    lr = _pad_chunks(labels, n_chunks, ignore_index)
+
+    def body(_, hl):
+        hc, lc = hl
+        logits = _chunk_logits(hc, w, transpose_y)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        valid = lc != ignore_index
+        safe = jnp.where(valid, lc, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        return None, jnp.where(valid, lse - picked, 0.0)
+
+    _, losses = _lax.scan(body, None, (hr, lr))
+    return losses.reshape(-1)[:n], (h, w, labels)
+
+
+def _fused_linear_ce_bwd(transpose_y, ignore_index, n_chunks, res, g):
+    h, w, labels = res
+    n, hidden = h.shape
+    hr = _pad_chunks(h, n_chunks, 0)
+    lr = _pad_chunks(labels, n_chunks, ignore_index)
+    gr = _pad_chunks(g, n_chunks, 0)
+
+    def body(dw, hlg):
+        hc, lc, gc = hlg
+        c = hc.shape[0]
+        logits = _chunk_logits(hc, w, transpose_y)
+        p = jax.nn.softmax(logits, axis=-1)
+        valid = lc != ignore_index
+        safe = jnp.where(valid, lc, 0).astype(jnp.int32)
+        d = p.at[jnp.arange(c), safe].add(-1.0)
+        d = d * jnp.where(valid, gc, 0.0).astype(jnp.float32)[:, None]
+        dlow = d.astype(h.dtype)  # grads ride the MXU in the param dtype
+        if transpose_y:           # w [V, H]
+            dh = jnp.dot(dlow, w, preferred_element_type=jnp.float32)
+            dwc = jnp.dot(dlow.T, hc, preferred_element_type=jnp.float32)
+        else:                     # w [H, V]
+            dh = jnp.dot(dlow, w.T, preferred_element_type=jnp.float32)
+            dwc = jnp.dot(hc.T, dlow, preferred_element_type=jnp.float32)
+        return dw + dwc, dh.astype(h.dtype)
+
+    dw, dh = _lax.scan(body, jnp.zeros(w.shape, jnp.float32), (hr, lr, gr))
+    dh = dh.reshape(-1, hidden)[:n]
+    ct_labels = _np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dh, dw.astype(w.dtype), ct_labels
+
+
+_fused_linear_ce.defvjp(_fused_linear_ce_fwd, _fused_linear_ce_bwd)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, transpose_y=True,
+                               ignore_index=-100, reduction="mean",
+                               n_chunks=None, name=None):
+    """Cross entropy of `softmax(hidden @ weight)` computed chunkwise so the
+    full logits matrix never hits HBM (see module comment above).
+
+    hidden: [..., H] activations; weight: [V, H] (transpose_y=True — the
+    tied-embedding layout) or [H, V]; labels: int [...] matching hidden's
+    leading dims. reduction "mean" averages over non-ignored tokens.
+    """
+    from ...utils import flags as _flags
+
+    hidden = ensure_tensor(hidden)
+    weight = ensure_tensor(weight)
+    labels = ensure_tensor(labels)
+    if n_chunks is None:
+        n_chunks = int(_flags.get_flags(["FLAGS_fused_ce_chunks"])
+                       ["FLAGS_fused_ce_chunks"])
+    n_chunks = max(1, int(n_chunks))
+
+    def f(h, w, lbl):
+        hsz = h.shape[-1]
+        losses = _fused_linear_ce(h.reshape(-1, hsz), w,
+                                  lbl.reshape(-1).astype(jnp.int32),
+                                  transpose_y, ignore_index, n_chunks)
+        if reduction == "none":
+            return losses.reshape(lbl.shape)
+        if reduction == "sum":
+            return jnp.sum(losses)
+        valid = (lbl.reshape(-1) != ignore_index).astype(jnp.float32)
+        return jnp.sum(losses) / jnp.maximum(jnp.sum(valid), 1.0)
+
+    return nary(f, [hidden, weight, labels], "fused_linear_cross_entropy")

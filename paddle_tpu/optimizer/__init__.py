@@ -47,40 +47,151 @@ class Momentum(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, use_multi_tensor=False, amsgrad=False,
-                 name=None):
+                 multi_precision=False, use_multi_tensor=None, amsgrad=False,
+                 moment_dtype=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+        # multi-tensor fused update (reference: adam.py use_multi_tensor /
+        # multi_tensor_adam kernels). Default ON here: in eager mode every
+        # per-param jnp update is its own XLA dispatch (~10 launches x
+        # n_params per step); the fused path jits ONE program over the whole
+        # param set. Identical math, so unlike the reference it is the
+        # default; pass use_multi_tensor=False to fall back.
+        self._use_multi_tensor = (True if use_multi_tensor is None
+                                  else bool(use_multi_tensor))
+        self._fused_fn = None
+        # TPU-first knob: store moments in a narrower dtype (e.g. "bfloat16")
+        # to cut optimizer-state HBM traffic; the update math still runs in
+        # fp32 (read → upcast → update → downcast-store). bf16's 8 mantissa
+        # bits round away second-moment increments once (1-beta2)*g^2 falls
+        # ~256x below v, so the option trades a slightly stale v for
+        # bandwidth — measure before enabling at scale (PERF.md).
+        from ..framework.dtype import to_jax_dtype
+
+        self._moment_dtype = (to_jax_dtype(moment_dtype)
+                              if moment_dtype is not None else None)
+
+    def _adam_math(self, pv, g, m, v, vmax, lr, t, wd):
+        """The single source of the Adam/AdamW update rule, shared by the
+        per-param (traced) and multi-tensor (fused-jit) paths: all math in
+        fp32; returns (new_pv, new_m, new_v, new_vmax) in fp32 — callers
+        downcast to their storage dtypes. `vmax` is None unless amsgrad;
+        `wd` is the decoupled (AdamW) coefficient."""
+        pv32 = pv.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        m_new = self._beta1 * m.astype(jnp.float32) + (1 - self._beta1) * g
+        v_new = self._beta2 * v.astype(jnp.float32) + (1 - self._beta2) * g * g
+        m_hat = m_new / (1 - self._beta1 ** t)
+        if vmax is not None:
+            vmax_new = jnp.maximum(vmax.astype(jnp.float32), v_new)
+            v_hat = vmax_new / (1 - self._beta2 ** t)
+        else:
+            vmax_new = None
+            v_hat = v_new / (1 - self._beta2 ** t)
+        update = m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        out = pv32 * (1 - lr * wd) - lr * update
+        return out, m_new, v_new, vmax_new
 
     def _adam_update(self, p, g, decoupled_wd=0.0):
         lr_v = self.get_lr()
-        m = self._get_accumulator("moment1", p)
-        v = self._get_accumulator("moment2", p)
+        md = self._moment_dtype
+        m = self._get_accumulator("moment1", p, dtype=md)
+        v = self._get_accumulator("moment2", p, dtype=md)
+        vmax = (self._get_accumulator("moment2_max", p, dtype=md)
+                if self._amsgrad else None)
         t = jnp.asarray(self._step_count, jnp.float32)
-        m_new = self._beta1 * m + (1 - self._beta1) * g
-        v_new = self._beta2 * v + (1 - self._beta2) * g * g
-        self._set_accumulator("moment1", p, m_new)
-        self._set_accumulator("moment2", p, v_new)
-        m_hat = m_new / (1 - self._beta1 ** t)
-        if self._amsgrad:
-            vmax = self._get_accumulator("moment2_max", p)
-            vmax_new = jnp.maximum(vmax, v_new)
-            self._set_accumulator("moment2_max", p, vmax_new)
-            v_hat = vmax_new / (1 - self._beta2 ** t)
-        else:
-            v_hat = v_new / (1 - self._beta2 ** t)
-        pv = self._param_value(p)
-        update = m_hat / (jnp.sqrt(v_hat) + self._epsilon)
-        if decoupled_wd:
-            pv = pv * (1 - lr_v * decoupled_wd)
-        self._write_param(p, pv - lr_v * update)
+        out, m_new, v_new, vmax_new = self._adam_math(
+            self._param_value(p), g, m, v, vmax,
+            jnp.asarray(lr_v, jnp.float32), t, jnp.float32(decoupled_wd))
+        self._set_accumulator("moment1", p, m_new.astype(m.dtype))
+        self._set_accumulator("moment2", p, v_new.astype(v.dtype))
+        if vmax_new is not None:
+            self._set_accumulator("moment2_max", p, vmax_new.astype(vmax.dtype))
+        self._write_param(p, out)
 
     def _append_optimize_op(self, p, g):
         self._adam_update(p, g)
+
+    # -- multi-tensor fused step -------------------------------------------
+    def _decoupled_wd(self, p):
+        """AdamW's per-param decoupled decay coefficient (0 for plain Adam,
+        whose L2 decay folds into the gradient instead)."""
+        return 0.0
+
+    def _l2_coeff(self, p):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        coeff = wd if isinstance(wd, float) else getattr(wd, "_coeff", 0.0)
+        if coeff == 0.0 or getattr(p, "regularizer", None) is not None:
+            return 0.0
+        return float(coeff)
+
+    def _maybe_fused_step(self, params_grads):
+        if not self._use_multi_tensor or not params_grads:
+            return False
+        import jax
+
+        first = params_grads[0][1]
+        d = first._data if hasattr(first, "_data") else first
+        if isinstance(d, jax.core.Tracer):
+            # under TrainStep's whole-step trace the per-param path is
+            # traced once into the same single program anyway; a nested
+            # jit would only add a fusion barrier
+            return False
+        if self._fused_fn is None:
+            self._fused_fn = self._build_fused_fn()
+        keys, pvs, gs, ms, vs, vmaxs = [], {}, {}, {}, {}, {}
+        wds, l2s = {}, {}
+        md = self._moment_dtype
+        for p, g in params_grads:
+            k = p.name or str(id(p))
+            keys.append((k, p))
+            g_data = g._data if hasattr(g, "_data") else g
+            pvs[k] = self._param_value(p)
+            gs[k] = g_data.astype(jnp.float32)
+            ms[k] = self._get_accumulator("moment1", p, dtype=md)
+            vs[k] = self._get_accumulator("moment2", p, dtype=md)
+            if self._amsgrad:
+                vmaxs[k] = self._get_accumulator("moment2_max", p, dtype=md)
+            wds[k] = jnp.float32(self._decoupled_wd(p))
+            l2s[k] = jnp.float32(self._l2_coeff(p))
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        t = jnp.asarray(self._step_count, jnp.float32)
+        new_p, new_m, new_v, new_vmax = self._fused_fn(
+            pvs, gs, ms, vs, vmaxs, wds, l2s, lr, t)
+        for k, p in keys:
+            self._accumulators["moment1"][k] = new_m[k]
+            self._accumulators["moment2"][k] = new_v[k]
+            if self._amsgrad:
+                self._accumulators["moment2_max"][k] = new_vmax[k]
+            self._write_param(p, new_p[k])
+        return True
+
+    def _build_fused_fn(self):
+        import jax
+
+        amsgrad = self._amsgrad
+
+        def f(pvs, gs, ms, vs, vmaxs, wds, l2s, lr, t):
+            new_p, new_m, new_v, new_vmax = {}, {}, {}, {}
+            for k in pvs:
+                g = gs[k] + l2s[k] * pvs[k].astype(jnp.float32)
+                out, m_n, v_n, vmax_n = self._adam_math(
+                    pvs[k], g, ms[k], vs[k],
+                    vmaxs[k] if amsgrad else None, lr, t, wds[k])
+                new_p[k] = out.astype(pvs[k].dtype)
+                new_m[k] = m_n.astype(ms[k].dtype)
+                new_v[k] = v_n.astype(vs[k].dtype)
+                if vmax_n is not None:
+                    new_vmax[k] = vmax_n.astype(vmaxs[k].dtype)
+            return new_p, new_m, new_v, new_vmax
+
+        return jax.jit(f)
 
 
 class AdamW(Adam):
@@ -90,18 +201,23 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, amsgrad=False, name=None):
+                 multi_precision=False, amsgrad=False, moment_dtype=None,
+                 use_multi_tensor=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
-                         amsgrad=amsgrad, name=name)
+                         use_multi_tensor=use_multi_tensor, amsgrad=amsgrad,
+                         moment_dtype=moment_dtype, name=name)
         self._wd_coeff = float(weight_decay) if weight_decay else 0.0
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _append_optimize_op(self, p, g):
-        wd = self._wd_coeff
-        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
-            wd = 0.0
-        self._adam_update(p, g, decoupled_wd=wd)
+        self._adam_update(p, g, decoupled_wd=self._decoupled_wd(p))
+
+    def _decoupled_wd(self, p):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            return 0.0
+        return self._wd_coeff
 
 
 class Adamax(Optimizer):

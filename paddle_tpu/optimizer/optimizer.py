@@ -111,12 +111,20 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         self._step_count += 1
+        if self._maybe_fused_step(params_grads):
+            return
         for p, g in params_grads:
             g_data = g._data if isinstance(g, Tensor) else g
             if self._use_master(p):
                 g_data = g_data.astype(jnp.float32)
             g_data = self._apply_decay(p, g_data)
             self._append_optimize_op(p, g_data)
+
+    def _maybe_fused_step(self, params_grads):
+        """Subclass hook: apply ALL param updates as one jitted program (the
+        reference's multi_tensor_adam, python/paddle/optimizer/adam.py
+        `use_multi_tensor`). Return True when handled. Base: per-param path."""
+        return False
 
     def _append_optimize_op(self, param, grad_data):
         raise NotImplementedError

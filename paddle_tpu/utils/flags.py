@@ -69,11 +69,19 @@ define_flag("FLAGS_init_allocated_mem", False, "")
 define_flag("FLAGS_use_stream_safe_cuda_allocator", True, "no-op on TPU (PJRT-managed)")
 define_flag("FLAGS_distributed_timeout_sec", 1800, "collective watchdog timeout")
 define_flag("FLAGS_log_level", 0, "VLOG level")
-define_flag("FLAGS_pallas_flash_min_seqlen", 8192,
+define_flag("FLAGS_attention_fp32_scores", False,
+            "store attention scores in fp32 instead of the input dtype "
+            "(softmax math is fp32 either way); costs ~2x score-matrix "
+            "HBM traffic")
+define_flag("FLAGS_fused_ce_chunks", 4,
+            "token-chunk count for fused_linear_cross_entropy: logits are "
+            "computed per chunk and discarded instead of materializing the "
+            "full [tokens, vocab] fp32 matrix")
+define_flag("FLAGS_pallas_flash_min_seqlen", 16384,
             "min seq len to route scaled_dot_product_attention to the "
-            "pallas flash kernel. Measured on v5e (bf16, d=64, fwd+bwd, "
-            "1024-blocks): standalone the kernel wins from ~4096 and is "
-            "3.3x at 8192, but under whole-block remat XLA attention "
-            "stays ahead through 4096 in full-model training; at 8192 "
-            "XLA's O(s^2) score materialization OOMs 16G HBM outright "
-            "while the flash kernel trains (gpt3-350m bs1: 2464 tok/s)")
+            "pallas flash kernel. Measured on v5e (gpt3-350m, bf16, d=64, "
+            "fwd+bwd, full model): with bf16 score storage (see "
+            "FLAGS_attention_fp32_scores) XLA attention beats the flash "
+            "kernel through seq 8192 (7293 vs 2482 tok/s at 8192); at "
+            "16384 the O(s^2) bf16 score matrix (8G/layer) OOMs 16G HBM "
+            "while the flash kernel trains (1126 tok/s)")

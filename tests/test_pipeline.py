@@ -25,7 +25,8 @@ def _mesh(n, axis="pp"):
 
 
 class TestPipelinePrimitive:
-    @pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 4), (4, 2)])
+    @pytest.mark.parametrize("n_stages,n_micro", [
+        (2, 2), pytest.param(4, 4, marks=pytest.mark.slow), (4, 2)])
     def test_matches_sequential(self, n_stages, n_micro):
         mesh = _mesh(n_stages)
         rng = np.random.default_rng(0)

@@ -30,6 +30,9 @@ class TestModels:
         out.sum().backward()
         assert m.conv1.weight.grad is not None
 
+    @pytest.mark.slow  # construct-only architecture bookkeeping; ~22s of
+    # per-param eager init on the 1-core CI box — resnet18 paths cover the
+    # block logic in the default run
     def test_resnet50_param_count(self):
         m = resnet50()
         n = sum(p.size for p in m.parameters())
