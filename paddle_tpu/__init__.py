@@ -66,6 +66,9 @@ from .distributed import DataParallel  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import vision  # noqa: E402,F401

@@ -240,16 +240,22 @@ class GPTForCausalLM(nn.Layer):
             w, t_y = self.gpt.wte.weight, True
         else:
             w, t_y = self.lm_head.weight, False
-        if loss_mask is None:
-            return F.fused_linear_cross_entropy(hidden, w, labels,
-                                                transpose_y=t_y)
-        from .. import ops
+        return fused_lm_loss(hidden, w, t_y, labels, loss_mask)
 
-        losses = F.fused_linear_cross_entropy(hidden, w, labels,
-                                              transpose_y=t_y,
-                                              reduction="none")
-        m = loss_mask.astype(losses.dtype)
-        return ops.sum(losses * m) / ops.clip(ops.sum(m), min=1.0)
+
+def fused_lm_loss(hidden, weight, transpose_y, labels, loss_mask=None):
+    """Shared fused-LM-head loss used by the GPT/LLaMA `model.loss()`
+    paths: fused CE, then the criterion's masked-mean reduction."""
+    if loss_mask is None:
+        return F.fused_linear_cross_entropy(hidden, weight, labels,
+                                            transpose_y=transpose_y)
+    from .. import ops
+
+    losses = F.fused_linear_cross_entropy(hidden, weight, labels,
+                                          transpose_y=transpose_y,
+                                          reduction="none")
+    m = loss_mask.astype(losses.dtype)
+    return ops.sum(losses * m) / ops.clip(ops.sum(m), min=1.0)
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -274,23 +274,14 @@ class LlamaForCausalLM(nn.Layer):
 
     def loss(self, input_ids, labels, loss_mask=None):
         """Fused LM-head training loss (see GPTForCausalLM.loss)."""
-        from ..nn import functional as F
+        from .gpt import fused_lm_loss
 
         hidden = self.llama(input_ids)
         if self.lm_head is None:
             w, t_y = self.llama.embed_tokens.weight, True
         else:
             w, t_y = self.lm_head.weight, False
-        if loss_mask is None:
-            return F.fused_linear_cross_entropy(hidden, w, labels,
-                                                transpose_y=t_y)
-        from .. import ops
-
-        losses = F.fused_linear_cross_entropy(hidden, w, labels,
-                                              transpose_y=t_y,
-                                              reduction="none")
-        m = loss_mask.astype(losses.dtype)
-        return ops.sum(losses * m) / ops.clip(ops.sum(m), min=1.0)
+        return fused_lm_loss(hidden, w, t_y, labels, loss_mask)
 
 
 # the GPT criterion is architecture-agnostic CE over shifted tokens

@@ -1,0 +1,181 @@
+"""Signal processing (paddle.signal parity: reference
+python/paddle/signal.py — frame :42, overlap_add :167, stft :272,
+istft :449).
+
+TPU-first: framing is a static gather (indices computed at trace time),
+overlap-add a segment-sum scatter, STFT = frame → window → (r)fft — all
+jnp ops, so the whole pipeline jits and differentiates.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+from .ops._dispatch import unary, nary, ensure_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_impl(a, frame_length, hop_length, axis):
+    """`axis` is SEMANTIC: -1 (window the last dim) or 0 (the first) —
+    they coincide positionally for 1-D input but produce different layouts
+    (reference frame: axis=-1 -> [..., frame_length, num_frames];
+    axis=0 -> [num_frames, frame_length, ...])."""
+    ax = a.ndim - 1 if axis == -1 else 0
+    n = a.shape[ax]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length ({frame_length}) > signal length ({n})")
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    out = jnp.take(a, idx.reshape(-1), axis=ax)
+    # reshape the flattened gather back to [..., num_frames, frame_length, ...]
+    shape = (a.shape[:ax] + (num_frames, frame_length) + a.shape[ax + 1:])
+    out = out.reshape(shape)
+    if axis == -1:
+        out = jnp.swapaxes(out, ax, ax + 1)
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slide a window over `axis`: output [..., frame_length, num_frames]
+    (axis=-1) or [num_frames, frame_length, ...] (axis=0) — reference
+    signal.py:42."""
+    x = ensure_tensor(x)
+    if hop_length < 1:
+        raise ValueError(f"hop_length should be > 0, got {hop_length}")
+    if axis not in (-1, 0):   # reference frame: axis must be 0 or -1
+        raise ValueError(f"axis should be 0 or -1, got {axis}")
+    return unary(lambda a: _frame_impl(a, int(frame_length), int(hop_length),
+                                       axis),
+                 x, "frame")
+
+
+def _overlap_add_impl(a, hop_length, axis):
+    # reference layout (a is >= 2-D): axis=-1 -> [..., frame_length,
+    # num_frames]; axis=0 -> [num_frames, frame_length, ...]
+    last = axis in (-1, a.ndim - 1)
+    if last:
+        frames = jnp.swapaxes(a, -1, -2)     # [..., num_frames, frame_length]
+    else:
+        frames = jnp.moveaxis(a, (0, 1), (-2, -1))
+    num_frames, frame_length = frames.shape[-2], frames.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    seg = (jnp.arange(num_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :]).reshape(-1)
+    flat = frames.reshape(frames.shape[:-2] + (-1,))
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), a.dtype)
+    out = out.at[..., seg].add(flat)
+    if not last:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of `frame` (sum of overlapping windows) — reference
+    signal.py:167."""
+    x = ensure_tensor(x)
+    if hop_length < 1:
+        raise ValueError(f"hop_length should be > 0, got {hop_length}")
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add supports axis -1 or 0")
+    return unary(lambda a: _overlap_add_impl(a, int(hop_length), axis),
+                 x, "overlap_add")
+
+
+def _pad_window(w, win_length, n_fft):
+    lpad = (n_fft - win_length) // 2
+    return jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference signal.py:272). Output
+    [..., n_fft//2+1, num_frames] (real input, onesided) else
+    [..., n_fft, num_frames]."""
+    x = ensure_tensor(x)
+    hop_length = int(hop_length or n_fft // 4)
+    win_length = int(win_length or n_fft)
+    is_complex = "complex" in str(x.dtype)
+    if is_complex and onesided:
+        raise ValueError("onesided is not supported for complex input")
+
+    inputs = [x]
+    if window is not None:
+        inputs.append(ensure_tensor(window))
+
+    def f(a, *maybe_w):
+        if maybe_w:
+            w = _pad_window(maybe_w[0], win_length, int(n_fft))
+        else:
+            w = _pad_window(jnp.ones((win_length,), jnp.float32), win_length,
+                            int(n_fft))
+        if center:
+            pad = int(n_fft) // 2
+            cfg = [(0, 0)] * (a.ndim - 1) + [(pad, pad)]
+            a = jnp.pad(a, cfg, mode=pad_mode)
+        frames = _frame_impl(a, int(n_fft), hop_length, -1)
+        # [..., n_fft, num_frames] -> transform over the n_fft axis
+        frames = jnp.swapaxes(frames, -1, -2) * w.astype(
+            jnp.float32 if not is_complex else w.dtype)
+        if onesided and not is_complex:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(float(n_fft), jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)   # [..., freq, num_frames]
+
+    return nary(f, inputs, "stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT via windowed overlap-add with NOLA normalization
+    (reference signal.py:449). Input [..., freq, num_frames]."""
+    x = ensure_tensor(x)
+    hop_length = int(hop_length or n_fft // 4)
+    win_length = int(win_length or n_fft)
+
+    inputs = [x]
+    if window is not None:
+        inputs.append(ensure_tensor(window))
+
+    def f(a, *maybe_w):
+        if maybe_w:
+            w = _pad_window(maybe_w[0].astype(jnp.float32), win_length,
+                            int(n_fft))
+        else:
+            w = _pad_window(jnp.ones((win_length,), jnp.float32), win_length,
+                            int(n_fft))
+        spec = jnp.swapaxes(a, -1, -2)       # [..., num_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(float(n_fft), jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=int(n_fft), axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w
+        num_frames = frames.shape[-2]
+        sig = _overlap_add_impl(jnp.swapaxes(frames, -1, -2), hop_length,
+                                frames.ndim - 1)
+        # NOLA normalization: divide by summed squared window
+        wsq = jnp.tile(w * w, (num_frames, 1))
+        denom = _overlap_add_impl(jnp.swapaxes(wsq, -1, -2), hop_length, 1)
+        sig = sig / jnp.maximum(denom, 1e-11)
+        if center:
+            pad = int(n_fft) // 2
+            sig = sig[..., pad:sig.shape[-1] - pad]
+        if length is not None:
+            if sig.shape[-1] < length:   # reference: zero-pad to `length`
+                cfg = [(0, 0)] * (sig.ndim - 1) + [(0, length - sig.shape[-1])]
+                sig = jnp.pad(sig, cfg)
+            sig = sig[..., :length]
+        return sig
+
+    return nary(f, inputs, "istft")

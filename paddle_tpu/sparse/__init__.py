@@ -1,0 +1,504 @@
+"""Sparse tensors (paddle.sparse parity: reference python/paddle/sparse/
+creation.py, unary.py, binary.py, multiary.py, nn/).
+
+TPU-first design: XLA has no dynamic-nnz sparse kernels, so sparsity is
+represented with STATIC-shape index/value arrays (COO: indices [ndim, nnz],
+values [nnz, ...]; CSR: crows/cols/values) and every op is expressed as
+gathers, scatters and segment-sums — all jit/grad/shard-friendly at fixed
+nnz. Pattern-changing conversions (`Tensor.to_sparse_coo`, `nonzero`) are
+eager-only, like every framework's sparse construction path.
+
+  - elementwise unary ops run on `values` only (sparsity preserved)
+  - sparse+sparse binary ops align the two patterns with sorted-id
+    searchsorted lookups over the union (static nnz1+nnz2 bound)
+  - matmul(sparse, dense) = gather rows + segment_sum — the MXU-friendly
+    formulation of SpMM
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+from . import nn  # noqa: E402,F401
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "sin", "tan", "asin", "atan", "sinh", "tanh",
+    "asinh", "atanh", "sqrt", "square", "log1p", "abs", "pow", "cast",
+    "neg", "deg2rad", "rad2deg", "expm1", "isnan", "coalesce", "sum",
+    "transpose", "reshape", "add", "subtract", "multiply", "divide",
+    "matmul", "mv", "masked_matmul", "addmm", "mask_as", "is_same_shape",
+]
+
+
+def _as_jnp(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO tensor: indices [sparse_dim, nnz] int64, values [nnz, *dense_dims].
+
+    Reference: paddle's sparse Tensor with coo layout
+    (paddle/phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = _as_jnp(indices).astype(jnp.int64)
+        self._values = _as_jnp(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = bool(coalesced)
+
+    # -- bookkeeping ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        from ..framework.dtype import from_jax_dtype
+
+        return from_jax_dtype(self._values.dtype)
+
+    def sparse_dim(self):
+        return int(self._indices.shape[0])
+
+    def dense_dim(self):
+        return self._values.ndim - 1
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def indices(self):
+        return Tensor._wrap(self._indices)
+
+    def values(self):
+        return Tensor._wrap(self._values)
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # -- conversion -----------------------------------------------------
+    def _linear_ids(self):
+        """Row-major linearized index of each nonzero over the sparse dims."""
+        strides = np.cumprod((self._shape[:self._indices.shape[0]] + (1,))[::-1])[::-1][1:]
+        s = jnp.asarray(strides.copy(), jnp.int64)
+        return (self._indices * s[:, None]).sum(0)
+
+    def to_dense(self):
+        sd = self.sparse_dim()
+        out = jnp.zeros(self._shape[:sd] + self._values.shape[1:],
+                        self._values.dtype)
+        out = out.at[tuple(self._indices)].add(self._values)
+        return Tensor._wrap(out)
+
+    def to_sparse_csr(self):
+        if self.sparse_dim() != 2 or self.dense_dim() != 0:
+            raise ValueError("to_sparse_csr needs a 2-D sparse matrix")
+        c = coalesce(self)
+        rows, cols = c._indices[0], c._indices[1]
+        crows = jnp.zeros((self._shape[0] + 1,), jnp.int64).at[rows + 1].add(1)
+        crows = jnp.cumsum(crows)
+        return SparseCsrTensor(crows, cols, c._values, self._shape)
+
+    # -- arithmetic sugar ----------------------------------------------
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def matmul(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR matrix: crows [rows+1], cols [nnz], values [nnz]
+    (paddle/phi/core/sparse_csr_tensor.h)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _as_jnp(crows).astype(jnp.int64)
+        self._cols = _as_jnp(cols).astype(jnp.int64)
+        self._values = _as_jnp(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        from ..framework.dtype import from_jax_dtype
+
+        return from_jax_dtype(self._values.dtype)
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def crows(self):
+        return Tensor._wrap(self._crows)
+
+    def cols(self):
+        return Tensor._wrap(self._cols)
+
+    def values(self):
+        return Tensor._wrap(self._values)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _row_ids(self):
+        # expand crows to one row id per nonzero: rows[i] = #crows <= i
+        return (jnp.searchsorted(self._crows, jnp.arange(self.nnz()),
+                                 side="right") - 1).astype(jnp.int64)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        idx = jnp.stack([self._row_ids(), self._cols])
+        return SparseCooTensor(idx, self._values, self._shape,
+                               coalesced=True)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Reference sparse/creation.py sparse_coo_tensor."""
+    idx = _as_jnp(indices).astype(jnp.int64)
+    vals = _as_jnp(values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+
+        vals = vals.astype(to_jax_dtype(dtype))
+    if shape is None:
+        if idx.shape[1] == 0:
+            raise ValueError(
+                "shape is required for an empty sparse_coo_tensor (no "
+                "indices to infer it from)")
+        sparse_shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+        shape = sparse_shape + vals.shape[1:]
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """Reference sparse/creation.py sparse_csr_tensor."""
+    vals = _as_jnp(values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+
+        vals = vals.astype(to_jax_dtype(dtype))
+    return SparseCsrTensor(_as_jnp(crows), _as_jnp(cols), vals, shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"expected a sparse tensor, got {type(x)}")
+    return x
+
+
+def _rewrap(x, coo_out):
+    """Return in the caller's layout (csr in -> csr out)."""
+    if isinstance(x, SparseCsrTensor):
+        return coo_out.to_sparse_csr()
+    return coo_out
+
+
+# ---------------------------------------------------------------------------
+# unary: values-only (sparsity-preserving) ops — reference sparse/unary.py
+# ---------------------------------------------------------------------------
+
+def _unary(fn):
+    def op(x, name=None):
+        c = _coo(x)
+        out = SparseCooTensor(c._indices, fn(c._values), c._shape,
+                              coalesced=c._coalesced)
+        return _rewrap(x, out)
+
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+expm1 = _unary(jnp.expm1)
+isnan = _unary(jnp.isnan)
+
+
+def pow(x, factor, name=None):
+    c = _coo(x)
+    return _rewrap(x, SparseCooTensor(c._indices,
+                                      jnp.power(c._values, factor),
+                                      c._shape, coalesced=c._coalesced))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework.dtype import to_jax_dtype
+
+    c = _coo(x)
+    idx = c._indices
+    vals = c._values
+    if index_dtype is not None:
+        idx = idx.astype(to_jax_dtype(index_dtype))
+    if value_dtype is not None:
+        vals = vals.astype(to_jax_dtype(value_dtype))
+    return _rewrap(x, SparseCooTensor(idx, vals, c._shape,
+                                      coalesced=c._coalesced))
+
+
+def coalesce(x, name=None):
+    """Sort indices and sum duplicates. Static-shape form: nnz is
+    preserved; each duplicate run keeps its coordinates but carries the
+    run's sum in its FIRST slot and zeros in the rest, so ids stay sorted
+    (a requirement of the searchsorted alignment in binary ops) and
+    `to_dense` is exact."""
+    c = _coo(x)
+    if c._coalesced or c.nnz() == 0:
+        return _rewrap(x, c)
+    ids = c._linear_ids()
+    order = jnp.argsort(ids)
+    ids_s = ids[order]
+    vals_s = c._values[order]
+    idx_s = c._indices[:, order]
+    first = jnp.concatenate([jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]])
+    seg = jnp.cumsum(first) - 1
+    n = c.nnz()
+    summed = jax.ops.segment_sum(vals_s, seg, num_segments=n)
+    vals_new = jnp.where(
+        first.reshape((-1,) + (1,) * (vals_s.ndim - 1)), summed[seg],
+        jnp.zeros_like(vals_s))
+    return _rewrap(x, SparseCooTensor(idx_s, vals_new, c._shape,
+                                      coalesced=True))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    c = _coo(x)
+    dense = c.to_dense()._data
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor._wrap(out)
+
+
+def transpose(x, perm, name=None):
+    c = _coo(x)
+    if c.dense_dim() != 0:
+        raise NotImplementedError("transpose of hybrid sparse tensors")
+    idx = jnp.stack([c._indices[p] for p in perm])
+    shape = tuple(c._shape[p] for p in perm)
+    return _rewrap(x, SparseCooTensor(idx, c._values, shape))
+
+
+def reshape(x, shape, name=None):
+    c = _coo(x)
+    if c.dense_dim() != 0:
+        raise NotImplementedError("reshape of hybrid sparse tensors")
+    new_shape = tuple(int(s) for s in shape)
+    if int(np.prod(new_shape)) != int(np.prod(c._shape)):
+        raise ValueError(f"cannot reshape {c._shape} to {new_shape}")
+    lin = c._linear_ids()
+    strides = np.cumprod((new_shape + (1,))[::-1])[::-1][1:]
+    s = jnp.asarray(strides.copy(), jnp.int64)
+    idx = (lin[None, :] // s[:, None]) % jnp.asarray(
+        np.asarray(new_shape, np.int64))[:, None]
+    return _rewrap(x, SparseCooTensor(idx, c._values, new_shape))
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def mask_as(x, mask, name=None):
+    """Pick values of dense `x` at `mask`'s sparsity pattern
+    (reference sparse/binary.py mask_as)."""
+    m = _coo(mask)
+    xd = _as_jnp(x)
+    vals = xd[tuple(m._indices)]
+    return _rewrap(mask, SparseCooTensor(m._indices, vals, m._shape,
+                                         coalesced=m._coalesced))
+
+
+# ---------------------------------------------------------------------------
+# binary — union/intersection alignment via sorted-id searchsorted
+# ---------------------------------------------------------------------------
+
+def _aligned_binary(a, b, fn):
+    ca, cb = coalesce(_coo(a)), coalesce(_coo(b))
+    if ca._shape != cb._shape:
+        raise ValueError(f"shape mismatch {ca._shape} vs {cb._shape}")
+    ids_a, ids_b = ca._linear_ids(), cb._linear_ids()
+    # union pattern: concatenated (static nnz_a + nnz_b), re-coalesced
+    idx_u = jnp.concatenate([ca._indices, cb._indices], axis=1)
+    ids_u = jnp.concatenate([ids_a, ids_b])
+    order = jnp.argsort(ids_u)
+    ids_s = ids_u[order]
+    idx_s = idx_u[:, order]
+
+    def lookup(ids_sorted, vals, q):
+        pos = jnp.searchsorted(ids_sorted, q)
+        pos = jnp.clip(pos, 0, vals.shape[0] - 1)
+        hit = ids_sorted[pos] == q
+        v = vals[pos]
+        return jnp.where(hit, v, jnp.zeros_like(v))
+
+    va = lookup(ids_a, ca._values, ids_s)
+    vb = lookup(ids_b, cb._values, ids_s)
+    out_vals = fn(va, vb)
+    # zero out duplicate union slots (keep first occurrence only)
+    first = jnp.concatenate([jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]])
+    out_vals = jnp.where(first, out_vals, jnp.zeros_like(out_vals))
+    out = SparseCooTensor(idx_s, out_vals, ca._shape, coalesced=False)
+    return _rewrap(a, out)
+
+
+def add(x, y, name=None):
+    if isinstance(y, Tensor):         # sparse + dense -> dense (reference)
+        return Tensor._wrap(_coo(x).to_dense()._data + y._data)
+    return _aligned_binary(x, y, jnp.add)
+
+
+def subtract(x, y, name=None):
+    if isinstance(y, Tensor):
+        return Tensor._wrap(_coo(x).to_dense()._data - y._data)
+    return _aligned_binary(x, y, jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    if isinstance(y, (int, float)):
+        c = _coo(x)
+        return _rewrap(x, SparseCooTensor(c._indices, c._values * y,
+                                          c._shape, c._coalesced))
+    if isinstance(y, Tensor):         # sparse * dense: gather pattern
+        c = _coo(x)
+        vals = c._values * y._data[tuple(c._indices)]
+        return _rewrap(x, SparseCooTensor(c._indices, vals, c._shape,
+                                          c._coalesced))
+    return _aligned_binary(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return multiply(x, 1.0 / y)
+    if isinstance(y, Tensor):
+        c = _coo(x)
+        vals = c._values / y._data[tuple(c._indices)]
+        return _rewrap(x, SparseCooTensor(c._indices, vals, c._shape,
+                                          c._coalesced))
+    return _aligned_binary(x, y, jnp.divide)
+
+
+# ---------------------------------------------------------------------------
+# matmul family — gather + segment_sum SpMM
+# ---------------------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse [M, N] @ dense [N, K] -> dense [M, K] (reference
+    sparse/binary.py matmul over cusparse SpMM): one gather of y's rows at
+    the nonzero columns and one segment-sum over rows — both native XLA."""
+    c = coalesce(_coo(x))
+    if c.sparse_dim() != 2 or c.dense_dim() != 0:
+        raise NotImplementedError("matmul supports 2-D sparse matrices")
+    yd = _as_jnp(y)
+    rows, cols = c._indices[0], c._indices[1]
+    contrib = c._values[:, None] * yd[cols]          # [nnz, K]
+    out = jax.ops.segment_sum(contrib, rows, num_segments=c._shape[0])
+    return Tensor._wrap(out)
+
+
+def mv(x, vec, name=None):
+    """sparse [M, N] @ dense [N] -> dense [M]."""
+    c = coalesce(_coo(x))
+    vd = _as_jnp(vec)
+    rows, cols = c._indices[0], c._indices[1]
+    return Tensor._wrap(jax.ops.segment_sum(
+        c._values * vd[cols], rows, num_segments=c._shape[0]))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at `mask`'s pattern (SDDMM — reference
+    sparse/binary.py masked_matmul): per-nonzero row/col gathers + a
+    contraction, never materializing the dense product."""
+    m = _coo(mask)
+    xd, yd = _as_jnp(x), _as_jnp(y)
+    rows, cols = m._indices[0], m._indices[1]
+    vals = (xd[rows] * yd[:, cols].T).sum(-1)
+    return _rewrap(mask, SparseCooTensor(m._indices, vals, m._shape,
+                                         coalesced=m._coalesced))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (reference
+    sparse/multiary.py addmm)."""
+    prod = matmul(x, y)
+    return Tensor._wrap(beta * _as_jnp(input) + alpha * prod._data)
+
+
+# ---------------------------------------------------------------------------
+# dense Tensor -> sparse conversions (eager-only: nnz is data-dependent)
+# ---------------------------------------------------------------------------
+
+def _tensor_to_sparse_coo(self, sparse_dim=None):
+    """Dense -> COO (reference Tensor.to_sparse_coo). Eager-only: the
+    nonzero pattern is data-dependent, so this cannot run under jit —
+    construct sparse tensors outside traced code (as with every framework)."""
+    a = np.asarray(self._data)
+    sd = int(sparse_dim) if sparse_dim is not None else a.ndim
+    if sd == a.ndim:
+        reduced = a
+    else:
+        reduced = np.abs(a).sum(axis=tuple(range(sd, a.ndim)))
+    nz = np.nonzero(reduced)
+    idx = np.stack(nz).astype(np.int64)
+    values = a[nz]
+    return SparseCooTensor(idx, values, a.shape, coalesced=True)
+
+
+def _tensor_to_sparse_csr(self):
+    return _tensor_to_sparse_coo(self).to_sparse_csr()
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo
+Tensor.to_sparse_csr = _tensor_to_sparse_csr

@@ -1,0 +1,89 @@
+"""Sparse NN layers (reference python/paddle/sparse/nn/): activations and
+norms run on the value array (pattern-preserving); sparse softmax
+normalizes per row over the stored nonzeros, matching the reference's
+"treat implicit zeros as -inf" semantics (sparse/nn/functional/activation.py).
+
+The 3-D point-cloud conv pack (Conv3D/SubmConv3D/MaxPool3D over cuSPARSE
+gather-scatter kernels) is not in the TPU v1 scope and raises
+NotImplementedError — the data layouts exist (SparseCooTensor), so it can
+land as a pallas kernel pack later.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layer.layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "Conv3D", "SubmConv3D", "MaxPool3D", "functional"]
+
+
+from . import functional  # noqa: E402
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the nonzero values' channel dim (reference
+    sparse/nn/layer/norm.py BatchNorm: norm over the dense channel axis of
+    a hybrid COO tensor's values)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        from ... import nn as dnn
+
+        self._bn = dnn.BatchNorm1D(num_features, momentum=momentum,
+                                   epsilon=epsilon)
+
+    def forward(self, x):
+        from .. import SparseCooTensor
+        from ...framework.tensor import Tensor
+
+        vals = self._bn(Tensor._wrap(x._values))
+        return SparseCooTensor(x._indices, vals._data, x._shape,
+                               coalesced=x._coalesced)
+
+
+class Conv3D(Layer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "sparse Conv3D is not in the TPU v1 op set (needs a pallas "
+            "gather-GEMM-scatter kernel pack)")
+
+
+class SubmConv3D(Conv3D):
+    pass
+
+
+class MaxPool3D(Layer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "sparse MaxPool3D is not in the TPU v1 op set")

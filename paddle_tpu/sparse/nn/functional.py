@@ -1,0 +1,61 @@
+"""Sparse functional ops (reference sparse/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _values_op(x, fn):
+    from .. import SparseCooTensor, SparseCsrTensor, _coo, _rewrap
+
+    c = _coo(x)
+    return _rewrap(x, SparseCooTensor(c._indices, fn(c._values), c._shape,
+                                      coalesced=c._coalesced))
+
+
+def relu(x, name=None):
+    return _values_op(x, jax.nn.relu)
+
+
+def relu6(x, name=None):
+    return _values_op(x, lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _values_op(x, lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the stored nonzeros of each row (implicit zeros act as
+    -inf, i.e. they do not participate) — reference sparse softmax
+    semantics for 2-D COO/CSR."""
+    from .. import SparseCooTensor, _coo, _rewrap, coalesce
+
+    if axis not in (-1, 1):
+        raise NotImplementedError("sparse softmax supports the last axis")
+    c = coalesce(_coo(x))
+    if c.sparse_dim() != 2 or c.dense_dim() != 0:
+        raise NotImplementedError("sparse softmax supports 2-D matrices")
+    rows = c._indices[0]
+    n_rows = c._shape[0]
+    vals = c._values.astype(jnp.float32)
+    # zero-valued duplicate slots from static coalesce must not join the
+    # softmax: mark occupied slots by value... a zero value is a valid
+    # logit, so mark via first-occurrence structure instead
+    ids = c._linear_ids()
+    first = jnp.concatenate([jnp.ones((1,), bool), ids[1:] != ids[:-1]])
+    neg_inf = jnp.asarray(-jnp.inf, vals.dtype)
+    masked = jnp.where(first, vals, neg_inf)
+    row_max = jax.ops.segment_max(masked, rows, num_segments=n_rows)
+    e = jnp.where(first, jnp.exp(masked - row_max[rows]), 0.0)
+    denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+    out = e / jnp.maximum(denom[rows], 1e-38)
+    return _rewrap(x, SparseCooTensor(c._indices, out.astype(c._values.dtype),
+                                      c._shape, coalesced=True))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    raise NotImplementedError(
+        "sparse attention rides the dense flash/ring paths on TPU "
+        "(nn/functional/flash_attention.py)")

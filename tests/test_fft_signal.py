@@ -1,0 +1,125 @@
+"""paddle.fft / paddle.signal parity against numpy references
+(reference python/paddle/fft.py, signal.py; numpy is the numeric oracle,
+as in the reference's own fft tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+
+class TestFFT:
+    def test_fft_ifft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 16))
+        y = fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(y._data), np.fft.fft(x),
+                                   rtol=1e-4, atol=1e-4)
+        back = fft.ifft(y)
+        np.testing.assert_allclose(np.asarray(back._data).real, x,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8,))
+        y = fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(y._data), np.fft.rfft(x),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(fft.irfft(y, n=8)._data), x,
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_norms(self, norm):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((6,))
+        y = fft.fft(paddle.to_tensor(x), norm=norm)
+        np.testing.assert_allclose(np.asarray(y._data),
+                                   np.fft.fft(x, norm=norm),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fft2_fftn(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 8, 8))
+        np.testing.assert_allclose(
+            np.asarray(fft.fft2(paddle.to_tensor(x))._data),
+            np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(fft.fftn(paddle.to_tensor(x))._data),
+            np.fft.fftn(x), rtol=1e-4, atol=1e-4)
+
+    def test_hfft_ihfft(self):
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((9,)) + 1j * rng.standard_normal((9,))
+        np.testing.assert_allclose(
+            np.asarray(fft.hfft(paddle.to_tensor(x))._data),
+            np.fft.hfft(x), rtol=1e-4, atol=1e-4)
+        xr = rng.standard_normal((8,))
+        np.testing.assert_allclose(
+            np.asarray(fft.ihfft(paddle.to_tensor(xr))._data),
+            np.fft.ihfft(xr), rtol=1e-4, atol=1e-4)
+
+    def test_helpers(self):
+        np.testing.assert_allclose(np.asarray(fft.fftfreq(8, d=0.5)._data),
+                                   np.fft.fftfreq(8, d=0.5))
+        np.testing.assert_allclose(np.asarray(fft.rfftfreq(8)._data),
+                                   np.fft.rfftfreq(8))
+        x = np.arange(8.0)
+        np.testing.assert_allclose(
+            np.asarray(fft.fftshift(paddle.to_tensor(x))._data),
+            np.fft.fftshift(x))
+        np.testing.assert_allclose(
+            np.asarray(fft.ifftshift(paddle.to_tensor(x))._data),
+            np.fft.ifftshift(x))
+
+    def test_fft_grad_flows(self):
+        x = paddle.to_tensor(np.random.default_rng(5).standard_normal((8,)),
+                             dtype="float32")
+        x.stop_gradient = False
+        y = fft.rfft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert np.all(np.isfinite(np.asarray(x.grad._data)))
+
+
+class TestSignal:
+    def test_frame_matches_manual(self):
+        x = np.arange(10.0)
+        out = signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2)
+        got = np.asarray(out._data)           # [frame_length, num_frames]
+        assert got.shape == (4, 4)
+        for t in range(4):
+            np.testing.assert_allclose(got[:, t], x[2 * t:2 * t + 4])
+
+    def test_frame_axis0(self):
+        x = np.arange(10.0)
+        out = signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2,
+                           axis=0)
+        got = np.asarray(out._data)           # [num_frames, frame_length]
+        assert got.shape == (4, 4)
+        for t in range(4):
+            np.testing.assert_allclose(got[t], x[2 * t:2 * t + 4])
+
+    def test_overlap_add_inverts_frame_sum(self):
+        x = np.arange(8.0)
+        framed = signal.frame(paddle.to_tensor(x), 4, 4)  # non-overlapping
+        back = signal.overlap_add(framed, hop_length=4)
+        np.testing.assert_allclose(np.asarray(back._data), x)
+
+    def test_stft_shape_and_istft_roundtrip(self):
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((2, 512)).astype(np.float32)
+        spec = signal.stft(paddle.to_tensor(x), n_fft=128)
+        assert list(spec.shape) == [2, 65, 17]   # [..., n_fft//2+1, frames]
+        back = signal.istft(spec, n_fft=128, length=512)
+        np.testing.assert_allclose(np.asarray(back._data), x, atol=1e-4)
+
+    def test_stft_with_window(self):
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((512,)).astype(np.float32)
+        w = np.hanning(128).astype(np.float32)
+        spec = signal.stft(paddle.to_tensor(x), n_fft=128,
+                           window=paddle.to_tensor(w))
+        back = signal.istft(spec, n_fft=128, window=paddle.to_tensor(w),
+                            length=512)
+        np.testing.assert_allclose(np.asarray(back._data), x, atol=1e-3)

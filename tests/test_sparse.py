@@ -1,0 +1,193 @@
+"""paddle.sparse parity tests (reference python/paddle/sparse): COO/CSR
+construction, dense round-trips, values-only unary ops, pattern-aligned
+binary ops, SpMM/SDDMM, and sparse softmax — numpy dense ops are the
+oracle, as in the reference's own test_sparse_* suites."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = np.zeros(shape, np.float32)
+    flat = rng.choice(np.prod(shape), size=nnz, replace=False)
+    dense.flat[flat] = rng.standard_normal(nnz).astype(np.float32)
+    idx = np.stack(np.nonzero(dense)).astype(np.int64)
+    vals = dense[tuple(idx)]
+    return sparse.sparse_coo_tensor(idx, vals, shape), dense
+
+
+class TestCreationAndConversion:
+    def test_coo_to_dense_roundtrip(self):
+        sp, dense = _rand_coo()
+        np.testing.assert_allclose(np.asarray(sp.to_dense()._data), dense)
+        back = paddle.to_tensor(dense).to_sparse_coo()
+        np.testing.assert_allclose(np.asarray(back.to_dense()._data), dense)
+
+    def test_coo_duplicate_indices_coalesce(self):
+        idx = np.array([[0, 0, 1], [1, 1, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        sp = sparse.sparse_coo_tensor(idx, vals, (2, 3))
+        d = np.asarray(sparse.coalesce(sp).to_dense()._data)
+        assert d[0, 1] == 3.0 and d[1, 2] == 3.0
+
+    def test_csr_roundtrip(self):
+        sp, dense = _rand_coo((3, 4), 5, seed=1)
+        csr = sp.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_allclose(np.asarray(csr.to_dense()._data), dense)
+        np.testing.assert_allclose(
+            np.asarray(csr.to_sparse_coo().to_dense()._data), dense)
+
+    def test_csr_direct_construction(self):
+        # [[0, 1, 0], [2, 0, 3]]
+        csr = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2],
+                                       [1.0, 2.0, 3.0], (2, 3))
+        want = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+        np.testing.assert_allclose(np.asarray(csr.to_dense()._data), want)
+
+    def test_hybrid_dense_dim(self):
+        dense = np.zeros((3, 4, 2), np.float32)
+        dense[0, 1] = [1.0, 2.0]
+        dense[2, 3] = [3.0, 4.0]
+        sp = paddle.to_tensor(dense).to_sparse_coo(sparse_dim=2)
+        assert sp.sparse_dim() == 2 and sp.dense_dim() == 1
+        np.testing.assert_allclose(np.asarray(sp.to_dense()._data), dense)
+
+
+class TestUnary:
+    @pytest.mark.parametrize("name", ["sin", "tanh", "square", "abs",
+                                      "expm1", "neg"])
+    def test_values_ops_match_dense(self, name):
+        sp, dense = _rand_coo(seed=2)
+        out = getattr(sparse, name)(sp)
+        ref = getattr(np, {"neg": "negative"}.get(name, name))(dense)
+        # implicit zeros stay zero for these (f(0)=0 ops)
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data), ref,
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_cast_and_pow(self):
+        sp, dense = _rand_coo(seed=3)
+        out = sparse.cast(sp, value_dtype="float64")
+        assert "float64" in str(out.values().dtype)
+        out2 = sparse.pow(sp, 2.0)
+        np.testing.assert_allclose(np.asarray(out2.to_dense()._data),
+                                   dense ** 2, rtol=1e-5, atol=1e-6)
+
+    def test_transpose_reshape_sum(self):
+        sp, dense = _rand_coo((3, 4), 5, seed=4)
+        np.testing.assert_allclose(
+            np.asarray(sparse.transpose(sp, [1, 0]).to_dense()._data),
+            dense.T)
+        np.testing.assert_allclose(
+            np.asarray(sparse.reshape(sp, [4, 3]).to_dense()._data),
+            dense.reshape(4, 3))
+        np.testing.assert_allclose(np.asarray(sparse.sum(sp)._data),
+                                   dense.sum(), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.sum(sp, axis=1)._data), dense.sum(1),
+            rtol=1e-6)
+
+
+class TestBinary:
+    def test_add_subtract_different_patterns(self):
+        a, da = _rand_coo(seed=5)
+        b, db = _rand_coo(seed=6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.add(a, b).to_dense()._data), da + db,
+            rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.subtract(a, b).to_dense()._data), da - db,
+            rtol=1e-6, atol=1e-6)
+
+    def test_multiply_intersection(self):
+        a, da = _rand_coo(seed=7)
+        b, db = _rand_coo(seed=8)
+        np.testing.assert_allclose(
+            np.asarray(sparse.multiply(a, b).to_dense()._data), da * db,
+            rtol=1e-6, atol=1e-6)
+
+    def test_scalar_and_dense_operands(self):
+        a, da = _rand_coo(seed=9)
+        np.testing.assert_allclose(
+            np.asarray(sparse.multiply(a, 2.5).to_dense()._data), da * 2.5,
+            rtol=1e-6)
+        dense_y = paddle.to_tensor(
+            np.random.default_rng(10).standard_normal((4, 5)).astype(np.float32))
+        got = sparse.add(a, dense_y)
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   da + np.asarray(dense_y._data),
+                                   rtol=1e-6)
+
+    def test_mask_as_and_is_same_shape(self):
+        a, da = _rand_coo(seed=11)
+        x = np.random.default_rng(12).standard_normal((4, 5)).astype(np.float32)
+        got = sparse.mask_as(paddle.to_tensor(x), a)
+        mask = (da != 0).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(got.to_dense()._data),
+                                   x * mask, rtol=1e-6)
+        assert sparse.is_same_shape(a, a)
+
+
+class TestMatmul:
+    def test_spmm_matches_dense(self):
+        sp, dense = _rand_coo((4, 5), 7, seed=13)
+        y = np.random.default_rng(14).standard_normal((5, 3)).astype(np.float32)
+        got = sparse.matmul(sp, paddle.to_tensor(y))
+        np.testing.assert_allclose(np.asarray(got._data), dense @ y,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mv(self):
+        sp, dense = _rand_coo((4, 5), 6, seed=15)
+        v = np.random.default_rng(16).standard_normal((5,)).astype(np.float32)
+        got = sparse.mv(sp, paddle.to_tensor(v))
+        np.testing.assert_allclose(np.asarray(got._data), dense @ v,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_masked_matmul_sddmm(self):
+        mask, dmask = _rand_coo((4, 4), 5, seed=17)
+        rng = np.random.default_rng(18)
+        x = rng.standard_normal((4, 6)).astype(np.float32)
+        y = rng.standard_normal((6, 4)).astype(np.float32)
+        got = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        want = (x @ y) * (dmask != 0)
+        np.testing.assert_allclose(np.asarray(got.to_dense()._data), want,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_addmm(self):
+        sp, dense = _rand_coo((3, 4), 5, seed=19)
+        rng = np.random.default_rng(20)
+        y = rng.standard_normal((4, 2)).astype(np.float32)
+        inp = rng.standard_normal((3, 2)).astype(np.float32)
+        got = sparse.addmm(paddle.to_tensor(inp), sp, paddle.to_tensor(y),
+                           beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   0.5 * inp + 2.0 * (dense @ y),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestSparseNN:
+    def test_relu_layer(self):
+        sp, dense = _rand_coo(seed=21)
+        out = sparse.nn.ReLU()(sp)
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data),
+                                   np.maximum(dense, 0), rtol=1e-6)
+
+    def test_softmax_over_stored_nonzeros(self):
+        sp, dense = _rand_coo((3, 6), 8, seed=22)
+        out = sparse.nn.functional.softmax(sp)
+        got = np.asarray(out.to_dense()._data)
+        for r in range(3):
+            nz = dense[r] != 0
+            if nz.sum() == 0:
+                continue
+            e = np.exp(dense[r][nz] - dense[r][nz].max())
+            np.testing.assert_allclose(got[r][nz], e / e.sum(), rtol=1e-5)
+            assert np.all(got[r][~nz] == 0)
+
+    def test_conv3d_raises(self):
+        with pytest.raises(NotImplementedError):
+            sparse.nn.Conv3D(3, 3, 3)
