@@ -55,6 +55,24 @@ class Generator:
     def split_key(self, n: int):
         return jax.random.split(self.next_key(), n)
 
+    def next_host_seed(self):
+        """Host-side (seed, offset) draw for eager-only consumers (weight
+        init): advancing the same offset stream as next_key keeps
+        reproducibility under paddle.seed while letting the consumer use a
+        numpy RNG — no per-shape XLA compile per parameter, which is what
+        makes eager model construction O(params) cheap. Returns None when
+        the offset is a tracer (construction inside jit): callers must fall
+        back to the functional jax.random path."""
+        with self._lock:
+            off = self._offset
+            if isinstance(off, jax.Array) and not isinstance(
+                    off, jax.core.Tracer):
+                off = int(off)
+            if isinstance(off, jax.core.Tracer):
+                return None
+            self._offset = off + 1
+            return (self._seed, off)
+
 
 _default_generator = Generator(0)
 
@@ -79,3 +97,35 @@ def set_rng_state(state):
 
 def next_key():
     return _default_generator.next_key()
+
+
+def host_rng():
+    """Numpy RNG seeded from the global generator's (seed, offset) stream —
+    THE single implementation of the eager init fast path (one host draw +
+    one transfer per parameter instead of one compiled XLA program per
+    shape). Returns None under a trace; callers then use the functional
+    jax.random path. Consumed by nn.initializer and model _init_weights."""
+    import numpy as np
+
+    hs = _default_generator.next_host_seed()
+    if hs is None:
+        return None
+    return np.random.default_rng(np.random.SeedSequence(hs))
+
+
+def host_normal(shape, std=1.0, mean=0.0, dtype=None):
+    """Normal init draw via host_rng (jax.random fallback under trace).
+    The draw is float64 on host and rounded once to the target dtype."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    dt = dtype or jnp.float32
+    rng = host_rng()
+    if rng is None:
+        return mean + std * jax.random.normal(
+            _default_generator.next_key(), tuple(shape), dt)
+    arr = mean + std * rng.standard_normal(tuple(shape))
+    try:
+        return jnp.asarray(np.asarray(arr, np.dtype(dt)))
+    except TypeError:   # bf16 etc: host-cast f32, device-cast target
+        return jnp.asarray(np.asarray(arr, np.float32)).astype(dt)

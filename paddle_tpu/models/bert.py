@@ -145,13 +145,12 @@ class BertModel(nn.Layer):
         self._init_weights(config)
 
     def _init_weights(self, config):
-        from ..framework.random import next_key
+        from ..framework.random import host_normal
 
         std = config.initializer_range
         for _, p in self.named_parameters():
             if p.ndim >= 2:
-                p._data = std * jax.random.normal(next_key(), p._data.shape,
-                                                  jnp.float32)
+                p._data = host_normal(p._data.shape, std)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):

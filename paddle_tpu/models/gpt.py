@@ -184,14 +184,13 @@ class GPTModel(nn.Layer):
     def _init_weights(self, config):
         import jax
 
-        from ..framework.random import next_key
+        from ..framework.random import host_normal
         import jax.numpy as jnp
 
         std = config.initializer_range
         for name, p in self.named_parameters():
             if p.ndim >= 2:
-                p._data = std * jax.random.normal(next_key(), p._data.shape,
-                                                  jnp.float32)
+                p._data = host_normal(p._data.shape, std)
                 if re.search(r"(out_proj|fc2)\.weight$", name):
                     # GPT-2 residual-scaled init
                     p._data = p._data / math.sqrt(2.0 * config.num_layers)

@@ -74,13 +74,13 @@ class GPTForCausalLMPipe(nn.Layer):
         lead = ((self.num_stages, self.layers_per_stage)
                 if self.num_chunks == 1 else
                 (self.num_stages, self.num_chunks, self.layers_per_stage))
-        from ..framework.random import next_key
+        from ..framework.random import host_normal
 
         std = config.initializer_range
         for pname, p in self._template.named_parameters():
             shape = lead + tuple(p.shape)
             if p.ndim >= 2:
-                data = std * jax.random.normal(next_key(), shape, jnp.float32)
+                data = host_normal(shape, std)
                 if re.search(r"(out_proj|fc2)\.weight$", pname):
                     data = data / (2.0 * config.num_layers) ** 0.5
             else:

@@ -171,10 +171,17 @@ class LlamaAttention(nn.Layer):
             logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kr,
                                 preferred_element_type=jnp.float32)
             logits = logits / math.sqrt(hd)
+            # bf16 score HBM residency (same policy as _sdpa_ref — softmax
+            # math stays fp32; FLAGS_attention_fp32_scores restores fp32)
+            from ..utils import flags as _flags
+
+            if (qd.dtype in (jnp.bfloat16, jnp.float16)
+                    and not _flags.get_flag("FLAGS_attention_fp32_scores")):
+                logits = logits.astype(qd.dtype)
             mask = jnp.tril(jnp.ones((s, s), bool))
             logits = jnp.where(mask[None, None, None], logits,
-                               jnp.float32(-jnp.inf))
-            probs = jax.nn.softmax(logits, axis=-1)
+                               jnp.asarray(-jnp.inf, logits.dtype))
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
             out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(vd.dtype),
                              vd, preferred_element_type=jnp.float32)
             return out.reshape(b, s, self.num_heads, hd).astype(qd.dtype)
@@ -235,13 +242,12 @@ class LlamaModel(nn.Layer):
         self._init_weights(config)
 
     def _init_weights(self, config):
-        from ..framework.random import next_key
+        from ..framework.random import host_normal
 
         std = config.initializer_range
         for name, p in self.named_parameters():
             if p.ndim >= 2:
-                p._data = std * jax.random.normal(next_key(), p._data.shape,
-                                                  jnp.float32)
+                p._data = host_normal(p._data.shape, std)
                 if re.search(r"(o_proj|down_proj)\.weight$", name):
                     p._data = p._data / math.sqrt(2.0 * config.num_layers)
 

@@ -15,6 +15,22 @@ from ...framework.dtype import to_jax_dtype
 from ...framework.random import default_generator
 
 
+from ...framework.random import host_rng as _host_rng  # noqa: E402
+
+
+def _as_dtype(arr, dtype):
+    # the host draw is float64; round ONCE to the target dtype, and do the
+    # rounding ON HOST when numpy supports the dtype — transferring f64
+    # and casting on device would double the host->device bytes (meaningful
+    # for 100M+-param models over a remote-device link)
+    jdt = to_jax_dtype(dtype)
+    try:
+        np_dt = np.dtype(jdt)
+        return jnp.asarray(np.asarray(arr, np_dt))
+    except TypeError:   # bf16 etc: host-cast to f32, device-cast to target
+        return jnp.asarray(np.asarray(arr, np.float32)).astype(jdt)
+
+
 def _fan_in_out(shape):
     shape = tuple(shape)
     if len(shape) < 2:
@@ -44,6 +60,11 @@ class Normal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype="float32"):
+        rng = _host_rng()
+        if rng is not None:
+            return _as_dtype(
+                self.mean + self.std * rng.standard_normal(tuple(shape)),
+                dtype)
         key = default_generator().next_key()
         return self.mean + self.std * jax.random.normal(key, tuple(shape), to_jax_dtype(dtype))
 
@@ -53,6 +74,16 @@ class TruncatedNormal(Initializer):
         self.mean, self.std, self.a, self.b = mean, std, a, b
 
     def __call__(self, shape, dtype="float32"):
+        rng = _host_rng()
+        if rng is not None:
+            arr = rng.standard_normal(tuple(shape))
+            for _ in range(64):   # resample out-of-bounds draws
+                bad = (arr < self.a) | (arr > self.b)
+                if not bad.any():
+                    break
+                arr = np.where(bad, rng.standard_normal(tuple(shape)), arr)
+            arr = np.clip(arr, self.a, self.b)
+            return _as_dtype(self.mean + self.std * arr, dtype)
         key = default_generator().next_key()
         return self.mean + self.std * jax.random.truncated_normal(
             key, self.a, self.b, tuple(shape), to_jax_dtype(dtype)
@@ -64,6 +95,10 @@ class Uniform(Initializer):
         self.low, self.high = low, high
 
     def __call__(self, shape, dtype="float32"):
+        rng = _host_rng()
+        if rng is not None:
+            return _as_dtype(rng.uniform(self.low, self.high, tuple(shape)),
+                             dtype)
         key = default_generator().next_key()
         return jax.random.uniform(
             key, tuple(shape), to_jax_dtype(dtype), minval=self.low, maxval=self.high
@@ -79,6 +114,9 @@ class XavierUniform(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
         limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        rng = _host_rng()
+        if rng is not None:
+            return _as_dtype(rng.uniform(-limit, limit, tuple(shape)), dtype)
         key = default_generator().next_key()
         return jax.random.uniform(
             key, tuple(shape), to_jax_dtype(dtype), minval=-limit, maxval=limit
@@ -94,6 +132,9 @@ class XavierNormal(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
         std = self.gain * math.sqrt(2.0 / (fi + fo))
+        rng = _host_rng()
+        if rng is not None:
+            return _as_dtype(std * rng.standard_normal(tuple(shape)), dtype)
         key = default_generator().next_key()
         return std * jax.random.normal(key, tuple(shape), to_jax_dtype(dtype))
 
@@ -108,6 +149,9 @@ class KaimingUniform(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
         limit = gain * math.sqrt(3.0 / fi)
+        rng = _host_rng()
+        if rng is not None:
+            return _as_dtype(rng.uniform(-limit, limit, tuple(shape)), dtype)
         key = default_generator().next_key()
         return jax.random.uniform(
             key, tuple(shape), to_jax_dtype(dtype), minval=-limit, maxval=limit
@@ -124,6 +168,9 @@ class KaimingNormal(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
         std = gain / math.sqrt(fi)
+        rng = _host_rng()
+        if rng is not None:
+            return _as_dtype(std * rng.standard_normal(tuple(shape)), dtype)
         key = default_generator().next_key()
         return std * jax.random.normal(key, tuple(shape), to_jax_dtype(dtype))
 

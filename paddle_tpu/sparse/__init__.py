@@ -173,8 +173,11 @@ class SparseCsrTensor:
 
     def to_sparse_coo(self, sparse_dim=2):
         idx = jnp.stack([self._row_ids(), self._cols])
+        # cols are not guaranteed sorted within a row (user-built CSR), so
+        # the COO view may not have sorted linear ids: let consumers
+        # coalesce (which sorts) rather than claim it here
         return SparseCooTensor(idx, self._values, self._shape,
-                               coalesced=True)
+                               coalesced=False)
 
     def to_dense(self):
         return self.to_sparse_coo().to_dense()
@@ -237,12 +240,33 @@ def _rewrap(x, coo_out):
 # unary: values-only (sparsity-preserving) ops — reference sparse/unary.py
 # ---------------------------------------------------------------------------
 
+def _first_slot_mask(c):
+    """Bool [nnz]: True on the first slot of each duplicate-coordinate run
+    of a COALESCED tensor (static coalesce keeps duplicate slots with zero
+    values — see coalesce). Value-transforming ops must only touch first
+    slots, since f(0) != 0 ops would otherwise resurrect the zero fillers."""
+    if c.nnz() == 0:
+        return jnp.zeros((0,), bool)
+    ids = c._linear_ids()
+    return jnp.concatenate([jnp.ones((1,), bool), ids[1:] != ids[:-1]])
+
+
+def _apply_values(x, fn):
+    """Coalesce, apply fn to the (summed) values, and keep duplicate filler
+    slots at zero — so duplicate-index inputs behave like their dense
+    equivalent."""
+    c = coalesce(_coo(x))
+    vals = fn(c._values)
+    first = _first_slot_mask(c)
+    shape = (-1,) + (1,) * (vals.ndim - 1)
+    vals = jnp.where(first.reshape(shape), vals, jnp.zeros_like(vals))
+    return _rewrap(x, SparseCooTensor(c._indices, vals, c._shape,
+                                      coalesced=True))
+
+
 def _unary(fn):
     def op(x, name=None):
-        c = _coo(x)
-        out = SparseCooTensor(c._indices, fn(c._values), c._shape,
-                              coalesced=c._coalesced)
-        return _rewrap(x, out)
+        return _apply_values(x, fn)
 
     return op
 
@@ -267,10 +291,7 @@ isnan = _unary(jnp.isnan)
 
 
 def pow(x, factor, name=None):
-    c = _coo(x)
-    return _rewrap(x, SparseCooTensor(c._indices,
-                                      jnp.power(c._values, factor),
-                                      c._shape, coalesced=c._coalesced))
+    return _apply_values(x, lambda v: jnp.power(v, factor))
 
 
 def cast(x, index_dtype=None, value_dtype=None, name=None):
@@ -313,9 +334,24 @@ def coalesce(x, name=None):
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Reduction over the stored values only — never densifies the full
+    tensor (an axis reduction scatter-adds values into the REDUCED dense
+    shape, which is what the caller receives anyway)."""
     c = _coo(x)
-    dense = c.to_dense()._data
-    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if c.dense_dim() != 0:
+        raise NotImplementedError("sum of hybrid sparse tensors")
+    if axis is None:
+        out = jnp.sum(c._values)
+        if keepdim:
+            out = out.reshape((1,) * len(c._shape))
+    else:
+        ax = axis % len(c._shape)
+        keep = [d for d in range(len(c._shape)) if d != ax]
+        red_shape = tuple(c._shape[d] for d in keep)
+        out = jnp.zeros(red_shape, c._values.dtype)
+        out = out.at[tuple(c._indices[d] for d in keep)].add(c._values)
+        if keepdim:
+            out = jnp.expand_dims(out, ax)
     if dtype is not None:
         from ..framework.dtype import to_jax_dtype
 
@@ -353,12 +389,16 @@ def is_same_shape(x, y):
 
 def mask_as(x, mask, name=None):
     """Pick values of dense `x` at `mask`'s sparsity pattern
-    (reference sparse/binary.py mask_as)."""
-    m = _coo(mask)
+    (reference sparse/binary.py mask_as). Duplicate mask slots gather the
+    dense value once (fillers stay zero) so to_dense matches x*pattern."""
+    m = coalesce(_coo(mask))
     xd = _as_jnp(x)
     vals = xd[tuple(m._indices)]
+    first = _first_slot_mask(m)
+    vals = jnp.where(first.reshape((-1,) + (1,) * (vals.ndim - 1)), vals,
+                     jnp.zeros_like(vals))
     return _rewrap(mask, SparseCooTensor(m._indices, vals, m._shape,
-                                         coalesced=m._coalesced))
+                                         coalesced=True))
 
 
 # ---------------------------------------------------------------------------
@@ -378,6 +418,8 @@ def _aligned_binary(a, b, fn):
     idx_s = idx_u[:, order]
 
     def lookup(ids_sorted, vals, q):
+        if vals.shape[0] == 0:   # empty operand contributes only zeros
+            return jnp.zeros(q.shape + vals.shape[1:], vals.dtype)
         pos = jnp.searchsorted(ids_sorted, q)
         pos = jnp.clip(pos, 0, vals.shape[0] - 1)
         hit = ids_sorted[pos] == q

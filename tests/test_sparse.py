@@ -191,3 +191,56 @@ class TestSparseNN:
     def test_conv3d_raises(self):
         with pytest.raises(NotImplementedError):
             sparse.nn.Conv3D(3, 3, 3)
+
+
+class TestEdgeCases:
+    """Regressions: empty operands, unsorted CSR cols, duplicate-index
+    inputs through value-transforming ops."""
+
+    def test_empty_operand_binary(self):
+        a, da = _rand_coo(seed=30)
+        empty = sparse.sparse_coo_tensor(np.zeros((2, 0), np.int64),
+                                         np.zeros((0,), np.float32), (4, 5))
+        np.testing.assert_allclose(
+            np.asarray(sparse.add(a, empty).to_dense()._data), da,
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.add(empty, a).to_dense()._data), da,
+            rtol=1e-6)
+        assert float(np.asarray(sparse.sum(empty)._data)) == 0.0
+
+    def test_unsorted_csr_cols_binary(self):
+        # dense [[2, 0, 1]] with cols stored out of order within the row
+        csr = sparse.sparse_csr_tensor([0, 2], [2, 0], [1.0, 2.0], (1, 3))
+        other = sparse.sparse_csr_tensor([0, 1], [1], [10.0], (1, 3))
+        got = np.asarray(sparse.add(csr, other).to_dense()._data)
+        np.testing.assert_allclose(got, [[2.0, 10.0, 1.0]])
+
+    def test_duplicate_indices_nonlinear_unary(self):
+        sp = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], (2, 3))
+        got = np.asarray(sparse.tanh(sp).to_dense()._data)
+        assert abs(got[0, 1] - np.tanh(3.0)) < 1e-6
+
+    def test_duplicate_indices_mask_as(self):
+        sp = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], (2, 3))
+        x = paddle.to_tensor(np.full((2, 3), 5.0, np.float32))
+        got = np.asarray(sparse.mask_as(x, sp).to_dense()._data)
+        assert got[0, 1] == 5.0
+
+    def test_sum_axis_no_densify(self):
+        sp, dense = _rand_coo((4, 5), 6, seed=31)
+        np.testing.assert_allclose(np.asarray(sparse.sum(sp, axis=0)._data),
+                                   dense.sum(0), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.sum(sp, axis=1, keepdim=True)._data),
+            dense.sum(1, keepdims=True), rtol=1e-6)
+
+
+def test_float64_initializer_precision():
+    """Host-RNG init fast path must not round float64 draws through fp32."""
+    import paddle_tpu.nn.initializer as init
+
+    arr = np.asarray(init.Normal()((64,), dtype="float64"))
+    assert arr.dtype == np.float64
+    # float64 draws are float32-representable only with prob ~0
+    assert np.any(arr != arr.astype(np.float32).astype(np.float64))
