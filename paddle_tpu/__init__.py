@@ -69,6 +69,7 @@ from . import profiler  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import models  # noqa: E402,F401
 from . import vision  # noqa: E402,F401

@@ -285,7 +285,92 @@ def square_error_cost(input, label):
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss lands with the audio op pack")
+    """CTC loss (reference nn/functional/loss.py ctc_loss over the warpctc
+    kernel). TPU-first: the standard log-semiring forward algorithm as a
+    `lax.scan` over time — static shapes, jit/grad-friendly; per-sample
+    lengths are handled by freezing alpha past input_lengths and gathering
+    the final states at 2*label_lengths.
+
+    log_probs: [max_T, batch, num_classes] logits (log_softmax is applied
+    internally, matching warpctc's built-in softmax); labels: [batch,
+    max_label_len] int; reduction "mean" divides each loss by its
+    label_length then averages (reference semantics).
+    """
+    from jax import lax
+
+    if norm_by_times:
+        raise NotImplementedError("ctc_loss norm_by_times")
+    log_probs = ensure_tensor(log_probs)
+    labels = ensure_tensor(labels)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def f(lp, lbl, ilen, llen):
+        T, B, C = lp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        lbl = lbl.astype(jnp.int32)
+        ilen = ilen.astype(jnp.int32)
+        llen = llen.astype(jnp.int32)
+        neg_inf = jnp.float32(-1e30)
+
+        # extended sequence z = [blank, l1, blank, l2, ..., blank]: [B, S]
+        z = jnp.full((B, S), blank, jnp.int32)
+        z = z.at[:, 1::2].set(lbl)
+        s_idx = jnp.arange(S)
+        in_seq = s_idx[None, :] < (2 * llen[:, None] + 1)
+        # skip transition allowed into odd (label) states whose label
+        # differs from the one two back
+        z_m2 = jnp.concatenate([jnp.full((B, 2), blank, jnp.int32),
+                                z[:, :-2]], axis=1)
+        allow_skip = (s_idx[None, :] >= 2) & (z != blank) & (z != z_m2)
+
+        def emit(lp_t):
+            # lp_t: [B, C] -> [B, S] log-prob of each extended state's symbol
+            return jnp.take_along_axis(lp_t, z, axis=1)
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        if L > 0:
+            first_lbl = jnp.take_along_axis(lp[0], z[:, 1:2], axis=1)[:, 0]
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(llen > 0, first_lbl, neg_inf))
+
+        def step(alpha, inp):
+            lp_t, t = inp
+            a0 = alpha
+            a1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(allow_skip, a2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(a0, a1), a2)
+            new = merged + emit(lp_t)
+            new = jnp.where(in_seq, new, neg_inf)
+            # freeze finished sequences (t >= their input length)
+            active = (t < ilen)[:, None]
+            new = jnp.where(active, new, alpha)
+            return new, None
+
+        alpha, _ = lax.scan(step, alpha0,
+                            (lp[1:], jnp.arange(1, T)))
+        # final: logaddexp(alpha[2*llen], alpha[2*llen - 1])
+        e0 = 2 * llen
+        e1 = jnp.maximum(e0 - 1, 0)
+        a_end0 = jnp.take_along_axis(alpha, e0[:, None], axis=1)[:, 0]
+        a_end1 = jnp.take_along_axis(alpha, e1[:, None], axis=1)[:, 0]
+        a_end1 = jnp.where(llen > 0, a_end1, neg_inf)
+        loss = -jnp.logaddexp(a_end0, a_end1)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(llen.astype(jnp.float32),
+                                               1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return nary(f, [log_probs, labels, input_lengths, label_lengths],
+                "ctc_loss")
 
 
 # ---------------------------------------------------------------------------

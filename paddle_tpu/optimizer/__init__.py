@@ -19,7 +19,7 @@ class SGD(Optimizer):
                          multi_precision, name)
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         self._write_param(p, self._param_value(p) - lr_v * g)
 
 
@@ -33,7 +33,7 @@ class Momentum(Optimizer):
         self._nesterov = use_nesterov
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         v = self._get_accumulator("velocity", p)
         v_new = self._momentum * v + g
         self._set_accumulator("velocity", p, v_new)
@@ -97,7 +97,7 @@ class Adam(Optimizer):
         return out, m_new, v_new, vmax_new
 
     def _adam_update(self, p, g, decoupled_wd=0.0):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         md = self._moment_dtype
         m = self._get_accumulator("moment1", p, dtype=md)
         v = self._get_accumulator("moment2", p, dtype=md)
@@ -123,7 +123,9 @@ class Adam(Optimizer):
         return 0.0
 
     def _l2_coeff(self, p):
-        wd = self._weight_decay
+        wd = getattr(p, "_group_weight_decay", None)
+        if wd is None:
+            wd = self._weight_decay
         if wd is None:
             return 0.0
         coeff = wd if isinstance(wd, float) else getattr(wd, "_coeff", 0.0)
@@ -146,7 +148,7 @@ class Adam(Optimizer):
         if self._fused_fn is None:
             self._fused_fn = self._build_fused_fn()
         keys, pvs, gs, ms, vs, vmaxs = [], {}, {}, {}, {}, {}
-        wds, l2s = {}, {}
+        wds, l2s, lrs = {}, {}, {}
         md = self._moment_dtype
         for p, g in params_grads:
             k = p.name or str(id(p))
@@ -160,10 +162,11 @@ class Adam(Optimizer):
                 vmaxs[k] = self._get_accumulator("moment2_max", p, dtype=md)
             wds[k] = jnp.float32(self._decoupled_wd(p))
             l2s[k] = jnp.float32(self._l2_coeff(p))
+            lrs[k] = jnp.float32(self._param_lr_scale(p))
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         t = jnp.asarray(self._step_count, jnp.float32)
         new_p, new_m, new_v, new_vmax = self._fused_fn(
-            pvs, gs, ms, vs, vmaxs, wds, l2s, lr, t)
+            pvs, gs, ms, vs, vmaxs, wds, l2s, lrs, lr, t)
         for k, p in keys:
             self._accumulators["moment1"][k] = new_m[k]
             self._accumulators["moment2"][k] = new_v[k]
@@ -177,13 +180,13 @@ class Adam(Optimizer):
 
         amsgrad = self._amsgrad
 
-        def f(pvs, gs, ms, vs, vmaxs, wds, l2s, lr, t):
+        def f(pvs, gs, ms, vs, vmaxs, wds, l2s, lrs, lr, t):
             new_p, new_m, new_v, new_vmax = {}, {}, {}, {}
             for k in pvs:
                 g = gs[k] + l2s[k] * pvs[k].astype(jnp.float32)
                 out, m_n, v_n, vmax_n = self._adam_math(
                     pvs[k], g, ms[k], vs[k],
-                    vmaxs[k] if amsgrad else None, lr, t, wds[k])
+                    vmaxs[k] if amsgrad else None, lr * lrs[k], t, wds[k])
                 new_p[k] = out.astype(pvs[k].dtype)
                 new_m[k] = m_n.astype(ms[k].dtype)
                 new_v[k] = v_n.astype(vs[k].dtype)
@@ -217,7 +220,17 @@ class AdamW(Adam):
         if (self._apply_decay_param_fun is not None
                 and not self._apply_decay_param_fun(p.name)):
             return 0.0
-        return self._wd_coeff
+        gwd = getattr(p, "_group_weight_decay", None)
+        return self._wd_coeff if gwd is None else gwd
+
+    # AdamW's decay is decoupled (applied in the update rule) — it must
+    # never ALSO be L2-folded into the gradient, including param-group
+    # weight_decay overrides (which _decoupled_wd above consumes)
+    def _l2_coeff(self, p):
+        return 0.0
+
+    def _apply_decay(self, param, grad_data):
+        return grad_data
 
 
 class Adamax(Optimizer):
@@ -227,7 +240,7 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         m = self._get_accumulator("moment", p)
         u = self._get_accumulator("inf_norm", p)
         t = jnp.asarray(self._step_count, jnp.float32)
@@ -249,7 +262,7 @@ class Adadelta(Optimizer):
         self._rho, self._epsilon = rho, epsilon
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         avg_sq = self._get_accumulator("avg_squared_grad", p)
         avg_up = self._get_accumulator("avg_squared_update", p)
         avg_sq_new = self._rho * avg_sq + (1 - self._rho) * g * g
@@ -269,7 +282,7 @@ class Adagrad(Optimizer):
         self._initial = initial_accumulator_value
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         acc = self._get_accumulator(
             "moment", p, init=jnp.full(p._data.shape, self._initial, jnp.float32)
         )
@@ -289,7 +302,7 @@ class RMSProp(Optimizer):
         self._momentum, self._centered = momentum, centered
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         ms = self._get_accumulator("mean_square", p)
         mom = self._get_accumulator("momentum", p)
         ms_new = self._rho * ms + (1 - self._rho) * g * g
@@ -314,7 +327,7 @@ class ASGD(Optimizer):
         self._batch_num = batch_num
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         d = self._get_accumulator("d", p)
         ys = self._get_accumulator("ys", p)
         y = g  # current grad replaces the oldest in the window (window=1 simplification)
@@ -335,7 +348,7 @@ class Lamb(Optimizer):
         self._exclude_fn = exclude_from_weight_decay_fn
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
         t = jnp.asarray(self._step_count, jnp.float32)
@@ -377,7 +390,7 @@ class NAdam(Optimizer):
         self._accumulators.setdefault("nadam_mu_product", {})["_global"] = value
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         t = jnp.asarray(self._step_count, jnp.float32)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
@@ -409,7 +422,7 @@ class RAdam(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _append_optimize_op(self, p, g):
-        lr_v = self.get_lr()
+        lr_v = self._cur_lr()
         t = jnp.asarray(self._step_count, jnp.float32)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)

@@ -19,7 +19,37 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
         self._learning_rate = learning_rate
-        self._parameter_list = list(parameters) if parameters is not None else None
+        # param groups (reference optimizer.py:140: list of dicts whose
+        # 'learning_rate' is a SCALE of the base lr and whose
+        # 'weight_decay' overrides the optimizer default for that group) —
+        # flattened here; per-param attrs carry the overrides
+        self._lr_scale = 1.0
+        if parameters is not None:
+            flat = []
+            for entry in parameters:
+                if isinstance(entry, dict):
+                    group_params = list(entry["params"])
+                    for p in group_params:
+                        if "learning_rate" in entry:
+                            # only override when the group sets it — a
+                            # ParamAttr(learning_rate=...) scale must survive
+                            # membership in a plain group
+                            attr = dict(getattr(p, "optimize_attr", None)
+                                        or {})
+                            attr["learning_rate"] = float(
+                                entry["learning_rate"])
+                            p.optimize_attr = attr
+                        if "weight_decay" in entry:
+                            wd = entry["weight_decay"]
+                            p._group_weight_decay = (
+                                float(wd) if isinstance(wd, (int, float))
+                                else getattr(wd, "_coeff", 0.0))
+                    flat.extend(group_params)
+                else:
+                    flat.append(entry)
+            self._parameter_list = flat
+        else:
+            self._parameter_list = None
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         if isinstance(weight_decay, (int, float)):
@@ -87,17 +117,26 @@ class Optimizer:
             )
         pgs = []
         for p in self._parameter_list:
-            if isinstance(p, dict):
-                raise NotImplementedError("param groups not yet supported")
             if p.stop_gradient or p.grad is None:
                 continue
             pgs.append((p, p.grad))
         return pgs
 
+    def _param_lr_scale(self, p):
+        return (getattr(p, "optimize_attr", None) or {}).get(
+            "learning_rate", 1.0)
+
+    def _cur_lr(self):
+        """Base lr times the current param's group scale (set by step())."""
+        lr = self.get_lr()
+        return lr * self._lr_scale if self._lr_scale != 1.0 else lr
+
     def _apply_decay(self, param, grad_data):
         """L2 regularization folded into the gradient (reference: the
         regularizer path in optimizer.py; AdamW overrides with decoupled decay)."""
-        wd = self._weight_decay
+        wd = getattr(param, "_group_weight_decay", None)
+        if wd is None:
+            wd = self._weight_decay
         if wd is None:
             return grad_data
         coeff = wd if isinstance(wd, float) else getattr(wd, "_coeff", 0.0)
@@ -118,7 +157,11 @@ class Optimizer:
             if self._use_master(p):
                 g_data = g_data.astype(jnp.float32)
             g_data = self._apply_decay(p, g_data)
-            self._append_optimize_op(p, g_data)
+            self._lr_scale = self._param_lr_scale(p)
+            try:
+                self._append_optimize_op(p, g_data)
+            finally:
+                self._lr_scale = 1.0
 
     def _maybe_fused_step(self, params_grads):
         """Subclass hook: apply ALL param updates as one jitted program (the

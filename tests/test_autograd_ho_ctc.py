@@ -1,0 +1,178 @@
+"""Higher-order autograd (jacobian/hessian), optimizer param groups, and
+CTC loss — parity against analytic results and torch's CPU CTC oracle."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as popt
+from paddle_tpu import autograd
+
+
+class TestJacobianHessian:
+    def test_jacobian_analytic(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0]), dtype="float32")
+
+        def f(t):
+            return (t * t).sum()
+
+        j = autograd.jacobian(f, x)
+        np.testing.assert_allclose(np.asarray(j._data), [2.0, 4.0, 6.0],
+                                   rtol=1e-5)
+
+    def test_jacobian_vector_output(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0]), dtype="float32")
+
+        def f(t):
+            return t * t * t
+
+        j = autograd.jacobian(f, x)  # diag(3x^2)
+        np.testing.assert_allclose(np.asarray(j._data),
+                                   np.diag([3.0, 12.0]), rtol=1e-5)
+
+    def test_jacobian_batched(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+
+        def f(t):
+            return (t * t).sum()
+
+        j = autograd.jacobian(f, x, batch_axis=0)
+        np.testing.assert_allclose(np.asarray(j._data),
+                                   2 * np.asarray(x._data), rtol=1e-5)
+
+    def test_hessian_quadratic(self):
+        A = np.array([[2.0, 1.0], [1.0, 3.0]], np.float32)
+        x = paddle.to_tensor(np.array([0.5, -1.0]), dtype="float32")
+        At = paddle.to_tensor(A)
+
+        def f(t):
+            return 0.5 * (t.unsqueeze(0) @ At @ t.unsqueeze(1)).sum()
+
+        h = autograd.hessian(f, x)
+        np.testing.assert_allclose(np.asarray(h._data), A, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_jacobian_through_layers(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(3, 2)
+        x = paddle.to_tensor(np.array([1.0, -1.0, 0.5]), dtype="float32")
+        j = autograd.jacobian(lambda t: lin(t.unsqueeze(0)).sum(), x)
+        want = np.asarray(lin.weight._data).sum(axis=1)
+        np.testing.assert_allclose(np.asarray(j._data), want, rtol=1e-5)
+
+
+class TestParamGroups:
+    def test_group_lr_scale_and_wd(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(5)
+        m = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+        frozen_like = m[0].parameters()
+        fast = m[1].parameters()
+        opt = popt.AdamW(
+            learning_rate=0.1,
+            parameters=[
+                {"params": frozen_like, "learning_rate": 0.0},
+                {"params": fast, "learning_rate": 1.0, "weight_decay": 0.0},
+            ],
+            weight_decay=0.5)
+        before0 = np.asarray(frozen_like[0]._data).copy()
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((2, 4)), dtype="float32")
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        # lr scale 0 -> group-0 params unchanged
+        np.testing.assert_allclose(np.asarray(frozen_like[0]._data),
+                                   before0)
+        # group 1 moved
+        assert not np.allclose(np.asarray(fast[0]._data),
+                               np.asarray(fast[0]._data) * 0 + before0[0, 0])
+
+    def test_adamw_group_wd_is_decoupled_only(self):
+        """Group weight_decay on AdamW must apply ONCE (decoupled), never
+        additionally as L2 folded into the gradient."""
+        p = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+        p.stop_gradient = False
+        p.name = "pw"
+        opt = popt.AdamW(learning_rate=0.1,
+                         parameters=[{"params": [p], "weight_decay": 0.1}],
+                         weight_decay=0.0)
+        p.grad = paddle.to_tensor(np.zeros((4,), np.float32))
+        opt.step()
+        # zero grad -> pure decoupled update: p * (1 - lr*wd)
+        np.testing.assert_allclose(np.asarray(p._data), 2.0 * (1 - 0.01),
+                                   rtol=1e-5)
+
+    def test_group_parity_fused_vs_perparam(self):
+        import paddle_tpu.nn as nn
+
+        def build():
+            paddle.seed(9)
+            m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+            groups = [
+                {"params": m[0].parameters(), "learning_rate": 0.5},
+                {"params": m[2].parameters(), "weight_decay": 0.0},
+            ]
+            return m, groups
+
+        x = paddle.to_tensor(np.random.default_rng(1)
+                             .standard_normal((4, 4)), dtype="float32")
+        y = paddle.to_tensor(np.random.default_rng(2)
+                             .standard_normal((4, 2)), dtype="float32")
+
+        results = []
+        for fused in (False, None):
+            m, groups = build()
+            o = popt.AdamW(learning_rate=0.05, parameters=groups,
+                           weight_decay=0.3, use_multi_tensor=fused)
+            for _ in range(3):
+                loss = ((m(x) - y) ** 2).mean()
+                loss.backward()
+                o.step()
+                o.clear_grad()
+            results.append([np.asarray(p._data) for p in m.parameters()])
+        for a, b in zip(*results):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+class TestCTCLoss:
+    def _torch_ctc(self, logits, labels, ilen, llen, blank, reduction):
+        import torch
+
+        lp = torch.log_softmax(torch.tensor(logits), dim=-1)
+        return torch.nn.functional.ctc_loss(
+            lp, torch.tensor(labels), torch.tensor(ilen),
+            torch.tensor(llen), blank=blank, reduction=reduction,
+            zero_infinity=False).numpy()
+
+    @pytest.mark.parametrize("reduction", ["none", "mean", "sum"])
+    def test_matches_torch(self, reduction):
+        rng = np.random.default_rng(0)
+        T, B, C, L = 12, 3, 6, 4
+        logits = rng.standard_normal((T, B, C)).astype(np.float32)
+        labels = rng.integers(1, C, (B, L)).astype(np.int32)
+        ilen = np.array([12, 10, 8], np.int32)
+        llen = np.array([4, 3, 2], np.int32)
+        got = np.asarray(F.ctc_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(ilen), paddle.to_tensor(llen),
+            reduction=reduction)._data)
+        # torch 'mean' divides by target lengths then averages — same rule
+        want = self._torch_ctc(logits, labels, ilen, llen, 0, reduction)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_grad_flows(self):
+        rng = np.random.default_rng(1)
+        logits = paddle.to_tensor(
+            rng.standard_normal((8, 2, 5)).astype(np.float32))
+        logits.stop_gradient = False
+        labels = paddle.to_tensor(rng.integers(1, 5, (2, 3)), dtype="int32")
+        loss = F.ctc_loss(logits, labels,
+                          paddle.to_tensor(np.array([8, 8], np.int32)),
+                          paddle.to_tensor(np.array([3, 2], np.int32)))
+        loss.backward()
+        g = np.asarray(logits.grad._data)
+        assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0

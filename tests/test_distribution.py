@@ -1,0 +1,227 @@
+"""paddle.distribution parity: log_prob/entropy against scipy.stats (the
+same oracle the reference's test_distribution_* suites use), analytic KL
+identities, sampling moments, and autograd through rsample/log_prob."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestLogProbVsScipy:
+    def test_normal(self):
+        d = D.Normal(1.0, 2.0)
+        v = np.linspace(-3, 5, 9)
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(v))),
+                                   st.norm.logpdf(v, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(d.entropy())),
+                                   st.norm.entropy(1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.cdf(paddle.to_tensor(v))),
+                                   st.norm.cdf(v, 1.0, 2.0), rtol=1e-5)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.5, 0.8)
+        v = np.linspace(0.1, 4, 7)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.lognorm.logpdf(v, 0.8, scale=np.exp(0.5)), rtol=1e-5)
+
+    def test_uniform(self):
+        d = D.Uniform(-1.0, 3.0)
+        v = np.array([-0.5, 0.0, 2.9])
+        np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(v))),
+                                   st.uniform.logpdf(v, -1, 4), rtol=1e-5)
+
+    def test_exponential_gamma_beta(self):
+        v = np.array([0.2, 1.0, 2.5])
+        np.testing.assert_allclose(
+            _np(D.Exponential(1.5).log_prob(paddle.to_tensor(v))),
+            st.expon.logpdf(v, scale=1 / 1.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(D.Gamma(2.0, 3.0).log_prob(paddle.to_tensor(v))),
+            st.gamma.logpdf(v, 2.0, scale=1 / 3.0), rtol=1e-5)
+        b = np.array([0.1, 0.5, 0.9])
+        np.testing.assert_allclose(
+            _np(D.Beta(2.0, 3.0).log_prob(paddle.to_tensor(b))),
+            st.beta.logpdf(b, 2.0, 3.0), rtol=5e-5)
+
+    def test_laplace_gumbel_cauchy_student(self):
+        v = np.array([-1.0, 0.3, 2.0])
+        np.testing.assert_allclose(
+            _np(D.Laplace(0.5, 1.2).log_prob(paddle.to_tensor(v))),
+            st.laplace.logpdf(v, 0.5, 1.2), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(D.Gumbel(0.5, 1.2).log_prob(paddle.to_tensor(v))),
+            st.gumbel_r.logpdf(v, 0.5, 1.2), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(D.Cauchy(0.5, 1.2).log_prob(paddle.to_tensor(v))),
+            st.cauchy.logpdf(v, 0.5, 1.2), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(D.StudentT(4.0, 0.5, 1.2).log_prob(paddle.to_tensor(v))),
+            st.t.logpdf(v, 4.0, 0.5, 1.2), rtol=1e-4)
+
+    @pytest.mark.parametrize("rate", [0.1, 2.5, 10.0, 40.0])
+    def test_poisson_entropy(self, rate):
+        np.testing.assert_allclose(
+            float(_np(D.Poisson(rate).entropy())),
+            st.poisson.entropy(rate), atol=2e-3)
+
+    def test_discrete(self):
+        k = np.array([0.0, 1.0, 3.0])
+        np.testing.assert_allclose(
+            _np(D.Poisson(2.5).log_prob(paddle.to_tensor(k))),
+            st.poisson.logpmf(k, 2.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(D.Geometric(0.3).log_prob(paddle.to_tensor(k))),
+            st.geom.logpmf(k + 1, 0.3), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(D.Binomial(10.0, 0.4).log_prob(paddle.to_tensor(k))),
+            st.binom.logpmf(k, 10, 0.4), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(_np(D.Bernoulli(0.3).log_prob(paddle.to_tensor(1.0)))),
+            np.log(0.3), rtol=1e-5)
+
+    def test_dirichlet_mvn(self):
+        c = np.array([1.5, 2.0, 3.0])
+        v = np.array([0.2, 0.3, 0.5])
+        np.testing.assert_allclose(
+            float(_np(D.Dirichlet(c).log_prob(paddle.to_tensor(v)))),
+            st.dirichlet.logpdf(v, c), rtol=1e-5)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]])
+        mu = np.array([1.0, -1.0])
+        x = np.array([0.3, 0.7])
+        mvn = D.MultivariateNormal(mu, covariance_matrix=cov)
+        np.testing.assert_allclose(
+            float(_np(mvn.log_prob(paddle.to_tensor(x)))),
+            st.multivariate_normal.logpdf(x, mu, cov), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(mvn.entropy())),
+                                   st.multivariate_normal.entropy(mu, cov),
+                                   rtol=1e-5)
+
+    def test_categorical_multinomial(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5]))
+        d = D.Categorical(logits)
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(paddle.to_tensor(2)))), np.log(0.5),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            float(_np(d.entropy())),
+            st.multinomial.entropy(1, [0.2, 0.3, 0.5]), rtol=1e-4)
+        m = D.Multinomial(5, np.array([0.2, 0.3, 0.5]))
+        cnt = np.array([1.0, 2.0, 2.0])
+        np.testing.assert_allclose(
+            float(_np(m.log_prob(paddle.to_tensor(cnt)))),
+            st.multinomial.logpmf(cnt, 5, [0.2, 0.3, 0.5]), rtol=1e-5)
+
+
+class TestKL:
+    def test_normal_normal_analytic(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        got = float(_np(D.kl_divergence(p, q)))
+        want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_kl_nonnegative_and_zero_on_self(self):
+        pairs = [
+            (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)),
+            (D.Beta(2.0, 2.0), D.Beta(1.0, 3.0)),
+            (D.Bernoulli(0.3), D.Bernoulli(0.6)),
+            (D.Poisson(2.0), D.Poisson(4.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),
+            (D.Categorical(np.log([0.3, 0.7])),
+             D.Categorical(np.log([0.6, 0.4]))),
+        ]
+        for p, q in pairs:
+            assert float(_np(D.kl_divergence(p, q))) > 0
+            assert abs(float(_np(D.kl_divergence(p, p)))) < 1e-6
+
+    def test_kl_mvn(self):
+        mu = np.zeros(2)
+        p = D.MultivariateNormal(mu, covariance_matrix=np.eye(2))
+        q = D.MultivariateNormal(np.ones(2),
+                                 covariance_matrix=2 * np.eye(2))
+        got = float(_np(D.kl_divergence(p, q)))
+        # analytic: 0.5*(tr(S2^-1 S1) + maha - d + logdet ratio)
+        want = 0.5 * (1.0 + 1.0 / 2 * 2 - 2 + np.log(4.0))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))
+
+
+class TestSampling:
+    def test_moments(self):
+        paddle.seed(0)
+        for d, mean, var in [
+            (D.Normal(1.0, 2.0), 1.0, 4.0),
+            (D.Exponential(2.0), 0.5, 0.25),
+            (D.Gamma(3.0, 2.0), 1.5, 0.75),
+            (D.Uniform(0.0, 2.0), 1.0, 1 / 3),
+        ]:
+            s = _np(d.sample((20000,)))
+            np.testing.assert_allclose(s.mean(), mean, atol=0.08)
+            np.testing.assert_allclose(s.var(), var, atol=0.12)
+
+    def test_discrete_sampling(self):
+        paddle.seed(1)
+        s = _np(D.Bernoulli(0.3).sample((5000,)))
+        assert abs(s.mean() - 0.3) < 0.03
+        c = _np(D.Categorical(np.log([0.2, 0.3, 0.5])).sample((5000,)))
+        assert abs((c == 2).mean() - 0.5) < 0.04
+        m = _np(D.Multinomial(10, np.array([0.5, 0.5])).sample())
+        assert m.sum() == 10
+
+    def test_rsample_grad_flows(self):
+        loc = paddle.to_tensor(0.5)
+        loc.stop_gradient = False
+        scale = paddle.to_tensor(1.5)
+        scale.stop_gradient = False
+        d = D.Normal(loc, scale)
+        paddle.seed(3)
+        s = d.rsample((64,))
+        (s.mean() + (s * s).mean()).backward()
+        assert loc.grad is not None and scale.grad is not None
+        assert np.isfinite(float(_np(loc.grad)))
+
+    def test_log_prob_grad_flows(self):
+        rate = paddle.to_tensor(2.0)
+        rate.stop_gradient = False
+        d = D.Exponential(rate)
+        lp = d.log_prob(paddle.to_tensor(np.array([0.5, 1.0])))
+        lp.sum().backward()
+        # d/dr [log r - r v] summed = 2/r - 1.5
+        np.testing.assert_allclose(float(_np(rate.grad)), 2 / 2.0 - 1.5,
+                                   rtol=1e-4)
+
+
+class TestComposition:
+    def test_transformed_matches_lognormal(self):
+        base = D.Normal(0.3, 0.7)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(0.3, 0.7)
+        v = paddle.to_tensor(np.array([0.5, 1.0, 2.0]))
+        np.testing.assert_allclose(_np(td.log_prob(v)), _np(ln.log_prob(v)),
+                                   rtol=1e-5)
+
+    def test_affine_transform(self):
+        base = D.Normal(0.0, 1.0)
+        td = D.TransformedDistribution(
+            base, [D.AffineTransform(1.0, 2.0)])
+        v = paddle.to_tensor(np.array([-1.0, 0.5, 3.0]))
+        np.testing.assert_allclose(_np(td.log_prob(v)),
+                                   st.norm.logpdf(_np(v), 1.0, 2.0),
+                                   rtol=1e-5)
+
+    def test_independent(self):
+        d = D.Independent(D.Normal(np.zeros(4), np.ones(4)), 1)
+        assert d.event_shape == (4,)
+        v = paddle.to_tensor(np.array([0.1, -0.2, 0.3, 0.4]))
+        np.testing.assert_allclose(
+            float(_np(d.log_prob(v))),
+            st.norm.logpdf(_np(v)).sum(), rtol=1e-5)
