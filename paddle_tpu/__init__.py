@@ -28,6 +28,8 @@ from .framework import (  # noqa: F401
     # device
     CPUPlace,
     TPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
     set_device,
     get_device,
     device_count,
@@ -46,6 +48,15 @@ from .framework import (  # noqa: F401
     set_rng_state,
     Generator,
 )
+
+# CUDA-rng compat aliases (single accelerator RNG stream on TPU) + float8
+# storage dtypes (jnp-native)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+import jax.numpy as _jnp_f8  # noqa: E402
+
+float8_e4m3fn = _jnp_f8.float8_e4m3fn
+float8_e5m2 = _jnp_f8.float8_e5m2
 
 from .ops import *  # noqa: F401,F403  — paddle.* tensor ops
 from . import ops  # noqa: F401

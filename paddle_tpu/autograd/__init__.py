@@ -124,7 +124,12 @@ def _ho_wrap(func):
     def f(*arrays):
         wrapped = [Tensor._wrap(a) for a in arrays]
         out = func(*wrapped) if len(wrapped) > 1 else func(wrapped[0])
-        return out._data if isinstance(out, Tensor) else out
+        if isinstance(out, Tensor):
+            return out._data
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in out)
+        return out
 
     return f
 
@@ -147,9 +152,15 @@ def jacobian(func, xs, batch_axis=None):
         jac = jax.vmap(jax.jacrev(f, argnums=argnums))(*datas)
     else:
         raise ValueError("batch_axis must be None or 0")
-    jac = jac if isinstance(jac, tuple) else (jac,)
-    outs = tuple(Tensor._wrap(j) for j in jac)
-    return outs[0] if single else outs
+    outs = jax.tree_util.tree_map(Tensor._wrap, jac)
+    # single xs: unwrap the per-input tuple layer (outputs keep their own
+    # structure — a tuple-valued func yields a tuple of jacobians)
+    if single and isinstance(outs, tuple) and len(outs) == 1:
+        return outs[0]
+    if single and isinstance(outs, tuple):
+        return tuple(o[0] if isinstance(o, tuple) and len(o) == 1 else o
+                     for o in outs)
+    return outs
 
 
 def hessian(func, xs, batch_axis=None):

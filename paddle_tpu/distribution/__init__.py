@@ -36,10 +36,6 @@ def _op(f, *tensors):
     return nary(f, [ensure_tensor(t) for t in tensors], "distribution")
 
 
-def _data(x):
-    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
-
-
 class Distribution:
     """Reference distribution.py Distribution: batch_shape/event_shape,
     sample/log_prob/prob/entropy surface."""
@@ -943,8 +939,11 @@ def _kl_normal(p, q):
 
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
-    return _op(lambda a1, b1, a2, b2: jnp.log((b2 - a2) / (b1 - a1)),
-               p.low, p.high, q.low, q.high)
+    # inf when q's support does not cover p's (otherwise the log-ratio
+    # could go negative as q shrinks)
+    return _op(lambda a1, b1, a2, b2: jnp.where(
+        (a2 > a1) | (b2 < b1), jnp.inf, jnp.log((b2 - a2) / (b1 - a1))),
+        p.low, p.high, q.low, q.high)
 
 
 @register_kl(Bernoulli, Bernoulli)

@@ -36,6 +36,8 @@ from .device import (  # noqa: E402
     Place,
     CPUPlace,
     TPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
     set_device,
     get_device,
     current_place,

@@ -55,6 +55,15 @@ class TPUPlace(Place):
         super().__init__("tpu", device_id)
 
 
+class CUDAPlace(TPUPlace):
+    """Compat shim: reference code constructing CUDAPlace(i) gets the
+    accelerator (TPU) place — device_id semantics carry over."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Compat shim: pinned host memory is plain host memory under PJRT."""
+
+
 # `axon` is the experimental tunnel platform name for the real chip in this
 # environment; treat it as TPU.
 _TPU_PLATFORMS = ("tpu", "axon")

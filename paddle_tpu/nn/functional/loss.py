@@ -300,6 +300,9 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     if norm_by_times:
         raise NotImplementedError("ctc_loss norm_by_times")
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(
+            f"reduction should be 'mean', 'sum' or 'none', got {reduction!r}")
     log_probs = ensure_tensor(log_probs)
     labels = ensure_tensor(labels)
     input_lengths = ensure_tensor(input_lengths)

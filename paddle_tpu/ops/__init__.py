@@ -10,9 +10,45 @@ from .logic import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
 from . import creation, math, logic, reduction, linalg, manipulation  # noqa: E402
+from . import extras  # noqa: E402
 from ..framework.tensor import Tensor
+
+
+def _mk_inplace(fn):
+    """Functional-rebind in-place variant: run the op, rebind the first
+    operand's storage (Tensor._inplace_from keeps autograd identity)."""
+    def inplace(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._inplace_from(out if isinstance(out, Tensor) else out[0])
+        return x
+
+    inplace.__name__ = fn.__name__ + "_"
+    return inplace
+
+
+# the reference's full in-place surface (tensor/*.py `<op>_` variants) is
+# generated from the functional ops
+_INPLACE_BASES = [
+    "addmm", "t", "cumsum", "cummin", "cumprod", "logit", "equal", "tan",
+    "logical_and", "logical_or", "logical_not", "less_than", "less_equal",
+    "greater_than", "greater_equal", "floor_divide", "remainder",
+    "floor_mod", "mod", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "bitwise_left_shift", "bitwise_right_shift", "tril",
+    "triu", "pow", "acos", "expm1", "sinh", "sinc", "lgamma", "gammainc",
+    "gammaincc", "gammaln", "multigammaln", "polygamma", "square", "atan",
+    "gcd", "lcm", "cast", "erf", "transpose", "flatten", "log", "log2",
+    "log10", "trunc", "frac", "digamma", "renorm", "nan_to_num",
+    "index_add", "index_put", "index_fill", "masked_scatter", "i0",
+    "copysign", "hypot", "ldexp",
+]
+for _n in _INPLACE_BASES:
+    _base = globals().get(_n)
+    if _base is not None and (_n + "_") not in globals():
+        globals()[_n + "_"] = _mk_inplace(_base)
+del _n, _base
 
 
 _TENSOR_METHODS = [

@@ -1,0 +1,726 @@
+"""Long-tail op parity pack (reference python/paddle/tensor/math.py,
+manipulation.py, creation.py, search.py — the remaining paddle.* names of
+the reference's top-level __all__ not yet covered by the core op modules).
+
+Every op is a jnp expression through the dispatch layer: jit/grad/shard
+semantics come for free. In-place variants (`*_`) follow the framework's
+functional-rebind convention (`Tensor._inplace_from`).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_builtin_abs = abs
+
+from ..framework.tensor import Tensor
+from ._dispatch import unary, binary, nary, ensure_tensor
+
+
+# ---------------------------------------------------------------------------
+# special functions (reference tensor/math.py over phi special kernels)
+# ---------------------------------------------------------------------------
+
+def gammaln(x, name=None):
+    return unary(lambda v: jax.scipy.special.gammaln(
+        v.astype(jnp.float32) if jnp.issubdtype(v.dtype, jnp.integer) else v),
+        x, "gammaln")
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y)."""
+    return binary(lambda a, v: jax.scipy.special.gammainc(a, v), x, y,
+                  "gammainc")
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return binary(lambda a, v: jax.scipy.special.gammaincc(a, v), x, y,
+                  "gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    return unary(lambda v: jax.scipy.special.multigammaln(v, int(p)), x,
+                 "multigammaln")
+
+
+def polygamma(x, n, name=None):
+    return unary(lambda v: jax.scipy.special.polygamma(int(n), v), x,
+                 "polygamma")
+
+
+def i0(x, name=None):
+    return unary(lambda v: jax.scipy.special.i0(v), x, "i0")
+
+
+def i0e(x, name=None):
+    return unary(lambda v: jax.scipy.special.i0e(v), x, "i0e")
+
+
+def i1(x, name=None):
+    return unary(lambda v: jax.scipy.special.i1(v), x, "i1")
+
+
+def i1e(x, name=None):
+    return unary(lambda v: jax.scipy.special.i1e(v), x, "i1e")
+
+
+def sinc(x, name=None):
+    return unary(jnp.sinc, x, "sinc")
+
+
+def sgn(x, name=None):
+    """Sign for real; unit phasor (x/|x|, 0 at 0) for complex."""
+    def f(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+
+    return unary(f, x, "sgn")
+
+
+def signbit(x, name=None):
+    return unary(jnp.signbit, x, "signbit")
+
+
+def isneginf(x, name=None):
+    return unary(jnp.isneginf, x, "isneginf")
+
+
+def isposinf(x, name=None):
+    return unary(jnp.isposinf, x, "isposinf")
+
+
+def isreal(x, name=None):
+    return unary(jnp.isreal, x, "isreal")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return binary(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x,
+                  "isin")
+
+
+def polar(abs, angle, name=None):
+    return binary(lambda r, th: (r * jnp.cos(th)).astype(jnp.float32)
+                  + 1j * (r * jnp.sin(th)).astype(jnp.float32),
+                  abs, angle, "polar")
+
+
+def complex(real, imag, name=None):
+    return binary(lambda r, i: jax.lax.complex(r, i), real, imag, "complex")
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    from ..framework.random import next_key
+    from ..framework.dtype import to_jax_dtype
+
+    key = next_key()
+    dt = to_jax_dtype(dtype or "float32")
+    out = jnp.exp(mean + std * jax.random.normal(key, tuple(shape or ())))
+    return Tensor._wrap(out.astype(dt))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    from ..framework.random import next_key
+    from ..framework.dtype import to_jax_dtype
+
+    return Tensor._wrap(jax.random.normal(
+        next_key(), tuple(shape), to_jax_dtype(dtype or "float32")))
+
+
+def binomial(count, prob, name=None):
+    from ..framework.random import next_key
+
+    return nary(lambda n, p: jax.random.binomial(
+        next_key(), n, p).astype(jnp.int64),
+        [ensure_tensor(count), ensure_tensor(prob)], "binomial")
+
+
+def standard_gamma(x, name=None):
+    from ..framework.random import next_key
+
+    return unary(lambda a: jax.random.gamma(next_key(), a), x,
+                 "standard_gamma")
+
+
+# ---------------------------------------------------------------------------
+# manipulation (reference tensor/manipulation.py)
+# ---------------------------------------------------------------------------
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    arrs = jnp.array_split(x._data, num_or_indices
+                           if isinstance(num_or_indices, int)
+                           else list(num_or_indices), axis=axis)
+    # route each piece through a slice op so autograd sees them
+    outs = []
+    offs = 0
+    for a in arrs:
+        size = a.shape[axis]
+        lo = offs
+        outs.append(unary(
+            lambda v, lo=lo, size=size: jax.lax.slice_in_dim(
+                v, lo, lo + size, axis=axis), x, "tensor_split"))
+        offs += size
+    return outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = ensure_tensor(x)
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def column_stack(x, name=None):
+    return nary(lambda *vs: jnp.column_stack(vs),
+                [ensure_tensor(v) for v in x], "column_stack")
+
+
+def row_stack(x, name=None):
+    return nary(lambda *vs: jnp.vstack(vs), [ensure_tensor(v) for v in x],
+                "row_stack")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [unary(jnp.atleast_1d, ensure_tensor(v), "atleast_1d")
+            for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [unary(jnp.atleast_2d, ensure_tensor(v), "atleast_2d")
+            for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [unary(jnp.atleast_3d, ensure_tensor(v), "atleast_3d")
+            for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def block_diag(inputs, name=None):
+    return nary(lambda *vs: jax.scipy.linalg.block_diag(*vs),
+                [ensure_tensor(v) for v in inputs], "block_diag")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(v):
+        n = v.shape[-1] + _builtin_abs(int(offset))
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-int(offset), 0)
+        c = idx + max(int(offset), 0)
+        out = out.at[..., r, c].set(v)
+        # move the two new axes to dim1/dim2
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    return unary(f, input, "diag_embed")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary(lambda v: jnp.diagonal(v, offset=int(offset),
+                                        axis1=int(axis1), axis2=int(axis2)),
+                 x, "diagonal")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        vals = jnp.sort(v, axis=axis)
+        idxs = jnp.argsort(v, axis=axis)
+        got = jnp.take(vals, int(k) - 1, axis=axis)
+        gi = jnp.take(idxs, int(k) - 1, axis=axis)
+        if keepdim:
+            got = jnp.expand_dims(got, axis)
+            gi = jnp.expand_dims(gi, axis)
+        return got, gi.astype(jnp.int64)
+
+    x = ensure_tensor(x)
+    vals = unary(lambda v: f(v)[0], x, "kthvalue")
+    idxs = unary(lambda v: f(v)[1], x, "kthvalue_idx")
+    idxs.stop_gradient = True
+    return vals, idxs
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def _mode_vals(v):
+        # sort, then run-length count of equal neighbors; ties between
+        # equally-frequent values resolve to the SMALLEST (reference
+        # test_mode_op.py _mode1D: strictly-greater frequency updates)
+        vm = jnp.moveaxis(jnp.sort(v, axis=axis), axis % v.ndim, -1)
+        eq = jnp.concatenate([jnp.zeros(vm.shape[:-1] + (1,), bool),
+                              vm[..., 1:] == vm[..., :-1]], -1)
+
+        def body(c, e):
+            c = jnp.where(e, c + 1, 0)
+            return c, c
+
+        _, runs = jax.lax.scan(body, jnp.zeros(vm.shape[:-1], jnp.int32),
+                               jnp.moveaxis(eq, -1, 0))
+        runs = jnp.moveaxis(runs, 0, -1)
+        best = jnp.argmax(runs, -1)
+        return jnp.take_along_axis(vm, best[..., None], -1)[..., 0]
+
+    def fv(v):
+        md = _mode_vals(v)
+        return jnp.expand_dims(md, axis) if keepdim else md
+
+    def fi(v):
+        # reference semantics: the ORIGINAL index of the mode's LAST
+        # occurrence (stable-sorted run end)
+        md = _mode_vals(v)
+        n = v.shape[axis % v.ndim]
+        eq = jnp.flip(v == jnp.expand_dims(md, axis), axis=axis)
+        idx = (n - 1) - jnp.argmax(eq, axis=axis)
+        idx = idx.astype(jnp.int64)
+        return jnp.expand_dims(idx, axis) if keepdim else idx
+
+    vals = unary(fv, x, "mode")
+    idxs = unary(fi, x, "mode_idx")
+    idxs.stop_gradient = True
+    return vals, idxs
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = axis if axis is not None else None
+    if ax is None:
+        flat = unary(lambda v: jnp.minimum.accumulate(v.reshape(-1)), x,
+                     "cummin")
+        vals = flat
+        idx_f = unary(lambda v: _cummin_idx(v.reshape(-1)), x, "cummin_idx")
+    else:
+        vals = unary(lambda v: jnp.minimum.accumulate(v, axis=ax), x,
+                     "cummin")
+        idx_f = unary(lambda v: _cummin_idx(v, ax), x, "cummin_idx")
+    idx_f.stop_gradient = True
+    return vals, idx_f
+
+
+def _cummin_idx(v, axis=0):
+    vm = jnp.moveaxis(v, axis, 0)
+
+    def body(carry, x):
+        best, bidx, i = carry
+        take = x < best
+        best = jnp.where(take, x, best)
+        bidx = jnp.where(take, i, bidx)
+        return (best, bidx, i + 1), bidx
+
+    init = (vm[0], jnp.zeros(vm.shape[1:], jnp.int64), jnp.int64(1))
+    _, idxs = jax.lax.scan(body, init, vm[1:])
+    idxs = jnp.concatenate(
+        [jnp.zeros((1,) + vm.shape[1:], jnp.int64), idxs], 0)
+    return jnp.moveaxis(idxs, 0, axis)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(v, val):
+        idx = [slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(int(s), int(e), int(st))
+        return v.at[tuple(idx)].set(val)
+
+    return binary(f, ensure_tensor(x), ensure_tensor(value), "slice_scatter")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(v, val):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = int(index)
+        return v.at[tuple(idx)].set(val)
+
+    return binary(f, ensure_tensor(x), ensure_tensor(values),
+                  "select_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(v, val):
+        n = min(v.shape[axis1], v.shape[axis2])
+        idx = jnp.arange(n - _builtin_abs(int(offset)))
+        r = idx + max(-int(offset), 0)
+        c = idx + max(int(offset), 0)
+        vm = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        vm = vm.at[..., r, c].set(val)
+        return jnp.moveaxis(vm, (-2, -1), (axis1, axis2))
+
+    return binary(f, ensure_tensor(x), ensure_tensor(y), "diagonal_scatter")
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(v, idx):
+        vm = jnp.moveaxis(v, axis, 0)
+        vm = vm.at[idx].set(value)
+        return jnp.moveaxis(vm, 0, axis)
+
+    return binary(f, ensure_tensor(x), ensure_tensor(index, dtype="int32"),
+                  "index_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions of x with consecutive elements of value
+    (row-major), reference tensor/manipulation.py masked_scatter."""
+    def f(v, m, val):
+        flatm = m.reshape(-1)
+        # position of each True among Trues
+        order = jnp.cumsum(flatm.astype(jnp.int32)) - 1
+        picked = val.reshape(-1)[jnp.clip(order, 0, val.size - 1)]
+        out = jnp.where(flatm, picked, v.reshape(-1))
+        return out.reshape(v.shape)
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(mask),
+                    ensure_tensor(value)], "masked_scatter")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[0]
+    import itertools
+
+    pool = (itertools.combinations_with_replacement(range(n), r)
+            if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(pool), np.int32).reshape(-1, r)
+    return unary(lambda v: v[idx], x, "combinations")
+
+
+def cartesian_prod(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+
+    def f(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return nary(f, tensors, "cartesian_prod")
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *vs):
+        stacked = jnp.stack(vs, 0)   # [n, batch, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return nary(lambda *args: f(args[-1], *args[:-1]),
+                [ensure_tensor(v) for v in inputs]
+                + [ensure_tensor(index)], "multiplex")
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]), jnp.int64))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]), jnp.int64))
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        return v.reshape(v.shape[:ax] + tuple(shape) + v.shape[ax + 1:])
+
+    return unary(f, x, "unflatten")
+
+
+def unfold(x, axis, size, step, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        n = v.shape[ax]
+        starts = jnp.arange(0, n - size + 1, step)
+        idx = starts[:, None] + jnp.arange(size)[None, :]
+        out = jnp.take(v, idx.reshape(-1), axis=ax)
+        return out.reshape(v.shape[:ax] + (starts.shape[0], size)
+                           + v.shape[ax + 1:])
+
+    return unary(f, x, "unfold")
+
+
+def view_as(x, other, name=None):
+    other = ensure_tensor(other)
+    return unary(lambda v: v.reshape(other._data.shape), x, "view_as")
+
+
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return unary(lambda v: jnp.flip(v, axis=ax), x, "reverse")
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (reference tensor/math.py reduce_as)."""
+    def f(v, t):
+        extra = v.ndim - t.ndim
+        if extra:
+            v = jnp.sum(v, axis=tuple(range(extra)))
+        axes = tuple(i for i, (a, b) in enumerate(zip(v.shape, t.shape))
+                     if a != b and b == 1)
+        if axes:
+            v = jnp.sum(v, axis=axes, keepdims=True)
+        return v
+
+    return binary(f, ensure_tensor(x), ensure_tensor(target), "reduce_as")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    x = ensure_tensor(x)
+    w = None if weights is None else np.asarray(
+        ensure_tensor(weights)._data)
+    hist, edges = np.histogramdd(np.asarray(x._data), bins=bins,
+                                 range=ranges, density=density, weights=w)
+    return (Tensor._wrap(jnp.asarray(hist)),
+            [Tensor._wrap(jnp.asarray(e)) for e in edges])
+
+
+def pdist(x, p=2.0, name=None):
+    def f(v):
+        n = v.shape[0]
+        d = jnp.linalg.norm(v[:, None, :] - v[None, :, :], ord=p, axis=-1)
+        iu = np.triu_indices(n, 1)
+        return d[iu]
+
+    return unary(f, x, "pdist")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    yt = ensure_tensor(y)
+
+    if x is not None:
+        def f(yv, xv):
+            dxs = jnp.diff(xv, axis=axis)
+            mids = (jnp.take(yv, jnp.arange(1, yv.shape[axis]), axis=axis)
+                    + jnp.take(yv, jnp.arange(0, yv.shape[axis] - 1),
+                               axis=axis)) / 2
+            return jnp.cumsum(mids * dxs, axis=axis)
+
+        return binary(f, yt, ensure_tensor(x), "cumulative_trapezoid")
+
+    step = 1.0 if dx is None else float(dx)
+
+    def f(yv):
+        mids = (jnp.take(yv, jnp.arange(1, yv.shape[axis]), axis=axis)
+                + jnp.take(yv, jnp.arange(0, yv.shape[axis] - 1),
+                           axis=axis)) / 2
+        return jnp.cumsum(mids * step, axis=axis)
+
+    return unary(f, yt, "cumulative_trapezoid")
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return binary(jnp.left_shift, x, y, "bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    def f(a, b):
+        if is_arithmetic:
+            return jnp.right_shift(a, b)
+        # logical shift: reinterpret as unsigned
+        ut = {jnp.int8: jnp.uint8, jnp.int16: jnp.uint16,
+              jnp.int32: jnp.uint32, jnp.int64: jnp.uint64}.get(
+                  a.dtype.type, None)
+        if ut is None:
+            return jnp.right_shift(a, b)
+        return jnp.right_shift(a.view(ut), b.astype(ut)).view(a.dtype.type)
+
+    return binary(f, x, y, "bitwise_right_shift")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return nary(lambda *vs: sum(vs[1:], vs[0]),
+                [ensure_tensor(v) for v in inputs], "add_n")
+
+
+# ---------------------------------------------------------------------------
+# queries / utilities
+# ---------------------------------------------------------------------------
+
+def shape(input):
+    return Tensor._wrap(jnp.asarray(ensure_tensor(input)._data.shape,
+                                    jnp.int32))
+
+
+def rank(input):
+    return Tensor._wrap(jnp.asarray(ensure_tensor(input).ndim, jnp.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.floating)
+
+
+def tolist(x):
+    return np.asarray(ensure_tensor(x)._data).tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(x):
+    return shape(x)
+
+
+def disable_signal_handler():
+    return None
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Deprecated reference io helper: wrap a sample reader into batches."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.create_parameter parity (static+dygraph creation API)."""
+    from ..nn import initializer as I
+
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierNormal())
+    data = init(shape, dtype)
+    p = Tensor._wrap(data)
+    p.stop_gradient = False
+    if name:
+        p.name = name
+    return p
+
+
+# ---------------------------------------------------------------------------
+# random in-place fills (reference tensor/random.py: Tensor.normal_ etc.)
+# ---------------------------------------------------------------------------
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    from ..framework.random import next_key
+
+    x = ensure_tensor(x)
+    key = next_key()
+    out = unary(lambda v: mean + std * jax.random.normal(key, v.shape,
+                                                         v.dtype),
+                x, "normal_")
+    x._inplace_from(out)
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    from ..framework.random import next_key
+
+    x = ensure_tensor(x)
+    key = next_key()
+    out = unary(lambda v: jnp.exp(mean + std * jax.random.normal(
+        key, v.shape, v.dtype)), x, "log_normal_")
+    x._inplace_from(out)
+    return x
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    from ..framework.random import next_key
+
+    x = ensure_tensor(x)
+    key = next_key()
+    out = unary(lambda v: loc + scale * jax.random.cauchy(key, v.shape,
+                                                          v.dtype),
+                x, "cauchy_")
+    x._inplace_from(out)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    from ..framework.random import next_key
+
+    x = ensure_tensor(x)
+    key = next_key()
+    out = unary(lambda v: jax.random.geometric(
+        key, probs, v.shape).astype(v.dtype), x, "geometric_")
+    x._inplace_from(out)
+    return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    from ..framework.random import next_key
+
+    x = ensure_tensor(x)
+    key = next_key()
+    out = unary(lambda v: jax.random.bernoulli(
+        key, p, v.shape).astype(v.dtype), x, "bernoulli_")
+    x._inplace_from(out)
+    return x
+
+
+def where_(condition, x, y, name=None):
+    """In-place variant of where: writes the selection into x."""
+    from .logic import where as _where
+
+    out = _where(condition, x, y)
+    x._inplace_from(out)
+    return x
+
+
+__all__ = [
+    # special
+    "gammaln", "gammainc", "gammaincc", "multigammaln", "polygamma",
+    "i0", "i0e", "i1", "i1e", "sinc", "sgn", "signbit", "isneginf",
+    "isposinf", "isreal", "isin", "polar", "complex",
+    # random
+    "log_normal", "standard_normal", "binomial", "standard_gamma",
+    "normal_", "log_normal_", "cauchy_", "geometric_", "bernoulli_",
+    "where_",
+    # manipulation
+    "tensor_split", "hsplit", "vsplit", "dsplit", "column_stack",
+    "row_stack", "atleast_1d", "atleast_2d", "atleast_3d", "block_diag",
+    "diag_embed", "diagonal", "kthvalue", "mode", "cummin",
+    "slice_scatter", "select_scatter", "diagonal_scatter", "index_fill",
+    "masked_scatter", "combinations", "cartesian_prod", "multiplex",
+    "tril_indices", "triu_indices", "unflatten", "unfold", "view_as",
+    "reverse", "reduce_as", "histogramdd", "pdist", "cumulative_trapezoid",
+    "bitwise_left_shift", "bitwise_right_shift", "add_n",
+    # queries / utils
+    "shape", "rank", "is_complex", "is_integer", "is_floating_point",
+    "tolist", "set_printoptions", "check_shape", "disable_signal_handler",
+    "batch", "create_parameter",
+]

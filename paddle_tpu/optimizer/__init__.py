@@ -123,7 +123,7 @@ class Adam(Optimizer):
         return 0.0
 
     def _l2_coeff(self, p):
-        wd = getattr(p, "_group_weight_decay", None)
+        wd = self._param_group_wd(p)
         if wd is None:
             wd = self._weight_decay
         if wd is None:
@@ -220,7 +220,7 @@ class AdamW(Adam):
         if (self._apply_decay_param_fun is not None
                 and not self._apply_decay_param_fun(p.name)):
             return 0.0
-        gwd = getattr(p, "_group_weight_decay", None)
+        gwd = self._param_group_wd(p)
         return self._wd_coeff if gwd is None else gwd
 
     # AdamW's decay is decoupled (applied in the update rule) — it must

@@ -24,24 +24,26 @@ class Optimizer:
         # 'weight_decay' overrides the optimizer default for that group) —
         # flattened here; per-param attrs carry the overrides
         self._lr_scale = 1.0
+        # group overrides live on THIS optimizer (keyed by param), never on
+        # the param objects — params outlive optimizers, and stale attrs
+        # would leak group settings into later optimizers over the same
+        # params. ParamAttr(learning_rate=...) on the param itself remains
+        # the per-param fallback.
+        self._group_lr_scale = {}
+        self._group_wd = {}
         if parameters is not None:
             flat = []
             for entry in parameters:
                 if isinstance(entry, dict):
                     group_params = list(entry["params"])
                     for p in group_params:
+                        k = p.name or str(id(p))
                         if "learning_rate" in entry:
-                            # only override when the group sets it — a
-                            # ParamAttr(learning_rate=...) scale must survive
-                            # membership in a plain group
-                            attr = dict(getattr(p, "optimize_attr", None)
-                                        or {})
-                            attr["learning_rate"] = float(
+                            self._group_lr_scale[k] = float(
                                 entry["learning_rate"])
-                            p.optimize_attr = attr
                         if "weight_decay" in entry:
                             wd = entry["weight_decay"]
-                            p._group_weight_decay = (
+                            self._group_wd[k] = (
                                 float(wd) if isinstance(wd, (int, float))
                                 else getattr(wd, "_coeff", 0.0))
                     flat.extend(group_params)
@@ -123,8 +125,14 @@ class Optimizer:
         return pgs
 
     def _param_lr_scale(self, p):
+        k = p.name or str(id(p))
+        if k in self._group_lr_scale:
+            return self._group_lr_scale[k]
         return (getattr(p, "optimize_attr", None) or {}).get(
             "learning_rate", 1.0)
+
+    def _param_group_wd(self, p):
+        return self._group_wd.get(p.name or str(id(p)))
 
     def _cur_lr(self):
         """Base lr times the current param's group scale (set by step())."""
@@ -134,7 +142,7 @@ class Optimizer:
     def _apply_decay(self, param, grad_data):
         """L2 regularization folded into the gradient (reference: the
         regularizer path in optimizer.py; AdamW overrides with decoupled decay)."""
-        wd = getattr(param, "_group_weight_decay", None)
+        wd = self._param_group_wd(param)
         if wd is None:
             wd = self._weight_decay
         if wd is None:
