@@ -1,0 +1,746 @@
+"""Long-tail nn.functional parity pack (reference python/paddle/nn/
+functional/: distance.py, activation.py in-place variants, pooling.py
+lp/unpool/fractional, loss.py specialty losses, extension.py
+sequence_mask/gather_tree/temporal_shift, and the margin-softmax pair from
+the large-scale-classification stack).
+
+All jnp expressions through the dispatch layer; sequence/beam utilities are
+scans, so everything jits.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...ops._dispatch import unary, binary, nary, ensure_tensor
+
+
+# ---------------------------------------------------------------------------
+# distance / simple activations
+# ---------------------------------------------------------------------------
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+    return binary(f, ensure_tensor(x), ensure_tensor(y),
+                  "pairwise_distance")
+
+
+def log_sigmoid(x, name=None):
+    return unary(lambda v: -jax.nn.softplus(-v), x, "log_sigmoid")
+
+
+def _mk_inplace(fn):
+    def inplace(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._inplace_from(out)
+        return x
+
+    return inplace
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths [..., n] -> bool/int mask [..., n, maxlen] (reference
+    nn/functional/extension.py sequence_mask)."""
+    from ...framework.dtype import to_jax_dtype
+
+    x = ensure_tensor(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(x._data).max())
+    dt = to_jax_dtype(dtype)
+    return unary(lambda v: (jnp.arange(maxlen) < v[..., None]).astype(dt),
+                 x, "sequence_mask")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (dim 1), SELU-preserving
+    statistics (reference common.py feature_alpha_dropout)."""
+    if not training or p == 0.0:
+        return ensure_tensor(x)
+    from ...framework.random import next_key
+
+    key = next_key()
+    alpha_p = -1.7580993408473766  # -scale*alpha of SELU
+    a = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
+    b = -a * alpha_p * p
+
+    def f(v):
+        mask_shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, mask_shape)
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return unary(f, x, "feature_alpha_dropout")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = [int(v) for v in padding]
+
+    def f(v):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+        return jnp.pad(v, cfg)
+
+    return unary(f, x, "zeropad2d")
+
+
+# ---------------------------------------------------------------------------
+# pooling: LP / unpool / fractional
+# ---------------------------------------------------------------------------
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    from .pooling import avg_pool1d
+
+    p = float(norm_type)
+    xp = unary(lambda v: jnp.power(jnp.abs(v), p), x, "lp_pow")
+    pooled = avg_pool1d(xp, kernel_size, stride=stride, padding=padding,
+                        ceil_mode=ceil_mode, exclusive=False)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    return unary(lambda v: jnp.power(v * k, 1.0 / p), pooled, "lp_root")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from .pooling import avg_pool2d
+
+    p = float(norm_type)
+    xp = unary(lambda v: jnp.power(jnp.abs(v), p), x, "lp_pow")
+    pooled = avg_pool2d(xp, kernel_size, stride=stride, padding=padding,
+                        ceil_mode=ceil_mode, exclusive=False)
+    if isinstance(kernel_size, int):
+        kk = kernel_size * kernel_size
+    else:
+        kk = kernel_size[0] * kernel_size[1]
+    return unary(lambda v: jnp.power(v * kk, 1.0 / p), pooled, "lp_root")
+
+
+def _max_unpool(x, indices, spatial_out, name):
+    """Scatter pooled values back to `spatial_out` positions (indices are
+    flat positions within each channel's input spatial block — the layout
+    max_pool(return_mask=True) produces)."""
+    def f(v, idx):
+        lead = v.shape[:2]
+        flat_n = int(np.prod(spatial_out))
+        vf = v.reshape(lead + (-1,))
+        i = idx.reshape(lead + (-1,)).astype(jnp.int32)
+        out = jnp.zeros(lead + (flat_n,), v.dtype)
+        out = jax.vmap(jax.vmap(lambda o, ii, vv: o.at[ii].set(vv)))(
+            out, i, vf)
+        return out.reshape(lead + tuple(spatial_out))
+
+    return binary(f, ensure_tensor(x), ensure_tensor(indices), name)
+
+
+def _unpool_out_size(in_size, kernel, stride, padding):
+    stride = stride or kernel
+    return (in_size - 1) * stride - 2 * padding + kernel
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    x = ensure_tensor(x)
+    if output_size is not None:
+        out_l = (output_size[-1] if len(output_size) > 1
+                 else output_size[0])
+    else:
+        out_l = _unpool_out_size(x.shape[-1], kernel_size,
+                                 stride or kernel_size, padding)
+    return _max_unpool(x, indices, (out_l,), "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    x = ensure_tensor(x)
+    ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+          else tuple(kernel_size))
+    st = (ks if stride is None else
+          ((stride, stride) if isinstance(stride, int) else tuple(stride)))
+    pd = ((padding, padding) if isinstance(padding, int)
+          else tuple(padding))
+    if output_size is not None:
+        hw = tuple(output_size[-2:])
+    else:
+        hw = (_unpool_out_size(x.shape[-2], ks[0], st[0], pd[0]),
+              _unpool_out_size(x.shape[-1], ks[1], st[1], pd[1]))
+    return _max_unpool(x, indices, hw, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    x = ensure_tensor(x)
+    ks = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+          else tuple(kernel_size))
+    st = (ks if stride is None else
+          ((stride,) * 3 if isinstance(stride, int) else tuple(stride)))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    if output_size is not None:
+        dhw = tuple(output_size[-3:])
+    else:
+        dhw = tuple(_unpool_out_size(x.shape[-3 + i], ks[i], st[i], pd[i])
+                    for i in range(3))
+    return _max_unpool(x, indices, dhw, "max_unpool3d")
+
+
+def _fractional_starts(in_size, out_size, u):
+    alpha = in_size / out_size
+    idx = np.arange(out_size + 1)
+    pts = np.ceil(alpha * (idx + u)).astype(np.int64) - 1
+    pts[0] = 0
+    pts[-1] = in_size
+    return pts
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (reference pooling.py fractional_max_pool2d,
+    Graham 2014): pseudo-random pooling regions from one uniform draw."""
+    x = ensure_tensor(x)
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    u = (float(random_u) if random_u is not None
+         else float(np.random.default_rng(0).uniform(0.3, 0.7)))
+    hs = _fractional_starts(x.shape[-2], oh, u)
+    ws = _fractional_starts(x.shape[-1], ow, u)
+
+    def f(v):
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                patch = v[..., hs[i]:max(hs[i + 1], hs[i] + 1),
+                          ws[j]:max(ws[j + 1], ws[j] + 1)]
+                cols.append(jnp.max(patch, axis=(-2, -1)))
+            rows.append(jnp.stack(cols, -1))
+        return jnp.stack(rows, -2)
+
+    out = unary(f, x, "fractional_max_pool2d")
+    if return_mask:
+        def fm(v):
+            rows = []
+            for i in range(oh):
+                cols = []
+                for j in range(ow):
+                    patch = v[..., hs[i]:max(hs[i + 1], hs[i] + 1),
+                              ws[j]:max(ws[j + 1], ws[j] + 1)]
+                    pf = patch.reshape(patch.shape[:-2] + (-1,))
+                    loc = jnp.argmax(pf, -1)
+                    ph = patch.shape[-1]
+                    r = hs[i] + loc // ph
+                    c = ws[j] + loc % ph
+                    cols.append(r * v.shape[-1] + c)
+                rows.append(jnp.stack(cols, -1))
+            return jnp.stack(rows, -2).astype(jnp.int32)
+
+        mask = unary(fm, x, "fractional_max_pool2d_mask")
+        mask.stop_gradient = True
+        return out, mask
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    x = ensure_tensor(x)
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else tuple(output_size))
+    u = (float(random_u) if random_u is not None
+         else float(np.random.default_rng(0).uniform(0.3, 0.7)))
+    ds = _fractional_starts(x.shape[-3], od, u)
+    hs = _fractional_starts(x.shape[-2], oh, u)
+    ws = _fractional_starts(x.shape[-1], ow, u)
+
+    def f(v):
+        out = jnp.stack([
+            jnp.stack([
+                jnp.stack([
+                    jnp.max(v[..., ds[d]:max(ds[d + 1], ds[d] + 1),
+                              hs[i]:max(hs[i + 1], hs[i] + 1),
+                              ws[j]:max(ws[j + 1], ws[j] + 1)],
+                            axis=(-3, -2, -1))
+                    for j in range(ow)], -1)
+                for i in range(oh)], -2)
+            for d in range(od)], -3)
+        return out
+
+    out = unary(f, x, "fractional_max_pool3d")
+    if return_mask:
+        raise NotImplementedError("fractional_max_pool3d return_mask")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|X∩Y| / (|X|+|Y|) over one-hot-able labels (reference
+    loss.py dice_loss)."""
+    def f(x, y):
+        y1 = jax.nn.one_hot(y[..., 0].astype(jnp.int32), x.shape[-1],
+                            dtype=x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * y1, reduce_dims)
+        union = jnp.sum(x, reduce_dims) + jnp.sum(y1, reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return binary(f, ensure_tensor(input), ensure_tensor(label), "dice_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return binary(
+        lambda x, y: -(y * jnp.log(x + epsilon)
+                       + (1 - y) * jnp.log(1 - x + epsilon)),
+        ensure_tensor(input), ensure_tensor(label), "log_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return binary(lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)),
+                                       reduction),
+                  ensure_tensor(input), ensure_tensor(label),
+                  "soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def f(x, y, *maybe_w):
+        loss = -(y * (-jax.nn.softplus(-x))
+                 + (1 - y) * (-jax.nn.softplus(x)))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(jnp.mean(loss, -1), reduction)
+
+    inputs = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    return nary(f, inputs, "multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def f(x, y, *maybe_w):
+        n, c = x.shape
+        y = y.astype(jnp.int32)
+        xy = jnp.take_along_axis(x, y[:, None], 1)
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if maybe_w:
+            m = m * maybe_w[0][y][:, None]
+        m = m * (1 - jax.nn.one_hot(y, c, dtype=x.dtype))
+        return _reduce(jnp.sum(m, -1) / c, reduction)
+
+    inputs = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    return nary(f, inputs, "multi_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = (y * jnp.log(y) - y
+                        + 0.5 * jnp.log(2 * math.pi * y))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return binary(f, ensure_tensor(input), ensure_tensor(label),
+                  "poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+
+    return nary(f, [ensure_tensor(input), ensure_tensor(label),
+                    ensure_tensor(variance)], "gaussian_nll_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    from .loss import triplet_margin_loss
+
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        dn = nary(lambda a, b: jnp.minimum(a, b), [dn, dn2], "min_dist")
+    return nary(lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0),
+                                     reduction), [dp, dn],
+                "triplet_margin_with_distance_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Reference loss.py npair_loss: softmax CE over anchor·positiveᵀ
+    similarities + L2 on the embeddings."""
+    def f(a, p, y):
+        sim = a @ p.T                                   # [n, n]
+        tgt = (y[:, None] == y[None, :]).astype(jnp.float32)
+        tgt = tgt / jnp.sum(tgt, -1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, -1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, -1))
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / (2 * a.shape[0])
+        return ce + reg
+
+    return nary(f, [ensure_tensor(anchor), ensure_tensor(positive),
+                    ensure_tensor(labels)], "npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference loss.py hsigmoid_loss / MatrixBitCodeFunctor): leaf id =
+    label + num_classes; ancestors leaf>>1.. down to 1 are the internal
+    nodes; each step is a binary logistic decision."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom-tree hsigmoid")
+    depth = int(math.ceil(math.log2(num_classes))) + 1
+
+    def f(x, y, w, *maybe_b):
+        y = y.astype(jnp.int32).reshape(-1)
+        leaf = y + num_classes
+        losses = jnp.zeros(y.shape, jnp.float32)
+        node = leaf
+        for _ in range(depth):
+            bit = (node & 1).astype(jnp.float32)
+            parent = node >> 1
+            active = parent >= 1
+            nid = jnp.clip(parent - 1, 0, num_classes - 2)
+            z = jnp.einsum("nf,nf->n", x, w[nid])
+            if maybe_b:
+                z = z + maybe_b[0].reshape(-1)[nid]
+            # BCE with target = bit
+            step_loss = jax.nn.softplus(z) - bit * z
+            losses = losses + jnp.where(active, step_loss, 0.0)
+            node = parent
+        return losses[:, None]
+
+    inputs = [ensure_tensor(input), ensure_tensor(label),
+              ensure_tensor(weight)]
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return nary(f, inputs, "hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """Combined-margin softmax CE (reference loss.py margin_cross_entropy:
+    ArcFace/CosFace family — cos(m1·θ + m2) − m3 on the target logit)."""
+    def f(x, y):
+        y = y.astype(jnp.int32).reshape(-1)
+        xt = jnp.take_along_axis(x, y[:, None], 1)[:, 0]
+        theta = jnp.arccos(jnp.clip(xt, -1 + 1e-7, 1 - 1e-7))
+        xt_m = jnp.cos(margin1 * theta + margin2) - margin3
+        mod = x.at[jnp.arange(x.shape[0]), y].set(xt_m) * scale
+        logp = jax.nn.log_softmax(mod, -1)
+        loss = -jnp.take_along_axis(logp, y[:, None], 1)
+        return _reduce(loss, reduction)
+
+    out = binary(f, ensure_tensor(logits), ensure_tensor(label),
+                 "margin_cross_entropy")
+    if return_softmax:
+        def fs(x, y):
+            y = y.astype(jnp.int32).reshape(-1)
+            xt = jnp.take_along_axis(x, y[:, None], 1)[:, 0]
+            theta = jnp.arccos(jnp.clip(xt, -1 + 1e-7, 1 - 1e-7))
+            xt_m = jnp.cos(margin1 * theta + margin2) - margin3
+            mod = x.at[jnp.arange(x.shape[0]), y].set(xt_m) * scale
+            return jax.nn.softmax(mod, -1)
+
+        sm = binary(fs, ensure_tensor(logits), ensure_tensor(label),
+                    "margin_softmax")
+        return out, sm
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference loss.py
+    class_center_sample): keep all positive classes, pad with sampled
+    negatives up to num_samples; returns (remapped_label, sampled_ids).
+    Single-controller implementation (data-dependent size, eager-only)."""
+    lbl = np.asarray(ensure_tensor(label)._data).reshape(-1)
+    pos = np.unique(lbl)
+    n_extra = max(0, num_samples - pos.size)
+    neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.default_rng(0)
+    extra = rng.choice(neg_pool, size=min(n_extra, neg_pool.size),
+                       replace=False)
+    sampled = np.concatenate([pos, extra])
+    remap = {c: i for i, c in enumerate(sampled)}
+    new_lbl = np.asarray([remap[c] for c in lbl], np.int64)
+    return (Tensor._wrap(jnp.asarray(new_lbl)),
+            Tensor._wrap(jnp.asarray(sampled.astype(np.int64))))
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T (transducer) loss — the standard log-semiring lattice
+    recursion (Graves 2012) as nested scans over (t, u). input:
+    [B, T, U+1, V] joint logits; label: [B, U]."""
+    def f(lp, y, ti, ui):
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), -1)
+        y = y.astype(jnp.int32)
+        ti = ti.astype(jnp.int32)
+        ui = ui.astype(jnp.int32)
+        neg_inf = jnp.float32(-1e30)
+        blank_lp = lp[..., blank]                       # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], y[:, None, :, None], -1)[..., 0]  # [B,T,U]
+
+        u_idx = jnp.arange(U1)
+
+        def t_step(alpha_prev, inp):
+            bl_t, em_t, t = inp   # bl_t [B,U+1], em_t [B,U], prev column t-1
+
+            # horizontal move: alpha[t, u] += alpha[t-1, u] + blank
+            horiz = alpha_prev + bl_t
+
+            # then vertical prefix within column t:
+            # alpha[t,u] = logaddexp(horiz[u], alpha[t,u-1] + emit[u-1])
+            def u_step(carry, xu):
+                h_u, e_um1 = xu
+                val = jnp.logaddexp(h_u, carry + e_um1)
+                return val, val
+
+            first = horiz[:, 0]
+            _, rest = jax.lax.scan(
+                lambda c, xu: jax.vmap(u_step)(c, xu),
+                first, (horiz[:, 1:].swapaxes(0, 1),
+                        em_t.swapaxes(0, 1)))
+            col = jnp.concatenate([first[:, None],
+                                   rest.swapaxes(0, 1)], 1)
+            # freeze beyond valid u and finished t
+            col = jnp.where(u_idx[None, :] <= ui[:, None], col, neg_inf)
+            col = jnp.where((t < ti)[:, None], col, alpha_prev)
+            return col, None
+
+        # t = 0 column: only vertical moves
+        def u0_step(carry, e):
+            val = carry + e
+            return val, val
+
+        first0 = jnp.zeros((B,), jnp.float32)
+        _, rest0 = jax.lax.scan(
+            lambda c, e: jax.vmap(u0_step)(c, e),
+            first0, emit_lp[:, 0].swapaxes(0, 1))
+        alpha0 = jnp.concatenate([first0[:, None], rest0.swapaxes(0, 1)],
+                                 1)
+        alpha0 = jnp.where(u_idx[None, :] <= ui[:, None], alpha0, neg_inf)
+
+        alpha, _ = jax.lax.scan(
+            t_step, alpha0,
+            (blank_lp[:, :-1].swapaxes(0, 1),
+             emit_lp[:, 1:].swapaxes(0, 1),
+             jnp.arange(1, T)))
+        # terminal: alpha[T-1, U] + blank(T-1, U), per-sample T/U
+        a_end = jnp.take_along_axis(alpha, ui[:, None], 1)[:, 0]
+        bl_end = blank_lp[jnp.arange(B), jnp.clip(ti - 1, 0, T - 1),
+                          jnp.clip(ui, 0, U)]
+        loss = -(a_end + bl_end)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return nary(f, [ensure_tensor(input), ensure_tensor(label),
+                    ensure_tensor(input_lengths),
+                    ensure_tensor(label_lengths)], "rnnt_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference loss.py adaptive_log_softmax_with_loss;
+    Grave et al. 2017): frequent classes in the head, rare ones in
+    projected tail clusters. Returns (per-sample logprob-of-target, mean
+    loss)."""
+    n_clusters = len(cutoffs)
+    head_size = cutoffs[0] + n_clusters
+
+    def f(x, y, hw, *rest):
+        i = 0
+        tails = []
+        for _ in range(n_clusters):
+            tails.append((rest[i], rest[i + 1]))
+            i += 2
+        hb = rest[i] if len(rest) > i else None
+        y = y.astype(jnp.int32).reshape(-1)
+        head = x @ hw
+        if hb is not None:
+            head = head + hb
+        head_logp = jax.nn.log_softmax(head, -1)
+        # in-head targets
+        out = jnp.take_along_axis(head_logp,
+                                  jnp.clip(y, 0, cutoffs[0] - 1)[:, None],
+                                  1)[:, 0]
+        lows = [0] + list(cutoffs)
+        for c in range(n_clusters):
+            lo, hi = lows[c + 1], (lows + [None])[c + 2]
+            proj, emb = tails[c]
+            tail_logit = (x @ proj) @ emb
+            tail_logp = jax.nn.log_softmax(tail_logit, -1)
+            cluster_lp = head_logp[:, cutoffs[0] + c]
+            in_c = (y >= lo) & (y < (hi if hi is not None else 10 ** 9))
+            rel = jnp.clip(y - lo, 0, tail_logp.shape[-1] - 1)
+            lp_c = cluster_lp + jnp.take_along_axis(
+                tail_logp, rel[:, None], 1)[:, 0]
+            out = jnp.where(in_c, lp_c, out)
+        return out
+
+    inputs = [ensure_tensor(input), ensure_tensor(label),
+              ensure_tensor(head_weight)]
+    for tw in tail_weights:
+        inputs.append(ensure_tensor(tw[0]))
+        inputs.append(ensure_tensor(tw[1]))
+    if head_bias is not None:
+        inputs.append(ensure_tensor(head_bias))
+    out = nary(f, inputs, "adaptive_log_softmax")
+    loss = nary(lambda *a: -jnp.mean(f(*a)), inputs,
+                "adaptive_log_softmax_loss")
+    return out, loss
+
+
+# ---------------------------------------------------------------------------
+# sequence utilities
+# ---------------------------------------------------------------------------
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference extension.py gather_tree):
+    ids/parents [max_time, batch, beam] -> full paths."""
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(next_beams, inp):
+            idv_t, par_t = inp
+            # next_beams: the beam slot chosen at t+1 -> follow parent
+            gathered = jnp.take_along_axis(idv_t, next_beams, -1)
+            prev = jnp.take_along_axis(par_t, next_beams, -1)
+            return prev, gathered
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[-1]),
+                                idv.shape[1:]).astype(par.dtype)
+        _, rows = jax.lax.scan(step, init, (idv[::-1], par[::-1]))
+        return rows[::-1]
+
+    out = binary(f, ensure_tensor(ids), ensure_tensor(parents),
+                 "gather_tree")
+    out.stop_gradient = True
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal channel shift (reference extension.py temporal_shift)."""
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v5[:, 1:, :fold], jnp.zeros_like(v5[:, :1, :fold])], 1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, fold:2 * fold]),
+             v5[:, :-1, fold:2 * fold]], 1)
+        rest = v5[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], 2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return unary(f, x, "temporal_shift")
+
+
+# ---------------------------------------------------------------------------
+# flash-attention wrappers
+# ---------------------------------------------------------------------------
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, training=True, name=None,
+                         **kwargs):
+    """qkv [batch, seq, 3, heads, dim] -> flash_attention (reference
+    flash_attention.py flash_attn_qkvpacked)."""
+    from .flash_attention import flash_attention
+
+    qkv = ensure_tensor(qkv)
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None, **kwargs):
+    from .flash_attention import flash_attn_unpadded
+
+    qkv = ensure_tensor(qkv)
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout=dropout, causal=causal,
+                               training=training)
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=True, training=True,
+                                     name=None):
+    """Row-sparse causal mask variant (reference flash_attention.py):
+    token q attends to keys < start_row_indices[q] positions masked.
+    Dense-mask composition on TPU (XLA fuses the mask into attention)."""
+    from .flash_attention import scaled_dot_product_attention
+
+    q = ensure_tensor(query)
+    s = q.shape[1]
+    idx = ensure_tensor(attn_mask_start_row_indices)
+
+    def build(ind):
+        rows = jnp.arange(s)[None, None, :, None]
+        cols = jnp.arange(s)[None, None, None, :]
+        causal = cols <= rows
+        sparse = cols < ind[:, :, :, None]
+        return causal & sparse
+
+    mask = unary(build, idx, "sparse_mask")
+    return scaled_dot_product_attention(query, key, value, attn_mask=mask,
+                                        dropout_p=dropout_p, is_causal=False,
+                                        training=training)
