@@ -109,7 +109,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     captured = run_backward(
         list(outputs),
         list(grad_outputs) if grad_outputs is not None else None,
-        retain_graph=bool(retain_graph) if retain_graph is not None else create_graph,
+        # NB: plain `bool` is shadowed here by the paddle.bool DType export
+        retain_graph=((not not retain_graph) if retain_graph is not None
+                      else create_graph),
         capture=capture,
         accumulate_leaf=False,
     )

@@ -134,13 +134,156 @@ def _ho_wrap(func):
     return f
 
 
-def jacobian(func, xs, batch_axis=None):
-    """paddle.autograd.jacobian parity (reference autograd/autograd.py):
-    d func(xs) / d xs. With batch_axis=0 the jacobian is computed
-    per-batch-row (vmapped), matching the reference's batch semantics.
-    Returns a Tensor (single xs) or tuple of Tensors."""
+class Jacobian:
+    """Lazy Jacobian of a computed ``ys`` w.r.t. ``xs`` (reference
+    autograd/autograd.py:35): rows are evaluated on first access via one
+    tape backward per output element (retain_graph) and cached, matching
+    the reference's row-granular lazy evaluation.
+
+    Shapes follow the reference: non-batched needs 0/1-D ys and xs and
+    yields [M, N]; batched (batch_axis=0) needs 1/2-D and yields
+    [B, M, N].
+    """
+
+    def __init__(self, ys, xs, is_batched=False):
+        if not isinstance(ys, Tensor) or not isinstance(xs, Tensor):
+            raise TypeError("Jacobian takes computed Tensors (ys, xs)")
+        lo = 1 if is_batched else 0
+        if not lo <= len(xs.shape) <= lo + 1:
+            raise ValueError(
+                f"xs.ndim must be {lo} or {lo + 1} with "
+                f"is_batched={is_batched}, got {len(xs.shape)}")
+        if not lo <= len(ys.shape) <= lo + 1:
+            raise ValueError(
+                f"ys.ndim must be {lo} or {lo + 1} with "
+                f"is_batched={is_batched}, got {len(ys.shape)}")
+        self._ys = ys
+        self._xs = xs
+        self._batched = is_batched
+        b = ys.shape[0] if is_batched else None
+        m = (ys.shape[lo] if len(ys.shape) == lo + 1 else 1)
+        n = (xs.shape[lo] if len(xs.shape) == lo + 1 else 1)
+        self.shape = ((b, m, n) if is_batched else (m, n))
+        self._rows: dict[int, jnp.ndarray] = {}
+
+    def _eval_row(self, i):
+        """d ys[..., i] / d xs via one backward with a one-hot seed."""
+        if i in self._rows:
+            return self._rows[i]
+        import paddle_tpu as paddle
+
+        seed = jnp.zeros(self._ys.shape, self._ys._data.dtype)
+        lo = 1 if self._batched else 0
+        if len(self._ys.shape) == lo + 1:
+            if self._batched:
+                seed = seed.at[:, i].set(1)
+            else:
+                seed = seed.at[i].set(1)
+        else:
+            seed = jnp.ones_like(seed)
+        (g,) = paddle.grad([self._ys], [self._xs],
+                           grad_outputs=[Tensor._wrap(seed)],
+                           retain_graph=True, allow_unused=True)
+        if g is None:
+            g = Tensor._wrap(jnp.zeros(self._xs.shape,
+                                       self._xs._data.dtype))
+        # row layout: batched [B, N]; non-batched [N]
+        data = g._data.reshape((-1, self.shape[-1])
+                               if self._batched else (self.shape[-1],))
+        self._rows[i] = data
+        return data
+
+    def _assemble(self):
+        """Full-shaped array from the row cache; rows never evaluated are
+        zero-filled (callers only read rows they asked for)."""
+        m = self.shape[1] if self._batched else self.shape[0]
+        zero = None
+        rows = []
+        for i in range(m):
+            r = self._rows.get(i)
+            if r is None:
+                if zero is None:
+                    any_row = next(iter(self._rows.values()))
+                    zero = jnp.zeros_like(any_row)
+                r = zero
+            rows.append(r)
+        axis = 1 if self._batched else 0
+        return jnp.stack(rows, axis=axis)        # [B, M, N] / [M, N]
+
+    def _materialize(self):
+        m = self.shape[1] if self._batched else self.shape[0]
+        for i in range(m):
+            self._eval_row(i)
+        return self._assemble()
+
+    def __getitem__(self, idx):
+        # row-granular laziness (reference: "lazily evaluated along row
+        # axis"): an index that selects rows only evaluates those rows
+        m = self.shape[1] if self._batched else self.shape[0]
+        if self._batched:
+            row_sel = (idx[1] if isinstance(idx, tuple) and len(idx) > 1
+                       else slice(None))
+        else:
+            row_sel = idx[0] if isinstance(idx, tuple) else idx
+        if isinstance(row_sel, int):
+            rows = [row_sel % m]
+        elif isinstance(row_sel, slice):
+            rows = list(range(*row_sel.indices(m)))
+        else:
+            rows = list(range(m))
+        for i in rows:
+            self._eval_row(i)
+        return Tensor._wrap(self._assemble()[idx])
+
+    def __len__(self):
+        return self.shape[0]
+
+    def numpy(self):
+        import numpy as _np
+
+        return _np.asarray(self._materialize())
+
+
+class Hessian(Jacobian):
+    def __init__(self, ys, xs, is_batched=False):
+        # the tape records first-order vjp closures only (primals frozen),
+        # so a Hessian from computed tensors cannot be evaluated — refuse
+        # loudly instead of silently returning first-order values
+        raise NotImplementedError(
+            "Hessian(ys, xs) needs double backward through the tape, which "
+            "the eager engine does not record; use the functional form "
+            "paddle.autograd.hessian(func, xs) instead")
+
+
+def _jacobian_from_ys(ys, xs, batch_axis):
+    is_batched = batch_axis is not None
+    ys_seq = isinstance(ys, (list, tuple))
+    xs_seq = isinstance(xs, (list, tuple))
+    if ys_seq and xs_seq:
+        return tuple(tuple(Jacobian(y, x, is_batched) for x in xs)
+                     for y in ys)
+    if ys_seq:
+        return tuple(Jacobian(y, xs, is_batched) for y in ys)
+    if xs_seq:
+        return tuple(Jacobian(ys, x, is_batched) for x in xs)
+    return Jacobian(ys, xs, is_batched)
+
+
+def jacobian(func_or_ys, xs, batch_axis=None):
+    """paddle.autograd.jacobian parity (reference autograd/autograd.py:492).
+
+    Stable form: ``jacobian(ys, xs)`` with computed Tensor(s) ``ys`` —
+    returns lazy :class:`Jacobian` object(s) evaluated row-by-row through
+    the tape. Legacy functional form: ``jacobian(func, xs)`` — computes
+    eagerly via jax.jacrev and returns Tensor(s)."""
     import jax
 
+    if batch_axis is not None and batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    if not callable(func_or_ys) or isinstance(func_or_ys, Tensor):
+        return _jacobian_from_ys(func_or_ys, xs, batch_axis)
+
+    func = func_or_ys
     single = isinstance(xs, Tensor)
     xs_list = [xs] if single else list(xs)
     datas = [x._data for x in xs_list]
@@ -148,10 +291,8 @@ def jacobian(func, xs, batch_axis=None):
     argnums = tuple(range(len(datas)))
     if batch_axis is None:
         jac = jax.jacrev(f, argnums=argnums)(*datas)
-    elif batch_axis == 0:
-        jac = jax.vmap(jax.jacrev(f, argnums=argnums))(*datas)
     else:
-        raise ValueError("batch_axis must be None or 0")
+        jac = jax.vmap(jax.jacrev(f, argnums=argnums))(*datas)
     outs = jax.tree_util.tree_map(Tensor._wrap, jac)
     # single xs: unwrap the per-input tuple layer (outputs keep their own
     # structure — a tuple-valued func yields a tuple of jacobians)
@@ -163,11 +304,27 @@ def jacobian(func, xs, batch_axis=None):
     return outs
 
 
-def hessian(func, xs, batch_axis=None):
-    """paddle.autograd.hessian parity: d^2 func(xs) / d xs^2 for a scalar
-    (or per-batch-row scalar) valued func."""
+def hessian(func_or_ys, xs, batch_axis=None):
+    """paddle.autograd.hessian parity: d^2 ys / d xs^2 for a scalar (or
+    per-batch-row scalar) ys.
+
+    Only the functional form ``hessian(func, xs)`` computes here (via
+    jax.hessian). The reference's ``hessian(ys, xs)`` Tensor form needs
+    double backward through the tape, which the eager engine does not
+    record (vjp closures freeze their primals) — it raises with guidance
+    to pass the function instead."""
     import jax
 
+    if batch_axis is not None and batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    if not callable(func_or_ys) or isinstance(func_or_ys, Tensor):
+        raise NotImplementedError(
+            "hessian(ys, xs) with a computed Tensor needs double backward "
+            "through the tape, which is not recorded; call "
+            "hessian(func, xs) with the function that produced ys (the "
+            "functional form computes through jax.hessian)")
+
+    func = func_or_ys
     single = isinstance(xs, Tensor)
     xs_list = [xs] if single else list(xs)
     datas = [x._data for x in xs_list]
@@ -175,10 +332,8 @@ def hessian(func, xs, batch_axis=None):
     argnums = tuple(range(len(datas)))
     if batch_axis is None:
         h = jax.hessian(f, argnums=argnums)(*datas)
-    elif batch_axis == 0:
-        h = jax.vmap(jax.hessian(f, argnums=argnums))(*datas)
     else:
-        raise ValueError("batch_axis must be None or 0")
+        h = jax.vmap(jax.hessian(f, argnums=argnums))(*datas)
     if single:
         hh = h[0][0] if isinstance(h, tuple) else h
         return Tensor._wrap(hh)

@@ -398,11 +398,17 @@ def p2p_permute(tensor, perm, group=None):
 
 
 def barrier(group=None):
-    """Synchronize: a tiny psum forced to completion."""
+    """Synchronize: a tiny psum forced to completion. The blocking wait is
+    guarded by the comm watchdog (reference: comm_task_manager.h:37 watches
+    every outstanding collective) so a dead peer interrupts instead of
+    hanging forever."""
     group = group or _world_group()
     fn = _reduce_traced(group.axes, ReduceOp.SUM)
     out = _run(group, jnp.zeros((), jnp.int32), fn)
-    jax.block_until_ready(out)
+    from . import comm_watchdog
+
+    with comm_watchdog.watch(f"barrier(axes={group.axes})"):
+        jax.block_until_ready(out)
 
 
 def all_gather_object(object_list, obj, group=None):

@@ -112,6 +112,7 @@ class TrainStep:
         self._params = None   # resolved lazily: optimizer may create accums on 1st step
         self._buffers = None
         self._jitted = None
+        self._step_count = 0
         self._donate = donate
         # gradient accumulation INSIDE the fused program (the reference's
         # no_sync/gradient-merge loop, compiled): the batch's dim 0 splits
@@ -263,8 +264,16 @@ class TrainStep:
         state = self._extract_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         try:
-            with RecordEvent("TrainStep"):
+            # comm watchdog (reference comm_task_manager.h:37): the dispatch
+            # blocks when the device queue is full behind a dead collective,
+            # so guard it — without forcing a sync that would break async
+            # dispatch pipelining
+            from ..distributed import comm_watchdog
+
+            with RecordEvent("TrainStep"), \
+                    comm_watchdog.watch(f"TrainStep#{self._step_count}"):
                 loss_data, new_state = self._jitted(state, lr, batch_data)
+            self._step_count += 1
         except Exception:
             # a tracing error leaves tracers bound in the live objects;
             # restore the concrete state so the model stays usable

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...framework.tensor import Tensor
+from ...framework.random import host_rng as _host_rng
 from ...ops._dispatch import unary, binary, nary, ensure_tensor
 
 
@@ -206,7 +207,7 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
     oh, ow = ((output_size, output_size) if isinstance(output_size, int)
               else tuple(output_size))
     u = (float(random_u) if random_u is not None
-         else float(np.random.default_rng(0).uniform(0.3, 0.7)))
+         else float(_host_rng().uniform(0.3, 0.7)))
     hs = _fractional_starts(x.shape[-2], oh, u)
     ws = _fractional_starts(x.shape[-1], ow, u)
 
@@ -251,7 +252,7 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
     od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
                   else tuple(output_size))
     u = (float(random_u) if random_u is not None
-         else float(np.random.default_rng(0).uniform(0.3, 0.7)))
+         else float(_host_rng().uniform(0.3, 0.7)))
     ds = _fractional_starts(x.shape[-3], od, u)
     hs = _fractional_starts(x.shape[-2], oh, u)
     ws = _fractional_starts(x.shape[-1], ow, u)
@@ -491,7 +492,7 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     pos = np.unique(lbl)
     n_extra = max(0, num_samples - pos.size)
     neg_pool = np.setdiff1d(np.arange(num_classes), pos)
-    rng = np.random.default_rng(0)
+    rng = _host_rng()
     extra = rng.choice(neg_pool, size=min(n_extra, neg_pool.size),
                        replace=False)
     sampled = np.concatenate([pos, extra])
@@ -567,16 +568,33 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
         a_end = jnp.take_along_axis(alpha, ui[:, None], 1)[:, 0]
         bl_end = blank_lp[jnp.arange(B), jnp.clip(ti - 1, 0, T - 1),
                           jnp.clip(ui, 0, U)]
-        loss = -(a_end + bl_end)
+        return -(a_end + bl_end)                        # per-sample [B]
+
+    def reduced(lp, y, ti, ui):
+        loss = f(lp, y, ti, ui)
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148) as the warprnnt kernel applies
+            # it: the emit-transition gradient is scaled by (1 + lambda),
+            # i.e. each sequence's objective gains lambda *
+            # <stop_grad(emit part of dL_b/dlogits), logits>.
+            g = jax.grad(lambda z: jnp.sum(f(z, y, ti, ui)))(lp)
+            U = lp.shape[2] - 1
+            emit_mask = jax.nn.one_hot(y.astype(jnp.int32), lp.shape[-1],
+                                       dtype=jnp.float32)   # [B, U, V]
+            emit_g = g[:, :, :U, :] * emit_mask[:, None, :, :]
+            corr = jnp.sum(jax.lax.stop_gradient(emit_g)
+                           * lp[:, :, :U, :].astype(emit_g.dtype),
+                           axis=(1, 2, 3))                  # [B]
+            loss = loss + fastemit_lambda * corr.astype(loss.dtype)
         if reduction == "mean":
             return jnp.mean(loss)
         if reduction == "sum":
             return jnp.sum(loss)
         return loss
 
-    return nary(f, [ensure_tensor(input), ensure_tensor(label),
-                    ensure_tensor(input_lengths),
-                    ensure_tensor(label_lengths)], "rnnt_loss")
+    return nary(reduced, [ensure_tensor(input), ensure_tensor(label),
+                          ensure_tensor(input_lengths),
+                          ensure_tensor(label_lengths)], "rnnt_loss")
 
 
 def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,

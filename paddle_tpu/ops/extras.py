@@ -105,8 +105,9 @@ def isin(x, test_x, assume_unique=False, invert=False, name=None):
 
 
 def polar(abs, angle, name=None):
-    return binary(lambda r, th: (r * jnp.cos(th)).astype(jnp.float32)
-                  + 1j * (r * jnp.sin(th)).astype(jnp.float32),
+    # complex dtype follows the input (complex128 for float64 inputs)
+    return binary(lambda r, th: jax.lax.complex(r * jnp.cos(th),
+                                                r * jnp.sin(th)),
                   abs, angle, "polar")
 
 
@@ -299,17 +300,22 @@ def mode(x, axis=-1, keepdim=False, name=None):
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
+    from ..framework.dtype import to_jax_dtype
+
     x = ensure_tensor(x)
     ax = axis if axis is not None else None
+    idt = to_jax_dtype(dtype)  # reference honors 'int32'/'int64' for indices
     if ax is None:
         flat = unary(lambda v: jnp.minimum.accumulate(v.reshape(-1)), x,
                      "cummin")
         vals = flat
-        idx_f = unary(lambda v: _cummin_idx(v.reshape(-1)), x, "cummin_idx")
+        idx_f = unary(lambda v: _cummin_idx(v.reshape(-1)).astype(idt), x,
+                      "cummin_idx")
     else:
         vals = unary(lambda v: jnp.minimum.accumulate(v, axis=ax), x,
                      "cummin")
-        idx_f = unary(lambda v: _cummin_idx(v, ax), x, "cummin_idx")
+        idx_f = unary(lambda v: _cummin_idx(v, ax).astype(idt), x,
+                      "cummin_idx")
     idx_f.stop_gradient = True
     return vals, idx_f
 

@@ -2,12 +2,29 @@
 
 Reference parity: the CUDA flash-attn kernel the reference dispatches to
 (paddle/phi/kernels/gpu/flash_attn_kernel.cu, declared in
-paddle/phi/kernels/flash_attn_kernel.h). TPU-first design: an
-online-softmax tiled kernel over the MXU with fp32 accumulation and LSE
-residuals, plus the flash-attention-2 backward decomposition (one kernel
-for dQ, one for dK/dV), mapped onto pallas grids
-(/opt/skills/guides/pallas_guide.md). Off-TPU the same kernels run in
-pallas interpret mode, so CPU tests exercise the real kernel code.
+paddle/phi/kernels/flash_attn_kernel.h). TPU-first design, two paths:
+
+* **Single-block path** (seq <= 1024): the whole row of scores fits one
+  VMEM tile, so forward is an exact (non-online) softmax fused into one
+  grid step per batch*head, and backward is one fused step that
+  recomputes the softmax in-register — no LSE or delta tensors ever
+  touch HBM. This is the training hot path (seq 1024-class models).
+* **Tiled path** (longer seq): online-softmax forward with LSE
+  residuals, and a *single-pass* fused backward: one sweep of the
+  (q-block, k-block) grid computes dQ (fp32 scratch, resident per
+  q-row), dK/dV (fp32 HBM accumulators via input_output_aliases), and
+  delta (in-kernel from dO·O) — where the classic FA2 decomposition
+  runs two sweeps and recomputes the score / dO·V^T matmuls (the
+  MXU-unfriendly d=64 contractions) twice.
+
+The TPU pipeline semantics these rely on were validated empirically:
+output blocks with a constant index stay resident in VMEM and can be
+read back for accumulation (both compiled and interpret mode), while
+revisited aliased blocks round-trip through HBM correctly only in
+compiled mode — so in interpret mode (CPU tests) the tiled backward
+runs the same kernel body in a per-q-row loop, threading the dK/dV
+accumulators through as aliased call inputs (each block visited once
+per call, which interpret mode handles).
 
 Internal layout is [batch*heads, seq, head_dim]; the public entry takes
 the reference's [batch, seq, heads, head_dim].
@@ -29,8 +46,9 @@ except Exception:  # pragma: no cover - pallas ships with jax
     pltpu = None
     _HAS_PALLAS = False
 
-_LANES = 128
-_Z = np.int32(0)  # index-map zero: literal 0 traces as i64 under x64  # VPU lane count: scratch stats are kept lane-replicated
+_LANES = 128  # VPU lane count: row stats are kept lane-replicated in VMEM
+_Z = np.int32(0)  # index-map zero: literal 0 traces as i64 under x64
+_SINGLE_BLOCK_MAX = 1024  # whole-row tile above this busts VMEM (fp32 s)
 
 
 def is_available() -> bool:
@@ -55,6 +73,8 @@ def supports(q_shape, dtype, causal) -> bool:
     b, s, h, d = q_shape
     if d > 256:
         return False
+    if s <= _SINGLE_BLOCK_MAX:
+        return s % 16 == 0  # Mosaic pads sublane/lane tiles from 16
     return _pick_block(s) is not None
 
 
@@ -63,7 +83,7 @@ def _pick_block(seq: int):
     # ~1.7x faster than 512 (fewer grid steps, better MXU occupancy);
     # 2048 gains only ~5% more while quadrupling the fp32 score tile's
     # VMEM, so 1024 is the default ceiling.
-    for blk in (1024, 512, 256, 128, 64, 32, 16, 8):
+    for blk in (1024, 512, 256, 128):
         if seq % blk == 0:
             return blk
     return None
@@ -82,8 +102,90 @@ def _dot(a, b, contract):
                                precision=prec)
 
 
+def _causal_mask(s, row0, col0, bq, bk):
+    row = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(row >= col, s, -jnp.inf)
+
+
 # ---------------------------------------------------------------------------
-# forward
+# single-block path: whole sequence in one tile, grid (bh,)
+# ---------------------------------------------------------------------------
+
+def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal):
+    q = q_ref[0]                                         # [sq, d]
+    k = k_ref[0]
+    v = v_ref[0]
+    s = _dot(q, k, ((1,), (1,))) * scale                 # [sq, sk] fp32
+    if causal:
+        s = _causal_mask(s, 0, 0, q.shape[0], k.shape[0])
+    m = jnp.max(s, axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=1, keepdims=True)
+    o = _dot((p / l).astype(v.dtype), v, ((1,), (0,)))   # [sq, d]
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _bwd_single_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
+                       *, scale, causal):
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = _dot(q, k, ((1,), (1,))) * scale                 # [sq, sk] fp32
+    if causal:
+        s = _causal_mask(s, 0, 0, q.shape[0], k.shape[0])
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=1, keepdims=True)            # exact softmax
+    pc = p.astype(do.dtype)
+    dv = _dot(pc, do, ((0,), (0,)))                      # [sk, d]
+    dp = _dot(do, v, ((1,), (1,)))                       # [sq, sk] fp32
+    delta = jnp.sum(p * dp, axis=1, keepdims=True)       # = rowsum(do*o)
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    dq = _dot(ds, k, ((1,), (0,)))                       # [sq, d]
+    dk = _dot(ds, q, ((0,), (0,)))                       # [sk, d]
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fwd_single(q, k, v, scale, causal, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    spec_q = pl.BlockSpec((1, sq, d), lambda b: (b, _Z, _Z))
+    spec_k = pl.BlockSpec((1, sk, d), lambda b: (b, _Z, _Z))
+    return pl.pallas_call(
+        functools.partial(_fwd_single_kernel, scale=scale, causal=causal),
+        grid=(bh,),
+        in_specs=[spec_q, spec_k, spec_k],
+        out_specs=spec_q,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _bwd_single(q, k, v, do, scale, causal, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    spec_q = pl.BlockSpec((1, sq, d), lambda b: (b, _Z, _Z))
+    spec_k = pl.BlockSpec((1, sk, d), lambda b: (b, _Z, _Z))
+    return pl.pallas_call(
+        functools.partial(_bwd_single_kernel, scale=scale, causal=causal),
+        grid=(bh,),
+        in_specs=[spec_q, spec_k, spec_k, spec_q],
+        out_specs=[spec_q, spec_k, spec_k],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do)
+
+
+# ---------------------------------------------------------------------------
+# tiled path: online-softmax forward (grid bh x qi x ki)
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
@@ -108,11 +210,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         v = v_ref[0]
         s = _dot(q, k, ((1,), (1,))) * scale   # [bq, bk]
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, -jnp.inf)
+            s = _causal_mask(s, qi * block_q, ki * block_k, block_q, block_k)
         m_prev = m_ref[...]                              # [bq, LANES]
         l_prev = l_ref[...]
         m_cur = jnp.max(s, axis=1, keepdims=True)        # [bq, 1]
@@ -169,136 +267,128 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 # ---------------------------------------------------------------------------
-# backward: dQ kernel (grid bh × qi × ki), dK/dV kernel (grid bh × ki × qi)
+# tiled path: fused single-pass backward (grid bh x qi x ki)
+#
+# dQ accumulates in fp32 scratch (its block index is constant over the
+# inner ki sweep, so the scratch is flushed once per q-row). dK/dV
+# accumulate in fp32 HBM buffers passed as aliased inputs — their blocks
+# are revisited once per outer qi step, a full sweep apart, which the
+# compiled pipeline handles (write-back completes long before the next
+# visit's prefetch). delta (= rowsum(dO*O)) is computed in-kernel at
+# ki == 0, so no [bh, sq, LANES] delta tensor is ever materialized.
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, block_q, block_k):
-    qi = pl.program_id(1)
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                      dki_ref, dvi_ref, dq_ref, dk_ref, dv_ref,
+                      dq_acc, delta_ref,
+                      *, scale, causal, block_q, block_k, qi_base):
+    qi = qi_base + pl.program_id(1)
     ki = pl.program_id(2)
     num_k = pl.num_programs(2)
 
     @pl.when(ki == 0)
     def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        delta_ref[...] = jnp.broadcast_to(
+            jnp.sum(do * o, axis=-1, keepdims=True), delta_ref.shape)
 
     active = (ki * block_k <= qi * block_q + block_q - 1) if causal else ki >= 0
 
+    # pass the accumulators through unconditionally (skipped causal blocks
+    # must still round-trip their current value)
+    dk_ref[0] = dki_ref[0]
+    dv_ref[0] = dvi_ref[0]
+
     @pl.when(active)
     def _step():
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)               # [bq, d]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]                          # [bq, 1]
-        delta = delta_ref[0][:, :1]                      # [bq, 1]
-        s = _dot(q, k, ((1,), (1,))) * scale
+        delta = delta_ref[...][:, :1]                    # [bq, 1]
+        s = _dot(q, k, ((1,), (1,))) * scale             # [bq, bk] fp32
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, -jnp.inf)
+            s = _causal_mask(s, qi * block_q, ki * block_k, block_q, block_k)
         p = jnp.exp(s - lse)                             # [bq, bk]
-        dp = _dot(do.astype(v.dtype), v, ((1,), (1,)))          # [bq, bk]
-        ds = p * (dp - delta) * scale
-        acc_ref[...] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))          # [bq, d]
+        pc = p.astype(do.dtype)
+        dv_ref[0] += _dot(pc, do, ((0,), (0,)))          # [bk, d]
+        dp = _dot(do, v, ((1,), (1,)))                   # [bq, bk] fp32
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_ref[0] += _dot(ds, q, ((0,), (0,)))           # [bk, d]
+        dq_acc[...] += _dot(ds, k, ((1,), (0,)))         # [bq, d]
 
     @pl.when(ki == num_k - 1)
     def _finish():
-        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, block_q, block_k):
-    ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    num_q = pl.num_programs(2)
-
-    @pl.when(qi == 0)
-    def _init():
-        dk_acc[...] = jnp.zeros_like(dk_acc)
-        dv_acc[...] = jnp.zeros_like(dv_acc)
-
-    active = (qi * block_q + block_q - 1 >= ki * block_k) if causal else qi >= 0
-
-    @pl.when(active)
-    def _step():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]                          # [bq, 1]
-        delta = delta_ref[0][:, :1]                      # [bq, 1]
-        s = _dot(q, k, ((1,), (1,))) * scale   # [bq, bk]
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, -jnp.inf)
-        p = jnp.exp(s - lse)                              # [bq, bk]
-        dv_acc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))           # [bk, d]
-        dp = _dot(do.astype(v.dtype), v, ((1,), (1,)))           # [bq, bk]
-        ds = p * (dp - delta) * scale                     # [bq, bk]
-        dk_acc[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))           # [bk, d]
-
-    @pl.when(qi == num_q - 1)
-    def _finish():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+def _bwd_fused_call(q, k, v, do, out, lse, dk_acc, dv_acc, scale, causal,
+                    block_q, block_k, num_q, qi_base, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    # q/do/out/lse arrive pre-sliced to the processed rows (the interpret
+    # loop passes one q-row per call), so their specs always index from 0;
+    # qi_base only offsets the causal mask inside the kernel.
+    spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _Z))
+    spec_k = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, _Z))
+    spec_lse = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, _Z))
+    kern = functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k,
+                             qi_base=qi_base)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, num_q, sk // block_k),
+        in_specs=[spec_q, spec_k, spec_k, spec_q, spec_q, spec_lse,
+                  spec_k, spec_k],
+        out_specs=[spec_q, spec_k, spec_k],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, num_q * block_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        # dk/dv accumulators alias their inputs (positions 6, 7 -> 1, 2)
+        input_output_aliases={6: 1, 7: 2},
+        interpret=interpret,
+    )(q, k, v, do, out, lse, dk_acc, dv_acc)
 
 
 def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    delta = jnp.broadcast_to(
-        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                axis=-1, keepdims=True), (bh, sq, _LANES))  # lane-replicated
-
-    q_spec_qk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _Z))
-    k_spec_qk = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, _Z))
-    row_spec_qk = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, _Z))
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(bh, sq // block_q, sk // block_k),
-        in_specs=[q_spec_qk, k_spec_qk, k_spec_qk, q_spec_qk,
-                  row_spec_qk, row_spec_qk],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _Z)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    # dk/dv grid: ki outer, qi inner
-    q_spec_kq = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, _Z))
-    k_spec_kq = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, _Z))
-    row_spec_kq = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, _Z))
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(bh, sk // block_k, sq // block_q),
-        in_specs=[q_spec_kq, k_spec_kq, k_spec_kq, q_spec_kq,
-                  row_spec_kq, row_spec_kq],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, _Z)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, _Z)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
-        ],
-        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
-                        pltpu.VMEM((block_k, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    num_q = sq // block_q
+    dk_acc = jnp.zeros((bh, sk, d), jnp.float32)
+    dv_acc = jnp.zeros((bh, sk, d), jnp.float32)
+    if not interpret:
+        dq, dk_acc, dv_acc = _bwd_fused_call(
+            q, k, v, do, out, lse, dk_acc, dv_acc, scale, causal,
+            block_q, block_k, num_q, 0, interpret)
+    else:
+        # interpret mode replays the revisited aliased blocks from the
+        # original input, so run one q-row per call and thread the
+        # accumulators through (each dk/dv block visited once per call).
+        dq_rows = []
+        for qi in range(num_q):
+            row = jax.lax.dynamic_slice_in_dim(q, qi * block_q, block_q, 1)
+            do_row = jax.lax.dynamic_slice_in_dim(do, qi * block_q, block_q, 1)
+            out_row = jax.lax.dynamic_slice_in_dim(out, qi * block_q, block_q, 1)
+            lse_row = jax.lax.dynamic_slice_in_dim(lse, qi * block_q, block_q, 1)
+            dq_row, dk_acc, dv_acc = _bwd_fused_call(
+                row, k, v, do_row, out_row, lse_row, dk_acc, dv_acc,
+                scale, causal, block_q, block_k, 1, qi, interpret)
+            dq_rows.append(dq_row)
+        dq = jnp.concatenate(dq_rows, axis=1)
+    return dq, dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
 
 
 # ---------------------------------------------------------------------------
-# custom_vjp wrapper + public entry
+# custom_vjp wrappers + public entry
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -322,6 +412,23 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_single(q, k, v, scale, causal, interpret):
+    return _fwd_single(q, k, v, scale, causal, interpret)
+
+
+def _flash_single_fwd(q, k, v, scale, causal, interpret):
+    return _fwd_single(q, k, v, scale, causal, interpret), (q, k, v)
+
+
+def _flash_single_bwd(scale, causal, interpret, res, do):
+    q, k, v = res
+    return _bwd_single(q, k, v, do, scale, causal, interpret)
+
+
+_flash_single.defvjp(_flash_single_fwd, _flash_single_bwd)
+
+
 def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
                     block_k=None, interpret=None):
     """q/k/v: [batch, seq, heads, head_dim] (reference layout). Returns the
@@ -333,12 +440,6 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
         raise ValueError("causal flash attention needs equal q/k seq lens")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    if block_q is None:
-        block_q = _pick_block(sq)
-    if block_k is None:
-        block_k = _pick_block(sk)
-    if block_q is None or block_k is None:
-        raise ValueError(f"unsupported seq lens ({sq}, {sk}) for flash blocks")
     if interpret is None:
         interpret = not _on_tpu()
 
@@ -346,6 +447,21 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, x.shape[-1])
 
     qb, kb, vb = to_bh(q, sq), to_bh(k, sk), to_bh(v, sk)
-    ob = _flash(qb, kb, vb, float(scale), bool(causal), int(block_q),
-                int(block_k), bool(interpret))
+
+    single = (sq <= _SINGLE_BLOCK_MAX and sk <= _SINGLE_BLOCK_MAX
+              and sq % 16 == 0 and sk % 16 == 0
+              and block_q is None and block_k is None)
+    if single:
+        ob = _flash_single(qb, kb, vb, float(scale), bool(causal),
+                           bool(interpret))
+    else:
+        if block_q is None:
+            block_q = _pick_block(sq)
+        if block_k is None:
+            block_k = _pick_block(sk)
+        if block_q is None or block_k is None:
+            raise ValueError(
+                f"unsupported seq lens ({sq}, {sk}) for flash blocks")
+        ob = _flash(qb, kb, vb, float(scale), bool(causal), int(block_q),
+                    int(block_k), bool(interpret))
     return jnp.transpose(ob.reshape(b, h, sq, d), (0, 2, 1, 3))

@@ -77,11 +77,13 @@ define_flag("FLAGS_fused_ce_chunks", 4,
             "token-chunk count for fused_linear_cross_entropy: logits are "
             "computed per chunk and discarded instead of materializing the "
             "full [tokens, vocab] fp32 matrix")
-define_flag("FLAGS_pallas_flash_min_seqlen", 16384,
+define_flag("FLAGS_pallas_flash_min_seqlen", 1024,
             "min seq len to route scaled_dot_product_attention to the "
-            "pallas flash kernel. Measured on v5e (gpt3-350m, bf16, d=64, "
-            "fwd+bwd, full model): with bf16 score storage (see "
-            "FLAGS_attention_fp32_scores) XLA attention beats the flash "
-            "kernel through seq 8192 (7293 vs 2482 tok/s at 8192); at "
-            "16384 the O(s^2) bf16 score matrix (8G/layer) OOMs 16G HBM "
-            "while the flash kernel trains (1126 tok/s)")
+            "pallas flash kernel. Measured on v5e (h16 d64 bf16, fwd+bwd "
+            "vs bf16-score XLA attention): the round-3 kernels (fused "
+            "single-block path at <=1024; single-pass fused backward "
+            "beyond) win from seq 1024 up (1.22x at 1024, 1.64x at 2048, "
+            "1.17x at 4096, 2.5x at 8192 — PERF.md round-3 A/B), and from "
+            "16384 the O(s^2) score matrix OOMs 16G HBM while the flash "
+            "kernel trains. Below 1024 XLA's fused softmax is fine and "
+            "the kernel is not plumbed for masks/dropout.")

@@ -52,6 +52,73 @@ class TestJacobianHessian:
         np.testing.assert_allclose(np.asarray(h._data), A, rtol=1e-4,
                                    atol=1e-5)
 
+    def test_jacobian_ys_form_lazy_object(self):
+        """Reference stable API (autograd/autograd.py:492): jacobian(ys, xs)
+        with a computed Tensor returns a lazy Jacobian object."""
+        x = paddle.to_tensor(np.array([1.0, 2.0]), dtype="float32")
+        x.stop_gradient = False
+        y = x * x * x
+        J = autograd.jacobian(y, x)
+        assert isinstance(J, autograd.Jacobian)
+        assert J.shape == (2, 2)
+        np.testing.assert_allclose(np.asarray(J[:]._data),
+                                   np.diag([3.0, 12.0]), rtol=1e-5)
+        # row caching: second access returns the same data
+        np.testing.assert_allclose(np.asarray(J[0]._data), [3.0, 0.0],
+                                   rtol=1e-5)
+
+    def test_jacobian_ys_form_tuple_xs(self):
+        x1 = paddle.to_tensor(np.array([1.0, 2.0, 3.0]), dtype="float32")
+        x2 = paddle.to_tensor(np.array([4.0, 5.0, 6.0]), dtype="float32")
+        x1.stop_gradient = False
+        x2.stop_gradient = False
+        y = x1 * 2.0 + x2 * 3.0
+        J = autograd.jacobian(y, (x1, x2))
+        assert isinstance(J, tuple) and len(J) == 2
+        np.testing.assert_allclose(np.asarray(J[0][:]._data),
+                                   2 * np.eye(3), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(J[1][:]._data),
+                                   3 * np.eye(3), rtol=1e-5)
+
+    def test_jacobian_ys_form_batched(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        x.stop_gradient = False
+        y = x * x
+        J = autograd.jacobian(y, x, batch_axis=0)
+        assert J.shape == (3, 2, 2)
+        got = np.asarray(J[:]._data)
+        for b in range(3):
+            np.testing.assert_allclose(
+                got[b], np.diag(2 * np.asarray(x._data)[b]), rtol=1e-5)
+
+    def test_jacobian_row_laziness(self):
+        """Accessing one row must evaluate only that row (reference:
+        lazy evaluation at row granularity with caching)."""
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0]), dtype="float32")
+        x.stop_gradient = False
+        y = x * x
+        J = autograd.jacobian(y, x)
+        row = J[1]
+        np.testing.assert_allclose(np.asarray(row._data), [0.0, 4.0, 0.0],
+                                   rtol=1e-5)
+        assert set(J._rows) == {1}
+        np.testing.assert_allclose(np.asarray(J[1, 1]._data), 4.0, rtol=1e-5)
+        assert set(J._rows) == {1}
+
+    def test_hessian_object_refuses_construction(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0]), dtype="float32")
+        x.stop_gradient = False
+        y = (x * x).sum()
+        with pytest.raises(NotImplementedError, match="hessian\\(func"):
+            autograd.Hessian(y, x)
+
+    def test_hessian_ys_form_raises_with_guidance(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0]), dtype="float32")
+        x.stop_gradient = False
+        y = (x * x).sum()
+        with pytest.raises(NotImplementedError, match="hessian\\(func, xs\\)"):
+            autograd.hessian(y, x)
+
     def test_jacobian_through_layers(self):
         import paddle_tpu.nn as nn
 

@@ -69,6 +69,27 @@ class TestFlashAttentionKernel:
         want = _ref(q, k, v, True, scale)
         assert float(jnp.max(jnp.abs(out - want))) < 2e-5
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_tiled_fused_backward_grads(self, causal):
+        """The single-pass fused backward (dK/dV HBM accumulators via
+        aliasing, in-kernel delta, qi_base causal offsets) — forced via
+        explicit blocks so the single-block path can't take it."""
+        q, k, v = _rand_qkv(b=1, s=256, h=2, d=32, seed=3)
+        scale = 1.0 / 32 ** 0.5
+
+        def loss_fa(q, k, v):
+            return jnp.sum(jnp.sin(fa.flash_attention(
+                q, k, v, causal=causal, scale=scale,
+                block_q=64, block_k=64, interpret=True)))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(_ref(q, k, v, causal, scale)))
+
+        got = jax.grad(loss_fa, (0, 1, 2))(q, k, v)
+        want = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            assert float(jnp.max(jnp.abs(g - w))) < 3e-4
+
     def test_bf16(self):
         q, k, v = _rand_qkv(dtype=jnp.bfloat16)
         out = fa.flash_attention(q, k, v, causal=True, interpret=True)

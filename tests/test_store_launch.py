@@ -170,3 +170,57 @@ class TestCommWatchdog:
             assert "slowpoke" in mgr.timed_out
         finally:
             mgr.shutdown()
+
+    def test_barrier_wait_is_watched(self, monkeypatch):
+        """A barrier whose device wait hangs must trip the watchdog
+        interrupt (the real wire-up, not just the manager in isolation)."""
+        import jax
+
+        from paddle_tpu.distributed import collective, comm_watchdog
+        from paddle_tpu.utils import flags
+
+        mgr = comm_watchdog.CommTaskManager(interval=0.05)
+        monkeypatch.setattr(comm_watchdog, "_manager", mgr)
+        monkeypatch.setattr(jax, "block_until_ready",
+                            lambda x: time.sleep(5))
+        flags.set_flags({"FLAGS_distributed_timeout_sec": 0.2})
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                collective.barrier()
+            assert any("barrier" in t for t in mgr.timed_out)
+        finally:
+            flags.set_flags({"FLAGS_distributed_timeout_sec": 1800})
+            mgr.shutdown()
+
+    def test_train_step_dispatch_is_watched(self, monkeypatch):
+        """A TrainStep whose jitted dispatch hangs must trip the watchdog."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.distributed import comm_watchdog
+        from paddle_tpu.utils import flags
+
+        mgr = comm_watchdog.CommTaskManager(interval=0.05)
+        monkeypatch.setattr(comm_watchdog, "_manager", mgr)
+
+        model = nn.Linear(4, 4)
+        opt = popt.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = TrainStep(model, lambda m, x: m(x).sum(), opt)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        step(x)  # compile normally first
+
+        def hang(*a, **kw):
+            time.sleep(5)
+
+        step._jitted = hang
+        flags.set_flags({"FLAGS_distributed_timeout_sec": 0.2})
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                step(x)
+            assert any("TrainStep" in t for t in mgr.timed_out)
+        finally:
+            flags.set_flags({"FLAGS_distributed_timeout_sec": 1800})
+            mgr.shutdown()
