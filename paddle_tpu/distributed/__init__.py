@@ -32,6 +32,8 @@ from .collective import (  # noqa: F401
     recv,
     isend,
     irecv,
+    P2POp,
+    batch_isend_irecv,
     p2p_permute,
     barrier,
     get_rank,

@@ -370,17 +370,124 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
     return out
 
 
+class _P2PTask:
+    """Completed-task handle (reference core.task): eager single-controller
+    p2p completes synchronously, so wait() is a no-op."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+# One FIFO mailbox per group (keyed by mesh identity + axes, holding a
+# strong mesh ref so id() can't be reused for a different mesh while
+# messages are pending). Single-controller semantics: EVERY group rank is
+# this process (the all_gather_object convention), so peer arguments are
+# range-validated routing metadata, not matching keys — a recv returns
+# the oldest unconsumed send in the group. For a symmetric SPMD program
+# (each rank sends to next / receives from prev) this is exactly the
+# value the real exchange would deliver, since all ranks run this same
+# code on the same process-local data. destroy_process_group drains it.
+_p2p_mailbox: dict[tuple, tuple] = {}
+
+
+def _p2p_box(group):
+    from collections import deque
+
+    key = (id(group.mesh), group.axes)
+    entry = _p2p_mailbox.get(key)
+    if entry is None or entry[0] is not group.mesh:
+        entry = (group.mesh, deque())
+        _p2p_mailbox[key] = entry
+    return entry[1]
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise RuntimeError(
-        "point-to-point send/recv only exist inside traced pipeline stages "
-        "on TPU (lax.ppermute over the pp axis); use "
-        "paddle_tpu.distributed.fleet.PipelineParallel or p2p_permute()"
-    )
+    """Point-to-point send (reference communication/send.py:27).
+
+    Eager single-controller: the tensor is enqueued to the group's
+    in-process mailbox; `recv` dequeues it (see _p2p_mailbox). Inside
+    traced code use `p2p_permute` (lax.ppermute) — XLA has no
+    rank-conditional send."""
+    group = group or _world_group()
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    if isinstance(t._data, jax.core.Tracer):
+        raise RuntimeError(
+            "send() inside traced code is not expressible (per-rank "
+            "branches don't trace); use p2p_permute() / the pipeline ring")
+    if not 0 <= dst < group.nranks:
+        raise ValueError(f"dst {dst} out of range for {group!r}")
+    _p2p_box(group).append(t._data)
+    return _P2PTask()
 
 
-recv = send
+def recv(tensor, src=0, group=None, sync_op=True):
+    """Point-to-point receive (reference communication/recv.py:27): fills
+    `tensor` in place with the group's oldest unconsumed `send`. Shape
+    and dtype must match the sent tensor (reference send/recv metadata
+    contract)."""
+    group = group or _world_group()
+    t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+    if isinstance(t._data, jax.core.Tracer):
+        raise RuntimeError(
+            "recv() inside traced code is not expressible; use "
+            "p2p_permute() / the pipeline ring")
+    if not 0 <= src < group.nranks:
+        raise ValueError(f"src {src} out of range for {group!r}")
+    box = _p2p_box(group)
+    if not box:
+        raise RuntimeError(
+            f"recv(src={src}): no matching send in flight (single-"
+            "controller p2p completes in-process; send must happen first)")
+    data = box.popleft()
+    if tuple(data.shape) != tuple(t._data.shape):
+        raise ValueError(
+            f"recv buffer shape {tuple(t._data.shape)} != sent shape "
+            f"{tuple(data.shape)}")
+    if data.dtype != t._data.dtype:
+        raise ValueError(
+            f"recv buffer dtype {t._data.dtype} != sent dtype "
+            f"{data.dtype} (send/recv metadata must match)")
+    t._inplace_from(Tensor._wrap(data))
+    return _P2PTask()
+
+
 isend = send
-irecv = send
+irecv = recv
+
+
+class P2POp:
+    """Batched p2p descriptor (reference communication/batch_isend_irecv.py:34)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError(
+                "op must be paddle.distributed.isend or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of P2POps (reference batch_isend_irecv.py:132).
+    Sends run before receives so a rank's paired ops can't deadlock —
+    the single-controller analog of the reference's grouped NCCL calls."""
+    if not p2p_op_list:
+        raise ValueError("p2p_op_list must not be empty")
+    for p in p2p_op_list:
+        if not isinstance(p, P2POp):
+            raise TypeError("batch_isend_irecv takes a list of P2POp")
+    tasks = []
+    sends = [p for p in p2p_op_list if p.op in (send, isend)]
+    recvs = [p for p in p2p_op_list if p.op in (recv, irecv)]
+    for p in sends:
+        tasks.append(p.op(p.tensor, p.peer, group=p.group))
+    for p in recvs:
+        tasks.append(p.op(p.tensor, p.peer, group=p.group))
+    return tasks
 
 
 def p2p_permute(tensor, perm, group=None):
@@ -443,3 +550,4 @@ def is_initialized() -> bool:
 def destroy_process_group(group=None):
     global _default_group
     _default_group = None
+    _p2p_mailbox.clear()   # drop pending p2p messages (and mesh refs)

@@ -108,10 +108,18 @@ def recompute(function, *args, **kwargs):
     preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # traced RNG
     policy = kwargs.pop("policy", None)
     if isinstance(policy, str):
-        policy = {
-            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "nothing": None,
-        }[policy]
+        try:
+            policy = {
+                "dots":
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                "nothing": None,
+                "full": None,  # alias: save nothing == full recompute
+            }[policy]
+        except KeyError:
+            raise ValueError(
+                f"unknown recompute policy {policy!r}; use 'dots', "
+                "'nothing'/'full', or a jax checkpoint policy callable"
+            ) from None
     if kwargs:
         raise TypeError(f"unsupported recompute kwargs: {sorted(kwargs)}")
 

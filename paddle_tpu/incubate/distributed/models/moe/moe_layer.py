@@ -166,11 +166,79 @@ class MoELayer(nn.Layer):
         return y
 
 
+def _default_group():
+    """World group when the distributed env is up, else None (count checks
+    that need a group are skipped outside a mesh)."""
+    from .....distributed import env as denv
+
+    if not denv.is_initialized():
+        return None
+    from .....distributed.collective import get_group
+
+    return get_group()
+
+
+def _validated_counts(local_count, global_count, name, x=None, group=None):
+    """The reference kernels move count-shaped ragged buffers
+    (distributed/utils/moe_utils.py global_scatter/global_gather). The XLA
+    all_to_all path is equal-split, so the counts are VERIFIED rather than
+    silently ignored: uniform counts run (they describe exactly the
+    equal-split exchange), ragged counts raise with guidance to the
+    TPU-native dense-capacity einsum dispatch (MoELayer), which is this
+    framework's ragged-routing mechanism (static shapes, GSPMD all-to-all).
+    """
+    import numpy as np
+
+    counts = []
+    for c in (local_count, global_count):
+        if c is None:
+            counts.append(None)
+            continue
+        data = c._data if isinstance(c, Tensor) else c
+        if isinstance(data, jax.core.Tracer):
+            raise NotImplementedError(
+                f"{name} with traced counts cannot be validated; use "
+                "MoELayer's dense capacity dispatch inside jit")
+        counts.append(np.asarray(data))
+    lc, gc = counts
+    if lc is not None and gc is not None and lc.sum() != gc.sum():
+        raise ValueError(
+            f"{name}: local_count total ({int(lc.sum())}) != global_count "
+            f"total ({int(gc.sum())}) — the exchange would lose tokens")
+    for label, c in (("local_count", lc), ("global_count", gc)):
+        if c is not None and len(set(c.tolist())) > 1:
+            raise NotImplementedError(
+                f"{name} with ragged {label} ({c.tolist()}) is not "
+                "supported on the XLA equal-split all_to_all path; route "
+                "tokens with MoELayer's capacity-slot einsum dispatch "
+                "(the TPU-native ragged mechanism) or pad buckets to "
+                "uniform counts")
+    # counts must actually describe the exchange (not just be uniform):
+    # length a multiple of nranks (n_expert * world entries) and totals
+    # covering x's rows (global leading dim = nranks * per-rank rows)
+    if group is not None and lc is not None:
+        nranks = group.nranks
+        if lc.size % nranks:
+            raise ValueError(
+                f"{name}: counts length {lc.size} is not a multiple of "
+                f"the group's nranks ({nranks})")
+        if x is not None:
+            rows = (x._data if isinstance(x, Tensor)
+                    else jnp.asarray(x)).shape[0]
+            if int(lc.sum()) * nranks != rows:
+                raise ValueError(
+                    f"{name}: counts route {int(lc.sum())} rows/rank x "
+                    f"{nranks} ranks but x has {rows} rows")
+
+
 def global_scatter(x, local_count, global_count, group=None):
-    """Reference moe_layer.py:119 — alltoall token push. The einsum MoE path
-    does not need it; kept for API parity with equal splits."""
+    """Reference moe_layer.py:119 — alltoall token push. Counts are
+    validated (uniform -> equal-split all_to_all; ragged -> error), never
+    silently ignored."""
     from .....distributed.collective import alltoall_single
 
+    _validated_counts(local_count, global_count, "global_scatter", x=x,
+                      group=group or _default_group())
     out = Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor)
                                 else jnp.asarray(x)))
     alltoall_single(out, x, group=group)
@@ -178,9 +246,12 @@ def global_scatter(x, local_count, global_count, group=None):
 
 
 def global_gather(x, local_count, global_count, group=None):
-    """Reference moe_layer.py:140 — inverse alltoall pull (equal splits)."""
+    """Reference moe_layer.py:140 — inverse alltoall pull (counts
+    validated, equal splits only; see global_scatter)."""
     from .....distributed.collective import alltoall_single
 
+    _validated_counts(local_count, global_count, "global_gather", x=x,
+                      group=group or _default_group())
     out = Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor)
                                 else jnp.asarray(x)))
     alltoall_single(out, x, group=group)

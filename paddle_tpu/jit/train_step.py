@@ -60,34 +60,6 @@ def _unwrap_optimizer(opt):
     return opt
 
 
-class _OptimizerState:
-    """Snapshot/inject the mutable numeric state of an Optimizer."""
-
-    def __init__(self, optimizer):
-        self.opt = optimizer
-
-    def extract(self):
-        opt = self.opt
-        accum = {
-            name: {k: v for k, v in per.items()}
-            for name, per in opt._accumulators.items()
-        }
-        master = dict(opt._master_weights)
-        return {
-            "accumulators": accum,
-            "master_weights": master,
-            "step": jnp.asarray(opt._step_count, jnp.int32),
-        }
-
-    def inject(self, state):
-        opt = self.opt
-        for name, per in state["accumulators"].items():
-            opt._accumulators.setdefault(name, {}).update(per)
-        opt._master_weights.update(state["master_weights"])
-        opt._step_count = state["step"]
-
-
-
 class TrainStep:
     """Compile `(batch) -> loss` + backward + optimizer into one XLA program.
 
@@ -108,7 +80,7 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer             # outer (may be a wrapper)
         self._opt = _unwrap_optimizer(optimizer)  # state owner
-        self._opt_state = _OptimizerState(self._opt)
+
         self._params = None   # resolved lazily: optimizer may create accums on 1st step
         self._buffers = None
         self._jitted = None
@@ -129,7 +101,7 @@ class TrainStep:
         return {
             "params": [p._data for p in self._params],
             "buffers": [b._data for b in self._buffers],
-            "opt": self._opt_state.extract(),
+            "opt": self._opt.opt_state_pytree(),
             "rng_offset": jnp.asarray(_random.default_generator()._offset, jnp.int64
                                       if jax.config.jax_enable_x64 else jnp.int32),
         }
@@ -139,7 +111,7 @@ class TrainStep:
             p._data = d
         for b, d in zip(self._buffers, state["buffers"]):
             b._data = d
-        self._opt_state.inject(state["opt"])
+        self._opt.load_opt_state_pytree(state["opt"])
         _random.default_generator()._offset = state["rng_offset"]
 
     # -- the traced step ------------------------------------------------
@@ -233,13 +205,17 @@ class TrainStep:
             else:
                 loss = self.loss_fn(self.model, *batch_t)
                 loss.backward()
-            # freeze lr at the traced scalar for this step
-            prev_get_lr = inner.get_lr
-            inner.get_lr = lambda: lr
-            try:
+            # freeze lr at the traced scalar for this step (declared
+            # protocol: Optimizer.get_lr honors _lr_override)
+            with inner.lr_frozen(lr):
+                if inner.get_lr() is not lr:
+                    raise RuntimeError(
+                        f"{type(inner).__name__}.get_lr() ignores "
+                        "_lr_override — it would bake a stale host lr "
+                        "into the compiled step; honor the traced-step "
+                        "protocol (call super().get_lr() or check "
+                        "self._lr_override)")
                 opt.step()
-            finally:
-                inner.get_lr = prev_get_lr
             opt.clear_grad()
             new_state = _repin(self._extract_state())
             return loss._data, new_state
@@ -258,7 +234,8 @@ class TrainStep:
             if isinstance(gen._offset, jax.Array):
                 gen._offset = int(gen._offset)
             # run optimizer accumulator creation eagerly once so the state
-            # pytree is complete before tracing
+            # pytree is complete before tracing (Optimizer.warmup_state —
+            # the declared dry-run protocol)
             self._warmup_accumulators()
             self._build(batch_data)
         state = self._extract_state()
@@ -287,30 +264,16 @@ class TrainStep:
         return Tensor._wrap(loss_data)
 
     def _warmup_accumulators(self):
-        """Create optimizer accumulators at their init values without mutating
-        anything: run each param's update op once with writes patched out, so
-        `_get_accumulator` creation fires but no state changes."""
+        """Complete the optimizer state pytree before tracing via the
+        declared Optimizer.warmup_state protocol (no monkeypatching — a
+        subclass overriding step()/_append_optimize_op keeps working as
+        long as it honors the traced-step protocol, optimizer.py)."""
         self._resolve_slots()
-        opt = self._opt
-        for p in self._params:
-            if opt._use_master(p):
-                opt._master_weight(p)
-        saved_set = opt._set_accumulator
-        saved_write = opt._write_param
-        opt._set_accumulator = lambda *a, **k: None
-        opt._write_param = lambda *a, **k: None
-        try:
-            for p in self._params:
-                pv = opt._param_value(p)
-                g = jnp.zeros(pv.shape, pv.dtype)
-                opt._append_optimize_op(p, g)
-        finally:
-            opt._set_accumulator = saved_set
-            opt._write_param = saved_write
+        self._opt.warmup_state(self._params)
         # sharded-optimizer wrappers place their state layouts now so the
         # first compile already sees them (ZeRO-1 as sharding annotations)
         outer = self.optimizer
-        while outer is not opt:
+        while outer is not self._opt:
             if hasattr(outer, "reshard_state"):
                 outer.reshard_state()
-            outer = getattr(outer, "_inner_opt", opt)
+            outer = getattr(outer, "_inner_opt", self._opt)

@@ -8,6 +8,8 @@ parameters too.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager as _contextmanager
+
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
@@ -61,9 +63,15 @@ class Optimizer:
         self._accumulators = {}  # name -> {param_name: jax array}
         self._master_weights = {}  # param_name -> fp32 jax array
         self._step_count = 0
+        # traced-step protocol fields (see the "traced-step protocol"
+        # section): a frozen lr tracer and the dry-run switch
+        self._lr_override = None
+        self._dry_run = False
 
     # -- lr ----------------------------------------------------------------
     def get_lr(self):
+        if self._lr_override is not None:
+            return self._lr_override
         if isinstance(self._learning_rate, LRScheduler):
             return self._learning_rate.get_lr()
         return float(self._learning_rate)
@@ -86,6 +94,8 @@ class Optimizer:
         return store[key]
 
     def _set_accumulator(self, name, param, value):
+        if self._dry_run:
+            return
         key = param.name or str(id(param))
         self._accumulators[name][key] = value
 
@@ -99,6 +109,8 @@ class Optimizer:
         return self._master_weights[key]
 
     def _write_param(self, param, new_value_f32_or_native):
+        if self._dry_run:
+            return
         key = param.name or str(id(param))
         if self._use_master(param):
             self._master_weights[key] = new_value_f32_or_native
@@ -192,6 +204,64 @@ class Optimizer:
                     p.clear_grad()
 
     clear_gradients = clear_grad
+
+    # -- traced-step protocol (the TrainStep contract) ----------------------
+    # TrainStep compiles step() into one XLA program by threading ALL
+    # numeric optimizer state through the traced function. The contract a
+    # subclass must keep for that to work:
+    #   * every mutable numeric value lives in `_accumulators`,
+    #     `_master_weights`, or `_step_count` (exposed by
+    #     `opt_state_pytree`); NAdam's mu_product shows the pattern for
+    #     extra scalars — store them in the accumulator dicts.
+    #   * `warmup_state(params)` must create every accumulator the real
+    #     step will touch, without changing values — the default runs the
+    #     update ops with writes disabled (`_dry_run`), so subclasses that
+    #     use `_get_accumulator`/`_set_accumulator`/`_write_param` get it
+    #     for free. Override it only for exotic state.
+    #   * `get_lr()` must respect `_lr_override` (call super or check the
+    #     field) so the step's lr can be a traced input.
+
+    def opt_state_pytree(self):
+        """The numeric state threaded through a compiled train step."""
+        accum = {
+            name: {k: v for k, v in per.items()}
+            for name, per in self._accumulators.items()
+        }
+        return {
+            "accumulators": accum,
+            "master_weights": dict(self._master_weights),
+            "step": jnp.asarray(self._step_count, jnp.int32),
+        }
+
+    def load_opt_state_pytree(self, state):
+        for name, per in state["accumulators"].items():
+            self._accumulators.setdefault(name, {}).update(per)
+        self._master_weights.update(state["master_weights"])
+        self._step_count = state["step"]
+
+    def warmup_state(self, params):
+        """Create (at init values) every accumulator/master weight that
+        step() will use for `params`, mutating nothing else."""
+        self._dry_run = True
+        try:
+            for p in params:
+                if self._use_master(p):
+                    self._master_weight(p)
+                pv = self._param_value(p)
+                self._append_optimize_op(p, jnp.zeros(pv.shape, pv.dtype))
+        finally:
+            self._dry_run = False
+
+    @_contextmanager
+    def lr_frozen(self, lr):
+        """Context: step() sees `lr` (typically a traced scalar) from
+        get_lr() — the reference's LRScheduler stays host-side."""
+        prev = self._lr_override
+        self._lr_override = lr
+        try:
+            yield
+        finally:
+            self._lr_override = prev
 
     # -- state dict -----------------------------------------------------------
     def state_dict(self):

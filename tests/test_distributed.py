@@ -110,6 +110,65 @@ class TestCollectives:
         dist.barrier()
 
 
+class TestP2P:
+    """send/recv/batch_isend_irecv (reference process_group.h:213,375 —
+    first-class Send and Recv). Single-controller: the pair completes
+    through the in-process mailbox, FIFO per sender."""
+
+    def test_send_recv_roundtrip(self):
+        mesh = Mesh(np.asarray(cpu8()), ("dp",))
+        denv.set_mesh(mesh)
+        src = paddle.to_tensor([1.0, 2.0, 3.0])
+        dist.send(src, dst=1)
+        buf = paddle.to_tensor([0.0, 0.0, 0.0])
+        task = dist.recv(buf, src=0)
+        task.wait()
+        np.testing.assert_allclose(buf.numpy(), [1.0, 2.0, 3.0])
+
+    def test_recv_without_send_raises(self):
+        mesh = Mesh(np.asarray(cpu8()), ("dp",))
+        denv.set_mesh(mesh)
+        buf = paddle.to_tensor([0.0])
+        with pytest.raises(RuntimeError, match="no matching send"):
+            dist.recv(buf, src=3)
+
+    def test_fifo_per_sender(self):
+        mesh = Mesh(np.asarray(cpu8()), ("dp",))
+        denv.set_mesh(mesh)
+        dist.send(paddle.to_tensor([1.0]), dst=1)
+        dist.send(paddle.to_tensor([2.0]), dst=1)
+        a = paddle.to_tensor([0.0])
+        b = paddle.to_tensor([0.0])
+        dist.recv(a, src=0)
+        dist.recv(b, src=0)
+        assert float(a.numpy()[0]) == 1.0 and float(b.numpy()[0]) == 2.0
+
+    def test_shape_mismatch_raises(self):
+        mesh = Mesh(np.asarray(cpu8()), ("dp",))
+        denv.set_mesh(mesh)
+        dist.send(paddle.to_tensor([1.0, 2.0]), dst=1)
+        with pytest.raises(ValueError, match="shape"):
+            dist.recv(paddle.to_tensor([0.0]), src=0)
+
+    def test_batch_isend_irecv(self):
+        mesh = Mesh(np.asarray(cpu8()), ("dp",))
+        denv.set_mesh(mesh)
+        out = paddle.to_tensor([0.0, 0.0])
+        ops = [
+            dist.P2POp(dist.irecv, out, 0),   # recv listed first on purpose
+            dist.P2POp(dist.isend, paddle.to_tensor([5.0, 6.0]), 1),
+        ]
+        tasks = dist.batch_isend_irecv(ops)
+        assert all(t.is_completed() for t in tasks)
+        np.testing.assert_allclose(out.numpy(), [5.0, 6.0])
+
+    def test_batch_rejects_non_p2pop(self):
+        with pytest.raises(TypeError):
+            dist.batch_isend_irecv([object()])
+        with pytest.raises(ValueError):
+            dist.batch_isend_irecv([])
+
+
 class TestTopology:
     def test_comm_topology(self):
         topo = CommunicateTopology(dims=(2, 2, 1, 1, 2))

@@ -52,6 +52,61 @@ class TestGating:
         assert float(jnp.sum(dispatch[:, 0].astype(jnp.int32))) == 2
 
 
+class TestGlobalScatterGather:
+    def test_ragged_counts_raise(self):
+        """Counts must never be silently ignored (reference
+        moe_utils.global_scatter moves count-shaped ragged buffers)."""
+        from paddle_tpu.incubate.distributed.models.moe import moe_layer
+
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        ragged = paddle.to_tensor(np.array([3, 1], np.int64))
+        with pytest.raises(NotImplementedError, match="ragged"):
+            moe_layer.global_scatter(x, ragged, ragged)
+        with pytest.raises(NotImplementedError, match="ragged"):
+            moe_layer.global_gather(x, ragged, ragged)
+
+    def test_mismatched_totals_raise(self):
+        from paddle_tpu.incubate.distributed.models.moe import moe_layer
+
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        lc = paddle.to_tensor(np.array([2, 2], np.int64))
+        gc = paddle.to_tensor(np.array([1, 1], np.int64))
+        with pytest.raises(ValueError, match="lose tokens"):
+            moe_layer.global_scatter(x, lc, gc)
+
+    def test_uniform_counts_exchange(self):
+        """Uniform counts describe exactly the equal-split all_to_all."""
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.incubate.distributed.models.moe import moe_layer
+
+        mesh = denv.build_mesh({"ep": 2}, devices=jax.devices("cpu")[:2])
+        prev = denv.get_mesh() if denv.is_initialized() else None
+        denv.set_mesh(mesh)
+        try:
+            from paddle_tpu.distributed.collective import new_group
+
+            grp = new_group(axes=["ep"], mesh=mesh)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            x = paddle.to_tensor(
+                np.arange(8, dtype=np.float32).reshape(4, 2))
+            # rank-sharded leading dim (the per-rank concat layout)
+            x._data = jax.device_put(x._data,
+                                     NamedSharding(mesh, P("ep", None)))
+            uniform = paddle.to_tensor(np.array([1, 1], np.int64))
+            out = moe_layer.global_scatter(x, uniform, uniform, group=grp)
+            # all_to_all swaps the middle blocks (rank-major regrouping)
+            want = np.asarray(x._data).reshape(2, 2, 2).swapaxes(0, 1) \
+                .reshape(4, 2)
+            np.testing.assert_allclose(np.asarray(out._data), want)
+            back = moe_layer.global_gather(out, uniform, uniform, group=grp)
+            np.testing.assert_allclose(np.asarray(back._data),
+                                       np.asarray(x._data))
+        finally:
+            if prev is not None:
+                denv.set_mesh(prev)
+
+
 class TestMoELayer:
     def test_identical_experts_match_dense(self):
         """All experts share weights -> MoE(top-2 normalized) == dense FFN."""
@@ -101,6 +156,21 @@ class TestMoELayer:
         assert "ep" in str(p._data.sharding)
         out = np.asarray(moe2(x)._data)
         np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_capacity_overflow_drops_tokens(self):
+        """Reference drop semantics: tokens over an expert's capacity get
+        zero combine weight, so their layer output is exactly zero."""
+        paddle.seed(3)
+        layer = MoELayer(16, [ExpertFFN(16, 16) for _ in range(2)],
+                         gate="switch", capacity_factor=2 / 16)  # 1 slot
+        x = _x(b=1, s=16, seed=4)
+        y = layer(x)
+        out = np.asarray(y._data).reshape(16, 16)
+        zero_rows = np.sum(np.all(np.abs(out) < 1e-7, axis=-1))
+        # 16 tokens, 2 experts x 1 slot -> at least 14 dropped (exactly,
+        # unless a token ties); drops are zeros, not garbage
+        assert zero_rows >= 14
+        assert np.all(np.isfinite(out))
 
     def test_train_step_with_moe(self):
         """MoE composes with the fused TrainStep (jit path)."""
