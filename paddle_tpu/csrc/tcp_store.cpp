@@ -240,7 +240,7 @@ static int client_connect(const char* host, int port, int timeout_ms) {
 int tcp_store_set(const char* host, int port, const char* key,
                   const uint8_t* val, uint32_t vlen, int timeout_ms) {
   int fd = client_connect(host, port, timeout_ms);
-  if (fd < 0) return -1;
+  if (fd < 0) return -2;  // connect failure: nothing sent, safe to retry
   uint8_t cmd = 1;
   uint32_t klen = (uint32_t)strlen(key);
   int ok = write_n(fd, &cmd, 1) && write_n(fd, &klen, 4) &&
@@ -289,7 +289,7 @@ int64_t tcp_store_get(const char* host, int port, const char* key,
 int tcp_store_add(const char* host, int port, const char* key, int64_t delta,
                   int64_t* result, int timeout_ms) {
   int fd = client_connect(host, port, timeout_ms);
-  if (fd < 0) return -1;
+  if (fd < 0) return -2;  // connect failure: nothing sent, safe to retry
   uint8_t cmd = 3;
   uint32_t klen = (uint32_t)strlen(key);
   int ok = write_n(fd, &cmd, 1) && write_n(fd, &klen, 4) &&

@@ -94,10 +94,38 @@ class ElasticManager:
     def world_changed(self) -> bool:
         return self._watcher is not None and self._watcher.peer_failed.is_set()
 
-    def mark_completed(self):
+    def mark_completed(self, drain_timeout: float = 30.0):
         """Publish clean completion so peers' watchers don't read our
-        heartbeat stopping as a crash."""
-        self.master.store.set(f"gen{self.gen}/done/{self.rank}", b"1")
+        heartbeat stopping as a crash. Best-effort on non-master ranks: a
+        master that is already gone means rank 0 completed — exactly the
+        state this mark exists to advertise. The MASTER waits (bounded)
+        for every registered peer's done mark before returning, so its
+        shutdown() can't tear the store from under slower peers."""
+        try:
+            self.master.store.set(f"gen{self.gen}/done/{self.rank}", b"1")
+        except (ConnectionError, RuntimeError, OSError):
+            if self.rank == 0:
+                raise
+            return
+        if self.rank == 0:
+            import struct
+
+            try:
+                raw = self.master.store._get_once(f"gen{self.gen}/registered")
+                n = struct.unpack("<q", raw)[0] if raw and len(raw) == 8 \
+                    else 1
+            except (ConnectionError, RuntimeError, OSError):
+                n = 1
+            deadline = time.monotonic() + drain_timeout
+            for r in range(1, n):
+                while time.monotonic() < deadline:
+                    try:
+                        if self.master.store._get_once(
+                                f"gen{self.gen}/done/{r}") is not None:
+                            break
+                    except (ConnectionError, RuntimeError, OSError):
+                        break
+                    time.sleep(0.2)
 
     def next_generation(self):
         """Close the watch and bump the namespace for re-rendezvous."""

@@ -1,0 +1,7 @@
+"""python -m paddle_tpu.distributed.launch (reference:
+python/paddle/distributed/launch/__main__.py)."""
+import sys
+
+from .main import main
+
+sys.exit(main())

@@ -50,11 +50,22 @@ def main(argv=None):
     env = dict(os.environ)
     env["PADDLE_TRAINERS_NUM"] = str(nnodes)
     env["PADDLE_TRAINER_ID"] = str(args.rank)
+    # resolve the master endpoint once (either the --master flag or the
+    # MASTER_ADDR/PORT env contract); the rendezvous TCPStore binds this
+    # port itself (controllers/master.py), so the children's jax
+    # coordination service (init_parallel_env reads MASTER_ADDR/PORT)
+    # rides on the NEXT port — same host, no collision, on both paths
+    master_host = master_port = None
     if args.master:
         env["PADDLE_MASTER"] = args.master
-        host, _, port = args.master.partition(":")
-        env.setdefault("MASTER_ADDR", host)
-        env.setdefault("MASTER_PORT", port or "8765")
+        master_host, _, p = args.master.partition(":")
+        master_port = int(p or "8765")
+    elif env.get("MASTER_ADDR"):
+        master_host = env["MASTER_ADDR"]
+        master_port = int(env.get("MASTER_PORT", "8765"))
+    if master_host is not None:
+        env["MASTER_ADDR"] = master_host
+        env["MASTER_PORT"] = str(master_port + 1)
 
     if nnodes <= 1 and args.max_restart == 0:
         os.environ.update(env)
@@ -66,17 +77,20 @@ def main(argv=None):
 
     log = get_logger("paddle_tpu.launch")
     manager = None
-    if nnodes > 1 and (args.master or env.get("MASTER_ADDR")):
+    if nnodes > 1 and master_host is not None:
         # master rendezvous + liveness watch + elastic re-rendezvous
         # (reference controllers/master.py, watcher.py, elastic/manager.py)
         import socket as _socket
 
         from ...distributed.fleet.elastic import ElasticManager
 
-        master_ep = args.master or (f"{env['MASTER_ADDR']}:"
-                                    f"{env.get('MASTER_PORT', '8765')}")
+        master_ep = f"{master_host}:{master_port}"
         manager = ElasticManager(master_ep, args.rank, args.nnodes)
-        my_ep = _socket.gethostbyname(_socket.gethostname())
+        # per-trainer endpoint must be UNIQUE even with several launchers
+        # on one host (reference endpoints are ip:port per trainer) —
+        # identical bare IPs would re-densify every child to trainer id 0
+        my_ep = (f"{_socket.gethostbyname(_socket.gethostname())}:"
+                 f"{master_port + 2 + args.rank}")
 
     restarts = 0
     while True:

@@ -32,6 +32,13 @@ def _load_lib():
         if _lib is not None or _lib_tried:
             return _lib
         _lib_tried = True
+        stale = (os.path.exists(_SO_PATH) and os.path.exists(_SRC)
+                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO_PATH))
+        if stale:
+            try:
+                os.remove(_SO_PATH)
+            except OSError:
+                pass
         if not os.path.exists(_SO_PATH):
             try:
                 subprocess.run(
@@ -224,16 +231,39 @@ class TCPStore:
         self._resolved = socket.gethostbyname(host)
 
     # -- API ------------------------------------------------------------
+    def _client_retry(self, fn, what):
+        """Retry fn until the store's master is up (ranks race the
+        master's bind at startup — reference TCPStore clients block in
+        connect the same way) or self.timeout elapses. ONLY pre-send
+        connect failures (ConnectionError) retry: a lost RESPONSE after
+        the server applied a non-idempotent add must not re-apply."""
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                return fn()
+            except ConnectionError:
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"store {what}: master never came up within "
+                        f"{self.timeout}s")
+                time.sleep(0.2)
+
     def set(self, key: str, value: bytes):
         value = value if isinstance(value, bytes) else str(value).encode()
-        if self._native:
-            rc = _lib.tcp_store_set(self._resolved.encode(), self.port,
-                                    key.encode(), value, len(value),
-                                    int(self.timeout * 1000))
-            if rc != 0:
-                raise RuntimeError(f"store set({key!r}) failed")
-        else:
-            self._py_client.set(key, value)
+
+        def go():
+            if self._native:
+                rc = _lib.tcp_store_set(self._resolved.encode(), self.port,
+                                        key.encode(), value, len(value),
+                                        int(self.timeout * 1000))
+                if rc == -2:
+                    raise ConnectionError(f"store set({key!r}) connect")
+                if rc != 0:
+                    raise RuntimeError(f"store set({key!r}) failed")
+            else:
+                self._py_client.set(key, value)
+
+        self._client_retry(go, f"set({key!r})")
 
     def _get_once(self, key: str):
         if self._native:
@@ -272,16 +302,21 @@ class TCPStore:
             time.sleep(0.05)
 
     def add(self, key: str, delta: int = 1) -> int:
-        if self._native:
-            out = ctypes.c_int64(0)
-            rc = _lib.tcp_store_add(self._resolved.encode(), self.port,
-                                    key.encode(), delta,
-                                    ctypes.byref(out),
-                                    int(self.timeout * 1000))
-            if rc != 0:
-                raise RuntimeError(f"store add({key!r}) failed")
-            return out.value
-        return self._py_client.add(key, delta)
+        def go():
+            if self._native:
+                out = ctypes.c_int64(0)
+                rc = _lib.tcp_store_add(self._resolved.encode(), self.port,
+                                        key.encode(), delta,
+                                        ctypes.byref(out),
+                                        int(self.timeout * 1000))
+                if rc == -2:
+                    raise ConnectionError(f"store add({key!r}) connect")
+                if rc != 0:
+                    raise RuntimeError(f"store add({key!r}) failed")
+                return out.value
+            return self._py_client.add(key, delta)
+
+        return self._client_retry(go, f"add({key!r})")
 
     def wait(self, keys, timeout: float = None):
         deadline = time.monotonic() + (timeout or self.timeout)

@@ -137,4 +137,5 @@ from .clip import clip_grad_norm_  # noqa: F401
 
 
 from . import utils  # noqa: E402,F401  (spectral/weight norm, param vectors)
+from . import quant  # noqa: E402,F401  (QAT fake-quant + weight-only int8)
 from .layer.common import Unfold, Fold  # noqa: E402,F401

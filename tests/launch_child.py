@@ -1,0 +1,32 @@
+"""Child script for the 2-launcher E2E test: joins the cluster through the
+launcher's env contract (init_parallel_env -> jax.distributed.initialize),
+all-reduces across the two processes, prints the proof line."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1").strip()
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import env as denv
+
+denv.init_parallel_env()
+assert jax.process_count() == 2, jax.process_count()
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+mesh = denv.get_mesh()
+
+# dp-sharded global vector [1, 2]: each host owns one element
+full = np.asarray([1.0, 2.0], np.float32)
+arr = jax.make_array_from_callback(
+    full.shape, NamedSharding(mesh, P("dp")), lambda idx: full[idx])
+t = paddle.Tensor._wrap(arr)
+dist.all_reduce(t)   # psum over dp -> every shard holds 3
+local = np.asarray(t._data.addressable_shards[0].data)
+assert float(local[0]) == 3.0, local
+print(f"LAUNCH-OK rank={rank} sum={float(local[0])}", flush=True)

@@ -1,0 +1,83 @@
+"""Worker for the REAL multi-process branch test (test_multiprocess.py).
+
+Forms a 2-process jax.distributed CPU cluster (the reference's
+multi-process-on-one-node strategy, test_parallel_dygraph_dataparallel.py:55)
+and exercises the branches that only run when jax.process_count() > 1:
+Group.rank's SPMD branch, cross-process barrier, and distributed
+checkpoint save with metapart merge + reshard-on-load.
+"""
+import os
+import pickle
+import sys
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    port = sys.argv[3]
+    outdir = sys.argv[4]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs, process_id=proc_id)
+    assert jax.process_count() == nprocs, jax.process_count()
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.collective import get_group, barrier
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict,
+    )
+
+    devices = jax.devices()          # global: 2 per process
+    assert len(devices) == 2 * nprocs, devices
+    mesh = denv.build_mesh({"dp": len(devices)}, devices=devices)
+    denv.set_mesh(mesh)
+
+    # --- Group.rank SPMD branch (collective.py: process_count > 1) ------
+    g = get_group()
+    rank = g.rank
+    assert rank == proc_id * 2, (rank, proc_id)   # first owned device's coord
+
+    # --- global sharded array, multi-process save + metapart merge ------
+    full = np.arange(32, dtype=np.float32).reshape(8, 4)
+    sharding = NamedSharding(mesh, P("dp", None))
+    arr = jax.make_array_from_callback(
+        full.shape, sharding, lambda idx: full[idx])
+    sd = {"w": paddle.Tensor._wrap(arr), "step": 7}
+    ckpt = os.path.join(outdir, "ckpt")
+    save_state_dict(sd, ckpt)
+
+    # both processes see the merged manifest after the closing barrier
+    with open(os.path.join(ckpt, "0.metadata"), "rb") as f:
+        meta = pickle.load(f)
+    chunks = meta.state_dict_metadata["w"]
+    assert len(chunks) == len(devices), chunks          # all shards present
+    files = set(meta.storage_metadata.values())
+    assert files == {f"{p}_0.distcp" for p in range(nprocs)}, files
+
+    # --- reshard-on-load: read back replicated, verify every element ----
+    target = jax.make_array_from_callback(
+        full.shape, NamedSharding(mesh, P()), lambda idx: np.zeros_like(full[idx]))
+    out = {"w": paddle.Tensor._wrap(target), "step": 0}
+    load_state_dict(out, ckpt)
+    got = np.asarray(out["w"]._data.addressable_shards[0].data)
+    np.testing.assert_allclose(got, full)
+    assert int(out["step"]) == 7
+
+    barrier()
+    print(f"MP2-OK rank={rank} proc={proc_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Real multi-process execution of the process_count > 1 branches.
+
+The reference exercises its whole distributed stack multi-process on one
+node (test/legacy_test/test_parallel_dygraph_dataparallel.py:55 spawns
+ranks and waits). Same strategy: spawn a 2-process jax.distributed CPU
+cluster (mp2_worker.py) and require every branch-assert inside to pass —
+Group.rank SPMD path, cross-process barrier, checkpoint metapart merge,
+reshard-on-load.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _clean_env():
+    """Hermetic CPU child: same axon-strip recipe as the dryrun child."""
+    env = dict(os.environ)
+    for k in list(env):
+        ku = k.upper()
+        if ku.startswith(("AXON_", "PALLAS_AXON", "TPU_", "LIBTPU")):
+            env.pop(k)
+    pyp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+           if p and ".axon_site" not in p.lower()]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(pyp + [repo])
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)   # worker sets its own device count
+    return env
+
+
+class TestTwoProcessCluster:
+    def test_rank_branch_checkpoint_merge_and_reshard(self, tmp_path):
+        worker = os.path.join(os.path.dirname(__file__), "mp2_worker.py")
+        port = _free_port()
+        env = _clean_env()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(i), "2", str(port),
+                 str(tmp_path)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            for i in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("2-process cluster timed out:\n"
+                        + "\n".join(o or "" for o in outs))
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {i} failed:\n{out}"
+        assert "MP2-OK rank=0 proc=0" in outs[0]
+        assert "MP2-OK rank=2 proc=1" in outs[1]
+
+
+class TestLauncherSpawnsBothRanks:
+    def test_two_launchers_form_cluster(self):
+        """Both 'hosts' started via the launcher CLI: master rendezvous on
+        the --master port, children joining the jax coordination service
+        through the env contract (MASTER_ADDR/PORT on the next port), and
+        a cross-process all_reduce proving the cluster formed."""
+        child = os.path.join(os.path.dirname(__file__), "launch_child.py")
+        port = _free_port()
+        env = _clean_env()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nnodes", "2", "--rank", str(i),
+                 "--master", f"127.0.0.1:{port}",
+                 "--max_restart", "0", child],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            for i in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("launcher cluster timed out:\n"
+                        + "\n".join(o or "" for o in outs))
+        joined = "\n".join(f"--- rank {i} (rc={p.returncode}):\n{o}"
+                           for i, (p, o) in enumerate(zip(procs, outs)))
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"launcher rank {i} failed:\n{joined}"
+            assert f"LAUNCH-OK rank={i} sum=3.0" in out, joined

@@ -1,0 +1,157 @@
+"""paddle.nn.quant tests: weight quantize round-trip, weight-only /
+llm.int8 linears vs the dequantized oracle, QAT fake-quant STE
+gradients, LSQ learned scales, QAT-wrapped linear training."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import quant as Q
+
+
+def _w(shape=(64, 32), seed=0, dtype=np.float32, scale=0.1):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape) * scale).astype(dtype)
+
+
+class TestWeightQuantize:
+    def test_round_trip_int8(self):
+        w = _w()
+        q, s = Q.weight_quantize(paddle.to_tensor(w))
+        assert tuple(q.shape) == (32, 64)      # transposed, reference shape
+        assert tuple(s.shape) == (32,)
+        assert q._data.dtype == jnp.int8
+        back = Q.weight_dequantize(q, s, out_dtype="float32")
+        # absmax int8: max error is scale/2 = |w|_max / 254 per channel
+        err = np.abs(np.asarray(back._data) - w)
+        bound = np.abs(w).max(axis=0) / 254 + 1e-7
+        assert (err <= bound[None, :] + 1e-6).all()
+
+    def test_round_trip_int4(self):
+        w = _w()
+        q, s = Q.weight_quantize(paddle.to_tensor(w),
+                                 algo="weight_only_int4")
+        assert int(np.abs(np.asarray(q._data)).max()) <= 8
+        back = np.asarray(Q.weight_dequantize(
+            q, s, algo="weight_only_int4", out_dtype="float32")._data)
+        assert np.abs(back - w).max() < np.abs(w).max() / 7
+
+    def test_grouped(self):
+        w = _w((128, 16))
+        q, s = Q.weight_quantize(paddle.to_tensor(w), group_size=64)
+        assert tuple(s.shape) == (2, 16)
+        back = np.asarray(Q.weight_dequantize(q, s,
+                                              out_dtype="float32")._data)
+        assert np.abs(back - w).max() < np.abs(w).max() / 100
+
+    def test_bad_algo_raises(self):
+        with pytest.raises(ValueError):
+            Q.weight_quantize(paddle.to_tensor(_w()), algo="int3")
+
+
+class TestQuantizedLinears:
+    def test_weight_only_linear_matches_dequant_matmul(self):
+        x = paddle.to_tensor(_w((4, 32), seed=1))
+        w = _w((32, 16), seed=2)
+        q, s = Q.weight_quantize(paddle.to_tensor(w))
+        bias = paddle.to_tensor(_w((16,), seed=3))
+        got = Q.weight_only_linear(x, q, bias=bias, weight_scale=s)
+        wd = np.asarray(Q.weight_dequantize(q, s, out_dtype="float32")._data)
+        want = np.asarray(x._data) @ wd + np.asarray(bias._data)
+        np.testing.assert_allclose(np.asarray(got._data), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_llm_int8_linear_close_to_fp(self):
+        rng = np.random.default_rng(5)
+        x = (rng.standard_normal((8, 64)) * 0.5).astype(np.float32)
+        x[:, 3] *= 30.0   # outlier feature column
+        w = _w((64, 32), seed=6)
+        q, s = Q.weight_quantize(paddle.to_tensor(w), algo="llm.int8")
+        got = np.asarray(Q.llm_int8_linear(
+            paddle.to_tensor(x), q, weight_scale=s, threshold=6.0)._data)
+        want = x @ w
+        # int8 dynamic quant: ~1% relative error on the inlier part
+        assert np.abs(got - want).max() < 0.05 * np.abs(want).max() + 1e-3
+
+    def test_apply_per_channel_scale(self):
+        x = _w((4, 8), seed=7) + 1.0
+        s = np.abs(_w((8,), seed=8)) + 0.5
+        got = np.asarray(Q.apply_per_channel_scale(
+            paddle.to_tensor(x), paddle.to_tensor(s))._data)
+        np.testing.assert_allclose(got, x / s, rtol=1e-6)
+
+
+class TestFakeQuant:
+    def test_abs_max_forward_and_ste_grad(self):
+        fq = Q.FakeQuantAbsMax(quant_bits=8)
+        x = paddle.to_tensor(_w((16, 16), seed=9), stop_gradient=False)
+        y = fq(x)
+        # quantized to the 255-level grid
+        scale = np.abs(np.asarray(x._data)).max() / 127
+        ratio = np.asarray(y._data) / scale
+        np.testing.assert_allclose(ratio, np.round(ratio), atol=1e-4)
+        # STE: gradient passes through as identity
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), 1.0)
+
+    def test_channel_wise_scales_differ(self):
+        fq = Q.FakeQuantChannelWiseAbsMax(quant_bits=8, quant_axis=1)
+        x = np.stack([_w((8,), seed=1, scale=1.0),
+                      _w((8,), seed=2, scale=10.0)], axis=1)
+        y = np.asarray(fq(paddle.to_tensor(x))._data)
+        for c, col in enumerate(x.T):
+            sc = np.abs(col).max() / 127
+            np.testing.assert_allclose(y[:, c] / sc,
+                                       np.round(y[:, c] / sc), atol=1e-4)
+
+    def test_moving_average_updates_in_train_only(self):
+        fq = Q.FakeQuantMovingAverageAbsMax(moving_rate=0.5)
+        x = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+        fq.train()
+        fq(x)
+        s1 = float(fq.scale._data)
+        fq(paddle.to_tensor(np.full((4,), 10.0, np.float32)))
+        s2 = float(fq.scale._data)
+        assert s2 > s1
+        fq.eval()
+        fq(paddle.to_tensor(np.full((4,), 100.0, np.float32)))
+        assert float(fq.scale._data) == s2   # frozen in eval
+
+    def test_lsq_weight_scale_learns(self):
+        fq = Q.FakeQuantWeightLSQPlus(quant_bits=8)
+        x = paddle.to_tensor(_w((8, 8), seed=11), stop_gradient=False)
+        y = fq(x)
+        (y * y).sum().backward()
+        assert fq.s.grad is not None
+        assert np.isfinite(np.asarray(fq.s.grad._data)).all()
+
+
+class TestQATLinear:
+    def test_wrapped_linear_trains(self):
+        paddle.seed(0)
+        lin = nn.Linear(16, 4)
+        qlin = Q.QuantizedLinear(lin)
+        import paddle_tpu.optimizer as popt
+
+        opt = popt.SGD(learning_rate=0.05,
+                       parameters=[lin.weight, lin.bias])
+        x = paddle.to_tensor(_w((8, 16), seed=12))
+        target = paddle.to_tensor(_w((8, 4), seed=13))
+        losses = []
+        for _ in range(5):
+            out = qlin(x)
+            loss = ((out - target) * (out - target)).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_stub_identity_and_observer(self):
+        st = Q.Stub()
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        assert np.allclose(np.asarray(st(x)._data), 1.0)
+        st2 = Q.Stub(Q.FakeQuantAbsMax())
+        assert st2(x).shape == x.shape
