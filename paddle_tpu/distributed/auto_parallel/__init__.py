@@ -237,6 +237,7 @@ def moe_global_mesh_tensor(*args, **kwargs):
 
 
 from .engine import DistModel, Strategy, to_static  # noqa: E402,F401
+from .planner import Plan, infer_model_spec, plan  # noqa: E402,F401
 
 
 def apply_sharding_rules(layer, rules, mesh=None):

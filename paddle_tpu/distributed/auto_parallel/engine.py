@@ -51,10 +51,31 @@ class DistModel:
     """
 
     def __init__(self, layer, loader=None, loss=None, optimizer=None,
-                 strategy: Optional[Strategy] = None):
+                 strategy: Optional[Strategy] = None, global_batch=None,
+                 seq_len=None):
         self.network = layer
         self._loader = loader
         self._loss = loss
+        self.plan = None
+        if strategy == "auto":
+            # derive the strategy from the cost model (planner.py): mesh
+            # factorization + sharding stage + micro-batching chosen by
+            # estimate_step_ms/estimate_memory_gb, TP rules applied when
+            # the model advertises them
+            from .planner import plan as _plan
+
+            if global_batch is None:
+                global_batch = getattr(loader, "batch_size", None)
+            if global_batch is None:
+                raise ValueError(
+                    "strategy='auto' needs global_batch (or a loader with "
+                    "batch_size) for the cost model")
+            self.plan = _plan(layer, global_batch, seq_len=seq_len)
+            if self.plan is None:
+                raise RuntimeError(
+                    "auto-parallel planner found no configuration that "
+                    "fits HBM; shrink the model/batch or add devices")
+            strategy = self.plan.strategy
         self._strategy = strategy or Strategy()
         self._mode = None
         self._train_step = None
@@ -163,7 +184,10 @@ class DistModel:
 
 
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
-              input_spec=None):
-    """Reference api.py:2446 — build the compiled DistModel."""
+              input_spec=None, global_batch=None, seq_len=None):
+    """Reference api.py:2446 — build the compiled DistModel. Pass
+    ``strategy="auto"`` to have the cost-model planner derive the mesh +
+    sharding + micro-batching (planner.py)."""
     return DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
-                     strategy=strategy)
+                     strategy=strategy, global_batch=global_batch,
+                     seq_len=seq_len)

@@ -1,0 +1,147 @@
+"""Auto-parallel planning: DERIVE a parallel strategy, don't just apply one.
+
+Reference parity: the static auto-parallel completion + cost-model
+planning pipeline (python/paddle/distributed/auto_parallel/static/
+completion.py, cost/, tuner/) whose job is: given a model and a device
+count, choose the process-mesh factorization and shardings. The
+reference re-plans a ProgramDesc with per-op cost models; TPU-first the
+probing surface is much smaller — GSPMD owns per-op propagation, so the
+plan is (dp, mp, pp, sharding stage, micro-batches) + model sharding
+rules, and the ranking comes from the auto_tuner's scaling-book cost
+model (estimate_step_ms / estimate_memory_gb). This module is the
+bridge VERDICT r2 (Missing #5) asked for: AutoTuner proposes/prunes/
+ranks, the planner materializes the winner as a Strategy + mesh +
+applied sharding rules.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+from ..auto_tuner.tuner import AutoTuner, Candidate, ModelSpec
+
+
+@dataclass
+class Plan:
+    candidate: Candidate
+    mesh: "object"              # jax.sharding.Mesh
+    strategy: "object"          # engine.Strategy
+    spec: ModelSpec
+
+
+def infer_model_spec(layer, global_batch, seq_len=None) -> ModelSpec:
+    """Build a ModelSpec from a model: transformer dims from its config
+    when it has one (GPT/LLaMA/BERT-style), conservative fallbacks
+    otherwise."""
+    import numpy as np
+
+    params = int(sum(int(np.prod(p.shape)) for p in layer.parameters()))
+    cfg = getattr(layer, "config", None)
+    if cfg is None:
+        for sub in getattr(layer, "sublayers", lambda **k: [])(
+                include_self=False):
+            if getattr(sub, "config", None) is not None:
+                cfg = sub.config
+                break
+
+    def _get(*names, default):
+        for n in names:
+            v = getattr(cfg, n, None)
+            if v is not None:
+                return int(v)
+        return int(default)
+
+    hidden = _get("hidden_size", default=max(
+        256, 2 ** int(math.log2(max(params, 1) ** (1 / 3) + 1))))
+    layers = _get("num_layers", "num_hidden_layers", default=max(
+        2, params // max(12 * hidden * hidden, 1)))
+    heads = _get("num_attention_heads", default=max(1, hidden // 64))
+    vocab = _get("vocab_size", default=50304)
+    seq = int(seq_len) if seq_len is not None else _get(
+        "max_position_embeddings", default=1024)
+    return ModelSpec(params=params, num_layers=layers, hidden_size=hidden,
+                     num_heads=heads, vocab_size=vocab, seq_len=seq,
+                     global_batch=int(global_batch))
+
+
+def plan(layer, global_batch, *, seq_len=None, n_devices=None,
+         hbm_gb: float = 16.0, devices=None, max_mp=None, max_pp=None,
+         runner=None, measure_top_k: int = 0) -> Optional[Plan]:
+    """Derive the best (dp, mp, pp, sharding, micro) plan for `layer`.
+
+    Proposes the factorization grid, prunes on the HBM model, ranks with
+    the cost model (optionally measures the top_k with `runner`), then
+    materializes: builds the dp x pp x mp mesh, applies the model's
+    sharding rules when it advertises them (`sharding_rules(tp_axis,
+    fsdp_axis)` method or `tp_sharding_rules` attribute), and returns
+    the Plan. Returns None when nothing fits `hbm_gb`.
+    """
+    import jax
+
+    from .. import env as denv
+    from . import apply_sharding_rules
+    from .engine import Strategy
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = list(devices)[:n_devices]
+
+    spec = infer_model_spec(layer, global_batch, seq_len)
+    tuner = AutoTuner(spec, n_devices, hbm_gb=hbm_gb, runner=runner)
+    cands = tuner.candidates()
+
+    # models with no TP sharding rules can only run dp/sharding plans; and
+    # pipeline degree is a model-CONSTRUCTION choice (GPTForCausalLMPipe
+    # takes num_stages), so instance-level planning keeps pp = 1 unless
+    # the layer was already built as a pipe (advertises num_stages)
+    has_rules = (hasattr(layer, "sharding_rules")
+                 or getattr(layer, "tp_sharding_rules", None) is not None)
+    if not has_rules:
+        cands = [c for c in cands if c.mp == 1]
+    built_pp = int(getattr(layer, "num_stages", 1) or 1)
+    cands = [c for c in cands if c.pp == built_pp]
+    if max_mp is not None:
+        cands = [c for c in cands if c.mp <= max_mp]
+    if max_pp is not None:
+        cands = [c for c in cands if c.pp <= max_pp]
+    if not cands:
+        return None
+    best = cands[0]
+    if measure_top_k and runner is not None:
+        # measure the FILTERED ranking (AutoTuner.measure would re-propose
+        # the unfiltered grid and could hand back e.g. an mp>1 plan for a
+        # model with no TP rules)
+        measured = []
+        for c in cands[:measure_top_k]:
+            try:
+                c.measured_step_ms = float(runner(c))
+                measured.append(c)
+            except Exception as e:
+                c.pruned_reason = f"trial failed: {e}"
+        if measured:
+            best = min(measured, key=lambda c: c.measured_step_ms)
+
+    mesh = denv.build_mesh({"dp": best.dp, "pp": best.pp, "mp": best.mp},
+                           devices=devices)
+    denv.set_mesh(mesh)
+    if has_rules and (best.mp > 1 or best.pp > 1):
+        rules = (layer.sharding_rules(tp_axis="mp", fsdp_axis=None)
+                 if hasattr(layer, "sharding_rules")
+                 else layer.tp_sharding_rules)
+        apply_sharding_rules(layer, rules, mesh)
+
+    strategy = Strategy()
+    if best.sharding_stage >= 1:
+        strategy.sharding.enable = True
+        strategy.sharding.stage = best.sharding_stage
+        strategy.sharding.degree = best.dp
+    micro = max(1, int(best.micro_batch))
+    if micro > 1:
+        strategy.gradient_merge.enable = True
+        strategy.gradient_merge.k_steps = micro
+    if spec.use_recompute:
+        strategy.recompute.enable = True
+    return Plan(candidate=best, mesh=mesh, strategy=strategy, spec=spec)

@@ -108,16 +108,20 @@ class ElasticManager:
                 raise
             return
         if self.rank == 0:
-            import struct
-
-            try:
-                raw = self.master.store._get_once(f"gen{self.gen}/registered")
-                n = struct.unpack("<q", raw)[0] if raw and len(raw) == 8 \
-                    else 1
-            except (ConnectionError, RuntimeError, OSError):
-                n = 1
+            # drain by the ORIGINAL rank ids that actually registered this
+            # generation (node keys) — a shrunken elastic world has sparse
+            # survivors, so dense range(1, n) would stall on dead ranks
+            # and never cover live ones
+            peers = []
+            for r in range(1, self.max_np):
+                try:
+                    if self.master.store._get_once(
+                            f"gen{self.gen}/node/{r}") is not None:
+                        peers.append(r)
+                except (ConnectionError, RuntimeError, OSError):
+                    return
             deadline = time.monotonic() + drain_timeout
-            for r in range(1, n):
+            for r in peers:
                 while time.monotonic() < deadline:
                     try:
                         if self.master.store._get_once(

@@ -163,8 +163,16 @@ class _PyStoreClient:
     def _roundtrip(self, payload):
         import struct
 
-        with socket.create_connection((self.host, self.port),
-                                      timeout=self.timeout) as s:
+        # connect failures (pre-send, safe to retry) surface as
+        # ConnectionError; anything after the request may have been
+        # APPLIED server-side, so it must NOT look retryable to
+        # _client_retry (non-idempotent add) — re-raise as RuntimeError
+        try:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.timeout)
+        except OSError as e:
+            raise ConnectionError(f"store connect failed: {e}") from e
+        try:
             s.sendall(payload)
             hdr = b""
             while len(hdr) < 5:
@@ -175,6 +183,11 @@ class _PyStoreClient:
             while len(val) < vlen:
                 val += s.recv(vlen - len(val))
             return status, val
+        except OSError as e:
+            raise RuntimeError(f"store roundtrip failed mid-stream: {e}") \
+                from e
+        finally:
+            s.close()
 
     def set(self, key, val):
         import struct

@@ -229,6 +229,11 @@ class GPTForCausalLM(nn.Layer):
             logits = self.lm_head(hidden)
         return logits
 
+    def sharding_rules(self, tp_axis="mp", fsdp_axis=None):
+        """Advertise the Megatron TP placement to the auto-parallel
+        planner (distributed/auto_parallel/planner.py)."""
+        return gpt_sharding_rules(tp_axis=tp_axis, fsdp_axis=fsdp_axis)
+
     def loss(self, input_ids, labels, loss_mask=None, position_ids=None):
         """Training loss via the fused LM head: hidden states go straight
         into F.fused_linear_cross_entropy, so the [tokens, vocab] logits are
