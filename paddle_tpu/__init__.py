@@ -83,6 +83,21 @@ from . import sparse  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import models  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import callbacks  # noqa: E402,F401
+from . import dataset  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from . import tensor  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import version  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from .nn import ParamAttr  # noqa: E402,F401

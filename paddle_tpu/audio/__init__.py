@@ -1,0 +1,24 @@
+"""paddle.audio — spectral feature extraction.
+
+Reference parity: python/paddle/audio/ (functional/functional.py
+hz_to_mel:29 / compute_fbank_matrix:189 / power_to_db:262 / create_dct:306,
+features/layers.py Spectrogram:45 / MelSpectrogram:130 /
+LogMelSpectrogram:237 / MFCC:344). All computation is jnp over the
+framework's stft (signal.py), so features jit and run on the MXU/VPU;
+dataset classes are download-backed and raise (zero egress).
+"""
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
+)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]
+
+
+def __getattr__(name):
+    if name in {"datasets", "ESC50", "TESS", "GTZAN", "UrbanSound8K"}:
+        raise RuntimeError(
+            f"paddle.audio.{name} downloads its corpus; this environment "
+            "has no network egress — load files locally via paddle.io.")
+    raise AttributeError(name)

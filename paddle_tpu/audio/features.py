@@ -1,0 +1,93 @@
+"""paddle.audio.features parity (reference audio/features/layers.py):
+Spectrogram:45, MelSpectrogram:130, LogMelSpectrogram:237, MFCC:344 —
+nn.Layers over the framework stft, fully traceable."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..ops._dispatch import ensure_tensor
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        from ..signal import stft
+
+        spec = stft(ensure_tensor(x), self.n_fft, self.hop_length,
+                    self.win_length, window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        mag = Tensor._wrap(jnp.abs(spec._data) ** self.power)
+        return mag
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank = F.compute_fbank_matrix(
+            sr, n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk,
+            norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)                # [.., freq, time]
+        mel = jnp.einsum("mf,...ft->...mt", self.fbank._data, spec._data)
+        return Tensor._wrap(mel)
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)       # [.., n_mels, time]
+        out = jnp.einsum("mk,...mt->...kt", self.dct_matrix._data,
+                         logmel._data)
+        return Tensor._wrap(out)

@@ -1,0 +1,127 @@
+"""paddle.audio.functional parity (reference audio/functional/functional.py
+and window_utils.py). Pure jnp — every helper is jit-safe."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import ensure_tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """reference functional.py:29 (Slaney by default, HTK optional)."""
+    is_t = isinstance(freq, Tensor)
+    f = freq._data if is_t else freq
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + jnp.asarray(f, jnp.float32) / 700.0)
+        return Tensor._wrap(out) if is_t else float(out)
+    f = jnp.asarray(f, jnp.float32)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    mels = jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(f / min_log_hz) / logstep, mels)
+    return Tensor._wrap(mels) if is_t else float(mels)
+
+
+def mel_to_hz(mel, htk=False):
+    """reference functional.py:83."""
+    is_t = isinstance(mel, Tensor)
+    m = mel._data if is_t else jnp.asarray(mel, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (jnp.asarray(m, jnp.float32) / 2595.0) - 1.0)
+        return Tensor._wrap(out) if is_t else float(out)
+    m = jnp.asarray(m, jnp.float32)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    freqs = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                      freqs)
+    return Tensor._wrap(freqs) if is_t else float(freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """reference functional.py:126."""
+    lo = hz_to_mel(f_min, htk=htk)
+    hi = hz_to_mel(f_max, htk=htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor._wrap(mel_to_hz(Tensor._wrap(mels), htk=htk)._data
+                        .astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """reference functional.py:166."""
+    return Tensor._wrap(jnp.linspace(0, sr / 2, 1 + n_fft // 2)
+                        .astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]
+    (reference functional.py:189)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)._data
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor._wrap(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """reference functional.py:262."""
+    x = ensure_tensor(spect)._data.astype(jnp.float32)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor._wrap(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.py:306)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k) * 2.0
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(math.sqrt(1.0 / (4 * n_mels)))
+        dct = dct.at[:, 1:].multiply(math.sqrt(1.0 / (2 * n_mels)))
+    return Tensor._wrap(dct.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window helper (reference window_utils.py get_window)."""
+    if isinstance(window, (tuple, list)):
+        window, beta = window
+    n = win_length if fftbins else win_length - 1
+    i = jnp.arange(win_length, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / n)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / n)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * i / n)
+             + 0.08 * jnp.cos(4 * math.pi * i / n))
+    elif window in ("rect", "boxcar", "ones"):
+        w = jnp.ones((win_length,), jnp.float32)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor._wrap(w.astype(dtype))

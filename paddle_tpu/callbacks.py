@@ -1,0 +1,9 @@
+"""paddle.callbacks parity (python/paddle/callbacks.py): re-exports the
+hapi callback set used by paddle.Model.fit."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, EarlyStopping, LRScheduler, ModelCheckpoint,
+    ProgBarLogger,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler"]

@@ -1,0 +1,9 @@
+"""paddle.dataset (legacy corpus downloaders): every dataset here pulls
+from the network; this environment has no egress. Use paddle.vision.
+datasets with local files or wrap local data in paddle.io.Dataset."""
+
+
+def __getattr__(name):
+    raise RuntimeError(
+        f"paddle.dataset.{name} downloads its corpus; no network egress "
+        "here — load local files via paddle.io.Dataset/DataLoader")

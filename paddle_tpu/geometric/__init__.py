@@ -1,0 +1,199 @@
+"""paddle.geometric — graph learning ops.
+
+Reference parity: python/paddle/geometric/ (math.py segment_sum:23 etc.,
+message_passing/send_recv.py send_u_recv). TPU-first: segment reductions
+map onto ``jax.ops.segment_*`` (one XLA scatter-reduce, static
+num_segments via out_size); message passing is gather + segment-reduce,
+which XLA fuses — no CSR kernels needed. Neighbor sampling is data-
+dependent-shape host work and stays eager (numpy), like the reference's
+CPU sampling kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import ensure_tensor, nary
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "sample_neighbors",
+]
+
+
+def _num_segments(segment_ids, hint=None):
+    if hint is not None:
+        return int(hint)
+    ids = segment_ids._data if isinstance(segment_ids, Tensor) else segment_ids
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment ops inside jit need a static segment count; pass "
+            "out_size (reference kernels read it from the ids eagerly)")
+    return int(np.asarray(ids).max()) + 1 if ids.size else 0
+
+
+def _reduce(values, ids, op, n):
+    """Shared segment reduce: ids int32, static n segments; empty
+    segments come back 0 IN THE INPUT DTYPE (reference semantics) via a
+    count mask — not an isinf probe, which would clobber legitimate inf
+    values and promote integer inputs."""
+    ids = ids.astype(jnp.int32)
+    if op == "mean":
+        s = jax.ops.segment_sum(values, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((ids.shape[0],), values.dtype),
+                                  ids, num_segments=n)
+        return s / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (values.ndim - 1))
+    fn = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+          "max": jax.ops.segment_max}[op]
+    out = fn(values, ids, num_segments=n)
+    if op in ("min", "max"):
+        cnt = jax.ops.segment_sum(jnp.ones((ids.shape[0],), jnp.int32),
+                                  ids, num_segments=n)
+        empty = (cnt == 0).reshape((-1,) + (1,) * (values.ndim - 1))
+        out = jnp.where(empty, jnp.zeros((), out.dtype), out)
+    return out
+
+
+def _segment(op, data, segment_ids, name, out_size=None):
+    n = _num_segments(segment_ids, out_size)
+
+    def f(d, ids):
+        return _reduce(d, ids, op, n)
+
+    return nary(f, [ensure_tensor(data), ensure_tensor(segment_ids)],
+                f"segment_{op}")
+
+
+def segment_sum(data, segment_ids, name=None):
+    """reference geometric/math.py:23."""
+    return _segment("sum", data, segment_ids, name)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment("mean", data, segment_ids, name)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("min", data, segment_ids, name)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("max", data, segment_ids, name)
+
+
+_POOLS = {"sum": "sum", "add": "sum", "mean": "mean", "min": "min",
+          "max": "max"}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges and reduce at destinations
+    (reference message_passing/send_recv.py send_u_recv)."""
+    if reduce_op not in _POOLS:
+        raise ValueError(f"reduce_op must be one of {sorted(_POOLS)}")
+    x = ensure_tensor(x)
+    n_out = out_size if out_size is not None else x.shape[0]
+    op = _POOLS[reduce_op]
+
+    def f(xv, src, dst):
+        return _reduce(xv[src.astype(jnp.int32)], dst, op, n_out)
+
+    return nary(f, [x, ensure_tensor(src_index), ensure_tensor(dst_index)],
+                "send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Node features combined with edge features, then reduced
+    (reference send_ue_recv); message_op: add/sub/mul/div."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"message_op must be one of {sorted(ops)}")
+    if reduce_op not in _POOLS:
+        raise ValueError(f"reduce_op must be one of {sorted(_POOLS)}")
+    x = ensure_tensor(x)
+    n_out = out_size if out_size is not None else x.shape[0]
+    red = _POOLS[reduce_op]
+    msg = ops[message_op]
+
+    def f(xv, yv, src, dst):
+        return _reduce(msg(xv[src.astype(jnp.int32)], yv), dst, red, n_out)
+
+    return nary(f, [x, ensure_tensor(y), ensure_tensor(src_index),
+                    ensure_tensor(dst_index)], "send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (reference send_uv)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    msg = ops[message_op]
+
+    def f(xv, yv, src, dst):
+        return msg(xv[src.astype(jnp.int32)], yv[dst.astype(jnp.int32)])
+
+    return nary(f, [ensure_tensor(x), ensure_tensor(y),
+                    ensure_tensor(src_index), ensure_tensor(dst_index)],
+                "send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference reindex_graph;
+    eager/host — data-dependent output size)."""
+    xs = np.asarray(ensure_tensor(x)._data)
+    nb = np.asarray(ensure_tensor(neighbors)._data)
+    # reference semantics: x keeps its order first, then new neighbor ids
+    order = {int(v): i for i, v in enumerate(xs)}
+    nxt = len(order)
+    for v in nb:
+        if int(v) not in order:
+            order[int(v)] = nxt
+            nxt += 1
+    reindex_src = np.asarray([order[int(v)] for v in nb], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64),
+                            np.asarray(ensure_tensor(count)._data))
+    out_nodes = np.asarray(sorted(order, key=order.get), dtype=np.int64)
+    return (Tensor._wrap(jnp.asarray(reindex_src)),
+            Tensor._wrap(jnp.asarray(reindex_dst)),
+            Tensor._wrap(jnp.asarray(out_nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling over CSC (reference sample_neighbors;
+    host-side — ragged, data-dependent shapes). With return_eids=True
+    the sampled edges' ids come back too (reference 3-tuple)."""
+    from ..framework.random import host_rng
+
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs the eids tensor")
+    r = np.asarray(ensure_tensor(row)._data)
+    cp = np.asarray(ensure_tensor(colptr)._data)
+    nodes = np.asarray(ensure_tensor(input_nodes)._data)
+    ev = np.asarray(ensure_tensor(eids)._data) if eids is not None else None
+    rng = host_rng()
+    out, counts, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        idx = np.arange(lo, hi)
+        if sample_size >= 0 and len(idx) > sample_size:
+            idx = rng.choice(idx, size=sample_size, replace=False)
+        out.append(r[idx])
+        counts.append(len(idx))
+        if return_eids:
+            out_eids.append(ev[idx])
+    flat = (np.concatenate(out) if out else np.zeros((0,), r.dtype))
+    res = (Tensor._wrap(jnp.asarray(flat.astype(np.int64))),
+           Tensor._wrap(jnp.asarray(np.asarray(counts, np.int64))))
+    if return_eids:
+        fe = (np.concatenate(out_eids) if out_eids
+              else np.zeros((0,), np.int64))
+        return res + (Tensor._wrap(jnp.asarray(fe.astype(np.int64))),)
+    return res

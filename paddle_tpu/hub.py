@@ -1,0 +1,45 @@
+"""paddle.hub parity (python/paddle/hub.py): local-source model loading;
+remote github/gitee sources need network egress and raise."""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+_MODULE = "hubconf"
+
+
+def _load_entry(repo_dir):
+    path = os.path.join(repo_dir, _MODULE + ".py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_MODULE}.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location(_MODULE, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            f"paddle.hub source {source!r} downloads from the network; "
+            "this environment has no egress — clone the repo and use "
+            "source='local'")
+
+
+def list(repo_dir, source="github", force_reload=False):
+    _check_source(source)
+    mod = _load_entry(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    _check_source(source)
+    return getattr(_load_entry(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    _check_source(source)
+    return getattr(_load_entry(repo_dir), model)(**kwargs)

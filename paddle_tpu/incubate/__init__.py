@@ -1,2 +1,3 @@
 """paddle.incubate parity — experimental/advanced features."""
 from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,209 @@
+"""paddle.incubate.nn — the "fused" transformer building blocks.
+
+Reference parity: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention:213, FusedFeedForward:534,
+FusedBiasDropoutResidualLayerNorm:94, FusedTransformerEncoderLayer:750)
+and layer/fused_linear.py. The reference backs these with hand-fused
+CUDA megakernels; on TPU the SAME fusion comes from XLA (elementwise
+chains into matmuls) plus the pallas flash-attention path behind
+F.scaled_dot_product_attention — so these layers are thin, keep the
+reference's parameter layout (single packed qkv weight
+[3, heads, head_dim, embed] etc.), and compile into fused programs
+through TrainStep like everything else.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...framework.tensor import Tensor
+from ...nn import functional as F
+from ...ops._dispatch import ensure_tensor
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedBiasDropoutResidualLayerNorm",
+           "FusedTransformerEncoderLayer", "FusedLinear"]
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """reference fused_transformer.py:213 — pre/post-LN attention block
+    with packed qkv weight [3, num_heads, head_dim, embed_dim]."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("num_heads must divide embed_dim")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._transpose_qkv_wb = transpose_qkv_wb
+        if transpose_qkv_wb:
+            qkv_shape = [embed_dim, 3 * embed_dim]
+            bias_shape = [3 * embed_dim]
+        else:
+            qkv_shape = [3, num_heads, self.head_dim, embed_dim]
+            bias_shape = [3, num_heads, self.head_dim]
+        self.qkv_weight = self.create_parameter(qkv_shape,
+                                                attr=qkv_weight_attr)
+        self.qkv_bias = (None if qkv_bias_attr is False else
+                         self.create_parameter(bias_shape,
+                                               attr=qkv_bias_attr,
+                                               is_bias=True))
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = (None if linear_bias_attr is False else
+                            self.create_parameter([embed_dim],
+                                                  attr=linear_bias_attr,
+                                                  is_bias=True))
+        self.pre_ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.post_ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        x = ensure_tensor(query)
+        residual = x
+        if self.normalize_before:
+            x = self.pre_ln(x)
+        b, s, _ = x.shape
+        # all reshapes/slices go through taped Tensor ops so grads flow
+        # back to the packed qkv parameters
+        if self._transpose_qkv_wb:
+            qkv = x.matmul(self.qkv_weight)            # [b, s, 3e]
+            if self.qkv_bias is not None:
+                qkv = qkv + self.qkv_bias
+        else:
+            w = self.qkv_weight.reshape(
+                [3 * self.num_heads * self.head_dim, self.embed_dim])
+            qkv = x.matmul(w, transpose_y=True)        # [b, s, 3e]
+            if self.qkv_bias is not None:
+                qkv = qkv + self.qkv_bias.reshape([-1])
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]                               # [b, s, h, d]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, is_causal=False,
+            training=self.training)
+        out = out.reshape([b, s, self.embed_dim])
+        out = out.matmul(self.linear_weight)
+        if self.linear_bias is not None:
+            out = out + self.linear_bias
+        if self.dropout_rate:
+            out = F.dropout(out, p=self.dropout_rate,
+                            training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = self.post_ln(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """reference fused_transformer.py:534 — LN + fc1 + act + fc2 +
+    dropout + residual in one compiled block."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 weight_attr=linear1_weight_attr,
+                                 bias_attr=linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 weight_attr=linear2_weight_attr,
+                                 bias_attr=linear2_bias_attr)
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon)
+
+    def forward(self, src, cache=None):
+        x = ensure_tensor(src)
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        x = getattr(F, self.activation)(self.linear1(x))
+        if self.act_dropout_rate:
+            x = F.dropout(x, p=self.act_dropout_rate,
+                          training=self.training)
+        x = self.linear2(x)
+        if self.dropout_rate:
+            x = F.dropout(x, p=self.dropout_rate, training=self.training)
+        out = residual + x
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """reference fused_transformer.py:94 — y = LN(residual + dropout(x
+    + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, bias_attr=None,
+                 epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.linear_bias = (None if bias_attr is False else
+                            self.create_parameter([embed_dim],
+                                                  attr=bias_attr,
+                                                  is_bias=True))
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, residual):
+        x = ensure_tensor(x)
+        if self.linear_bias is not None:
+            x = x + self.linear_bias
+        if self.dropout_rate:
+            x = F.dropout(x, p=self.dropout_rate, training=self.training)
+        return self.norm(ensure_tensor(residual) + x)
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """reference fused_transformer.py:750 — attention block + FFN block."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_drop = (dropout_rate if attn_dropout_rate is None
+                     else attn_dropout_rate)
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_drop,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedLinear(nn.Linear):
+    """reference layer/fused_linear.py — on TPU a Linear already compiles
+    to one fused matmul+bias kernel; kept for API parity."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+        self._transpose_weight = transpose_weight

@@ -1,0 +1,108 @@
+"""paddle.inference — minimal Predictor over the jit servable.
+
+Reference parity surface: paddle/fluid/inference (Config:
+paddle.inference.Config, create_predictor, Predictor.run). The 92k-LoC
+deployment stack (pass pipelines, TensorRT) is explicitly descoped
+(docs/DECISIONS.md §4); what ships is the piece a ported serving script
+needs: load a `paddle.jit.save` artifact and run it as a compiled XLA
+executable with the reference's handle-style API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """reference paddle.inference.Config(prog_file?) — here: the
+    jit.save path prefix."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self._model_path = model_path
+        self._use_gpu = False
+        self._ir_optim = True
+
+    def model_path(self):
+        return self._model_path
+
+    # accepted-for-parity toggles: XLA owns optimization/placement
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_gpu = True
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class _Handle:
+    """Input/output handle (reference ZeroCopyTensor surface)."""
+
+    def __init__(self):
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        if config.model_path() is None:
+            raise ValueError("Config needs the jit.save path prefix")
+        self._layer = jit_load(config.model_path())
+        self._inputs = {}
+        self._outputs = []
+
+    def get_input_names(self):
+        return [f"x{i}" for i in range(max(1, len(self._inputs) or 1))]
+
+    def get_input_handle(self, name):
+        return self._inputs.setdefault(name, _Handle())
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(max(1, len(self._outputs) or 1))]
+
+    def get_output_handle(self, name):
+        idx = int(name[3:]) if name.startswith("out") else 0
+        while len(self._outputs) <= idx:
+            self._outputs.append(_Handle())
+        return self._outputs[idx]
+
+    def run(self):
+        import paddle_tpu as paddle
+
+        def _key(item):
+            name = item[0]
+            digits = "".join(c for c in name if c.isdigit())
+            return (int(digits) if digits else 0, name)
+
+        args = [paddle.to_tensor(h._value)
+                for _, h in sorted(self._inputs.items(), key=_key)]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            while len(self._outputs) <= i:
+                self._outputs.append(_Handle())
+            self._outputs[i]._value = np.asarray(o._data)
+        return True
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

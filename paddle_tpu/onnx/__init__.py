@@ -1,0 +1,11 @@
+"""paddle.onnx parity surface: export() requires the onnx package, which
+this image does not ship; jit.save (StableHLO round-trip) is the
+serialization path on TPU."""
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise RuntimeError(
+        "paddle.onnx.export needs the 'onnx' package (not available in "
+        "this environment). TPU deployment path: paddle.jit.save(layer, "
+        "path) -> compiled servable via paddle.jit.load")

@@ -1,0 +1,210 @@
+"""paddle.quantization — QAT/PTQ workflow over nn.quant.
+
+Reference parity: python/paddle/quantization/ (config.py QuantConfig:67,
+qat.py QAT:27, ptq.py PTQ:29, quanters/). The reference swaps layers for
+quantized counterparts via its layer registry; here the same walk swaps
+``nn.Linear`` for fake-quant training wrappers (QAT) or observer
+wrappers (PTQ), and ``convert`` lowers a trained model to the
+weight-only int8 inference form (nn.quant.weight_quantize +
+weight_only_linear — the TPU-native deployment path, PERF.md round 3).
+"""
+from __future__ import annotations
+
+import copy
+
+from .. import nn
+from ..nn import quant as _q
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+           "AbsMaxObserver", "quanter"]
+
+
+class FakeQuanterWithAbsMaxObserver:
+    """Quanter factory (reference quanters/abs_max.py): EMA absmax
+    fake-quant for activations."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32"):
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+
+    def _instance(self, layer=None):
+        return _q.FakeQuantMovingAverageAbsMax(
+            moving_rate=self.moving_rate, quant_bits=self.bit_length)
+
+
+class AbsMaxObserver:
+    """PTQ observer factory (reference observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+
+    def _instance(self, layer=None):
+        # the observer tracks the absmax scale; quant_bits applies at
+        # convert() time (weight_quantize int8)
+        return _q.MovingAverageAbsMaxScale()
+
+
+def quanter(name):
+    """Decorator parity (reference factory.py quanter) — registers a
+    quanter class; the lean registry is a no-op passthrough."""
+    def deco(cls):
+        return cls
+
+    return deco
+
+
+class QuantConfig:
+    """reference config.py:67 — which quanters apply to which layers."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs = {}
+        self._layer_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_configs[t] = {"activation": activation,
+                                     "weight": weight}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = {"activation": activation,
+                                          "weight": weight}
+
+    def _config_for(self, layer, name=None, by_name=None):
+        """by_name: {sublayer_name: cfg} resolved on the ORIGINAL model —
+        quantize(inplace=False) deepcopies first, which changes every
+        id(), so per-layer configs are carried across the copy by
+        name."""
+        if by_name is not None and name in by_name:
+            return by_name[name]
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if self.activation is not None or self.weight is not None:
+            return {"activation": self.activation, "weight": self.weight}
+        return None
+
+    def _resolve_names(self, model):
+        """Map per-layer configs (id-keyed on the original) to names."""
+        out = {}
+        for name, sub in model.named_sublayers():
+            if id(sub) in self._layer_configs:
+                out[name] = self._layer_configs[id(sub)]
+        return out
+
+
+class _ObservedLinear(nn.Layer):
+    """PTQ wrapper: observe activations, run the float linear."""
+
+    def __init__(self, linear, observer):
+        super().__init__()
+        self._linear = linear
+        self._observer = observer
+
+    def forward(self, x):
+        if self._observer is not None:
+            x = self._observer(x)
+        return self._linear(x)
+
+
+class _WeightOnlyLinear(nn.Layer):
+    """Converted inference layer: int8 weights + scales."""
+
+    def __init__(self, linear):
+        super().__init__()
+        q, s = _q.weight_quantize(linear.weight)
+        self.register_buffer("quant_weight", q)
+        self.register_buffer("weight_scale", s)
+        self.bias = getattr(linear, "bias", None)
+
+    def forward(self, x):
+        return _q.weight_only_linear(x, self.quant_weight, bias=self.bias,
+                                     weight_scale=self.weight_scale)
+
+
+def _swap_linears(model, make):
+    """make(full_name, sublayer) -> replacement or None."""
+    def walk(layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, nn.Linear):
+                replacement = make(full, sub)
+                if replacement is not None:
+                    layer._sub_layers[name] = replacement
+            else:
+                walk(sub, full)
+
+    walk(model, "")
+    return model
+
+
+class _Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def convert(self, model, inplace=False):
+        """Lower fake-quant/observed layers to weight-only int8 inference
+        form (the reference converts to its quantized inference ops)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        for layer in model.sublayers(include_self=True):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, (_q.QuantizedLinear, _ObservedLinear)):
+                    # both expose .weight/.bias (QuantizedLinear directly,
+                    # _ObservedLinear via its inner Linear)
+                    inner = getattr(sub, "_linear", sub)
+                    layer._sub_layers[name] = _WeightOnlyLinear(inner)
+        return model
+
+
+class QAT(_Quantization):
+    """reference qat.py:27 — swap layers for fake-quant training forms."""
+
+    def quantize(self, model, inplace=False):
+        by_name = self._config._resolve_names(model)
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(name, sub):
+            cfg = self._config._config_for(sub, name, by_name)
+            if cfg is None:
+                return None
+            kw = {}
+            act = cfg.get("activation")
+            w = cfg.get("weight")
+            if act is not None:
+                kw["activation_bits"] = getattr(act, "bit_length", 8)
+                kw["moving_rate"] = getattr(act, "moving_rate", 0.9)
+            if w is not None:
+                kw["weight_bits"] = getattr(w, "bit_length", 8)
+            return _q.QuantizedLinear(sub, **kw)
+
+        return _swap_linears(model, make)
+
+
+class PTQ(_Quantization):
+    """reference ptq.py:29 — insert observers; calibrate by running data
+    through the model in eval mode, then convert()."""
+
+    def quantize(self, model, inplace=False):
+        by_name = self._config._resolve_names(model)
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(name, sub):
+            cfg = self._config._config_for(sub, name, by_name)
+            if cfg is None:
+                return None
+            act = cfg.get("activation")
+            obs = act._instance(sub) if act is not None else None
+            return _ObservedLinear(sub, obs)
+
+        return _swap_linears(model, make)

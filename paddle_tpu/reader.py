@@ -1,0 +1,88 @@
+"""paddle.reader parity (legacy python/paddle/reader/decorator.py): the
+composable reader decorators ported scripts still use."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "ComposeNotAligned"]
+
+
+def cache(reader):
+    all_data = None
+
+    def new_reader():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        return iter(all_data)
+
+    return new_reader
+
+
+def map_readers(func, *readers):
+    def new_reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return new_reader
+
+
+def shuffle(reader, buf_size):
+    def new_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return new_reader
+
+
+def chain(*readers):
+    def new_reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return new_reader
+
+
+class ComposeNotAligned(ValueError):
+    """reference reader.decorator.ComposeNotAligned."""
+
+
+def compose(*readers, check_alignment=True):
+    def new_reader():
+        sentinel = object()
+        for items in itertools.zip_longest(*[r() for r in readers],
+                                           fillvalue=sentinel):
+            if sentinel in items:
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                return
+            out = ()
+            for it in items:
+                out = out + (it if isinstance(it, tuple) else (it,))
+            yield out
+
+    return new_reader
+
+
+def buffered(reader, size):
+    def new_reader():
+        yield from reader()   # single-process: buffering is the loader's job
+
+    return new_reader
+
+
+def firstn(reader, n):
+    def new_reader():
+        return itertools.islice(reader(), n)
+
+    return new_reader

@@ -1,0 +1,26 @@
+"""paddle.regularizer parity (python/paddle/regularizer.py): L1Decay /
+L2Decay carry their coefficient; the optimizer folds them into the
+gradient (optimizer/optimizer.py _apply_decay reads `_coeff`)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (reference regularizer.py L2Decay)."""
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param) (reference regularizer.py L1Decay)."""

@@ -1,0 +1,117 @@
+"""paddle.static — the static-graph surface, subsumed by jit/to_static.
+
+Reference parity: python/paddle/static/ — Program/Executor graph
+building. TPU-first this whole layer is jaxpr/XLA (SURVEY §2.4 "PIR /
+static IR: subsumed"): `paddle.jit.to_static` + `paddle.jit.save` are
+the program-capture path. What remains here is the API surface ported
+scripts actually touch: InputSpec, name/device guards (no-op context
+managers — tracing owns scoping), Program objects with the attributes
+training loops read (random_seed), and `data()` which returns an
+InputSpec-like placeholder for to_static signatures. Graph-editing
+calls raise with guidance.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..hapi.model import InputSpec  # noqa: F401  (reference static.InputSpec)
+
+__all__ = ["InputSpec", "Program", "default_main_program",
+           "default_startup_program", "program_guard", "name_scope",
+           "device_guard", "data", "py_func", "gradients", "nn",
+           "cpu_places", "cuda_places", "Executor"]
+
+
+class Program:
+    """Attribute shell (reference framework Program): scripts set
+    .random_seed and compare identities; the graph lives in XLA."""
+
+    def __init__(self):
+        self.random_seed = 0
+
+    def global_block(self):
+        raise RuntimeError(
+            "static graph blocks do not exist on the TPU backend; the "
+            "program is captured by paddle.jit.to_static (jaxpr/XLA)")
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder (reference static.data) -> InputSpec for to_static."""
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise RuntimeError(
+        "static.py_func builds graph nodes; in eager/to_static code just "
+        "call the function (jax.pure_callback handles host calls under jit)")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference static.gradients — route to the eager engine."""
+    import paddle_tpu as paddle
+
+    return paddle.grad(targets, inputs, grad_outputs=target_gradients,
+                       allow_unused=True)
+
+
+def cpu_places(device_count=None):
+    import jax
+
+    from ..framework.device import CPUPlace
+
+    n = device_count or len(jax.devices("cpu"))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+class Executor:
+    def __init__(self, place=None):
+        raise RuntimeError(
+            "static.Executor does not exist on the TPU backend: compiled "
+            "execution is paddle.jit.to_static / TrainStep (one fused XLA "
+            "program per step)")
+
+
+class nn:
+    """static.nn namespace: the dygraph functional ops serve both modes."""
+
+    def __getattr__(self, name):
+        import paddle_tpu.nn.functional as F
+
+        return getattr(F, name)
+
+
+nn = nn()

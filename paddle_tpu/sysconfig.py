@@ -1,0 +1,17 @@
+"""paddle.sysconfig parity (python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """C headers dir (the csrc sources double as the public surface)."""
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib():
+    """Directory holding the framework's native libraries (built lazily
+    next to their Python wrappers)."""
+    return os.path.join(_ROOT, "distributed")

@@ -1,0 +1,7 @@
+"""paddle.tensor namespace parity (python/paddle/tensor/): the tensor op
+library grouped by category. The ops live in paddle_tpu.ops (same
+categories); this package re-exports them under the reference's module
+names so `paddle.tensor.math.add`-style imports work."""
+from ..ops import *  # noqa: F401,F403
+from ..ops import creation, linalg, logic, manipulation, math  # noqa: F401
+from ..ops import reduction as stat  # noqa: F401  (mean/std/var live here)

@@ -1,0 +1,117 @@
+"""paddle.text — viterbi decoding + dataset stubs.
+
+Reference parity: python/paddle/text/ (viterbi_decode.py:31, datasets/).
+The decoder is a lax.scan over time (jit-compilable, batched); the
+datasets are download-backed (Conll05st, Imdb, ...) and this image has
+zero egress, so they raise with guidance to local files.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import ensure_tensor, nary
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag path (reference text/viterbi_decode.py:31).
+
+    potentials [B, T, N], transition_params [N, N], lengths [B] ->
+    (scores [B], paths [B, T_dec]) where T_dec = max(lengths) steps are
+    emitted (reference trims to the longest sequence).
+    """
+    pot = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    lens = ensure_tensor(lengths)
+    import numpy as np
+
+    if isinstance(lens._data, jax.core.Tracer):
+        raise ValueError(
+            "viterbi_decode inside jit needs concrete lengths to size the "
+            "decode (the reference kernel reads them eagerly); call it "
+            "eagerly or fix max length via padding")
+    max_len = int(np.asarray(lens._data).max())
+
+    def f(p, tr, ln):
+        B, T, N = p.shape
+        p = p.astype(jnp.float32)
+        tr = tr.astype(jnp.float32)
+        if include_bos_eos_tag:
+            # last row/col = start tag, second-to-last = stop tag
+            start, stop = tr[-1, :-2], tr[:-2, -2]
+            tr_core = tr[:-2, :-2]
+            n = N - 2
+            alpha0 = p[:, 0, :n] + start[None, :]
+        else:
+            tr_core = tr
+            n = N
+            alpha0 = p[:, 0, :n]
+
+        def step(carry, t):
+            alpha, = carry
+            # scores[b, i, j] = alpha[b, i] + tr[i, j] + emit[b, t, j]
+            sc = alpha[:, :, None] + tr_core[None, :, :]
+            best_prev = jnp.argmax(sc, axis=1)               # [B, n]
+            best_sc = jnp.max(sc, axis=1) + p[:, t, :n]
+            # sequences already finished keep their alpha (mask by length)
+            active = (t < ln)[:, None]
+            new_alpha = jnp.where(active, best_sc, alpha)
+            bp = jnp.where(active, best_prev,
+                           jnp.arange(n, dtype=best_prev.dtype)[None, :])
+            return (new_alpha,), bp
+
+        (alpha,), bps = jax.lax.scan(step, (alpha0,),
+                                     jnp.arange(1, max_len))
+        if include_bos_eos_tag:
+            alpha = alpha + stop[None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)                    # [B]
+
+        # backtrack: tag_{t-1} = bp_t[tag_t]; reverse scan emits
+        # [tag_1 .. tag_{T-1}] and the final carry is tag_0
+        def back(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+            return prev, tag
+
+        tag0, path_rev = jax.lax.scan(back, last, bps, reverse=True)
+        paths = jnp.concatenate([tag0[:, None],
+                                 path_rev.swapaxes(0, 1)], axis=1)
+        return scores, paths.astype(jnp.int64)
+
+    scores, paths = nary(f, [pot, trans, lens], "viterbi_decode")
+    scores.stop_gradient = True
+    paths.stop_gradient = True
+    return scores, paths
+
+
+from .. import nn as _nn
+
+
+class ViterbiDecoder(_nn.Layer):
+    """reference text/viterbi_decode.py ViterbiDecoder — an nn.Layer so
+    the transitions register as state (checkpoints/summary parity)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.register_buffer("transitions", ensure_tensor(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def __getattr__(name):
+    _datasets = {"Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+                 "WMT14", "WMT16"}
+    if name in _datasets:
+        raise RuntimeError(
+            f"paddle.text.{name} downloads its corpus; this environment "
+            "has no network egress. Load the files locally and feed them "
+            "through paddle.io.Dataset/DataLoader instead.")
+    raise AttributeError(name)

@@ -1,0 +1,31 @@
+"""paddle.version parity (generated python/paddle/version/__init__.py)."""
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+istaged = True
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"commit: {commit}")
+    print(f"full_version: {full_version}")
+    print(f"major: {major}\nminor: {minor}\npatch: {patch}\nrc: {rc}")
+    print("cuda: False\ncudnn: False\ntpu: True (XLA/PJRT)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def tpu():
+    return True

@@ -1,0 +1,342 @@
+"""Top-level namespace completion pack: geometric, text (viterbi), audio
+features, quantization workflow, static/regularizer/callbacks/version/
+sysconfig/tensor/reader/hub shims — reference submodule parity."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                         np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+        s = paddle.geometric.segment_sum(data, ids)
+        np.testing.assert_allclose(np.asarray(s._data),
+                                   [[4., 6.], [5., 6.]])
+        m = paddle.geometric.segment_mean(data, ids)
+        np.testing.assert_allclose(np.asarray(m._data),
+                                   [[2., 3.], [5., 6.]])
+        mx = paddle.geometric.segment_max(data, ids)
+        np.testing.assert_allclose(np.asarray(mx._data),
+                                   [[3., 4.], [5., 6.]])
+        mn = paddle.geometric.segment_min(data, ids)
+        np.testing.assert_allclose(np.asarray(mn._data),
+                                   [[1., 2.], [5., 6.]])
+
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[0.], [1.], [2.], [3.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+        out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   [[0.], [2.], [1.], [0.]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.]], np.float32))
+        y = paddle.to_tensor(np.array([[10.], [20.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1], np.int64))
+        dst = paddle.to_tensor(np.array([1, 0], np.int64))
+        out = paddle.geometric.send_ue_recv(x, y, src, dst,
+                                            message_op="add")
+        np.testing.assert_allclose(np.asarray(out._data), [[22.], [11.]])
+        uv = paddle.geometric.send_uv(x, x, src, dst, message_op="mul")
+        np.testing.assert_allclose(np.asarray(uv._data), [[2.], [2.]])
+
+    def test_sample_neighbors(self):
+        # CSC: node 0 neighbors {1,2}, node 1 {0}, node 2 {}
+        row = paddle.to_tensor(np.array([1, 2, 0], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+        nodes = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        nb, cnt = paddle.geometric.sample_neighbors(row, colptr, nodes)
+        np.testing.assert_array_equal(np.asarray(cnt._data), [2, 1, 0])
+        assert np.asarray(nb._data).shape == (3,)
+
+
+class TestTextViterbi:
+    def test_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        B, T, N = 2, 4, 3
+        pot = rng.standard_normal((B, T, N)).astype(np.float32)
+        trans = rng.standard_normal((N, N)).astype(np.float32)
+        lens = np.array([4, 3], np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        # brute force over all tag sequences
+        import itertools
+
+        for b in range(B):
+            best, bestp = -1e30, None
+            L = int(lens[b])
+            for seq in itertools.product(range(N), repeat=L):
+                sc = pot[b, 0, seq[0]]
+                for t in range(1, L):
+                    sc += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+                if sc > best:
+                    best, bestp = sc, seq
+            np.testing.assert_allclose(float(scores._data[b]), best,
+                                       rtol=1e-5)
+            got = np.asarray(paths._data)[b][:L]
+            np.testing.assert_array_equal(got, bestp)
+
+
+class TestAudio:
+    def test_mel_hz_roundtrip(self):
+        F = paddle.audio.functional
+        for htk in (False, True):
+            hz = F.mel_to_hz(F.hz_to_mel(440.0, htk=htk), htk=htk)
+            assert abs(hz - 440.0) < 1e-2
+
+    def test_fbank_shape_and_rows(self):
+        F = paddle.audio.functional
+        fb = F.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert tuple(fb.shape) == (40, 257)
+        assert float(jnp.max(fb._data)) > 0
+
+    def test_feature_layers_run(self):
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((1, 2048))
+            .astype(np.float32))
+        spec = paddle.audio.features.Spectrogram(n_fft=256)(x)
+        assert spec.shape[-2] == 129
+        mel = paddle.audio.features.MelSpectrogram(
+            sr=16000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[-2] == 32
+        mfcc = paddle.audio.features.MFCC(
+            sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+        assert mfcc.shape[-2] == 13
+        assert np.isfinite(np.asarray(mfcc._data)).all()
+
+
+class TestQuantizationWorkflow:
+    def _model(self):
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(nn.functional.relu(self.fc1(x)))
+
+        return Net()
+
+    def test_qat_quantize_and_convert(self):
+        from paddle_tpu.quantization import (
+            FakeQuanterWithAbsMaxObserver, QAT, QuantConfig,
+        )
+        from paddle_tpu.nn import quant as nnq
+
+        q = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+        cfg = QuantConfig(activation=q, weight=q)
+        model = self._model()
+        qat = QAT(cfg)
+        qmodel = qat.quantize(model, inplace=False)
+        subs = [type(s).__name__ for s in qmodel.sublayers()]
+        assert "QuantizedLinear" in subs
+        x = paddle.to_tensor(
+            np.random.default_rng(1).standard_normal((4, 8))
+            .astype(np.float32))
+        out = qmodel(x)
+        assert out.shape == [4, 4]
+        converted = qat.convert(qmodel, inplace=False)
+        names = [type(s).__name__ for s in converted.sublayers()]
+        assert "_WeightOnlyLinear" in names
+        out2 = converted(x)
+        # int8 weight-only inference tracks the fake-quant model closely
+        np.testing.assert_allclose(np.asarray(out2._data),
+                                   np.asarray(out._data), atol=0.15)
+
+    def test_ptq_observe_convert(self):
+        from paddle_tpu.quantization import (
+            AbsMaxObserver, PTQ, QuantConfig,
+        )
+
+        cfg = QuantConfig(activation=AbsMaxObserver(), weight=None)
+        model = self._model()
+        ptq = PTQ(cfg)
+        omodel = ptq.quantize(model, inplace=False)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        omodel.train()
+        omodel(x)   # calibrate
+        conv = ptq.convert(omodel, inplace=False)
+        out = conv(x)
+        assert np.isfinite(np.asarray(out._data)).all()
+
+
+class TestShims:
+    def test_static_surface(self):
+        spec = paddle.static.data("x", [None, 8], "float32")
+        assert spec.shape == [None, 8]
+        with paddle.static.program_guard(paddle.static.default_main_program()):
+            with paddle.static.name_scope("blk"):
+                pass
+        assert paddle.static.default_main_program().random_seed == 0
+        with pytest.raises(RuntimeError, match="TrainStep"):
+            paddle.static.Executor()
+
+    def test_regularizer_flows_into_optimizer(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+
+        lin = nn.Linear(4, 4)
+        opt = popt.Momentum(learning_rate=0.1,
+                            parameters=lin.parameters(),
+                            weight_decay=paddle.regularizer.L2Decay(0.5))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        lin(x).sum().backward()
+        w0 = np.asarray(lin.weight._data).copy()
+        opt.step()
+        assert not np.allclose(np.asarray(lin.weight._data), w0)
+
+    def test_misc_shims(self):
+        assert paddle.version.full_version.startswith("3.")
+        assert paddle.version.tpu() is True
+        assert paddle.sysconfig.get_include().endswith("csrc")
+        assert paddle.callbacks.EarlyStopping is not None
+        assert callable(paddle.tensor.math.add)
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.text.Imdb
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.dataset.mnist
+        with pytest.raises(RuntimeError, match="onnx"):
+            paddle.onnx.export(None, "x")
+
+    def test_reader_decorators(self):
+        r = lambda: iter([1, 2, 3, 4])
+        assert list(paddle.reader.firstn(r, 2)()) == [1, 2]
+        assert list(paddle.reader.map_readers(lambda a: a * 2, r)()) == \
+            [2, 4, 6, 8]
+        assert sorted(paddle.reader.shuffle(r, 2)()) == [1, 2, 3, 4]
+        c = paddle.reader.cache(r)
+        assert list(c()) == list(c())
+
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(n=2):\n    'a tiny model'\n    return list(range(n))\n")
+        assert "tiny" in paddle.hub.list(str(tmp_path), source="local")
+        assert paddle.hub.help(str(tmp_path), "tiny",
+                               source="local") == "a tiny model"
+        assert paddle.hub.load(str(tmp_path), "tiny", source="local",
+                               n=3) == [0, 1, 2]
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.hub.load(str(tmp_path), "tiny")
+
+
+class TestReviewRegressions:
+    def test_segment_min_int_dtype_and_empty(self):
+        """Empty segments -> 0 in the INPUT dtype (no isinf float
+        promotion, no INT_MAX leak)."""
+        data = paddle.to_tensor(np.array([[2], [5]], np.int32))
+        ids = paddle.to_tensor(np.array([0, 0], np.int64))
+        out = paddle.geometric.segment_min(data, ids)
+        # segment 1 empty when out_size forces 2 segments via send_u_recv
+        x = paddle.to_tensor(np.array([[2.], [5.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1], np.int64))
+        dst = paddle.to_tensor(np.array([0, 0], np.int64))
+        o = paddle.geometric.send_u_recv(x, src, dst, reduce_op="min")
+        np.testing.assert_allclose(np.asarray(o._data), [[2.], [0.]])
+        assert np.asarray(out._data).dtype == np.int32
+
+    def test_layer_config_survives_deepcopy(self):
+        from paddle_tpu.quantization import (
+            FakeQuanterWithAbsMaxObserver, QAT, QuantConfig,
+        )
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 4)
+                self.fc2 = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        model = Net()
+        q = FakeQuanterWithAbsMaxObserver()
+        cfg = QuantConfig()          # no global default
+        cfg.add_layer_config(model.fc1, activation=q, weight=q)
+        out = QAT(cfg).quantize(model, inplace=False)   # deepcopies
+        names = {n: type(s).__name__ for n, s in out.named_sublayers()}
+        assert names["fc1"] == "QuantizedLinear"
+        assert names["fc2"] == "Linear"
+
+    def test_compose_alignment(self):
+        a = lambda: iter([1, 2, 3])
+        b = lambda: iter([4, 5])
+        with pytest.raises(paddle.reader.ComposeNotAligned):
+            list(paddle.reader.compose(a, b)())
+        got = list(paddle.reader.compose(a, b, check_alignment=False)())
+        assert got == [(1, 4), (2, 5)]
+
+
+class TestIncubateFused:
+    def test_fused_mha_block(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+        attn = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((2, 8, 32))
+            .astype(np.float32))
+        out = attn(x)
+        assert out.shape == [2, 8, 32]
+        out.sum().backward()
+        assert attn.qkv_weight.grad is not None
+
+    def test_fused_mha_transposed_weights(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+        attn = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0,
+                                       transpose_qkv_wb=True)
+        assert attn.qkv_weight.shape == [32, 96]
+        x = paddle.to_tensor(np.ones((1, 4, 32), np.float32))
+        assert attn(x).shape == [1, 4, 32]
+
+    def test_fused_ffn_and_encoder_layer(self):
+        from paddle_tpu.incubate.nn import (
+            FusedFeedForward, FusedTransformerEncoderLayer,
+        )
+
+        ffn = FusedFeedForward(32, 64, dropout_rate=0.0)
+        x = paddle.to_tensor(np.ones((2, 4, 32), np.float32))
+        assert ffn(x).shape == [2, 4, 32]
+        enc = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        out = enc(x)
+        assert out.shape == [2, 4, 32]
+        assert np.isfinite(np.asarray(out._data)).all()
+
+    def test_fused_bias_dropout_residual_ln(self):
+        from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+
+        blk = FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+        x = paddle.to_tensor(np.ones((2, 16), np.float32))
+        out = blk(x, x)
+        assert out.shape == [2, 16]
+
+
+class TestInferencePredictor:
+    def test_jit_save_predict_roundtrip(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference, jit
+
+        lin = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        want = np.asarray(lin(x)._data)
+        path = str(tmp_path / "model")
+        jit.save(lin, path, input_spec=[x])
+        cfg = inference.Config(path)
+        pred = inference.create_predictor(cfg)
+        h = pred.get_input_handle("x0")
+        h.copy_from_cpu(np.ones((3, 4), np.float32))
+        pred.run()
+        got = pred.get_output_handle("out0").copy_to_cpu()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
