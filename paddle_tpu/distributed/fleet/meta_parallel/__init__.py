@@ -93,4 +93,6 @@ class ShardingParallel(MetaParallelBase):
 
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: E402,F401
 from .pipeline_parallel import PipelineParallel  # noqa: E402,F401
-from .ring_attention import ring_attention, sep_sharding  # noqa: E402,F401
+from .ring_attention import (  # noqa: E402,F401
+    ring_attention, ring_flash_attention, sep_sharding,
+)
