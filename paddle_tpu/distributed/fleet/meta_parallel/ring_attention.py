@@ -183,17 +183,19 @@ def _flash_ring_local(axis, n, blk, scale, causal, interpret):
             lse_new = jnp.logaddexp(l1, l2)
             w1 = jnp.exp(l1 - lse_new)
             w2 = jnp.exp(l2 - lse_new)
-            out_new = (out_run.astype(jnp.float32) * w1
-                       + out_b.astype(jnp.float32) * w2)
+            # out_run stays fp32 across the whole scan: casting back to the
+            # input dtype every tick would accumulate O(n) rounding error
+            # in the rescale-and-add merge instead of rounding once at end
+            out_new = out_run * w1 + out_b.astype(jnp.float32) * w2
             kv = jax.lax.ppermute((kt, vt), axis, perm)
             lse_full = jnp.broadcast_to(lse_new, lse_run.shape)
-            return (out_new.astype(qb.dtype), lse_full, kv), None
+            return (out_new, lse_full, kv), None
 
-        out0 = jnp.zeros_like(qb)
+        out0 = jnp.zeros(qb.shape, jnp.float32)
         lse0 = jnp.full((bh, blk, fa._LANES), neg, jnp.float32)
         (out, lse, _), _ = jax.lax.scan(
             tick, (out0, lse0, (kb, vb)), jnp.arange(n))
-        return out, lse
+        return out.astype(qb.dtype), lse
 
     @jax.custom_vjp
     def ring(qb, kb, vb):
@@ -272,7 +274,10 @@ def ring_flash_attention(q, k, v, *, mesh, axis="sep", causal=True,
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if interpret is None:
-        interpret = not fa._on_tpu()
+        # the kernels run on the mesh's devices, which may differ from the
+        # process default (axon tunnel keeps default backend 'tpu' even
+        # when the mesh is built from cpu devices)
+        interpret = mesh.devices.flat[0].platform != "tpu"
     local_ring = _flash_ring_local(axis, n, blk, float(scale),
                                    bool(causal), bool(interpret))
 
