@@ -55,13 +55,23 @@ def is_available() -> bool:
     return _HAS_PALLAS
 
 
+_platform_cache = None
+
+
 def _on_tpu() -> bool:
     # NOTE: under the axon TPU tunnel jax reports backend "tpu" even when
     # JAX_PLATFORMS=cpu is set, so check the actual default device platform.
-    try:
-        return jnp.zeros(1).devices().pop().platform == "tpu"
-    except Exception:
-        return False
+    # ensure_compile_time_eval keeps the probe concrete even when called
+    # from inside a jit trace (a traced jnp.zeros is a Tracer whose
+    # .devices() lies); cached because the answer is per-process.
+    global _platform_cache
+    if _platform_cache is None:
+        try:
+            with jax.ensure_compile_time_eval():
+                _platform_cache = jnp.zeros(1).devices().pop().platform
+        except Exception:
+            return False  # transient probe failure: retry next call
+    return _platform_cache == "tpu"
 
 
 def supports(q_shape, dtype, causal) -> bool:
@@ -359,31 +369,117 @@ def _bwd_fused_call(q, k, v, do, out, lse, dk_acc, dv_acc, scale, causal,
     )(q, k, v, do, out, lse, dk_acc, dv_acc)
 
 
+# The aliased dK/dV round-trip (write-back → HBM → re-prefetch) is only
+# trusted when consecutive visits of a kv block are at least this many
+# grid steps apart (one full ki sweep = sk // block_k steps). Below it
+# the write-back and the next visit's prefetch share a step window, and
+# correctness would hinge on undocumented Mosaic pipeline ordering.
+_REVISIT_MIN = 4
+_alias_checked: set = set()
+
+
+def _bwd_rowloop(q, k, v, do, out, lse, dk_acc, dv_acc, scale, causal,
+                 block_q, block_k, num_q, interpret):
+    """Hazard-free tiled backward: one q-row per pallas call, threading the
+    dk/dv accumulators through as aliased call inputs — each aliased block
+    is visited exactly once per call, so no revisit ordering is relied on.
+    Used by interpret mode (which replays revisited aliased blocks from
+    the original input) and as the compiled fallback when the fused
+    grid's revisit distance would be < _REVISIT_MIN."""
+    dq_rows = []
+    for qi in range(num_q):
+        row = jax.lax.dynamic_slice_in_dim(q, qi * block_q, block_q, 1)
+        do_row = jax.lax.dynamic_slice_in_dim(do, qi * block_q, block_q, 1)
+        out_row = jax.lax.dynamic_slice_in_dim(out, qi * block_q, block_q, 1)
+        lse_row = jax.lax.dynamic_slice_in_dim(lse, qi * block_q, block_q, 1)
+        dq_row, dk_acc, dv_acc = _bwd_fused_call(
+            row, k, v, do_row, out_row, lse_row, dk_acc, dv_acc,
+            scale, causal, block_q, block_k, 1, qi, interpret)
+        dq_rows.append(dq_row)
+    return jnp.concatenate(dq_rows, axis=1), dk_acc, dv_acc
+
+
+def _alias_selfcheck(dtype, d, scale, causal, block_q, block_k, sk):
+    """One-time (per config, per process) on-device check of the fused
+    full-grid backward against the hazard-free per-row path, so a future
+    Mosaic scheduling change that breaks the aliased-accumulator
+    round-trip fails loudly instead of training on wrong gradients.
+    Runs eagerly (concrete inputs) even when called from inside a trace."""
+    from ...utils import flags as _flags
+
+    key = (str(dtype), d, causal, block_q, block_k, sk)
+    if key in _alias_checked or not _flags.get_flag(
+            "FLAGS_pallas_alias_selfcheck"):
+        return
+    sq = 2 * block_q  # >= 2 q-rows so every kv block is revisited
+
+    # _bwd is typically being traced inside a jit backward when this runs;
+    # the check must execute eagerly, so run it in a fresh thread (trace
+    # contexts are thread-local — a new thread has none active).
+    def _run():
+        rng = np.random.default_rng(0)
+        mk = lambda s: jnp.asarray(  # noqa: E731
+            rng.standard_normal((1, s, d)) * 0.5, dtype)
+        q, do = mk(sq), mk(sq)
+        k, v = mk(sk), mk(sk)
+        out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, False)
+        z = lambda: jnp.zeros((1, sk, d), jnp.float32)  # noqa: E731
+        dq_f, dk_f, dv_f = _bwd_fused_call(
+            q, k, v, do, out, lse, z(), z(), scale, causal, block_q,
+            block_k, sq // block_q, 0, False)
+        dq_r, dk_r, dv_r = _bwd_rowloop(
+            q, k, v, do, out, lse, z(), z(), scale, causal, block_q,
+            block_k, sq // block_q, False)
+        return {name: float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                            - b.astype(jnp.float32))))
+                for name, a, b in (("dq", dq_f, dq_r), ("dk", dk_f, dk_r),
+                                   ("dv", dv_f, dv_r))}
+
+    import concurrent.futures
+    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+        errs = pool.submit(_run).result()
+    tol = 1e-5 if dtype == jnp.float32 else 2e-2
+    for name, err in errs.items():
+        if not err < tol:
+            raise RuntimeError(
+                f"pallas flash backward self-check FAILED ({name} max err "
+                f"{err:.3e}, tol {tol:.0e}, config {key}): the aliased "
+                "dK/dV accumulator round-trip no longer matches the "
+                "hazard-free path — a Mosaic pipeline-ordering change "
+                "likely broke input_output_aliases revisits. Set "
+                "FLAGS_pallas_flash_min_seqlen high to route attention "
+                "to XLA, and report this.")
+    _alias_checked.add(key)  # only memoize a PASSING check
+
+
 def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
     num_q = sq // block_q
     dk_acc = jnp.zeros((bh, sk, d), jnp.float32)
     dv_acc = jnp.zeros((bh, sk, d), jnp.float32)
-    if not interpret:
+    # with a single q-row every kv block is visited exactly once — no
+    # revisit, no hazard, keep the full fused grid untouched
+    if not interpret and num_q == 1:
         dq, dk_acc, dv_acc = _bwd_fused_call(
             q, k, v, do, out, lse, dk_acc, dv_acc, scale, causal,
             block_q, block_k, num_q, 0, interpret)
+        return dq, dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+    # shrink the backward's k-block until the revisit distance is safe
+    # (the forward keeps its own block_k: it has no aliased accumulators)
+    bk = block_k
+    while sk // bk < _REVISIT_MIN and bk % 2 == 0 and (bk // 2) % 128 == 0 \
+            and sk % (bk // 2) == 0:
+        bk //= 2
+    if not interpret and sk // bk >= _REVISIT_MIN:
+        _alias_selfcheck(q.dtype, d, scale, causal, block_q, bk, sk)
+        dq, dk_acc, dv_acc = _bwd_fused_call(
+            q, k, v, do, out, lse, dk_acc, dv_acc, scale, causal,
+            block_q, bk, num_q, 0, interpret)
     else:
-        # interpret mode replays the revisited aliased blocks from the
-        # original input, so run one q-row per call and thread the
-        # accumulators through (each dk/dv block visited once per call).
-        dq_rows = []
-        for qi in range(num_q):
-            row = jax.lax.dynamic_slice_in_dim(q, qi * block_q, block_q, 1)
-            do_row = jax.lax.dynamic_slice_in_dim(do, qi * block_q, block_q, 1)
-            out_row = jax.lax.dynamic_slice_in_dim(out, qi * block_q, block_q, 1)
-            lse_row = jax.lax.dynamic_slice_in_dim(lse, qi * block_q, block_q, 1)
-            dq_row, dk_acc, dv_acc = _bwd_fused_call(
-                row, k, v, do_row, out_row, lse_row, dk_acc, dv_acc,
-                scale, causal, block_q, block_k, 1, qi, interpret)
-            dq_rows.append(dq_row)
-        dq = jnp.concatenate(dq_rows, axis=1)
+        dq, dk_acc, dv_acc = _bwd_rowloop(
+            q, k, v, do, out, lse, dk_acc, dv_acc, scale, causal,
+            block_q, block_k, num_q, interpret)
     return dq, dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
 
 

@@ -77,6 +77,11 @@ define_flag("FLAGS_fused_ce_chunks", 4,
             "token-chunk count for fused_linear_cross_entropy: logits are "
             "computed per chunk and discarded instead of materializing the "
             "full [tokens, vocab] fp32 matrix")
+define_flag("FLAGS_pallas_alias_selfcheck", True,
+            "one-time per-config on-device check that the fused flash "
+            "backward's aliased dK/dV HBM accumulation matches the "
+            "hazard-free per-q-row path; fails loudly if a Mosaic "
+            "pipeline-ordering change silently corrupts gradients")
 define_flag("FLAGS_pallas_flash_min_seqlen", 1024,
             "min seq len to route scaled_dot_product_attention to the "
             "pallas flash kernel. Measured on v5e (h16 d64 bf16, fwd+bwd "
