@@ -203,8 +203,14 @@ class Jacobian:
             r = self._rows.get(i)
             if r is None:
                 if zero is None:
-                    any_row = next(iter(self._rows.values()))
-                    zero = jnp.zeros_like(any_row)
+                    if self._rows:
+                        zero = jnp.zeros_like(
+                            next(iter(self._rows.values())))
+                    else:  # empty selection (e.g. jac[0:0]): no cached row
+                        n = self.shape[-1]
+                        shape = ((self.shape[0], n) if self._batched
+                                 else (n,))
+                        zero = jnp.zeros(shape, self._xs._data.dtype)
                 r = zero
             rows.append(r)
         axis = 1 if self._batched else 0

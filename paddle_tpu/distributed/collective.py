@@ -391,6 +391,7 @@ class _P2PTask:
 # value the real exchange would deliver, since all ranks run this same
 # code on the same process-local data. destroy_process_group drains it.
 _p2p_mailbox: dict[tuple, tuple] = {}
+_p2p_multidst_warned: list = []  # once-per-process latch
 
 
 def _p2p_box(group):
@@ -419,7 +420,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
             "branches don't trace); use p2p_permute() / the pipeline ring")
     if not 0 <= dst < group.nranks:
         raise ValueError(f"dst {dst} out of range for {group!r}")
-    _p2p_box(group).append(t._data)
+    _p2p_box(group).append((int(dst), t._data))
     return _P2PTask()
 
 
@@ -441,7 +442,28 @@ def recv(tensor, src=0, group=None, sync_op=True):
         raise RuntimeError(
             f"recv(src={src}): no matching send in flight (single-"
             "controller p2p completes in-process; send must happen first)")
-    data = box.popleft()
+    # The single-controller mailbox delivers in send order. That is correct
+    # for translation-symmetric SPMD patterns — including bidirectional
+    # halo exchanges (two dsts in flight), where every rank issues the
+    # same sends/recvs in the same program order — but it cannot verify a
+    # genuinely non-symmetric pattern (e.g. rank 0 sending different
+    # tensors to ranks 1 and 2), which would silently deliver the oldest
+    # send to the wrong logical receiver. Warn once per process when
+    # multiple distinct dsts are in flight so that case is auditable.
+    dsts = {d for d, _ in box}
+    if len(dsts) > 1 and not _p2p_multidst_warned:
+        import warnings
+
+        _p2p_multidst_warned.append(True)
+        warnings.warn(
+            f"recv(src={src}): sends to multiple distinct dst ranks "
+            f"{sorted(dsts)} are in flight; the in-process mailbox "
+            "delivers in send order, which is only correct for "
+            "symmetric SPMD p2p programs (every rank issuing the same "
+            "sends/recvs in the same order). For non-symmetric patterns "
+            "use p2p_permute() inside traced code.", RuntimeWarning,
+            stacklevel=2)
+    _, data = box.popleft()
     if tuple(data.shape) != tuple(t._data.shape):
         raise ValueError(
             f"recv buffer shape {tuple(t._data.shape)} != sent shape "

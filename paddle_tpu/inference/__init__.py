@@ -71,13 +71,19 @@ class Predictor:
         self._outputs = []
 
     def get_input_names(self):
-        return [f"x{i}" for i in range(max(1, len(self._inputs) or 1))]
+        # arity from the saved artifact (jit.save records it), so the
+        # reference workflow — get_input_names() first, then bind each —
+        # works for multi-input servables; fall back to bound handles
+        # for pre-arity artifacts
+        n = getattr(self._layer, "num_inputs", None)
+        return [f"x{i}" for i in range(n or max(1, len(self._inputs)))]
 
     def get_input_handle(self, name):
         return self._inputs.setdefault(name, _Handle())
 
     def get_output_names(self):
-        return [f"out{i}" for i in range(max(1, len(self._outputs) or 1))]
+        n = getattr(self._layer, "num_outputs", None)
+        return [f"out{i}" for i in range(n or max(1, len(self._outputs)))]
 
     def get_output_handle(self, name):
         idx = int(name[3:]) if name.startswith("out") else 0

@@ -189,6 +189,10 @@ def save(layer, path, input_spec=None, **config):
         _json.dump({
             "param_keys": [k for k in sd_keys if k in named_p],
             "buffer_keys": [k for k in sd_keys if k not in named_p],
+            # arity for inference front-ends (Predictor.get_input_names
+            # must work before any handle is bound)
+            "num_inputs": len(examples),
+            "num_outputs": len(exported.out_avals),
         }, f)
 
 
@@ -196,10 +200,13 @@ class TranslatedLayer:
     """What jit.load returns: a callable inference program rebound to its
     saved params (reference TranslatedLayer role)."""
 
-    def __init__(self, exported, param_datas, buffer_datas):
+    def __init__(self, exported, param_datas, buffer_datas,
+                 num_inputs=None, num_outputs=None):
         self._exported = exported
         self._params = param_datas
         self._buffers = buffer_datas
+        self.num_inputs = num_inputs    # None for pre-arity artifacts
+        self.num_outputs = num_outputs
 
     def __call__(self, *xs):
         from ..framework.tensor import Tensor
@@ -242,4 +249,6 @@ def load(path, **config):
     state = fio.load(path + ".pdparams", return_numpy=True)
     params = [state[k] for k in meta["param_keys"]]
     buffers = [state[k] for k in meta["buffer_keys"]]
-    return TranslatedLayer(exported, params, buffers)
+    return TranslatedLayer(exported, params, buffers,
+                           num_inputs=meta.get("num_inputs"),
+                           num_outputs=meta.get("num_outputs"))

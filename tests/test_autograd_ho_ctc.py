@@ -67,6 +67,16 @@ class TestJacobianHessian:
         np.testing.assert_allclose(np.asarray(J[0]._data), [3.0, 0.0],
                                    rtol=1e-5)
 
+    def test_jacobian_empty_selection(self):
+        """jac[0:0] evaluates no rows; assembly must not depend on a
+        cached row existing."""
+        x = paddle.to_tensor(np.array([1.0, 2.0]), dtype="float32")
+        x.stop_gradient = False
+        y = x * x
+        J = autograd.jacobian(y, x)
+        out = J[0:0]
+        assert np.asarray(out._data).shape[0] == 0
+
     def test_jacobian_ys_form_tuple_xs(self):
         x1 = paddle.to_tensor(np.array([1.0, 2.0, 3.0]), dtype="float32")
         x2 = paddle.to_tensor(np.array([4.0, 5.0, 6.0]), dtype="float32")

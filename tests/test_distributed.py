@@ -143,6 +143,33 @@ class TestP2P:
         dist.recv(b, src=0)
         assert float(a.numpy()[0]) == 1.0 and float(b.numpy()[0]) == 2.0
 
+    def test_multi_dst_in_flight_warns_but_delivers(self):
+        """Multiple distinct dsts in flight: FIFO is still correct for
+        symmetric patterns (e.g. bidirectional halo exchange), so the
+        mailbox delivers — with a once-per-process audit warning."""
+        import warnings as _w
+
+        from paddle_tpu.distributed import collective as _c
+
+        mesh = Mesh(np.asarray(cpu8()), ("dp",))
+        denv.set_mesh(mesh)
+        _c._p2p_multidst_warned.clear()
+        try:
+            # every rank: send fwd to r+1, send bwd to r-1, recv both
+            dist.send(paddle.to_tensor([1.0]), dst=1)
+            dist.send(paddle.to_tensor([2.0]), dst=3)
+            a, b = paddle.to_tensor([0.0]), paddle.to_tensor([0.0])
+            with _w.catch_warnings(record=True) as rec:
+                _w.simplefilter("always")
+                dist.recv(a, src=3)
+                dist.recv(b, src=1)
+            assert any("distinct dst" in str(r.message) for r in rec)
+            assert float(a.numpy()[0]) == 1.0
+            assert float(b.numpy()[0]) == 2.0
+        finally:
+            _c._p2p_mailbox.clear()
+            _c._p2p_multidst_warned.clear()
+
     def test_shape_mismatch_raises(self):
         mesh = Mesh(np.asarray(cpu8()), ("dp",))
         denv.set_mesh(mesh)

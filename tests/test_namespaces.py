@@ -340,3 +340,33 @@ class TestInferencePredictor:
         pred.run()
         got = pred.get_output_handle("out0").copy_to_cpu()
         np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_multi_input_names_before_binding(self, tmp_path):
+        """Reference workflow: get_input_names() FIRST to discover arity,
+        then bind each handle — needs the saved artifact's input spec."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference, jit
+
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, a, b):
+                return self.fc(a + b)
+
+        net = TwoIn()
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        want = np.asarray(net(x, x)._data)
+        path = str(tmp_path / "m2in")
+        jit.save(net, path, input_spec=[x, x])
+        pred = inference.create_predictor(inference.Config(path))
+        names = pred.get_input_names()       # before any handle bound
+        assert names == ["x0", "x1"]
+        assert pred.get_output_names() == ["out0"]
+        for n in names:
+            pred.get_input_handle(n).copy_from_cpu(
+                np.ones((3, 4), np.float32))
+        pred.run()
+        np.testing.assert_allclose(
+            pred.get_output_handle("out0").copy_to_cpu(), want, rtol=1e-5)
