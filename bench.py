@@ -1,12 +1,13 @@
 """Driver benchmark: flagship GPT training throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The reference publishes no in-tree numbers (BASELINE.md), so vs_baseline is
 reported against the north-star target qualitatively as null.
 
-Runs a bf16 GPT (350M-class by default; override with BENCH_MODEL/BENCH_BS/
-BENCH_SEQ env vars) through the whole-step-compiled TrainStep (one fused XLA
-program per step: forward + backward + AdamW with fp32 master weights).
+Primary metric (BASELINE.md north star): gpt3-1.3b tokens/sec/chip —
+bf16 params + fp32 master weights, AdamW, whole-step-compiled TrainStep.
+A gpt3-350m line is kept as `secondary` for round-over-round continuity.
+Override with BENCH_MODEL/BENCH_BS/BENCH_SEQ/BENCH_SECONDARY env vars.
 """
 from __future__ import annotations
 
@@ -17,7 +18,20 @@ import time
 import numpy as np
 
 
-def main():
+def _setup_jax():
+    import jax
+
+    # persistent compile cache: the 1.3b step compile is minutes cold, ~s
+    # warm; the driver window is 580s so cold-compile must not recur
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
+def run_config(model_name, batch, seq, steps, recompute, remat_policy,
+               offload_masters):
     import jax
 
     import paddle_tpu as paddle
@@ -27,19 +41,11 @@ def main():
         GPTForCausalLM, GPTPretrainingCriterion, gpt_config,
     )
 
-    model_name = os.environ.get("BENCH_MODEL", "gpt3-350m")
-    seq = int(os.environ.get("BENCH_SEQ", "1024"))
-    batch = int(os.environ.get("BENCH_BS", "8"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
-
-    # recompute default OFF: with bf16 score storage + the logsumexp CE the
-    # 350m/bs8/seq1024 step fits in 16G HBM without remat (35.9k tok/s vs
-    # 31.9k with it) — PERF.md round-2 sweep
     cfg = gpt_config(model_name, max_position_embeddings=seq,
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                     use_recompute=os.environ.get("BENCH_RECOMPUTE", "0") == "1",
-                     recompute_policy=os.environ.get("BENCH_REMAT_POLICY",
-                                                     "dots") or None)
+                     use_recompute=recompute,
+                     recompute_policy=remat_policy or None)
+    paddle.seed(0)
     model = GPTForCausalLM(cfg)
     # bf16 params + fp32 master weights — the TPU-native AMP O2 layout
     model.bfloat16()
@@ -49,7 +55,8 @@ def main():
                      moment_dtype=("bfloat16"
                                    if os.environ.get("BENCH_BF16_MOMENTS",
                                                      "1") == "1"
-                                   else None))
+                                   else None),
+                     offload_master_weights=offload_masters)
 
     if os.environ.get("BENCH_FUSED_CE", "0") == "1":
         # fused LM head: chunked logsumexp, no [tokens, vocab] logits at
@@ -84,22 +91,54 @@ def main():
     # 12*L*h*s (QK^T + PV, fwd+bwd, causal ~halves but count full per
     # PaLM-appendix convention); peak from the chip generation.
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    flops_per_token = (6 * n_params
+                       + 12 * cfg.num_layers * cfg.hidden_size * seq)
     peaks = {"v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12,
              "v4": 275e12, "v6e": 918e12}
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
     peak = next((v for k, v in peaks.items() if gen.startswith(k)), 197e12)
     mfu = tokens_per_sec * flops_per_token / peak
-    print(json.dumps({
+    return {
         "metric": f"{model_name}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": None,
         "mfu": round(mfu, 4),
         "config": {"batch": batch, "seq": seq, "steps": steps,
-                   "params": n_params,
-                   "recompute": cfg.use_recompute},
-    }))
+                   "params": n_params, "recompute": cfg.use_recompute,
+                   "remat_policy": remat_policy or None,
+                   "offload_masters": offload_masters},
+    }
+
+
+def main():
+    _setup_jax()
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt3-1.3b")
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    batch = int(os.environ.get("BENCH_BS", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    # 1.3b on one 16G chip is capacity-bound: 13G param+optimizer state
+    # (PERF.md), so remat is mandatory there but off for 350m-class
+    big = "1.3b" in model_name or "2.7b" in model_name
+    recompute = os.environ.get("BENCH_RECOMPUTE", "1" if big else "0") == "1"
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "dots")
+    offload = os.environ.get("BENCH_OFFLOAD", "1" if big else "0") == "1"
+
+    result = run_config(model_name, batch, seq, steps, recompute,
+                        remat_policy, offload)
+
+    secondary_name = os.environ.get("BENCH_SECONDARY",
+                                    "gpt3-350m" if big else "")
+    if secondary_name:
+        # pinned historical config (round-over-round continuity is the
+        # point — BENCH_BS/BENCH_SEQ overrides apply to the primary only)
+        sec = run_config(secondary_name, batch=8, seq=1024, steps=steps,
+                         recompute=False, remat_policy="",
+                         offload_masters=False)
+        result["secondary"] = sec
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -79,6 +79,14 @@ def is_initialized() -> bool:
     return _state["initialized"]
 
 
+def reset():
+    """Drop the ambient mesh/degrees (tests and single-device reference
+    runs next to a hybrid run use this; fleet re-init starts clean)."""
+    _state["initialized"] = False
+    _state["mesh"] = None
+    _state["axis_degrees"] = {}
+
+
 def pin_sharding(x, sharding):
     """Pin a raw jax value to a sharding: `with_sharding_constraint` under
     trace, `device_put` eager. The one shared home for this dispatch rule
