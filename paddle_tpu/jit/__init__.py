@@ -17,6 +17,7 @@ import functools
 import jax
 
 from ..framework.tensor import Tensor
+from . import dy2static
 from .train_step import TrainStep, _tree_data, _tree_wrap
 
 __all__ = ["to_static", "TrainStep", "not_to_static", "ignore_module", "save", "load"]
@@ -31,23 +32,45 @@ class StaticFunction:
     """
 
     def __init__(self, fn, layer=None, full_graph=True):
-        self._fn = fn
+        self._orig_fn = fn
         self._layer = layer
-        self._jitted = None
+        self._jitted = {}         # (treedef, statics) -> compiled fn
+        self._eager = False       # set when tracing proves unconvertible
+        # dy2static AST pass: rewrite tensor-dependent if/while/for into
+        # lax.cond/while_loop calls (reference jit/dy2static/, see
+        # dy2static.py). Unconvertible sources keep the original function
+        # (plain tracing still handles tensor-free control flow).
+        try:
+            self._fn, self._n_converted = dy2static.convert_function(fn)
+        except dy2static.ConversionError:
+            self._fn, self._n_converted = fn, 0
         functools.update_wrapper(self, fn)
 
-    def _build(self):
+    def _build(self, treedef, static_items):
+        """Compile for one (tree structure, static-leaf values) signature.
+        Non-array leaves (python scalars, strings, None) are trace-time
+        CONSTANTS — dygraph semantics, where only Tensors are data — so
+        `if flag:` over a python bool stays a Python branch."""
         layer = self._layer
+        static_map = dict(static_items)
+
+        def reassemble(dyn_leaves):
+            leaves, d = [], iter(dyn_leaves)
+            n = treedef.num_leaves
+            for i in range(n):
+                leaves.append(static_map[i] if i in static_map
+                              else next(d))
+            return jax.tree_util.tree_unflatten(treedef, leaves)
 
         if layer is None:
-            def pure(batch):
-                out = self._fn(*_tree_wrap(batch))
+            def pure(dyn):
+                out = self._fn(*_tree_wrap(reassemble(dyn)))
                 return _tree_data(out)
         else:
             params = list(layer.parameters())
             buffers = list(layer.buffers())
 
-            def pure(state, batch):
+            def pure(state, dyn):
                 saved_p = [p._data for p in params]
                 saved_b = [b._data for b in buffers]
                 for p, d in zip(params, state[0]):
@@ -55,7 +78,7 @@ class StaticFunction:
                 for b, d in zip(buffers, state[1]):
                     b._data = d
                 try:
-                    out = self._fn(*_tree_wrap(batch))
+                    out = self._fn(*_tree_wrap(reassemble(dyn)))
                 finally:
                     for p, d in zip(params, saved_p):
                         p._data = d
@@ -63,21 +86,65 @@ class StaticFunction:
                         b._data = d
                 return _tree_data(out)
 
-        self._jitted = jax.jit(pure)
+        return jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
         if kwargs:
             raise TypeError("to_static-compiled callables take positional "
                             "Tensor args only")
-        if self._jitted is None:
-            self._build()
+        if self._eager:
+            return self._orig_fn(*args)
         batch = _tree_data(list(args))
-        if self._layer is None:
-            out = self._jitted(batch)
-        else:
-            state = ([p._data for p in self._layer.parameters()],
-                     [b._data for b in self._layer.buffers()])
-            out = self._jitted(state, batch)
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        import numpy as _np
+
+        static_items = tuple(
+            (i, l) for i, l in enumerate(leaves)
+            if not isinstance(l, (jax.Array, _np.ndarray)))
+        static_idx = {i for i, _ in static_items}
+        dyn = [l for i, l in enumerate(leaves) if i not in static_idx]
+        try:
+            key = (treedef, static_items)
+            hash(key)
+        except TypeError:  # unhashable static leaf: trace fresh each call
+            key = None
+        jitted = self._jitted.get(key) if key is not None else None
+        if jitted is None:
+            jitted = self._build(treedef, static_items)
+            if key is not None:
+                self._jitted[key] = jitted
+        try:
+            if self._layer is None:
+                out = jitted(dyn)
+            else:
+                state = ([p._data for p in self._layer.parameters()],
+                         [b._data for b in self._layer.buffers()])
+                out = jitted(state, dyn)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.UnexpectedTracerError,
+                dy2static.Unsupported,
+                TypeError) as e:
+            # TypeError included: lax.cond/while reject non-array branch
+            # outputs (strings, dicts mutated in place, ...) with it. The
+            # eager re-run below surfaces any GENUINE user TypeError
+            # unchanged, so widening here cannot mask real bugs.
+            # the documented dy2static fallback contract: control flow the
+            # converter couldn't stage (return-in-branch, tensor-iterated
+            # for, ...) runs EAGERLY with a warning instead of crashing
+            import warnings
+
+            warnings.warn(
+                f"to_static({getattr(self._orig_fn, '__name__', '?')}): "
+                f"data-dependent control flow could not be compiled "
+                f"({type(e).__name__}); falling back to eager execution. "
+                "Restructure with convertible if/while (no "
+                "return/break inside tensor-dependent branches) to "
+                "compile.", RuntimeWarning, stacklevel=2)
+            self._eager = True
+            return self._orig_fn(*args)
         return _tree_wrap(out)
 
     @property

@@ -1,0 +1,543 @@
+"""dy2static — AST control-flow conversion for `to_static`.
+
+Reference parity: python/paddle/jit/dy2static/ (entry jit/api.py:195; the
+control-flow converters live in convert_operators.py). The reference
+rewrites Python `if`/`while`/`for` whose conditions depend on tensor
+values into `paddle.static.nn.cond`/`while_loop` ops; here the same AST
+rewrite targets `jax.lax.cond`/`jax.lax.while_loop`, so data-dependent
+control flow compiles into the XLA program instead of failing in the
+`jax.jit` trace (TPU-first: compiler-friendly control flow, no Python
+branching inside jit).
+
+Shape of the rewrite (mirroring dy2static's convert_ifelse contract):
+
+    if cond:            def _t(ctx):                # true branch
+        x = x + 1           x, = ctx
+    else:                   x = x + 1
+        x = x - 1           return (x,)
+                        def _f(ctx): ...            # false branch
+                        (x,) = _jst.convert_ifelse(cond, _t, _f, (x,))
+
+The carried names are the union of names assigned in either branch (the
+reference computes the same "modified vars" set). `while` carries the
+names assigned in the body plus those read by the condition; `for i in
+range(...)` lowers to the while form. Conditions' `and`/`or`/`not`
+convert to lazy logical helpers (convert_logical_and/or/not parity).
+
+Conversion limits (converted statements containing these stay plain
+Python, which still traces fine for non-tensor conditions; a tensor
+condition then falls back to EAGER execution with a warning — the
+documented dy2static fallback contract):
+  * return/break/continue/yield inside a converted branch or loop body
+  * names assigned in only one branch and unbound before the `if`
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+# reserved name injected into the target module globals for the rewritten
+# code to reach the runtime converters (collision-safe, dunder-style)
+_JST = "__paddle_tpu_jst__"
+
+__all__ = ["convert_function", "convert_ifelse", "convert_while",
+           "logical_and", "logical_or", "logical_not", "ConversionError"]
+
+
+class ConversionError(RuntimeError):
+    pass
+
+
+class Unsupported(RuntimeError):
+    """Raised mid-trace when a converted statement cannot be staged (e.g.
+    a name assigned in only one branch and unbound before the `if`);
+    StaticFunction catches it and falls back to eager."""
+
+
+class _Undef:
+    """UndefinedVar parity (reference dy2static/utils.py): placeholder for
+    carried names with no binding before the converted statement. Any use
+    raises UnboundLocalError (python would raise NameError at the read
+    site; the converted form binds the name to this sentinel instead, so
+    the sentinel must be loud rather than silently truthy)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "variable referenced before assignment (it was assigned in "
+            "only one branch of converted control flow — dy2static "
+            "UndefinedVar)")
+
+    __bool__ = __len__ = __iter__ = __index__ = __int__ = __float__ = \
+        __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = \
+        __truediv__ = __rtruediv__ = __lt__ = __le__ = __gt__ = __ge__ = \
+        __call__ = __getitem__ = _raise
+
+
+_UNDEF = _Undef()
+
+
+def _load(fn):
+    """Load a carried name tolerating unboundness (generated code passes
+    `_jst._load(lambda: name)`)."""
+    try:
+        return fn()
+    except (NameError, UnboundLocalError):
+        return _UNDEF
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (the `_jst` namespace the rewritten code calls)
+# ---------------------------------------------------------------------------
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_tensorish(x):
+    return isinstance(x, (Tensor, jax.Array)) or isinstance(
+        x, jax.core.Tracer)
+
+
+def _ctx_to_jax(ctx):
+    """Carried-state pytree → jax arrays (python scalars become weakly
+    typed jax scalars so the carry has stable avals across iterations)."""
+    out = []
+    for v in ctx:
+        v = _unwrap(v)
+        if isinstance(v, (bool, int, float)):
+            v = jnp.asarray(v)
+        out.append(v)
+    return tuple(out)
+
+
+def _ctx_wrap(ctx):
+    """jax arrays → Tensors for the branch/body code (which runs paddle
+    ops); non-arrays pass through."""
+    return tuple(Tensor._wrap(v) if isinstance(v, (jax.Array,))
+                 or isinstance(v, jax.core.Tracer) else v for v in ctx)
+
+
+def convert_ifelse(pred, true_fn, false_fn, ctx):
+    """Reference convert_operators.convert_ifelse: tensor predicate →
+    lax.cond over the carried names; python predicate → plain branch.
+
+    Carried slots holding _UNDEF (no binding before the `if`) are fed to
+    the branch code as-is; both branches must then assign them — a branch
+    returning _UNDEF for such a slot cannot be staged (Unsupported)."""
+    p = _unwrap(pred)
+    if isinstance(p, jax.core.Tracer):
+        defined = [i for i, v in enumerate(ctx) if v is not _UNDEF]
+        init = _ctx_to_jax([ctx[i] for i in defined])
+
+        def _run(branch_fn, c):
+            full = list(ctx)
+            w = _ctx_wrap(c)
+            for j, i in enumerate(defined):
+                full[i] = w[j]
+            out = branch_fn(tuple(full))
+            for v in out:
+                if v is _UNDEF:
+                    raise Unsupported(
+                        "a name assigned in only one branch of a "
+                        "tensor-dependent `if` has no binding before it")
+            return _ctx_to_jax(out)
+
+        out = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                           lambda c: _run(true_fn, c),
+                           lambda c: _run(false_fn, c), init)
+        return _ctx_wrap(out)
+    if isinstance(p, jax.Array):
+        p = bool(p)  # concrete tensor: eager semantics
+    return true_fn(ctx) if p else false_fn(ctx)
+
+
+def convert_while(cond_fn, body_fn, ctx):
+    """Reference convert_operators.convert_while_loop: tensor condition →
+    lax.while_loop; python condition → plain loop."""
+    first = cond_fn(ctx)
+    p = _unwrap(first)
+    if isinstance(p, jax.core.Tracer):
+        if any(v is _UNDEF for v in ctx):
+            raise Unsupported(
+                "a name assigned inside a tensor-dependent `while` has no "
+                "binding before the loop (zero-iteration value unknown)")
+        init = _ctx_to_jax(ctx)
+
+        def _cond(c):
+            return jnp.reshape(_unwrap(cond_fn(_ctx_wrap(c))), ()).astype(
+                bool)
+
+        def _body(c):
+            return _ctx_to_jax(body_fn(_ctx_wrap(c)))
+
+        # stabilize the carry: one body pass may promote dtypes (e.g.
+        # python-int counter -> weak i32 vs strong i64); while_loop needs
+        # identical avals, so seed with the body's output structure
+        stable = jax.eval_shape(_body, init)
+        init = tuple(jnp.asarray(v, dtype=s.dtype)
+                     for v, s in zip(init, stable))
+        out = jax.lax.while_loop(_cond, _body, init)
+        return _ctx_wrap(out)
+    while bool(p):
+        ctx = body_fn(ctx)
+        p = _unwrap(cond_fn(ctx))
+    return ctx
+
+
+def logical_and(lhs_fn, rhs_fn):
+    """Short-circuit-preserving `and` (convert_logical_and parity)."""
+    lhs = lhs_fn()
+    l = _unwrap(lhs)
+    if not (isinstance(l, jax.core.Tracer) or isinstance(l, jax.Array)):
+        return lhs and rhs_fn()
+    r = _unwrap(rhs_fn())
+    return Tensor._wrap(jnp.logical_and(jnp.asarray(l, bool),
+                                        jnp.asarray(r, bool)))
+
+
+def logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    l = _unwrap(lhs)
+    if not (isinstance(l, jax.core.Tracer) or isinstance(l, jax.Array)):
+        return lhs or rhs_fn()
+    r = _unwrap(rhs_fn())
+    return Tensor._wrap(jnp.logical_or(jnp.asarray(l, bool),
+                                       jnp.asarray(r, bool)))
+
+
+def logical_not(x):
+    v = _unwrap(x)
+    if isinstance(v, jax.core.Tracer) or isinstance(v, jax.Array):
+        return Tensor._wrap(jnp.logical_not(jnp.asarray(v, bool)))
+    return not x
+
+
+# ---------------------------------------------------------------------------
+# AST pass
+# ---------------------------------------------------------------------------
+
+_BLOCKERS = (ast.Return, ast.Break, ast.Continue, ast.Yield, ast.YieldFrom,
+             ast.Global, ast.Nonlocal)
+
+
+def _has_blocker(nodes):
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, _BLOCKERS):
+                return True
+    return False
+
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names (re)bound by a statement list — the carried-state set (the
+    reference's "modified vars in the block" analysis)."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)   # binds the name; don't descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    @classmethod
+    def of(cls, nodes):
+        v = cls()
+        for n in nodes:
+            v.visit(n)
+        return v.names
+
+
+class _ReadNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+    @classmethod
+    def of(cls, node):
+        v = cls()
+        v.visit(node)
+        return v.names
+
+
+class _CondExprTransformer(ast.NodeTransformer):
+    """Inside converted conditions only: and/or/not → lazy helpers."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("logical_and" if isinstance(node.op, ast.And)
+              else "logical_or")
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
+                                   attr=fn, ctx=ast.Load()),
+                args=[ast.Lambda(args=_EMPTY_ARGS, body=expr),
+                      ast.Lambda(args=_EMPTY_ARGS, body=rhs)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
+                                   attr="logical_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+
+_EMPTY_ARGS = ast.arguments(posonlyargs=[], args=[], vararg=None,
+                            kwonlyargs=[], kw_defaults=[], kwarg=None,
+                            defaults=[])
+
+
+def _ctx_tuple(names, ctx):
+    return ast.Tuple([ast.Name(n, ctx()) for n in names], ctx())
+
+
+def _ctx_load_guarded(names):
+    """( _jst._load(lambda: a), _jst._load(lambda: b) ) — tolerates names
+    with no binding before the converted statement (UndefinedVar parity)."""
+    elems = [
+        ast.Call(
+            func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
+                               attr="_load", ctx=ast.Load()),
+            args=[ast.Lambda(args=_EMPTY_ARGS,
+                             body=ast.Name(n, ast.Load()))],
+            keywords=[])
+        for n in names]
+    return ast.Tuple(elems, ast.Load())
+
+
+def _make_branch_fn(name, carried, body):
+    """def <name>(__ctx): (a, b) = __ctx; BODY; return (a, b)"""
+    stmts = []
+    if carried:
+        stmts.append(ast.Assign(
+            targets=[_ctx_tuple(carried, ast.Store)],
+            value=ast.Name("__ctx", ast.Load())))
+    stmts.extend(body)
+    stmts.append(ast.Return(_ctx_tuple(carried, ast.Load)))
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg("__ctx")], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+    return ast.FunctionDef(name=name, args=args, body=stmts,
+                           decorator_list=[], returns=None)
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.count = 0
+        self.converted = 0
+
+    def _uid(self):
+        self.count += 1
+        return self.count
+
+    # nested defs/lambdas keep their own semantics — only the decorated
+    # function's own statements convert (decorate inner fns separately,
+    # the reference's convert_call recursion is out of scope)
+    def _visit_stmts(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+    def visit_FunctionDef(self, node):
+        return node  # don't descend into nested defs
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_If(self, node):
+        body = self._visit_stmts(node.body)
+        orelse = self._visit_stmts(node.orelse)
+        if _has_blocker(body) or _has_blocker(orelse):
+            return ast.If(test=node.test, body=body, orelse=orelse)
+        carried = sorted(n for n in (_AssignedNames.of(body)
+                                     | _AssignedNames.of(orelse))
+                         if not n.startswith("__dy2st_"))
+        i = self._uid()
+        self.converted += 1
+        test = _CondExprTransformer().visit(node.test)
+        tname, fname = f"__dy2st_true_{i}", f"__dy2st_false_{i}"
+        tfn = _make_branch_fn(tname, carried, body)
+        ffn = _make_branch_fn(
+            fname, carried, orelse or [ast.Pass()])
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
+                               attr="convert_ifelse", ctx=ast.Load()),
+            args=[test, ast.Name(tname, ast.Load()),
+                  ast.Name(fname, ast.Load()),
+                  _ctx_load_guarded(carried)],
+            keywords=[])
+        assign = (ast.Assign(targets=[_ctx_tuple(carried, ast.Store)],
+                             value=call)
+                  if carried else ast.Expr(call))
+        return [tfn, ffn, assign]
+
+    def visit_While(self, node):
+        body = self._visit_stmts(node.body)
+        if _has_blocker(body) or node.orelse:
+            return ast.While(test=node.test, body=body, orelse=node.orelse)
+        # names the loop rebinds; everything else (loop-invariant reads in
+        # the test or body) resolves through the generated closures
+        carried = sorted(n for n in _AssignedNames.of(body)
+                         if not n.startswith("__dy2st_"))
+        i = self._uid()
+        self.converted += 1
+        test = _CondExprTransformer().visit(node.test)
+        cname, bname = f"__dy2st_cond_{i}", f"__dy2st_body_{i}"
+        cfn = _make_branch_fn(cname, carried, [])
+        cfn.body[-1] = ast.Return(test)  # return COND instead of ctx
+        bfn = _make_branch_fn(bname, carried, body)
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
+                               attr="convert_while", ctx=ast.Load()),
+            args=[ast.Name(cname, ast.Load()), ast.Name(bname, ast.Load()),
+                  _ctx_load_guarded(carried)],
+            keywords=[])
+        assign = (ast.Assign(targets=[_ctx_tuple(carried, ast.Store)],
+                             value=call)
+                  if carried else ast.Expr(call))
+        return [cfn, bfn, assign]
+
+    def visit_For(self, node):
+        """`for i in range(...)` → while form (reference converts for-range
+        through the same while machinery); other iterables untouched."""
+        body = self._visit_stmts(node.body)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        if (not is_range or _has_blocker(body) or node.orelse):
+            return ast.For(target=node.target, iter=node.iter, body=body,
+                           orelse=node.orelse)
+        i = self._uid()
+        a = node.iter.args
+        start = a[0] if len(a) >= 2 else ast.Constant(0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        stp = a[2] if len(a) == 3 else ast.Constant(1)
+        var = node.target.id
+        # an internal counter (__d2sv_ prefix: carried, unlike __dy2st_
+        # helper defs) drives the iteration; the user's loop var is
+        # assigned AT THE TOP of each iteration, so after the loop it
+        # holds the last iterated value (python for-range semantics), not
+        # `stop`. Known deviation: a zero-trip range leaves the var bound
+        # to `start` where python leaves it unbound.
+        it_n = f"__d2sv_it_{i}"
+        stop_n, step_n = f"__dy2st_stop_{i}", f"__dy2st_step_{i}"
+        pre = [
+            ast.Assign(targets=[ast.Name(it_n, ast.Store())], value=start),
+            ast.Assign(targets=[ast.Name(var, ast.Store())],
+                       value=ast.Name(it_n, ast.Load())),
+            ast.Assign(targets=[ast.Name(stop_n, ast.Store())], value=stop),
+            ast.Assign(targets=[ast.Name(step_n, ast.Store())], value=stp),
+        ]
+        # while it < stop (step > 0 assumed for tensor bounds; negative
+        # python steps still work via the python-loop path of
+        # convert_while because the cond stays concrete then)
+        test = ast.Compare(left=ast.Name(it_n, ast.Load()),
+                           ops=[ast.Lt()],
+                           comparators=[ast.Name(stop_n, ast.Load())])
+        bind = ast.Assign(targets=[ast.Name(var, ast.Store())],
+                          value=ast.Name(it_n, ast.Load()))
+        incr = ast.AugAssign(target=ast.Name(it_n, ast.Store()),
+                             op=ast.Add(),
+                             value=ast.Name(step_n, ast.Load()))
+        wnode = ast.While(test=test, body=[bind] + body + [incr], orelse=[])
+        return pre + self.visit_While(wnode)
+
+
+# ---------------------------------------------------------------------------
+# function conversion
+# ---------------------------------------------------------------------------
+
+def convert_function(fn):
+    """AST-convert `fn` (plain function or bound method). Returns
+    (converted_callable, n_converted_statements); raises ConversionError
+    when the source can't be rewritten (caller falls back to `fn`)."""
+    import types as _types
+
+    target = fn.__func__ if inspect.ismethod(fn) else fn
+    if getattr(target, "_paddle_tpu_not_to_static", False):
+        raise ConversionError("marked @not_to_static")
+    try:
+        src = textwrap.dedent(inspect.getsource(target))
+    except (OSError, TypeError) as e:
+        raise ConversionError(f"source unavailable: {e}") from e
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:  # e.g. decorated lambda fragments
+        raise ConversionError(f"unparsable source: {e}") from e
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise ConversionError("not a function definition")
+    fdef.decorator_list = []
+
+    tr = ControlFlowTransformer()
+    fdef.body = tr._visit_stmts(fdef.body)
+    if tr.converted == 0:
+        return fn, 0  # nothing to do — keep the original (zero overhead)
+    # mangle the def name so exec-ing into the LIVE module globals (needed
+    # so later rebinding of module globals stays visible, matching eager
+    # semantics) cannot clobber the original function's binding
+    mangled = f"__dy2st_fn_{fdef.name}"
+    fdef.name = mangled
+    ast.fix_missing_locations(tree)
+
+    has_closure = bool(target.__closure__)
+    if has_closure:
+        # re-exec'd code has no cells; snapshot free vars into a copy of
+        # globals (documented deviation: later cell mutation is invisible)
+        glb = dict(target.__globals__)
+        for name, cell in zip(target.__code__.co_freevars,
+                              target.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError as e:
+                raise ConversionError(
+                    f"unfilled closure cell {name!r}") from e
+    else:
+        glb = target.__globals__    # live view — rebinding stays visible
+    from . import dy2static as _jst_mod
+
+    glb[_JST] = _jst_mod
+    code = compile(tree, filename=f"<dy2static {target.__name__}>",
+                   mode="exec")
+    exec(code, glb)
+    conv = glb.pop(mangled)
+    conv = functools.wraps(target)(conv)
+    conv._dy2static_converted = tr.converted
+    if inspect.ismethod(fn):
+        conv = _types.MethodType(conv, fn.__self__)
+    return conv, tr.converted

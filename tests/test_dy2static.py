@@ -1,0 +1,273 @@
+"""dy2static control-flow conversion tests (reference
+python/paddle/jit/dy2static/convert_operators.py behavior): tensor-
+dependent Python if/while/for compile into the XLA program; unconvertible
+patterns fall back to eager with a warning.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit import dy2static as d2s
+
+
+class TestConvertIf:
+    def test_tensor_if_compiles(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        assert f._n_converted == 1
+        pos = paddle.to_tensor([1.0, 2.0])
+        neg = paddle.to_tensor([-1.0, -2.0])
+        np.testing.assert_allclose(f(pos).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(f(neg).numpy(), [-2.0, -3.0])
+        assert not f._eager
+
+    def test_if_without_else(self):
+        @to_static
+        def f(x):
+            y = x + 1
+            if x.sum() > 0:
+                y = y * 10
+            return y
+
+        np.testing.assert_allclose(f(paddle.to_tensor([1.0])).numpy(),
+                                   [20.0])
+        np.testing.assert_allclose(f(paddle.to_tensor([-1.0])).numpy(),
+                                   [0.0])
+        assert not f._eager
+
+    def test_python_if_untouched_semantics(self):
+        @to_static
+        def f(x, flag):
+            if flag:            # python bool: stays a trace-time branch
+                return x * 2
+            return x + 1
+
+        np.testing.assert_allclose(f(paddle.to_tensor([3.0]), True).numpy(),
+                                   [6.0])
+
+    def test_bool_ops_in_condition(self):
+        @to_static
+        def f(x, y):
+            if (x.sum() > 0) and (y.sum() > 0):
+                out = x + y
+            else:
+                out = x - y
+            return out
+
+        a = paddle.to_tensor([1.0])
+        b = paddle.to_tensor([2.0])
+        c = paddle.to_tensor([-2.0])
+        np.testing.assert_allclose(f(a, b).numpy(), [3.0])
+        np.testing.assert_allclose(f(a, c).numpy(), [3.0])
+        assert not f._eager
+
+    def test_not_in_condition(self):
+        @to_static
+        def f(x):
+            if not (x.sum() > 0):
+                y = x * 0
+            else:
+                y = x
+            return y
+
+        np.testing.assert_allclose(f(paddle.to_tensor([-5.0])).numpy(),
+                                   [-0.0])
+        np.testing.assert_allclose(f(paddle.to_tensor([5.0])).numpy(),
+                                   [5.0])
+
+
+class TestConvertWhile:
+    def test_tensor_while(self):
+        @to_static
+        def f(x):
+            while x.sum() > 1.0:
+                x = x / 2
+            return x
+
+        out = f(paddle.to_tensor([16.0]))
+        np.testing.assert_allclose(out.numpy(), [1.0])
+        assert not f._eager
+
+    def test_while_with_counter(self):
+        @to_static
+        def f(x, n):
+            i = 0
+            while i < n:        # n is a Tensor → staged loop
+                x = x + 1
+                i = i + 1
+            return x
+
+        out = f(paddle.to_tensor([0.0]), paddle.to_tensor(5))
+        np.testing.assert_allclose(out.numpy(), [5.0])
+        assert not f._eager
+
+    def test_python_while_still_works(self):
+        @to_static
+        def f(x):
+            i = 0
+            while i < 3:        # concrete python loop
+                x = x * 2
+                i += 1
+            return x
+
+        np.testing.assert_allclose(f(paddle.to_tensor([1.0])).numpy(),
+                                   [8.0])
+
+    def test_nested_if_in_while(self):
+        @to_static
+        def f(x):
+            i = 0
+            while i < 4:
+                if x.sum() > 0:
+                    x = x - 1
+                else:
+                    x = x + 2
+                i += 1
+            return x
+
+        # 3 -> 2 -> 1 -> 0 -> (sum 0 not > 0) +2 = 2
+        out = f(paddle.to_tensor([3.0]))
+        np.testing.assert_allclose(out.numpy(), [2.0])
+
+
+class TestConvertForRange:
+    def test_for_tensor_bound(self):
+        @to_static
+        def f(x, n):
+            for i in range(n):      # tensor bound → while form
+                x = x + i
+            return x
+
+        out = f(paddle.to_tensor([0.0]), paddle.to_tensor(5))
+        np.testing.assert_allclose(out.numpy(), [10.0])  # 0+1+2+3+4
+        assert not f._eager
+
+    def test_for_python_range(self):
+        @to_static
+        def f(x):
+            for i in range(3):
+                x = x * 2
+            return x
+
+        np.testing.assert_allclose(f(paddle.to_tensor([1.0])).numpy(),
+                                   [8.0])
+
+    def test_for_over_list_untouched(self):
+        @to_static
+        def f(x):
+            for s in [1.0, 2.0]:
+                x = x + s
+            return x
+
+        np.testing.assert_allclose(f(paddle.to_tensor([0.0])).numpy(),
+                                   [3.0])
+
+
+class TestFallback:
+    def test_return_in_tensor_branch_falls_back(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:     # return blocks conversion
+                return x * 2
+            return x - 1
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            out = f(paddle.to_tensor([2.0]))
+        assert f._eager
+        assert any("falling back to eager" in str(r.message) for r in rec)
+        np.testing.assert_allclose(out.numpy(), [4.0])
+        # subsequent calls run eagerly and stay correct
+        np.testing.assert_allclose(f(paddle.to_tensor([-2.0])).numpy(),
+                                   [-3.0])
+
+
+class TestRuntimeConverters:
+    """Direct unit coverage of the _jst runtime (convert_operators
+    parity)."""
+
+    def test_convert_ifelse_concrete_tensor(self):
+        out = d2s.convert_ifelse(
+            paddle.to_tensor(True),
+            lambda c: (c[0] + 1,), lambda c: (c[0] - 1,),
+            (paddle.to_tensor([1.0]),))
+        np.testing.assert_allclose(out[0].numpy(), [2.0])
+
+    def test_convert_while_python(self):
+        out = d2s.convert_while(
+            lambda c: c[0] < 3, lambda c: (c[0] + 1,), (0,))
+        assert out[0] == 3
+
+    def test_logical_helpers_python(self):
+        assert d2s.logical_and(lambda: True, lambda: False) is False
+        assert d2s.logical_or(lambda: False, lambda: True) is True
+        assert d2s.logical_not(True) is False
+
+
+_GLOBAL_SCALE = 2.0
+
+
+class TestReviewRegressions:
+    def test_for_range_loop_var_last_value(self):
+        @to_static
+        def f(x, n):
+            for i in range(n):
+                x = x + 1.0
+            return x * i
+
+        out = f(paddle.to_tensor([1.0]), paddle.to_tensor(3))
+        np.testing.assert_allclose(out.numpy(), [8.0])  # (1+3) * 2
+
+    def test_undef_use_raises_loudly(self):
+        @to_static
+        def f(x, p):
+            if p:
+                a = x * 2
+            else:
+                b = x * 3
+            return b  # unbound when p is True
+
+        with pytest.raises(UnboundLocalError):
+            f(paddle.to_tensor([1.0]), True)
+
+    def test_string_branch_falls_back(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                tag = "pos"
+            else:
+                tag = "neg"
+            return x * (1.0 if tag == "pos" else -1.0)
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            out = f(paddle.to_tensor([2.0]))
+        assert any("falling back to eager" in str(r.message) for r in rec)
+        np.testing.assert_allclose(out.numpy(), [2.0])
+
+    def test_live_globals_visible(self):
+        global _GLOBAL_SCALE
+
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * _GLOBAL_SCALE
+            else:
+                y = x
+            return y
+
+        try:
+            _GLOBAL_SCALE = 10.0
+            out = f(paddle.to_tensor([1.0]))
+            np.testing.assert_allclose(out.numpy(), [10.0])
+        finally:
+            _GLOBAL_SCALE = 2.0
