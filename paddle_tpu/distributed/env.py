@@ -80,11 +80,18 @@ def is_initialized() -> bool:
 
 
 def reset():
-    """Drop the ambient mesh/degrees (tests and single-device reference
-    runs next to a hybrid run use this; fleet re-init starts clean)."""
+    """Drop the ambient mesh/degrees AND the fleet HCG (tests and
+    single-device reference runs next to a hybrid run use this; fleet
+    re-init starts clean — a stale HybridCommunicateGroup would keep
+    handing its old mesh to mp layers)."""
     _state["initialized"] = False
     _state["mesh"] = None
     _state["axis_degrees"] = {}
+    try:
+        from .fleet import topology as _topo
+    except ImportError:  # fleet never imported in this process: no HCG
+        return
+    _topo.set_hybrid_communicate_group(None)
 
 
 def pin_sharding(x, sharding):

@@ -3,5 +3,6 @@ from .mp_layers import (  # noqa: F401
     ColumnParallelLinear,
     RowParallelLinear,
     ParallelCrossEntropy,
+    vocab_parallel_cross_entropy,
 )
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

@@ -150,14 +150,107 @@ class RowParallelLinear(nn.Layer):
         return out
 
 
+def _pmax_nograd(x, axis):
+    """Cross-device max treated as a constant by AD (lax.pmax has no
+    differentiation rule; zero gradient is exact here — the logsumexp
+    shift cancels in the CE gradient since softmax rows sum to 1)."""
+    @jax.custom_vjp
+    def f(v):
+        return jax.lax.pmax(v, axis)
+
+    f.defvjp(lambda v: (jax.lax.pmax(v, axis), None),
+             lambda _, g: (jnp.zeros_like(g),))
+    return f(x)
+
+
+def vocab_parallel_cross_entropy(logits, label, *, mesh, axis,
+                                 ignore_index=-100):
+    """Explicit sharded-logsumexp CE over vocab-sharded logits (reference
+    mp_layers.py:742 ParallelCrossEntropy — which also computes the
+    sharded max/sumexp/gather by hand rather than materializing the full
+    logits row).
+
+    The whole computation runs inside a shard_map manual over the mp
+    axis, so per-device memory is O(V / mp) BY CONSTRUCTION — no
+    replicated [.., V] buffer can exist, whatever GSPMD would have
+    guessed (tests/test_distributed.py asserts the compiled HLO carries
+    no full-vocab shape). Three scalar-per-token collectives (max, two
+    psums) replace the reference's c_allreduce calls; gradients flow
+    through psum's transpose (softmax - onehot, computed shard-local).
+    """
+    def run(x, y):
+        return vocab_parallel_ce_pure(x, y, mesh=mesh, axis=axis,
+                                      ignore_index=ignore_index)
+
+    return apply_op(run, [logits, label], name="vocab_parallel_ce")
+
+
+def vocab_parallel_ce_pure(x, y, *, mesh, axis, ignore_index=-100):
+    """The pure-jax sharded-logsumexp CE (see
+    `vocab_parallel_cross_entropy` for the Tensor-level entry)."""
+    in_spec = P(*((None,) * (x.ndim - 1) + (axis,)))
+    lab_spec = P(*((None,) * y.ndim))
+
+    def local(xl, yl):
+        lv = xl.shape[-1]
+        off = jax.lax.axis_index(axis) * lv
+        xf = xl.astype(jnp.float32)
+        gmax = _pmax_nograd(jnp.max(xf, axis=-1), axis)
+        gse = jax.lax.psum(
+            jnp.sum(jnp.exp(xf - gmax[..., None]), axis=-1), axis)
+        rel = yl - off
+        in_range = (rel >= 0) & (rel < lv)
+        safe = jnp.clip(rel, 0, lv - 1).astype(jnp.int32)
+        pred = jnp.take_along_axis(xf, safe[..., None], -1)[..., 0]
+        pred = jax.lax.psum(jnp.where(in_range, pred, 0.0), axis)
+        loss = jnp.log(gse) + gmax - pred
+        if ignore_index is not None:
+            loss = jnp.where(yl == ignore_index, 0.0, loss)
+        return loss
+
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(in_spec, lab_spec),
+        out_specs=lab_spec, axis_names=frozenset({axis}),
+        check_vma=False,
+    )(x, y)
+
+
 class ParallelCrossEntropy(nn.Layer):
-    """Reference mp_layers.py:742 — CE over vocab-sharded logits. GSPMD
-    computes the sharded logsumexp + gather with its own collectives."""
+    """Reference mp_layers.py:742 — CE over vocab-sharded logits via the
+    explicit sharded logsumexp (`vocab_parallel_cross_entropy`). Falls
+    back to plain CE when no mp axis is active or the vocab does not
+    split evenly.
+
+    The mesh resolves at FORWARD time (an instance built before
+    fleet.init — or surviving a denv.reset() — must see the current
+    mesh, not a stale or absent one), and only an axis literally named
+    "mp" routes to the sharded path: guessing another axis (e.g. a
+    dp-only mesh's last axis) would reshard batch-sharded logits into
+    vocab shards and silently regress memory/traffic."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self._ignore_index = ignore_index
+        self._mp_group = mp_group
+
+    def _resolve(self):
+        if self._mp_group is not None:
+            return self._mp_group.axes[0], self._mp_group.mesh
+        hcg = get_hybrid_communicate_group()
+        mesh = (hcg.mesh if hcg is not None
+                else env.get_mesh() if env.is_initialized() else None)
+        if mesh is None or "mp" not in mesh.axis_names:
+            return None, None
+        return "mp", mesh
 
     def forward(self, input, label):
+        axis, mesh = self._resolve()
+        degree = (int(mesh.shape[axis])
+                  if mesh is not None and axis in mesh.axis_names else 1)
+        vocab = input.shape[-1]
+        if degree > 1 and vocab % degree == 0:
+            return vocab_parallel_cross_entropy(
+                input, label, mesh=mesh, axis=axis,
+                ignore_index=self._ignore_index)
         return F.cross_entropy(input, label, reduction="none",
                                ignore_index=self._ignore_index)

@@ -270,18 +270,23 @@ class GPTPretrainingCriterion(nn.Layer):
 
     def forward(self, logits, labels, loss_mask=None):
         from .. import ops
+        from ..distributed.fleet.layers.mpu import ParallelCrossEntropy
 
+        # ParallelCrossEntropy owns the routing: an active mp axis that
+        # divides the vocab → explicit sharded-logsumexp CE (no replicated
+        # [tokens, vocab] buffer per device); otherwise plain CE. Its mesh
+        # resolution happens per forward, so one criterion instance works
+        # across fleet re-inits. Constructed lazily (no params).
+        if not hasattr(self, "_ce"):
+            object.__setattr__(self, "_ce", ParallelCrossEntropy())
         vocab = logits.shape[-1]
+        flat_logits = logits.reshape([-1, vocab])
+        flat_labels = labels.reshape([-1])
+        loss = self._ce(flat_logits, flat_labels)         # [N], 0 at -100
         if loss_mask is None:
-            return F.cross_entropy(
-                logits.reshape([-1, vocab]), labels.reshape([-1]),
-                reduction="mean",
-            )
-        loss = F.cross_entropy(
-            logits.reshape([-1, vocab]), labels.reshape([-1]),
-            reduction="none",
-        )
-        m = loss_mask.reshape([-1]).astype(loss.dtype)
+            m = (flat_labels != -100).astype(loss.dtype)
+        else:
+            m = loss_mask.reshape([-1]).astype(loss.dtype)
         return ops.sum(loss * m) / ops.clip(ops.sum(m), min=1.0)
 
 

@@ -21,8 +21,7 @@ from paddle_tpu.distributed.fleet import (
 @pytest.fixture(autouse=True)
 def reset_env():
     yield
-    denv._state["initialized"] = False
-    denv._state["mesh"] = None
+    denv.reset()
     import paddle_tpu.distributed.collective as coll
 
     coll._default_group = None
@@ -536,3 +535,86 @@ class TestMasterWeightOffload:
                 if hasattr(m.sharding, "spec"))
         finally:
             denv.reset()
+
+
+class TestVocabParallelCrossEntropy:
+    """Explicit sharded-logsumexp CE (reference mp_layers.py:742): parity
+    with plain CE, grads through the psum transposes, and the memory
+    proof — the compiled per-device HLO carries NO full-vocab buffer."""
+
+    VOCAB = 512
+
+    def _setup(self, mp=4):
+        mesh = Mesh(np.asarray(cpu8()[:mp]), ("mp",))
+        denv.set_mesh(mesh)
+        return mesh
+
+    def test_matches_plain_ce_and_grads(self):
+        from paddle_tpu.distributed.fleet.layers.mpu import (
+            ParallelCrossEntropy,
+        )
+        import paddle_tpu.nn.functional as F
+
+        mesh = self._setup()
+        rng = np.random.default_rng(0)
+        logits_np = rng.standard_normal((2, 8, self.VOCAB)).astype(
+            np.float32)
+        labels_np = rng.integers(0, self.VOCAB, (2, 8))
+        labels_np[0, 0] = -100   # ignore_index coverage
+        logits = paddle.to_tensor(logits_np)
+        logits._data = jax.device_put(
+            logits._data, NamedSharding(mesh, P(None, None, "mp")))
+        logits.stop_gradient = False
+        labels = paddle.to_tensor(labels_np, dtype="int64")
+
+        ce = ParallelCrossEntropy()
+        loss = ce(logits, labels)
+        ref_logits = paddle.to_tensor(logits_np)
+        ref_logits.stop_gradient = False
+        ref = F.cross_entropy(ref_logits.reshape([-1, self.VOCAB]),
+                              paddle.to_tensor(
+                                  labels_np.reshape(-1), dtype="int64"),
+                              reduction="none",
+                              ignore_index=-100).reshape([2, 8])
+        np.testing.assert_allclose(np.asarray(loss._data),
+                                   np.asarray(ref._data), atol=1e-5)
+        loss.sum().backward()
+        ref.sum().backward()
+        np.testing.assert_allclose(np.asarray(logits.grad._data),
+                                   np.asarray(ref_logits.grad._data),
+                                   atol=1e-5)
+
+    def test_compiled_hlo_has_no_full_vocab_buffer(self):
+        """The VERDICT-mandated memory proof: under mp vocab sharding the
+        per-device program must never materialize a [.., V] buffer (the
+        shard_map construction makes this structural; this test fails if
+        anyone reroutes the layer through GSPMD guessing again)."""
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+            vocab_parallel_ce_pure,
+        )
+
+        mesh = self._setup()
+        V = self.VOCAB
+        sh = NamedSharding(mesh, P(None, None, "mp"))
+
+        def loss_fn(x, y):
+            return vocab_parallel_ce_pure(x, y, mesh=mesh,
+                                          axis="mp").sum()
+
+        grad_fn = jax.jit(jax.grad(loss_fn), in_shardings=(sh, None))
+        x = jax.device_put(
+            jnp.asarray(np.random.default_rng(1).standard_normal(
+                (2, 8, V)), jnp.float32), sh)
+        y = jnp.asarray(np.random.default_rng(2).integers(0, V, (2, 8)))
+        hlo = grad_fn.lower(x, y).compile().as_text()
+        # per-device shapes must be V/mp = 128 wide; a full-V dimension
+        # appears nowhere (fails if an all-gather rebuilds the vocab dim).
+        # Word-boundary match so unrelated numbers (ids, literals, padded
+        # dims like 1512) cannot false-positive.
+        import re as _re
+
+        full_vocab_dims = _re.findall(rf"[\[,]{V}[\],]", hlo)
+        assert not full_vocab_dims, (
+            f"full-vocab buffer found in compiled HLO: {full_vocab_dims}")
+        g = grad_fn(x, y)
+        assert bool(jnp.all(jnp.isfinite(g)))
