@@ -18,6 +18,7 @@ from ..framework.tensor import Tensor
 from ..ops._dispatch import ensure_tensor, nary
 
 __all__ = [
+    "weighted_sample_neighbors",
     "send_u_recv", "send_ue_recv", "send_uv",
     "segment_sum", "segment_mean", "segment_min", "segment_max",
     "reindex_graph", "sample_neighbors",
@@ -185,6 +186,52 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
         idx = np.arange(lo, hi)
         if sample_size >= 0 and len(idx) > sample_size:
             idx = rng.choice(idx, size=sample_size, replace=False)
+        out.append(r[idx])
+        counts.append(len(idx))
+        if return_eids:
+            out_eids.append(ev[idx])
+    flat = (np.concatenate(out) if out else np.zeros((0,), r.dtype))
+    res = (Tensor._wrap(jnp.asarray(flat.astype(np.int64))),
+           Tensor._wrap(jnp.asarray(np.asarray(counts, np.int64))))
+    if return_eids:
+        fe = (np.concatenate(out_eids) if out_eids
+              else np.zeros((0,), np.int64))
+        return res + (Tensor._wrap(jnp.asarray(fe.astype(np.int64))),)
+    return res
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling over CSC (reference
+    weighted_sample_neighbors_kernel.h): like sample_neighbors but each
+    neighbor is drawn with probability proportional to its edge weight
+    (without replacement). Host-side like sample_neighbors (ragged)."""
+    from ..framework.random import host_rng
+
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs the eids tensor")
+    r = np.asarray(ensure_tensor(row)._data)
+    cp = np.asarray(ensure_tensor(colptr)._data)
+    w = np.asarray(ensure_tensor(edge_weight)._data).astype(np.float64)
+    nodes = np.asarray(ensure_tensor(input_nodes)._data)
+    ev = np.asarray(ensure_tensor(eids)._data) if eids is not None else None
+    rng = host_rng()
+    out, counts, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        idx = np.arange(lo, hi)
+        if sample_size >= 0 and len(idx) > sample_size:
+            p = w[lo:hi]
+            if p.sum() > 0:
+                # without-replacement draws need >= size positive-weight
+                # entries; clamp like the reference kernel does
+                pos = idx[p > 0]
+                take = min(sample_size, len(pos))
+                pn = p[p > 0] / p[p > 0].sum()
+                idx = rng.choice(pos, size=take, replace=False, p=pn)
+            else:
+                idx = rng.choice(idx, size=sample_size, replace=False)
         out.append(r[idx])
         counts.append(len(idx))
         if return_eids:

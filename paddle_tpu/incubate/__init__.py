@@ -1,3 +1,10 @@
 """paddle.incubate parity — experimental/advanced features."""
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+# segment reductions at the incubate root (reference incubate/tensor/math.py)
+from ..geometric import (  # noqa: E402,F401
+    segment_sum, segment_mean, segment_max, segment_min,
+)
+from .nn.functional import (  # noqa: E402,F401
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)

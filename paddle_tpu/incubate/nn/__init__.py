@@ -207,3 +207,4 @@ class FusedLinear(nn.Linear):
         super().__init__(in_features, out_features,
                          weight_attr=weight_attr, bias_attr=bias_attr)
         self._transpose_weight = transpose_weight
+from . import functional  # noqa: E402,F401

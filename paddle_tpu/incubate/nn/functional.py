@@ -1,0 +1,88 @@
+"""paddle.incubate.nn.functional — fused functional ops.
+
+Reference parity: python/paddle/incubate/nn/functional/ (swiglu,
+fused_softmax_mask, fused_linear, ...). On TPU these are jnp
+compositions XLA fuses into single kernels — the reference's
+hand-written CUDA fusions exist because its eager mode can't fuse;
+whole-program XLA does it for free (SURVEY.md §7 design stance).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import nary, unary
+from ...nn import functional as F
+
+__all__ = [
+    "swiglu", "fused_linear", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "fused_dropout_add",
+    "fused_bias_act",
+]
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU activation (reference swiglu_kernel.h): silu(x) * y, with
+    x split in half when y is omitted."""
+    if y is None:
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+
+        return unary(f, x, "swiglu")
+
+    def f2(a, b):
+        return jax.nn.silu(a) * b
+
+    return nary(f2, [x, y], name="swiglu")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """Reference fused_gemm_epilogue: linear with the bias add fused (XLA
+    fuses it regardless)."""
+    w = weight
+    if transpose_weight:
+        from ...framework.tensor import Tensor
+
+        w = Tensor._wrap(jnp.swapaxes(
+            w._data if isinstance(w, Tensor) else jnp.asarray(w), -1, -2))
+    return F.linear(x, w, bias)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) (reference fused_softmax_mask_kernel.h)."""
+    def f(v, m):
+        return jax.nn.softmax(v.astype(jnp.float32) + m.astype(jnp.float32),
+                              axis=-1).astype(v.dtype)
+
+    return nary(f, [x, mask], name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (reference
+    fused_softmax_mask_upper_triangle_kernel.h): upper triangle is
+    masked out."""
+    def f(v):
+        s = v.shape[-1]
+        mask = jnp.tril(jnp.ones((v.shape[-2], s), bool))
+        vf = jnp.where(mask, v.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(vf, axis=-1).astype(v.dtype)
+
+    return unary(f, x, "softmax_mask_fuse_upper_triangle")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y (reference fused_dropout_add_kernel.h)."""
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kw):
+    """bias + activation (reference fused_bias_act_kernel.h)."""
+    out = x if bias is None else x + bias
+    act = getattr(F, act_method, None)
+    if act_method == "swiglu":
+        return swiglu(out)
+    if act is None:
+        raise ValueError(f"unknown act_method {act_method!r}")
+    return act(out)

@@ -48,6 +48,13 @@ class GPTConfig:
     # (meta_parallel/ring_attention.py) instead of GSPMD's k/v all-gather —
     # O(seq/n) activation memory per device on a sep mesh
     use_ring_attention: bool = False
+    # compile-time lever: stack the identical decoder blocks on a leading
+    # [num_layers] dim and run them as ONE lax.scan body instead of
+    # num_layers inlined copies. XLA compiles one block instead of 24+ —
+    # the standard big-model trick on TPU (the 1.3b whole-step compile
+    # drops from ~17 min to minutes; see PERF.md). Same math; param names
+    # become blocks__<template-name> with a stacked leading dim.
+    scan_layers: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -167,6 +174,78 @@ class GPTBlock(nn.Layer):
         return self._inner(x)
 
 
+class GPTStackedBlocks(nn.Layer):
+    """The decoder stack as ONE scanned block over [num_layers]-stacked
+    parameters (see GPTConfig.scan_layers). Mirrors the stage-stacking of
+    models/gpt_pipe.py (which scans within a pipeline stage); this is the
+    single-chip/whole-model variant."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        n = config.num_layers
+        object.__setattr__(self, "_template", GPTBlock(config))
+        self._stacked_names = []
+        from ..framework.random import host_normal
+        import jax.numpy as jnp
+
+        std = config.initializer_range
+        for pname, p in self._template.named_parameters():
+            shape = (n,) + tuple(p.shape)
+            if p.ndim >= 2:
+                data = host_normal(shape, std)
+                if re.search(r"(out_proj|fc2)\.weight$", pname):
+                    data = data / (2.0 * n) ** 0.5
+            else:
+                data = jnp.broadcast_to(p._data, shape)
+            flat = "blocks__" + pname.replace(".", "__")
+            from ..nn.layer.layers import Parameter
+
+            self.add_parameter(flat, Parameter(jnp.asarray(data)))
+            self._stacked_names.append((flat, pname))
+
+    def forward(self, x):
+        import jax
+
+        from ..framework.autograd import apply_op, no_grad
+        from ..framework.tensor import Tensor
+
+        template = self._template
+        leaves = [p for _, p in template.named_parameters()]
+        training = self.training
+        cfg = self.config
+
+        def one_layer(h, layer_leaves):
+            with no_grad():
+                saved = [p._data for p in leaves]
+                for p, d in zip(leaves, layer_leaves):
+                    p._data = d
+                template.training = training
+                try:
+                    y = template._inner(Tensor._wrap(h))._data
+                finally:
+                    for p, d in zip(leaves, saved):
+                        p._data = d
+            return y, None
+
+        if cfg.use_recompute and training:
+            policy = (jax.checkpoint_policies
+                      .dots_with_no_batch_dims_saveable
+                      if cfg.recompute_policy == "dots" else None)
+            one_layer = (jax.checkpoint(one_layer, policy=policy)
+                         if policy is not None
+                         else jax.checkpoint(one_layer))
+
+        stacked = [self._parameters[flat] for flat, _ in
+                   self._stacked_names]
+
+        def scanfn(h, *stk):
+            out, _ = jax.lax.scan(one_layer, h, list(stk))
+            return out
+
+        return apply_op(scanfn, [x] + stacked, name="gpt_scan_blocks")
+
+
 class GPTModel(nn.Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -175,8 +254,18 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(config.max_position_embeddings,
                                 config.hidden_size)
         self.drop = nn.Dropout(config.hidden_dropout_prob)
-        self.blocks = nn.LayerList([GPTBlock(config)
-                                    for _ in range(config.num_layers)])
+        if config.scan_layers:
+            if config.hidden_dropout_prob or config.attention_dropout_prob:
+                # the scan body traces once, so eager dropout keys would be
+                # shared by every layer — refuse rather than silently
+                # correlate masks across layers
+                raise ValueError(
+                    "scan_layers=True requires zero dropout (per-layer "
+                    "RNG is not threaded through the scan yet)")
+            self.blocks = GPTStackedBlocks(config)
+        else:
+            self.blocks = nn.LayerList([GPTBlock(config)
+                                        for _ in range(config.num_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_epsilon)
         self._init_weights(config)
@@ -189,6 +278,8 @@ class GPTModel(nn.Layer):
 
         std = config.initializer_range
         for name, p in self.named_parameters():
+            if "blocks__" in name:
+                continue  # stacked scan params init in GPTStackedBlocks
             if p.ndim >= 2:
                 p._data = host_normal(p._data.shape, std)
                 if re.search(r"(out_proj|fc2)\.weight$", name):
@@ -201,8 +292,11 @@ class GPTModel(nn.Layer):
             position_ids = C.arange(0, s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
-        for block in self.blocks:
-            x = block(x)
+        if isinstance(self.blocks, GPTStackedBlocks):
+            x = self.blocks(x)
+        else:
+            for block in self.blocks:
+                x = block(x)
         return self.ln_f(x)
 
 

@@ -362,3 +362,51 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         return jnp.moveaxis(out, -1, 1).astype(v.dtype)  # [N,C,Ho,Wo]
 
     return nary(f, [ensure_tensor(x), ensure_tensor(grid)], "grid_sample")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Lengths -> binary mask (reference sequence_mask; kernel
+    sequence_mask_kernel.h). Output shape x.shape + [maxlen]."""
+    from ...ops._dispatch import unary
+    from ...framework.dtype import to_jax_dtype
+    import jax.numpy as jnp
+
+    if maxlen is None:
+        raise ValueError(
+            "sequence_mask needs a static maxlen on TPU (dynamic output "
+            "shapes do not compile); pass maxlen explicitly")
+    dt = to_jax_dtype(dtype)
+
+    def f(v):
+        rng = jnp.arange(maxlen)
+        return (rng < v[..., None]).astype(dt)
+
+    return unary(f, x, "sequence_mask")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift (reference temporal_shift_kernel.h): shift a
+    channel slice one step forward/backward along the segment dim."""
+    from ...ops._dispatch import unary
+    import jax.numpy as jnp
+
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, :c1]), v5[:, :-1, :c1]], axis=1)
+        bwd = jnp.concatenate(
+            [v5[:, 1:, c1:c2], jnp.zeros_like(v5[:, :1, c1:c2])], axis=1)
+        out = jnp.concatenate([fwd, bwd, v5[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return unary(f, x, "temporal_shift")

@@ -507,3 +507,144 @@ def fused_linear_cross_entropy(hidden, weight, labels, transpose_y=True,
         return jnp.sum(losses) / jnp.maximum(jnp.sum(valid), 1.0)
 
     return nary(f, [hidden, weight, labels], "fused_linear_cross_entropy")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Negative log likelihood of probabilities (reference log_loss_kernel.h):
+    -label*log(p+eps) - (1-label)*log(1-p+eps)."""
+    from ...ops._dispatch import nary
+
+    def f(p, y):
+        return (-y * jnp.log(p + epsilon)
+                - (1.0 - y) * jnp.log(1.0 - p + epsilon))
+
+    return nary(f, [input, label], "log_loss")
+
+
+def identity_loss(x, reduction="none"):
+    """Marks a value as the loss for IPU-style pipelines (reference
+    identity_loss_kernel.h); numerically the reduction of x."""
+    from ...ops._dispatch import unary
+
+    red = {0: "sum", 1: "mean", 2: "none", "sum": "sum", "mean": "mean",
+           "none": "none"}[reduction]
+
+    def f(v):
+        if red == "sum":
+            return jnp.sum(v)
+        if red == "mean":
+            return jnp.mean(v)
+        return v
+
+    return unary(f, x, "identity_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference hsigmoid_loss_kernel.h),
+    default complete-binary-tree coding: num_classes-1 internal nodes;
+    class c's path/code derive from the tree layout the reference uses
+    (node ids from (c + num_classes) walking to the root)."""
+    import numpy as np
+
+    from ...ops._dispatch import nary
+
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is descoped — "
+            "default complete-binary-tree mode only")
+    # precompute per-class paths host-side (static num_classes)
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    paths = np.zeros((num_classes, depth), np.int32)
+    codes = np.zeros((num_classes, depth), np.float32)
+    valid = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        node = c + num_classes          # leaf id in the implicit heap
+        d = 0
+        while node > 1 and d < depth:
+            codes[c, d] = float(node % 2)
+            node //= 2
+            paths[c, d] = node - 1      # internal node row in weight
+            valid[c, d] = 1.0
+            d += 1
+    pathsj = jnp.asarray(paths)
+    codesj = jnp.asarray(codes)
+    validj = jnp.asarray(valid)
+
+    def f(x, y, w, *rest):
+        b = rest[0] if bias is not None else None
+        yp = pathsj[y]                  # [N, depth]
+        yc = codesj[y]
+        yv = validj[y]
+        wsel = w[yp]                    # [N, depth, D]
+        logits = jnp.einsum("nd,nkd->nk", x.astype(jnp.float32),
+                            wsel.astype(jnp.float32))
+        if b is not None:
+            logits = logits + b[yp].astype(jnp.float32)
+        # sigmoid CE per node with target = code
+        per = jnp.maximum(logits, 0) - logits * yc \
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(per * yv, axis=1, keepdims=True)
+
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return nary(f, args, name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace-family margin softmax CE (reference
+    margin_cross_entropy_kernel.h): cos(m1*θ + m2) - m3 on the target
+    logit, then scaled softmax CE. Single-group (non-model-parallel)
+    path; logits are cosines in [-1, 1]."""
+    from ...ops._dispatch import nary
+
+    def f(lg, y):
+        lf = lg.astype(jnp.float32)
+        n = lf.shape[0]
+        tgt = jnp.take_along_axis(lf, y[:, None], 1)[:, 0]
+        theta = jnp.arccos(jnp.clip(tgt, -1.0 + 1e-7, 1.0 - 1e-7))
+        tgt_m = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(y, lf.shape[1], dtype=lf.dtype)
+        adj = lf + onehot * (tgt_m - tgt)[:, None]
+        adj = adj * scale
+        lse = jax.scipy.special.logsumexp(adj, axis=1)
+        loss = lse - jnp.take_along_axis(adj, y[:, None], 1)[:, 0]
+        sm = jnp.exp(adj - lse[:, None])
+        return loss[:, None], sm
+
+    import jax
+
+    loss, sm = nary(f, [logits, label], name="margin_cross_entropy")
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference
+    class_center_sample_kernel.h / PartialFC): returns remapped labels +
+    the sampled class index set (positives first, padded with uniformly
+    sampled negatives to num_samples)."""
+    import numpy as np
+
+    from ...framework import random as _random
+    from ...framework.tensor import Tensor
+    from ...ops._dispatch import ensure_tensor
+
+    y = np.asarray(ensure_tensor(label)._data).astype(np.int64)
+    pos = np.unique(y)
+    rng = np.random.default_rng(int(_random.default_generator().seed_) + 1
+                                if hasattr(_random.default_generator(),
+                                           "seed_") else 0)
+    neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+    n_neg = max(0, num_samples - len(pos))
+    neg = (rng.choice(neg_pool, size=n_neg, replace=False)
+           if n_neg <= len(neg_pool) else neg_pool)
+    sampled = np.concatenate([pos, neg])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor._wrap(jnp.asarray(remap[y])),
+            Tensor._wrap(jnp.asarray(sampled)))

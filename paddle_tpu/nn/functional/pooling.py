@@ -64,6 +64,9 @@ def _pool_nd(x, kernel, stride, padding, n, reducer, init, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_index(x, kernel_size, stride, padding, 1,
+                                    ceil_mode, data_format)
     out = _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                    ceil_mode, data_format)
@@ -72,6 +75,9 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_index(x, kernel_size, stride, padding, 2,
+                                    ceil_mode, data_format)
     out = _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                    ceil_mode, data_format)
@@ -80,6 +86,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_index(x, kernel_size, stride, padding, 3,
+                                    ceil_mode, data_format)
     return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
                     lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                     ceil_mode, data_format)
@@ -162,3 +171,175 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, False, "NCDHW")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True) (reference
+    unpool_kernel.h): scatter pooled values back to their argmax
+    positions."""
+    from ...ops._dispatch import nary
+    import jax.numpy as jnp
+
+    if stride is None:
+        stride = kernel_size
+    kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+
+    def f(v, idx):
+        n, c, hin, win = v.shape
+        if output_size is not None:
+            ho, wo = output_size[-2], output_size[-1]
+        else:
+            ho = (hin - 1) * sh - 2 * ph + kh
+            wo = (win - 1) * sw - 2 * pw + kw
+        flat = jnp.zeros((n, c, ho * wo), v.dtype)
+        ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        vv = v.reshape(n, c, -1)
+        out = jax.vmap(jax.vmap(
+            lambda fl, i, val: fl.at[i].set(val)))(flat, ii, vv)
+        return out.reshape(n, c, ho, wo)
+
+    import jax
+
+    return nary(f, [x, indices], name="max_unpool2d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    from ...framework.tensor import Tensor
+
+    x3 = x.unsqueeze(-2)
+    i3 = indices.unsqueeze(-2)
+    out = max_unpool2d(x3, i3, (1, kernel_size),
+                       (1, stride if stride is not None else kernel_size),
+                       (0, padding),
+                       output_size=(1, output_size[-1])
+                       if output_size is not None else None)
+    return out.squeeze(-2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    from ...ops._dispatch import nary
+    import jax
+    import jax.numpy as jnp
+
+    if stride is None:
+        stride = kernel_size
+    k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+
+    def f(v, idx):
+        n, c, d, h, w = v.shape
+        if output_size is not None:
+            do, ho, wo = output_size[-3:]
+        else:
+            do = (d - 1) * s[0] - 2 * p[0] + k[0]
+            ho = (h - 1) * s[1] - 2 * p[1] + k[1]
+            wo = (w - 1) * s[2] - 2 * p[2] + k[2]
+        flat = jnp.zeros((n, c, do * ho * wo), v.dtype)
+        ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        vv = v.reshape(n, c, -1)
+        out = jax.vmap(jax.vmap(
+            lambda fl, i, val: fl.at[i].set(val)))(flat, ii, vv)
+        return out.reshape(n, c, do, ho, wo)
+
+    return nary(f, [x, indices], name="max_unpool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    from ...ops._dispatch import unary
+    import jax.numpy as jnp
+
+    out = lp_pool2d(x.unsqueeze(-2), norm_type, (1, kernel_size),
+                    (1, stride if stride is not None else kernel_size),
+                    (0, padding), ceil_mode=ceil_mode)
+    return out.squeeze(-2)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """Lp-norm pooling (reference lp_pool2d): (sum |x|^p)^(1/p) over
+    windows — expressed via avg_pool on |x|^p (count-scaled)."""
+    from ...ops._dispatch import unary
+    import jax.numpy as jnp
+
+    if stride is None:
+        stride = kernel_size
+    kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+    p = float(norm_type)
+
+    def f(v):
+        from ...framework.tensor import Tensor
+
+        powed = jnp.power(jnp.abs(v.astype(jnp.float32)), p)
+        pooled = avg_pool2d(Tensor._wrap(powed), kernel_size, stride,
+                            padding, ceil_mode=ceil_mode,
+                            exclusive=False)._data
+        return jnp.power(pooled * (kh * kw), 1.0 / p).astype(v.dtype)
+
+    return unary(f, x, "lp_pool2d")
+
+
+def _max_pool_with_index(x, kernel, stride, padding, nd, ceil_mode=False,
+                         data_format=None):
+    """(pooled, indices): indices are flat positions in the UNPADDED
+    input plane (reference max_pool2d_with_index_kernel.h convention).
+    Differentiable through the pooled values (routed via the op
+    dispatcher like every other op)."""
+    from ...ops._dispatch import nary
+
+    if ceil_mode:
+        raise NotImplementedError(
+            "max_pool(return_mask=True) with ceil_mode=True is not "
+            "supported; pad the input explicitly")
+    channels_last = data_format in ("NHWC", "NDHWC", "NLC")
+    k = (kernel,) * nd if isinstance(kernel, int) else tuple(kernel)
+    s = ((stride,) * nd if isinstance(stride, int)
+         else tuple(stride)) if stride is not None else k
+    p = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+
+    def f(v):
+        if channels_last:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[0], v.shape[1]
+        spatial = v.shape[2:]
+        neg = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+               else jnp.iinfo(v.dtype).min)
+        pad_cfg = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+        vp = jnp.pad(v, pad_cfg, constant_values=neg)
+        # extract windows: [N*C, prod(k), *out_spatial]
+        patches = jax.lax.conv_general_dilated_patches(
+            vp.reshape((n * c, 1) + vp.shape[2:]), k, s, "VALID")
+        out_sp = patches.shape[2:]
+        patches = patches.reshape((n, c, int(np.prod(k))) + out_sp)
+        pooled = jnp.max(patches, axis=2)
+        win_idx = jnp.argmax(patches, axis=2)          # [N, C, *out_sp]
+        # window-local -> global unpadded flat index
+        k_coords = jnp.stack(jnp.unravel_index(
+            jnp.arange(int(np.prod(k))), k), -1)       # [K, nd]
+        base = jnp.stack(jnp.meshgrid(
+            *[jnp.arange(o) * si for o, si in zip(out_sp, s)],
+            indexing="ij"), -1)                        # [*out_sp, nd]
+        coords = base[None, None] + k_coords[win_idx]  # [N, C, *out, nd]
+        for d in range(nd):
+            coords = coords.at[..., d].add(-p[d])
+            coords = coords.at[..., d].set(
+                jnp.clip(coords[..., d], 0, spatial[d] - 1))
+        flat = coords[..., 0]
+        for d in range(1, nd):
+            flat = flat * spatial[d] + coords[..., d]
+        if channels_last:
+            pooled = jnp.moveaxis(pooled, 1, -1)
+            flat = jnp.moveaxis(flat, 1, -1)
+        return pooled, flat.astype(jnp.int64)
+
+    return nary(f, [x], name="max_pool_with_index")

@@ -87,6 +87,9 @@ _TENSOR_METHODS = [
     "masked_select", "masked_fill", "masked_fill_", "repeat_interleave", "pad",
     "topk", "sort", "argsort", "nonzero", "unique", "unique_consecutive",
     "searchsorted", "bucketize", "cast",
+    # in-place random fills (reference tensor/random.py)
+    "normal_", "log_normal_", "exponential_", "fill_diagonal_",
+    "fill_diagonal_tensor", "fill_diagonal_tensor_",
 ]
 
 

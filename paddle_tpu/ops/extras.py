@@ -708,6 +708,8 @@ def where_(condition, x, y, name=None):
 
 
 __all__ = [
+    "top_p_sampling", "fill_diagonal_", "fill_diagonal_tensor", "fill_diagonal_tensor_",
+    "l1_norm", "exponential_",
     # special
     "gammaln", "gammainc", "gammaincc", "multigammaln", "polygamma",
     "i0", "i0e", "i1", "i1e", "sinc", "sgn", "signbit", "isneginf",
@@ -730,3 +732,107 @@ __all__ = [
     "tolist", "set_printoptions", "check_shape", "disable_signal_handler",
     "batch", "create_parameter",
 ]
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place diagonal fill (reference fill_diagonal_kernel.h):
+    functional update rebound through the in-place machinery."""
+    from ._dispatch import ensure_tensor
+
+    x = ensure_tensor(x)
+
+    def f(v):
+        m, n = v.shape[-2], v.shape[-1]
+        if v.ndim == 2 and wrap and m > n:
+            if offset:
+                raise NotImplementedError(
+                    "fill_diagonal_(wrap=True) with offset != 0")
+            # numpy fill_diagonal wrap: restart every n+1 flat positions
+            idx = jnp.arange(0, m * n, n + 1)
+            return v.reshape(-1).at[idx].set(value).reshape(m, n)
+        # diagonal length for a rectangular matrix with offset
+        k = min(m + min(offset, 0), n - max(offset, 0))
+        i = jnp.arange(max(k, 0))
+        return v.at[..., i - min(offset, 0), i + max(offset, 0)].set(value)
+
+    from ..framework.autograd import apply_op
+
+    out = apply_op(f, [x], name="fill_diagonal_")
+    x._inplace_from(out)
+    return x
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor `y` onto x's (dim1, dim2) diagonal (reference
+    fill_diagonal_tensor_kernel.h)."""
+    from ._dispatch import nary
+
+    def f(v, w):
+        vd = jnp.moveaxis(v, (dim1, dim2), (-2, -1))
+        m, n = vd.shape[-2], vd.shape[-1]
+        k = min(m + min(offset, 0), n - max(offset, 0))
+        i = jnp.arange(max(k, 0))
+        rows = i - min(offset, 0)
+        cols = i + max(offset, 0)
+        vd = vd.at[..., rows, cols].set(w)
+        return jnp.moveaxis(vd, (-2, -1), (dim1, dim2))
+
+    return nary(f, [x, y], name="fill_diagonal_tensor")
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    out = fill_diagonal_tensor(x, y, offset=offset, dim1=dim1, dim2=dim2)
+    x._inplace_from(out)
+    return x
+
+
+def l1_norm(x, name=None):
+    """Sum of absolute values (reference l1_norm_kernel.h)."""
+    from ._dispatch import unary
+
+    return unary(lambda v: jnp.sum(jnp.abs(v)), x, "l1_norm")
+
+
+def exponential_(x, lam=1.0, name=None):
+    """In-place exponential-distribution fill (reference
+    exponential_kernel.h / Tensor.exponential_)."""
+    from ..framework.random import next_key
+
+    x = ensure_tensor(x)
+    key = next_key()
+    out = unary(lambda v: (jax.random.exponential(key, v.shape, v.dtype)
+                           / lam), x, "exponential_")
+    x._inplace_from(out)
+    return x
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling (reference top_p_sampling_kernel.h):
+    per row, keep the smallest prefix of descending-probability tokens
+    whose mass reaches p, renormalize, sample one. Returns (scores,
+    ids)."""
+    from ..framework.random import next_key
+    from ..framework.tensor import Tensor
+
+    x = ensure_tensor(x)
+    ps_t = ensure_tensor(ps)
+
+    def f(probs, p):
+        pf = probs.astype(jnp.float32)
+        order = jnp.argsort(-pf, axis=-1)
+        sorted_p = jnp.take_along_axis(pf, order, -1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens while cumulative mass (exclusive) < p
+        keep = (cum - sorted_p) < p[..., None]
+        keep = keep.at[..., 0].set(True)
+        masked = jnp.where(keep, sorted_p, 0.0)
+        norm = masked / jnp.sum(masked, -1, keepdims=True)
+        key = next_key()
+        choice = jax.random.categorical(key, jnp.log(norm + 1e-30))
+        ids = jnp.take_along_axis(order, choice[..., None], -1)
+        scores = jnp.take_along_axis(pf, ids, -1)
+        return scores.astype(probs.dtype), ids.astype(jnp.int64)
+
+    scores, ids = f(x._data, ps_t._data)
+    return Tensor._wrap(scores), Tensor._wrap(ids)

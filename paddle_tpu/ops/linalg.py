@@ -294,3 +294,116 @@ def renorm(x, p, axis, max_norm, name=None):
         return v * factor
 
     return unary(f, x, "renorm")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (reference tensor/linalg.py lu; kernel
+    lu_kernel.h): returns packed LU and 1-indexed pivots (and infos when
+    requested), matching paddle's LAPACK getrf convention."""
+    from ._dispatch import ensure_tensor
+    from ..framework.tensor import Tensor
+    import jax
+
+    x = ensure_tensor(x)
+    lu_p, piv = jax.scipy.linalg.lu_factor(x._data)
+    piv1 = (piv + 1).astype(jnp.int32)
+    if get_infos:
+        infos = jnp.zeros(x._data.shape[:-2], jnp.int32)
+        return Tensor._wrap(lu_p), Tensor._wrap(piv1), Tensor._wrap(infos)
+    return Tensor._wrap(lu_p), Tensor._wrap(piv1)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s result into P, L, U (reference lu_unpack_kernel.h)."""
+    from ._dispatch import ensure_tensor
+    from ..framework.tensor import Tensor
+
+    lu_d = ensure_tensor(x)._data
+    piv = ensure_tensor(y)._data.astype(jnp.int32) - 1   # 0-indexed
+    m, n = lu_d.shape[-2], lu_d.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_d[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_d.dtype)
+    U = jnp.triu(lu_d[..., :k, :])
+    # P from pivot swaps: row i <-> piv[i], applied in order
+    def perm_of(p):
+        def body(i, perm):
+            j = p[i]
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi)
+        import jax
+
+        return jax.lax.fori_loop(0, p.shape[0], body, jnp.arange(m))
+
+    if piv.ndim == 1:
+        perm = perm_of(piv)
+        P = jnp.eye(m, dtype=lu_d.dtype)[:, perm]
+    else:
+        import jax
+
+        perm = jax.vmap(perm_of)(piv.reshape(-1, piv.shape[-1]))
+        P = jnp.eye(m, dtype=lu_d.dtype)[:, perm]
+        P = jnp.moveaxis(P, 1, 0).reshape(lu_d.shape[:-2] + (m, m))
+    outs = []
+    if unpack_pivots:
+        outs.append(Tensor._wrap(P))
+    if unpack_ludata:
+        outs.extend([Tensor._wrap(L), Tensor._wrap(U)])
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def lu_solve(b, lu_data, pivots, trans="N", name=None):
+    """Solve A x = b from lu() factors (reference lu_solve_kernel.h)."""
+    from ._dispatch import ensure_tensor
+    from ..framework.tensor import Tensor
+    import jax
+
+    b = ensure_tensor(b)
+    lu_d = ensure_tensor(lu_data)._data
+    piv = ensure_tensor(pivots)._data.astype(jnp.int32) - 1
+    t = {"N": 0, "T": 1, "C": 2}.get(trans, 0)
+    out = jax.scipy.linalg.lu_solve((lu_d, piv), b._data, trans=t)
+    return Tensor._wrap(out)
+
+
+def svdvals(x, name=None):
+    """Singular values only (reference svdvals_kernel.h)."""
+    from ._dispatch import unary
+
+    return unary(lambda v: jnp.linalg.svd(v, compute_uv=False), x,
+                 "svdvals")
+
+
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (reference
+    householder_product_kernel.h / LAPACK orgqr)."""
+    from ._dispatch import nary
+
+    def f(a, t):
+        import jax
+
+        return jax.lax.linalg.householder_product(a, t)
+
+    return nary(f, [x, tau], "householder_product")
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference tensor/linalg.py matrix_exp)."""
+    from ._dispatch import unary
+    import jax
+
+    return unary(lambda v: jax.scipy.linalg.expm(v), x, "matrix_exp")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by Q from householder reflectors (ormqr)."""
+    from ._dispatch import nary
+
+    def f(a, t, c):
+        import jax
+
+        q = jax.lax.linalg.householder_product(a, t)
+        if transpose:
+            q = jnp.swapaxes(q, -1, -2)
+        return q @ c if left else c @ q
+
+    return nary(f, [x, tau, other], "ormqr")

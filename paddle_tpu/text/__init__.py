@@ -115,3 +115,48 @@ def __getattr__(name):
             "has no network egress. Load the files locally and feed them "
             "through paddle.io.Dataset/DataLoader instead.")
     raise AttributeError(name)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per batch row (reference
+    edit_distance_kernel.h). Host-side DP (the reference's kernel is a
+    sequential DP too — no MXU win exists); returns (distances [N,1],
+    sequence_num)."""
+    import numpy as np
+
+    from ..framework.tensor import Tensor
+    from ..ops._dispatch import ensure_tensor
+
+    a = np.asarray(ensure_tensor(input)._data)
+    b = np.asarray(ensure_tensor(label)._data)
+    il = (np.asarray(ensure_tensor(input_length)._data)
+          if input_length is not None else
+          np.full(a.shape[0], a.shape[1], np.int64))
+    ll = (np.asarray(ensure_tensor(label_length)._data)
+          if label_length is not None else
+          np.full(b.shape[0], b.shape[1], np.int64))
+    drop = set(ignored_tokens or ())
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for i in range(a.shape[0]):
+        s = [t for t in a[i, :il[i]].tolist() if t not in drop]
+        t = [u for u in b[i, :ll[i]].tolist() if u not in drop]
+        m, n = len(s), len(t)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for r in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in range(1, n + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s[r - 1] != t[c - 1]))
+        d = float(dp[n])
+        if normalized:
+            d = d / max(n, 1)
+        out[i, 0] = d
+    import jax.numpy as jnp
+
+    return (Tensor._wrap(jnp.asarray(out)),
+            Tensor._wrap(jnp.asarray([a.shape[0]], jnp.int64)))
+
+
+__all__ += ["edit_distance"]

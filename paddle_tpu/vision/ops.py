@@ -1,0 +1,611 @@
+"""paddle.vision.ops — detection/vision operators.
+
+Reference parity: python/paddle/vision/ops.py (nms, box handling, RoI
+pooling family, yolo helpers, deform_conv2d). TPU-first: everything is
+expressed as fixed-shape jnp programs — NMS as a lax.fori_loop over a
+static box budget (no dynamic output shapes: returns keep indices padded
+with -1, the XLA-friendly convention), RoI ops as gather + bilinear
+interpolation batched over boxes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import nary, ensure_tensor
+
+__all__ = [
+    "nms", "matrix_nms", "box_coder", "box_clip", "prior_box",
+    "yolo_box", "yolo_loss", "roi_align", "roi_pool", "psroi_pool",
+    "distribute_fpn_proposals", "generate_proposals", "deform_conv2d",
+]
+
+
+def _iou_matrix(boxes):
+    """[N,4] (x1,y1,x2,y2) -> [N,N] IoU."""
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS (reference vision/ops.py nms). Returns kept indices in
+    descending-score order. Static-shape inner loop (lax.fori over the
+    candidate list with a suppression mask); the returned index array is
+    trimmed on host like the reference's dynamic result."""
+    def f(b, *rest):
+        n = b.shape[0]
+        s = rest[0] if scores is not None else jnp.arange(n, 0, -1, dtype=jnp.float32)
+        cats = rest[-1] if category_idxs is not None else None
+        iou = _iou_matrix(b.astype(jnp.float32))
+        if cats is not None:
+            # category-aware: only same-category boxes suppress each other
+            iou = jnp.where(cats[:, None] == cats[None, :], iou, 0.0)
+        order = jnp.argsort(-s)
+        iou_o = iou[order][:, order]
+
+        def body(i, alive):
+            # i-th (in score order) suppresses later overlapping boxes,
+            # but only if itself still alive
+            sup = (iou_o[i] > iou_threshold) & (jnp.arange(n) > i) & alive[i]
+            return alive & ~sup
+
+        alive = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+        kept_sorted = jnp.where(alive, order, -1)
+        return kept_sorted
+
+    args = [boxes] + ([scores] if scores is not None else []) \
+        + ([category_idxs] if category_idxs is not None else [])
+    out = nary(f, args, name="nms")
+    idx = [int(i) for i in out.numpy() if i >= 0]
+    if top_k is not None:
+        idx = idx[:top_k]
+    import numpy as np
+
+    return Tensor._wrap(jnp.asarray(np.asarray(idx, np.int64)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py matrix_nms): soft decay of
+    scores by pairwise IoU — fully parallel, no sequential suppression
+    (the TPU-friendly NMS)."""
+    bboxes = ensure_tensor(bboxes)
+    scores = ensure_tensor(scores)
+    bb = bboxes._data.astype(jnp.float32)       # [N, M, 4]
+    sc = scores._data.astype(jnp.float32)       # [N, C, M]
+    n, c, m = sc.shape
+    top_k = min(nms_top_k if nms_top_k > 0 else m, m)
+
+    def one_class(boxes, s):
+        order = jnp.argsort(-s)[:top_k]
+        b_s, s_s = boxes[order], s[order]
+        iou = _iou_matrix(b_s)
+        iou = jnp.triu(iou, k=1)                 # ious with higher-scored
+        max_iou = jnp.max(iou, axis=0)           # per box
+        comp = jnp.max(iou, axis=1)
+        if use_gaussian:
+            decay = jnp.exp(-(iou ** 2 - comp[None, :] ** 2)
+                            / gaussian_sigma)
+        else:
+            decay = (1 - iou) / jnp.maximum(1 - comp[None, :], 1e-9)
+        decay = jnp.min(jnp.where(jnp.triu(jnp.ones_like(iou), 1) > 0,
+                                  decay, 1.0), axis=0)
+        return s_s * decay, b_s, order
+
+    outs, boxes_out, labels, idxs = [], [], [], []
+    for bi in range(n):
+        for ci in range(c):
+            if ci == background_label:
+                continue
+            s_dec, b_s, order = one_class(bb[bi], sc[bi, ci])
+            keep = s_dec > post_threshold
+            outs.append(jnp.where(keep, s_dec, 0.0))
+            boxes_out.append(b_s)
+            labels.append(jnp.full((top_k,), ci, jnp.float32))
+            idxs.append(order)
+    import numpy as _np
+
+    s_all = _np.asarray(jnp.concatenate(outs))
+    order = _np.argsort(-s_all)
+    order = order[s_all[order] > 0]          # drop suppressed/thresholded
+    if keep_top_k > 0:
+        order = order[:keep_top_k]
+    lab = _np.asarray(jnp.concatenate(labels))[order]
+    sc_k = s_all[order]
+    bx = _np.asarray(jnp.concatenate(boxes_out))[order]
+    out = jnp.asarray(_np.concatenate(
+        [lab[:, None], sc_k[:, None], bx], axis=1))
+    res = [Tensor._wrap(out)]
+    if return_index:
+        res.append(Tensor._wrap(jnp.asarray(
+            _np.asarray(jnp.concatenate(idxs))[order])))
+    if return_rois_num:
+        res.append(Tensor._wrap(jnp.asarray([out.shape[0]], jnp.int32)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference vision/ops.py
+    box_coder)."""
+    pb = ensure_tensor(prior_box)._data.astype(jnp.float32)
+    tb = ensure_tensor(target_box)._data.astype(jnp.float32)
+    if prior_box_var is None:
+        var = jnp.ones((4,), jnp.float32)
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    else:
+        var = ensure_tensor(prior_box_var)._data.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(tw[:, None] / pw[None, :])
+        oh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if var.ndim == 1:
+            out = out / var
+        else:
+            out = out / var[None, :, :]
+        return Tensor._wrap(out)
+    # decode_center_size: target [N, M, 4] deltas against priors
+    if pb.ndim == 2:
+        pbb = pb[None, :, :] if axis == 0 else pb[:, None, :]
+        pwx = pw[None, :] if axis == 0 else pw[:, None]
+        phx = ph[None, :] if axis == 0 else ph[:, None]
+        pcxx = pcx[None, :] if axis == 0 else pcx[:, None]
+        pcyx = pcy[None, :] if axis == 0 else pcy[:, None]
+    if var.ndim == 1:
+        d = tb * var
+    else:
+        d = tb * (var[None, :, :] if axis == 0 else var[:, None, :])
+    dcx = d[..., 0] * pwx + pcxx
+    dcy = d[..., 1] * phx + pcyx
+    dw = jnp.exp(d[..., 2]) * pwx
+    dh = jnp.exp(d[..., 3]) * phx
+    out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                     dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm], -1)
+    return Tensor._wrap(out)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference fluid box_clip)."""
+    def f(b, info):
+        h = info[..., 0] / info[..., 2] - 1
+        w = info[..., 1] / info[..., 2] - 1
+        x = jnp.clip(b[..., 0::2], 0, w[..., None])
+        y = jnp.clip(b[..., 1::2], 0, h[..., None])
+        out = jnp.stack([x[..., 0], y[..., 0], x[..., 1], y[..., 1]], -1)
+        return out
+
+    return nary(f, [input, im_info], name="box_clip")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference vision/ops.py prior_box)."""
+    inp = ensure_tensor(input)._data
+    img = ensure_tensor(image)._data
+    fh, fw = inp.shape[2], inp.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    ratios = list(aspect_ratios)
+    if flip:
+        ratios += [1.0 / r for r in aspect_ratios if r != 1.0]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    boxes = []
+    for ms in min_sizes:
+        sizes = [(ms / iw, ms / ih)]
+        for r in ratios:
+            if r != 1.0:
+                sizes.append((ms * (r ** 0.5) / iw, ms / (r ** 0.5) / ih))
+        if max_sizes:
+            for Ms in max_sizes:
+                s = (ms * Ms) ** 0.5
+                sizes.insert(1, (s / iw, s / ih))
+        boxes.extend(sizes)
+    cx = (jnp.arange(fw) + offset) * step_w / iw
+    cy = (jnp.arange(fh) + offset) * step_h / ih
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    out = []
+    for bw, bh in boxes:
+        out.append(jnp.stack([cxg - bw / 2, cyg - bh / 2,
+                              cxg + bw / 2, cyg + bh / 2], -1))
+    pri = jnp.stack(out, axis=2)       # [fh, fw, nprior, 4]
+    if clip:
+        pri = jnp.clip(pri, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), pri.shape)
+    return Tensor._wrap(pri), Tensor._wrap(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes (reference vision/ops.py
+    yolo_box)."""
+    xd = ensure_tensor(x)._data.astype(jnp.float32)
+    imgs = ensure_tensor(img_size)._data
+    n, c, h, w = xd.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    xd = xd.reshape(n, na, -1, h, w)              # [N, na, 5+cls, H, W]
+    gx = (jax.nn.sigmoid(xd[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + jnp.arange(w)[None, None, None, :]) / w
+    gy = (jax.nn.sigmoid(xd[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2
+          + jnp.arange(h)[None, None, :, None]) / h
+    input_w = downsample_ratio * w
+    input_h = downsample_ratio * h
+    gw = jnp.exp(xd[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    gh = jnp.exp(xd[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(xd[:, :, 4])
+    probs = jax.nn.sigmoid(xd[:, :, 5:5 + class_num])
+    score = conf[:, :, None] * probs
+    score = jnp.where(conf[:, :, None] > conf_thresh, score, 0.0)
+    imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+    imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+    x1 = (gx - gw / 2) * imw
+    y1 = (gy - gh / 2) * imh
+    x2 = (gx + gw / 2) * imw
+    y2 = (gy + gh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0); y1 = jnp.clip(y1, 0)  # noqa: E702
+        x2 = jnp.minimum(x2, imw - 1)
+        y2 = jnp.minimum(y2, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+    scores = jnp.transpose(score, (0, 1, 3, 4, 2)).reshape(
+        n, -1, class_num)
+    return Tensor._wrap(boxes), Tensor._wrap(scores)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    raise NotImplementedError(
+        "yolo_loss: compose yolo_box decode with standard losses; the "
+        "monolithic fused training loss is not provided (descoped — "
+        "docs/OP_COVERAGE.md)")
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C,H,W]; y/x scalar grids [..]: bilinear values [C, ...]."""
+    h, w = feat.shape[1], feat.shape[2]
+    y0 = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = jnp.clip(y - y0, 0, 1)
+    wx = jnp.clip(x - x0, 0, 1)
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoI Align (reference vision/ops.py roi_align): bilinear-sampled
+    average pooling per RoI bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xd, bx, bn):
+        xd = xd.astype(jnp.float32)
+        bx = bx.astype(jnp.float32)
+        n = xd.shape[0]
+        # map each box to its batch image from boxes_num
+        counts = bn.astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(n), counts,
+                             total_repeat_length=bx.shape[0])
+        off = 0.5 if aligned else 0.0
+        ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+        def one_box(box, img):
+            feat = xd[img]
+            x1 = box[0] * spatial_scale - off
+            y1 = box[1] * spatial_scale - off
+            x2 = box[2] * spatial_scale - off
+            y2 = box[3] * spatial_scale - off
+            rw = x2 - x1
+            rh = y2 - y1
+            if not aligned:
+                rw = jnp.maximum(rw, 1.0)
+                rh = jnp.maximum(rh, 1.0)
+            bh = rh / ph
+            bw = rw / pw
+            iy = (jnp.arange(ph)[:, None, None, None]
+                  * bh + y1 + (jnp.arange(ratio)[None, None, :, None]
+                               + 0.5) * bh / ratio)
+            ix = (jnp.arange(pw)[None, :, None, None] * bw + x1
+                  + (jnp.arange(ratio)[None, None, None, :] + 0.5)
+                  * bw / ratio)
+            iy = jnp.broadcast_to(iy, (ph, pw, ratio, ratio))
+            ix = jnp.broadcast_to(ix, (ph, pw, ratio, ratio))
+            vals = _bilinear_sample(feat, iy, ix)   # [C, ph, pw, r, r]
+            return jnp.mean(vals, axis=(-2, -1))    # [C, ph, pw]
+
+        return jax.vmap(one_box)(bx, img_idx)
+
+    return nary(f, [x, boxes, boxes_num], name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoI max pooling (reference vision/ops.py roi_pool): quantized bins
+    with max reduction — implemented as dense spatial masking + max (no
+    dynamic shapes)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xd, bx, bn):
+        xd = xd.astype(jnp.float32)
+        bx = bx.astype(jnp.float32)
+        n, c, H, W = xd.shape
+        counts = bn.astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(n), counts,
+                             total_repeat_length=bx.shape[0])
+
+        def one_box(box, img):
+            feat = xd[img]
+            x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+            rw = jnp.maximum(x2 - x1 + 1, 1)
+            rh = jnp.maximum(y2 - y1 + 1, 1)
+            ys = jnp.arange(H)[None, :]             # bins via masks
+            xs = jnp.arange(W)[None, :]
+            b_y0 = y1 + (jnp.arange(ph)[:, None] * rh) // ph
+            b_y1 = y1 + ((jnp.arange(ph)[:, None] + 1) * rh + ph - 1) // ph
+            b_x0 = x1 + (jnp.arange(pw)[:, None] * rw) // pw
+            b_x1 = x1 + ((jnp.arange(pw)[:, None] + 1) * rw + pw - 1) // pw
+            my = (ys >= b_y0) & (ys < jnp.maximum(b_y1, b_y0 + 1))  # [ph,H]
+            mx = (xs >= b_x0) & (xs < jnp.maximum(b_x1, b_x0 + 1))  # [pw,W]
+            m = (my[:, None, :, None] & mx[None, :, None, :])  # [ph,pw,H,W]
+            neg = jnp.full((c, 1, 1, H, W), -jnp.inf)
+            vals = jnp.where(m[None], feat[:, None, None, :, :], neg)
+            out = jnp.max(vals, axis=(-2, -1))      # [C, ph, pw]
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(one_box)(bx, img_idx)
+
+    return nary(f, [x, boxes, boxes_num], name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (reference vision/ops.py
+    psroi_pool): channel c*ph*pw maps bin (i,j) to channel group."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xd, bx, bn):
+        xd = xd.astype(jnp.float32)
+        bx = bx.astype(jnp.float32)
+        n, C, H, W = xd.shape
+        oc = C // (ph * pw)
+        counts = bn.astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(n), counts,
+                             total_repeat_length=bx.shape[0])
+
+        def one_box(box, img):
+            feat = xd[img].reshape(oc, ph, pw, H, W)
+            x1 = box[0] * spatial_scale
+            y1 = box[1] * spatial_scale
+            x2 = box[2] * spatial_scale
+            y2 = box[3] * spatial_scale
+            bh = jnp.maximum(y2 - y1, 0.1) / ph
+            bw = jnp.maximum(x2 - x1, 0.1) / pw
+            ys = jnp.arange(H)[None, :]
+            xs = jnp.arange(W)[None, :]
+            b_y0 = jnp.floor(y1 + jnp.arange(ph)[:, None] * bh)
+            b_y1 = jnp.ceil(y1 + (jnp.arange(ph)[:, None] + 1) * bh)
+            b_x0 = jnp.floor(x1 + jnp.arange(pw)[:, None] * bw)
+            b_x1 = jnp.ceil(x1 + (jnp.arange(pw)[:, None] + 1) * bw)
+            my = (ys >= b_y0) & (ys < b_y1)
+            mx = (xs >= b_x0) & (xs < b_x1)
+            m = (my[:, None, :, None] & mx[None, :, None, :]).astype(
+                jnp.float32)                        # [ph,pw,H,W]
+            s = jnp.einsum("obxy,bxy->ob",
+                           feat.reshape(oc, ph * pw, H, W),
+                           m.reshape(ph * pw, H, W))
+            cnt = jnp.sum(m.reshape(ph * pw, -1), -1)
+            out = s / jnp.maximum(cnt[None, :], 1.0)
+            return out.reshape(oc, ph, pw)
+
+        return jax.vmap(one_box)(bx, img_idx)
+
+    return nary(f, [x, boxes, boxes_num], name="psroi_pool")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference vision/ops.py):
+    returns per-level roi lists + restore index."""
+    import numpy as np
+
+    rois = np.asarray(ensure_tensor(fpn_rois)._data, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, nums, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        outs.append(Tensor._wrap(jnp.asarray(rois[idx])))
+        nums.append(Tensor._wrap(jnp.asarray([len(idx)], jnp.int32)))
+        order.extend(idx.tolist())
+    restore = np.empty(len(order), np.int64)
+    restore[np.asarray(order, np.int64)] = np.arange(len(order))
+    restore_t = Tensor._wrap(jnp.asarray(restore[:, None]))
+    if rois_num is not None:
+        return outs, restore_t, nums
+    return outs, restore_t, None
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference vision/ops.py
+    generate_proposals): decode anchors, top-k, clip, NMS."""
+    import numpy as np
+
+    sc = np.asarray(ensure_tensor(scores)._data, np.float32)
+    bd = np.asarray(ensure_tensor(bbox_deltas)._data, np.float32)
+    ims = np.asarray(ensure_tensor(img_size)._data, np.float32)
+    an = np.asarray(ensure_tensor(anchors)._data, np.float32).reshape(-1, 4)
+    va = np.asarray(ensure_tensor(variances)._data, np.float32).reshape(-1, 4)
+    n = sc.shape[0]
+    all_rois, all_scores, all_nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for bi in range(n):
+        s = sc[bi].transpose(1, 2, 0).reshape(-1)
+        d = bd[bi].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_k, d_k, a_k, v_k = s[order], d[order], an[order % len(an)] \
+            if len(an) != len(s) else an[order], \
+            va[order % len(va)] if len(va) != len(s) else va[order]
+        aw = a_k[:, 2] - a_k[:, 0] + off
+        ah = a_k[:, 3] - a_k[:, 1] + off
+        acx = a_k[:, 0] + aw / 2
+        acy = a_k[:, 1] + ah / 2
+        cx = v_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = v_k[:, 1] * d_k[:, 1] * ah + acy
+        wN = np.exp(np.minimum(v_k[:, 2] * d_k[:, 2], 10.0)) * aw
+        hN = np.exp(np.minimum(v_k[:, 3] * d_k[:, 3], 10.0)) * ah
+        props = np.stack([cx - wN / 2, cy - hN / 2,
+                          cx + wN / 2 - off, cy + hN / 2 - off], 1)
+        H, W = ims[bi][0], ims[bi][1]
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, W - off)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, H - off)
+        keepm = ((props[:, 2] - props[:, 0] + off >= min_size)
+                 & (props[:, 3] - props[:, 1] + off >= min_size))
+        props, s_k = props[keepm], s_k[keepm]
+        kept = nms(Tensor._wrap(jnp.asarray(props)),
+                   iou_threshold=nms_thresh,
+                   scores=Tensor._wrap(jnp.asarray(s_k)))
+        kept = np.asarray(kept._data)[:post_nms_top_n]
+        all_rois.append(props[kept])
+        all_scores.append(s_k[kept])
+        all_nums.append(len(kept))
+    rois = Tensor._wrap(jnp.asarray(np.concatenate(all_rois, 0)))
+    rois_num = Tensor._wrap(jnp.asarray(all_nums, jnp.int32))
+    scores_out = Tensor._wrap(jnp.asarray(
+        np.concatenate(all_scores, 0).astype(np.float32)))
+    if return_rois_num:
+        return rois, scores_out, rois_num
+    return rois, scores_out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference vision/ops.py deform_conv2d):
+    bilinear-sampled im2col + matmul — the gather-heavy part vmaps over
+    output positions; the contraction stays on the MXU."""
+    def to2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    sh, sw = to2(stride)
+    ph_, pw_ = to2(padding)
+    dh, dw = to2(dilation)
+
+    def f(xd, od, wd, *rest):
+        xd = xd.astype(jnp.float32)
+        od = od.astype(jnp.float32)
+        wd = wd.astype(jnp.float32)
+        md = rest[0].astype(jnp.float32) if mask is not None else None
+        n, c, H, W = xd.shape
+        co, cg, kh, kw = wd.shape
+        xp = jnp.pad(xd, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+        Hp, Wp = xp.shape[2], xp.shape[3]
+        oh = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+
+        base_y = (jnp.arange(oh) * sh)[:, None, None] \
+            + (jnp.arange(kh) * dh)[None, :, None]      # [oh, kh, 1]
+        base_x = (jnp.arange(ow) * sw)[:, None, None] \
+            + (jnp.arange(kw) * dw)[None, :, None]      # [ow, kw, 1]
+
+        def one_image(img, offs, mk):
+            # offs [2*dg*kh*kw, oh, ow]; mk [dg*kh*kw, oh, ow] or None
+            dg = deformable_groups
+            cpg = c // dg
+            offs = offs.reshape(dg, 2, kh * kw, oh, ow)
+            mk_r = mk.reshape(dg, kh * kw, oh, ow) if mk is not None \
+                else None
+
+            def one_pos(i, j):
+                oy = offs[:, 0, :, i, j]                 # [dg, kh*kw]
+                ox = offs[:, 1, :, i, j]
+                ky = base_y[i, :, 0]
+                kx = base_x[j, :, 0]
+                gy = jnp.broadcast_to(ky[:, None], (kh, kw)).reshape(-1)
+                gx = jnp.broadcast_to(kx[None, :], (kh, kw)).reshape(-1)
+                img_g = img.reshape(dg, cpg, Hp, Wp)
+                vals = jax.vmap(_bilinear_sample)(
+                    img_g, gy[None] + oy, gx[None] + ox)  # [dg,cpg,kh*kw]
+                if mk_r is not None:
+                    vals = vals * mk_r[:, None, :, i, j]
+                return vals.reshape(c, kh * kw)
+
+            cols = jax.vmap(lambda i: jax.vmap(
+                lambda j: one_pos(i, j))(jnp.arange(ow)))(jnp.arange(oh))
+            # cols [oh, ow, C, kh*kw] -> output via grouped matmul
+            cols = cols.reshape(oh * ow, c * kh * kw)
+            wmat = wd.reshape(co, cg * kh * kw)
+            if groups == 1:
+                out = cols @ wmat.T                      # [oh*ow, co]
+            else:
+                cols_g = cols.reshape(oh * ow, groups, cg * kh * kw)
+                w_g = wmat.reshape(groups, co // groups, cg * kh * kw)
+                out = jnp.einsum("ngk,gok->ngo", cols_g, w_g).reshape(
+                    oh * ow, co)
+            return out.T.reshape(co, oh, ow)
+
+        if md is None:
+            outs = jax.vmap(
+                lambda img, offs: one_image(img, offs, None))(xp, od)
+        else:
+            outs = jax.vmap(one_image)(xp, od, md)
+        return outs
+
+    args = [x, offset, weight] + ([mask] if mask is not None else [])
+    out = nary(f, args, name="deform_conv2d")
+    if bias is not None:
+        b = ensure_tensor(bias)
+        out = out + b.reshape([1, -1, 1, 1])
+    return out

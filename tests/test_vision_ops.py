@@ -1,0 +1,161 @@
+"""paddle.vision.ops tests (reference python/paddle/vision/ops.py):
+nms/matrix_nms/box_coder/roi family/yolo_box/deform_conv2d — numerics
+checked against straightforward numpy references.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _np_iou(a, b):
+    ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+class TestNMS:
+    def test_matches_greedy_reference(self):
+        rng = np.random.default_rng(0)
+        xy = rng.uniform(0, 50, (30, 2))
+        wh = rng.uniform(5, 20, (30, 2))
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        scores = rng.uniform(0, 1, 30).astype(np.float32)
+
+        # greedy numpy reference
+        order = np.argsort(-scores)
+        keep = []
+        for i in order:
+            if all(_np_iou(boxes[i], boxes[j]) <= 0.4 for j in keep):
+                keep.append(i)
+        got = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.4,
+                       scores=paddle.to_tensor(scores))
+        np.testing.assert_array_equal(np.asarray(got._data), keep)
+
+    def test_no_scores_uses_input_order(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [50, 50, 60, 60]], np.float32)
+        got = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.3)
+        np.testing.assert_array_equal(np.asarray(got._data), [0, 2])
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                          [50, 50, 60, 60]], np.float32)
+        got = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.3,
+                       scores=paddle.to_tensor(
+                           np.array([0.9, 0.8, 0.7], np.float32)),
+                       top_k=2)
+        assert len(np.asarray(got._data)) == 2
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(1)
+        priors = np.array([[0, 0, 10, 10], [10, 10, 30, 30]], np.float32)
+        targets = np.array([[2, 2, 12, 14], [8, 8, 28, 24]], np.float32)
+        enc = vops.box_coder(paddle.to_tensor(priors), [0.1, 0.1, 0.2, 0.2],
+                             paddle.to_tensor(targets),
+                             code_type="encode_center_size")
+        # decode back: deltas [N=2 targets, M=2 priors, 4] — take diagonal
+        dec = vops.box_coder(paddle.to_tensor(priors), [0.1, 0.1, 0.2, 0.2],
+                             enc, code_type="decode_center_size")
+        d = np.asarray(dec._data)
+        np.testing.assert_allclose(d[0, 0], targets[0], rtol=1e-4,
+                                   atol=1e-3)
+        np.testing.assert_allclose(d[1, 1], targets[1], rtol=1e-4,
+                                   atol=1e-3)
+
+
+class TestRoiOps:
+    def _feat(self):
+        # deterministic ramp feature [1, 2, 8, 8]
+        base = np.arange(64, dtype=np.float32).reshape(8, 8)
+        return np.stack([base, base * 10])[None]
+
+    def test_roi_align_center_value(self):
+        x = self._feat()
+        boxes = np.array([[2.0, 2.0, 6.0, 6.0]], np.float32)
+        out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([1], np.int32)),
+                             output_size=1, aligned=True)
+        # aligned=True: region [1.5,5.5]^2; ratio-2 samples at 2.5/4.5 on
+        # each axis -> mean = ramp value at (3.5, 3.5) = 3.5*8 + 3.5
+        v = np.asarray(out._data)
+        assert v.shape == (1, 2, 1, 1)
+        np.testing.assert_allclose(v[0, 0, 0, 0], 31.5, atol=1e-4)
+
+    def test_roi_pool_max(self):
+        x = self._feat()
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = vops.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                            paddle.to_tensor(np.array([1], np.int32)),
+                            output_size=2)
+        v = np.asarray(out._data)
+        assert v.shape == (1, 2, 2, 2)
+        # region rows/cols 0..3 split 2x2: maxes at (1,1),(1,3),(3,1),(3,3)
+        np.testing.assert_allclose(v[0, 0], [[9, 11], [25, 27]])
+
+    def test_psroi_pool_shape_and_mean(self):
+        # C = oc * ph * pw = 1*2*2
+        x = np.ones((1, 4, 8, 8), np.float32)
+        for ch in range(4):
+            x[0, ch] = ch
+        boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+        out = vops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                              paddle.to_tensor(np.array([1], np.int32)),
+                              output_size=2)
+        v = np.asarray(out._data)
+        assert v.shape == (1, 1, 2, 2)
+        np.testing.assert_allclose(v[0, 0], [[0, 1], [2, 3]])
+
+
+class TestYoloBox:
+    def test_shapes_and_range(self):
+        n, na, cls, h, w = 1, 2, 3, 4, 4
+        x = np.random.default_rng(2).standard_normal(
+            (n, na * (5 + cls), h, w)).astype(np.float32)
+        boxes, scores = vops.yolo_box(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.array([[128, 128]], np.int32)),
+            anchors=[10, 13, 16, 30], class_num=cls, conf_thresh=0.0,
+            downsample_ratio=32)
+        assert np.asarray(boxes._data).shape == (1, na * h * w, 4)
+        assert np.asarray(scores._data).shape == (1, na * h * w, cls)
+        s = np.asarray(scores._data)
+        assert (s >= 0).all() and (s <= 1).all()
+
+
+class TestDistributeFpn:
+    def test_levels(self):
+        rois = np.array([[0, 0, 10, 10],        # small -> low level
+                         [0, 0, 300, 300]], np.float32)  # large -> high
+        outs, restore, nums = vops.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224,
+            rois_num=paddle.to_tensor(np.array([2], np.int32)))
+        sizes = [np.asarray(o._data).shape[0] for o in outs]
+        assert sum(sizes) == 2
+        assert np.asarray(outs[0]._data).shape[0] == 1   # small at lvl 2
+        r = np.asarray(restore._data).reshape(-1)
+        assert sorted(r.tolist()) == [0, 1]
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+        kh = kw = 3
+        oh = ow = 4
+        offset = np.zeros((1, 2 * kh * kw, oh, ow), np.float32)
+        got = vops.deform_conv2d(paddle.to_tensor(x),
+                                 paddle.to_tensor(offset),
+                                 paddle.to_tensor(w))
+        want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.asarray(want._data), atol=1e-4)
