@@ -20,6 +20,9 @@ from ....nn.layer.layers import Layer
 from .pp_layers import PipelineLayer
 
 
+_WARNED_ACCUM_ONLY = False
+
+
 class PipelineParallel(Layer):
     def __init__(self, layers, hcg, strategy=None):
         super().__init__()
@@ -54,7 +57,26 @@ class PipelineParallel(Layer):
 
         Runs `accumulate_steps` micro-steps: each forward+backward
         accumulates grads on the tape; then one optimizer step. Loss
-        returned is the micro-step mean."""
+        returned is the micro-step mean.
+
+        NOTE: this eager path is numerically a pipeline schedule but gets
+        NO stage parallelism (micro-steps run sequentially on every
+        device). Real pipelining lives on the compiled path —
+        `pipeline_spmd` / `pipeline_spmd_hetero` (spmd_pipeline.py), used
+        by GPTForCausalLMPipe inside TrainStep — where the ppermute ring
+        overlaps stages. A once-per-process warning says so."""
+        global _WARNED_ACCUM_ONLY
+        if self.accumulate_steps > 1 and not _WARNED_ACCUM_ONLY:
+            _WARNED_ACCUM_ONLY = True
+            import warnings
+
+            warnings.warn(
+                "PipelineParallel.train_batch runs micro-steps "
+                "SEQUENTIALLY (gradient accumulation only — no stage "
+                "parallelism in eager mode). For a real pipeline, compile "
+                "the step: use a pipeline model (GPTForCausalLMPipe / "
+                "pipeline_spmd) under jit.TrainStep.", RuntimeWarning,
+                stacklevel=2)
         micro_batches = self._split_micro(data, self.accumulate_steps)
         total = None
         for mb in micro_batches:

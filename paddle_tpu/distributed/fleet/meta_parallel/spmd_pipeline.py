@@ -26,6 +26,33 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
+def _ring_scan(apply_fn, fresh_of, state0, outs0, n_stages, n_micro, axis,
+               perm, stage):
+    """The 1F1B ring schedule shared by pipeline_spmd and
+    pipeline_spmd_hetero: warmup/steady/cooldown fall out of
+    n_stages + n_micro - 1 ticks; stage 0 injects fresh micro-batches and
+    collects finished ones (the ring wraps the last stage back to 0)."""
+
+    def tick(carry, t):
+        state, outs = carry
+        take = jnp.clip(t, 0, n_micro - 1)
+        inp = jnp.where(stage == 0, fresh_of(take), state)
+        y = apply_fn(inp)
+        passed = jax.lax.ppermute(y, axis, perm)
+        done = t - (n_stages - 1)
+        slot = jnp.clip(done, 0, n_micro - 1)
+        outs = jax.lax.cond(
+            done >= 0,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, passed, slot, 0),
+            lambda o: o, outs)
+        return (passed, outs), None
+
+    (_, outs), _ = jax.lax.scan(
+        tick, (state0, outs0), jnp.arange(n_stages + n_micro - 1))
+    return outs
+
+
 def pipeline_spmd(block_fn, stage_params, x_micro, *, mesh, axis="pp",
                   num_chunks=1):
     """Run stacked pipeline stages over micro-batches.
@@ -53,29 +80,12 @@ def pipeline_spmd(block_fn, stage_params, x_micro, *, mesh, axis="pp",
 
     def one_pass(params, xs, stage):
         """One full ring pass: every micro-batch through n_stages stages."""
-        state = jnp.zeros(xs.shape[1:], xs.dtype)
-        outs = jnp.zeros_like(xs)
-
-        def tick(carry, t):
-            state, outs = carry
-            take = jnp.clip(t, 0, n_micro - 1)
-            fresh = jax.lax.dynamic_index_in_dim(xs, take, 0, keepdims=False)
-            inp = jnp.where(stage == 0, fresh, state)
-            y = block_fn(params, inp)
-            passed = jax.lax.ppermute(y, axis, perm)
-            done = t - (n_stages - 1)
-            slot = jnp.clip(done, 0, n_micro - 1)
-            outs = jax.lax.cond(
-                done >= 0,
-                lambda o: jax.lax.dynamic_update_index_in_dim(
-                    o, passed, slot, 0),
-                lambda o: o,
-                outs)
-            return (passed, outs), None
-
-        (_, outs), _ = jax.lax.scan(
-            tick, (state, outs), jnp.arange(n_stages + n_micro - 1))
-        return outs
+        return _ring_scan(
+            lambda inp: block_fn(params, inp),
+            lambda take: jax.lax.dynamic_index_in_dim(xs, take, 0,
+                                                      keepdims=False),
+            jnp.zeros(xs.shape[1:], xs.dtype), jnp.zeros_like(xs),
+            n_stages, n_micro, axis, perm, stage)
 
     def staged(params, xs):
         params = jax.tree.map(lambda a: a[0], params)  # local stage slice
@@ -113,3 +123,187 @@ def microbatch(x, n_micro):
 def unmicrobatch(x):
     """[n_micro, mb, ...] -> [b, ...]"""
     return x.reshape((x.shape[0] * x.shape[1],) + tuple(x.shape[2:]))
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous stages (reference pp_layers.py LayerDesc segmentation:
+# embedding on stage 0, head on the last stage — stages need NOT preserve
+# the activation shape)
+# ---------------------------------------------------------------------------
+
+def _pad_to(x, shape):
+    pad = [(0, t - s) for s, t in zip(x.shape, shape)]
+    return jnp.pad(x, pad) if any(p != (0, 0) for p in pad) else x
+
+
+def _union_shape(shapes):
+    rank = max(len(s) for s in shapes)
+    padded = [(1,) * (rank - len(s)) + tuple(s) for s in shapes]
+    return tuple(max(dims) for dims in zip(*padded))
+
+
+def pipeline_spmd_hetero(stage_fns, stage_params, x_micro, *, mesh,
+                         axis="pp", out_shape=None, out_dtype=None):
+    """`pipeline_spmd` without the shape-preserving-stage restriction.
+
+    Args:
+      stage_fns: list of ``n_stages`` callables ``(params, x) -> y`` —
+        each stage has its OWN parameter pytree and in/out activation
+        shapes (e.g. stage 0 embeds int tokens into hiddens, the last
+        stage projects hiddens to logits).
+      stage_params: list of ``n_stages`` parameter pytrees (arbitrary,
+        heterogeneous structures).
+      x_micro: ``[n_micro, ...]`` micro-batched stage-0 inputs.
+      out_shape/out_dtype: the LAST stage's per-micro output aval
+        (inferred via ``jax.eval_shape`` when omitted).
+
+    Mechanics (TPU-first): every device runs ONE compiled body that
+    ``lax.switch``es on its stage index; activations ride the ring in a
+    PADDED-UNION buffer (elementwise-max of all boundary shapes, widest
+    dtype), each branch unpadding its input and repadding its output.
+    Per-stage parameters are flattened, rank/shape-padded slot-wise and
+    stacked on a leading [n_stages] dim sharded over ``axis`` — so each
+    device stores ~one stage's (padded) parameters, preserving pipeline
+    memory scaling, at the cost of slot padding up to the largest stage.
+    """
+    n_stages = mesh.shape[axis]
+    if len(stage_fns) != n_stages or len(stage_params) != n_stages:
+        raise ValueError(
+            f"need exactly {n_stages} stage_fns/stage_params (mesh "
+            f"{axis}={n_stages})")
+    n_micro = int(x_micro.shape[0])
+    mb_in = x_micro.shape[1:]
+
+    # --- boundary avals: trace each stage to learn its output shape ----
+    flat_params = [jax.tree_util.tree_flatten(p) for p in stage_params]
+    in_aval = jax.ShapeDtypeStruct(mb_in, x_micro.dtype)
+    boundary = [in_aval]
+    for s in range(n_stages):
+        out = jax.eval_shape(stage_fns[s], stage_params[s], boundary[-1])
+        if not isinstance(out, jax.ShapeDtypeStruct):
+            raise ValueError(
+                f"stage {s} must return a single array, got {out}")
+        boundary.append(out)
+    if out_shape is None:
+        out_shape = boundary[-1].shape
+    if out_dtype is None:
+        out_dtype = boundary[-1].dtype
+
+    carry_shape = _union_shape([b.shape for b in boundary])
+    floats = [b.dtype for b in boundary
+              if not jnp.issubdtype(b.dtype, jnp.integer)]
+    ints = [b.dtype for b in boundary
+            if jnp.issubdtype(b.dtype, jnp.integer)]
+    carry_dtype = jnp.result_type(*floats) if floats else jnp.float32
+    # integer activations (token ids) ride the ring BITCAST into the
+    # float carry — exact for every id, unlike a value cast (float32
+    # rounds ints >= 2^24). Widen to the NARROWEST float that fits the
+    # widest int (bf16 + int32 -> float32, not float64).
+    if ints:
+        need = max(jnp.finfo(carry_dtype).bits,
+                   jnp.iinfo(jnp.result_type(*ints)).bits)
+        carry_dtype = {16: carry_dtype, 32: jnp.float32,
+                       64: jnp.float64}[need]
+    _cbits = jnp.finfo(carry_dtype).bits
+    _int_of_width = {16: jnp.int16, 32: jnp.int32, 64: jnp.int64}[_cbits]
+
+    def to_carry(y):
+        yr = y.reshape((1,) * (len(carry_shape) - y.ndim) + y.shape)
+        if jnp.issubdtype(yr.dtype, jnp.integer):
+            yr = jax.lax.bitcast_convert_type(
+                yr.astype(_int_of_width), carry_dtype)
+        else:
+            yr = yr.astype(carry_dtype)
+        return _pad_to(yr, carry_shape)
+
+    def from_carry(c, aval):
+        sl = tuple(slice(0, d) for d in
+                   (1,) * (len(carry_shape) - len(aval.shape))
+                   + aval.shape)
+        v = c[sl].reshape(aval.shape)
+        if jnp.issubdtype(aval.dtype, jnp.integer):
+            return jax.lax.bitcast_convert_type(
+                v, _int_of_width).astype(aval.dtype)
+        return v.astype(aval.dtype)
+
+    # --- pad + stack per-stage parameter leaves slot-wise --------------
+    max_slots = max(len(f[0]) for f in flat_params)
+    slot_shapes, slot_dtypes = [], []
+    for j in range(max_slots):
+        shapes, dts = [], []
+        for leaves, _ in flat_params:
+            if j < len(leaves):
+                shapes.append(jnp.shape(leaves[j]))
+                dts.append(jnp.result_type(leaves[j]))
+        slot_shapes.append(_union_shape(shapes))
+        slot_dtypes.append(jnp.result_type(*dts))
+    stacked = []
+    for j in range(max_slots):
+        per = []
+        for leaves, _ in flat_params:
+            if j < len(leaves):
+                x = jnp.asarray(leaves[j]).astype(slot_dtypes[j])
+                x = x.reshape((1,) * (len(slot_shapes[j]) - x.ndim)
+                              + x.shape)
+                per.append(_pad_to(x, slot_shapes[j]))
+            else:
+                per.append(jnp.zeros(slot_shapes[j], slot_dtypes[j]))
+        stk = jnp.stack(per)                    # [n_stages, *slot_shape]
+        # place each stage's slice on its pp devices up front so the full
+        # (padding-inflated) stack never lives replicated on one device
+        if not isinstance(stk, jax.core.Tracer):
+            from jax.sharding import NamedSharding
+
+            stk = jax.device_put(stk, NamedSharding(
+                mesh, P(axis, *([None] * (stk.ndim - 1)))))
+        stacked.append(stk)
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def branch(s):
+        leaves_avals = [jax.ShapeDtypeStruct(jnp.shape(l),
+                                             jnp.result_type(l))
+                        for l in flat_params[s][0]]
+        treedef = flat_params[s][1]
+
+        def run(slot_leaves, c):
+            leaves = []
+            for j, aval in enumerate(leaves_avals):
+                leaves.append(from_carry_slot(slot_leaves[j], aval))
+            params = jax.tree_util.tree_unflatten(treedef, leaves)
+            x = from_carry(c, boundary[s])
+            y = stage_fns[s](params, x)
+            return to_carry(y)
+
+        return run
+
+    def from_carry_slot(padded, aval):
+        sl = tuple(slice(0, d) for d in
+                   (1,) * (len(padded.shape) - len(aval.shape))
+                   + aval.shape)
+        return padded[sl].reshape(aval.shape).astype(aval.dtype)
+
+    branches = [branch(s) for s in range(n_stages)]
+
+    def staged(stk, xs):
+        local = [a[0] for a in stk]             # this device's slot slices
+        stage = jax.lax.axis_index(axis)
+        outs = _ring_scan(
+            lambda inp: jax.lax.switch(stage, branches, local, inp),
+            lambda take: to_carry(jax.lax.dynamic_index_in_dim(
+                xs, take, 0, keepdims=False)),
+            jnp.zeros(carry_shape, carry_dtype),
+            jnp.zeros((n_micro,) + carry_shape, carry_dtype),
+            n_stages, n_micro, axis, perm, stage)
+        return outs[None]
+
+    in_specs = (tuple(P(axis) for _ in stacked), P())
+    out = jax.shard_map(
+        staged, mesh=mesh,
+        in_specs=in_specs, out_specs=P(axis),
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )(tuple(stacked), x_micro)
+    outs = out[0]                                # [n_micro, *carry_shape]
+    last_aval = jax.ShapeDtypeStruct(tuple(out_shape), out_dtype)
+    return jax.vmap(lambda c: from_carry(c, last_aval))(outs)

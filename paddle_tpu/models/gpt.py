@@ -201,7 +201,9 @@ class GPTStackedBlocks(nn.Layer):
             flat = "blocks__" + pname.replace(".", "__")
             from ..nn.layer.layers import Parameter
 
-            self.add_parameter(flat, Parameter(jnp.asarray(data)))
+            param = Parameter(jnp.asarray(data))
+            param.layer_stacked = True   # optimizer chunks the update
+            self.add_parameter(flat, param)
             self._stacked_names.append((flat, pname))
 
     def forward(self, x):
@@ -254,14 +256,15 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(config.max_position_embeddings,
                                 config.hidden_size)
         self.drop = nn.Dropout(config.hidden_dropout_prob)
+        if config.scan_layers and (config.hidden_dropout_prob
+                                   or config.attention_dropout_prob):
+            # the scan body traces once, so eager dropout keys would be
+            # shared by every layer — refuse rather than silently
+            # correlate masks across layers
+            raise ValueError(
+                "scan_layers=True requires zero dropout (per-layer "
+                "RNG is not threaded through the scan yet)")
         if config.scan_layers:
-            if config.hidden_dropout_prob or config.attention_dropout_prob:
-                # the scan body traces once, so eager dropout keys would be
-                # shared by every layer — refuse rather than silently
-                # correlate masks across layers
-                raise ValueError(
-                    "scan_layers=True requires zero dropout (per-layer "
-                    "RNG is not threaded through the scan yet)")
             self.blocks = GPTStackedBlocks(config)
         else:
             self.blocks = nn.LayerList([GPTBlock(config)
@@ -292,7 +295,7 @@ class GPTModel(nn.Layer):
             position_ids = C.arange(0, s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
-        if isinstance(self.blocks, GPTStackedBlocks):
+        if self.config.scan_layers:
             x = self.blocks(x)
         else:
             for block in self.blocks:

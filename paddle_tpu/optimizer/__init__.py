@@ -105,9 +105,33 @@ class Adam(Optimizer):
         vmax = (self._get_accumulator("moment2_max", p, dtype=md)
                 if self._amsgrad else None)
         t = jnp.asarray(self._step_count, jnp.float32)
+        lr = jnp.asarray(lr_v, jnp.float32)
+        wd = jnp.float32(decoupled_wd)
+        pv = self._param_value(p)
+        if getattr(p, "layer_stacked", False) and pv.ndim >= 2 \
+                and vmax is None:
+            # layer-stacked params (scan_layers models): running the
+            # update on the whole [L, ...] stack materializes whole-stack
+            # fp32 temps (g/m/v upcasts + outputs ~ 4 x 4 bytes/param) —
+            # measured to OOM a 16G chip at 1.3b. Update layer-by-layer
+            # with in-place .at[i].set chains seeded from the CURRENT
+            # buffers, so XLA aliases the donated state through the chain
+            # (a lax.scan assembling fresh outputs defeats that aliasing —
+            # also measured to OOM). Temps shrink by L; state traffic
+            # unchanged.
+            out, m_new, v_new = pv, m, v
+            for i in range(pv.shape[0]):
+                o_i, mn_i, vn_i, _ = self._adam_math(
+                    pv[i], g[i], m[i], v[i], None, lr, t, wd)
+                out = out.at[i].set(o_i.astype(pv.dtype))
+                m_new = m_new.at[i].set(mn_i.astype(m.dtype))
+                v_new = v_new.at[i].set(vn_i.astype(v.dtype))
+            self._set_accumulator("moment1", p, m_new)
+            self._set_accumulator("moment2", p, v_new)
+            self._write_param(p, out)
+            return
         out, m_new, v_new, vmax_new = self._adam_math(
-            self._param_value(p), g, m, v, vmax,
-            jnp.asarray(lr_v, jnp.float32), t, jnp.float32(decoupled_wd))
+            pv, g, m, v, vmax, lr, t, wd)
         self._set_accumulator("moment1", p, m_new.astype(m.dtype))
         self._set_accumulator("moment2", p, v_new.astype(v.dtype))
         if vmax_new is not None:
