@@ -41,14 +41,13 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
         GPTForCausalLM, GPTPretrainingCriterion, gpt_config,
     )
 
-    # scan-over-layers for big models: one compiled block instead of 24+
-    # inlined copies — the 1.3b whole-step compile drops from ~17 min
-    # (would blow the driver's bench window) to minutes, same math
-    # (parity-tested); override with BENCH_SCAN_LAYERS=0/1
-    big_model = "1.3b" in model_name or "2.7b" in model_name \
-        or "6.7b" in model_name or "13b" in model_name
-    scan_layers = os.environ.get("BENCH_SCAN_LAYERS",
-                                 "1" if big_model else "0") == "1"
+    # scan-over-layers (one compiled block instead of 24+ inlined copies)
+    # is available via BENCH_SCAN_LAYERS=1 but OFF by default: at 1.3b the
+    # scan keeps all layer grads live simultaneously (the unrolled program
+    # lets XLA free each grad right after its optimizer slice) and OOMs
+    # the 16G chip; the unrolled step fits and its ~17 min cold compile is
+    # amortized by the persistent compile cache (.jax_cache)
+    scan_layers = os.environ.get("BENCH_SCAN_LAYERS", "0") == "1"
     cfg = gpt_config(model_name, max_position_embeddings=seq,
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
                      use_recompute=recompute,

@@ -1,0 +1,220 @@
+"""paddle.distributed.rpc — tensor/function RPC between workers.
+
+Reference parity: python/paddle/distributed/rpc/rpc.py (init_rpc:95,
+rpc_sync, rpc_async, shutdown, get_worker_info) over the C++ brpc agent
+(paddle/fluid/distributed/rpc/). TPU-first replacement: the control plane
+is the SAME TCPStore used for rendezvous (store.py) — requests are
+pickled (fn, args) posted under atomically-claimed sequence keys, served
+by a daemon thread per worker, results posted back. No brpc, no extra
+sockets; data-plane tensors ride the store too (RPC is a control-path
+API — bulk tensor movement belongs to the collectives).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+
+
+_state = {
+    "store": None, "rank": None, "world_size": None, "name": None,
+    "server": None, "stop": None, "workers": {},
+}
+
+
+def _req_key(dst, seq):
+    return f"__rpc/{dst}/req/{seq}"
+
+
+def _ret_key(dst, seq):
+    return f"__rpc/{dst}/ret/{seq}"
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Reference rpc.py init_rpc: register this worker and start serving.
+
+    rank/world_size/master_endpoint default from the launcher env
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER)."""
+    from .store import TCPStore
+
+    if _state["store"] is not None:
+        raise RuntimeError("rpc already initialized; call shutdown() first")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else int(rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else int(world_size)
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER", None)
+    if ep is None:
+        if world_size > 1:
+            raise ValueError(
+                "multi-worker rpc needs master_endpoint (host:port)")
+        # single worker: self-hosted ephemeral store
+        store = TCPStore("127.0.0.1", _free_port(), is_master=True,
+                         world_size=1)
+    else:
+        host, port = ep.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=(rank == 0),
+                         world_size=world_size)
+    _state.update(store=store, rank=rank, world_size=world_size, name=name)
+    store.set(f"__rpc/worker/{rank}", name.encode())
+    # learn peers (blocks until everyone registered)
+    workers = {}
+    for r in range(world_size):
+        store.wait([f"__rpc/worker/{r}"])
+        peer = store.get(f"__rpc/worker/{r}").decode()
+        if peer in workers:
+            raise ValueError(
+                f"duplicate rpc worker name {peer!r} (ranks "
+                f"{workers[peer]} and {r}); names must be unique")
+        workers[peer] = r
+    _state["workers"] = workers
+    stop = threading.Event()
+    server = threading.Thread(target=_serve_loop, args=(store, rank, stop),
+                              daemon=True, name=f"rpc-server-{rank}")
+    _state.update(server=server, stop=stop)
+    server.start()
+    return WorkerInfo(name, rank)
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _serve_loop(store, rank, stop):
+    served = 0
+    while not stop.is_set():
+        key = _req_key(rank, served)
+        blob = store._get_once(key)
+        if blob is None:
+            time.sleep(0.005)
+            continue
+        served += 1
+        src = seq = None
+        try:
+            src, seq, fn, args, kwargs = pickle.loads(blob)
+            result = ("ok", fn(*args, **(kwargs or {})))
+        except Exception as e:  # ship the failure back, don't kill serving
+            result = ("err", repr(e))
+        # free the consumed request blob (the store is shared with
+        # rendezvous — unbounded growth would leak in long jobs)
+        _try_delete(store, key)
+        if src is None:
+            # unpicklable request: the sender's token is unknown, so no
+            # reply is possible — the caller times out, serving continues
+            continue
+        store.set(_ret_key(src, seq), pickle.dumps(result))
+
+
+def _try_delete(store, key):
+    for meth in ("delete", "delete_key", "_delete"):
+        f = getattr(store, meth, None)
+        if f is not None:
+            try:
+                f(key)
+            except Exception:
+                pass
+            return
+
+
+def _resolve_rank(to):
+    if isinstance(to, int):
+        return to
+    if isinstance(to, WorkerInfo):
+        return to.rank
+    workers = _state["workers"]
+    if to not in workers:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(workers)}")
+    return workers[to]
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=120):
+    """Reference rpc.py rpc_async: returns a Future of fn(*args) executed
+    on the destination worker."""
+    store = _state["store"]
+    if store is None:
+        raise RuntimeError("call init_rpc first")
+    dst = _resolve_rank(to)
+    rank = _state["rank"]
+    # serialize BEFORE claiming the sequence slot: the serve loop consumes
+    # slots strictly in order, so a claimed-but-never-posted slot (e.g.
+    # unpicklable args) would head-of-line-block the destination forever
+    probe = pickle.dumps((rank, "probe", fn, tuple(args or ()), kwargs))
+    del probe
+    seq = store.add(f"__rpc/{dst}/cnt", 1) - 1      # claim a slot
+    token = f"{rank}:{seq}"
+    store.set(_req_key(dst, seq),
+              pickle.dumps((rank, token, fn, tuple(args or ()), kwargs)))
+    fut = Future()
+
+    def waiter():
+        deadline = time.time() + timeout
+        key = _ret_key(rank, token)
+        while time.time() < deadline:
+            blob = store._get_once(key)
+            if blob is not None:
+                _try_delete(store, key)
+                status, payload = pickle.loads(blob)
+                if status == "ok":
+                    fut.set_result(payload)
+                else:
+                    fut.set_exception(RuntimeError(
+                        f"remote raised: {payload}"))
+                return
+            time.sleep(0.005)
+        fut.set_exception(TimeoutError(f"rpc to rank {dst} timed out"))
+
+    threading.Thread(target=waiter, daemon=True).start()
+    return fut
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=120):
+    """Reference rpc.py rpc_sync: blocking remote call."""
+    return rpc_async(to, fn, args=args, kwargs=kwargs,
+                     timeout=timeout).result(timeout=timeout)
+
+
+def get_worker_info(name=None):
+    if name is None:
+        return WorkerInfo(_state["name"], _state["rank"])
+    return WorkerInfo(name, _resolve_rank(name))
+
+
+def get_all_worker_infos():
+    return [WorkerInfo(n, r) for n, r in sorted(
+        _state["workers"].items(), key=lambda kv: kv[1])]
+
+
+def shutdown(graceful=True, timeout=60):
+    """Reference rpc.py shutdown: barrier with every peer (so no request
+    is in flight when serving stops), then stop the server thread."""
+    store = _state["store"]
+    if graceful and store is not None and _state["world_size"] > 1:
+        n = store.add("__rpc/shutdown_cnt", 1)
+        deadline = time.time() + timeout
+        while n < _state["world_size"] and time.time() < deadline:
+            time.sleep(0.01)
+            n = store.add("__rpc/shutdown_cnt", 0)
+    if _state["stop"] is not None:
+        _state["stop"].set()
+        _state["server"].join(timeout=2)
+    _state.update(store=None, rank=None, world_size=None, name=None,
+                  server=None, stop=None, workers={})

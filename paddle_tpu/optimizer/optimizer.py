@@ -235,7 +235,11 @@ class Optimizer:
             return
         for p, g in params_grads:
             g_data = g._data if isinstance(g, Tensor) else g
-            if self._use_master(p):
+            if self._use_master(p) and not getattr(p, "layer_stacked",
+                                                   False):
+                # layer-stacked params skip the whole-stack fp32 upcast:
+                # their update is layer-chunked (adam _adam_math upcasts
+                # per slice) and a [L, ...] fp32 grad temp OOMs at 1.3b
                 g_data = g_data.astype(jnp.float32)
             g_data = self._apply_decay(p, g_data)
             self._lr_scale = self._param_lr_scale(p)

@@ -1,0 +1,37 @@
+"""2-process rpc test worker (driven by test_multiprocess.py pattern)."""
+import sys
+
+
+def double(x):
+    return x * 2
+
+
+def whoami():
+    import os
+
+    return int(os.environ.get("PADDLE_TRAINER_ID", -1))
+
+
+def main(rank, world, port):
+    import os
+
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(world)
+    from paddle_tpu.distributed import rpc
+
+    info = rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world,
+                        master_endpoint=f"127.0.0.1:{port}")
+    assert info.rank == rank
+    peer = f"worker{1 - rank}"
+    assert rpc.rpc_sync(peer, double, args=(21,)) == 42
+    assert rpc.rpc_sync(peer, whoami) == 1 - rank
+    fut = rpc.rpc_async(peer, double, args=(5,))
+    assert fut.result(timeout=60) == 10
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1"]
+    rpc.shutdown()   # barriers with the peer internally
+    print(f"rpc worker {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))

@@ -102,3 +102,27 @@ class TestLauncherSpawnsBothRanks:
         for i, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"launcher rank {i} failed:\n{joined}"
             assert f"LAUNCH-OK rank={i} sum=3.0" in out, joined
+
+
+class TestRpcTwoProcess:
+    def test_rpc_sync_async_across_processes(self):
+        """paddle.distributed.rpc over the TCPStore control plane
+        (reference python/paddle/distributed/rpc/rpc.py): two real
+        processes call functions on each other."""
+        worker = os.path.join(os.path.dirname(__file__), "rpc_worker.py")
+        port = _free_port()
+        env = _clean_env()
+        procs = [
+            subprocess.Popen([sys.executable, worker, str(i), "2",
+                              str(port)],
+                             env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+            for i in range(2)
+        ]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out.decode())
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {i} failed:\n{out}"
+            assert f"rpc worker {i} OK" in out
