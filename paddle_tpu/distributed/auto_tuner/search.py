@@ -6,22 +6,26 @@ def _divisors(n):
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
-def grid_candidates(n_devices, sharding_stages, max_micro, global_batch):
+def grid_candidates(n_devices, sharding_stages, max_micro, global_batch,
+                    enable_sep=False):
     from .tuner import Candidate
 
     out = []
     for mp in _divisors(n_devices):
         for pp in _divisors(n_devices // mp):
-            dp = n_devices // (mp * pp)
-            micros = [m for m in _divisors(max(global_batch // max(dp, 1), 1))
-                      if m <= max_micro]
-            for stage in sharding_stages:
-                if stage and dp == 1:
-                    continue  # nothing to shard over
-                for micro in (micros or [1]):
-                    if pp > 1 and micro == 1:
-                        continue  # pipeline needs micro-batches
-                    out.append(Candidate(dp=dp, mp=mp, pp=pp,
-                                         sharding_stage=stage,
-                                         micro_batch=micro))
+            for sep in (_divisors(n_devices // (mp * pp))
+                        if enable_sep else [1]):
+                dp = n_devices // (mp * pp * sep)
+                micros = [m for m in
+                          _divisors(max(global_batch // max(dp, 1), 1))
+                          if m <= max_micro]
+                for stage in sharding_stages:
+                    if stage and dp == 1:
+                        continue  # nothing to shard over
+                    for micro in (micros or [1]):
+                        if pp > 1 and micro == 1:
+                            continue  # pipeline needs micro-batches
+                        out.append(Candidate(dp=dp, mp=mp, pp=pp, sep=sep,
+                                             sharding_stage=stage,
+                                             micro_batch=micro))
     return out

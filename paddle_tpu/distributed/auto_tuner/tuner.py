@@ -23,6 +23,7 @@ class Candidate:
     dp: int = 1
     mp: int = 1
     pp: int = 1
+    sep: int = 1                 # sequence/context-parallel (ring) degree
     sharding_stage: int = 0      # 0=none, 1/2=state/grad shard, 3=param
     micro_batch: int = 1
     estimated_step_ms: float = 0.0
@@ -32,11 +33,11 @@ class Candidate:
 
     @property
     def degree(self):
-        return self.dp * self.mp * self.pp
+        return self.dp * self.mp * self.pp * self.sep
 
     def hybrid_configs(self):
         return {"dp_degree": self.dp, "mp_degree": self.mp,
-                "pp_degree": self.pp,
+                "pp_degree": self.pp, "sep_degree": self.sep,
                 "sharding_degree": self.dp if self.sharding_stage else 1}
 
 
@@ -69,30 +70,53 @@ def estimate_memory_gb(spec: ModelSpec, c: Candidate) -> float:
     opt_gb = spec.params * spec.master_bytes / o_shard / 1e9
     mb = max(1, spec.global_batch // max(c.dp, 1) // max(c.micro_batch, 1))
     live_per_layer = 4 if spec.use_recompute else 34
-    act_gb = (mb * spec.seq_len * spec.hidden_size
+    # sep shards the sequence dim of every activation (ring attention
+    # keeps attention memory O(seq/sep) too — meta_parallel/ring_attention)
+    act_gb = (mb * (spec.seq_len // c.sep) * spec.hidden_size
               * (spec.num_layers // c.pp) * live_per_layer * 2 / c.mp) / 1e9
-    logits_gb = mb * spec.seq_len * spec.vocab_size * 4 / c.mp / 1e9
+    logits_gb = mb * (spec.seq_len // c.sep) * spec.vocab_size * 4 \
+        / c.mp / 1e9
     return param_gb + opt_gb + act_gb + logits_gb
 
 
 def estimate_step_ms(spec: ModelSpec, c: Candidate, *,
                      peak_flops=197e12, ici_gbps=400e9,
-                     hbm_gbps=819e9) -> float:
+                     hbm_gbps=819e9, coll_lat_us=10.0) -> float:
     """Scaling-book style step-time decomposition (coarse, for RANKING --
     absolute numbers come from measured trials)."""
     tokens = spec.global_batch * spec.seq_len
     flops = 6 * spec.params * tokens * (4 / 3 if spec.use_recompute else 1)
     compute_ms = flops / (c.degree * peak_flops) * 1e3
-    # TP: 2 allreduces of activations per layer (fwd+bwd doubles)
+    # TP: 2 allreduces of activations per layer (fwd+bwd doubles). The
+    # latency term (fixed cost per collective, r4 planner validation —
+    # without it small workloads rank comm-heavy configs FASTER) counts
+    # 4 collectives/layer regardless of size.
     if c.mp > 1:
         act_bytes = (spec.global_batch // c.dp) * spec.seq_len \
             * spec.hidden_size * 2
+        n_coll = 4 * spec.num_layers // c.pp
         tp_ms = (4 * act_bytes * (c.mp - 1) / c.mp / ici_gbps) \
-            * spec.num_layers / c.pp * 1e3
+            * spec.num_layers / c.pp * 1e3 \
+            + n_coll * coll_lat_us * 1e-3
     else:
         tp_ms = 0.0
-    # PP bubble inflates compute by (pp-1)/micro
+    # SEP/ring attention: k+v blocks rotate the full ring each layer —
+    # per tick 2 tensors of [mb, seq/sep, hidden] bf16, (sep-1) ticks,
+    # ~3x for the reverse-ring backward's extra dk/dv rotation
+    if c.sep > 1:
+        blk_bytes = (spec.global_batch // max(c.dp, 1)) \
+            * (spec.seq_len // c.sep) * spec.hidden_size * 2
+        sep_ms = (3 * 2 * blk_bytes * (c.sep - 1) / ici_gbps) \
+            * spec.num_layers / c.pp * 1e3 \
+            + 3 * (c.sep - 1) * spec.num_layers // c.pp \
+            * coll_lat_us * 1e-3
+    else:
+        sep_ms = 0.0
+    # PP bubble inflates compute by (pp-1)/micro; each ring tick also
+    # pays a ppermute latency
     bubble = (c.pp - 1) / max(c.micro_batch, 1)
+    pp_lat_ms = ((c.pp + max(c.micro_batch, 1) - 1) * coll_lat_us * 1e-3
+                 if c.pp > 1 else 0.0)
     # DP/ZeRO grad sync: each replica allreduces only ITS param shard
     # (params / (mp*pp)) around the dp ring
     if c.dp > 1:
@@ -104,7 +128,8 @@ def estimate_step_ms(spec: ModelSpec, c: Candidate, *,
     # HBM floor: optimizer sweep
     hbm_ms = spec.params * spec.master_bytes / (
         c.mp * c.pp * (c.dp if c.sharding_stage >= 1 else 1)) / hbm_gbps * 1e3
-    return compute_ms * (1 + bubble) + tp_ms + dp_ms + hbm_ms
+    return (compute_ms * (1 + bubble) + tp_ms + sep_ms + dp_ms
+            + pp_lat_ms + hbm_ms)
 
 
 class AutoTuner:
@@ -121,18 +146,21 @@ class AutoTuner:
 
     def __init__(self, spec: ModelSpec, n_devices: int, hbm_gb: float = 16.0,
                  runner: Optional[Callable] = None,
-                 sharding_stages=(0, 1, 3), max_micro=64):
+                 sharding_stages=(0, 1, 3), max_micro=64,
+                 enable_sep=False):
         self.spec = spec
         self.n_devices = n_devices
         self.hbm_gb = hbm_gb
         self.runner = runner
         self.sharding_stages = sharding_stages
         self.max_micro = max_micro
+        self.enable_sep = enable_sep
         self.history: list[Candidate] = []
 
     def candidates(self) -> list[Candidate]:
         cands = grid_candidates(self.n_devices, self.sharding_stages,
-                                self.max_micro, self.spec.global_batch)
+                                self.max_micro, self.spec.global_batch,
+                                enable_sep=self.enable_sep)
         cands = prune_candidates(cands, self.spec, self.hbm_gb)
         for c in cands:
             if c.pruned_reason is None:

@@ -30,16 +30,17 @@ class WorkerInfo:
 
 _state = {
     "store": None, "rank": None, "world_size": None, "name": None,
-    "server": None, "stop": None, "workers": {},
+    "server": None, "stop": None, "workers": {}, "epoch": 0,
+    "owns_store": False,
 }
 
 
 def _req_key(dst, seq):
-    return f"__rpc/{dst}/req/{seq}"
+    return f"__rpc/{_state['epoch']}/{dst}/req/{seq}"
 
 
 def _ret_key(dst, seq):
-    return f"__rpc/{dst}/ret/{seq}"
+    return f"__rpc/{_state['epoch']}/{dst}/ret/{seq}"
 
 
 def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
@@ -55,25 +56,44 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         else int(rank)
     world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
         if world_size is None else int(world_size)
-    ep = master_endpoint or os.environ.get("PADDLE_MASTER", None)
-    if ep is None:
+    ep = master_endpoint
+    owns = False
+    if ep is None and (os.environ.get("MASTER_ADDR")
+                       or os.environ.get("PADDLE_MASTER")):
+        # share the job's rendezvous store (a second master on the same
+        # endpoint would fail to bind) — reference parallel.py:1134
+        from .store import create_or_get_global_tcp_store
+
+        store = create_or_get_global_tcp_store()
+    elif ep is None:
         if world_size > 1:
             raise ValueError(
                 "multi-worker rpc needs master_endpoint (host:port)")
         # single worker: self-hosted ephemeral store
         store = TCPStore("127.0.0.1", _free_port(), is_master=True,
                          world_size=1)
+        owns = True
     else:
         host, port = ep.rsplit(":", 1)
         store = TCPStore(host, int(port), is_master=(rank == 0),
                          world_size=world_size)
-    _state.update(store=store, rank=rank, world_size=world_size, name=name)
-    store.set(f"__rpc/worker/{rank}", name.encode())
+        owns = (rank == 0)
+    # epoch isolates this init's mailboxes from a previous init/shutdown
+    # cycle against the same (possibly external) store
+    if rank == 0:
+        epoch = store.add("__rpc/epoch", 1)
+        store.set("__rpc/epoch_now", str(epoch).encode())
+    else:
+        store.wait(["__rpc/epoch_now"])
+        epoch = int(store.get("__rpc/epoch_now").decode())
+    _state.update(store=store, rank=rank, world_size=world_size,
+                  name=name, epoch=epoch, owns_store=owns)
+    store.set(f"__rpc/{epoch}/worker/{rank}", name.encode())
     # learn peers (blocks until everyone registered)
     workers = {}
     for r in range(world_size):
-        store.wait([f"__rpc/worker/{r}"])
-        peer = store.get(f"__rpc/worker/{r}").decode()
+        store.wait([f"__rpc/{epoch}/worker/{r}"])
+        peer = store.get(f"__rpc/{epoch}/worker/{r}").decode()
         if peer in workers:
             raise ValueError(
                 f"duplicate rpc worker name {peer!r} (ranks "
@@ -102,11 +122,18 @@ def _serve_loop(store, rank, stop):
     served = 0
     while not stop.is_set():
         key = _req_key(rank, served)
-        blob = store._get_once(key)
+        try:
+            blob = store._get_once(key)
+        except ConnectionError:
+            # master tearing down during shutdown: just wind down
+            time.sleep(0.05)
+            continue
         if blob is None:
             time.sleep(0.005)
             continue
         served += 1
+        if blob == b"\x00":
+            continue              # tombstoned (already consumed)
         src = seq = None
         try:
             src, seq, fn, args, kwargs = pickle.loads(blob)
@@ -124,14 +151,13 @@ def _serve_loop(store, rank, stop):
 
 
 def _try_delete(store, key):
-    for meth in ("delete", "delete_key", "_delete"):
-        f = getattr(store, meth, None)
-        if f is not None:
-            try:
-                f(key)
-            except Exception:
-                pass
-            return
+    """The store protocol has no delete; overwrite the consumed blob with
+    a 1-byte tombstone so per-call growth is bounded by key size, not
+    payload size (full deletion would need a store-protocol extension)."""
+    try:
+        store.set(key, b"\x00")
+    except Exception:
+        pass
 
 
 def _resolve_rank(to):
@@ -159,7 +185,7 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=120):
     # unpicklable args) would head-of-line-block the destination forever
     probe = pickle.dumps((rank, "probe", fn, tuple(args or ()), kwargs))
     del probe
-    seq = store.add(f"__rpc/{dst}/cnt", 1) - 1      # claim a slot
+    seq = store.add(f"__rpc/{_state['epoch']}/{dst}/cnt", 1) - 1
     token = f"{rank}:{seq}"
     store.set(_req_key(dst, seq),
               pickle.dumps((rank, token, fn, tuple(args or ()), kwargs)))
@@ -207,14 +233,27 @@ def shutdown(graceful=True, timeout=60):
     """Reference rpc.py shutdown: barrier with every peer (so no request
     is in flight when serving stops), then stop the server thread."""
     store = _state["store"]
-    if graceful and store is not None and _state["world_size"] > 1:
-        n = store.add("__rpc/shutdown_cnt", 1)
+    ep = _state["epoch"]
+    world = _state["world_size"] or 1
+    if graceful and store is not None and world > 1:
         deadline = time.time() + timeout
-        while n < _state["world_size"] and time.time() < deadline:
+        n = store.add(f"__rpc/{ep}/shutdown_cnt", 1)
+        while n < world and time.time() < deadline:
             time.sleep(0.01)
-            n = store.add("__rpc/shutdown_cnt", 0)
+            n = store.add(f"__rpc/{ep}/shutdown_cnt", 0)
+        # ack phase: the store OWNER must not tear the master down while
+        # a peer is still polling its way out of the barrier
+        store.add(f"__rpc/{ep}/shutdown_ack", 1)
+        if _state["owns_store"]:
+            a = store.add(f"__rpc/{ep}/shutdown_ack", 0)
+            while a < world and time.time() < deadline:
+                time.sleep(0.01)
+                a = store.add(f"__rpc/{ep}/shutdown_ack", 0)
     if _state["stop"] is not None:
         _state["stop"].set()
         _state["server"].join(timeout=2)
+    if _state["owns_store"] and store is not None:
+        store.shutdown()          # free the master port for a re-init
     _state.update(store=None, rank=None, world_size=None, name=None,
-                  server=None, stop=None, workers={})
+                  server=None, stop=None, workers={}, epoch=0,
+                  owns_store=False)

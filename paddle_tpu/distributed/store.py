@@ -283,22 +283,34 @@ class TCPStore:
             # reused per-instance buffer: get() and the watcher poll this
             # in tight loops, so per-call 64MB allocations would churn;
             # grow only when a value overflows (tcp_store_get returns the
-            # full length even when truncating)
-            buf = getattr(self, "_get_buf", None)
-            if buf is None:
-                buf = self._get_buf = ctypes.create_string_buffer(1 << 16)
+            # full length even when truncating). The buffer is shared, so
+            # concurrent pollers (rpc server + waiter threads) serialize
+            # on a lock — ctypes calls drop the GIL, and an interleaved
+            # overwrite would hand one thread another's payload.
+            lock = getattr(self, "_get_lock", None)
+            if lock is None:
+                import threading as _threading
+
+                lock = self._get_lock = _threading.Lock()
+            with lock:
+                return self._get_once_locked(key)
+        return self._py_client.get_once(key)
+
+    def _get_once_locked(self, key: str):
+        buf = getattr(self, "_get_buf", None)
+        if buf is None:
+            buf = self._get_buf = ctypes.create_string_buffer(1 << 16)
+        n = _lib.tcp_store_get(self._resolved.encode(), self.port,
+                               key.encode(), buf, len(buf),
+                               int(self.timeout * 1000))
+        if n > len(buf):
+            buf = self._get_buf = ctypes.create_string_buffer(int(n))
             n = _lib.tcp_store_get(self._resolved.encode(), self.port,
                                    key.encode(), buf, len(buf),
                                    int(self.timeout * 1000))
-            if n > len(buf):
-                buf = self._get_buf = ctypes.create_string_buffer(int(n))
-                n = _lib.tcp_store_get(self._resolved.encode(), self.port,
-                                       key.encode(), buf, len(buf),
-                                       int(self.timeout * 1000))
-            if n == -2:
-                raise ConnectionError(f"store get({key!r}) connect failed")
-            return None if n < 0 else buf.raw[:n]
-        return self._py_client.get_once(key)
+        if n == -2:
+            raise ConnectionError(f"store get({key!r}) connect failed")
+        return None if n < 0 else buf.raw[:n]
 
     def get(self, key: str) -> bytes:
         """Blocks (client-side retry) until the key exists or timeout."""

@@ -285,3 +285,36 @@ class TestHeteroPipeline:
         for a, b in zip(flat_p, flat_s):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
+
+
+class TestZeroBubblePipeline:
+    """dW-deferred hand-written ring VJP (docs/pipeline_schedules.md r4):
+    exact gradient parity with the AD-derived pipeline."""
+
+    def test_matches_ad_pipeline(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline \
+            import zb_linear_pipeline, pipeline_spmd
+
+        mesh = Mesh(np.asarray(jax.devices("cpu")[:4]), ("pp",))
+        rng = np.random.default_rng(0)
+        S, M, B, D = 4, 4, 8, 32
+        w = jnp.asarray(rng.standard_normal((S, D, D)) * 0.3, jnp.float32)
+        x = jnp.asarray(rng.standard_normal((M, B, D)), jnp.float32)
+
+        def block(wl, xb):
+            return jnp.tanh(xb @ wl)
+
+        np.testing.assert_allclose(
+            np.asarray(zb_linear_pipeline(w, x, mesh=mesh)),
+            np.asarray(pipeline_spmd(block, w, x, mesh=mesh)), atol=1e-5)
+
+        g_ref = jax.grad(lambda w, x: jnp.sum(jnp.sin(
+            pipeline_spmd(block, w, x, mesh=mesh))), (0, 1))(w, x)
+        g_zb = jax.grad(lambda w, x: jnp.sum(jnp.sin(
+            zb_linear_pipeline(w, x, mesh=mesh))), (0, 1))(w, x)
+        for a, b in zip(g_zb, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
