@@ -263,3 +263,7 @@ def apply_sharding_rules(layer, rules, mesh=None):
         p._data = jax.device_put(
             p._data, NamedSharding(mesh, P(*axes) if axes else P()))
     return layer
+
+from .spmd_rules import (  # noqa: E402,F401
+    auto_shard_layer, plan_layer_specs, register_layer_rule, LAYER_RULES,
+)

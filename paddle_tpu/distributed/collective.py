@@ -179,6 +179,11 @@ def _run(group, data, traced_fn, out_spec=None, cache_key=None):
         full_key = (cache_key, mesh, axes, in_spec, o_spec)
         fn = _eager_fn_cache.get(full_key)
         if fn is None:
+            # evict entries for OTHER meshes: a replaced mesh (elastic
+            # re-rendezvous, tests) must not pin dead devices/executables
+            for k in list(_eager_fn_cache):
+                if k[1] is not mesh:
+                    del _eager_fn_cache[k]
             fn = jax.jit(shard_map(traced_fn, mesh=mesh,
                                    in_specs=(in_spec,),
                                    out_specs=o_spec, check_vma=False))
