@@ -280,7 +280,8 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     def f(v):
         from ...framework.tensor import Tensor
 
-        powed = jnp.power(jnp.abs(v.astype(jnp.float32)), p)
+        ct = jnp.promote_types(v.dtype, jnp.float32)
+        powed = jnp.power(jnp.abs(v.astype(ct)), p)
         pooled = avg_pool2d(Tensor._wrap(powed), kernel_size, stride,
                             padding, ceil_mode=ceil_mode,
                             exclusive=False)._data

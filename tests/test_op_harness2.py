@@ -1,0 +1,295 @@
+"""OpTest harness, batch 2 (VERDICT r3 weak #8: widen the registered op
+set) — numpy-referenced forward + finite-difference grad sweeps for
+reductions, manipulation, pooling, activations and the round-4 ops.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpTest
+
+
+class TestLogSumExp(OpTest):
+    def op(self, x):
+        return paddle.logsumexp(x, axis=-1)
+
+    def ref(self, x):
+        m = x.max(-1, keepdims=True)
+        return (m + np.log(np.exp(x - m).sum(-1, keepdims=True)))[..., 0]
+
+    def inputs(self, rng):
+        return [rng.standard_normal((4, 16)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestCumsumCumprod(OpTest):
+    def op(self, x):
+        return paddle.cumsum(x, axis=1)
+
+    def ref(self, x):
+        return np.cumsum(x, axis=1)
+
+    def inputs(self, rng):
+        return [rng.standard_normal((3, 8)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestTakeAlongAxis(OpTest):
+    def op(self, x):
+        idx = paddle.to_tensor(self._idx)
+        return paddle.take_along_axis(x, idx, axis=1)
+
+    def ref(self, x):
+        return np.take_along_axis(x, self._idx, axis=1)
+
+    def inputs(self, rng):
+        self._idx = rng.integers(0, 8, (4, 3)).astype("int64")
+        return [rng.standard_normal((4, 8)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestTrilTriu(OpTest):
+    def op(self, x):
+        return paddle.tril(x, diagonal=1)
+
+    def ref(self, x):
+        return np.tril(x, k=1)
+
+    def inputs(self, rng):
+        return [rng.standard_normal((6, 6)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestErf(OpTest):
+    def op(self, x):
+        return paddle.erf(x)
+
+    def ref(self, x):
+        from scipy.special import erf as _erf
+
+        return _erf(x)
+
+    def inputs(self, rng):
+        return [rng.standard_normal((4, 8)).astype("float32")]
+
+    def test(self):
+        try:
+            import scipy  # noqa: F401
+        except ImportError:
+            pytest.skip("no scipy")
+        self.check_output()
+        self.check_grad()
+
+
+class TestPad(OpTest):
+    def op(self, x):
+        return F.pad(x, [1, 2], value=0.5)
+
+    def ref(self, x):
+        return np.pad(x, [(0, 0), (1, 2)], constant_values=0.5)
+
+    def inputs(self, rng):
+        return [rng.standard_normal((3, 5)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestAvgPool2D(OpTest):
+    def op(self, x):
+        return F.avg_pool2d(x, 2)
+
+    def ref(self, x):
+        n, c, h, w = x.shape
+        return x.reshape(n, c, h // 2, 2, w // 2, 2).mean((3, 5))
+
+    def inputs(self, rng):
+        return [rng.standard_normal((2, 3, 8, 8)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestLpPool2D(OpTest):
+    def op(self, x):
+        return F.lp_pool2d(x, 2, 2)
+
+    def ref(self, x):
+        n, c, h, w = x.shape
+        sq = (x ** 2).reshape(n, c, h // 2, 2, w // 2, 2).sum((3, 5))
+        return np.sqrt(sq)
+
+    def inputs(self, rng):
+        return [np.abs(rng.standard_normal((2, 3, 8, 8)))
+                .astype("float32") + 0.1]
+
+    def test(self):
+        self.check_output()
+        # the harness FD runs through to_tensor (float32), and sqrt-of-
+        # sum-of-squares curvature makes f32 FD noise exceed tolerance;
+        # check the gradient directly in float64 against fine central
+        # differences instead (exact to ~1e-9)
+        import jax
+        import jax.numpy as jnp
+
+        x = (np.abs(np.random.default_rng(3)
+                    .standard_normal((1, 2, 4, 4))) + 0.1)
+
+        def f(xv):
+            return jnp.sum(F.lp_pool2d(
+                paddle.Tensor._wrap(xv), 2, 2)._data)
+
+        g = jax.grad(f)(jnp.asarray(x))
+        eps = 1e-6
+        for i in [(0, 0, 1, 2), (0, 1, 3, 3), (0, 0, 0, 0)]:
+            xp = x.copy(); xp[i] += eps          # noqa: E702
+            xm = x.copy(); xm[i] -= eps          # noqa: E702
+            fd = (float(f(jnp.asarray(xp))) - float(f(jnp.asarray(xm)))) \
+                / (2 * eps)
+            np.testing.assert_allclose(float(g[i]), fd, rtol=1e-4)
+
+
+class TestSwiglu(OpTest):
+    def op(self, x):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        return IF.swiglu(x)
+
+    def ref(self, x):
+        a, b = np.split(x, 2, axis=-1)
+        return (a / (1 + np.exp(-a))) * b
+
+    def inputs(self, rng):
+        return [rng.standard_normal((4, 16)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestLogLoss(OpTest):
+    def op(self, p, y):
+        return F.log_loss(p, y)
+
+    def ref(self, p, y):
+        eps = 1e-4
+        return -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+
+    def inputs(self, rng):
+        return [rng.uniform(0.05, 0.95, (6, 1)).astype("float32"),
+                rng.integers(0, 2, (6, 1)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(wrt=(0,))
+
+
+class TestSequenceMask(OpTest):
+    dtypes = ("float32",)          # int op, no grad
+
+    def op(self, x):
+        lengths = paddle.to_tensor(self._len)
+        return F.sequence_mask(lengths, maxlen=6,
+                               dtype="float32") * 0 + \
+            F.sequence_mask(lengths, maxlen=6, dtype="float32") * x[0, 0]
+
+    def ref(self, x):
+        m = (np.arange(6)[None, :] < self._len[:, None]).astype("float32")
+        return m * x[0, 0]
+
+    def inputs(self, rng):
+        self._len = rng.integers(0, 7, (4,)).astype("int64")
+        return [np.ones((1, 1), np.float32)]
+
+    def test(self):
+        self.check_output()
+
+
+class TestTemporalShift(OpTest):
+    def op(self, x):
+        return F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+
+    def ref(self, x):
+        nt, c, h, w = x.shape
+        n = nt // 2
+        v = x.reshape(n, 2, c, h, w)
+        c1 = c // 4
+        c2 = c // 2
+        out = np.zeros_like(v)
+        out[:, 1:, :c1] = v[:, :-1, :c1]
+        out[:, :-1, c1:c2] = v[:, 1:, c1:c2]
+        out[:, :, c2:] = v[:, :, c2:]
+        return out.reshape(nt, c, h, w)
+
+    def inputs(self, rng):
+        return [rng.standard_normal((4, 8, 3, 3)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestKron(OpTest):
+    def op(self, x, y):
+        return paddle.kron(x, y)
+
+    def ref(self, x, y):
+        return np.kron(x, y)
+
+    def inputs(self, rng):
+        return [rng.standard_normal((2, 3)).astype("float32"),
+                rng.standard_normal((3, 2)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(wrt=(0,))
+
+
+class TestDiagEmbed(OpTest):
+    def op(self, x):
+        return paddle.diag_embed(x)
+
+    def ref(self, x):
+        out = np.zeros(x.shape + (x.shape[-1],), x.dtype)
+        i = np.arange(x.shape[-1])
+        out[..., i, i] = x
+        return out
+
+    def inputs(self, rng):
+        return [rng.standard_normal((3, 5)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestSoftplusSilu(OpTest):
+    def op(self, x):
+        return F.silu(F.softplus(x))
+
+    def ref(self, x):
+        sp = np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+        return sp / (1 + np.exp(-sp))
+
+    def inputs(self, rng):
+        return [rng.standard_normal((4, 8)).astype("float32")]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
