@@ -17,3 +17,9 @@ from .flash_attention import (  # noqa: F401
     flash_attention,
     flash_attn_unpadded,
 )
+# long-tail losses/pools/utilities (rnnt_loss with FastEmit, dice/soft-
+# margin/poisson-nll/gaussian-nll/npair losses, fractional max pools,
+# adaptive_log_softmax_with_loss, gather_tree, packed flash variants).
+# NOTE r4: this module existed since r3 but was never imported — the op
+# audit caught the hole.
+from .extras import *  # noqa: E402,F401,F403

@@ -46,19 +46,6 @@ def _mk_inplace(fn):
     return inplace
 
 
-def sequence_mask(x, maxlen=None, dtype="int64", name=None):
-    """lengths [..., n] -> bool/int mask [..., n, maxlen] (reference
-    nn/functional/extension.py sequence_mask)."""
-    from ...framework.dtype import to_jax_dtype
-
-    x = ensure_tensor(x)
-    if maxlen is None:
-        maxlen = int(np.asarray(x._data).max())
-    dt = to_jax_dtype(dtype)
-    return unary(lambda v: (jnp.arange(maxlen) < v[..., None]).astype(dt),
-                 x, "sequence_mask")
-
-
 def feature_alpha_dropout(x, p=0.5, training=True, name=None):
     """Alpha dropout over whole channels (dim 1), SELU-preserving
     statistics (reference common.py feature_alpha_dropout)."""
@@ -95,100 +82,6 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
 # ---------------------------------------------------------------------------
 # pooling: LP / unpool / fractional
 # ---------------------------------------------------------------------------
-
-def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
-              ceil_mode=False, data_format="NCL", name=None):
-    from .pooling import avg_pool1d
-
-    p = float(norm_type)
-    xp = unary(lambda v: jnp.power(jnp.abs(v), p), x, "lp_pow")
-    pooled = avg_pool1d(xp, kernel_size, stride=stride, padding=padding,
-                        ceil_mode=ceil_mode, exclusive=False)
-    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
-    return unary(lambda v: jnp.power(v * k, 1.0 / p), pooled, "lp_root")
-
-
-def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
-              ceil_mode=False, data_format="NCHW", name=None):
-    from .pooling import avg_pool2d
-
-    p = float(norm_type)
-    xp = unary(lambda v: jnp.power(jnp.abs(v), p), x, "lp_pow")
-    pooled = avg_pool2d(xp, kernel_size, stride=stride, padding=padding,
-                        ceil_mode=ceil_mode, exclusive=False)
-    if isinstance(kernel_size, int):
-        kk = kernel_size * kernel_size
-    else:
-        kk = kernel_size[0] * kernel_size[1]
-    return unary(lambda v: jnp.power(v * kk, 1.0 / p), pooled, "lp_root")
-
-
-def _max_unpool(x, indices, spatial_out, name):
-    """Scatter pooled values back to `spatial_out` positions (indices are
-    flat positions within each channel's input spatial block — the layout
-    max_pool(return_mask=True) produces)."""
-    def f(v, idx):
-        lead = v.shape[:2]
-        flat_n = int(np.prod(spatial_out))
-        vf = v.reshape(lead + (-1,))
-        i = idx.reshape(lead + (-1,)).astype(jnp.int32)
-        out = jnp.zeros(lead + (flat_n,), v.dtype)
-        out = jax.vmap(jax.vmap(lambda o, ii, vv: o.at[ii].set(vv)))(
-            out, i, vf)
-        return out.reshape(lead + tuple(spatial_out))
-
-    return binary(f, ensure_tensor(x), ensure_tensor(indices), name)
-
-
-def _unpool_out_size(in_size, kernel, stride, padding):
-    stride = stride or kernel
-    return (in_size - 1) * stride - 2 * padding + kernel
-
-
-def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
-                 data_format="NCL", output_size=None, name=None):
-    x = ensure_tensor(x)
-    if output_size is not None:
-        out_l = (output_size[-1] if len(output_size) > 1
-                 else output_size[0])
-    else:
-        out_l = _unpool_out_size(x.shape[-1], kernel_size,
-                                 stride or kernel_size, padding)
-    return _max_unpool(x, indices, (out_l,), "max_unpool1d")
-
-
-def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
-                 data_format="NCHW", output_size=None, name=None):
-    x = ensure_tensor(x)
-    ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
-          else tuple(kernel_size))
-    st = (ks if stride is None else
-          ((stride, stride) if isinstance(stride, int) else tuple(stride)))
-    pd = ((padding, padding) if isinstance(padding, int)
-          else tuple(padding))
-    if output_size is not None:
-        hw = tuple(output_size[-2:])
-    else:
-        hw = (_unpool_out_size(x.shape[-2], ks[0], st[0], pd[0]),
-              _unpool_out_size(x.shape[-1], ks[1], st[1], pd[1]))
-    return _max_unpool(x, indices, hw, "max_unpool2d")
-
-
-def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
-                 data_format="NCDHW", output_size=None, name=None):
-    x = ensure_tensor(x)
-    ks = ((kernel_size,) * 3 if isinstance(kernel_size, int)
-          else tuple(kernel_size))
-    st = (ks if stride is None else
-          ((stride,) * 3 if isinstance(stride, int) else tuple(stride)))
-    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
-    if output_size is not None:
-        dhw = tuple(output_size[-3:])
-    else:
-        dhw = tuple(_unpool_out_size(x.shape[-3 + i], ks[i], st[i], pd[i])
-                    for i in range(3))
-    return _max_unpool(x, indices, dhw, "max_unpool3d")
-
 
 def _fractional_starts(in_size, out_size, u):
     alpha = in_size / out_size
@@ -302,13 +195,6 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
     return binary(f, ensure_tensor(input), ensure_tensor(label), "dice_loss")
 
 
-def log_loss(input, label, epsilon=1e-4, name=None):
-    return binary(
-        lambda x, y: -(y * jnp.log(x + epsilon)
-                       + (1 - y) * jnp.log(1 - x + epsilon)),
-        ensure_tensor(input), ensure_tensor(label), "log_loss")
-
-
 def soft_margin_loss(input, label, reduction="mean", name=None):
     return binary(lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)),
                                        reduction),
@@ -412,94 +298,6 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
 
     return nary(f, [ensure_tensor(anchor), ensure_tensor(positive),
                     ensure_tensor(labels)], "npair_loss")
-
-
-def hsigmoid_loss(input, label, num_classes, weight, bias=None,
-                  path_table=None, path_code=None, is_sparse=False,
-                  name=None):
-    """Hierarchical sigmoid over the default complete binary tree
-    (reference loss.py hsigmoid_loss / MatrixBitCodeFunctor): leaf id =
-    label + num_classes; ancestors leaf>>1.. down to 1 are the internal
-    nodes; each step is a binary logistic decision."""
-    if path_table is not None or path_code is not None:
-        raise NotImplementedError("custom-tree hsigmoid")
-    depth = int(math.ceil(math.log2(num_classes))) + 1
-
-    def f(x, y, w, *maybe_b):
-        y = y.astype(jnp.int32).reshape(-1)
-        leaf = y + num_classes
-        losses = jnp.zeros(y.shape, jnp.float32)
-        node = leaf
-        for _ in range(depth):
-            bit = (node & 1).astype(jnp.float32)
-            parent = node >> 1
-            active = parent >= 1
-            nid = jnp.clip(parent - 1, 0, num_classes - 2)
-            z = jnp.einsum("nf,nf->n", x, w[nid])
-            if maybe_b:
-                z = z + maybe_b[0].reshape(-1)[nid]
-            # BCE with target = bit
-            step_loss = jax.nn.softplus(z) - bit * z
-            losses = losses + jnp.where(active, step_loss, 0.0)
-            node = parent
-        return losses[:, None]
-
-    inputs = [ensure_tensor(input), ensure_tensor(label),
-              ensure_tensor(weight)]
-    if bias is not None:
-        inputs.append(ensure_tensor(bias))
-    return nary(f, inputs, "hsigmoid_loss")
-
-
-def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
-                         margin3=0.0, scale=64.0, group=None,
-                         return_softmax=False, reduction="mean", name=None):
-    """Combined-margin softmax CE (reference loss.py margin_cross_entropy:
-    ArcFace/CosFace family — cos(m1·θ + m2) − m3 on the target logit)."""
-    def f(x, y):
-        y = y.astype(jnp.int32).reshape(-1)
-        xt = jnp.take_along_axis(x, y[:, None], 1)[:, 0]
-        theta = jnp.arccos(jnp.clip(xt, -1 + 1e-7, 1 - 1e-7))
-        xt_m = jnp.cos(margin1 * theta + margin2) - margin3
-        mod = x.at[jnp.arange(x.shape[0]), y].set(xt_m) * scale
-        logp = jax.nn.log_softmax(mod, -1)
-        loss = -jnp.take_along_axis(logp, y[:, None], 1)
-        return _reduce(loss, reduction)
-
-    out = binary(f, ensure_tensor(logits), ensure_tensor(label),
-                 "margin_cross_entropy")
-    if return_softmax:
-        def fs(x, y):
-            y = y.astype(jnp.int32).reshape(-1)
-            xt = jnp.take_along_axis(x, y[:, None], 1)[:, 0]
-            theta = jnp.arccos(jnp.clip(xt, -1 + 1e-7, 1 - 1e-7))
-            xt_m = jnp.cos(margin1 * theta + margin2) - margin3
-            mod = x.at[jnp.arange(x.shape[0]), y].set(xt_m) * scale
-            return jax.nn.softmax(mod, -1)
-
-        sm = binary(fs, ensure_tensor(logits), ensure_tensor(label),
-                    "margin_softmax")
-        return out, sm
-    return out
-
-
-def class_center_sample(label, num_classes, num_samples, group=None):
-    """Sample negative class centers (reference loss.py
-    class_center_sample): keep all positive classes, pad with sampled
-    negatives up to num_samples; returns (remapped_label, sampled_ids).
-    Single-controller implementation (data-dependent size, eager-only)."""
-    lbl = np.asarray(ensure_tensor(label)._data).reshape(-1)
-    pos = np.unique(lbl)
-    n_extra = max(0, num_samples - pos.size)
-    neg_pool = np.setdiff1d(np.arange(num_classes), pos)
-    rng = _host_rng()
-    extra = rng.choice(neg_pool, size=min(n_extra, neg_pool.size),
-                       replace=False)
-    sampled = np.concatenate([pos, extra])
-    remap = {c: i for i, c in enumerate(sampled)}
-    new_lbl = np.asarray([remap[c] for c in lbl], np.int64)
-    return (Tensor._wrap(jnp.asarray(new_lbl)),
-            Tensor._wrap(jnp.asarray(sampled.astype(np.int64))))
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
@@ -676,34 +474,6 @@ def gather_tree(ids, parents, name=None):
     out.stop_gradient = True
     return out
 
-
-def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
-                   name=None):
-    """TSM temporal channel shift (reference extension.py temporal_shift)."""
-    def f(v):
-        if data_format == "NHWC":
-            v = jnp.transpose(v, (0, 3, 1, 2))
-        nt, c, h, w = v.shape
-        n = nt // seg_num
-        v5 = v.reshape(n, seg_num, c, h, w)
-        fold = int(c * shift_ratio)
-        left = jnp.concatenate(
-            [v5[:, 1:, :fold], jnp.zeros_like(v5[:, :1, :fold])], 1)
-        right = jnp.concatenate(
-            [jnp.zeros_like(v5[:, :1, fold:2 * fold]),
-             v5[:, :-1, fold:2 * fold]], 1)
-        rest = v5[:, :, 2 * fold:]
-        out = jnp.concatenate([left, right, rest], 2).reshape(nt, c, h, w)
-        if data_format == "NHWC":
-            out = jnp.transpose(out, (0, 2, 3, 1))
-        return out
-
-    return unary(f, x, "temporal_shift")
-
-
-# ---------------------------------------------------------------------------
-# flash-attention wrappers
-# ---------------------------------------------------------------------------
 
 def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
                          return_softmax=False, training=True, name=None,

@@ -648,3 +648,5 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     remap[sampled] = np.arange(len(sampled))
     return (Tensor._wrap(jnp.asarray(remap[y])),
             Tensor._wrap(jnp.asarray(sampled)))
+
+

@@ -184,3 +184,66 @@ class TestTopLevelAPI:
         draws = paddle.poisson(
             paddle.to_tensor(np.full((1000,), 5.0, np.float32)))
         assert 4.0 < float(draws.mean()) < 6.0
+
+
+class TestRnntLoss:
+    """RNN-Transducer loss (reference warprnnt_kernel.h) vs a direct
+    numpy log-semiring DP."""
+
+    def _np_rnnt(self, logits, labels, T, U, blank=0):
+        from scipy.special import log_softmax
+
+        lp = log_softmax(logits, axis=-1)
+        B = logits.shape[0]
+        out = np.zeros(B)
+        for b in range(B):
+            t_len, u_len = T[b], U[b]
+            alpha = np.full((t_len, u_len + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for t in range(t_len):
+                for u in range(u_len + 1):
+                    if t == 0 and u == 0:
+                        continue
+                    cands = []
+                    if t > 0:
+                        cands.append(alpha[t - 1, u]
+                                     + lp[b, t - 1, u, blank])
+                    if u > 0:
+                        cands.append(alpha[t, u - 1]
+                                     + lp[b, t, u - 1, labels[b, u - 1]])
+                    m = max(cands)
+                    alpha[t, u] = m + np.log(
+                        sum(np.exp(c - m) for c in cands))
+            out[b] = -(alpha[t_len - 1, u_len]
+                       + lp[b, t_len - 1, u_len, blank])
+        return out
+
+    def test_matches_numpy_dp_and_grads(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(0)
+        B, T, U, V = 2, 5, 3, 6
+        logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+        labels = rng.integers(1, V, (B, U)).astype(np.int64)
+        tl = np.array([5, 4])
+        ul = np.array([3, 2])
+        want = self._np_rnnt(logits, labels, tl, ul)
+        got = F.rnnt_loss(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          paddle.to_tensor(tl), paddle.to_tensor(ul),
+                          fastemit_lambda=0.0, reduction="none")
+        np.testing.assert_allclose(np.asarray(got._data), want, rtol=1e-4)
+        # FastEmit regularization must actually change the objective
+        fe = F.rnnt_loss(paddle.to_tensor(logits),
+                         paddle.to_tensor(labels),
+                         paddle.to_tensor(tl), paddle.to_tensor(ul),
+                         fastemit_lambda=0.5, reduction="none")
+        assert not np.allclose(np.asarray(fe._data), want)
+
+        lg = paddle.to_tensor(logits)
+        lg.stop_gradient = False
+        loss = F.rnnt_loss(lg, paddle.to_tensor(labels),
+                           paddle.to_tensor(tl), paddle.to_tensor(ul))
+        loss.backward()
+        g = np.asarray(lg.grad._data)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0

@@ -42,7 +42,8 @@ ALIASES = {
     "tensor_unfold": "nn.functional.unfold",
     "view": "reshape", "view_shape": "reshape",
     "view_dtype": "Tensor.astype",
-    "strided_copy": "as_strided", "warprnnt": None,
+    "strided_copy": "as_strided",
+    "warprnnt": "nn.functional.rnnt_loss",
     "transfer_layout": None,
     "mask": "sparse.mask_as", "sparse_utils": "sparse.coalesce",
     "sparse/elementwise": "sparse.add",
