@@ -19,8 +19,10 @@ import jax
 from ..framework.tensor import Tensor
 from . import dy2static
 from .train_step import TrainStep, _tree_data, _tree_wrap
+from .fused_scan_step import FusedScanTrainStep
 
-__all__ = ["to_static", "TrainStep", "not_to_static", "ignore_module", "save", "load"]
+__all__ = ["to_static", "TrainStep", "FusedScanTrainStep", "not_to_static",
+           "ignore_module", "save", "load"]
 
 
 class StaticFunction:

@@ -1,0 +1,138 @@
+"""FusedScanTrainStep parity: the in-scan-optimizer reverse scan must
+produce the same training trajectory as the generic TrainStep over the
+same scan_layers model (tight, fp32) and over the unrolled model (loose,
+bf16 reorder tolerance). This is the memory-bounded path that makes the
+gpt3-1.3b north star fit one 16G chip (jit/fused_scan_step.py docstring;
+docs/DECISIONS.md §7)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as popt
+from paddle_tpu.jit import FusedScanTrainStep, TrainStep
+from paddle_tpu.models import (
+    GPTForCausalLM, GPTPretrainingCriterion, GPTConfig,
+)
+
+TINY = dict(vocab_size=96, hidden_size=32, num_layers=3,
+            num_attention_heads=2, max_position_embeddings=16,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+def _batch(bs=4, seq=16, vocab=96, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = paddle.to_tensor(rng.integers(0, vocab, (bs, seq)), dtype="int64")
+    labels = paddle.to_tensor(rng.integers(0, vocab, (bs, seq)),
+                              dtype="int64")
+    return ids, labels
+
+
+def _run(step_cls, scan_layers, steps=4, bf16=False, tie=True,
+         opt_kw=None, **cfg_over):
+    cfg = GPTConfig(**{**TINY, **cfg_over}, scan_layers=scan_layers,
+                    tie_word_embeddings=tie)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if bf16:
+        model.bfloat16()
+    crit = GPTPretrainingCriterion()
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                     **(opt_kw or {}))
+    if step_cls is TrainStep:
+        step = TrainStep(model, lambda m, a, b: crit(m(a), b), opt)
+    else:
+        step = FusedScanTrainStep(model, opt, criterion=crit)
+    ids, labels = _batch(vocab=cfg.vocab_size)
+    losses = [float(step(ids, labels)) for _ in range(steps)]
+    return losses, model
+
+
+def test_parity_fp32_vs_scan_trainstep():
+    """fp32, same scan structure: trajectories must agree to fp32 noise."""
+    base, m_base = _run(TrainStep, scan_layers=True)
+    fused, m_fused = _run(FusedScanTrainStep, scan_layers=True)
+    np.testing.assert_allclose(base, fused, rtol=2e-5, atol=1e-6)
+    for (n1, p1), (n2, p2) in zip(m_base.named_parameters(),
+                                  m_fused.named_parameters()):
+        assert n1 == n2
+        np.testing.assert_allclose(
+            np.asarray(p1._data, np.float32),
+            np.asarray(p2._data, np.float32), rtol=1e-4, atol=1e-5,
+            err_msg=n1)
+
+
+def test_parity_fp32_vs_unrolled_trainstep():
+    """fp32 vs the unrolled tape path (different program, same math).
+    The stacked init draws RNG in different shapes than per-layer init,
+    so the unrolled model's weights are copied into the scan model."""
+    import jax.numpy as jnp
+
+    cfg_u = GPTConfig(**TINY, scan_layers=False)
+    paddle.seed(0)
+    m_u = GPTForCausalLM(cfg_u)
+    cfg_s = GPTConfig(**TINY, scan_layers=True)
+    paddle.seed(0)
+    m_s = GPTForCausalLM(cfg_s)
+    blocks = m_s.gpt.blocks
+    tmpl_names = [n for n, _ in blocks._template.named_parameters()]
+    for flat, pname in blocks._stacked_names:
+        assert pname in tmpl_names
+        per_layer = []
+        for blk in m_u.gpt.blocks:
+            d = dict(blk.named_parameters())
+            per_layer.append(d[pname]._data)
+        blocks._parameters[flat]._data = jnp.stack(per_layer)
+    u_outer = dict(m_u.named_parameters())
+    for n, p in m_s.named_parameters():
+        if "blocks__" not in n:
+            # fresh copy: step_u donates its state buffers, which would
+            # delete an aliased array out from under the scan model
+            p._data = jnp.array(u_outer[n]._data)
+
+    crit = GPTPretrainingCriterion()
+    opt_u = popt.AdamW(learning_rate=1e-3, parameters=m_u.parameters())
+    step_u = TrainStep(m_u, lambda m, a, b: crit(m(a), b), opt_u)
+    opt_s = popt.AdamW(learning_rate=1e-3, parameters=m_s.parameters())
+    step_s = FusedScanTrainStep(m_s, opt_s, criterion=crit)
+    ids, labels = _batch(vocab=TINY["vocab_size"])
+    base = [float(step_u(ids, labels)) for _ in range(4)]
+    fused = [float(step_s(ids, labels)) for _ in range(4)]
+    np.testing.assert_allclose(base, fused, rtol=5e-4, atol=1e-5)
+
+
+def test_parity_bench_config_bf16_masters():
+    """The 1.3b bench layout: bf16 params + fp32 masters + bf16 moments."""
+    kw = dict(opt_kw=dict(multi_precision=True, moment_dtype="bfloat16"),
+              bf16=True)
+    base, _ = _run(TrainStep, scan_layers=True, **kw)
+    fused, m = _run(FusedScanTrainStep, scan_layers=True, **kw)
+    np.testing.assert_allclose(base, fused, rtol=3e-2, atol=1e-2)
+
+
+def test_untied_head():
+    fused, m = _run(FusedScanTrainStep, scan_layers=True, tie=False)
+    assert np.isfinite(fused).all() and fused[-1] < fused[0]
+    assert m.lm_head is not None
+
+
+def test_loss_decreases_and_state_advances():
+    fused, m = _run(FusedScanTrainStep, scan_layers=True, steps=6)
+    assert fused[-1] < fused[0]
+
+
+def test_rejects_unrolled_model_and_clip():
+    cfg = GPTConfig(**TINY, scan_layers=False)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    with pytest.raises(ValueError, match="scan_layers"):
+        FusedScanTrainStep(model, opt)
+
+    cfg2 = GPTConfig(**TINY, scan_layers=True)
+    paddle.seed(0)
+    model2 = GPTForCausalLM(cfg2)
+    import paddle_tpu.nn as nn
+    opt2 = popt.AdamW(learning_rate=1e-3, parameters=model2.parameters(),
+                      grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    with pytest.raises(ValueError, match="clip"):
+        FusedScanTrainStep(model2, opt2)
