@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -30,6 +31,10 @@ def _setup_jax():
     return jax
 
 
+def _is_big(model_name):
+    return any(s in model_name for s in ("1.3b", "2.7b", "6.7b", "13b"))
+
+
 def run_config(model_name, batch, seq, steps, recompute, remat_policy,
                offload_masters):
     import jax
@@ -41,51 +46,85 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
         GPTForCausalLM, GPTPretrainingCriterion, gpt_config,
     )
 
-    # scan-over-layers (one compiled block instead of 24+ inlined copies)
-    # is available via BENCH_SCAN_LAYERS=1 but OFF by default: at 1.3b the
-    # scan keeps all layer grads live simultaneously (the unrolled program
-    # lets XLA free each grad right after its optimizer slice) and OOMs
-    # the 16G chip; the unrolled step fits and its ~17 min cold compile is
-    # amortized by the persistent compile cache (.jax_cache)
-    scan_layers = os.environ.get("BENCH_SCAN_LAYERS", "0") == "1"
+    # fused-scan step (round 5): scan-over-layers with the AdamW update
+    # fused INTO the reverse scan, so one layer's grad is live at a time —
+    # this is what makes 1.3b both fit 16G (the plain scan path holds all
+    # 24 layers' grads and OOMs, docs/DECISIONS.md §7) and load fast on
+    # the axon tunnel (O(1-block) program vs the unrolled step's ~40-min
+    # remote program load). Default ON for 1.3b+; the plain paths remain
+    # via BENCH_FUSED_SCAN=0 (+BENCH_SCAN_LAYERS for the generic scan).
+    big_model = _is_big(model_name)
+    # fused-scan rejects master offload (in-scan update needs the masters
+    # resident), so BENCH_OFFLOAD=1 suppresses the big-model default
+    fused_scan = os.environ.get(
+        "BENCH_FUSED_SCAN",
+        "1" if big_model and not offload_masters else "0") == "1"
+    scan_layers = (fused_scan
+                   or os.environ.get("BENCH_SCAN_LAYERS", "0") == "1")
     cfg = gpt_config(model_name, max_position_embeddings=seq,
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                     use_recompute=recompute,
+                     use_recompute=recompute and not fused_scan,
                      recompute_policy=remat_policy or None,
                      scan_layers=scan_layers)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
-    # bf16 params + fp32 master weights — the TPU-native AMP O2 layout
-    model.bfloat16()
+    moment_dtype = ("bfloat16"
+                    if os.environ.get("BENCH_BF16_MOMENTS", "1") == "1"
+                    else None)
     crit = GPTPretrainingCriterion()
-    opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                     multi_precision=True,
-                     moment_dtype=("bfloat16"
-                                   if os.environ.get("BENCH_BF16_MOMENTS",
-                                                     "1") == "1"
-                                   else None),
-                     offload_master_weights=offload_masters)
-
-    if os.environ.get("BENCH_FUSED_CE", "0") == "1":
-        # fused LM head: chunked logsumexp, no [tokens, vocab] logits at
-        # all. Measured slower than the dense lse-CE path at every config
-        # that fits (PERF.md) — opt-in for vocab/memory regimes that don't
-        def loss_fn(m, ids, labels):
-            return m.loss(ids, labels)
+    if fused_scan:
+        # fp32-STORED params + bf16 compute views inside the scan: the
+        # param is its own master (2 bytes/param less HBM than the
+        # bf16-params+fp32-masters layout — the difference between the
+        # 15.3G measured-OOM peak and a fitting 13.4G at 1.3b,
+        # tools/diag_fused_mem.py). Same math as AMP O2.
+        opt = popt.AdamW(learning_rate=1e-4,
+                         parameters=model.parameters(),
+                         moment_dtype=moment_dtype)
     else:
-        def loss_fn(m, ids, labels):
-            return crit(m(ids), labels)
+        # bf16 params + fp32 master weights — the TPU-native AMP O2 layout
+        model.bfloat16()
+        opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                         multi_precision=True,
+                         moment_dtype=moment_dtype,
+                         offload_master_weights=offload_masters)
 
-    step = TrainStep(model, loss_fn, opt)
+    fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
+    if fused_scan:
+        if fused_ce:
+            print("[bench] BENCH_FUSED_CE ignored: the fused-scan step "
+                  "uses the criterion path (BENCH_FUSED_HEAD=1 is its "
+                  "chunked-CE lever)", file=sys.stderr)
+        from paddle_tpu.jit import FusedScanTrainStep
+
+        step = FusedScanTrainStep(
+            model, opt, criterion=crit,
+            fused_head=os.environ.get("BENCH_FUSED_HEAD", "0") == "1",
+            compute_dtype="bfloat16")
+    else:
+        if fused_ce:
+            # fused LM head: chunked logsumexp, no [tokens, vocab] logits
+            # at all. Measured slower than the dense lse-CE path at every
+            # config that fits (PERF.md) — opt-in for regimes that don't
+            def loss_fn(m, ids, labels):
+                return m.loss(ids, labels)
+        else:
+            def loss_fn(m, ids, labels):
+                return crit(m(ids), labels)
+        step = TrainStep(model, loss_fn, opt)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)), dtype="int64")
     labels = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)), dtype="int64")
 
-    # warmup/compile
+    # warmup/compile (stderr timing: lets a manual run judge whether this
+    # config fits the driver's bench window)
+    tw = time.perf_counter()
     loss = step(ids, labels)
     _ = float(loss)
+    print(f"[bench] {model_name} fused_scan={fused_scan} warmup "
+          f"{time.perf_counter() - tw:.1f}s", file=sys.stderr)
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -115,8 +154,11 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
         "config": {"batch": batch, "seq": seq, "steps": steps,
                    "params": n_params, "recompute": cfg.use_recompute,
                    "remat_policy": remat_policy or None,
-                   "offload_masters": offload_masters,
-                   "scan_layers": scan_layers},
+                   "offload_masters": (offload_masters
+                                       and not fused_scan),
+                   "scan_layers": scan_layers,
+                   "fused_scan": fused_scan,
+                   "fused_ce": fused_ce and not fused_scan},
     }
 
 
@@ -269,7 +311,7 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     # 1.3b on one 16G chip is capacity-bound: 13G param+optimizer state
     # (PERF.md), so remat is mandatory there but off for 350m-class
-    big = "1.3b" in model_name or "2.7b" in model_name
+    big = _is_big(model_name)
     recompute = os.environ.get("BENCH_RECOMPUTE", "1" if big else "0") == "1"
     # 1.3b: FULL remat (the dots policy OOMs the 13G-state chip, PERF.md)
     remat_policy = os.environ.get("BENCH_REMAT_POLICY",

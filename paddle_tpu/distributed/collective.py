@@ -179,15 +179,20 @@ def _run(group, data, traced_fn, out_spec=None, cache_key=None):
         full_key = (cache_key, mesh, axes, in_spec, o_spec)
         fn = _eager_fn_cache.get(full_key)
         if fn is None:
-            # evict entries for OTHER meshes: a replaced mesh (elastic
-            # re-rendezvous, tests) must not pin dead devices/executables
-            for k in list(_eager_fn_cache):
-                if k[1] is not mesh:
-                    del _eager_fn_cache[k]
+            # bounded LRU instead of evict-all-other-meshes: sub-group
+            # collectives (new_group sub-mesh) alternating with world-
+            # group ones must not evict each other per call — that
+            # silently reintroduced the per-call retrace this cache fixed
+            # (ADVICE r4). Replaced meshes (elastic re-rendezvous, tests)
+            # age out of the LRU instead of being evicted eagerly.
+            while len(_eager_fn_cache) >= 128:
+                _eager_fn_cache.pop(next(iter(_eager_fn_cache)))
             fn = jax.jit(shard_map(traced_fn, mesh=mesh,
                                    in_specs=(in_spec,),
                                    out_specs=o_spec, check_vma=False))
             _eager_fn_cache[full_key] = fn
+        else:
+            _eager_fn_cache[full_key] = _eager_fn_cache.pop(full_key)
         return fn(data)
     fn = shard_map(traced_fn, mesh=mesh, in_specs=(in_spec,),
                    out_specs=o_spec, check_vma=False)

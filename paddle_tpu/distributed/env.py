@@ -87,6 +87,12 @@ def reset():
     _state["initialized"] = False
     _state["mesh"] = None
     _state["axis_degrees"] = {}
+    # groups built on the dropped mesh are orphaned: clear their cached
+    # eager-collective executables here too, or a reset()+re-init turnover
+    # (where set_mesh sees no previous mesh) would keep them pinned
+    from . import collective as _c
+
+    _c._eager_fn_cache.clear()
     try:
         from .fleet import topology as _topo
     except ImportError:  # fleet never imported in this process: no HCG
@@ -106,10 +112,20 @@ def pin_sharding(x, sharding):
 def set_mesh(mesh: Mesh):
     """Install a custom global mesh (built by fleet.init or user code)."""
     with _lock:
+        replaced = _state["mesh"] is not None and _state["mesh"] != mesh
         _state["mesh"] = mesh
         _state["axis_degrees"] = dict(zip(mesh.axis_names,
                                           (int(s) for s in mesh.devices.shape)))
         _state["initialized"] = True
+    if replaced:
+        # a replaced world mesh orphans every group built on it (sub-group
+        # meshes derive from it) — drop their cached eager-collective
+        # executables here, the one place mesh turnover is visible, instead
+        # of per-call eviction (which evicted live sub-group entries on
+        # every alternating world/sub call, ADVICE r4)
+        from . import collective as _c
+
+        _c._eager_fn_cache.clear()
 
 
 def get_mesh() -> Mesh:

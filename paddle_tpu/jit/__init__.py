@@ -127,12 +127,7 @@ class StaticFunction:
                 jax.errors.TracerIntegerConversionError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.UnexpectedTracerError,
-                dy2static.Unsupported,
-                TypeError) as e:
-            # TypeError included: lax.cond/while reject non-array branch
-            # outputs (strings, dicts mutated in place, ...) with it. The
-            # eager re-run below surfaces any GENUINE user TypeError
-            # unchanged, so widening here cannot mask real bugs.
+                dy2static.Unsupported) as e:
             # the documented dy2static fallback contract: control flow the
             # converter couldn't stage (return-in-branch, tensor-iterated
             # for, ...) runs EAGERLY with a warning instead of crashing
@@ -147,6 +142,25 @@ class StaticFunction:
                 "compile.", RuntimeWarning, stacklevel=2)
             self._eager = True
             return self._orig_fn(*args)
+        except TypeError:
+            # lax.cond/while reject non-array branch outputs (strings,
+            # dicts mutated in place, ...) with TypeError — but so does a
+            # genuinely mis-typed user call. Discriminate by re-running
+            # eagerly ONCE: if eager also raises, it was the user's error
+            # — propagate WITHOUT latching _eager, so later well-typed
+            # calls still compile (ADVICE r4). If eager succeeds, the
+            # inputs were fine and staging is what failed — warn + latch
+            # (the documented dy2static fallback contract).
+            result = self._orig_fn(*args)
+            import warnings
+
+            warnings.warn(
+                f"to_static({getattr(self._orig_fn, '__name__', '?')}): "
+                "branch/loop produced values lax control flow cannot "
+                "stage (TypeError); falling back to eager execution.",
+                RuntimeWarning, stacklevel=2)
+            self._eager = True
+            return result
         return _tree_wrap(out)
 
     @property

@@ -69,7 +69,8 @@ class FusedScanTrainStep:
     offload was measured counterproductive (docs/DECISIONS.md §8).
     """
 
-    def __init__(self, model, optimizer, criterion=None):
+    def __init__(self, model, optimizer, criterion=None, fused_head=False,
+                 compute_dtype=None):
         from ..models.gpt import GPTStackedBlocks, GPTPretrainingCriterion
         from ..optimizer import Adam
 
@@ -100,6 +101,26 @@ class FusedScanTrainStep:
                 "worse, docs/DECISIONS.md §8)")
         self._opt = opt
         self._crit = criterion or GPTPretrainingCriterion()
+        # fused_head=True routes the LM head through the chunked-logsumexp
+        # fused CE (F.fused_linear_cross_entropy) instead of dense logits +
+        # criterion: the dense head's [tokens, vocab] logits + fp32 CE
+        # residuals are ~2.5G of the 1.3b step's temps — the measured
+        # difference between fitting 16G HBM and not (tools/diag_fused_mem).
+        # Numerically equal to the criterion path (models/gpt.fused_lm_loss).
+        self._fused_head = bool(fused_head)
+        # compute_dtype="bfloat16" with FP32-STORED params is the
+        # memory-optimal single-chip AMP-O2 layout: rather than keeping a
+        # bf16 param stack AND an fp32 master stack (2+4 bytes/param),
+        # store only fp32 and materialize the bf16 view per layer inside
+        # the scan (transient ~one layer). Identical math — the bf16 copy
+        # the masters scheme computes with IS cast(master) at all times —
+        # but 2 bytes/param less HBM: at 1.3b that is the 2.45G between
+        # the 15.3G measured-OOM peak and a fitting 12.9G
+        # (tools/diag_fused_mem.py).
+        from ..framework.dtype import to_jax_dtype
+
+        self._compute_dtype = (to_jax_dtype(compute_dtype)
+                               if compute_dtype is not None else None)
         self._blocks = blocks
         self._template = blocks._template
         self._t_leaves = [p for _, p in self._template.named_parameters()]
@@ -108,6 +129,12 @@ class FusedScanTrainStep:
         self._o_params = [(n, p) for n, p in model.named_parameters()
                           if "blocks__" not in n and p.trainable]
         self._buffers = list(model.buffers())
+        if self._compute_dtype is not None:
+            for p in self._s_params + [p for _, p in self._o_params]:
+                if p._data.dtype != jnp.float32:
+                    raise ValueError(
+                        "compute_dtype expects fp32-stored params (the "
+                        f"param IS the master); got {p._data.dtype}")
         self._jitted = None
         self._step_count = 0
 
@@ -118,11 +145,19 @@ class FusedScanTrainStep:
             p._data = d
         return saved
 
+    def _cc(self, datas):
+        """The compute-dtype view of fp32-stored params (identity when
+        compute_dtype is unset). Differentiable: the cast's vjp upcasts
+        the bf16 cotangent, exactly what the masters scheme feeds Adam."""
+        if self._compute_dtype is None:
+            return datas
+        return [d.astype(self._compute_dtype) for d in datas]
+
     def _block_fn(self, leaf_datas, x):
         """One decoder block as a pure jax function of (leaves, x)."""
         tmpl = self._template
         with no_grad():
-            saved = self._bind(self._t_leaves, leaf_datas)
+            saved = self._bind(self._t_leaves, self._cc(leaf_datas))
             try:
                 tmpl.training = True
                 return tmpl._inner(Tensor._wrap(x))._data
@@ -132,7 +167,8 @@ class FusedScanTrainStep:
     def _embed_fn(self, o_datas, ids, pos):
         m = self.model
         with no_grad():
-            saved = self._bind([p for _, p in self._o_params], o_datas)
+            saved = self._bind([p for _, p in self._o_params],
+                               self._cc(o_datas))
             try:
                 x = m.gpt.wte(Tensor._wrap(ids)) + m.gpt.wpe(
                     Tensor._wrap(pos))
@@ -148,9 +184,19 @@ class FusedScanTrainStep:
         from .. import ops
 
         with no_grad():
-            saved = self._bind([p for _, p in self._o_params], o_datas)
+            saved = self._bind([p for _, p in self._o_params],
+                               self._cc(o_datas))
             try:
                 h = m.gpt.ln_f(Tensor._wrap(xL))
+                if self._fused_head:
+                    from ..models.gpt import fused_lm_loss
+
+                    if m.lm_head is None:
+                        w, t_y = m.gpt.wte.weight, True
+                    else:
+                        w, t_y = m.lm_head.weight, False
+                    return fused_lm_loss(h, w, t_y,
+                                         Tensor._wrap(labels))._data
                 if m.lm_head is None:
                     logits = ops.matmul(h, m.gpt.wte.weight,
                                         transpose_y=True)
@@ -315,20 +361,27 @@ class FusedScanTrainStep:
 
         self._jitted = jax.jit(step_fn, donate_argnums=(0,))
 
+    def ensure_built(self):
+        """Create the Adam state and trace the step (idempotent). Split
+        out so diagnostics can AOT-lower the program (memory_analysis)
+        without executing a step. warmup_state's dry-run is NOT used: it
+        would eagerly execute the whole layer-chunked update chain —
+        ~1.7k pointless dispatches through the axon tunnel at 1.3b."""
+        if self._jitted is not None:
+            return
+        opt = self._opt
+        for p in self._s_params + [p for _, p in self._o_params]:
+            if opt._use_master(p):
+                opt._master_weight(p)
+            opt._get_accumulator("moment1", p, dtype=opt._moment_dtype)
+            opt._get_accumulator("moment2", p, dtype=opt._moment_dtype)
+        self._build()
+
     def __call__(self, ids, labels):
         ids_d = ids._data if isinstance(ids, Tensor) else ids
         lab_d = labels._data if isinstance(labels, Tensor) else labels
         if self._jitted is None:
-            # create (not run) the Adam state: warmup_state's dry-run would
-            # eagerly execute the whole layer-chunked update chain — ~1.7k
-            # pointless dispatches through the axon tunnel at 1.3b
-            opt = self._opt
-            for p in self._s_params + [p for _, p in self._o_params]:
-                if opt._use_master(p):
-                    opt._master_weight(p)
-                opt._get_accumulator("moment1", p, dtype=opt._moment_dtype)
-                opt._get_accumulator("moment2", p, dtype=opt._moment_dtype)
-            self._build()
+            self.ensure_built()
         state = self._extract_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         with RecordEvent("FusedScanTrainStep"):

@@ -574,6 +574,7 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
     def f(x, y, w, *rest):
         b = rest[0] if bias is not None else None
+        y = y.reshape(-1).astype(jnp.int32)   # accept [N, 1] labels
         yp = pathsj[y]                  # [N, depth]
         yc = codesj[y]
         yv = validj[y]
@@ -603,6 +604,7 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     def f(lg, y):
         lf = lg.astype(jnp.float32)
         n = lf.shape[0]
+        y = y.reshape(-1).astype(jnp.int32)   # accept [N, 1] labels
         tgt = jnp.take_along_axis(lf, y[:, None], 1)[:, 0]
         theta = jnp.arccos(jnp.clip(tgt, -1.0 + 1e-7, 1.0 - 1e-7))
         tgt_m = jnp.cos(margin1 * theta + margin2) - margin3
@@ -617,7 +619,12 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     import jax
 
     loss, sm = nary(f, [logits, label], name="margin_cross_entropy")
-    loss = _reduce(loss, reduction)
+    # Tensor-level reduction (the jnp-level _reduce would break the tape
+    # — and broke "mean" outright when this fn moved here in r4)
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
     if return_softmax:
         return loss, sm
     return loss

@@ -208,10 +208,20 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     return nary(f, [x, indices], name="max_unpool2d")
 
 
+def _scalar1d(v):
+    """Paddle's 1-D pooling APIs accept an int OR a 1-element list/tuple
+    for kernel/stride/padding; normalize to the scalar before lifting to
+    the 2-D helper (a nested tuple would mis-shape it)."""
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCL", output_size=None, name=None):
     from ...framework.tensor import Tensor
 
+    kernel_size = _scalar1d(kernel_size)
+    stride = _scalar1d(stride)
+    padding = _scalar1d(padding)
     x3 = x.unsqueeze(-2)
     i3 = indices.unsqueeze(-2)
     out = max_unpool2d(x3, i3, (1, kernel_size),
@@ -258,6 +268,9 @@ def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
     from ...ops._dispatch import unary
     import jax.numpy as jnp
 
+    kernel_size = _scalar1d(kernel_size)
+    stride = _scalar1d(stride)
+    padding = _scalar1d(padding)
     out = lp_pool2d(x.unsqueeze(-2), norm_type, (1, kernel_size),
                     (1, stride if stride is not None else kernel_size),
                     (0, padding), ceil_mode=ceil_mode)

@@ -88,20 +88,29 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
     top_k = min(nms_top_k if nms_top_k > 0 else m, m)
 
     def one_class(boxes, s):
-        order = jnp.argsort(-s)[:top_k]
-        b_s, s_s = boxes[order], s[order]
+        # reference (matrix_nms_kernel.cc NMSMatrix / the numpy model in
+        # test_matrix_nms_op.py): boxes <= score_threshold are removed
+        # BEFORE sorting/decay. Static-shape version: order them last and
+        # zero their IoU rows/columns so they neither suppress nor score.
+        valid = s > score_threshold
+        order = jnp.argsort(-jnp.where(valid, s, -jnp.inf))[:top_k]
+        b_s, s_s, valid_s = boxes[order], s[order], valid[order]
         iou = _iou_matrix(b_s)
-        iou = jnp.triu(iou, k=1)                 # ious with higher-scored
-        max_iou = jnp.max(iou, axis=0)           # per box
-        comp = jnp.max(iou, axis=1)
+        iou = jnp.triu(iou, k=1)                 # [i, j]: i higher-scored
+        iou = jnp.where(valid_s[:, None] & valid_s[None, :], iou, 0.0)
+        # compensation: the SUPPRESSOR's max IoU with its own
+        # higher-scored boxes, broadcast per row
+        cmax = jnp.max(iou, axis=0)
         if use_gaussian:
-            decay = jnp.exp(-(iou ** 2 - comp[None, :] ** 2)
-                            / gaussian_sigma)
+            decay = jnp.exp((cmax[:, None] ** 2 - iou ** 2)
+                            * gaussian_sigma)
         else:
-            decay = (1 - iou) / jnp.maximum(1 - comp[None, :], 1e-9)
+            decay = (1 - iou) / jnp.maximum(1 - cmax[:, None], 1e-9)
+        # min over suppressors; non-triu entries are >= 1 in the
+        # reference's full-matrix min, so masking them to 1 is equivalent
         decay = jnp.min(jnp.where(jnp.triu(jnp.ones_like(iou), 1) > 0,
                                   decay, 1.0), axis=0)
-        return s_s * decay, b_s, order
+        return jnp.where(valid_s, s_s * decay, 0.0), b_s, order
 
     outs, boxes_out, labels, idxs = [], [], [], []
     for bi in range(n):

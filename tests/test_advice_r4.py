@@ -1,0 +1,114 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md):
+matrix_nms semantics live in test_vision_ops.py; these cover the four
+lows — to_static TypeError latch, eager-collective multi-mesh cache,
+[N, 1] label acceptance in margin/hsigmoid losses, and 1-element
+list args in the 1-D pooling lifts."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestToStaticTypeErrorNoLatch:
+    def test_bad_call_does_not_disable_compilation(self):
+        from paddle_tpu import jit
+
+        calls = {"n": 0}
+
+        @jit.to_static
+        def f(x):
+            calls["n"] += 1
+            return x * 2 + x.shape[0]
+
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        _ = f(x)
+        # a genuinely mis-typed call raises (surfaced by the eager
+        # re-run), but must NOT latch eager mode
+        with pytest.raises(TypeError):
+            f(object())
+        assert not f._eager
+        # later well-typed calls still hit the compiled path: the traced
+        # python body does not re-run for a cache hit
+        n_before = calls["n"]
+        _ = f(x)
+        assert calls["n"] == n_before
+
+
+class TestEagerCollectiveCacheMultiMesh:
+    def test_alternating_groups_keep_entries(self):
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import collective as C
+        from paddle_tpu.distributed import env as denv
+
+        prev = denv.get_mesh() if denv.is_initialized() else None
+        denv.set_mesh(Mesh(_np.array(jax.devices("cpu")[:8]), ("dp",)))
+        try:
+            self._check(dist, C)
+        finally:
+            if prev is not None:
+                denv.set_mesh(prev)
+
+    def _check(self, dist, C):
+        g_sub = dist.new_group(ranks=[0, 1, 2, 3])
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        C._eager_fn_cache.clear()
+        dist.all_reduce(x)
+        dist.all_reduce(paddle.to_tensor(np.ones(4, np.float32)),
+                        group=g_sub)
+        n = len(C._eager_fn_cache)
+        assert n >= 2
+        # alternating between the groups must not evict each other
+        for _ in range(3):
+            dist.all_reduce(paddle.to_tensor(np.ones(8, np.float32)))
+            dist.all_reduce(paddle.to_tensor(np.ones(4, np.float32)),
+                            group=g_sub)
+        assert len(C._eager_fn_cache) == n
+
+
+class TestLabelShape:
+    def test_margin_cross_entropy_2d_label(self):
+        rng = np.random.default_rng(0)
+        logits = paddle.to_tensor(
+            np.clip(rng.standard_normal((6, 10)), -0.99, 0.99)
+            .astype(np.float32))
+        y1 = paddle.to_tensor(rng.integers(0, 10, (6,)), dtype="int64")
+        y2 = paddle.to_tensor(np.asarray(y1._data).reshape(6, 1))
+        a = float(F.margin_cross_entropy(logits, y1))
+        b = float(F.margin_cross_entropy(logits, y2))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_hsigmoid_2d_label(self):
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((5, 8)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((9, 8)).astype(np.float32))
+        y1 = paddle.to_tensor(rng.integers(0, 10, (5,)), dtype="int64")
+        y2 = paddle.to_tensor(np.asarray(y1._data).reshape(5, 1))
+        a = np.asarray(F.hsigmoid_loss(x, y1, 10, w)._data)
+        b = np.asarray(F.hsigmoid_loss(x, y2, 10, w)._data)
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestPooling1dListArgs:
+    def test_lp_pool1d_list_args(self):
+        x = paddle.to_tensor(
+            np.arange(24, dtype=np.float32).reshape(1, 2, 12))
+        a = np.asarray(F.lp_pool1d(x, 2.0, 3, stride=2, padding=1)._data)
+        b = np.asarray(F.lp_pool1d(x, 2.0, [3], stride=[2],
+                                   padding=[1])._data)
+        np.testing.assert_allclose(a, b)
+
+    def test_max_unpool1d_list_args(self):
+        x = paddle.to_tensor(
+            np.asarray([[[5.0, 7.0, 9.0]]], np.float32))
+        idx = paddle.to_tensor(np.asarray([[[1, 3, 5]]], np.int32))
+        a = np.asarray(F.max_unpool1d(x, idx, 2)._data)
+        b = np.asarray(F.max_unpool1d(x, idx, [2], stride=[2],
+                                      padding=[0])._data)
+        np.testing.assert_allclose(a, b)

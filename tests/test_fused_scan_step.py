@@ -136,3 +136,50 @@ def test_rejects_unrolled_model_and_clip():
                       grad_clip=nn.ClipGradByGlobalNorm(1.0))
     with pytest.raises(ValueError, match="clip"):
         FusedScanTrainStep(model2, opt2)
+
+
+def test_fused_head_parity():
+    """fused_head (chunked-logsumexp CE) must match the dense criterion
+    head: same trajectory in fp32."""
+    base, _ = _run(FusedScanTrainStep, scan_layers=True)
+    cfg = GPTConfig(**TINY, scan_layers=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = FusedScanTrainStep(model, opt, fused_head=True)
+    ids, labels = _batch(vocab=cfg.vocab_size)
+    fused = [float(step(ids, labels)) for _ in range(4)]
+    np.testing.assert_allclose(base, fused, rtol=2e-5, atol=1e-6)
+
+
+def test_compute_dtype_fp32_master_layout():
+    """compute_dtype='bfloat16' with fp32-stored params must track the
+    bf16-params+fp32-masters TrainStep trajectory (initial masters differ
+    by one bf16 rounding of the init, hence the loose tolerance), with no
+    master_weights allocated at all."""
+    kw = dict(opt_kw=dict(multi_precision=True, moment_dtype="bfloat16"),
+              bf16=True)
+    base, _ = _run(TrainStep, scan_layers=True, **kw)
+
+    cfg = GPTConfig(**TINY, scan_layers=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)          # stays fp32
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                     moment_dtype="bfloat16")
+    step = FusedScanTrainStep(model, opt, compute_dtype="bfloat16")
+    ids, labels = _batch(vocab=cfg.vocab_size)
+    fused = [float(step(ids, labels)) for _ in range(4)]
+    np.testing.assert_allclose(base, fused, rtol=3e-2, atol=1e-2)
+    assert not opt._master_weights
+    import jax.numpy as jnp
+    assert all(p._data.dtype == jnp.float32 for p in model.parameters())
+
+
+def test_compute_dtype_rejects_bf16_params():
+    cfg = GPTConfig(**TINY, scan_layers=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.bfloat16()
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    with pytest.raises(ValueError, match="fp32-stored"):
+        FusedScanTrainStep(model, opt, compute_dtype="bfloat16")

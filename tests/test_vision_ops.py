@@ -159,3 +159,72 @@ class TestDeformConv:
         want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
         np.testing.assert_allclose(np.asarray(got._data),
                                    np.asarray(want._data), atol=1e-4)
+
+
+class TestMatrixNMS:
+    """Numerics vs an independent numpy model of the reference decay
+    (matrix_nms_kernel.cc NMSMatrix; numpy model in
+    test_matrix_nms_op.py): suppressor-side compensation cmax=ious.max(0)
+    broadcast per-row, gaussian decay exp((cmax^2-iou^2)*sigma),
+    score_threshold filtering before decay."""
+
+    @staticmethod
+    def _np_iou(b):
+        n = b.shape[0]
+        x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        ix1 = np.maximum(x1[:, None], x1[None, :])
+        iy1 = np.maximum(y1[:, None], y1[None, :])
+        ix2 = np.minimum(x2[:, None], x2[None, :])
+        iy2 = np.minimum(y2[:, None], y2[None, :])
+        inter = (np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0))
+        union = area[:, None] + area[None, :] - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-10), 0.0)
+
+    def _np_one_class(self, boxes, s, score_threshold, top_k,
+                      use_gaussian, sigma):
+        keep = np.where(s > score_threshold)[0]
+        order = keep[np.argsort(-s[keep], kind="stable")][:top_k]
+        b_s, s_s = boxes[order], s[order]
+        ious = np.triu(self._np_iou(b_s), k=1)
+        cmax = np.repeat(ious.max(0)[:, None], ious.shape[0], axis=1)
+        if use_gaussian:
+            decay = np.exp((cmax ** 2 - ious ** 2) * sigma)
+        else:
+            decay = (1 - ious) / np.maximum(1 - cmax, 1e-9)
+        return s_s * decay.min(0), b_s
+
+    def _check(self, use_gaussian):
+        rng = np.random.default_rng(7)
+        m, c = 12, 3
+        wh = rng.uniform(0.1, 0.5, (m, 2))
+        xy = rng.uniform(0.0, 0.5, (m, 2))
+        boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        scores = rng.uniform(0.0, 1.0, (c, m)).astype(np.float32)
+        st, sigma = 0.25, 2.0
+        out = vops.matrix_nms(
+            paddle.to_tensor(boxes[None]), paddle.to_tensor(scores[None]),
+            score_threshold=st, post_threshold=0.0, nms_top_k=-1,
+            keep_top_k=-1, use_gaussian=use_gaussian,
+            gaussian_sigma=sigma, background_label=-1,
+            return_rois_num=False)
+        got = np.asarray(out._data)     # rows: [label, score, x1..y2]
+        want_rows = []
+        for ci in range(c):
+            s_dec, b_s = self._np_one_class(boxes, scores[ci], st, m,
+                                            use_gaussian, sigma)
+            for sc_v, bx in zip(s_dec, b_s):
+                want_rows.append((ci, sc_v, *bx))
+        want_rows.sort(key=lambda r: -r[1])
+        assert got.shape[0] == len(want_rows), (got.shape, len(want_rows))
+        for grow, wrow in zip(got, want_rows):
+            assert int(grow[0]) == wrow[0]
+            np.testing.assert_allclose(grow[1], wrow[1], rtol=1e-5,
+                                       atol=1e-6)
+            np.testing.assert_allclose(grow[2:], wrow[2:], rtol=1e-5)
+
+    def test_linear_decay(self):
+        self._check(use_gaussian=False)
+
+    def test_gaussian_decay(self):
+        self._check(use_gaussian=True)

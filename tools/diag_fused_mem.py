@@ -1,0 +1,72 @@
+"""AOT memory diagnosis of the fused-scan 1.3b step: lower+compile the
+program and print the XLA buffer-assignment stats (argument/output/temp/
+alias sizes) WITHOUT executing — the way to see whether donation aliased
+the state through the scan carries and where the peak lives, without
+paying an on-chip OOM each probe.
+
+Usage: python tools/diag_fused_mem.py [model] [batch]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    model_name = sys.argv[1] if len(sys.argv) > 1 else "gpt3-1.3b"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    seq = int(os.environ.get("SEQ", "1024"))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.jit import FusedScanTrainStep
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+
+    cfg = gpt_config(model_name, max_position_embeddings=seq,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     scan_layers=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    compute_dtype = None
+    if os.environ.get("FP32_STORE", "1") == "1":
+        compute_dtype = "bfloat16"      # fp32-stored params, bf16 compute
+        opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                         moment_dtype="bfloat16")
+    else:
+        model.bfloat16()
+        opt = popt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                         multi_precision=True, moment_dtype="bfloat16")
+    step = FusedScanTrainStep(
+        model, opt, fused_head=os.environ.get("FUSED_HEAD", "0") == "1",
+        compute_dtype=compute_dtype)
+    step.ensure_built()
+    state = step._extract_state()
+    lr = jnp.asarray(1e-4, jnp.float32)
+    ids = jnp.asarray(np.zeros((batch, seq), np.int32))
+    labels = jnp.asarray(np.zeros((batch, seq), np.int32))
+    lowered = step._jitted.lower(state, lr, ids, labels)
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    G = 1 << 30
+    print(f"model={model_name} batch={batch} seq={seq}")
+    try:
+        print(f"  argument_size   {ma.argument_size_in_bytes / G:.2f} G")
+        print(f"  output_size     {ma.output_size_in_bytes / G:.2f} G")
+        print(f"  temp_size       {ma.temp_size_in_bytes / G:.2f} G")
+        print(f"  alias_size      {ma.alias_size_in_bytes / G:.2f} G")
+        print(f"  peak (arg+out+temp-alias) "
+              f"{(ma.argument_size_in_bytes + ma.output_size_in_bytes + ma.temp_size_in_bytes - ma.alias_size_in_bytes) / G:.2f} G")
+    except AttributeError:
+        print(" ", ma)
+
+
+if __name__ == "__main__":
+    main()
