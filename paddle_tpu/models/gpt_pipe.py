@@ -43,12 +43,17 @@ class GPTForCausalLMPipe(nn.Layer):
     """
 
     def __init__(self, config: GPTConfig, num_stages, num_micro,
-                 num_chunks=1, mesh=None, axis="pp"):
+                 num_chunks=1, mesh=None, axis="pp", use_zero_bubble=False):
         super().__init__()
         self.config = config
         self.num_stages = int(num_stages)
         self.num_micro = int(num_micro)
         self.num_chunks = int(num_chunks)
+        # zero-bubble dW-deferred backward (pipeline_spmd_zb): the reverse
+        # ring computes dX only; weight grads fold off the critical path
+        self.use_zero_bubble = bool(use_zero_bubble)
+        if use_zero_bubble and num_chunks != 1:
+            raise ValueError("zero-bubble supports num_chunks=1 only")
         self._axis = axis
         self._mesh = mesh
         total = self.num_stages * self.num_chunks
@@ -140,10 +145,19 @@ class GPTForCausalLMPipe(nn.Layer):
         n_micro, n_chunks = self.num_micro, self.num_chunks
         stacked = [self._parameters[flat] for flat, _ in self._stacked_names]
 
+        use_zb = self.use_zero_bubble
+
         def pipefn(xa, *leaves):
             xm = microbatch(xa, n_micro)
-            out = pipeline_spmd(block_fn, list(leaves), xm, mesh=mesh,
-                                axis=axis, num_chunks=n_chunks)
+            if use_zb:
+                from ..distributed.fleet.meta_parallel.spmd_pipeline \
+                    import pipeline_spmd_zb
+
+                out = pipeline_spmd_zb(block_fn, list(leaves), xm,
+                                       mesh=mesh, axis=axis)
+            else:
+                out = pipeline_spmd(block_fn, list(leaves), xm, mesh=mesh,
+                                    axis=axis, num_chunks=n_chunks)
             return unmicrobatch(out)
 
         hidden = apply_op(pipefn, [x] + stacked, name="pipeline_spmd")
