@@ -100,7 +100,9 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
         step = FusedScanTrainStep(
             model, opt, criterion=crit,
             fused_head=os.environ.get("BENCH_FUSED_HEAD", "0") == "1",
-            compute_dtype="bfloat16")
+            compute_dtype="bfloat16",
+            layer_chunk=int(os.environ.get("BENCH_LAYER_CHUNK", "1")),
+            scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")))
     else:
         if fused_ce:
             # fused LM head: chunked logsumexp, no [tokens, vocab] logits

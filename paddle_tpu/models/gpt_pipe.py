@@ -54,6 +54,14 @@ class GPTForCausalLMPipe(nn.Layer):
         self.use_zero_bubble = bool(use_zero_bubble)
         if use_zero_bubble and num_chunks != 1:
             raise ValueError("zero-bubble supports num_chunks=1 only")
+        if use_zero_bubble and (config.hidden_dropout_prob
+                                or config.attention_dropout_prob):
+            # the zb backward RE-TRACES the block (dX tick + dW fold);
+            # eager dropout draws a fresh PRNG key per trace, so the
+            # backward would differentiate forwards that never ran
+            raise ValueError(
+                "use_zero_bubble requires zero dropout (the hand-written "
+                "backward re-traces the block; see pipeline_spmd_zb)")
         self._axis = axis
         self._mesh = mesh
         total = self.num_stages * self.num_chunks

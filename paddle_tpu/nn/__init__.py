@@ -28,6 +28,7 @@ from .layer.common import (  # noqa: F401
     ChannelShuffle,
     CosineSimilarity,
     Bilinear,
+    PairwiseDistance,
 )
 from .layer.conv import (  # noqa: F401
     Conv1D,

@@ -294,3 +294,20 @@ class Fold(Layer):
     def forward(self, x):
         return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
                       self.paddings, self.dilations)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (reference
+    nn/layer/distance.py PairwiseDistance over F.pairwise_distance)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ..functional.extras import pairwise_distance
+
+        return pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
+                                 keepdim=self.keepdim)

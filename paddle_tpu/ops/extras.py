@@ -320,12 +320,14 @@ def cummin(x, axis=None, dtype="int64", name=None):
     return vals, idx_f
 
 
-def _cummin_idx(v, axis=0):
+def _cum_idx(v, axis, cmp):
+    """Running arg-extremum along `axis`: index of the first element that
+    `cmp`-beats all before it (shared body of cummax/cummin indices)."""
     vm = jnp.moveaxis(v, axis, 0)
 
     def body(carry, x):
         best, bidx, i = carry
-        take = x < best
+        take = cmp(x, best)
         best = jnp.where(take, x, best)
         bidx = jnp.where(take, i, bidx)
         return (best, bidx, i + 1), bidx
@@ -335,6 +337,14 @@ def _cummin_idx(v, axis=0):
     idxs = jnp.concatenate(
         [jnp.zeros((1,) + vm.shape[1:], jnp.int64), idxs], 0)
     return jnp.moveaxis(idxs, 0, axis)
+
+
+def _cummax_idx(v, axis=0):
+    return _cum_idx(v, axis, jnp.greater)
+
+
+def _cummin_idx(v, axis=0):
+    return _cum_idx(v, axis, jnp.less)
 
 
 def slice_scatter(x, value, axes, starts, ends, strides, name=None):

@@ -353,14 +353,28 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return unary(f, x, "cumprod")
 
 
-def cummax(x, axis=None, name=None):
-    def f(v):
-        a = axis if axis is not None else 0
-        vv = v.reshape(-1) if axis is None else v
-        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=a if axis is not None else 0)
-        return vals
+def cummax(x, axis=None, dtype="int64", name=None):
+    """Reference cummax_kernel.h: returns (values, indices) — the running
+    max AND the original index of each running max (the r5 op sweep
+    caught this returning bare values while cummin returned the pair)."""
+    from .extras import _cummax_idx
+    from ..framework.dtype import to_jax_dtype
 
-    return unary(f, x, "cummax")
+    idt = to_jax_dtype(dtype)
+
+    def fv(v):
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.associative_scan(jnp.maximum, vv,
+                                        axis=0 if axis is None else axis)
+
+    def fi(v):
+        vv = v.reshape(-1) if axis is None else v
+        return _cummax_idx(vv, 0 if axis is None else axis).astype(idt)
+
+    vals = unary(fv, x, "cummax")
+    idxs = unary(fi, x, "cummax_idx")
+    idxs.stop_gradient = True
+    return vals, idxs
 
 
 def logcumsumexp(x, axis=None, name=None):

@@ -183,3 +183,28 @@ def test_compute_dtype_rejects_bf16_params():
     opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
     with pytest.raises(ValueError, match="fp32-stored"):
         FusedScanTrainStep(model, opt, compute_dtype="bfloat16")
+
+
+def test_layer_chunk_parity():
+    """scan-over-chunks (K layers unrolled per scan step) must be exactly
+    the same math as K=1 — and as the generic TrainStep."""
+    base, _ = _run(FusedScanTrainStep, scan_layers=True)
+    for K in (3,):
+        cfg = GPTConfig(**TINY, scan_layers=True)
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = FusedScanTrainStep(model, opt, layer_chunk=K)
+        ids, labels = _batch(vocab=cfg.vocab_size)
+        fused = [float(step(ids, labels)) for _ in range(4)]
+        np.testing.assert_allclose(base, fused, rtol=2e-5, atol=1e-6,
+                                   err_msg=f"K={K}")
+
+
+def test_layer_chunk_must_divide():
+    cfg = GPTConfig(**TINY, scan_layers=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    with pytest.raises(ValueError, match="divide"):
+        FusedScanTrainStep(model, opt, layer_chunk=2)  # 3 layers

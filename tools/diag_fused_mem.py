@@ -46,7 +46,8 @@ def main():
                          multi_precision=True, moment_dtype="bfloat16")
     step = FusedScanTrainStep(
         model, opt, fused_head=os.environ.get("FUSED_HEAD", "0") == "1",
-        compute_dtype=compute_dtype)
+        compute_dtype=compute_dtype,
+        layer_chunk=int(os.environ.get("LAYER_CHUNK", "1")))
     step.ensure_built()
     state = step._extract_state()
     lr = jnp.asarray(1e-4, jnp.float32)
