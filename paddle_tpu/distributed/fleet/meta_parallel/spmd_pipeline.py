@@ -151,7 +151,8 @@ def _pack_stage_segments(flat_params, *, mesh=None, axis="pp"):
     the largest stage's length, stack [n_stages, L] and (when a mesh is
     given) shard the stage dim over ``axis``. Returns
     ``(all_dtypes, seg_len, stacked)``. Per-device resident bytes =
-    max-stage-total — the single-program-SPMD floor (see
+    sum over dtypes of per-dtype max-stage totals — equal to the
+    max-stage-total floor when stages share one dtype mix (see
     pipeline_spmd_hetero docstring); exposed for the residency test."""
     all_dtypes = sorted({str(jnp.result_type(l))
                          for leaves, _ in flat_params for l in leaves})
@@ -203,10 +204,12 @@ def pipeline_spmd_hetero(stage_fns, stage_params, x_micro, *, mesh,
     dtype), each branch unpadding its input and repadding its output.
 
     Parameter residency (r5, VERDICT r4 weak #2): each stage's leaves are
-    flattened into ONE 1-D segment per dtype, segments padded to the
-    LARGEST STAGE'S total and stacked [n_stages, max_total] sharded over
-    ``axis`` — so a device's resident param bytes equal the largest
-    single stage's total, NOT the old per-slot elementwise-max union
+    flattened into ONE 1-D segment per dtype, each dtype's segments
+    padded to that dtype's largest per-stage total and stacked
+    [n_stages, max_total_d] sharded over ``axis`` — so a device's
+    resident param bytes are the SUM over dtypes of per-dtype
+    largest-stage totals (= the largest single stage's total when stages
+    share one dtype mix), NOT the old per-slot elementwise-max union
     (where one [vocab, hidden] embedding stage inflated every stage's
     slot to embedding size; at vocab≫hidden the union could approach the
     SUM of all distinct stage footprints). max-stage-total is the floor

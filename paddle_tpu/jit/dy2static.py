@@ -88,6 +88,15 @@ class _Undef:
 
 _UNDEF = _Undef()
 
+# aliases reachable from generated code via the injected _JST module ref
+UNDEF = _UNDEF
+
+
+def ret_value(v):
+    """Final-return helper for flag-lowered functions: UNDEF means no
+    valued `return` ever executed (python returns None)."""
+    return None if v is _UNDEF else v
+
 
 def _load(fn):
     """Load a carried name tolerating unboundness (generated code passes
@@ -130,15 +139,55 @@ def _ctx_wrap(ctx):
                  or isinstance(v, jax.core.Tracer) else v for v in ctx)
 
 
-def convert_ifelse(pred, true_fn, false_fn, ctx):
+def _fill_ph_slots(ctx, ph, probe_fns):
+    """Zero-fill UNDEF carried slots in `ph` (the flag-lowering's
+    return-value slots) from the aval another branch/body produces for
+    them. Sound because the generated gates guarantee such a slot is only
+    consumed when its flag is set — i.e. on the path that assigned it."""
+    undef_ph = [i for i in ph if ctx[i] is _UNDEF]
+    if not undef_ph:
+        return ctx
+    defined = [i for i, v in enumerate(ctx) if v is not _UNDEF]
+    init = _ctx_to_jax([ctx[i] for i in defined])
+    fills = {}
+    for fn in probe_fns:
+        rec = {}
+
+        def probe(c, fn=fn, rec=rec):
+            full = list(ctx)
+            w = _ctx_wrap(c)
+            for j, i in enumerate(defined):
+                full[i] = w[j]
+            out = fn(tuple(full))
+            rec["undef"] = [v is _UNDEF for v in out]
+            return _ctx_to_jax([jnp.zeros(()) if v is _UNDEF else v
+                                for v in out])
+
+        shp = jax.eval_shape(probe, init)
+        for i in undef_ph:
+            if i not in fills and not rec["undef"][i]:
+                fills[i] = jnp.zeros(shp[i].shape, shp[i].dtype)
+    ctx = list(ctx)
+    for i in undef_ph:
+        # never assigned by any branch: a scalar placeholder keeps the
+        # carry total; the gates make it unreadable
+        ctx[i] = fills.get(i, jnp.zeros(()))
+    return tuple(ctx)
+
+
+def convert_ifelse(pred, true_fn, false_fn, ctx, ph=()):
     """Reference convert_operators.convert_ifelse: tensor predicate →
     lax.cond over the carried names; python predicate → plain branch.
 
     Carried slots holding _UNDEF (no binding before the `if`) are fed to
     the branch code as-is; both branches must then assign them — a branch
-    returning _UNDEF for such a slot cannot be staged (Unsupported)."""
+    returning _UNDEF for such a slot cannot be staged (Unsupported),
+    EXCEPT slots in `ph` (flag-lowered return values), which zero-fill
+    from the assigning branch's aval (_fill_ph_slots)."""
     p = _unwrap(pred)
     if isinstance(p, jax.core.Tracer):
+        if ph:
+            ctx = _fill_ph_slots(ctx, ph, (true_fn, false_fn))
         defined = [i for i, v in enumerate(ctx) if v is not _UNDEF]
         init = _ctx_to_jax([ctx[i] for i in defined])
 
@@ -164,12 +213,14 @@ def convert_ifelse(pred, true_fn, false_fn, ctx):
     return true_fn(ctx) if p else false_fn(ctx)
 
 
-def convert_while(cond_fn, body_fn, ctx):
+def convert_while(cond_fn, body_fn, ctx, ph=()):
     """Reference convert_operators.convert_while_loop: tensor condition →
     lax.while_loop; python condition → plain loop."""
     first = cond_fn(ctx)
     p = _unwrap(first)
     if isinstance(p, jax.core.Tracer):
+        if ph:
+            ctx = _fill_ph_slots(ctx, ph, (body_fn,))
         if any(v is _UNDEF for v in ctx):
             raise Unsupported(
                 "a name assigned inside a tensor-dependent `while` has no "
@@ -234,11 +285,15 @@ _BLOCKERS = (ast.Return, ast.Break, ast.Continue, ast.Yield, ast.YieldFrom,
 
 
 def _has_blocker(nodes):
-    for n in nodes:
-        for sub in ast.walk(n):
-            if isinstance(sub, _BLOCKERS):
-                return True
-    return False
+    def check(n):
+        if isinstance(n, _BLOCKERS):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False    # own scope: a return/yield there is local
+        return any(check(c) for c in ast.iter_child_nodes(n))
+
+    return any(check(n) for n in nodes)
 
 
 class _AssignedNames(ast.NodeVisitor):
@@ -333,6 +388,194 @@ def _ctx_load_guarded(names):
     return ast.Tuple(elems, ast.Load())
 
 
+# ---------------------------------------------------------------------------
+# return/break/continue lowering (r5, VERDICT r4 next #6) — the flag-variable
+# rewriting of the reference's return_transformer.py /
+# break_continue_transformer.py, adapted to the carried-names design:
+#
+#   return X   ->  __d2sf_rv = X; __d2sf_ret = True     (+ block gating)
+#   break      ->  __d2sf_brkN = True                   (+ loop-test and)
+#   continue   ->  __d2sf_contN = True                  (+ body gating)
+#
+# Statements after a flag-setter in the same block are wrapped in
+# `if not (flags...):` — after the main transformer runs, those gates
+# become lax.cond when the flags are traced, which is exactly how an
+# early return inside a tensor `if` stages. The return-value slot
+# (__d2sf_rv) starts as the UNDEF sentinel; convert_ifelse/convert_while
+# zero-fill it from the other branch's aval (the `ph` parameter) — sound
+# because the gates guarantee it is only consumed when the flag is set.
+#
+# Eligibility (conservative): the function's LAST statement is a plain
+# `return`, and returns/breaks/continues appear inside if/while/for
+# bodies. Functions mixing valued returns with an implicit fall-off-None
+# are left to the eager-fallback path (their two return structures can't
+# stage into one program). Known deviation: `break` inside a converted
+# for-range gates the remaining iterations to no-ops instead of
+# terminating the counter, so the loop var's final value differs.
+# ---------------------------------------------------------------------------
+
+_RET, _RV = "__d2sf_ret", "__d2sf_rv"
+
+
+def _assign(name, value_node):
+    return ast.Assign(targets=[ast.Name(name, ast.Store())],
+                      value=value_node)
+
+
+def _not_flags(flags):
+    """`not (f1 or f2 or ...)` — lowered lazily by _CondExprTransformer
+    when the main pass converts the gate's `if`."""
+    ors = ast.BoolOp(op=ast.Or(),
+                     values=[ast.Name(f, ast.Load()) for f in flags]) \
+        if len(flags) > 1 else ast.Name(flags[0], ast.Load())
+    return ast.UnaryOp(op=ast.Not(), operand=ors)
+
+
+class _FlagLower:
+    """Bottom-up statement rewriter eliminating Return/Break/Continue in
+    favor of carried flag variables (see section comment)."""
+
+    def __init__(self):
+        self.n = 0
+        self.lowered = 0
+
+    def run(self, fdef):
+        body = fdef.body
+        if not body or not isinstance(body[-1], ast.Return):
+            return fdef
+        if not self._has_lowerable(body):
+            return fdef
+        # bare `return` and valued `return` cannot mix: the bare path
+        # would surface a zero-filled placeholder instead of None (r5
+        # review repro). All-bare is fine (rv stays UNDEF -> None).
+        has_val, has_bare = False, False
+        for s in body:
+            for sub in self._walk_own_scope(s):
+                if isinstance(sub, ast.Return):
+                    if sub.value is None:
+                        has_bare = True
+                    else:
+                        has_val = True
+        if has_val and has_bare:
+            return fdef
+        new, sets = self._block(body, loop=None)
+        inits = [
+            _assign(_RET, ast.Constant(False)),
+            _assign(_RV, ast.Attribute(
+                value=ast.Name(_JST, ast.Load()), attr="UNDEF",
+                ctx=ast.Load())),
+        ]
+        tail = [ast.Return(ast.Call(
+            func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
+                               attr="ret_value", ctx=ast.Load()),
+            args=[ast.Name(_RV, ast.Load())], keywords=[]))]
+        fdef.body = inits + new + tail
+        return fdef
+
+    @staticmethod
+    def _walk_own_scope(node):
+        """ast.walk that does not descend into nested function scopes."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _has_lowerable(self, stmts):
+        for s in stmts:
+            for sub in self._walk_own_scope(s):
+                if isinstance(sub, (ast.If, ast.While, ast.For)):
+                    for inner in self._walk_own_scope(sub):
+                        if isinstance(inner, (ast.Return, ast.Break,
+                                              ast.Continue)):
+                            return True
+        return False
+
+    def _block(self, stmts, loop):
+        """Returns (new_stmts, flags-this-block-may-set)."""
+        out = []
+        acc = set()
+        for idx, s in enumerate(stmts):
+            lowered, sets = self._stmt(s, loop)
+            out.extend(lowered)
+            acc |= sets
+            rest = stmts[idx + 1:]
+            if sets and rest:
+                rest_new, rest_sets = self._block(rest, loop)
+                out.append(ast.If(test=_not_flags(sorted(sets)),
+                                  body=rest_new, orelse=[]))
+                return out, acc | rest_sets
+        return out, acc
+
+    def _stmt(self, s, loop):
+        if isinstance(s, ast.Return):
+            self.lowered += 1
+            new = [_assign(_RET, ast.Constant(True))]
+            if s.value is not None:
+                new.insert(0, _assign(_RV, s.value))
+            return new, {_RET}
+        if isinstance(s, ast.Break):
+            self.lowered += 1
+            return [_assign(loop[0], ast.Constant(True))], {loop[0]}
+        if isinstance(s, ast.Continue):
+            self.lowered += 1
+            return [_assign(loop[1], ast.Constant(True))], {loop[1]}
+        if isinstance(s, ast.If):
+            body, bsets = self._block(s.body, loop)
+            orelse, osets = (self._block(s.orelse, loop)
+                             if s.orelse else ([], set()))
+            return [ast.If(test=s.test, body=body, orelse=orelse)], \
+                bsets | osets
+        if isinstance(s, (ast.While, ast.For)):
+            return self._loop(s, loop)
+        return [s], set()
+
+    def _loop(self, s, outer_loop):
+        self.n += 1
+        brk = f"__d2sf_brk{self.n}"
+        cont = f"__d2sf_cont{self.n}"
+        body, sets = self._block(s.body, loop=(brk, cont))
+        pre, inner_stop = [], []
+        if brk in sets:
+            pre.append(_assign(brk, ast.Constant(False)))
+            inner_stop.append(brk)
+        if cont in sets:
+            # reset at each iteration top AND bind before the loop (the
+            # carried-ctx capture needs a pre-loop binding)
+            body = [_assign(cont, ast.Constant(False))] + body
+            pre.append(_assign(cont, ast.Constant(False)))
+        escape = {_RET} if _RET in sets else set()
+        if escape:
+            inner_stop.append(_RET)
+        # loop `else` runs iff the loop was NOT broken out of: with break
+        # lowered to a flag the loop always "completes", so the orelse
+        # must be gated on the flags (python for/while-else semantics;
+        # r5 review repro)
+        orelse = s.orelse
+        if orelse:
+            orelse, osets = self._block(orelse, outer_loop)
+            escape |= osets
+            if inner_stop:
+                orelse = [ast.If(test=_not_flags(sorted(inner_stop)),
+                                 body=orelse, orelse=[])]
+        if isinstance(s, ast.While):
+            test = s.test
+            if inner_stop:
+                test = ast.BoolOp(op=ast.And(), values=[
+                    s.test, _not_flags(inner_stop)])
+            return pre + [ast.While(test=test, body=body,
+                                    orelse=orelse)], escape
+        # for: gate the body on the stop flags instead of cutting the
+        # iteration (see the deviation note in the section comment)
+        if inner_stop:
+            body = [ast.If(test=_not_flags(inner_stop), body=body,
+                           orelse=[])]
+        return pre + [ast.For(target=s.target, iter=s.iter, body=body,
+                              orelse=orelse)], escape
+
+
 def _make_branch_fn(name, carried, body):
     """def <name>(__ctx): (a, b) = __ctx; BODY; return (a, b)"""
     stmts = []
@@ -391,13 +634,15 @@ class ControlFlowTransformer(ast.NodeTransformer):
         tfn = _make_branch_fn(tname, carried, body)
         ffn = _make_branch_fn(
             fname, carried, orelse or [ast.Pass()])
+        ph = tuple(j for j, n in enumerate(carried) if n == _RV)
         call = ast.Call(
             func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
                                attr="convert_ifelse", ctx=ast.Load()),
             args=[test, ast.Name(tname, ast.Load()),
                   ast.Name(fname, ast.Load()),
                   _ctx_load_guarded(carried)],
-            keywords=[])
+            keywords=[ast.keyword(arg="ph", value=ast.Constant(ph))]
+            if ph else [])
         assign = (ast.Assign(targets=[_ctx_tuple(carried, ast.Store)],
                              value=call)
                   if carried else ast.Expr(call))
@@ -418,12 +663,14 @@ class ControlFlowTransformer(ast.NodeTransformer):
         cfn = _make_branch_fn(cname, carried, [])
         cfn.body[-1] = ast.Return(test)  # return COND instead of ctx
         bfn = _make_branch_fn(bname, carried, body)
+        ph = tuple(j for j, n in enumerate(carried) if n == _RV)
         call = ast.Call(
             func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
                                attr="convert_while", ctx=ast.Load()),
             args=[ast.Name(cname, ast.Load()), ast.Name(bname, ast.Load()),
                   _ctx_load_guarded(carried)],
-            keywords=[])
+            keywords=[ast.keyword(arg="ph", value=ast.Constant(ph))]
+            if ph else [])
         assign = (ast.Assign(targets=[_ctx_tuple(carried, ast.Store)],
                              value=call)
                   if carried else ast.Expr(call))
@@ -504,6 +751,10 @@ def convert_function(fn):
         raise ConversionError("not a function definition")
     fdef.decorator_list = []
 
+    # flag-lowering pre-pass: return/break/continue in convertible
+    # control flow become carried flags (see _FlagLower), so the main
+    # transformer below no longer bails on them
+    _FlagLower().run(fdef)
     tr = ControlFlowTransformer()
     fdef.body = tr._visit_stmts(fdef.body)
     if tr.converted == 0:

@@ -173,20 +173,23 @@ class TestConvertForRange:
 
 
 class TestFallback:
-    def test_return_in_tensor_branch_falls_back(self):
+    def test_return_in_tensor_branch_now_compiles(self):
+        """r4: return-in-tensor-branch fell back to eager; r5's flag
+        lowering (TestReturnBreakContinueLowering) compiles it. A still-
+        unconvertible shape (yield) keeps the fallback contract."""
         @to_static
         def f(x):
-            if x.sum() > 0:     # return blocks conversion
+            if x.sum() > 0:
                 return x * 2
             return x - 1
 
         with warnings.catch_warnings(record=True) as rec:
             warnings.simplefilter("always")
             out = f(paddle.to_tensor([2.0]))
-        assert f._eager
-        assert any("falling back to eager" in str(r.message) for r in rec)
+        assert not f._eager
+        assert not any("falling back to eager" in str(r.message)
+                       for r in rec)
         np.testing.assert_allclose(out.numpy(), [4.0])
-        # subsequent calls run eagerly and stay correct
         np.testing.assert_allclose(f(paddle.to_tensor([-2.0])).numpy(),
                                    [-3.0])
 
@@ -271,3 +274,145 @@ class TestReviewRegressions:
             np.testing.assert_allclose(out.numpy(), [10.0])
         finally:
             _GLOBAL_SCALE = 2.0
+
+
+class TestReturnBreakContinueLowering:
+    """r5 (VERDICT r4 next #6): flag-variable rewriting of
+    return/break/continue — early return inside a tensor `if` and break/
+    continue inside a tensor `while` compile to lax control flow with NO
+    eager fallback."""
+
+    def _assert_compiled(self, f, *args):
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            out = f(*args)
+        assert not any("falling back to eager" in str(r.message)
+                       for r in rec), [str(r.message) for r in rec]
+        assert not f._eager
+        return out
+
+    def test_early_return_in_tensor_if(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            return x - 1
+
+        pos = self._assert_compiled(f, paddle.to_tensor([1.0, 2.0]))
+        np.testing.assert_allclose(pos.numpy(), [2.0, 4.0])
+        neg = f(paddle.to_tensor([-3.0, -4.0]))
+        np.testing.assert_allclose(neg.numpy(), [-4.0, -5.0])
+
+    def test_nested_early_returns(self):
+        @to_static
+        def f(x):
+            s = x.sum()
+            if s > 0:
+                if s > 10:
+                    return x * 100
+                return x * 2
+            return x - 1
+
+        np.testing.assert_allclose(
+            self._assert_compiled(
+                f, paddle.to_tensor([20.0])).numpy(), [2000.0])
+        np.testing.assert_allclose(f(paddle.to_tensor([1.0])).numpy(),
+                                   [2.0])
+        np.testing.assert_allclose(f(paddle.to_tensor([-1.0])).numpy(),
+                                   [-2.0])
+
+    def test_break_in_tensor_while(self):
+        def body(x):
+            i = x * 0
+            s = x * 0
+            while i < 10:
+                s = s + i
+                if s > 5:
+                    break
+                i = i + 1
+            return s, i
+
+        f = to_static(body)
+        x = paddle.to_tensor(1.0)
+        s, i = self._assert_compiled(f, x)
+        # eager ground truth
+        es, ei = body(x)
+        np.testing.assert_allclose(float(s), float(es))
+        np.testing.assert_allclose(float(i), float(ei))
+
+    def test_continue_in_tensor_while(self):
+        def body(x):
+            i = x * 0
+            s = x * 0
+            while i < 8:
+                i = i + 1
+                if i % 2 == 0:
+                    continue
+                s = s + i
+            return s
+
+        f = to_static(body)
+        x = paddle.to_tensor(1.0)
+        out = self._assert_compiled(f, x)
+        np.testing.assert_allclose(float(out), float(body(x)))  # 1+3+5+7
+
+    def test_return_inside_tensor_while(self):
+        def body(x):
+            i = x * 0
+            while i < 10:
+                if i > 3:
+                    return x * i
+                i = i + 1
+            return x
+
+        f = to_static(body)
+        x = paddle.to_tensor(2.0)
+        out = self._assert_compiled(f, x)
+        np.testing.assert_allclose(float(out), float(body(x)))
+
+    def test_statements_after_flag_are_gated(self):
+        @to_static
+        def f(x):
+            y = x * 0
+            if x.sum() > 0:
+                return x + 100
+            y = y + 1          # must NOT run when returning early
+            return x + y
+
+        np.testing.assert_allclose(
+            self._assert_compiled(
+                f, paddle.to_tensor([1.0])).numpy(), [101.0])
+        np.testing.assert_allclose(f(paddle.to_tensor([-1.0])).numpy(),
+                                   [0.0])
+
+    def test_mixed_bare_and_valued_returns_fall_back(self):
+        """A bare `return` mixed with valued returns cannot stage (the
+        two return structures differ); the lowering must refuse and the
+        eager fallback must preserve the None result."""
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return
+            return x - 1
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            out = f(paddle.to_tensor([2.0]))
+        assert out is None          # eager semantics preserved
+        np.testing.assert_allclose(f(paddle.to_tensor([-2.0])).numpy(),
+                                   [-3.0])
+
+    def test_loop_else_skipped_on_break(self):
+        @to_static
+        def f(x):
+            s = x * 0
+            for k in range(5):
+                s = s + 1
+                if s.sum() > 2:
+                    break
+            else:
+                s = s + 100
+            return s
+
+        out = f(paddle.to_tensor([0.0]))
+        np.testing.assert_allclose(out.numpy(), [3.0])
