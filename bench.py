@@ -294,10 +294,11 @@ R4_UNROLLED_13B = {
                "params": 1313722368, "recompute": True,
                "remat_policy": None, "bf16_moments": True},
     "provenance": "measured live on this chip 2026-07-31 (round 4) by "
-                  "this bench; reproduce: BENCH_MODEL=gpt3-1.3b python "
+                  "this bench with the UNROLLED step; reproduce: "
+                  "BENCH_FUSED_SCAN=0 BENCH_MODEL=gpt3-1.3b python "
                   "bench.py (~50 min wall — axon remote program-load "
-                  "dominates; steady-state step time is what the metric "
-                  "reports)",
+                  "dominates; the r5 default is the fused-scan step, "
+                  "which measures ~7% lower but runs in-window)",
     "vs_round3": "10409 tok/s / MFU 0.448 -> 12949 / 0.558 (+24%, "
                  "Mosaic-kernel in-jit fix, PERF.md)",
 }

@@ -24,12 +24,22 @@ def _free_port() -> int:
 class Master:
     def __init__(self, endpoint: str, rank: int, nnodes: int,
                  timeout: float = 300.0):
-        host, _, port = endpoint.partition(":")
         self.rank = rank
         self.nnodes = nnodes
-        self.store = TCPStore(host or "127.0.0.1", int(port or 8765),
-                              world_size=nnodes, is_master=(rank == 0),
-                              timeout=timeout)
+        if endpoint.startswith(("http://", "https://", "etcd://")):
+            # external KV rendezvous (reference ETCDMaster :186): the
+            # store outlives every node, so killing rank 0 mid-run does
+            # not take the control plane down — the fault-injection test
+            # in tests/test_store_launch.py proves the recovery
+            from ..kv import HttpKVStore
+
+            url = endpoint.replace("etcd://", "http://", 1)
+            self.store = HttpKVStore(url, timeout=timeout)
+        else:
+            host, _, port = endpoint.partition(":")
+            self.store = TCPStore(host or "127.0.0.1", int(port or 8765),
+                                  world_size=nnodes, is_master=(rank == 0),
+                                  timeout=timeout)
 
     def sync_peers(self, my_endpoint: str, gen: int = 0) -> list[str]:
         """Publish my endpoint; block until all nnodes registered; return

@@ -113,10 +113,27 @@ class GroupShardedStage3:
             if p.size * p._data.dtype.itemsize < self._segment_size:
                 continue  # small params stay replicated (reference keeps
                           # sub-segment params unsharded)
-            dim = _shardable_dim(p.shape, degree)
-            if dim is None:
-                continue
+            # COMPOSE with an existing placement (r5): a param already
+            # TP/EP-sharded on this mesh keeps those dims and gains the
+            # stage-3 axis on a FREE divisible dim — clobbering the mp
+            # placement would silently undo tensor parallelism
+            prev = getattr(p._data, "sharding", None)
             axes = [None] * p.ndim
+            if (isinstance(prev, NamedSharding) and prev.mesh == self._mesh
+                    and any(a is not None for a in prev.spec)):
+                spec = list(prev.spec) + [None] * (p.ndim - len(prev.spec))
+                if self._axis in spec:
+                    continue        # already sharded over our axis
+                dim = next((i for i in range(p.ndim)
+                            if spec[i] is None
+                            and p.shape[i] % degree == 0), None)
+                if dim is None:
+                    continue        # no free divisible dim: keep TP as-is
+                axes = spec
+            else:
+                dim = _shardable_dim(p.shape, degree)
+                if dim is None:
+                    continue
             axes[dim] = self._axis
             sharding = NamedSharding(self._mesh, P(*axes))
             if self._offload:

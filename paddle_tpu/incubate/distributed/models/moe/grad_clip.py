@@ -14,8 +14,9 @@ over all experts, each counted exactly once. A plain global-norm clip is
 therefore numerically identical to the reference's EP-aware clip; the
 proof is tests/test_moe.py::TestMoEGradClip (EP-sharded vs dense-
 equivalent norms and clipped grads agree). This class exists for API
-parity — code ported from the reference keeps working — and asserts the
-moe_group argument it is handed matches the subsumed semantics.
+parity — code ported from the reference keeps working; the
+is_expert_param_func/moe_group arguments are accepted and stored for
+signature compatibility but the norm math needs neither.
 """
 from __future__ import annotations
 

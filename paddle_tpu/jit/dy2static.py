@@ -550,30 +550,33 @@ class _FlagLower:
         if escape:
             inner_stop.append(_RET)
         # loop `else` runs iff the loop was NOT broken out of: with break
-        # lowered to a flag the loop always "completes", so the orelse
-        # must be gated on the flags (python for/while-else semantics;
-        # r5 review repro)
-        orelse = s.orelse
-        if orelse:
-            orelse, osets = self._block(orelse, outer_loop)
+        # lowered to a flag the loop always "completes", so the else
+        # block becomes a flag-gated statement AFTER the loop — emitted
+        # as plain statements (NOT as the loop's orelse: the main
+        # transformer never descends into a loop's orelse, so a gate
+        # left there would stay a python `if` over a traced flag — r5
+        # review repro)
+        post = []
+        if s.orelse:
+            orelse, osets = self._block(s.orelse, outer_loop)
             escape |= osets
-            if inner_stop:
-                orelse = [ast.If(test=_not_flags(sorted(inner_stop)),
-                                 body=orelse, orelse=[])]
+            post = ([ast.If(test=_not_flags(sorted(inner_stop)),
+                            body=orelse, orelse=[])]
+                    if inner_stop else orelse)
         if isinstance(s, ast.While):
             test = s.test
             if inner_stop:
                 test = ast.BoolOp(op=ast.And(), values=[
                     s.test, _not_flags(inner_stop)])
             return pre + [ast.While(test=test, body=body,
-                                    orelse=orelse)], escape
+                                    orelse=[])] + post, escape
         # for: gate the body on the stop flags instead of cutting the
         # iteration (see the deviation note in the section comment)
         if inner_stop:
             body = [ast.If(test=_not_flags(inner_stop), body=body,
                            orelse=[])]
         return pre + [ast.For(target=s.target, iter=s.iter, body=body,
-                              orelse=orelse)], escape
+                              orelse=[])] + post, escape
 
 
 def _make_branch_fn(name, carried, body):

@@ -946,3 +946,21 @@ class TestSpmdRulesDeepened:
                                       replicated_warn_elems=1_000_000)
         assert "blob" in report["replicated_large"]
         assert any("replicated" in str(r.message) for r in rec)
+
+    def test_bottleneck_up_projection_keeps_row_role(self):
+        """out == 2*in alone must not trigger the fused guard: an
+        H/2 -> H up-projection is a legitimate row-parallel second
+        Linear (r5 review)."""
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+            plan_layer_specs,
+        )
+
+        class Bottleneck(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = paddle.nn.Linear(32, 16)
+                self.fc2 = paddle.nn.Linear(16, 32)   # out == 2*in
+
+        plan = plan_layer_specs(Bottleneck(), tp_axis="mp")
+        assert plan["fc2.weight"] == ("mp", None)     # row-parallel
+        assert plan["fc1.weight"] == (None, "mp")

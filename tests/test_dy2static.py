@@ -403,6 +403,8 @@ class TestReturnBreakContinueLowering:
                                    [-3.0])
 
     def test_loop_else_skipped_on_break(self):
+        """The gated else must COMPILE (it is emitted after the loop as
+        plain statements the transformer converts), not fall back."""
         @to_static
         def f(x):
             s = x * 0
@@ -414,5 +416,20 @@ class TestReturnBreakContinueLowering:
                 s = s + 100
             return s
 
-        out = f(paddle.to_tensor([0.0]))
+        out = self._assert_compiled(f, paddle.to_tensor([0.0]))
         np.testing.assert_allclose(out.numpy(), [3.0])
+
+    def test_while_else_runs_without_break(self):
+        @to_static
+        def f(x):
+            i = x * 0
+            while i < 3:
+                i = i + 1
+                if i > 99:
+                    break
+            else:
+                i = i + 100
+            return i
+
+        out = self._assert_compiled(f, paddle.to_tensor(0.0))
+        np.testing.assert_allclose(float(out), 103.0)
