@@ -11,7 +11,12 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool",
+           "get_version"]
+
+
+def get_version():
+    return "paddle-tpu-inference (XLA)"
 
 
 class Config:
@@ -42,6 +47,22 @@ class Config:
     def set_cpu_math_library_num_threads(self, n):
         pass
 
+    def set_model(self, prefix, params_path=None):
+        """reference Config.set_model — late-bind the artifact path."""
+        self._model_path = prefix
+
+    def enable_shape_bucketing(self, buckets=(1, 2, 4, 8, 16, 32, 64)):
+        """TPU-first serving lever: XLA compiles one executable per input
+        shape, so free-form batch sizes each pay a compile. With
+        bucketing on, Predictor.run pads every input's dim 0 up to the
+        nearest bucket (and trims outputs back), bounding the number of
+        compiled programs to len(buckets)."""
+        self._buckets = tuple(sorted(int(b) for b in buckets))
+
+    def summary(self):
+        return (f"Config(model={self._model_path!r}, "
+                f"buckets={getattr(self, '_buckets', None)})")
+
 
 class _Handle:
     """Input/output handle (reference ZeroCopyTensor surface)."""
@@ -61,14 +82,18 @@ class _Handle:
 
 
 class Predictor:
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, _shared_layer=None):
         from ..jit import load as jit_load
 
-        if config.model_path() is None:
-            raise ValueError("Config needs the jit.save path prefix")
-        self._layer = jit_load(config.model_path())
+        if _shared_layer is not None:
+            self._layer = _shared_layer
+        else:
+            if config.model_path() is None:
+                raise ValueError("Config needs the jit.save path prefix")
+            self._layer = jit_load(config.model_path())
         self._inputs = {}
         self._outputs = []
+        self._buckets = getattr(config, "_buckets", None)
 
     def get_input_names(self):
         # arity from the saved artifact (jit.save records it), so the
@@ -99,15 +124,51 @@ class Predictor:
             digits = "".join(c for c in name if c.isdigit())
             return (int(digits) if digits else 0, name)
 
-        args = [paddle.to_tensor(h._value)
-                for _, h in sorted(self._inputs.items(), key=_key)]
+        raw = [h._value
+               for _, h in sorted(self._inputs.items(), key=_key)]
+        true_b = bucket = None
+        if self._buckets and raw and raw[0].ndim > 0:
+            true_b = raw[0].shape[0]
+            bucket = next((b for b in self._buckets if b >= true_b),
+                          None)
+            if bucket is not None and bucket != true_b:
+                raw = [np.concatenate(
+                    [a, np.zeros((bucket - true_b,) + a.shape[1:],
+                                 a.dtype)], 0)
+                    if a.ndim > 0 and a.shape[0] == true_b else a
+                    for a in raw]
+            else:
+                true_b = bucket = None  # exact fit / over largest: as-is
+        args = [paddle.to_tensor(a) for a in raw]
         out = self._layer(*args)
         outs = out if isinstance(out, (list, tuple)) else [out]
         for i, o in enumerate(outs):
             while len(self._outputs) <= i:
                 self._outputs.append(_Handle())
-            self._outputs[i]._value = np.asarray(o._data)
+            val = np.asarray(o._data)
+            # trim ONLY outputs whose leading dim is exactly the padded
+            # bucket (an output whose dim 0 is not batch stays whole)
+            if true_b is not None and val.ndim > 0 \
+                    and val.shape[0] == bucket:
+                val = val[:true_b]
+            self._outputs[i]._value = val
         return True
+
+
+class PredictorPool:
+    """reference paddle.inference.PredictorPool: N predictors sharing
+    ONE loaded artifact (one deserialization, one on-device weight copy,
+    one compiled executable — per-predictor state is just the I/O
+    handles)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        first = Predictor(config)
+        self._predictors = [first] + [
+            Predictor(config, _shared_layer=first._layer)
+            for _ in range(size - 1)]
+
+    def retrieve(self, idx):
+        return self._predictors[idx]
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -212,7 +212,11 @@ def save(layer, path, input_spec=None, **config):
     portable StableHLO artifact via jax.export, so `jit.load` can run it
     WITHOUT the model class (the reference's TranslatedLayer contract).
 
-    input_spec: list of example Tensors/arrays (shape+dtype carriers).
+    input_spec: list of example Tensors/arrays (shape+dtype carriers) OR
+    InputSpec objects; an InputSpec dim of None/-1 exports that axis
+    SHAPE-POLYMORPHICALLY (jax.export symbolic dims), so the loaded
+    servable accepts any size there — the reference's None-batch
+    InputSpec contract (r5).
     """
     from ..framework import io as fio
     from ..framework.tensor import Tensor
@@ -231,8 +235,38 @@ def save(layer, path, input_spec=None, **config):
     named_all = layer.state_dict()
     params = [named_p[k] for k in sd_keys if k in named_p]
     buffers = [named_all[k] for k in sd_keys if k not in named_p]
-    examples = [s._data if isinstance(s, Tensor) else jnp.asarray(s)
-                for s in input_spec]
+
+    from ..framework.dtype import to_jax_dtype
+
+    scope = jexport.SymbolicScope()
+    examples = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, Tensor):
+            examples.append(s._data)
+        elif hasattr(s, "shape") and hasattr(s, "dtype") \
+                and not hasattr(s, "__array__"):
+            dims = list(s.shape)
+            if any(d is None or (isinstance(d, int) and d < 0)
+                   for d in dims):
+                # dim-0 None axes SHARE one symbol across inputs (the
+                # reference None-batch contract: x and y batch dims are
+                # the same variable, so x + y traces); other None dims
+                # get per-position symbols
+                def _dim(j, d):
+                    if d is None or (isinstance(d, int) and d < 0):
+                        return "_b" if j == 0 else f"_spec{i}d{j}"
+                    return str(int(d))
+
+                shp = jexport.symbolic_shape(
+                    ", ".join(_dim(j, d) for j, d in enumerate(dims)),
+                    scope=scope)
+                examples.append(jax.ShapeDtypeStruct(
+                    shp, to_jax_dtype(s.dtype)))
+            else:
+                examples.append(jax.ShapeDtypeStruct(
+                    tuple(int(d) for d in dims), to_jax_dtype(s.dtype)))
+        else:
+            examples.append(jnp.asarray(s))
 
     def pure(param_datas, buffer_datas, *xs):
         saved_p = [p._data for p in params]

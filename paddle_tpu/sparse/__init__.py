@@ -31,6 +31,7 @@ __all__ = [
     "neg", "deg2rad", "rad2deg", "expm1", "isnan", "coalesce", "sum",
     "transpose", "reshape", "add", "subtract", "multiply", "divide",
     "matmul", "mv", "masked_matmul", "addmm", "mask_as", "is_same_shape",
+    "slice", "pca_lowrank",
 ]
 
 
@@ -544,3 +545,62 @@ def _tensor_to_sparse_csr(self):
 
 Tensor.to_sparse_coo = _tensor_to_sparse_coo
 Tensor.to_sparse_csr = _tensor_to_sparse_csr
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Sparse slice (reference sparse/unary.py:1017 — sparse_slice
+    kernels): keep entries whose coordinates fall inside
+    [start, end) per sliced axis, shifting indices by the starts.
+    Pattern-changing → eager-only, like construction (module docstring).
+    Negative starts/ends wrap per dense-slice semantics."""
+    import builtins
+
+    coo = x if x.is_sparse_coo() else x.to_sparse_coo()
+    idx = np.asarray(coo._indices)
+    vals = np.asarray(coo._values)
+    shape = builtins.list(coo.shape)
+    axes = [int(a) for a in np.asarray(axes).reshape(-1)]
+    starts = [int(s) for s in np.asarray(starts).reshape(-1)]
+    ends = [int(e) for e in np.asarray(ends).reshape(-1)]
+    keep = np.ones(idx.shape[1], bool)
+    new_shape = builtins.list(shape)
+    for a in axes:
+        if a >= coo.sparse_dim():
+            raise NotImplementedError(
+                f"sparse.slice over dense (hybrid) dim {a} is not "
+                f"supported (sparse_dim={coo.sparse_dim()})")
+    for a, s, e in zip(axes, starts, ends):
+        dim = shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        e = max(e, s)
+        keep &= (idx[a] >= s) & (idx[a] < e)
+        new_shape[a] = e - s
+    idx = idx[:, keep]
+    vals = vals[keep]
+    for a, s, e in zip(axes, starts, ends):
+        dim = shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        idx[a] = idx[a] - s
+    out = SparseCooTensor(jnp.asarray(idx), jnp.asarray(vals),
+                          tuple(new_shape), coalesced=coo._coalesced)
+    return out if x.is_sparse_coo() else out.to_sparse_csr()
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """PCA of a sparse matrix (reference sparse/multiary.py pca_lowrank):
+    returns (U, S, V) with x ~ U diag(S) V^T. The factorization itself
+    is dense linear algebra (the reference calls svd_lowrank on a dense
+    product too); the sparse input is densified once — at the static-nnz
+    scales this backend targets that is the honest formulation."""
+    d = x.to_dense() if hasattr(x, "to_dense") else x
+    a = d._data if isinstance(d, Tensor) else jnp.asarray(d)
+    m, n = a.shape
+    if q is None:
+        q = min(6, m, n)
+    a = a.astype(jnp.float32)
+    if center:
+        a = a - jnp.mean(a, axis=0, keepdims=True)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return (Tensor._wrap(u[:, :q]), Tensor._wrap(s[:q]),
+            Tensor._wrap(vt[:q].T))

@@ -244,3 +244,45 @@ def test_float64_initializer_precision():
     assert arr.dtype == np.float64
     # float64 draws are float32-representable only with prob ~0
     assert np.any(arr != arr.astype(np.float32).astype(np.float64))
+
+
+class TestSparseSliceAndPCA:
+    """r5: the last two reference sparse.__all__ entries — slice and
+    pca_lowrank."""
+
+    def test_slice_coo_matches_dense(self):
+        import paddle_tpu.sparse as sp
+
+        d = np.zeros((4, 6), np.float32)
+        d[0, 1] = 1.0
+        d[2, 3] = 2.0
+        d[3, 5] = 3.0
+        t = paddle.to_tensor(d)
+        coo = t.to_sparse_coo(2)
+        out = sp.slice(coo, axes=[0, 1], starts=[1, 2], ends=[4, 6])
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data),
+                                   d[1:4, 2:6])
+
+    def test_slice_csr_and_negative_bounds(self):
+        import paddle_tpu.sparse as sp
+
+        d = np.arange(12, dtype=np.float32).reshape(3, 4)
+        d[d % 3 != 0] = 0.0
+        csr = paddle.to_tensor(d).to_sparse_csr()
+        out = sp.slice(csr, axes=[1], starts=[-3], ends=[4])
+        assert out.is_sparse_csr()
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data),
+                                   d[:, -3:])
+
+    def test_pca_lowrank_reconstructs(self):
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.default_rng(0)
+        base = rng.standard_normal((8, 2)) @ rng.standard_normal((2, 5))
+        d = base.astype(np.float32)
+        d[:, [1, 3]] = 0.0      # sparse-ish but still rank <= 2
+        coo = paddle.to_tensor(d).to_sparse_coo(2)
+        u, s, v = sp.pca_lowrank(coo, q=4, center=False)
+        rec = (np.asarray(u._data) * np.asarray(s._data)) \
+            @ np.asarray(v._data).T
+        np.testing.assert_allclose(rec, d, atol=1e-4)
