@@ -1,0 +1,145 @@
+"""ShuffleNetV2 (Ma et al., 2018). Reference parity surface:
+python/paddle/vision/models/shufflenetv2.py; architecture from the
+paper — channel split + shuffle units, stride-2 downsample units."""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+
+
+def _shuffle(x, groups=2):
+    return F.channel_shuffle(x, groups)
+
+
+def _act(name):
+    return {"relu": nn.ReLU, "swish": nn.Swish}[name]()
+
+
+class _Unit(nn.Layer):
+    """Stride-1 unit: split channels, transform one half, concat+shuffle."""
+
+    def __init__(self, c, act="relu"):
+        super().__init__()
+        half = c // 2
+        self.branch = nn.Sequential(
+            nn.Conv2D(half, half, 1, bias_attr=False),
+            nn.BatchNorm2D(half), _act(act),
+            nn.Conv2D(half, half, 3, padding=1, groups=half,
+                      bias_attr=False),
+            nn.BatchNorm2D(half),
+            nn.Conv2D(half, half, 1, bias_attr=False),
+            nn.BatchNorm2D(half), _act(act),
+        )
+        self._half = half
+
+    def forward(self, x):
+        from ... import ops
+
+        x1 = x[:, :self._half]
+        x2 = x[:, self._half:]
+        out = ops.concat([x1, self.branch(x2)], axis=1)
+        return _shuffle(out)
+
+
+class _DownUnit(nn.Layer):
+    """Stride-2 unit: both branches transform, output channels double."""
+
+    def __init__(self, inp, out, act="relu"):
+        super().__init__()
+        half = out // 2
+        self.b1 = nn.Sequential(
+            nn.Conv2D(inp, inp, 3, stride=2, padding=1, groups=inp,
+                      bias_attr=False),
+            nn.BatchNorm2D(inp),
+            nn.Conv2D(inp, half, 1, bias_attr=False),
+            nn.BatchNorm2D(half), _act(act),
+        )
+        self.b2 = nn.Sequential(
+            nn.Conv2D(inp, half, 1, bias_attr=False),
+            nn.BatchNorm2D(half), _act(act),
+            nn.Conv2D(half, half, 3, stride=2, padding=1, groups=half,
+                      bias_attr=False),
+            nn.BatchNorm2D(half),
+            nn.Conv2D(half, half, 1, bias_attr=False),
+            nn.BatchNorm2D(half), _act(act),
+        )
+
+    def forward(self, x):
+        from ... import ops
+
+        return _shuffle(ops.concat([self.b1(x), self.b2(x)], axis=1))
+
+
+_STAGE_OUT = {
+    0.25: (24, 48, 96, 512), 0.33: (32, 64, 128, 512),
+    0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+    1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048),
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"unsupported scale {scale}")
+        if act not in ("relu", "swish"):
+            raise ValueError(f"unsupported act {act!r}")
+        c1, c2, c3, c_last = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(24), _act(act))
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = 24
+        for c, reps in ((c1, 4), (c2, 8), (c3, 4)):
+            units = [_DownUnit(inp, c, act)]
+            units += [_Unit(c, act) for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            inp = c
+        self.stage2, self.stage3, self.stage4 = stages
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(inp, c_last, 1, bias_attr=False),
+            nn.BatchNorm2D(c_last), _act(act))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.stage4(self.stage3(self.stage2(x)))
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _factory(scale):
+    def make(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError(
+                "pretrained weights need egress; load a state_dict "
+                "instead")
+        return ShuffleNetV2(scale=scale, **kwargs)
+
+    return make
+
+
+shufflenet_v2_x0_25 = _factory(0.25)
+shufflenet_v2_x0_33 = _factory(0.33)
+shufflenet_v2_x0_5 = _factory(0.5)
+shufflenet_v2_x1_0 = _factory(1.0)
+shufflenet_v2_x1_5 = _factory(1.5)
+shufflenet_v2_x2_0 = _factory(2.0)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights need egress; load a state_dict instead")
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

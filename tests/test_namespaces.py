@@ -178,8 +178,13 @@ class TestShims:
             with paddle.static.name_scope("blk"):
                 pass
         assert paddle.static.default_main_program().random_seed == 0
-        with pytest.raises(RuntimeError, match="TrainStep"):
-            paddle.static.Executor()
+        # r5: Executor is functional over captured programs
+        # (test_static_exec.py); a body-less startup run is a no-op and
+        # fetching from a body-less program raises with guidance
+        exe = paddle.static.Executor()
+        assert exe.run(paddle.static.default_startup_program()) == []
+        with pytest.raises(RuntimeError, match="from_function"):
+            exe.run(fetch_list=["loss"])
 
     def test_regularizer_flows_into_optimizer(self):
         import paddle_tpu.nn as nn

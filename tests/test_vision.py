@@ -136,3 +136,40 @@ class TestConfig1LeNetModel:
         finally:
             denv._state["initialized"] = False
             denv._state["mesh"] = None
+
+
+class TestNewModelFamilies:
+    """r5: AlexNet / SqueezeNet / ShuffleNetV2 — forward shapes + grad
+    flow at small input."""
+
+    def _check(self, model, size=64, out_dim=10):
+        import numpy as np
+
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal(
+                (2, 3, size, size)).astype(np.float32))
+        y = model(x)
+        assert tuple(y.shape) == (2, out_dim), y.shape
+        loss = (y * y).mean()
+        loss.backward()
+        grads = [p.grad for p in model.parameters() if p.trainable]
+        assert any(g is not None for g in grads)
+
+    def test_alexnet(self):
+        from paddle_tpu.vision.models import alexnet
+
+        self._check(alexnet(num_classes=10), size=96)
+
+    def test_squeezenet_both_versions(self):
+        from paddle_tpu.vision.models import squeezenet1_0, squeezenet1_1
+
+        self._check(squeezenet1_0(num_classes=10), size=96)
+        m = squeezenet1_1(num_classes=10)
+        import numpy as np
+        x = paddle.to_tensor(np.zeros((1, 3, 96, 96), np.float32))
+        assert tuple(m(x).shape) == (1, 10)
+
+    def test_shufflenetv2(self):
+        from paddle_tpu.vision.models import shufflenet_v2_x0_5
+
+        self._check(shufflenet_v2_x0_5(num_classes=10), size=64)
