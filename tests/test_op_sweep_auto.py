@@ -764,6 +764,237 @@ S("temporal_shift", lambda x: F.temporal_shift(x, 2, 0.25),
       x.reshape(1, 2, 4, 2, 2)[:, :, 2:]], 2).reshape(2, 4, 2, 2),
   _std(shape=(2, 4, 2, 2)), grad=None)
 
+
+
+# --------------------------------------------------------------------------
+# batch 2 (r5): scatter/index family, windows, second-tier losses, linalg
+# tails — pushes the sweep past 300 named ops
+# --------------------------------------------------------------------------
+S("put_along_axis",
+  lambda x, i, v: paddle.put_along_axis(x, i, v, axis=1),
+  lambda x, i, v: (lambda y: (np.put_along_axis(y, i, v, 1), y)[1])(
+      x.copy()),
+  lambda rng: [rng.standard_normal((3, 5)).astype("float32"),
+               rng.integers(0, 5, (3, 2)).astype("int64"),
+               rng.standard_normal((3, 2)).astype("float32")],
+  grad=None)
+S("scatter_overwrite",
+  lambda x, i, u: paddle.scatter(x, i, u),
+  lambda x, i, u: (lambda y: (y.__setitem__(i, u), y)[1])(x.copy()),
+  lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
+               np.asarray([0, 2, 4], np.int64),
+               rng.standard_normal((3, 3)).astype("float32")],
+  grad=None)
+S("scatter_nd_add",
+  lambda x, i, u: paddle.scatter_nd_add(x, i, u),
+  lambda x, i, u: (lambda y: (np.add.at(y, tuple(i.T), u), y)[1])(
+      x.copy()),
+  lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
+               rng.integers(0, 5, (4, 1)).astype("int64"),
+               rng.standard_normal((4, 3)).astype("float32")],
+  grad=None)
+S("index_add",
+  lambda x, i, v: paddle.index_add(x, i, 0, v),
+  lambda x, i, v: (lambda y: (np.add.at(y, i, v), y)[1])(x.copy()),
+  lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
+               np.asarray([0, 2, 2], np.int64),
+               rng.standard_normal((3, 3)).astype("float32")],
+  grad=None)
+S("masked_fill",
+  lambda x, m: paddle.masked_fill(x, m, 7.5),
+  lambda x, m: np.where(m, 7.5, x), 
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.uniform(size=(3, 4)) > 0.5], grad=None)
+S("masked_scatter",
+  lambda x, m, v: paddle.masked_scatter(x, m, v),
+  lambda x, m, v: (lambda y: (y.__setitem__(m, v[:m.sum()]), y)[1])(
+      x.copy()),
+  lambda rng: [np.zeros((3, 4), np.float32),
+               np.tile(np.asarray([True, False, True, False]), (3, 1)),
+               np.arange(12, dtype=np.float32)], grad=None)
+S("index_fill",
+  lambda x, i: paddle.index_fill(x, i, 0, -1.0),
+  lambda x, i: (lambda y: (y.__setitem__(i, -1.0), y)[1])(x.copy()),
+  lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
+               np.asarray([1, 3], np.int64)], grad=None)
+S("take", lambda x, i: paddle.take(x, i),
+  lambda x, i: x.reshape(-1)[i],
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.integers(0, 12, (5,)).astype("int64")], grad=None)
+S("renorm", lambda x: paddle.renorm(x, 2.0, 0, 1.0),
+  lambda x: x * np.minimum(
+      1.0, 1.0 / np.maximum(
+          np.sqrt((x ** 2).sum(axis=(1,), keepdims=True)), 1e-7)),
+  _std(shape=(3, 4)), grad=None,
+  tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+S("diff", lambda x: paddle.diff(x, axis=1),
+  lambda x: np.diff(x, axis=1), _std())
+S("trapezoid", lambda y: paddle.trapezoid(y, dx=0.5, axis=1),
+  lambda y: np.trapezoid(y, dx=0.5, axis=1)
+  if hasattr(np, "trapezoid") else np.trapz(y, dx=0.5, axis=1), _std())
+S("cumulative_trapezoid",
+  lambda y: paddle.cumulative_trapezoid(y, dx=1.0, axis=1),
+  lambda y: (lambda c: c)(np.cumsum(
+      (y[:, 1:] + y[:, :-1]) / 2.0, axis=1)), _std())
+S("vander", lambda x: paddle.vander(x, 4),
+  lambda x: np.vander(x, 4, increasing=False),
+  lambda rng: [rng.standard_normal(5).astype("float32")], grad=None)
+S("unflatten", lambda x: paddle.unflatten(x, 1, [2, 2]),
+  lambda x: x.reshape(3, 2, 2), _std(shape=(3, 4)))
+S("as_complex_real_roundtrip",
+  lambda x: paddle.as_real(paddle.as_complex(x)),
+  lambda x: x, _std(shape=(3, 4, 2)), grad=None)
+S("cholesky_solve",
+  lambda b, l: paddle.cholesky_solve(b, l, upper=False),
+  lambda b, l: np.linalg.solve(l @ l.T, b),
+  lambda rng: [rng.standard_normal((3, 2)).astype("float32"),
+               (lambda a: np.linalg.cholesky(
+                   a @ a.T + 3 * np.eye(3)).astype("float32"))(
+                   rng.standard_normal((3, 3)))],
+  dtypes=("float32",), grad=None,
+  tols={"float32": dict(rtol=1e-4, atol=1e-4)})
+S("cov", lambda x: paddle.cov(x),
+  lambda x: np.cov(x), _std(shape=(3, 6)), dtypes=("float32",),
+  grad=None, tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+S("corrcoef", lambda x: paddle.corrcoef(x),
+  lambda x: np.corrcoef(x), _std(shape=(3, 6)), dtypes=("float32",),
+  grad=None, tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+S("nanmedian", lambda x: paddle.nanmedian(x, axis=1),
+  lambda x: np.nanmedian(x, 1),
+  lambda rng: [np.asarray([[1.0, np.nan, 3.0, 2.0],
+                           [5.0, 4.0, np.nan, np.nan]], np.float32)],
+  grad=None)
+S("frexp", lambda x: paddle.frexp(x),
+  lambda x: list(np.frexp(x)), _pos(), grad=None)
+S("signbit", lambda x: paddle.signbit(x), np.signbit, _std(),
+  grad=None)
+S("isneginf", lambda x: paddle.isneginf(x), np.isneginf,
+  lambda rng: [np.asarray([[1.0, -np.inf, np.inf]], np.float32)],
+  grad=None)
+S("isposinf", lambda x: paddle.isposinf(x), np.isposinf,
+  lambda rng: [np.asarray([[1.0, -np.inf, np.inf]], np.float32)],
+  grad=None)
+S("lerp", lambda x, y: paddle.lerp(x, y, 0.3),
+  lambda x, y: x + 0.3 * (y - x), _std(n=2), grad=(0, 1))
+S("bitwise_left_shift",
+  lambda x, y: paddle.bitwise_left_shift(x, y), np.left_shift,
+  lambda rng: [rng.integers(0, 8, (3, 4)).astype("int32"),
+               rng.integers(0, 4, (3, 4)).astype("int32")], grad=None)
+S("bitwise_right_shift",
+  lambda x, y: paddle.bitwise_right_shift(x, y), np.right_shift,
+  lambda rng: [rng.integers(0, 64, (3, 4)).astype("int32"),
+               rng.integers(0, 4, (3, 4)).astype("int32")], grad=None)
+S("tensordot", lambda x, y: paddle.tensordot(x, y, axes=1),
+  lambda x, y: np.tensordot(x, y, axes=1),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.standard_normal((4, 5)).astype("float32")],
+  grad=(0, 1))
+S("block_diag", lambda x, y: paddle.block_diag([x, y]),
+  lambda x, y: __import__("scipy.linalg", fromlist=["block_diag"])
+  .block_diag(x, y), _std(shape=(2, 3), n=2), grad=None)
+S("column_stack", lambda x, y: paddle.column_stack([x, y]),
+  lambda x, y: np.column_stack([x, y]), _std(n=2), grad=None)
+S("row_stack", lambda x, y: paddle.row_stack([x, y]),
+  lambda x, y: np.vstack([x, y]), _std(n=2), grad=None)
+S("tensor_split", lambda x: paddle.tensor_split(x, 3, axis=1),
+  lambda x: np.array_split(x, 3, axis=1), _std(shape=(2, 7)),
+  grad=None)
+S("hsplit", lambda x: paddle.hsplit(x, 2),
+  lambda x: np.hsplit(x, 2), _std(shape=(2, 6)), grad=None)
+S("vsplit", lambda x: paddle.vsplit(x, 2),
+  lambda x: np.vsplit(x, 2), _std(shape=(4, 3)), grad=None)
+S("gammainc", lambda x, y: paddle.gammainc(x, y),
+  lambda x, y: sps.gammainc(x, y),
+  lambda rng: [rng.uniform(0.5, 3, (3, 4)).astype("float32"),
+               rng.uniform(0.5, 3, (3, 4)).astype("float32")],
+  grad=None)
+S("gammaincc", lambda x, y: paddle.gammaincc(x, y),
+  lambda x, y: sps.gammaincc(x, y),
+  lambda rng: [rng.uniform(0.5, 3, (3, 4)).astype("float32"),
+               rng.uniform(0.5, 3, (3, 4)).astype("float32")],
+  grad=None)
+S("cartesian_prod", lambda x, y: paddle.cartesian_prod([x, y]),
+  lambda x, y: np.stack(np.meshgrid(x, y, indexing="ij"),
+                        -1).reshape(-1, 2),
+  lambda rng: [rng.standard_normal(3).astype("float32"),
+               rng.standard_normal(2).astype("float32")], grad=None)
+S("margin_ranking_loss",
+  lambda a, b, y: F.margin_ranking_loss(a, b, y),
+  lambda a, b, y: np.asarray(np.maximum(0, -y * (a - b)).mean()),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.standard_normal((3, 4)).astype("float32"),
+               np.where(rng.uniform(size=(3, 4)) > 0.5, 1.0, -1.0)
+               .astype("float32")], grad=(0, 1))
+S("soft_margin_loss",
+  lambda x, y: F.soft_margin_loss(x, y),
+  lambda x, y: np.asarray(np.log1p(np.exp(-y * x)).mean()),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               np.where(rng.uniform(size=(3, 4)) > 0.5, 1.0, -1.0)
+               .astype("float32")], grad=(0,))
+S("square_error_cost",
+  lambda x, y: F.square_error_cost(x, y),
+  lambda x, y: (x - y) ** 2, _std(n=2), grad=(0, 1))
+S("log_loss", lambda x, y: F.log_loss(x, y),
+  lambda x, y: -(y * np.log(x + 1e-4)
+                 + (1 - y) * np.log(1 - x + 1e-4)),
+  lambda rng: [rng.uniform(0.1, 0.9, (3, 1)).astype("float32"),
+               (rng.uniform(size=(3, 1)) > 0.5).astype("float32")],
+  grad=(0,))
+S("label_smooth", lambda x: F.label_smooth(x, epsilon=0.1),
+  lambda x: x * 0.9 + 0.1 / x.shape[-1],
+  lambda rng: [np.eye(4, dtype=np.float32)[
+      rng.integers(0, 4, (3,))]], grad=None)
+S("poisson_nll_loss",
+  lambda x, y: F.poisson_nll_loss(x, y, log_input=True, full=False),
+  lambda x, y: np.asarray((np.exp(x) - y * x).mean()),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.poisson(2.0, (3, 4)).astype("float32")], grad=(0,))
+S("gaussian_nll_loss",
+  lambda x, y, v: F.gaussian_nll_loss(x, y, v, full=False,
+                                      epsilon=1e-6),
+  lambda x, y, v: np.asarray(
+      0.5 * (np.log(np.maximum(v, 1e-6))
+             + (x - y) ** 2 / np.maximum(v, 1e-6)).mean()),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.standard_normal((3, 4)).astype("float32"),
+               rng.uniform(0.5, 2.0, (3, 4)).astype("float32")],
+  grad=None)
+S("multi_label_soft_margin",
+  lambda x, y: F.multi_label_soft_margin_loss(x, y),
+  lambda x, y: np.asarray(
+      -(y * np.log(sps.expit(x)) + (1 - y)
+        * np.log(sps.expit(-x))).mean(-1).mean()),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               (rng.uniform(size=(3, 4)) > 0.5).astype("float32")],
+  grad=(0,))
+S("npair_loss",
+  lambda a, p, l: F.npair_loss(a, p, l, l2_reg=0.0),
+  lambda a, p, l: np.asarray(
+      np.mean([sps.logsumexp(
+          np.concatenate([[0.0],
+                          (a[i] @ p.T)[np.arange(len(l)) != i]
+                          - a[i] @ p[i]]))
+          for i in range(len(l))])),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32") * 0.3,
+               rng.standard_normal((3, 4)).astype("float32") * 0.3,
+               np.arange(3).astype("int64")], grad=None,
+  tols={"float32": dict(rtol=1e-3, atol=1e-4)})
+S("local_response_norm",
+  lambda x: F.local_response_norm(x, size=3, alpha=1e-4, beta=0.75,
+                                  k=1.0),
+  lambda x: x / (1.0 + (1e-4 / 3) * np.stack([
+      (x ** 2)[:, max(0, c - 1):c + 2].sum(1)
+      for c in range(x.shape[1])], 1)) ** 0.75,
+  _std(shape=(2, 4, 3, 3)), grad=None,
+  tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+S("zeropad2d", lambda x: F.zeropad2d(x, [1, 2, 0, 1]),
+  lambda x: np.pad(x, ((0, 0), (0, 0), (0, 1), (1, 2))),
+  _std(shape=(1, 2, 3, 3)), grad=None)
+S("alpha_dropout_eval",
+  lambda x: F.alpha_dropout(x, 0.5, training=False),
+  lambda x: x, _std())
+
+
 SKIPPED = {
     "conv2d": "covered by dedicated shape/grad tests (test_ops.py)",
     "rnn/lstm/gru": "stateful multi-output recurrent API (test_nn.py)",
@@ -800,4 +1031,4 @@ def test_op_sweep(spec):
 
 def test_sweep_count():
     """The audit promises broad numeric coverage: keep the sweep large."""
-    assert len(SPECS) >= 210, len(SPECS)
+    assert len(SPECS) >= 300, len(SPECS)
