@@ -27,6 +27,7 @@ __all__ = [
     "Exponential", "Gamma", "Geometric", "Gumbel", "Independent", "Laplace",
     "LogNormal", "Multinomial", "MultivariateNormal", "Poisson", "StudentT",
     "TransformedDistribution", "kl_divergence", "register_kl",
+    "ContinuousBernoulli", "LKJCholesky",
 ]
 
 _HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
@@ -774,6 +775,165 @@ class Multinomial(Distribution):
         return _op(f, self.probs, value)
 
 
+class ContinuousBernoulli(ExponentialFamily):
+    """Reference distribution/continuous_bernoulli.py (Loaiza-Ganem &
+    Cunningham 2019): support (0, 1), density C(l) l^x (1-l)^(1-x) with
+    C(l) = 2 atanh(1-2l)/(1-2l) (-> 2 at l=1/2). Sampling by the
+    closed-form inverse CDF (reparameterizable)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = ensure_tensor(probs, dtype="float32")
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _stable_l(self, l):
+        lo, hi = self._lims
+        near = (l > lo) & (l < hi)
+        return jnp.where(near, lo, l), near
+
+    def _log_norm(self, l):
+        ls, near = self._stable_l(l)
+        c = 2.0 * jnp.arctanh(1.0 - 2.0 * ls) / (1.0 - 2.0 * ls)
+        # Taylor at l=1/2: C ~= 2 + (1-2l)^2 * 2/3
+        t = 2.0 + (1.0 - 2.0 * l) ** 2 * (2.0 / 3.0)
+        return jnp.log(jnp.where(near, t, c))
+
+    @property
+    def mean(self):
+        def f(l):
+            ls, near = self._stable_l(l)
+            m = ls / (2.0 * ls - 1.0) \
+                + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ls))
+            # Taylor at 1/2: 1/2 + (l - 1/2)/3
+            return jnp.where(near, 0.5 + (l - 0.5) / 3.0, m)
+
+        return _op(f, self.probs)
+
+    @property
+    def variance(self):
+        # var = E[x^2]-mean^2; use the paper's closed form via mean
+        def f(l):
+            ls, near = self._stable_l(l)
+            m = ls / (2.0 * ls - 1.0) \
+                + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ls))
+            v = ls * (ls - 1.0) / (1.0 - 2.0 * ls) ** 2 \
+                + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ls)) ** 2
+            return jnp.where(near, 1.0 / 12.0 - (l - 0.5) ** 2 / 15.0, v)
+
+        return _op(f, self.probs)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        ext = self._extend(shape)
+
+        def f(l):
+            u = jax.random.uniform(key, ext, minval=1e-6, maxval=1 - 1e-6)
+            ls, near = self._stable_l(l)
+            x = (jnp.log1p((2.0 * ls - 1.0) * u / (1.0 - ls))
+                 / (jnp.log(ls) - jnp.log1p(-ls)))
+            return jnp.where(near, u, x)
+
+        return _op(f, self.probs)
+
+    def log_prob(self, value):
+        return _op(lambda l, x: x * jnp.log(l) + (1 - x) * jnp.log1p(-l)
+                   + self._log_norm(l), self.probs, value)
+
+    def cdf(self, value):
+        def f(l, x):
+            ls, near = self._stable_l(l)
+            c = (ls ** x * (1 - ls) ** (1 - x) + ls - 1.0) \
+                / (2.0 * ls - 1.0)
+            return jnp.clip(jnp.where(near, x, c), 0.0, 1.0)
+
+        return _op(f, self.probs, value)
+
+    def entropy(self):
+        m = self.mean
+        return _op(lambda l, mm: -(self._log_norm(l) + mm * jnp.log(l)
+                                   + (1 - mm) * jnp.log1p(-l)),
+                   self.probs, m)
+
+    def icdf(self, value):
+        def f(l, u):
+            ls, near = self._stable_l(l)
+            x = (jnp.log1p((2.0 * ls - 1.0) * u / (1.0 - ls))
+                 / (jnp.log(ls) - jnp.log1p(-ls)))
+            return jnp.where(near, u, x)
+
+        return _op(f, self.probs, value)
+
+
+class LKJCholesky(Distribution):
+    """Reference distribution/lkj_cholesky.py — Cholesky factors of LKJ-
+    distributed correlation matrices. Onion-method sampling (one Beta
+    draw + one hypersphere direction per row) and the Stan-manual
+    density over Cholesky factors:
+    log p(L) = sum_i (2(eta-1) + d - i) log L_ii - log Z(d, eta).
+    Numerics verified against torch.distributions.LKJCholesky
+    (tests/test_distribution.py)."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError("LKJCholesky needs dim >= 2")
+        if sample_method == "cvine":
+            raise NotImplementedError(
+                "cvine sampling is not implemented; LKJCholesky samples "
+                "with the onion method (identical distribution, "
+                "different trajectories)")
+        if sample_method != "onion":
+            raise ValueError(f"unknown sample_method {sample_method!r}")
+        self.dim = int(dim)
+        self.concentration = ensure_tensor(concentration,
+                                           dtype="float32")
+        super().__init__(tuple(self.concentration.shape),
+                         (self.dim, self.dim))
+
+    def rsample(self, shape=()):
+        key = next_key()
+        k1, k2 = jax.random.split(key)
+        d = self.dim
+        batch = tuple(shape) + self._batch_shape
+
+        def f(conc):
+            marginal = conc + 0.5 * (d - 2)
+            off = jnp.concatenate([jnp.zeros(1),
+                                   jnp.arange(d - 1, dtype=jnp.float32)])
+            a = off + 0.5
+            b = marginal[..., None] - 0.5 * off
+            y = jax.random.beta(k1, jnp.broadcast_to(a, batch + (d,)),
+                                jnp.broadcast_to(b, batch + (d,)))
+            u = jax.random.normal(k2, batch + (d, d))
+            u = jnp.tril(u, -1)
+            norm = jnp.linalg.norm(u, axis=-1, keepdims=True)
+            u_sphere = u / jnp.maximum(norm, 1e-30)
+            u_sphere = u_sphere.at[..., 0, :].set(0.0)
+            w = jnp.sqrt(y[..., None]) * u_sphere
+            diag = jnp.sqrt(jnp.clip(1.0 - jnp.sum(w ** 2, -1), 1e-30))
+            return w + jnp.vectorize(jnp.diag,
+                                     signature="(n)->(n,n)")(diag)
+
+        return _op(f, self.concentration)
+
+    def log_prob(self, value):
+        d = self.dim
+
+        def f(conc, L):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            order = 2.0 * (conc[..., None] - 1.0) + d \
+                - jnp.arange(2, d + 1, dtype=jnp.float32)
+            unnorm = jnp.sum(order * jnp.log(diag), -1)
+            dm1 = d - 1
+            alpha = conc + 0.5 * dm1
+            denom = jax.scipy.special.gammaln(alpha) * dm1
+            num = jax.scipy.special.multigammaln(alpha - 0.5, dm1)
+            pi_const = 0.5 * dm1 * math.log(math.pi)
+            return unnorm - (pi_const + num - denom)
+
+        return _op(f, self.concentration, value)
+
+
 class Independent(Distribution):
     """Reinterpret batch dims as event dims (reference independent.py)."""
 
@@ -1005,6 +1165,18 @@ def _kl_dirichlet(p, q):
                 + jnp.sum((c1 - c2) * (dg(c1) - dg(s1)[..., None]), -1))
 
     return _op(f, p.concentration, q.concentration)
+
+
+@register_kl(ContinuousBernoulli, ContinuousBernoulli)
+def _kl_continuous_bernoulli(p, q):
+    # KL = E_p[log p - log q] = (C_p - C_q normalizers) + mean_p * (log
+    # l_p - log l_q) + (1-mean_p) * (log(1-l_p) - log(1-l_q))
+    m = p.mean
+    return _op(lambda lp, lq, mm: (p._log_norm(lp) - q._log_norm(lq)
+                                   + mm * (jnp.log(lp) - jnp.log(lq))
+                                   + (1 - mm) * (jnp.log1p(-lp)
+                                                 - jnp.log1p(-lq))),
+               p.probs, q.probs, m)
 
 
 @register_kl(Laplace, Laplace)

@@ -18,7 +18,7 @@ __all__ = [
     "swiglu", "fused_linear", "softmax_mask_fuse",
     "softmax_mask_fuse_upper_triangle", "fused_dropout_add",
     "fused_bias_act",
-]
+ "fused_moe",]
 
 
 def swiglu(x, y=None, name=None):
@@ -86,3 +86,59 @@ def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kw):
     if act is None:
         raise ValueError(f"unknown act_method {act_method!r}")
     return act(out)
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
+              ffn2_bias, ffn1_scale=None, ffn2_scale=None,
+              quant_method="None", moe_topk=2, norm_topk_prob=True,
+              name=None):
+    """Fused Mixtral-style MoE FFN (reference
+    incubate/nn/functional/fused_moe.py, fused_moe_kernel.cu): softmax
+    router over ALL experts → top-k (optionally renormalized) →
+    per-expert SwiGLU FFN → combine.
+
+    TPU-first formulation: instead of the reference's CUTLASS
+    grouped-GEMM over gathered rows, the experts run as ONE batched
+    einsum over the expert dim with the combine weights zeroing
+    unselected experts — static shapes, MXU-batched, fully
+    differentiable. This is the functional parity surface for
+    moderate `num_experts`; the scalable capacity-based dispatch (and
+    expert parallelism) is `incubate.distributed.models.moe.MoELayer`.
+
+    Shapes (reference contract): x [b, s, d]; gate_weight [d, E];
+    ffn1_weight [E, d, 2*ff] (SwiGLU gate+up fused);
+    ffn1_bias [E, 1, 2*ff]; ffn2_weight [E, ff, d]; ffn2_bias [E, 1, d].
+    Returns [b, s, d].
+    """
+    if quant_method != "None":
+        raise NotImplementedError(
+            "quantized fused_moe weights are not supported (use "
+            "nn.quant.weight_only_linear per expert)")
+    k = int(moe_topk)
+
+    def f(xv, gw, w1, b1, w2, b2):
+        b, s, d = xv.shape
+        t = b * s
+        xt = xv.reshape(t, d)
+        logits = (xt.astype(jnp.float32)
+                  @ gw.astype(jnp.float32))          # [t, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, k)          # [t, k]
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        n_e = gw.shape[-1]
+        # combine weights [t, E]: routing prob on the selected experts,
+        # exactly zero elsewhere — the einsum mask
+        comb = jnp.zeros((t, n_e), jnp.float32).at[
+            jnp.arange(t)[:, None], topi].add(topv)
+        h1 = jnp.einsum("td,edg->teg", xt, w1) + b1.reshape(
+            1, n_e, -1)                                # [t, E, 2ff]
+        g, u = jnp.split(h1, 2, axis=-1)
+        hs = jax.nn.silu(g) * u                        # [t, E, ff]
+        h2 = jnp.einsum("tef,efd->ted", hs, w2) + b2.reshape(
+            1, n_e, -1)                                # [t, E, d]
+        out = jnp.einsum("te,ted->td", comb.astype(h2.dtype), h2)
+        return out.reshape(b, s, d).astype(xv.dtype)
+
+    return nary(f, [x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
+                    ffn2_bias], "fused_moe")

@@ -225,3 +225,106 @@ class TestComposition:
         np.testing.assert_allclose(
             float(_np(d.log_prob(v))),
             st.norm.logpdf(_np(v)).sum(), rtol=1e-5)
+
+
+class TestContinuousBernoulli:
+    """r5: numerics vs torch.distributions.ContinuousBernoulli."""
+
+    def test_log_prob_mean_var_cdf_vs_torch(self):
+        import torch
+
+        probs = np.asarray([0.1, 0.3, 0.499999, 0.8], np.float32)
+        xs = np.asarray([0.2, 0.7, 0.4, 0.9], np.float32)
+        ours = paddle.distribution.ContinuousBernoulli(probs)
+        ref = torch.distributions.ContinuousBernoulli(
+            torch.tensor(probs))
+        np.testing.assert_allclose(
+            np.asarray(ours.log_prob(paddle.to_tensor(xs))._data),
+            ref.log_prob(torch.tensor(xs)).numpy(), rtol=1e-4,
+            atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ours.mean._data),
+                                   ref.mean.numpy(), rtol=1e-3,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ours.variance._data),
+                                   ref.variance.numpy(), rtol=2e-3,
+                                   atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ours.cdf(paddle.to_tensor(xs))._data),
+            ref.cdf(torch.tensor(xs)).numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ours.entropy()._data),
+                                   ref.entropy().numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_sample_mean_matches(self):
+        paddle.seed(0)
+        d = paddle.distribution.ContinuousBernoulli(
+            np.asarray([0.2, 0.8], np.float32))
+        s = np.asarray(d.sample((4000,))._data)
+        assert s.min() > 0 and s.max() < 1
+        np.testing.assert_allclose(s.mean(0), np.asarray(d.mean._data),
+                                   atol=0.02)
+
+
+class TestLKJCholesky:
+    """r5: onion sampling + Stan-manual density, verified against
+    torch.distributions.LKJCholesky."""
+
+    def test_log_prob_vs_torch(self):
+        import torch
+
+        for dim, conc in ((2, 1.0), (3, 0.5), (4, 2.5)):
+            tref = torch.distributions.LKJCholesky(dim, conc)
+            L = tref.sample((5,))
+            ours = paddle.distribution.LKJCholesky(dim, conc)
+            got = np.asarray(
+                ours.log_prob(paddle.to_tensor(L.numpy()))._data)
+            want = tref.log_prob(L).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                       err_msg=f"dim={dim} conc={conc}")
+
+    def test_samples_are_valid_cholesky(self):
+        paddle.seed(1)
+        d = paddle.distribution.LKJCholesky(4, 1.5)
+        L = np.asarray(d.sample((64,))._data)
+        assert L.shape == (64, 4, 4)
+        # lower triangular, unit-norm rows -> correlation diag of 1
+        assert np.allclose(np.triu(L, 1), 0, atol=1e-6)
+        R = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(R, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        # positive diagonal
+        assert (np.diagonal(L, axis1=-2, axis2=-1) > 0).all()
+
+    def test_concentration_shifts_mass(self):
+        """Higher concentration concentrates mass near identity: mean
+        |off-diag| shrinks."""
+        paddle.seed(2)
+        lo = np.abs(np.asarray(
+            paddle.distribution.LKJCholesky(3, 0.5).sample((400,))._data))
+        hi = np.abs(np.asarray(
+            paddle.distribution.LKJCholesky(3, 10.0).sample((400,))._data))
+
+        def offdiag(L):
+            R = L @ np.swapaxes(L, -1, -2)
+            return np.abs(R[:, 1, 0]).mean()
+
+        assert offdiag(hi) < offdiag(lo)
+
+    def test_icdf_and_kl(self):
+        import torch
+
+        probs = np.asarray([0.2, 0.7], np.float32)
+        d = paddle.distribution.ContinuousBernoulli(probs)
+        u = np.asarray([0.3, 0.6], np.float32)
+        t = torch.distributions.ContinuousBernoulli(torch.tensor(probs))
+        np.testing.assert_allclose(
+            np.asarray(d.icdf(paddle.to_tensor(u))._data),
+            t.icdf(torch.tensor(u)).numpy(), rtol=1e-4, atol=1e-5)
+        q = paddle.distribution.ContinuousBernoulli(
+            np.asarray([0.4, 0.5], np.float32))
+        tq = torch.distributions.ContinuousBernoulli(
+            torch.tensor([0.4, 0.5]))
+        np.testing.assert_allclose(
+            np.asarray(paddle.distribution.kl_divergence(d, q)._data),
+            torch.distributions.kl_divergence(t, tq).numpy(),
+            rtol=1e-3, atol=1e-4)

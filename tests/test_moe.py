@@ -250,3 +250,73 @@ class TestMoEGradClip:
                                        err_msg=k)
         # and the clip actually clipped (norm above the 0.05 bound)
         assert n_dense > 0.05
+
+
+class TestFusedMoEFunctional:
+    """r5 (VERDICT r4 missing #5 tail): fused_moe vs an independent
+    numpy Mixtral-style reference (softmax-all -> topk -> renorm ->
+    SwiGLU experts -> combine)."""
+
+    def _np_ref(self, x, gw, w1, b1, w2, b2, topk, norm):
+        import scipy.special as sps
+
+        b, s, d = x.shape
+        t = b * s
+        xt = x.reshape(t, d)
+        probs = sps.softmax(xt @ gw, axis=-1)
+        E = gw.shape[-1]
+        out = np.zeros((t, d), np.float32)
+        for ti in range(t):
+            sel = np.argsort(-probs[ti])[:topk]
+            w = probs[ti, sel]
+            if norm:
+                w = w / w.sum()
+            for wi, e in zip(w, sel):
+                h1 = xt[ti] @ w1[e] + b1[e, 0]
+                g, u = np.split(h1, 2)
+                hs = g * sps.expit(g) * u
+                out[ti] += wi * (hs @ w2[e] + b2[e, 0])
+        return out.reshape(b, s, d)
+
+    def test_matches_numpy(self):
+        from paddle_tpu.incubate.nn.functional import fused_moe
+
+        rng = np.random.default_rng(0)
+        b, s, d, ff, E = 2, 3, 8, 16, 4
+        x = rng.standard_normal((b, s, d)).astype(np.float32) * 0.5
+        gw = rng.standard_normal((d, E)).astype(np.float32) * 0.5
+        w1 = rng.standard_normal((E, d, 2 * ff)).astype(np.float32) * 0.2
+        b1 = rng.standard_normal((E, 1, 2 * ff)).astype(np.float32) * 0.1
+        w2 = rng.standard_normal((E, ff, d)).astype(np.float32) * 0.2
+        b2 = rng.standard_normal((E, 1, d)).astype(np.float32) * 0.1
+        for norm in (True, False):
+            got = fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                            paddle.to_tensor(w1), paddle.to_tensor(b1),
+                            paddle.to_tensor(w2), paddle.to_tensor(b2),
+                            moe_topk=2, norm_topk_prob=norm)
+            want = self._np_ref(x, gw, w1, b1, w2, b2, 2, norm)
+            np.testing.assert_allclose(np.asarray(got._data), want,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_grads_flow(self):
+        from paddle_tpu.incubate.nn.functional import fused_moe
+
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(
+            rng.standard_normal((1, 4, 8)).astype(np.float32),
+            stop_gradient=False)
+        gw = paddle.to_tensor(
+            rng.standard_normal((8, 3)).astype(np.float32),
+            stop_gradient=False)
+        w1 = paddle.to_tensor(
+            rng.standard_normal((3, 8, 8)).astype(np.float32) * 0.3,
+            stop_gradient=False)
+        b1 = paddle.to_tensor(np.zeros((3, 1, 8), np.float32))
+        w2 = paddle.to_tensor(
+            rng.standard_normal((3, 4, 8)).astype(np.float32) * 0.3,
+            stop_gradient=False)
+        b2 = paddle.to_tensor(np.zeros((3, 1, 8), np.float32))
+        out = fused_moe(x, gw, w1, b1, w2, b2, moe_topk=1)
+        (out ** 2).mean().backward()
+        assert x.grad is not None and w1.grad is not None
+        assert np.isfinite(np.asarray(w1.grad._data)).all()
