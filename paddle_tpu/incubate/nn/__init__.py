@@ -22,7 +22,8 @@ from ...ops._dispatch import ensure_tensor
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
            "FusedBiasDropoutResidualLayerNorm",
-           "FusedTransformerEncoderLayer", "FusedLinear"]
+           "FusedTransformerEncoderLayer", "FusedLinear",
+           "FusedDropoutAdd", "FusedDropout", "FusedEcMoe"]
 
 
 class FusedMultiHeadAttention(nn.Layer):
@@ -207,4 +208,66 @@ class FusedLinear(nn.Linear):
         super().__init__(in_features, out_features,
                          weight_attr=weight_attr, bias_attr=bias_attr)
         self._transpose_weight = transpose_weight
+
+
+class FusedDropoutAdd(nn.Layer):
+    """reference layer/fused_dropout_add.py — dropout(x) + y as one
+    layer (XLA fuses the pair; kept for API parity)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from .functional import fused_dropout_add
+
+        return fused_dropout_add(x, y, p=self.p, training=self.training,
+                                 mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedDropout(nn.Dropout):
+    """reference layer/fused_dropout_nd.py — Dropout with an axis arg
+    (row/column dropout); the TPU dropout already fuses."""
+
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train",
+                 name=None):
+        super().__init__(p=p, axis=axis, mode=mode)
+
+
+class FusedEcMoe(nn.Layer):
+    """reference layer/fused_ec_moe.py — expert-choice MoE FFN over the
+    fused_ec_moe functional (each expert picks its top-capacity tokens)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError("act_type must be 'gelu' or 'relu'")
+        self.act_type = act_type
+        e, d, f = num_experts, hidden_size, inter_size
+        if bias_attr is False:
+            raise ValueError(
+                "FusedEcMoe requires biases (the fused kernel contract "
+                "has [e, 1, *] bias operands); pass zeros instead")
+        self.bmm_weight0 = self.create_parameter(
+            (e, d, f), attr=weight_attr)
+        self.bmm_bias0 = self.create_parameter((e, 1, f), attr=bias_attr,
+                                               is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            (e, f, d), attr=weight_attr)
+        self.bmm_bias1 = self.create_parameter((e, 1, d), attr=bias_attr,
+                                               is_bias=True)
+
+    def forward(self, x, gate):
+        from .functional import fused_ec_moe
+
+        return fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
+                            self.bmm_weight1, self.bmm_bias1,
+                            self.act_type)
+
+
 from . import functional  # noqa: E402,F401

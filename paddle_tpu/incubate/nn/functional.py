@@ -18,7 +18,7 @@ __all__ = [
     "swiglu", "fused_linear", "softmax_mask_fuse",
     "softmax_mask_fuse_upper_triangle", "fused_dropout_add",
     "fused_bias_act",
- "fused_moe",]
+ "fused_moe", "fused_ec_moe",]
 
 
 def swiglu(x, y=None, name=None):
@@ -142,3 +142,51 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
 
     return nary(f, [x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
                     ffn2_bias], "fused_moe")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Expert-choice MoE (reference incubate/nn/functional/fused_ec_moe.py,
+    fused_ec_moe kernel; semantics from test_fused_ec_moe_op.py's
+    baseline): each EXPERT selects its top-(seq_len // 16) tokens by gate
+    logit, applies its two-layer FFN, and scatter-adds prob-weighted
+    outputs back over a residual connection.
+
+    TPU-first formulation: per-expert token gather + one batched einsum
+    pair + a scatter-add — static shapes (capacity fixed by seq_len), all
+    MXU-batched, differentiable end to end.
+
+    Shapes: x [b, s, d]; gate [b, s, e] (logits);
+    bmm0_weight [e, d, ff]; bmm0_bias [e, 1, ff];
+    bmm1_weight [e, ff, d]; bmm1_bias [e, 1, d]. Returns [b, s, d].
+    """
+    if act_type not in ("gelu", "relu"):
+        raise ValueError("act_type must be 'gelu' or 'relu'")
+    from ...ops._dispatch import nary
+
+    def f(xv, g, w0, b0, w1, b1):
+        b, s, d = xv.shape
+        e = g.shape[-1]
+        cap = max(s // 16, 1)
+        gates = jax.nn.softmax(g.astype(jnp.float32), axis=-1)
+        # per-expert top-capacity TOKENS, ranked by raw logits (the
+        # reference gating ranks logits, weights by softmax prob)
+        _, top_idx = jax.lax.top_k(
+            jnp.swapaxes(g, 1, 2), cap)               # [b, e, cap]
+        xg = jnp.take_along_axis(
+            xv[:, None], top_idx[..., None], axis=2)  # [b, e, cap, d]
+        h = jnp.einsum("becd,edf->becf", xg, w0) + b0[None, :, 0, None]
+        h = (jax.nn.gelu(h, approximate=False) if act_type == "gelu"
+             else jax.nn.relu(h))
+        o = jnp.einsum("becf,efd->becd", h, w1) + b1[None, :, 0, None]
+        prob = jnp.take_along_axis(
+            jnp.swapaxes(gates, 1, 2), top_idx, axis=-1)  # [b, e, cap]
+        contrib = prob[..., None].astype(o.dtype) * o
+        out = jnp.zeros_like(xv)
+        bidx = jnp.broadcast_to(jnp.arange(b)[:, None, None],
+                                top_idx.shape)
+        out = out.at[bidx, top_idx].add(contrib)
+        return out + xv
+
+    return nary(f, [x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                    bmm1_bias], "fused_ec_moe")

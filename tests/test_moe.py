@@ -320,3 +320,66 @@ class TestFusedMoEFunctional:
         (out ** 2).mean().backward()
         assert x.grad is not None and w1.grad is not None
         assert np.isfinite(np.asarray(w1.grad._data)).all()
+
+
+class TestFusedEcMoe:
+    """r5: expert-choice MoE vs an independent numpy model of the
+    reference baseline (test_fused_ec_moe_op.py semantics: each expert
+    takes its top-(s//16) tokens by logit, weights by softmax prob,
+    residual add)."""
+
+    def _np_ref(self, x, g, w0, b0, w1, b1, act):
+        import scipy.special as sps
+
+        b, s, d = x.shape
+        e = g.shape[-1]
+        cap = max(s // 16, 1)
+        gates = sps.softmax(g, axis=-1)
+        out = x.copy()
+        for bi in range(b):
+            for ei in range(e):
+                top = np.argsort(-g[bi, :, ei], kind="stable")[:cap]
+                for t in top:
+                    h = x[bi, t] @ w0[ei] + b0[ei, 0]
+                    h = (h * 0.5 * (1 + sps.erf(h / np.sqrt(2)))
+                         if act == "gelu" else np.maximum(h, 0))
+                    o = h @ w1[ei] + b1[ei, 0]
+                    out[bi, t] += gates[bi, t, ei] * o
+        return out
+
+    def test_matches_numpy(self):
+        from paddle_tpu.incubate.nn.functional import fused_ec_moe
+
+        rng = np.random.default_rng(3)
+        b, s, d, ff, e = 2, 32, 8, 16, 4
+        x = rng.standard_normal((b, s, d)).astype(np.float32) * 0.3
+        g = rng.standard_normal((b, s, e)).astype(np.float32)
+        w0 = rng.standard_normal((e, d, ff)).astype(np.float32) * 0.2
+        b0 = rng.standard_normal((e, 1, ff)).astype(np.float32) * 0.1
+        w1 = rng.standard_normal((e, ff, d)).astype(np.float32) * 0.2
+        b1 = rng.standard_normal((e, 1, d)).astype(np.float32) * 0.1
+        for act in ("gelu", "relu"):
+            got = fused_ec_moe(paddle.to_tensor(x), paddle.to_tensor(g),
+                               paddle.to_tensor(w0), paddle.to_tensor(b0),
+                               paddle.to_tensor(w1), paddle.to_tensor(b1),
+                               act_type=act)
+            want = self._np_ref(x, g, w0, b0, w1, b1, act)
+            np.testing.assert_allclose(np.asarray(got._data), want,
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=act)
+
+    def test_layer_and_grads(self):
+        from paddle_tpu.incubate.nn import FusedEcMoe
+
+        paddle.seed(0)
+        layer = FusedEcMoe(8, 16, 4, act_type="relu")
+        rng = np.random.default_rng(4)
+        x = paddle.to_tensor(
+            rng.standard_normal((1, 32, 8)).astype(np.float32),
+            stop_gradient=False)
+        g = paddle.to_tensor(
+            rng.standard_normal((1, 32, 4)).astype(np.float32))
+        out = layer(x, g)
+        assert tuple(out.shape) == (1, 32, 8)
+        (out ** 2).mean().backward()
+        assert layer.bmm_weight0.grad is not None

@@ -247,3 +247,23 @@ class TestRnntLoss:
         loss.backward()
         g = np.asarray(lg.grad._data)
         assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class TestIncubateFusedLayers:
+    def test_fused_dropout_add_layer(self):
+        from paddle_tpu.incubate.nn import FusedDropoutAdd
+
+        layer = FusedDropoutAdd(p=0.0)
+        layer.eval()
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+        np.testing.assert_allclose(np.asarray(layer(x, y)._data), 3.0)
+        assert "p=0.0" in layer.extra_repr()
+
+    def test_fused_dropout_layer(self):
+        from paddle_tpu.incubate.nn import FusedDropout
+
+        layer = FusedDropout(p=0.5)
+        layer.eval()
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        np.testing.assert_allclose(np.asarray(layer(x)._data), 1.0)
