@@ -7,7 +7,9 @@ the compiler tiles onto the systolic array.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import jax.scipy.linalg  # noqa: F401  (solve_triangular)
 
 from ..framework.tensor import Tensor
 from ..framework.autograd import apply_op
@@ -407,3 +409,68 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
         return q @ c if left else c @ q
 
     return nary(f, [x, tau, other], "ormqr")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference
+    cholesky_inverse): A^-1 where A = L L^T (or U^T U)."""
+    def f(l):
+        eye = jnp.eye(l.shape[-1], dtype=l.dtype)
+        if upper:
+            li = jax.scipy.linalg.solve_triangular(l, eye, lower=False)
+            return li @ li.T
+        li = jax.scipy.linalg.solve_triangular(l, eye, lower=True)
+        return li.T @ li
+
+    return unary(f, x, "cholesky_inverse")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """reference linalg.matrix_norm — the matrix-norm half of norm()."""
+    def f(v):
+        return jnp.linalg.norm(v, ord=p, axis=tuple(axis),
+                               keepdims=keepdim)
+
+    return unary(f, x, "matrix_norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """reference linalg.vector_norm — the vector-norm half of norm():
+    flattens when axis is None (numpy matrix semantics do NOT apply)."""
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        return jnp.linalg.norm(vv, ord=p,
+                               axis=None if axis is None else axis,
+                               keepdims=False if axis is None
+                               else keepdim)
+
+    return unary(f, x, "vector_norm")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """reference linalg.svd_lowrank: rank-q randomized SVD. On TPU the
+    exact thin SVD is a single XLA call and these shapes are small, so
+    the truncation of the exact factorization is the honest
+    formulation (same contract: x ~ U diag(S) V^T)."""
+    def f(v):
+        a = v - (M._data if hasattr(M, "_data") else M) \
+            if M is not None else v
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        k = min(int(q), s.shape[-1])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+
+    return unary(f, x, "svd_lowrank")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference linalg.pca_lowrank over svd_lowrank."""
+    def f(v):
+        a = v.astype(jnp.float32)
+        kq = min(q if q is not None else 6, a.shape[-1], a.shape[-2])
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :kq], s[..., :kq], jnp.swapaxes(
+            vt, -1, -2)[..., :kq]
+
+    return unary(f, x, "pca_lowrank")

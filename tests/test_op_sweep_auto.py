@@ -995,6 +995,43 @@ S("alpha_dropout_eval",
   lambda x: x, _std())
 
 
+
+# batch 3 (r5): the last reference linalg.__all__ entries
+S("cholesky_inverse",
+  lambda l: paddle.linalg.cholesky_inverse(l),
+  lambda l: np.linalg.inv(l @ l.T),
+  lambda rng: [(lambda a: np.linalg.cholesky(
+      a @ a.T + 3 * np.eye(3)).astype("float32"))(
+      rng.standard_normal((3, 3)))],
+  dtypes=("float32",), grad=None,
+  tols={"float32": dict(rtol=1e-4, atol=1e-4)})
+S("matrix_norm_fro",
+  lambda x: paddle.linalg.matrix_norm(x),
+  lambda x: np.asarray(np.linalg.norm(x)), _std(), grad=None)
+S("vector_norm_l3",
+  lambda x: paddle.linalg.vector_norm(x, p=3.0),
+  lambda x: np.asarray((np.abs(x) ** 3).sum() ** (1 / 3)), _std(),
+  grad=None, tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+S("svd_lowrank_reconstruct",
+  lambda x: (lambda u, s, v: paddle.matmul(
+      u * s.unsqueeze(-2), v, transpose_y=True))(
+      *paddle.linalg.svd_lowrank(x, q=2)),
+  lambda x: x,
+  lambda rng: [(rng.standard_normal((6, 2))
+                @ rng.standard_normal((2, 4))).astype("float32")],
+  dtypes=("float32",), grad=None,
+  tols={"float32": dict(rtol=1e-3, atol=1e-4)})
+S("pca_lowrank_linalg",
+  lambda x: (lambda u, s, v: paddle.matmul(
+      u * s.unsqueeze(-2), v, transpose_y=True))(
+      *paddle.linalg.pca_lowrank(x, q=3, center=False)),
+  lambda x: x,
+  lambda rng: [(rng.standard_normal((6, 3))
+                @ rng.standard_normal((3, 4))).astype("float32")],
+  dtypes=("float32",), grad=None,
+  tols={"float32": dict(rtol=1e-3, atol=1e-4)})
+
+
 SKIPPED = {
     "conv2d": "covered by dedicated shape/grad tests (test_ops.py)",
     "rnn/lstm/gru": "stateful multi-output recurrent API (test_nn.py)",
