@@ -38,6 +38,19 @@ from .layer.conv import (  # noqa: F401
     Conv2DTranspose,
     Conv3DTranspose,
 )
+from .decode import (  # noqa: F401
+    BeamSearchDecoder, dynamic_decode,
+)
+from .layer.extras import (  # noqa: F401
+    CTCLoss, RNNTLoss, GaussianNLLLoss, PoissonNLLLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss, MultiMarginLoss,
+    TripletMarginWithDistanceLoss, HSigmoidLoss,
+    AdaptiveLogSoftmaxWithLoss, LPPool1D, LPPool2D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D, FractionalMaxPool2D, FractionalMaxPool3D,
+    Softmax2D, ZeroPad1D, ZeroPad3D, FeatureAlphaDropout,
+    RNNCellBase, RNN, BiRNN,
+)
+from .utils.spectral_norm import SpectralNorm  # noqa: F401
 from .layer.norm import (  # noqa: F401
     BatchNorm,
     BatchNorm1D,

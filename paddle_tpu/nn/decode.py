@@ -1,0 +1,198 @@
+"""Seq2seq decoding — BeamSearchDecoder + dynamic_decode.
+
+Reference parity: python/paddle/nn/decode.py (Decoder protocol,
+BeamSearchDecoder :161, dynamic_decode :1021). TPU-first shape: the beam
+bookkeeping is batched tensor math over a [batch, beam] lattice (no
+TensorArray/LoD machinery — stacked outputs + a parent-pointer
+backtrack). The decode loop itself runs EAGERLY with early stopping —
+decoding is an inference-time utility whose step count is data-
+dependent; inside jit, express the model's step as the cell and bound
+the loop with ``max_step_num``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Protocol (reference decode.py Decoder): initialize/step/finalize."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+def _tree_map(f, tree):
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_map(f, t) for t in tree)
+    return f(tree)
+
+
+class BeamSearchDecoder(Decoder):
+    """Standard length-unnormalized beam search over a step cell
+    (reference decode.py:161): `cell(inputs, states) -> (out, states)`,
+    scores = log_softmax(output_fn(out)); finished beams are frozen by
+    forcing probability one on `end_token`.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- reference static helper ----------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch * beam_size, ...] by repeating each
+        batch entry beam_size times (reference :256)."""
+        import jax.numpy as jnp
+
+        from ..ops._dispatch import unary
+
+        return unary(lambda v: jnp.repeat(v, beam_size, axis=0), x,
+                     "tile_beam_merge_with_batch")
+
+    def _merge(self, x):
+        """[batch, beam, ...] -> [batch*beam, ...]"""
+        return x.reshape([-1] + list(x.shape[2:]))
+
+    def _split(self, x, batch):
+        return x.reshape([batch, self.beam_size] + list(x.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        import paddle_tpu as paddle
+
+        states = _tree_map(
+            lambda s: self.tile_beam_merge_with_batch(s, self.beam_size),
+            initial_cell_states)
+        probe = initial_cell_states
+        while isinstance(probe, (list, tuple)):
+            probe = probe[0]
+        batch = probe.shape[0]
+        ids = paddle.full([batch * self.beam_size], self.start_token,
+                          dtype="int64")
+        inputs = (self.embedding_fn(ids) if self.embedding_fn is not None
+                  else ids)
+        # only beam 0 live at t=0, so the first top-k does not pick the
+        # same token from beam_size identical candidates
+        lp = np.full((batch, self.beam_size), -1e9, np.float32)
+        lp[:, 0] = 0.0
+        log_probs = paddle.to_tensor(lp)
+        finished = paddle.to_tensor(
+            np.zeros((batch, self.beam_size), bool))
+        return inputs, (states, log_probs, finished), finished
+
+    def step(self, time, inputs, states, **kwargs):
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from ..nn import functional as F
+
+        cell_states, log_probs, finished = states
+        cell_out, next_cell_states = self.cell(inputs, cell_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        batch = log_probs.shape[0]
+        vocab = cell_out.shape[-1]
+        step_lp = F.log_softmax(cell_out, axis=-1)        # [b*beam, V]
+        step_np = np.asarray(step_lp._data, np.float32) \
+            .reshape(batch, self.beam_size, vocab)
+        lp = np.asarray(log_probs._data, np.float32)
+        fin = np.asarray(finished._data, bool)
+        # frozen beams: only end_token continues, at probability one
+        frozen = np.full((vocab,), -1e9, np.float32)
+        frozen[self.end_token] = 0.0
+        step_np = np.where(fin[..., None], frozen, step_np)
+        total = lp[..., None] + step_np                   # [b, beam, V]
+        flat = total.reshape(batch, -1)
+        top = np.argsort(-flat, axis=-1, kind="stable")[:, :self.beam_size]
+        new_lp = np.take_along_axis(flat, top, -1)
+        parent = (top // vocab).astype(np.int64)          # [b, beam]
+        token = (top % vocab).astype(np.int64)
+        new_fin = np.take_along_axis(fin, parent, -1) \
+            | (token == self.end_token)
+
+        # gather cell states along the selected parents
+        gather = (parent + np.arange(batch)[:, None]
+                  * self.beam_size).reshape(-1)
+
+        def g(s):
+            return Tensor._wrap(jnp.take(s._data, jnp.asarray(gather),
+                                         axis=0))
+
+        next_cell_states = _tree_map(g, next_cell_states)
+        ids_flat = paddle.to_tensor(token.reshape(-1))
+        next_inputs = (self.embedding_fn(ids_flat)
+                       if self.embedding_fn is not None else ids_flat)
+        out = {"ids": paddle.to_tensor(token),
+               "parents": paddle.to_tensor(parent),
+               "log_probs": paddle.to_tensor(new_lp)}
+        next_states = (next_cell_states, paddle.to_tensor(new_lp),
+                       paddle.to_tensor(new_fin))
+        return out, next_states, next_inputs, \
+            paddle.to_tensor(new_fin)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrack parent pointers via F.gather_tree: stacked per-step
+        (ids, parents) -> [batch, T, beam] token ids."""
+        import paddle_tpu as paddle
+        from ..nn import functional as F
+
+        if not outputs:
+            batch, beam = np.asarray(sequence_lengths).shape
+            return paddle.to_tensor(
+                np.zeros((batch, 0, beam), np.int64)), final_states
+        ids = paddle.to_tensor(np.stack(
+            [np.asarray(o["ids"]._data) for o in outputs], 0))
+        parents = paddle.to_tensor(np.stack(
+            [np.asarray(o["parents"]._data) for o in outputs], 0))
+        full = F.gather_tree(ids, parents)             # [T, b, beam]
+        return full.transpose([1, 0, 2]), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run `decoder.step` until every sequence finished or max_step_num
+    (reference decode.py:1021). Returns (outputs, final_states[,
+    sequence_lengths])."""
+    import paddle_tpu as paddle
+
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    fin = np.asarray(finished._data, bool)
+    lengths = np.zeros(fin.shape, np.int64)
+    limit = int(max_step_num) if max_step_num is not None else None
+    step = 0
+    while (limit is None or step < limit) and not fin.all():
+        out, states, inputs, finished = decoder.step(step, inputs,
+                                                     states, **kwargs)
+        prev_fin = fin
+        # reorder running lengths by the chosen parents before extending
+        parents = np.asarray(out["parents"]._data)
+        lengths = np.take_along_axis(lengths, parents, -1)
+        prev_fin = np.take_along_axis(prev_fin, parents, -1)
+        fin = np.asarray(finished._data, bool)
+        lengths = lengths + (~prev_fin).astype(np.int64)
+        outputs.append(out)
+        step += 1
+    result, final_states = decoder.finalize(outputs, states, lengths)
+    if output_time_major:
+        result = result.transpose([1, 0, 2])
+    if return_length:
+        return result, final_states, paddle.to_tensor(lengths)
+    return result, final_states

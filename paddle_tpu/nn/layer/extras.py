@@ -1,0 +1,409 @@
+"""Layer-class wrappers completing the reference nn.__all__ surface
+(r5): each wraps an already-implemented functional (reference
+python/paddle/nn/layer/{loss,pooling,common,rnn}.py class counterparts).
+Kept in one module — the math lives in nn/functional; these carry
+defaults, parameters where the reference class owns them (HSigmoidLoss,
+AdaptiveLogSoftmaxWithLoss, SpectralNorm), and the Layer idioms."""
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths,
+                          label_lengths, blank=self.blank,
+                          reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance,
+                                   full=self.full, epsilon=self.epsilon,
+                                   reduction=self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input = log_input
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label,
+                                  log_input=self.log_input,
+                                  full=self.full, epsilon=self.epsilon,
+                                  reduction=self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label,
+                                  reduction=self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(
+            input, label, weight=self.weight, reduction=self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, p=self.p,
+                                   margin=self.margin,
+                                   weight=self.weight,
+                                   reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function,
+            margin=self.margin, swap=self.swap,
+            reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Owns the tree weights (reference nn/layer/loss.py HSigmoidLoss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree hsigmoid is descoped (see F.hsigmoid_loss)")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        self.bias = self.create_parameter((num_classes - 1,),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, bias=self.bias,
+                               path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax (reference nn/layer/loss.py
+    AdaptiveLogSoftmaxWithLoss): head + per-cluster tail projections,
+    forward returns (output, loss)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (not cutoffs or cutoffs != sorted(cutoffs)
+                or len(set(cutoffs)) != len(cutoffs)
+                or cutoffs[-1] > n_classes - 1):
+            raise ValueError(
+                "cutoffs must be unique, increasing and < n_classes")
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(cutoffs)
+        head_size = cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter(
+            (in_features, head_size))
+        self.head_bias = (self.create_parameter((head_size,),
+                                                is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter((in_features, hsz))
+            w2 = self.create_parameter((hsz, osz))
+            self.add_parameter(f"tail_{i}_proj", w1)
+            self.add_parameter(f"tail_{i}_out", w2)
+            self.tail_weights.append([w1, w2])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:-1], head_bias=self.head_bias)
+
+
+# ---------------------------------------------------------------------------
+# pooling / padding / dropout
+# ---------------------------------------------------------------------------
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        n, k, s, p, c, d = self._a
+        return F.lp_pool1d(x, n, k, stride=s, padding=p, ceil_mode=c,
+                           data_format=d)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        n, k, s, p, c, d = self._a
+        return F.lp_pool2d(x, n, k, stride=s, padding=p, ceil_mode=c,
+                           data_format=d)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format,
+                   output_size)
+
+    def forward(self, x, indices):
+        k, s, p, d, o = self._a
+        return F.max_unpool1d(x, indices, k, stride=s, padding=p,
+                              data_format=d, output_size=o)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format,
+                   output_size)
+
+    def forward(self, x, indices):
+        k, s, p, d, o = self._a
+        return F.max_unpool2d(x, indices, k, stride=s, padding=p,
+                              data_format=d, output_size=o)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format,
+                   output_size)
+
+    def forward(self, x, indices):
+        k, s, p, d, o = self._a
+        return F.max_unpool3d(x, indices, k, stride=s, padding=p,
+                              data_format=d, output_size=o)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self._a
+        return F.fractional_max_pool2d(x, o, kernel_size=k, random_u=u,
+                                       return_mask=m)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self._a
+        return F.fractional_max_pool3d(x, o, kernel_size=k, random_u=u,
+                                       return_mask=m)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW (reference activation.py)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3D/4D input")
+        return F.softmax(x, axis=-3)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p,
+                                       training=self.training)
+
+
+# ---------------------------------------------------------------------------
+# generic RNN drivers (reference nn/layer/rnn.py RNN/BiRNN/RNNCellBase)
+# ---------------------------------------------------------------------------
+class RNNCellBase(Layer):
+    """Base for user cells: provides get_initial_states (reference
+    rnn.py:118) — zeros matching the cell's state_shape."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape if shape is not None else self.state_shape
+
+        def make(s):
+            return paddle.full([b] + list(s), init_value, dtype=dtype)
+
+        if isinstance(shape, (list, tuple)) and shape \
+                and isinstance(shape[0], (list, tuple)):
+            return type(shape)(make(s) for s in shape)
+        return make(shape)
+
+
+class RNN(Layer):
+    """Drive any cell over time (reference rnn.py RNN): cell(input_t,
+    state) -> (output_t, new_state); returns (outputs, final_states)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ... import ops
+
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "variable-length RNN: mask outputs with "
+                "paddle.nn.functional.sequence_mask instead")
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        if initial_states is None and hasattr(self.cell,
+                                              "get_initial_states"):
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=1 if self.time_major else 0)
+        # cells without the protocol (GRUCell etc.) default their own
+        # zero state when handed None
+        state = initial_states
+        order = range(steps - 1, -1, -1) if self.is_reverse \
+            else range(steps)
+        outs = [None] * steps
+        for t in order:
+            x_t = (inputs[t] if self.time_major
+                   else inputs[:, t])
+            y, state = self.cell(x_t, state, **kwargs)
+            outs[t] = y
+        out = ops.stack(outs, axis=t_axis)
+        return out, state
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (reference
+    rnn.py BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False,
+                          time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True,
+                          time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ... import ops
+
+        fw_init, bw_init = (initial_states
+                            if initial_states is not None
+                            else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, fw_init, sequence_length,
+                                    **kwargs)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_init, sequence_length,
+                                    **kwargs)
+        return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

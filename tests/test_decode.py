@@ -1,0 +1,91 @@
+"""BeamSearchDecoder + dynamic_decode (reference nn/decode.py): exact
+agreement with exhaustive search when beam covers the whole lattice, and
+a recurrent-cell smoke test."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+
+
+class _TableCell:
+    """Stateless cell: logits come from a fixed per-step table (state is
+    the step counter), making exhaustive scoring tractable."""
+
+    def __init__(self, table):
+        self.table = table                  # [T, V] logits
+
+    def __call__(self, inputs, states):
+        t = int(np.asarray(states._data).reshape(-1)[0])
+        b = inputs.shape[0]
+        logits = paddle.to_tensor(
+            np.tile(self.table[min(t, len(self.table) - 1)], (b, 1)))
+        return logits, paddle.to_tensor(
+            np.full((b,), t + 1, np.int64))
+
+
+class TestBeamExactness:
+    def test_full_beam_matches_exhaustive(self):
+        import itertools
+        import scipy.special as sps
+
+        rng = np.random.default_rng(0)
+        T, V = 3, 4
+        end = 0
+        table = rng.standard_normal((T, V)).astype(np.float32) * 2
+        # forbid the end token so all sequences have length T
+        table[:, end] = -50.0
+        logp = np.log(sps.softmax(table, -1))
+
+        cell = _TableCell(table)
+        beam = V * V  # covers every lattice path at each step
+        dec = BeamSearchDecoder(cell, start_token=1, end_token=end,
+                                beam_size=beam)
+        init = paddle.to_tensor(np.zeros((1,), np.int64))
+        out, _ = dynamic_decode(dec, init, max_step_num=T)
+        got = np.asarray(out._data)[0]      # [T, beam]
+
+        scores = {}
+        for seq in itertools.product(range(V), repeat=T):
+            scores[seq] = sum(logp[t, v] for t, v in enumerate(seq))
+        best = sorted(scores, key=scores.get, reverse=True)[:4]
+        for rank in range(4):
+            np.testing.assert_array_equal(got[:, rank], best[rank])
+
+    def test_end_token_freezes_beam(self):
+        T, V, end = 5, 3, 0
+        table = np.full((T, V), -10.0, np.float32)
+        table[0, end] = 10.0                # step 0 strongly prefers end
+        dec = BeamSearchDecoder(_TableCell(table), start_token=1,
+                                end_token=end, beam_size=2)
+        init = paddle.to_tensor(np.zeros((2,), np.int64))
+        out, _, lengths = dynamic_decode(dec, init, max_step_num=T,
+                                         return_length=True)
+        ids = np.asarray(out._data)
+        # top beam: end at step 0, frozen to end thereafter, length 1
+        assert (ids[:, :, 0] == end).all()
+        assert (np.asarray(lengths._data)[:, 0] == 1).all()
+
+
+class TestRecurrentSmoke:
+    def test_gru_cell_decode(self):
+        paddle.seed(0)
+        V, H = 6, 8
+        emb = paddle.nn.Embedding(V, H)
+        cell = paddle.nn.GRUCell(H, H)
+        proj = paddle.nn.Linear(H, V)
+
+        class Wrap:
+            def __call__(self, x, s):
+                y, s2 = cell(x, s)
+                return y, s2
+
+        dec = BeamSearchDecoder(Wrap(), start_token=1, end_token=0,
+                                beam_size=3, embedding_fn=emb,
+                                output_fn=proj)
+        init = paddle.to_tensor(np.zeros((2, H), np.float32))
+        out, _, lengths = dynamic_decode(dec, init, max_step_num=7,
+                                         return_length=True)
+        ids = np.asarray(out._data)
+        assert ids.shape[0] == 2 and ids.shape[2] == 3
+        assert ids.shape[1] <= 7
+        assert (np.asarray(lengths._data) <= 7).all()

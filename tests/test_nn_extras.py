@@ -267,3 +267,126 @@ class TestIncubateFusedLayers:
         layer.eval()
         x = paddle.to_tensor(np.ones((2, 3), np.float32))
         np.testing.assert_allclose(np.asarray(layer(x)._data), 1.0)
+
+
+class TestLayerWrappersR5:
+    """r5: the layer-class wrappers completing nn.__all__ (each over an
+    already-tested functional) — constructor/forward smoke + a numeric
+    spot check per family."""
+
+    def test_loss_wrappers(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((4, 5)).astype(np.float32))
+        y = paddle.to_tensor(
+            np.where(rng.uniform(size=(4, 5)) > 0.5, 1.0, -1.0)
+            .astype(np.float32))
+        l = paddle.nn.SoftMarginLoss()(x, y)
+        want = np.log1p(np.exp(-np.asarray(y._data)
+                               * np.asarray(x._data))).mean()
+        np.testing.assert_allclose(float(l), want, rtol=1e-5)
+
+        lbl = paddle.to_tensor(rng.integers(0, 5, (4,)), dtype="int64")
+        assert np.isfinite(float(paddle.nn.MultiMarginLoss()(x, lbl)))
+        onehot = paddle.to_tensor(
+            (rng.uniform(size=(4, 5)) > 0.5).astype(np.float32))
+        assert np.isfinite(
+            float(paddle.nn.MultiLabelSoftMarginLoss()(x, onehot)))
+        var = paddle.to_tensor(
+            rng.uniform(0.5, 2, (4, 5)).astype(np.float32))
+        assert np.isfinite(float(paddle.nn.GaussianNLLLoss()(x, x, var)))
+        assert np.isfinite(float(paddle.nn.PoissonNLLLoss()(
+            x, paddle.to_tensor(
+                rng.poisson(2.0, (4, 5)).astype(np.float32)))))
+        a, p, n = (paddle.to_tensor(
+            rng.standard_normal((3, 6)).astype(np.float32))
+            for _ in range(3))
+        assert np.isfinite(
+            float(paddle.nn.TripletMarginWithDistanceLoss()(a, p, n)))
+
+    def test_ctc_and_rnnt_wrappers(self):
+        rng = np.random.default_rng(1)
+        T, B, C, L = 6, 2, 5, 3
+        logp = paddle.to_tensor(
+            np.log(np.random.default_rng(1).dirichlet(
+                np.ones(C), (T, B)).astype(np.float32)))
+        labels = paddle.to_tensor(
+            rng.integers(1, C, (B, L)), dtype="int64")
+        il = paddle.to_tensor(np.full((B,), T, np.int64))
+        ll = paddle.to_tensor(np.full((B,), L, np.int64))
+        out = paddle.nn.CTCLoss()(logp, labels, il, ll)
+        assert np.isfinite(float(out)) and float(out) > 0
+
+    def test_hsigmoid_layer_owns_params(self):
+        rng = np.random.default_rng(2)
+        layer = paddle.nn.HSigmoidLoss(8, 10)
+        assert layer.weight.shape[0] == 9
+        x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 10, (4,)), dtype="int64")
+        out = layer(x, y)
+        assert out.shape[0] == 4 and np.isfinite(
+            np.asarray(out._data)).all()
+
+    def test_adaptive_log_softmax(self):
+        rng = np.random.default_rng(3)
+        layer = paddle.nn.AdaptiveLogSoftmaxWithLoss(16, 20, [5, 10])
+        x = paddle.to_tensor(
+            rng.standard_normal((6, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 20, (6,)), dtype="int64")
+        out, loss = layer(x, y)
+        assert np.isfinite(float(loss))
+
+    def test_pool_pad_wrappers(self):
+        rng = np.random.default_rng(4)
+        x2 = paddle.to_tensor(
+            rng.standard_normal((1, 2, 8, 8)).astype(np.float32))
+        assert paddle.nn.LPPool2D(2.0, 2)(x2).shape == [1, 2, 4, 4]
+        x1 = paddle.to_tensor(
+            rng.standard_normal((1, 2, 8)).astype(np.float32))
+        assert paddle.nn.LPPool1D(2.0, 2)(x1).shape == [1, 2, 4]
+        assert paddle.nn.FractionalMaxPool2D((4, 4))(x2).shape \
+            == [1, 2, 4, 4]
+        pooled, idx = paddle.nn.functional.max_pool2d(
+            x2, 2, return_mask=True)
+        un = paddle.nn.MaxUnPool2D(2)(pooled, idx)
+        assert un.shape == [1, 2, 8, 8]
+        z = paddle.nn.ZeroPad1D([1, 2])(x1)
+        assert z.shape == [1, 2, 11]
+        z3 = paddle.nn.ZeroPad3D([1, 1, 0, 0, 2, 0])(paddle.to_tensor(
+            rng.standard_normal((1, 1, 2, 3, 4)).astype(np.float32)))
+        assert z3.shape[-1] == 6
+        sm = paddle.nn.Softmax2D()(x2)
+        np.testing.assert_allclose(
+            np.asarray(sm._data).sum(1), 1.0, rtol=1e-5)
+        drop = paddle.nn.FeatureAlphaDropout(0.5)
+        drop.eval()
+        np.testing.assert_allclose(np.asarray(drop(x2)._data),
+                                   np.asarray(x2._data))
+
+    def test_spectral_norm_layer(self):
+        rng = np.random.default_rng(5)
+        w = paddle.to_tensor(
+            rng.standard_normal((6, 4)).astype(np.float32))
+        sn = paddle.nn.SpectralNorm(w.shape, power_iters=20)
+        wn = np.asarray(sn(w)._data)
+        s = np.linalg.svd(wn, compute_uv=False)
+        np.testing.assert_allclose(s.max(), 1.0, rtol=1e-3)
+
+    def test_rnn_drivers(self):
+        rng = np.random.default_rng(6)
+        cell = paddle.nn.GRUCell(4, 8)
+        rnn = paddle.nn.RNN(cell)
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 5, 4)).astype(np.float32))
+        out, state = rnn(x)
+        assert out.shape == [2, 5, 8]
+        # manual unroll must match
+        h = None
+        for t in range(5):
+            y, h = cell(paddle.Tensor._wrap(x._data[:, t]), h)
+        np.testing.assert_allclose(np.asarray(out._data)[:, -1],
+                                   np.asarray(y._data), atol=1e-5)
+
+        bi = paddle.nn.BiRNN(paddle.nn.GRUCell(4, 8),
+                             paddle.nn.GRUCell(4, 8))
+        out2, (sf, sb) = bi(x)
+        assert out2.shape == [2, 5, 16]
