@@ -20,3 +20,15 @@ from .auto_cast import (  # noqa: F401
 )
 from .grad_scaler import GradScaler, AmpScaler, OptimizerState  # noqa: F401
 from . import debugging  # noqa: F401
+
+
+def is_float16_supported(device=None):
+    """reference amp/__init__ is_float16_supported: TPUs compute in
+    bf16/fp32; fp16 storage works but matmul units prefer bf16."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def is_bfloat16_supported(device=None):
+    return True        # bf16 is the TPU-native half precision

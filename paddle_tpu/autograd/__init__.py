@@ -344,3 +344,39 @@ def hessian(func_or_ys, xs, batch_axis=None):
         hh = h[0][0] if isinstance(h, tuple) else h
         return Tensor._wrap(hh)
     return tuple(tuple(Tensor._wrap(c) for c in row) for row in h)
+
+
+class saved_tensors_hooks:
+    """reference autograd/saved_tensors_hooks — pack/unpack hooks for
+    activation residuals, a CUDA memory-pressure tool (offload saved
+    tensors to host and reload in backward).
+
+    TPU-first semantics (precise): with hooks active the eager tape
+    stores pack_hook(input) per op input and rebuilds the op's vjp from
+    unpack_hook at backward time — the vjp CLOSURE residuals (the
+    op-internal saved values jax.vjp would otherwise hold on device)
+    are never kept. Input tensors the tape routes gradients through
+    remain referenced by the graph itself, exactly as without hooks —
+    python liveness, not this context, owns those. Under whole-step XLA
+    compilation prefer the compiler's levers instead: jax.checkpoint
+    policies (GPTConfig.recompute_policy / fleet.recompute) and the
+    pinned-host offload knobs. Inside a trace both hooks see tracers
+    and must stay functional (no host round-trips).
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..framework import autograd as _ag
+
+        self._prev = getattr(_ag, "_saved_tensor_hooks", None)
+        _ag._saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..framework import autograd as _ag
+
+        _ag._saved_tensor_hooks = self._prev
+        return False

@@ -248,6 +248,10 @@ def run_backward(
     return captured
 
 
+# active (pack, unpack) pair installed by autograd.saved_tensors_hooks
+_saved_tensor_hooks = None
+
+
 def apply_op(fn, inputs, attrs=None, name="", num_outputs=None):
     """Execute `fn(*jax_arrays, **attrs)` and record a GradNode if needed.
 
@@ -262,7 +266,23 @@ def apply_op(fn, inputs, attrs=None, name="", num_outputs=None):
     datas = [t._data for t in inputs]
     needs_grad = is_grad_enabled() and any(not t.stop_gradient for t in inputs)
 
-    if needs_grad:
+    hooks = _saved_tensor_hooks
+    if needs_grad and hooks is not None:
+        # saved_tensors_hooks contract (autograd.saved_tensors_hooks):
+        # the tape keeps only pack_hook(input) per input and RECOMPUTES
+        # the op's vjp from unpack_hook at backward time — the genuine
+        # offload-saved-tensors semantics (recompute trades the fwd once
+        # more for whatever memory the pack moved off-device)
+        pack, unpack = hooks
+        f = (lambda *xs: fn(*xs, **attrs)) if attrs else fn
+        packed = [pack(d) for d in datas]
+        outs = fn(*datas, **attrs)
+
+        def vjp(cts, _f=f, _packed=packed, _unpack=unpack):
+            redone = [_unpack(p) for p in _packed]
+            _, inner = jax.vjp(_f, *redone)
+            return inner(cts)
+    elif needs_grad:
         f = (lambda *xs: fn(*xs, **attrs)) if attrs else fn
         outs, vjp = jax.vjp(f, *datas)
     else:

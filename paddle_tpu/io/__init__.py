@@ -154,6 +154,23 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class SubsetRandomSampler(Sampler):
+    """Sample a fixed index subset in random order (reference
+    io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        if len(indices) == 0:
+            raise ValueError("indices must be non-empty")
+        self.indices = list(indices)
+
+    def __iter__(self):
+        order = np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class WeightedRandomSampler(Sampler):
     def __init__(self, weights, num_samples, replacement=True):
         self.weights = np.asarray(weights, np.float64)

@@ -200,3 +200,33 @@ def maxout(x, groups, axis=1, name=None):
 
 def glu(x, axis=-1, name=None):
     return unary(lambda v: jax.nn.glu(v, axis=axis), x, "glu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    x._inplace_from(out)
+    return x
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    out = hardtanh(x, min, max)
+    x._inplace_from(out)
+    return x
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    out = leaky_relu(x, negative_slope)
+    x._inplace_from(out)
+    return x
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._inplace_from(out)
+    return x
+
+
+def thresholded_relu_(x, threshold=1.0, name=None):
+    out = thresholded_relu(x, threshold)
+    x._inplace_from(out)
+    return x

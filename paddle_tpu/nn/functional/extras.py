@@ -532,3 +532,65 @@ def flash_attention_with_sparse_mask(query, key, value,
     return scaled_dot_product_attention(query, key, value, attn_mask=mask,
                                         dropout_p=dropout_p, is_causal=False,
                                         training=training)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """CSR-masked attention (reference nn/functional/sparse_attention.py,
+    CUDA-11.3 kernel): softmax(QK^T/sqrt(d)) * V computed only at the
+    positions named by the per-(batch, head) CSR pattern.
+
+    TPU-first formulation: the CSR pattern densifies into a boolean mask
+    once (static nnz) and the whole op is the standard masked attention
+    einsum — on TPU the MXU prefers the dense computation and the mask
+    rides for free in the softmax; the memory win the CUDA kernel
+    targets comes from flash/ring attention here instead
+    (ops/pallas/flash_attention.py, meta_parallel/ring_attention.py).
+
+    Shapes (reference contract): q/k/v [b, h, s, d];
+    sparse_csr_offset [b, h, s+1]; sparse_csr_columns [b, h, nnz].
+    """
+    from ...ops._dispatch import nary
+
+    def f(q, k, v, offs, cols, *rest):
+        b, h, s, d = q.shape
+        # densify the CSR pattern: row r owns cols[offs[r]:offs[r+1]]
+        nnz = cols.shape[-1]
+        idx = jnp.arange(nnz)
+        # row id of each nnz slot: searchsorted over the offsets
+        row = jax.vmap(jax.vmap(
+            lambda o: jnp.searchsorted(o, idx, side="right") - 1))(offs)
+        mask = jnp.zeros((b, h, s, s), bool)
+        bidx = jnp.arange(b)[:, None, None]
+        hidx = jnp.arange(h)[None, :, None]
+        mask = mask.at[bidx, hidx, row, cols].set(True)
+        scores = jnp.einsum("bhqd,bhkd->bhqk",
+                            q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / jnp.sqrt(
+            jnp.float32(d))
+        i = 0
+        if key_padding_mask is not None:
+            kpm = rest[i]
+            i += 1
+            mask = mask & (kpm[:, None, None, :] != 0)
+        if attn_mask is not None:
+            am = rest[i]
+            mask = mask & (am[None, None] != 0 if am.ndim == 2
+                           else am != 0)
+        # finite fill (not -inf): an empty row would make softmax NaN
+        # and poison the BACKWARD through p * (ct - sum(p ct)) even with
+        # the forward where() — -1e9 keeps softmax finite and the
+        # where() zeroes dead rows in both directions
+        scores = jnp.where(mask, scores, -1e9)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(jnp.any(mask, -1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    args = [query, key, value, sparse_csr_offset, sparse_csr_columns]
+    if key_padding_mask is not None:
+        args.append(key_padding_mask)
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return nary(f, args, "sparse_attention")

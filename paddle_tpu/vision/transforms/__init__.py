@@ -210,3 +210,422 @@ def vflip(img):
 
 def center_crop(img, output_size):
     return CenterCrop(output_size)(img)
+
+
+# ---------------------------------------------------------------------------
+# r5: photometric + geometric batch completing the reference
+# vision/transforms surface (functional forms + class wrappers). All run
+# host-side on numpy HWC arrays, like the rest of this module — the
+# loader's transform stage is host work by design.
+# ---------------------------------------------------------------------------
+def adjust_brightness(img, brightness_factor):
+    """blend towards black (reference functional.adjust_brightness)."""
+    h = _as_hwc(img).astype(np.float32)
+    out = h * float(brightness_factor)
+    return _like(out, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    h = _as_hwc(img).astype(np.float32)
+    mean = _gray(h).mean()
+    out = mean + float(contrast_factor) * (h - mean)
+    return _like(out, img)
+
+
+def _gray(h):
+    if h.shape[-1] == 1:
+        return h[..., 0]
+    return (0.299 * h[..., 0] + 0.587 * h[..., 1] + 0.114 * h[..., 2])
+
+
+def _like(out, img):
+    ref = np.asarray(img)
+    if np.issubdtype(ref.dtype, np.integer):
+        return np.clip(np.round(out), 0, 255).astype(ref.dtype)
+    return out.astype(ref.dtype if ref.dtype.kind == "f" else np.float32)
+
+
+def adjust_saturation(img, saturation_factor):
+    h = _as_hwc(img).astype(np.float32)
+    g = _gray(h)[..., None]
+    out = g + float(saturation_factor) * (h - g)
+    return _like(out, img)
+
+
+def adjust_hue(img, hue_factor):
+    """rotate hue by hue_factor (in [-0.5, 0.5] turns) via HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    h = _as_hwc(img).astype(np.float32)
+    scale = 255.0 if np.issubdtype(np.asarray(img).dtype,
+                                   np.integer) else 1.0
+    rgb = h[..., :3] / scale
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn
+    hue = np.zeros_like(mx)
+    m = diff > 0
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    idx = (mx == r) & m
+    hue[idx] = ((g - b)[idx] / diff[idx]) % 6
+    idx = (mx == g) & m
+    hue[idx] = (b - r)[idx] / diff[idx] + 2
+    idx = (mx == b) & m
+    hue[idx] = (r - g)[idx] / diff[idx] + 4
+    hue = (hue / 6.0 + hue_factor) % 1.0
+    sat = np.where(mx > 0, diff / np.maximum(mx, 1e-12), 0.0)
+    # HSV -> RGB
+    i = np.floor(hue * 6.0)
+    f = hue * 6.0 - i
+    p = mx * (1 - sat)
+    q = mx * (1 - sat * f)
+    t = mx * (1 - sat * (1 - f))
+    i = i.astype(np.int32) % 6
+    out = np.choose(i[..., None],
+                    [np.stack([mx, t, p], -1), np.stack([q, mx, p], -1),
+                     np.stack([p, mx, t], -1), np.stack([p, q, mx], -1),
+                     np.stack([t, p, mx], -1), np.stack([mx, p, q], -1)])
+    return _like(out * scale, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    h = _as_hwc(img).astype(np.float32)
+    g = _gray(h)[..., None]
+    out = np.repeat(g, num_output_channels, axis=-1)
+    return _like(out, img)
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    h = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    spec = ((pt, pb), (pl, pr), (0, 0))
+    if padding_mode == "constant":
+        return np.pad(h, spec, mode="constant", constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(h, spec, mode=mode)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    hwc = out if out.ndim == 3 and out.shape[-1] <= 4 else None
+    if hwc is not None:                 # HWC layout
+        out[i:i + h, j:j + w] = v
+    else:                               # CHW layout
+        out[..., i:i + h, j:j + w] = v
+    return out
+
+
+def _sample_at(h, sx, sy, fill=0, interpolation="bilinear"):
+    """Inverse-map sampling at per-pixel source coordinates (sx, sy) —
+    the one warp kernel shared by rotate / affine / perspective."""
+    H, W = h.shape[:2]
+    if interpolation == "nearest":
+        xi = np.round(sx).astype(np.int32)
+        yi = np.round(sy).astype(np.int32)
+        valid = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+        out = h[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)]
+        return np.where(valid[..., None], out, np.float32(fill))
+    if interpolation != "bilinear":
+        raise ValueError(
+            f"unsupported interpolation {interpolation!r} "
+            "(bilinear/nearest)")
+    x0 = np.floor(sx).astype(np.int32)
+    y0 = np.floor(sy).astype(np.int32)
+    wx = sx - x0
+    wy = sy - y0
+    out = np.zeros(sx.shape + (h.shape[2],), np.float32)
+    total_w = np.zeros(sx.shape + (1,), np.float32)
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xi = x0 + dx
+            yi = y0 + dy
+            valid = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+            wgt = (np.where(dx, wx, 1 - wx)
+                   * np.where(dy, wy, 1 - wy)) * valid
+            out += (h[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)]
+                    * wgt[..., None])
+            total_w += wgt[..., None]
+    return out + (1 - total_w) * fill
+
+
+def _affine_grid_sample(h, matrix, out_shape=None, fill=0,
+                        interpolation="bilinear"):
+    """2x3 affine inverse map (output -> input coords) over _sample_at."""
+    H, W = h.shape[:2]
+    oh, ow = out_shape or (H, W)
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    a, b, c, d, e, f = matrix
+    return _sample_at(h, a * xs + b * ys + c, d * xs + e * ys + f,
+                      fill=fill, interpolation=interpolation)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False,
+           center=None, fill=0):
+    h = _as_hwc(img).astype(np.float32)
+    H, W = h.shape[:2]
+    cx, cy = center if center is not None else ((W - 1) / 2,
+                                                (H - 1) / 2)
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    oh, ow, ox, oy = H, W, 0.0, 0.0
+    if expand:
+        # canvas large enough for the rotated corners; keep the rotation
+        # centered in the new canvas
+        ow = int(np.ceil(abs(W * cos) + abs(H * sin)))
+        oh = int(np.ceil(abs(W * sin) + abs(H * cos)))
+        ox = (ow - 1) / 2 - cx
+        oy = (oh - 1) / 2 - cy
+    # inverse rotation about (cx, cy), output shifted by (ox, oy)
+    mat = (cos, sin, cx - cos * (cx + ox) - sin * (cy + oy),
+           -sin, cos, cy + sin * (cx + ox) - cos * (cy + oy))
+    out = _affine_grid_sample(h, mat, out_shape=(oh, ow), fill=fill,
+                              interpolation=interpolation)
+    return _like(out, img)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    h = _as_hwc(img).astype(np.float32)
+    H, W = h.shape[:2]
+    cx, cy = center if center is not None else ((W - 1) / 2,
+                                                (H - 1) / 2)
+    rad = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    # forward matrix: translate(center) . rot/shear/scale . translate(-center) . translate(t)
+    a = scale * np.cos(rad + sy) / np.cos(sy)
+    b = scale * (np.cos(rad + sy) * np.tan(sx) / np.cos(sy)
+                 - np.sin(rad))
+    c = scale * np.sin(rad + sy) / np.cos(sy)
+    d = scale * (np.sin(rad + sy) * np.tan(sx) / np.cos(sy)
+                 + np.cos(rad))
+    fwd = np.array([[a, b, 0], [c, d, 0], [0, 0, 1]], np.float32)
+    pre = np.array([[1, 0, -cx - translate[0]],
+                    [0, 1, -cy - translate[1]], [0, 0, 1]], np.float32)
+    post = np.array([[1, 0, cx], [0, 1, cy], [0, 0, 1]], np.float32)
+    inv = np.linalg.inv(post @ fwd @ pre)
+    mat = (inv[0, 0], inv[0, 1], inv[0, 2],
+           inv[1, 0], inv[1, 1], inv[1, 2])
+    return _like(_affine_grid_sample(h, mat, fill=fill,
+                                     interpolation=interpolation), img)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """4-point perspective warp (reference functional.perspective):
+    solve the homography end->start and inverse-sample."""
+    h = _as_hwc(img).astype(np.float32)
+    A, bvec = [], []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec += [sx, sy]
+    coef = np.linalg.solve(np.asarray(A, np.float32),
+                           np.asarray(bvec, np.float32))
+    H_, W_ = h.shape[:2]
+    ys, xs = np.meshgrid(np.arange(H_, dtype=np.float32),
+                         np.arange(W_, dtype=np.float32), indexing="ij")
+    den = coef[6] * xs + coef[7] * ys + 1.0
+    sxm = (coef[0] * xs + coef[1] * ys + coef[2]) / den
+    sym = (coef[3] * xs + coef[4] * ys + coef[5]) / den
+    return _like(_sample_at(h, sxm, sym, fill=fill,
+                            interpolation=interpolation), img)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value),
+                              1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value),
+                              1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value),
+                              1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value,
+                                                 self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self._ts = [BrightnessTransform(brightness),
+                    ContrastTransform(contrast),
+                    SaturationTransform(saturation),
+                    HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(4)
+        for i in order:
+            img = self._ts[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        ang = np.random.uniform(*self.degrees)
+        return rotate(img, ang, center=self.center, fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None,
+                 keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        H, W = _as_hwc(img).shape[:2]
+        ang = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * W
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * H
+        sc = (np.random.uniform(*self.scale)
+              if self.scale is not None else 1.0)
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, numbers.Number):
+                s = (-abs(s), abs(s))
+            sh = (np.random.uniform(s[0], s[1]), 0.0)
+        return affine(img, angle=ang, translate=(tx, ty), scale=sc,
+                      shear=sh, fill=self.fill, center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return img
+        H, W = _as_hwc(img).shape[:2]
+        d = self.distortion_scale
+
+        def jig(x, y, sx, sy):
+            return (x + sx * np.random.uniform(0, d * W / 2),
+                    y + sy * np.random.uniform(0, d * H / 2))
+
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [jig(0, 0, 1, 1), jig(W - 1, 0, -1, 1),
+               jig(W - 1, H - 1, -1, -1), jig(0, H - 1, 1, -1)]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        hwc = arr.ndim == 3 and arr.shape[-1] <= 4
+        H, W = (arr.shape[:2] if hwc or arr.ndim == 2
+                else arr.shape[-2:])
+        area = H * W
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < H and ew < W:
+                i = np.random.randint(0, H - eh)
+                j = np.random.randint(0, W - ew)
+                return erase(img, i, j, eh, ew, self.value,
+                             inplace=self.inplace)
+        return img

@@ -174,3 +174,46 @@ class TestPyLayer:
         y.sum().backward()
         np.testing.assert_allclose(y.numpy(), [6.0])
         np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestSavedTensorsHooks:
+    """r5 (reference autograd.saved_tensors_hooks): with hooks active the
+    tape saves pack_hook(input) and recomputes the op's vjp from
+    unpack_hook at backward time — gradients identical, hooks observed."""
+
+    def test_pack_unpack_roundtrip_grads_match(self):
+        import paddle_tpu.autograd as AG
+
+        x = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        want = np.asarray(x.grad._data).copy()
+
+        calls = {"pack": 0, "unpack": 0}
+
+        def pack(d):
+            calls["pack"] += 1
+            return np.asarray(d)        # "offload": device -> host numpy
+
+        def unpack(p):
+            calls["unpack"] += 1
+            import jax.numpy as jnp
+
+            return jnp.asarray(p)
+
+        x2 = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32),
+                              stop_gradient=False)
+        with AG.saved_tensors_hooks(pack, unpack):
+            y2 = (x2 * x2).sum()
+        y2.backward()
+        np.testing.assert_allclose(np.asarray(x2.grad._data), want)
+        assert calls["pack"] > 0 and calls["unpack"] > 0
+
+    def test_hooks_scope_ends(self):
+        import paddle_tpu.autograd as AG
+        from paddle_tpu.framework import autograd as fag
+
+        with AG.saved_tensors_hooks(lambda d: d, lambda p: p):
+            assert fag._saved_tensor_hooks is not None
+        assert fag._saved_tensor_hooks is None

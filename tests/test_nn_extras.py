@@ -390,3 +390,65 @@ class TestLayerWrappersR5:
                              paddle.nn.GRUCell(4, 8))
         out2, (sf, sb) = bi(x)
         assert out2.shape == [2, 5, 16]
+
+
+class TestInplaceAndSparseAttention:
+    def test_inplace_activation_variants(self):
+        import paddle_tpu.nn.functional as F
+
+        for name, ref in [("tanh_", np.tanh),
+                          ("elu_", lambda v: np.where(
+                              v > 0, v, np.expm1(v))),
+                          ("leaky_relu_", lambda v: np.where(
+                              v > 0, v, 0.01 * v)),
+                          ("hardtanh_", lambda v: np.clip(v, -1, 1)),
+                          ("thresholded_relu_", lambda v: np.where(
+                              v > 1.0, v, 0.0))]:
+            x = paddle.to_tensor(
+                np.asarray([-2.0, -0.5, 0.5, 2.0], np.float32))
+            out = getattr(F, name)(x)
+            assert out is x                     # in-place contract
+            np.testing.assert_allclose(
+                np.asarray(x._data),
+                ref(np.asarray([-2.0, -0.5, 0.5, 2.0], np.float32)),
+                rtol=1e-6, err_msg=name)
+
+    def test_sparse_attention_matches_dense_on_full_pattern(self):
+        import scipy.special as sps
+
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(0)
+        b, h, s, d = 1, 2, 4, 8
+        q, k, v = (rng.standard_normal((b, h, s, d)).astype(np.float32)
+                   for _ in range(3))
+        # full CSR pattern == dense attention
+        offs = np.tile(np.arange(0, s * s + 1, s, dtype=np.int32),
+                       (b, h, 1))
+        cols = np.tile(np.tile(np.arange(s, dtype=np.int32), s),
+                       (b, h, 1))
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(offs),
+            paddle.to_tensor(cols))
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        want = np.einsum("bhqk,bhkd->bhqd",
+                         sps.softmax(scores, -1), v)
+        np.testing.assert_allclose(np.asarray(out._data), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sparse_attention_banded_pattern(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(1)
+        b, h, s, d = 1, 1, 4, 4
+        q, k, v = (rng.standard_normal((b, h, s, d)).astype(np.float32)
+                   for _ in range(3))
+        # diagonal-only pattern: each row attends to itself => out == v
+        offs = np.tile(np.arange(s + 1, dtype=np.int32), (b, h, 1))
+        cols = np.tile(np.arange(s, dtype=np.int32), (b, h, 1))
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(offs),
+            paddle.to_tensor(cols))
+        np.testing.assert_allclose(np.asarray(out._data), v, rtol=1e-5)

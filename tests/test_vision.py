@@ -173,3 +173,118 @@ class TestNewModelFamilies:
         from paddle_tpu.vision.models import shufflenet_v2_x0_5
 
         self._check(shufflenet_v2_x0_5(num_classes=10), size=64)
+
+
+class TestTransformsBatchR5:
+    """r5: the photometric/geometric transforms batch — numeric checks
+    for the deterministic functionals, semantic checks for the random
+    wrappers."""
+
+    def _img(self):
+        rng = np.random.default_rng(0)
+        return rng.integers(0, 256, (8, 10, 3)).astype(np.uint8)
+
+    def test_adjust_brightness_contrast_saturation(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+        dark = T.adjust_brightness(img, 0.5)
+        assert dark.mean() < img.mean()
+        np.testing.assert_array_equal(T.adjust_contrast(img, 1.0), img)
+        flat = T.adjust_contrast(img, 0.0)
+        assert flat.std() < 1.0                  # collapses to the mean
+        np.testing.assert_array_equal(T.adjust_saturation(img, 1.0), img)
+        gray = T.adjust_saturation(img, 0.0)
+        assert np.abs(gray[..., 0].astype(int)
+                      - gray[..., 1].astype(int)).max() <= 1
+
+    def test_adjust_hue_identity_and_range(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        same = T.adjust_hue(img, 0.0)
+        assert np.abs(same.astype(int) - img.astype(int)).max() <= 2
+        rot = T.adjust_hue(img, 0.25)
+        assert rot.dtype == img.dtype and rot.shape == img.shape
+
+    def test_grayscale_crop_pad_erase(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        g3 = T.to_grayscale(img, 3)
+        assert (g3[..., 0] == g3[..., 1]).all()
+        c = T.crop(img, 2, 3, 4, 5)
+        np.testing.assert_array_equal(c, img[2:6, 3:8])
+        p = T.pad(img, 2)
+        assert p.shape == (12, 14, 3) and p[0, 0, 0] == 0
+        p2 = T.pad(img, (1, 2, 3, 4), padding_mode="edge")
+        assert p2.shape == (8 + 2 + 4, 10 + 1 + 3, 3)
+        e = T.erase(img, 1, 2, 3, 4, 7)
+        assert (e[1:4, 2:6] == 7).all()
+        np.testing.assert_array_equal(e[0], img[0])
+
+    def test_rotate_affine_perspective(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        # 360-degree rotation is identity (up to bilinear rounding)
+        r = T.rotate(img, 360.0)
+        assert np.abs(r.astype(int) - img.astype(int)).max() <= 2
+        # identity affine
+        a = T.affine(img)
+        assert np.abs(a.astype(int) - img.astype(int)).max() <= 2
+        # identity perspective (start == end)
+        pts = [(0, 0), (9, 0), (9, 7), (0, 7)]
+        pp = T.perspective(img, pts, pts)
+        assert np.abs(pp.astype(int) - img.astype(int)).max() <= 2
+        # a 90-degree rotation about the center permutes, not destroys
+        sq = self._img()[:8, :8]
+        r90 = T.rotate(sq, 90.0)
+        np.testing.assert_allclose(
+            np.sort(r90[1:-1, 1:-1].ravel()),
+            np.sort(np.rot90(sq)[1:-1, 1:-1].ravel()))
+
+    def test_random_wrappers_semantics(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        np.random.seed(0)
+        assert T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img).shape == img.shape
+        assert T.Grayscale(3)(img).shape == img.shape
+        assert T.Pad(1)(img).shape == (10, 12, 3)
+        assert T.RandomRotation(30)(img).shape == img.shape
+        assert T.RandomAffine(10, translate=(0.1, 0.1),
+                              scale=(0.9, 1.1), shear=5)(img).shape \
+            == img.shape
+        out = T.RandomPerspective(prob=1.0)(img)
+        assert out.shape == img.shape
+        erased = T.RandomErasing(prob=1.0, value=9)(img)
+        assert (erased == 9).any()
+
+    def test_rotate_expand_and_nearest(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        r = T.rotate(img, 45.0, expand=True)
+        assert r.shape[0] > img.shape[0] and r.shape[1] > img.shape[1]
+        # expand must not crop: pixel mass is preserved (up to blending)
+        assert r.astype(np.int64).sum() > 0.9 * img.astype(
+            np.int64).sum()
+        sq = img[:8, :8]
+        n = T.rotate(sq, 90.0, interpolation="nearest")
+        np.testing.assert_array_equal(
+            np.sort(n.ravel()), np.sort(np.rot90(sq).ravel()))
+        import pytest as _p
+        with _p.raises(ValueError, match="interpolation"):
+            T.rotate(img, 10.0, interpolation="bicubic")
+
+    def test_photometric_factor_lower_bound(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        np.random.seed(1)
+        # value > 1 must never produce a negative factor (black/inverted)
+        for _ in range(10):
+            out = T.BrightnessTransform(3.0)(img)
+            assert out.mean() >= 0
