@@ -19,7 +19,7 @@ from ..ops._dispatch import ensure_tensor, nary
 
 __all__ = [
     "weighted_sample_neighbors",
-    "send_u_recv", "send_ue_recv", "send_uv",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_heter_graph",
     "segment_sum", "segment_mean", "segment_min", "segment_max",
     "reindex_graph", "sample_neighbors",
 ]
@@ -162,6 +162,30 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     out_nodes = np.asarray(sorted(order, key=order.get), dtype=np.int64)
     return (Tensor._wrap(jnp.asarray(reindex_src)),
             Tensor._wrap(jnp.asarray(reindex_dst)),
+            Tensor._wrap(jnp.asarray(out_nodes)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous reindex (reference reindex_heter_graph): neighbors/
+    count are per-edge-type LISTS; one shared id mapping (x first, then
+    first-seen neighbor order across types), per-type reindexed edges."""
+    xs = np.asarray(ensure_tensor(x)._data)
+    order = {int(v): i for i, v in enumerate(xs)}
+    nxt = len(order)
+    srcs, dsts = [], []
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = np.asarray(ensure_tensor(nb_t)._data)
+        for v in nb:
+            if int(v) not in order:
+                order[int(v)] = nxt
+                nxt += 1
+        srcs.append(np.asarray([order[int(v)] for v in nb], np.int64))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64),
+                              np.asarray(ensure_tensor(cnt_t)._data)))
+    out_nodes = np.asarray(sorted(order, key=order.get), dtype=np.int64)
+    return (Tensor._wrap(jnp.asarray(np.concatenate(srcs))),
+            Tensor._wrap(jnp.asarray(np.concatenate(dsts))),
             Tensor._wrap(jnp.asarray(out_nodes)))
 
 

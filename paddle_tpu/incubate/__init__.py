@@ -8,3 +8,65 @@ from ..geometric import (  # noqa: E402,F401
 from .nn.functional import (  # noqa: E402,F401
     softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
 )
+from .optimizer import LookAhead, ModelAverage, identity_loss  # noqa: E402,F401
+# graph_* legacy aliases (reference incubate/graph_khop_sampler.py etc. —
+# the modern surface lives in paddle.geometric)
+from ..geometric import (  # noqa: E402,F401
+    send_u_recv as graph_send_recv,
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+)
+from .. import inference  # noqa: E402,F401
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference incubate/operators/
+    graph_khop_sampler.py) composed from per-hop sample_neighbors:
+    returns (edge_src, edge_dst, sample_index, reindex_x) over the union
+    of all hops, like the reference's fused kernel."""
+    import numpy as np
+
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    from ..geometric import sample_neighbors, reindex_graph
+
+    frontier = input_nodes
+    all_src, all_cnt = [], []
+    x_np = np.asarray(input_nodes._data
+                      if isinstance(input_nodes, Tensor)
+                      else input_nodes).reshape(-1)
+    seen = list(x_np)
+    seen_set = set(int(v) for v in x_np)
+    for size in sample_sizes:
+        nbr, cnt = sample_neighbors(row, colptr, frontier,
+                                    sample_size=int(size))
+        all_src.append(np.asarray(nbr._data))
+        all_cnt.append((np.asarray(frontier._data
+                                   if isinstance(frontier, Tensor)
+                                   else frontier).reshape(-1),
+                        np.asarray(cnt._data)))
+        fresh = []
+        for v in np.asarray(nbr._data).reshape(-1):
+            vi = int(v)
+            if vi not in seen_set:
+                seen_set.add(vi)
+                fresh.append(vi)
+        seen += fresh
+        frontier = Tensor._wrap(jnp.asarray(
+            np.asarray(fresh, np.int64)))
+        if frontier.shape[0] == 0:
+            break
+    srcs = np.concatenate([s.reshape(-1) for s in all_src])         if all_src else np.zeros((0,), np.int64)
+    dsts = np.concatenate([np.repeat(f, c) for f, c in all_cnt])         if all_cnt else np.zeros((0,), np.int64)
+    order = {int(v): i for i, v in enumerate(seen)}
+    r_src = np.asarray([order[int(v)] for v in srcs], np.int64)
+    r_dst = np.asarray([order[int(v)] for v in dsts], np.int64)
+    nodes = np.asarray(seen, np.int64)
+    # reference 4-tuple: (edge_src, edge_dst, sample_index, reindex_x) —
+    # reindex_x is the INPUT nodes' positions in the new id space
+    reindex_x = np.asarray([order[int(v)] for v in x_np], np.int64)
+    return (Tensor._wrap(jnp.asarray(r_src)),
+            Tensor._wrap(jnp.asarray(r_dst)),
+            Tensor._wrap(jnp.asarray(nodes)),
+            Tensor._wrap(jnp.asarray(reindex_x)))

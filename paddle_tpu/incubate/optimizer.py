@@ -1,0 +1,188 @@
+"""paddle.incubate optimizers — LookAhead and ModelAverage.
+
+Reference parity: python/paddle/incubate/optimizer/lookahead.py:27 and
+modelaverage.py:31. Both are WRAPPERS around parameter state rather than
+new update rules, so they compose with any inner optimizer (and with
+TrainStep, whose traced-state protocol they honor by storing every
+numeric in plain jax arrays keyed off the param list).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import no_grad
+from ..framework.tensor import Tensor
+
+
+class LookAhead:
+    """k steps forward, one step back (Zhang et al. 2019; reference
+    lookahead.py): after every ``k`` inner steps the slow weights pull
+    toward the fast weights by ``alpha`` and the fast weights reset to
+    the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None      # id(param) -> fp32 slow weights
+
+    def _params(self):
+        return [p for p in self.inner_optimizer._parameter_list
+                if p is not None]
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        params = self._params()
+        if self._slow is None:
+            self._slow = {id(p): p._data.astype(jnp.float32)
+                          for p in params}
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (
+                    p._data.astype(jnp.float32) - slow)
+                self._slow[id(p)] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        import numpy as np
+
+        sd = self.inner_optimizer.state_dict()
+        params = self._params()
+        sd["lookahead"] = {
+            "step": self._step_count,
+            # keyed by PARAM ORDER (stable across save/load — id() isn't)
+            "slow": {str(i): np.asarray(self._slow[id(p)])
+                     for i, p in enumerate(params)}
+            if self._slow is not None else {},
+        }
+        return sd
+
+    def set_state_dict(self, sd):
+        la = sd.pop("lookahead", None) if isinstance(sd, dict) else None
+        self.inner_optimizer.set_state_dict(sd)
+        if la:
+            self._step_count = int(la.get("step", 0))
+            slow = la.get("slow") or {}
+            if slow:
+                params = self._params()
+                self._slow = {id(p): jnp.asarray(slow[str(i)])
+                              for i, p in enumerate(params)
+                              if str(i) in slow}
+
+    load_state_dict = set_state_dict
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+class ModelAverage:
+    """Running average of parameters (reference modelaverage.py): keeps
+    accumulating sums of the trained weights; `apply()` swaps the
+    averaged weights in for evaluation, `restore()` swaps the trained
+    ones back. The window logic follows the reference: the accumulator
+    restarts once ``num_accumulates`` exceeds ``max_average_window``."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._parameter_list = list(parameters or [])
+        self._sum = {id(p): jnp.zeros(p._data.shape, jnp.float32)
+                     for p in self._parameter_list}
+        self._old_sum = {id(p): jnp.zeros(p._data.shape, jnp.float32)
+                         for p in self._parameter_list}
+        self._num = 0
+        self._old_num = 0
+        self._global_step = 0
+        self._backup = None
+
+    @no_grad()
+    def step(self):
+        """Accumulate the CURRENT weights (call after the training
+        optimizer's step)."""
+        self._global_step += 1
+        window = max(self.min_window,
+                     min(self.max_window,
+                         int(self._global_step * self.avg_rate) or 1))
+        if self._num >= window:
+            # roll the accumulator (reference sum_1/sum_2 rotation)
+            self._old_sum = self._sum
+            self._old_num = self._num
+            self._sum = {k: jnp.zeros_like(v)
+                         for k, v in self._sum.items()}
+            self._num = 0
+        for p in self._parameter_list:
+            self._sum[id(p)] = self._sum[id(p)] \
+                + p._data.astype(jnp.float32)
+        self._num += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, None
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager friendly)."""
+        total = self._num + self._old_num
+        if total == 0:
+            raise RuntimeError("ModelAverage.apply before any step()")
+        self._backup = {id(p): p._data for p in self._parameter_list}
+        for p in self._parameter_list:
+            avg = (self._sum[id(p)] + self._old_sum[id(p)]) / total
+            p._data = avg.astype(p._data.dtype)
+        self._need_restore = need_restore
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+        return False
+
+    @no_grad()
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+
+def identity_loss(x, reduction="none"):
+    """reference incubate.identity_loss — marks a tensor as the loss for
+    backend schedulers (IPU there); here it is the reduction itself."""
+    from .. import ops
+
+    if isinstance(reduction, int):
+        reduction = {0: "sum", 1: "mean", 2: "none"}.get(reduction,
+                                                         "none")
+    x = x if isinstance(x, Tensor) else Tensor._wrap(jnp.asarray(x))
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    return x

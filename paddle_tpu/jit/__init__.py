@@ -22,7 +22,8 @@ from .train_step import TrainStep, _tree_data, _tree_wrap
 from .fused_scan_step import FusedScanTrainStep
 
 __all__ = ["to_static", "TrainStep", "FusedScanTrainStep", "not_to_static",
-           "ignore_module", "save", "load"]
+           "ignore_module", "save", "load", "enable_to_static",
+           "set_code_level", "set_verbosity"]
 
 
 class StaticFunction:
@@ -94,7 +95,7 @@ class StaticFunction:
         if kwargs:
             raise TypeError("to_static-compiled callables take positional "
                             "Tensor args only")
-        if self._eager:
+        if self._eager or not _to_static_enabled:
             return self._orig_fn(*args)
         batch = _tree_data(list(args))
         leaves, treedef = jax.tree_util.tree_flatten(batch)
@@ -369,3 +370,26 @@ def load(path, **config):
     return TranslatedLayer(exported, params, buffers,
                            num_inputs=meta.get("num_inputs"),
                            num_outputs=meta.get("num_outputs"))
+
+
+# -- dy2static debug toggles (reference jit/api.py enable_to_static,
+# jit/dy2static/logging_utils.py set_code_level/set_verbosity) ------------
+_to_static_enabled = True
+
+
+def enable_to_static(flag=True):
+    """Globally enable/disable to_static conversion (a disabled
+    StaticFunction runs its original eager function)."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference logging_utils.set_code_level — print the transformed
+    code of subsequently-converted functions; level 0 disables."""
+    dy2static._code_level = int(level) if int(level) > 0 else None
+    dy2static._code_to_stdout = bool(also_to_stdout)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    dy2static._verbosity = int(level)

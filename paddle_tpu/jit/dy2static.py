@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import ast
 import functools
+import sys
 import inspect
 import textwrap
 import warnings
@@ -765,6 +766,12 @@ def convert_function(fn):
     # mangle the def name so exec-ing into the LIVE module globals (needed
     # so later rebinding of module globals stays visible, matching eager
     # semantics) cannot clobber the original function's binding
+    if getattr(sys.modules[__name__], "_code_level", None) is not None:
+        ast.fix_missing_locations(fdef)
+        stream = (sys.stdout
+                  if getattr(sys.modules[__name__], "_code_to_stdout",
+                             False) else sys.stderr)
+        print(ast.unparse(fdef), file=stream)
     mangled = f"__dy2st_fn_{fdef.name}"
     fdef.name = mangled
     ast.fix_missing_locations(tree)

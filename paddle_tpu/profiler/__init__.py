@@ -359,3 +359,38 @@ def profile_step(name: str = "train_step"):
     t0 = time.perf_counter_ns()
     yield
     _recorder.record(name, t0, time.perf_counter_ns())
+
+
+class SortedKeys(enum.Enum):
+    """reference profiler.SortedKeys — summary_sort key choices."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """reference profiler.SummaryView — summary table choices."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name="profiler_log", worker_name=None):
+    """reference profiler.export_protobuf scheduler-callback factory.
+    The TPU backend's native trace format is chrome tracing / the jax
+    profiler's TensorBoard protobufs — this returns a callback that
+    routes through export_chrome_tracing and notes the format."""
+    return export_chrome_tracing(dir_name, worker_name)

@@ -9,6 +9,22 @@ Pinning jax_default_device to cpu:0 keeps tests hermetic and fast; tests
 that want the real chip opt in explicitly.
 """
 import os
+import sys
+
+# Strip the axon plugin ENTIRELY (the dryrun's hermetic recipe,
+# __graft_entry__.py): the suite never needs the remote chip, and a
+# wedged tunnel otherwise HANGS jax backend init — observed r5 when a
+# process was killed during the claim leg; every later jax.devices()
+# call in every process blocked indefinitely, taking pytest down with
+# it via this file.
+for _k in list(os.environ):
+    if _k.upper().startswith(("AXON_", "PALLAS_AXON", "TPU_", "LIBTPU")):
+        os.environ.pop(_k)
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and ".axon_site" not in p.lower())
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p.lower()]
+sys.modules.pop("axon", None)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
@@ -18,6 +34,17 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# the axon plugin registers at INTERPRETER start (sitecustomize on
+# PYTHONPATH), before this file can strip the env — deregister its
+# factory so backend init can neither hang on a wedged tunnel nor
+# raise for missing config (r5)
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+# sitecustomize's register() can pin jax_platforms='axon' at the CONFIG
+# level (overriding the env var) — force cpu after deregistration
+jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
