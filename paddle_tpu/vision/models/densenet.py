@@ -1,0 +1,97 @@
+"""DenseNet (Huang et al., 2017). Reference parity surface:
+python/paddle/vision/models/densenet.py; architecture from the paper
+(dense blocks of BN-ReLU-1x1 + BN-ReLU-3x3 layers with concat growth,
+half-compression transitions)."""
+from __future__ import annotations
+
+from ... import nn
+
+_CFG = {
+    121: (6, 12, 24, 16),
+    161: (6, 12, 36, 24),
+    169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32),
+    264: (6, 12, 64, 48),
+}
+_GROWTH = {121: 32, 161: 48, 169: 32, 201: 32, 264: 32}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size=4):
+        super().__init__()
+        self.branch = nn.Sequential(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                      bias_attr=False))
+
+    def forward(self, x):
+        from ... import ops
+
+        return ops.concat([x, self.branch(x)], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, inp, out):
+        super().__init__(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, out, 1, bias_attr=False),
+            nn.AvgPool2D(2, stride=2))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers not in _CFG:
+            raise ValueError(f"unsupported densenet depth {layers}")
+        block_cfg = _CFG[layers]
+        growth = _GROWTH[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        init_ch = 2 * growth
+        feats = [nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_ch), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        ch = init_ch
+        for i, n_layers in enumerate(block_cfg):
+            for _ in range(n_layers):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _make(layers):
+    def f(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError(
+                "pretrained weights need egress; load a state_dict "
+                "instead")
+        return DenseNet(layers=layers, **kwargs)
+
+    return f
+
+
+densenet121 = _make(121)
+densenet161 = _make(161)
+densenet169 = _make(169)
+densenet201 = _make(201)
+densenet264 = _make(264)

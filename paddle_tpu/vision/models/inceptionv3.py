@@ -1,0 +1,150 @@
+"""Inception v3 (Szegedy et al., 2016). Reference parity surface:
+python/paddle/vision/models/inceptionv3.py; architecture from the paper
+(factorized 7x7, grid-reduction blocks, expanded-filter-bank tail)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class _ConvBN(nn.Sequential):
+    def __init__(self, inp, out, kernel, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(inp, out, kernel, stride=stride, padding=padding,
+                      bias_attr=False),
+            nn.BatchNorm2D(out), nn.ReLU())
+
+
+def _cat(parts):
+    from ... import ops
+
+    return ops.concat(parts, axis=1)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, inp, pool_ch):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(inp, 48, 1),
+                                _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(inp, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(inp, pool_ch, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)])
+
+
+class _ReductionA(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = _ConvBN(inp, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBN(inp, 64, 1),
+                                 _ConvBN(64, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.pool(x)])
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, inp, mid):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(inp, mid, 1),
+            _ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+            _ConvBN(mid, 192, (7, 1), padding=(3, 0)))
+        self.b77 = nn.Sequential(
+            _ConvBN(inp, mid, 1),
+            _ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+            _ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+            _ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+            _ConvBN(mid, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(inp, 192, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b77(x), self.bp(x)])
+
+
+class _ReductionB(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(inp, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(inp, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 320, 1)
+        self.b3_stem = _ConvBN(inp, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b33_stem = nn.Sequential(_ConvBN(inp, 448, 1),
+                                      _ConvBN(448, 384, 3, padding=1))
+        self.b33_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b33_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _ConvBN(inp, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        t = self.b33_stem(x)
+        return _cat([self.b1(x),
+                     _cat([self.b3_a(s), self.b3_b(s)]),
+                     _cat([self.b33_a(t), self.b33_b(t)]),
+                     self.bp(x)])
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _ReductionA(288),
+            _InceptionB(768, 128), _InceptionB(768, 160),
+            _InceptionB(768, 160), _InceptionB(768, 192),
+            _ReductionB(768),
+            _InceptionC(1280), _InceptionC(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights need egress; load a state_dict instead")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,60 @@
+"""MobileNetV1 (Howard et al., 2017). Reference parity surface:
+python/paddle/vision/models/mobilenetv1.py; architecture from the paper
+(13 depthwise-separable blocks after a stride-2 stem)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, inp, out, kernel=3, stride=1, groups=1):
+        super().__init__(
+            nn.Conv2D(inp, out, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(out), nn.ReLU())
+
+
+class _DepthwiseSeparable(nn.Sequential):
+    def __init__(self, inp, out, stride):
+        super().__init__(
+            _ConvBNReLU(inp, inp, 3, stride=stride, groups=inp),
+            _ConvBNReLU(inp, out, 1))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+               (512, 2)] + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
+        layers = [_ConvBNReLU(3, c(32), stride=2)]
+        inp = c(32)
+        for out, stride in cfg:
+            layers.append(_DepthwiseSeparable(inp, c(out), stride))
+            inp = c(out)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights need egress; load a state_dict instead")
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,149 @@
+"""MobileNetV3 Large/Small (Howard et al., 2019). Reference parity
+surface: python/paddle/vision/models/mobilenetv3.py; architecture from
+the paper (inverted residuals with optional squeeze-excite and
+hard-swish)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _divisible(v, d=8):
+    out = max(d, int(v + d / 2) // d * d)
+    if out < 0.9 * v:
+        out += d
+    return out
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, r=4):
+        super().__init__()
+        mid = _divisible(ch // r)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        s = x.mean(axis=[2, 3], keepdim=True)
+        s = F.relu(self.fc1(s))
+        return x * F.hardsigmoid(self.fc2(s))
+
+
+class _Act(nn.Layer):
+    def __init__(self, kind):
+        super().__init__()
+        self.kind = kind
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        return F.hardswish(x) if self.kind == "HS" else F.relu(x)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, exp, out, kernel, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        layers = []
+        if exp != inp:
+            layers += [nn.Conv2D(inp, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), _Act(act)]
+        layers += [nn.Conv2D(exp, exp, kernel, stride=stride,
+                             padding=kernel // 2, groups=exp,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp), _Act(act)]
+        if se:
+            layers.append(_SqueezeExcite(exp))
+        layers += [nn.Conv2D(exp, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+# (kernel, exp, out, SE, act, stride) — the paper's tables 1 and 2
+_LARGE = [
+    (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+    (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+    (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+    (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+    (5, 960, 160, True, "HS", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+    (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+    (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+    (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+    (5, 576, 96, True, "HS", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return _divisible(ch * scale)
+
+        stem = 16
+        layers = [nn.Conv2D(3, c(stem), 3, stride=2, padding=1,
+                            bias_attr=False),
+                  nn.BatchNorm2D(c(stem)), _Act("HS")]
+        inp = c(stem)
+        for kernel, exp, out, se, act, stride in config:
+            layers.append(_InvertedResidual(
+                inp, c(exp), c(out), kernel, stride, se, act))
+            inp = c(out)
+        last_conv = c(config[-1][1])
+        layers += [nn.Conv2D(inp, last_conv, 1, bias_attr=False),
+                   nn.BatchNorm2D(last_conv), _Act("HS")]
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), _Act("HS"),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights need egress; load a state_dict instead")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights need egress; load a state_dict instead")
+    return MobileNetV3Small(scale=scale, **kwargs)

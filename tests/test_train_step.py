@@ -46,14 +46,21 @@ class TestTrainStep:
         assert losses[-1] < losses[0]
 
     def test_single_compile(self):
-        """State avals must be stable: exactly one executable after N steps."""
+        """State avals must be stable: the executable count must not GROW
+        after the first call (growth = aval drift retrace). Asserted as
+        no-growth rather than == 1 because jax's global jit cache may
+        EVICT entries under a full-suite load (observed at 850+ tests:
+        cache_size 0 right after successful calls), which is not the
+        regression this test guards."""
         cfg = tiny_cfg()
         model, opt, step = build_step(cfg, multi_precision=True)
         model.bfloat16()
         ids, labels = make_batch(cfg)
-        for _ in range(3):
+        step(ids, labels)
+        after_first = step._jitted._cache_size()
+        for _ in range(2):
             step(ids, labels)
-        assert step._jitted._cache_size() == 1
+        assert step._jitted._cache_size() <= max(after_first, 1)
 
     def test_step_count_advances(self):
         cfg = tiny_cfg()

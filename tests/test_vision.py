@@ -288,3 +288,63 @@ class TestTransformsBatchR5:
         for _ in range(10):
             out = T.BrightnessTransform(3.0)(img)
             assert out.mean() >= 0
+
+
+class TestModelFamiliesBatch2:
+    """r5: DenseNet / GoogLeNet / InceptionV3 / MobileNetV1+V3 /
+    ResNeXt — forward shapes + grad flow at the smallest viable input."""
+
+    def _check(self, model, size, out_dim=10, n_ch=3):
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal(
+                (1, n_ch, size, size)).astype(np.float32))
+        y = model(x)
+        if isinstance(y, tuple):
+            y = y[0]
+        assert tuple(y.shape) == (1, out_dim), y.shape
+        (y * y).mean().backward()
+        assert any(p.grad is not None for p in model.parameters())
+
+    def test_mobilenet_v1(self):
+        from paddle_tpu.vision.models import mobilenet_v1
+
+        self._check(mobilenet_v1(scale=0.25, num_classes=10), 64)
+
+    def test_mobilenet_v3(self):
+        from paddle_tpu.vision.models import (
+            mobilenet_v3_large, mobilenet_v3_small,
+        )
+
+        self._check(mobilenet_v3_small(scale=0.5, num_classes=10), 64)
+        m = mobilenet_v3_large(scale=0.35, num_classes=10)
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        assert tuple(m(x).shape) == (1, 10)
+
+    def test_densenet(self):
+        from paddle_tpu.vision.models import densenet121
+
+        self._check(densenet121(num_classes=10), 64)
+
+    def test_googlenet_train_and_eval(self):
+        from paddle_tpu.vision.models import googlenet
+
+        m = googlenet(num_classes=10)
+        x = paddle.to_tensor(np.zeros((1, 3, 96, 96), np.float32))
+        m.eval()
+        out, a1, a2 = m(x)
+        assert tuple(out.shape) == (1, 10)
+        m.train()
+        out, a1, a2 = m(x)
+        assert tuple(a1.shape) == (1, 10) and tuple(a2.shape) == (1, 10)
+
+    def test_inception_v3(self):
+        from paddle_tpu.vision.models import inception_v3
+
+        m = inception_v3(num_classes=10)
+        x = paddle.to_tensor(np.zeros((1, 3, 160, 160), np.float32))
+        assert tuple(m(x).shape) == (1, 10)
+
+    def test_resnext(self):
+        from paddle_tpu.vision.models import resnext50_32x4d
+
+        self._check(resnext50_32x4d(num_classes=10), 64)
