@@ -19,6 +19,8 @@ __all__ = [
     "nms", "matrix_nms", "box_coder", "box_clip", "prior_box",
     "yolo_box", "yolo_loss", "roi_align", "roi_pool", "psroi_pool",
     "distribute_fpn_proposals", "generate_proposals", "deform_conv2d",
+    "DeformConv2D", "RoIAlign", "RoIPool", "PSRoIPool", "read_file",
+    "decode_jpeg",
 ]
 
 
@@ -618,3 +620,98 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         b = ensure_tensor(bias)
         out = out + b.reshape([1, -1, 1, 1])
     return out
+
+
+# ---------------------------------------------------------------------------
+# r5: layer-class wrappers + file ops completing the reference
+# vision/ops.py __all__
+# ---------------------------------------------------------------------------
+def _deform_conv2d_layer():
+    from ..nn.layer.layers import Layer
+
+    class DeformConv2D(Layer):
+        """Layer over deform_conv2d (reference vision/ops.py
+        DeformConv2D). Owns the conv weight/bias; offsets/masks arrive
+        per forward, like the reference."""
+
+        def __init__(self, in_channels, out_channels, kernel_size,
+                     stride=1, padding=0, dilation=1,
+                     deformable_groups=1, groups=1, weight_attr=None,
+                     bias_attr=None):
+            super().__init__()
+            k = (kernel_size if isinstance(kernel_size, (tuple, list))
+                 else (kernel_size, kernel_size))
+            self.weight = self.create_parameter(
+                (out_channels, in_channels // groups) + tuple(k),
+                attr=weight_attr)
+            self.bias = (self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True)
+                if bias_attr is not False else None)
+            self.stride = stride
+            self.padding = padding
+            self.dilation = dilation
+            self.deformable_groups = deformable_groups
+            self.groups = groups
+
+        def forward(self, x, offset, mask=None):
+            return deform_conv2d(
+                x, offset, self.weight, bias=self.bias,
+                stride=self.stride, padding=self.padding,
+                dilation=self.dilation,
+                deformable_groups=self.deformable_groups,
+                groups=self.groups, mask=mask)
+
+    return DeformConv2D
+
+
+DeformConv2D = _deform_conv2d_layer()
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         spatial_scale=self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        spatial_scale=self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          spatial_scale=self.spatial_scale)
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference vision/ops.py
+    read_file)."""
+    import numpy as np
+
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor._wrap(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference decode_jpeg — the CUDA build uses nvJPEG. This image has
+    no JPEG codec (no PIL/torchvision/nvJPEG); decode host-side with
+    your codec of choice and feed arrays through paddle.to_tensor."""
+    raise NotImplementedError(
+        "decode_jpeg needs a JPEG codec; none ships in this environment "
+        "(reference uses nvJPEG). Decode host-side (e.g. with PIL where "
+        "available) and pass the array to paddle.to_tensor.")

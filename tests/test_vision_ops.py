@@ -228,3 +228,35 @@ class TestMatrixNMS:
 
     def test_gaussian_decay(self):
         self._check(use_gaussian=True)
+
+
+class TestOpsClassWrappers:
+    def test_roi_align_layer_matches_functional(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(
+            rng.standard_normal((1, 2, 8, 8)).astype(np.float32))
+        boxes = paddle.to_tensor(
+            np.asarray([[0.0, 0.0, 4.0, 4.0]], np.float32))
+        bn = paddle.to_tensor(np.asarray([1], np.int32))
+        got = vops.RoIAlign(2)(x, boxes, bn)
+        want = vops.roi_align(x, boxes, bn, 2)
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.asarray(want._data))
+
+    def test_deform_conv_layer(self):
+        rng = np.random.default_rng(1)
+        layer = vops.DeformConv2D(2, 3, 3)
+        x = paddle.to_tensor(
+            rng.standard_normal((1, 2, 6, 6)).astype(np.float32))
+        offset = paddle.to_tensor(np.zeros((1, 18, 4, 4), np.float32))
+        out = layer(x, offset)
+        assert tuple(out.shape) == (1, 3, 4, 4)
+
+    def test_read_file_and_decode_jpeg(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        p.write_bytes(b"\x01\x02\x03")
+        t = vops.read_file(str(p))
+        np.testing.assert_array_equal(np.asarray(t._data), [1, 2, 3])
+        import pytest as _p
+        with _p.raises(NotImplementedError, match="JPEG"):
+            vops.decode_jpeg(t)
