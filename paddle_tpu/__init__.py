@@ -206,3 +206,11 @@ class LazyGuard:
 
     def __exit__(self, *exc):
         return False
+
+# Tensor method completion: attach the reference's tensor_method_func
+# surface once every namespace above exists (framework/tensor_methods.py)
+import sys as _sys  # noqa: E402
+
+from .framework import tensor_methods as _tensor_methods  # noqa: E402
+
+_tensor_methods.install(_sys.modules[__name__])

@@ -12,13 +12,30 @@ from .features import (  # noqa: F401
     LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
 )
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+from . import backends  # noqa: E402,F401
+from .backends import info, load, save  # noqa: E402,F401
+
+__all__ = ["functional", "features", "backends", "info", "load", "save",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
 
 
-def __getattr__(name):
-    if name in {"datasets", "ESC50", "TESS", "GTZAN", "UrbanSound8K"}:
+class _RaisingDataset:
+    """Corpus-downloading dataset (reference audio/datasets/*): this
+    environment has no egress, so construction raises with guidance —
+    the attribute itself exists (API-surface contract)."""
+
+    def __init__(self, *a, **k):
         raise RuntimeError(
-            f"paddle.audio.{name} downloads its corpus; this environment "
-            "has no network egress — load files locally via paddle.io.")
-    raise AttributeError(name)
+            f"paddle.audio.datasets.{type(self).__name__} downloads its "
+            "corpus; this environment has no network egress — load "
+            "files locally via paddle.io.")
+
+
+class _DatasetsNS:
+    ESC50 = type("ESC50", (_RaisingDataset,), {})
+    TESS = type("TESS", (_RaisingDataset,), {})
+    GTZAN = type("GTZAN", (_RaisingDataset,), {})
+    UrbanSound8K = type("UrbanSound8K", (_RaisingDataset,), {})
+
+
+datasets = _DatasetsNS()

@@ -1,0 +1,104 @@
+"""paddle.audio.backends (reference audio/backends/): audio file IO.
+The reference dispatches to soundfile/sox; this environment ships
+neither, so the built-in backend is the stdlib `wave` module — 8/16/32
+bit PCM WAV read/write, which covers the reference's default ('wave'!)
+backend exactly."""
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend",
+           "set_backend"]
+
+_BACKEND = "wave"
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name):
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable (no soundfile/sox in "
+            "this environment); 'wave' is the built-in backend")
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as w:
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=8 * w.getsampwidth())
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor [C, T] (or [T, C]), sample_rate) —
+    reference backends contract."""
+    import jax.numpy as jnp
+
+    with _wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n_ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = (w.getnframes() - frame_offset if num_frames < 0
+             else num_frames)
+        raw = w.readframes(n)
+    data = np.frombuffer(raw, dtype=_WIDTH_DTYPE[width])
+    if width == 1:                       # unsigned 8-bit -> centered
+        data = data.astype(np.int16) - 128
+    data = data.reshape(-1, n_ch)
+    if normalize:
+        denom = {1: 128.0, 2: 32768.0, 4: 2147483648.0}[width]
+        data = data.astype(np.float32) / denom
+    out = data.T if channels_first else data
+    return Tensor._wrap(jnp.asarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    if bits_per_sample not in (8, 16, 32):
+        raise ValueError("bits_per_sample must be 8, 16 or 32")
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T                       # -> [T, C]
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    width = bits_per_sample // 8
+    if np.issubdtype(arr.dtype, np.floating):
+        denom = {1: 127.0, 2: 32767.0, 4: 2147483647.0}[width]
+        # float64 math + pre-cast clip: f32(1.0)*2147483647 rounds UP to
+        # 2^31 and would wrap to INT32_MIN on the cast
+        arr = np.clip(arr.astype(np.float64) * denom, -denom, denom)
+    arr = arr.astype(_WIDTH_DTYPE[width] if width != 1 else np.int16)
+    if width == 1:
+        arr = (arr + 128).astype(np.uint8)
+    with _wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1])
+        w.setsampwidth(width)
+        w.setframerate(int(sample_rate))
+        w.writeframes(arr.tobytes())

@@ -73,3 +73,17 @@ from . import auto_tuner  # noqa: E402,F401
 from . import rpc  # noqa: E402,F401
 from .auto_parallel import DistModel, Strategy, to_static  # noqa: E402,F401
 from . import comm_watchdog  # noqa: E402,F401
+from .compat import (  # noqa: E402,F401
+    ParallelEnv, ParallelMode, ReduceType, DistAttr, is_available,
+    get_backend, wait, gather, scatter_object_list,
+    gloo_init_parallel_env, gloo_barrier, gloo_release,
+    ShardingStage1, ShardingStage2, ShardingStage3, shard_optimizer,
+    shard_scaler, shard_dataloader, dtensor_from_fn, unshard_dtensor,
+    split, InMemoryDataset, QueueDataset, ProbabilityEntry,
+    CountFilterEntry, ShowClickEntry,
+)
+from .auto_parallel import Placement  # noqa: E402,F401
+from .checkpoint import (  # noqa: E402,F401
+    save_state_dict, load_state_dict,
+)
+from . import io  # noqa: E402,F401

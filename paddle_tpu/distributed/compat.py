@@ -1,0 +1,336 @@
+"""distributed.__all__ completion (r5): the remaining reference surface
+— env/introspection objects, gather/scatter-object, gloo shims, the
+auto-parallel shard_* helpers and the legacy mp `split` — each mapped
+onto the single-controller XLA design (docstrings state the mapping).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from . import collective as C
+from . import env as denv
+
+
+class ParallelEnv:
+    """reference parallel.ParallelEnv: rank/world/device introspection
+    (single-controller: one process drives every device)."""
+
+    @property
+    def rank(self):
+        return C.get_rank()
+
+    local_rank = rank
+
+    @property
+    def world_size(self):
+        return C.get_world_size()
+
+    nranks = world_size
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        import os
+
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                              "127.0.0.1:8765")
+
+    @property
+    def trainer_endpoints(self):
+        import os
+
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]
+
+
+class ParallelMode:
+    """reference ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """reference auto_parallel ReduceType constants (Partial states)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Legacy static dist_attr container (reference
+    auto_parallel/static/dist_attribute): records mesh + dims_mapping;
+    the live placement system is Placement/shard_tensor."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+
+def is_available():
+    """reference distributed.is_available — collectives are always
+    available here: XLA collectives need no external runtime."""
+    return True
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference distributed.wait — block until the tensor's async work
+    is done (jax dispatch is async; this is block_until_ready)."""
+    jax.block_until_ready(tensor._data if isinstance(tensor, Tensor)
+                          else tensor)
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference collective.gather: collect every rank's tensor on dst.
+    Single-controller: values are global, so the gather is the
+    all-gather restricted to dst (every rank-shard lands in
+    gather_list on the one controlling process)."""
+    out = []
+    C.all_gather(out, tensor, group=group)
+    if gather_list is not None:
+        gather_list[:] = out
+    return out
+
+
+def scatter_object_list(out_object_list, in_object_list, src=0,
+                        group=None):
+    """reference scatter_object_list: rank r receives
+    in_object_list[r]. Single-controller: this process IS every rank's
+    driver, so it receives its own slot."""
+    rank = C.get_rank(group)
+    out_object_list[:] = [in_object_list[rank]]
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference gloo_* trio: CPU-side barrier fabric. The control plane
+    here is the TCPStore (distributed/store.py) — initialize it."""
+    from .store import TCPStore
+
+    host, _, port = server_endpoint.partition(":")
+    global _GLOO_STORE, _GLOO_WORLD
+    _GLOO_STORE = TCPStore(host or "127.0.0.1", int(port or 8765),
+                           world_size=rank_num,
+                           is_master=(rank_id == 0))
+    _GLOO_WORLD = int(rank_num)
+
+
+_GLOO_STORE = None
+
+
+_GLOO_WORLD = 0
+_GLOO_GEN = 0
+
+
+def gloo_barrier():
+    """A REAL barrier: arrive (counter add) then wait until the whole
+    world reached this generation's counter."""
+    import struct
+    import time
+
+    if _GLOO_STORE is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    global _GLOO_GEN
+    _GLOO_GEN += 1
+    key = f"gloo/barrier/{_GLOO_GEN}"
+    _GLOO_STORE.add(key, 1)
+    deadline = time.monotonic() + getattr(_GLOO_STORE, "timeout", 300.0)
+    while True:
+        raw = _GLOO_STORE.get(key)
+        n = struct.unpack("<q", raw)[0] if len(raw) == 8 else 0
+        if n >= _GLOO_WORLD:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"gloo_barrier: {n}/{_GLOO_WORLD} arrived")
+        time.sleep(0.02)
+
+
+def gloo_release():
+    global _GLOO_STORE
+    if _GLOO_STORE is not None:
+        _GLOO_STORE.shutdown()
+        _GLOO_STORE = None
+
+
+# -- auto-parallel shard_* helpers ------------------------------------------
+class _ShardingStage:
+    stage = 0
+
+    def __init__(self, mesh_dim=None):
+        self.mesh_dim = mesh_dim
+
+
+class ShardingStage1(_ShardingStage):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """reference auto_parallel.api.shard_optimizer: mark the optimizer's
+    states for sharding. Layout-based design: when the ambient mesh has
+    a sharding/dp axis, wrap in DygraphShardingOptimizer (ZeRO-1 state
+    layouts); otherwise the optimizer is returned unchanged (single
+    mesh-less runs)."""
+    if not denv.is_initialized():
+        return optimizer
+    mesh = denv.get_mesh()
+    if any(a in mesh.axis_names and mesh.shape[a] > 1
+           for a in ("sharding", "dp")):
+        from .fleet.meta_optimizers.dygraph_sharding_optimizer import (
+            DygraphShardingOptimizer,
+        )
+
+        return DygraphShardingOptimizer(optimizer)
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """reference auto_parallel.api.shard_scaler: the GradScaler's state
+    (scale, counters) is replicated scalars under the single controller
+    — already globally consistent; returned unchanged."""
+    return scaler
+
+
+def shard_dataloader(dataloader, meshes=None, input_keys=None,
+                     shard_dims="dp", is_dataset_splitted=False):
+    """reference auto_parallel.api.shard_dataloader: place every yielded
+    batch with its dim 0 sharded over the data axis of the mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if isinstance(meshes, list):
+        if len(meshes) > 1:
+            raise NotImplementedError(
+                "multi-mesh (pipeline-stage) shard_dataloader is not "
+                "supported; pass one mesh per loader")
+        meshes = meshes[0] if meshes else None
+    mesh = meshes if meshes is not None else denv.get_mesh()
+    axis = shard_dims if isinstance(shard_dims, str) else "dp"
+
+    class _Sharded:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __iter__(self):
+            sharding = NamedSharding(
+                getattr(mesh, "mesh", mesh),
+                P(axis if axis in getattr(mesh, "mesh", mesh).axis_names
+                  else None))
+
+            def place(x):
+                if isinstance(x, Tensor):
+                    return Tensor._wrap(jax.device_put(x._data, sharding))
+                return x
+
+            for batch in self._inner:
+                if isinstance(batch, (list, tuple)):
+                    yield type(batch)(place(b) for b in batch)
+                else:
+                    yield place(batch)
+
+        def __len__(self):
+            return len(self._inner)
+
+    return _Sharded(dataloader)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """reference dtensor_from_fn: build with `fn`, then place."""
+    from .auto_parallel import shard_tensor
+
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    """reference unshard_dtensor: gather back to a replicated tensor."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    d = dist_tensor._data if isinstance(dist_tensor, Tensor) \
+        else dist_tensor
+    sh = getattr(d, "sharding", None)
+    if sh is None or getattr(sh, "mesh", None) is None:
+        return dist_tensor
+    return Tensor._wrap(jax.device_put(
+        d, NamedSharding(sh.mesh, P())))
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Legacy mp helper (reference collective.split): build and apply a
+    row/column-parallel linear or vocab-parallel embedding over the mp
+    group. The modern surface is fleet.meta_parallel's mpu layers —
+    this wrapper constructs one on first use."""
+    from .fleet.layers import mpu
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = mpu.RowParallelLinear(in_f, out_f,
+                                          input_is_parallel=False,
+                                          has_bias=bias_attr is not False)
+        else:
+            layer = mpu.ColumnParallelLinear(
+                in_f, out_f, gather_output=gather_out,
+                has_bias=bias_attr is not False)
+        return layer(x)
+    if operation == "embedding":
+        vocab, emb = size
+        layer = mpu.VocabParallelEmbedding(vocab, emb)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")
+
+
+# PS-era datasets: attribute-present raisers (parameter-server stack is
+# descoped, docs/DECISIONS.md §3)
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "InMemoryDataset belongs to the parameter-server data stack "
+            "(descoped, docs/DECISIONS.md §3); use paddle.io.Dataset/"
+            "DataLoader")
+
+
+class QueueDataset(InMemoryDataset):
+    pass
+
+
+class ProbabilityEntry:
+    """PS sparse-table entry configs (descoped stack; kept as value
+    objects so configs parse)."""
+
+    def __init__(self, probability=1.0):
+        self.probability = probability
+
+
+class CountFilterEntry:
+    def __init__(self, count_filter=7):
+        self.count_filter = count_filter
+
+
+class ShowClickEntry:
+    def __init__(self, show_name="show", click_name="click"):
+        self.show_name = show_name
+        self.click_name = click_name

@@ -1,0 +1,18 @@
+"""paddle.distributed.io (reference distributed/io.py): persistables
+save/load for distributed inference programs. Under the single
+controller these are the plain framework save/load — re-exported so
+ported scripts resolve."""
+from ..framework.io import save, load  # noqa: F401
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static-program persistable sweeps do not exist here; use "
+        "paddle.save(model.state_dict(), path)")
+
+
+def load_inference_model_distributed(*a, **k):
+    raise NotImplementedError(
+        "distributed inference programs are served via jit.save/"
+        "paddle.inference (StableHLO artifacts)")

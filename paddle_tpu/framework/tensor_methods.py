@@ -1,0 +1,233 @@
+"""Tensor method completion (r5): attach every reference tensor-method
+name (python/paddle/tensor/__init__.py tensor_method_func) whose
+functional form exists in this framework, plus generated in-place
+variants and the small set of tensor-only predicates/utilities.
+
+Runs once from paddle_tpu/__init__ AFTER all namespaces exist, so the
+binder can resolve names through paddle.*, paddle.linalg.* and
+paddle.signal.*.
+"""
+from __future__ import annotations
+
+
+def install(paddle):
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    # names the reference patches onto Tensor; resolved through these
+    # namespaces in order
+    spaces = [paddle, paddle.linalg, paddle.signal, paddle.geometric]
+
+    def resolve(name):
+        for sp in spaces:
+            fn = getattr(sp, name, None)
+            if callable(fn):
+                return fn
+        return None
+
+    plain = [
+        "add_n", "angle", "as_complex", "as_real", "as_strided",
+        "atleast_1d", "atleast_2d", "atleast_3d", "bincount",
+        "bitwise_left_shift", "bitwise_right_shift", "block_diag",
+        "broadcast_shape", "broadcast_tensors", "cdist",
+        "cholesky_inverse", "cholesky_solve", "concat", "cond", "conj",
+        "copysign", "corrcoef", "cov", "create_parameter",
+        "create_tensor", "cummax", "cummin", "cumulative_trapezoid",
+        "deg2rad", "diag", "diag_embed", "diagflat", "diagonal",
+        "diagonal_scatter", "dsplit", "eig", "eigvals", "eigvalsh",
+        "floor_mod", "frexp", "gammainc", "gammaincc", "gammaln", "gcd",
+        "histogram", "histogram_bin_edges", "histogramdd",
+        "householder_product", "hsplit", "hypot", "i0", "i0e", "i1",
+        "i1e", "imag", "index_fill", "index_put", "inverse", "isin",
+        "isneginf", "isposinf", "isreal", "istft", "kthvalue", "lcm",
+        "ldexp", "logaddexp", "lstsq", "lu", "lu_unpack",
+        "masked_scatter", "multi_dot", "multigammaln", "multinomial",
+        "multiplex", "nanmedian", "nanquantile", "nextafter",
+        "ormqr", "pca_lowrank", "pinv", "polar", "polygamma", "qr", "rad2deg",
+        "real", "reduce_as", "renorm", "reverse", "scatter_nd",
+        "select_scatter", "sgn", "shard_index", "signbit", "sinc",
+        "slice", "slice_scatter", "solve", "stack", "stanh", "stft",
+        "strided_slice", "svd_lowrank", "tensor_split", "tensordot",
+        "top_p_sampling", "trapezoid", "triangular_solve", "tril",
+        "triu", "trunc", "unflatten", "unfold", "unstack", "vander",
+        "vsplit",
+    ]
+    for name in plain:
+        if hasattr(Tensor, name):
+            continue
+        fn = resolve(name)
+        if fn is None:
+            continue
+
+        def method(self, *a, _fn=fn, **k):
+            return _fn(self, *a, **k)
+
+        method.__name__ = name
+        setattr(Tensor, name, method)
+
+    # generated in-place variants: run the base op, rebind the buffer
+    inplace = [
+        "acos_", "acosh_", "addmm_", "asin_", "asinh_", "atan_",
+        "atanh_", "bitwise_and_", "bitwise_left_shift_", "bitwise_not_",
+        "bitwise_or_", "bitwise_right_shift_", "bitwise_xor_", "cast_",
+        "copysign_", "cosh_", "cumprod_", "cumsum_", "digamma_",
+        "equal_", "erfinv_", "flatten_", "floor_divide_", "floor_mod_",
+        "frac_", "gammainc_", "gammaincc_", "gammaln_", "gcd_",
+        "greater_equal_", "greater_than_", "hypot_", "i0_",
+        "index_fill_", "index_put_", "lcm_", "ldexp_", "lerp_",
+        "less_equal_", "less_than_", "lgamma_", "log10_", "log1p_",
+        "log2_", "log_", "logical_and_", "logical_not_", "logical_or_",
+        "logical_xor_", "logit_", "masked_scatter_", "mod_",
+        "multigammaln_", "nan_to_num_", "not_equal_", "polygamma_",
+        "pow_", "put_along_axis_", "remainder_", "renorm_", "sinc_",
+        "sinh_", "t_", "tan_", "transpose_", "tril_", "triu_", "trunc_",
+        "where_",
+    ]
+    for name in inplace:
+        if hasattr(Tensor, name):
+            continue
+        base = resolve(name[:-1])
+        if base is None:
+            continue
+
+        def method(self, *a, _fn=base, **k):
+            out = _fn(self, *a, **k)
+            self._inplace_from(out)
+            return self
+
+        method.__name__ = name
+        setattr(Tensor, name, method)
+
+    # --- tensor-only predicates / utilities ----------------------------
+    def _rank(self):
+        return paddle.to_tensor(int(self.ndim))
+
+    def _numel(self):
+        return paddle.to_tensor(int(self.size))
+
+    def _is_empty(self):
+        return paddle.to_tensor(self.size == 0)
+
+    def _is_complex(self):
+        return jnp.issubdtype(self._data.dtype, jnp.complexfloating)
+
+    def _is_integer(self):
+        return jnp.issubdtype(self._data.dtype, jnp.integer)
+
+    def _is_floating_point(self):
+        return jnp.issubdtype(self._data.dtype, jnp.floating)
+
+    def _is_tensor(self):
+        return True
+
+    def _increment(self, value=1.0):
+        self._inplace_from(self + value)
+        return self
+
+    def _view(self, shape_or_dtype):
+        """reference Tensor.view: reshape when given a shape; when given
+        a dtype, reinterpret the SAME bytes with the last dim resized by
+        the width ratio (reference view-dtype semantics — a [4] f32
+        views as [8] int16 or [2] f64, unlike raw bitcast_convert_type
+        which appends/consumes a trailing dim)."""
+        if isinstance(shape_or_dtype, (list, tuple)):
+            return self.reshape(list(shape_or_dtype))
+        from .dtype import to_jax_dtype
+
+        from ..ops._dispatch import unary
+        import jax
+
+        dt = to_jax_dtype(shape_or_dtype)
+
+        def f(v):
+            src_bits = v.dtype.itemsize * 8
+            dst_bits = jnp.dtype(dt).itemsize * 8
+            if src_bits == dst_bits:
+                return jax.lax.bitcast_convert_type(v, dt)
+            if src_bits > dst_bits:
+                if src_bits % dst_bits:
+                    raise ValueError("incompatible view dtype widths")
+                out = jax.lax.bitcast_convert_type(v, dt)
+                return out.reshape(v.shape[:-1]
+                                   + (v.shape[-1] * (src_bits
+                                                     // dst_bits),))
+            ratio = dst_bits // src_bits
+            if dst_bits % src_bits or v.shape[-1] % ratio:
+                raise ValueError(
+                    "last dim must divide by the dtype width ratio")
+            vv = v.reshape(v.shape[:-1] + (v.shape[-1] // ratio, ratio))
+            return jax.lax.bitcast_convert_type(vv, dt)
+
+        return unary(f, self, "view_dtype")
+
+    def _view_as(self, other):
+        return self.reshape(list(other.shape))
+
+    def _inverse(self):
+        return paddle.linalg.inv(self)
+
+    def _histogram_bin_edges(self, bins=100, min=0.0, max=0.0):
+        import numpy as np
+
+        v = np.asarray(self._data)
+        rng = None if (min == 0 and max == 0) else (min, max)
+        return paddle.to_tensor(np.histogram_bin_edges(
+            v, bins=bins, range=rng).astype(np.float32))
+
+    def _uniform_(self, min=-1.0, max=1.0, seed=0):
+        from . import random as _random
+        import jax
+
+        key = _random.next_key()
+        self._inplace_from(Tensor._wrap(jax.random.uniform(
+            key, self._data.shape, self._data.dtype, min, max)))
+        return self
+
+    def _bernoulli_(self, p=0.5, seed=0):
+        from . import random as _random
+        import jax
+
+        key = _random.next_key()
+        self._inplace_from(Tensor._wrap(jax.random.bernoulli(
+            key, p, self._data.shape).astype(self._data.dtype)))
+        return self
+
+    def _cauchy_(self, loc=0, scale=1, seed=0):
+        from . import random as _random
+        import jax
+
+        key = _random.next_key()
+        u = jax.random.uniform(key, self._data.shape, jnp.float32,
+                               1e-6, 1 - 1e-6)
+        self._inplace_from(Tensor._wrap(
+            (loc + scale * jnp.tan(jnp.pi * (u - 0.5)))
+            .astype(self._data.dtype)))
+        return self
+
+    def _geometric_(self, probs, seed=0):
+        from . import random as _random
+        import jax
+
+        key = _random.next_key()
+        u = jax.random.uniform(key, self._data.shape, jnp.float32,
+                               1e-6, 1 - 1e-6)
+        self._inplace_from(Tensor._wrap(
+            jnp.ceil(jnp.log(u) / jnp.log1p(-probs))
+            .astype(self._data.dtype)))
+        return self
+
+    extras = {
+        "rank": _rank, "numel": _numel, "is_empty": _is_empty,
+        "is_complex": _is_complex, "is_integer": _is_integer,
+        "is_floating_point": _is_floating_point, "is_tensor": _is_tensor,
+        "increment": _increment, "view": _view, "view_as": _view_as,
+        "inverse": _inverse,
+        "histogram_bin_edges": _histogram_bin_edges,
+        "uniform_": _uniform_, "bernoulli_": _bernoulli_,
+        "cauchy_": _cauchy_, "geometric_": _geometric_,
+    }
+    for name, fn in extras.items():
+        if not hasattr(Tensor, name):
+            fn.__name__ = name
+            setattr(Tensor, name, fn)

@@ -248,3 +248,11 @@ def poisson(x, name=None):
     return Tensor._wrap(
         jax.random.poisson(key, rate, x._data.shape)
         .astype(x._data.dtype))
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """reference tensor/creation.py create_tensor — an empty typed
+    tensor placeholder (static-era API; eager code assigns into it)."""
+    from ..framework.dtype import to_jax_dtype
+
+    return Tensor(jnp.zeros((0,), to_jax_dtype(dtype)))

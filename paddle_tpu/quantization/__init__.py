@@ -16,10 +16,26 @@ from .. import nn
 from ..nn import quant as _q
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
-           "AbsMaxObserver", "quanter"]
+           "AbsMaxObserver", "quanter", "BaseObserver", "BaseQuanter",]
 
 
-class FakeQuanterWithAbsMaxObserver:
+class BaseObserver:
+    """reference quantization/base_observer.py — the observer protocol:
+    watch activations/weights during calibration, produce scales."""
+
+    def _observe(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class BaseQuanter(BaseObserver):
+    """reference quantization/base_quanter.py — an observer that also
+    fake-quantizes in the forward."""
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     """Quanter factory (reference quanters/abs_max.py): EMA absmax
     fake-quant for activations."""
 
@@ -32,7 +48,7 @@ class FakeQuanterWithAbsMaxObserver:
             moving_rate=self.moving_rate, quant_bits=self.bit_length)
 
 
-class AbsMaxObserver:
+class AbsMaxObserver(BaseObserver):
     """PTQ observer factory (reference observers/abs_max.py)."""
 
     def __init__(self, quant_bits=8):

@@ -964,3 +964,74 @@ class TestSpmdRulesDeepened:
         plan = plan_layer_specs(Bottleneck(), tp_axis="mp")
         assert plan["fc2.weight"] == ("mp", None)     # row-parallel
         assert plan["fc1.weight"] == (None, "mp")
+
+
+class TestDistributedCompatSurface:
+    """r5 distributed.__all__ completion: semantics of the compat
+    helpers under the single controller."""
+
+    def test_env_objects_and_introspection(self):
+        import paddle_tpu.distributed as dist
+
+        env = dist.ParallelEnv()
+        assert env.world_size >= 1 and env.rank == 0
+        assert dist.is_available() and dist.get_backend() == "xla"
+        assert dist.ParallelMode.TENSOR_PARALLEL == 1
+        assert dist.ReduceType.kRedSum == 0
+
+    def test_wait_gather_scatter_objects(self):
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        assert dist.wait(t) is t
+        out = []
+        dist.gather(t, out)
+        assert len(out) >= 1
+        objs = [None]
+        dist.scatter_object_list(objs, [{"a": 1}, {"b": 2}])
+        assert objs[0] == {"a": 1}
+
+    def test_shard_helpers(self):
+        import paddle_tpu.optimizer as popt
+        import paddle_tpu.distributed as dist
+
+        lin = paddle.nn.Linear(4, 4)
+        opt = popt.SGD(learning_rate=0.1, parameters=lin.parameters())
+        # no mesh initialized in this test context: pass-through OR the
+        # ZeRO-1 wrapper when a prior test left a sharded mesh ambient —
+        # assert the precise contract instead of a tautology
+        from paddle_tpu.distributed import env as _denv
+        out = dist.shard_optimizer(opt)
+        if _denv.is_initialized() and any(
+                a in _denv.get_mesh().axis_names
+                and _denv.get_mesh().shape[a] > 1
+                for a in ('sharding', 'dp')):
+            assert out is not opt
+        else:
+            assert out is opt
+        from paddle_tpu.amp import GradScaler
+
+        sc = GradScaler()
+        assert dist.shard_scaler(sc) is sc
+
+    def test_unshard_and_dtensor_from_fn(self):
+        import jax
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.auto_parallel import (
+            ProcessMesh, Replicate,
+        )
+
+        mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+        t = dist.dtensor_from_fn(
+            lambda: paddle.to_tensor(np.ones((4, 4), np.float32)),
+            mesh, [Replicate(), Replicate()])
+        u = dist.unshard_dtensor(t)
+        np.testing.assert_allclose(np.asarray(u._data), 1.0)
+
+    def test_ps_era_raisers(self):
+        import paddle_tpu.distributed as dist
+
+        with pytest.raises(NotImplementedError, match="parameter-server"):
+            dist.InMemoryDataset()
+        assert dist.ShowClickEntry().show_name == "show"
+        assert dist.ShardingStage3().stage == 3

@@ -123,3 +123,28 @@ class TestSignal:
         back = signal.istft(spec, n_fft=128, window=paddle.to_tensor(w),
                             length=512)
         np.testing.assert_allclose(np.asarray(back._data), x, atol=1e-3)
+
+
+class TestAudioIO:
+    """r5: wave-backend audio IO roundtrip (reference audio.backends)."""
+
+    def test_wav_roundtrip_and_info(self, tmp_path):
+        import paddle_tpu.audio as audio
+
+        sr = 16000
+        t = np.linspace(0, 1, sr, endpoint=False)
+        wav = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+        stereo = np.stack([wav, -wav])              # [C, T]
+        p = str(tmp_path / "tone.wav")
+        audio.save(p, paddle.to_tensor(stereo), sr)
+        meta = audio.info(p)
+        assert meta.sample_rate == sr
+        assert meta.num_channels == 2
+        assert meta.num_samples == sr
+        back, sr2 = audio.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(np.asarray(back._data), stereo,
+                                   atol=2e-4)
+        assert audio.backends.get_current_backend() == "wave"
+        seg, _ = audio.load(p, frame_offset=100, num_frames=50)
+        assert seg.shape[-1] == 50

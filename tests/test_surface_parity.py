@@ -1,0 +1,85 @@
+"""Living surface-parity guard (r5): the reference's public __all__
+lists must stay fully covered — any regression (or future reference-
+bump gap) fails here with the exact missing names. Skipped when the
+reference checkout is not mounted."""
+import os
+import re
+
+import pytest
+
+import paddle_tpu as paddle
+
+REF = "/root/reference/python/paddle"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not mounted")
+
+
+def _ref_names(relpath):
+    """Parse the module's literal __all__ via ast (a plain regex over
+    the file also matches quoted names in docstrings)."""
+    import ast as _ast
+
+    with open(os.path.join(REF, relpath)) as f:
+        src = f.read()
+    try:
+        tree = _ast.parse(src)
+        for node in tree.body:
+            if isinstance(node, _ast.Assign) and any(
+                    isinstance(t, _ast.Name) and t.id == "__all__"
+                    for t in node.targets):
+                return sorted({e.value for e in node.value.elts
+                               if isinstance(e, _ast.Constant)
+                               and isinstance(e.value, str)})
+    except SyntaxError:
+        pass
+    return sorted(set(re.findall(r"^\s+'(\w+)',", src, re.M)))
+
+
+NAMESPACES = [
+    ("", "__init__.py"),
+    ("nn", "nn/__init__.py"),
+    ("nn.functional", "nn/functional/__init__.py"),
+    ("distributed", "distributed/__init__.py"),
+    ("vision.transforms", "vision/transforms/__init__.py"),
+    ("vision.ops", "vision/ops.py"),
+    ("io", "io/__init__.py"),
+    ("amp", "amp/__init__.py"),
+    ("autograd", "autograd/__init__.py"),
+    ("optimizer", "optimizer/__init__.py"),
+    ("metric", "metric/__init__.py"),
+    ("regularizer", "regularizer.py"),
+    ("geometric", "geometric/__init__.py"),
+    ("audio", "audio/__init__.py"),
+    ("jit", "jit/__init__.py"),
+    ("incubate", "incubate/__init__.py"),
+    ("quantization", "quantization/__init__.py"),
+    ("profiler", "profiler/__init__.py"),
+    ("fft", "fft.py"),
+]
+
+
+@pytest.mark.parametrize("mod,relpath", NAMESPACES,
+                         ids=[m or "paddle" for m, _ in NAMESPACES])
+def test_namespace_surface(mod, relpath):
+    obj = paddle
+    for part in [p for p in mod.split(".") if p]:
+        obj = getattr(obj, part)
+    missing = [n for n in _ref_names(relpath) if not hasattr(obj, n)]
+    assert not missing, f"paddle.{mod or ''} missing: {missing}"
+
+
+def test_tensor_method_surface():
+    names = _ref_names("tensor/__init__.py")
+    t = paddle.to_tensor([1.0, 2.0])
+    missing = [n for n in names if not hasattr(t, n)]
+    assert not missing, f"Tensor missing methods: {missing}"
+
+
+def test_vision_models_families():
+    names = _ref_names("vision/models/__init__.py")
+    import paddle_tpu.vision.models as M
+
+    missing = [n for n in names if not hasattr(M, n)]
+    # LeNet naming etc. covered; any residual must be justified here
+    assert not missing, f"vision.models missing: {missing}"
