@@ -41,3 +41,100 @@ def get_rng_state_tracker():
     from .layers.mpu.random import get_rng_state_tracker as _g
 
     return _g()
+
+
+class Role:
+    """reference fleet/base/role_maker.py Role constants."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """reference role_maker.PaddleCloudRoleMaker: resolve this process's
+    role from the cluster env. Single-controller collective mode: this
+    process is worker 0 of a world the mesh defines; PS roles belong to
+    the descoped parameter-server stack (docs/DECISIONS.md §3)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        if not is_collective:
+            raise NotImplementedError(
+                "parameter-server role resolution is descoped "
+                "(docs/DECISIONS.md §3); use is_collective=True")
+        self._is_collective = True
+
+    def _is_worker(self):
+        return True
+
+    is_worker = _is_worker
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return True
+
+    def worker_index(self):
+        import os
+
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def worker_num(self):
+        import os
+
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference role_maker.UserDefinedRoleMaker: explicit role wiring."""
+
+    def __init__(self, is_collective=True, current_id=0, role=None,
+                 worker_num=1, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._current_id = int(current_id)
+        self._worker_num = int(worker_num)
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+
+class UtilBase:
+    """reference fleet/utils UtilBase: barrier/all-gather over the
+    control plane for host-side values."""
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+
+        C.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]          # single controller: world of one host
+
+    def get_file_shard(self, files):
+        """Split a file list across workers (reference util.get_file_shard)."""
+        import os
+
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        return [f for i, f in enumerate(files) if i % n == rank]
+
+
+class MultiSlotDataGenerator:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "MultiSlot data generators feed the parameter-server "
+            "dataset pipeline (descoped, docs/DECISIONS.md §3); use "
+            "paddle.io.Dataset/DataLoader")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass

@@ -23,7 +23,8 @@ from ...ops._dispatch import ensure_tensor
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
            "FusedBiasDropoutResidualLayerNorm",
            "FusedTransformerEncoderLayer", "FusedLinear",
-           "FusedDropoutAdd", "FusedDropout", "FusedEcMoe"]
+           "FusedDropoutAdd", "FusedDropout", "FusedEcMoe",
+           "FusedMultiTransformer"]
 
 
 class FusedMultiHeadAttention(nn.Layer):
@@ -268,6 +269,21 @@ class FusedEcMoe(nn.Layer):
         return fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
                             self.bmm_weight1, self.bmm_bias1,
                             self.act_type)
+
+
+class FusedMultiTransformer(nn.Layer):
+    """reference incubate/nn/layer/fused_transformer.py
+    FusedMultiTransformer — the N-layer fused DECODE kernel of the
+    inference deployment stack. Descoped with the rest of that stack
+    (docs/DECISIONS.md §4): construction raises with guidance; training
+    uses the per-layer Fused* blocks / nn.TransformerEncoder."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "FusedMultiTransformer is the inference deployment stack's "
+            "decode engine (descoped, docs/DECISIONS.md §4); compose "
+            "FusedMultiHeadAttention + FusedFeedForward or "
+            "nn.TransformerEncoder for training/eval")
 
 
 from . import functional  # noqa: E402,F401

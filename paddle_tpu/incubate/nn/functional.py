@@ -18,7 +18,7 @@ __all__ = [
     "swiglu", "fused_linear", "softmax_mask_fuse",
     "softmax_mask_fuse_upper_triangle", "fused_dropout_add",
     "fused_bias_act",
- "fused_moe", "fused_ec_moe",]
+ "fused_moe", "fused_ec_moe", "fused_rotary_position_embedding", "fused_layer_norm", "fused_rms_norm", "fused_matmul_bias", "fused_linear_activation", "fused_bias_dropout_residual_layer_norm", "blha_get_max_len", "masked_multihead_attention", "block_multihead_attention", "variable_length_memory_efficient_attention", "fused_feedforward", "fused_multi_head_attention", "fused_multi_transformer",]
 
 
 def swiglu(x, y=None, name=None):
@@ -190,3 +190,388 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
 
     return nary(f, [x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
                     bmm1_bias], "fused_ec_moe")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False,
+                                    rotary_emb_base=10000.0):
+    """RoPE applied to q/k/v (reference incubate/nn/functional/
+    fused_rotary_position_embedding.py): returns the rotated (q, k, v)
+    tuple. Shapes [b, s, h, d] (or [s, b, h, d] when time_major);
+    sin/cos optional ([s, d] or [1, s, 1, d]) — derived from
+    rotary_emb_base when omitted. Neox style rotates adjacent pairs;
+    GPT-J style rotates front/back halves."""
+    from ...ops._dispatch import nary
+
+    def rope_one(x, sin_b, cos_b):
+        if use_neox_rotary_style:
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            s1 = sin_b[..., 0::2]
+            c1 = cos_b[..., 0::2]
+            r1 = x1 * c1 - x2 * s1
+            r2 = x2 * c1 + x1 * s1
+            out = jnp.stack([r1, r2], axis=-1)
+            return out.reshape(x.shape)
+        half = x.shape[-1] // 2
+        x1, x2 = x[..., :half], x[..., half:]
+        s1 = sin_b[..., :half]
+        c1 = cos_b[..., :half]
+        return jnp.concatenate([x1 * c1 - x2 * s1,
+                                x2 * c1 + x1 * s1], axis=-1)
+
+    def f(qv, *rest):
+        rest = list(rest)
+        kv = rest.pop(0) if k is not None else None
+        vv = rest.pop(0) if v is not None else None
+        sv = rest.pop(0) if sin is not None else None
+        cv = rest.pop(0) if cos is not None else None
+        pid = rest.pop(0) if position_ids is not None else None
+        x = jnp.swapaxes(qv, 0, 1) if time_major else qv
+        b, s, h, d = x.shape
+        if sv is None:
+            inv = 1.0 / (rotary_emb_base
+                         ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+            t = jnp.arange(s, dtype=jnp.float32)
+            freqs = jnp.outer(t, inv)                     # [s, d/2]
+            emb = jnp.repeat(freqs, 2, axis=-1)           # [s, d]
+            sv, cv = jnp.sin(emb), jnp.cos(emb)
+        sv = sv.reshape(-1, sv.shape[-1])[:s]             # [s, d]
+        cv = cv.reshape(-1, cv.shape[-1])[:s]
+        if pid is not None:
+            sv = sv[pid]                                   # [b, s, d]
+            cv = cv[pid]
+            sv = sv[:, :, None, :]
+            cv = cv[:, :, None, :]
+        else:
+            sv = sv[None, :, None, :]
+            cv = cv[None, :, None, :]
+
+        def go(t32):
+            out = rope_one(t32.astype(jnp.float32), sv, cv)
+            return out.astype(t32.dtype)
+
+        slots = [go(x)]
+        if kv is not None:
+            kk = jnp.swapaxes(kv, 0, 1) if time_major else kv
+            slots.append(go(kk))
+        if vv is not None:
+            vv2 = jnp.swapaxes(vv, 0, 1) if time_major else vv
+            slots.append(go(vv2))
+        if time_major:
+            slots = [jnp.swapaxes(o, 0, 1) for o in slots]
+        while len(slots) < 3:
+            slots.append(slots[0] * 0)   # structural filler only
+        return tuple(slots)
+
+    args = [q]
+    for t in (k, v, sin, cos, position_ids):
+        if t is not None:
+            args.append(t)
+    out = nary(f, args, "fused_rope")
+    # output slots were filled in PRESENCE order (q, then k if given,
+    # then v if given) — map back by the same bookkeeping
+    idx = 1
+    rk = rv_ = None
+    if k is not None:
+        rk = out[idx]
+        idx += 1
+    if v is not None:
+        rv_ = out[idx]
+    return (out[0], rk, rv_)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon,
+                     residual_alpha=1.0, begin_norm_axis=1, bias=None,
+                     residual=None, quant_scale=-1, quant_round_type=0,
+                     quant_max_bound=0, quant_min_bound=0):
+    """reference fused_layer_norm: (optional bias + residual_alpha *
+    residual add) -> layernorm over dims [begin_norm_axis:]. Reference
+    return contract: a bare tensor without `residual`, the
+    (out, residual_out) pair with it."""
+    from ...ops._dispatch import nary
+
+    if quant_scale > 0:
+        raise NotImplementedError("quantized fused_layer_norm descoped")
+
+    def f(xv, *rest):
+        rest = list(rest)
+        w = rest.pop(0) if norm_weight is not None else None
+        bta = rest.pop(0) if norm_bias is not None else None
+        bv = rest.pop(0) if bias is not None else None
+        rv = rest.pop(0) if residual is not None else None
+        pre = xv
+        if bv is not None:
+            pre = pre + bv
+        if rv is not None:
+            pre = pre + residual_alpha * rv
+        axes = tuple(range(begin_norm_axis, pre.ndim))
+        mu = jnp.mean(pre.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(pre.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (pre.astype(jnp.float32) - mu) / jnp.sqrt(var + epsilon)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        if bta is not None:
+            out = out + bta.astype(jnp.float32)
+        if residual is None:
+            return out.astype(xv.dtype)
+        return out.astype(xv.dtype), pre
+
+    args = [x]
+    for t in (norm_weight, norm_bias, bias, residual):
+        if t is not None:
+            args.append(t)
+    return nary(f, args, "fused_layer_norm")
+
+
+def fused_rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis=1,
+                   bias=None, residual=None, quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0):
+    """reference fused_rms_norm: like fused_layer_norm but RMS (no mean
+    subtraction). Return contract mirrors the reference: bare tensor
+    without `residual`, (out, residual_out) pair with it."""
+    from ...ops._dispatch import nary
+
+    if quant_scale > 0:
+        raise NotImplementedError("quantized fused_rms_norm descoped")
+
+    def f(xv, *rest):
+        rest = list(rest)
+        w = rest.pop(0) if norm_weight is not None else None
+        bta = rest.pop(0) if norm_bias is not None else None
+        bv = rest.pop(0) if bias is not None else None
+        rv = rest.pop(0) if residual is not None else None
+        pre = xv
+        if bv is not None:
+            pre = pre + bv
+        if rv is not None:
+            pre = pre + rv
+        axes = tuple(range(begin_norm_axis, pre.ndim))
+        ms = jnp.mean(jnp.square(pre.astype(jnp.float32)), axis=axes,
+                      keepdims=True)
+        out = pre.astype(jnp.float32) / jnp.sqrt(ms + epsilon)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        if bta is not None:
+            out = out + bta.astype(jnp.float32)
+        if residual is None:
+            return out.astype(xv.dtype)
+        return out.astype(xv.dtype), pre
+
+    args = [x]
+    for t in (norm_weight, norm_bias, bias, residual):
+        if t is not None:
+            args.append(t)
+    return nary(f, args, "fused_rms_norm")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """reference fused_matmul_bias — one fused GEMM+bias (XLA fuses)."""
+    from ... import ops
+
+    out = ops.matmul(x, y, transpose_x=transpose_x,
+                     transpose_y=transpose_y)
+    return out + bias if bias is not None else out
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation=None):
+    """reference fused_linear_activation: GEMM + bias + activation."""
+    from ...nn import functional as F
+
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    if activation == "gelu":
+        return F.gelu(out)
+    if activation == "relu":
+        return F.relu(out)
+    if activation in (None, "", "none"):
+        return out
+    raise ValueError(f"unsupported activation {activation!r}")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """reference fused_bias_dropout_residual_layer_norm functional:
+    layernorm(residual + dropout(x + bias))."""
+    from ...nn import functional as F
+
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = residual + h
+    return F.layer_norm(h, h.shape[-1:], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """reference blha_get_max_len: max encoder/decoder lengths for the
+    block-attention scheduler — a pair of max reductions."""
+    from ... import ops
+
+    return (ops.max(seq_lens_encoder), ops.max(seq_lens_decoder))
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None,
+                               src_mask=None, *args, **kwargs):
+    raise NotImplementedError(
+        "masked_multihead_attention is the CUDA decode-step kernel of "
+        "the inference deployment stack (descoped, docs/DECISIONS.md "
+        "§4); for decoding use nn.MultiHeadAttention with a cache or "
+        "jit-compiled step functions")
+
+
+def block_multihead_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "block_multihead_attention (paged KV cache) belongs to the "
+        "inference deployment stack (descoped, docs/DECISIONS.md §4)")
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """reference variable_length_memory_efficient_attention: attention
+    over ragged batches described by per-sequence lengths. TPU-first:
+    the ragged lengths densify into masks once and the whole op is one
+    batched MXU attention (the memory-efficiency the CUDA kernel gets
+    from tiling comes from the pallas flash kernel on the training
+    path)."""
+    from ...ops._dispatch import nary
+
+    def f(q, kk, vv, sl, kvl, *rest):
+        b, h, sq, d = q.shape
+        sk = kk.shape[2]
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            kk.astype(jnp.float32)) * sc
+        if rest:
+            # reference contract: mask is an ADDITIVE float bias
+            # (0 = attend, large-negative = blocked)
+            scores = scores + rest[0].astype(jnp.float32)
+        qmask = jnp.arange(sq)[None, :] < sl[:, None]      # [b, sq]
+        kmask = jnp.arange(sk)[None, :] < kvl[:, None]     # [b, sk]
+        m = qmask[:, None, :, None] & kmask[:, None, None, :]
+        if causal:
+            # queries sit AFTER pre_cache_length cached keys: key j is
+            # visible to query i when j <= i + pre_cache_length
+            m = m & (jnp.arange(sq)[:, None] + int(pre_cache_length)
+                     >= jnp.arange(sk)[None, :])[None, None]
+        scores = jnp.where(m, scores, -1e9)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(jnp.any(m, -1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          vv.astype(jnp.float32)).astype(q.dtype)
+
+    args = [query, key, value, seq_lens, kv_seq_lens]
+    if mask is not None:
+        args.append(mask)
+    return nary(f, args, "varlen_attention")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight,
+                      linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """reference fused_feedforward (fused_transformer.py:36):
+    residual + dropout2(linear2(dropout1(act(linear1(ln?(x)))))) with
+    pre- or post-layernorm — one XLA-fused expression here."""
+    from ... import ops
+    from ...nn import functional as F
+
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1:], weight=ln1_scale,
+                         bias=ln1_bias, epsilon=ln1_epsilon)
+    h = ops.matmul(h, linear1_weight)
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    h = F.relu(h) if activation == "relu" else F.gelu(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = ops.matmul(h, linear2_weight)
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = residual + h if add_residual else h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale,
+                           bias=ln2_bias, epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """reference fused_multi_head_attention (fused_transformer.py:502):
+    the fused MHA block — qkv GEMM, scaled-dot attention, out proj,
+    dropout, residual, pre/post layernorm. qkv_weight layout
+    [3, num_heads, head_dim, embed_dim] (reference contract) or the
+    transposed [embed_dim, 3*embed_dim] with transpose_qkv_wb."""
+    from ... import ops
+    from ...nn import functional as F
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "decode-cache path belongs to the inference stack "
+            "(docs/DECISIONS.md §4)")
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, h.shape[-1:], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    b, s, e = h.shape
+    if transpose_qkv_wb:
+        nh = num_heads
+        qkv = ops.matmul(h, qkv_weight)          # [b, s, 3e]
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias
+        qkv = qkv.reshape([b, s, 3, nh, e // nh])
+    else:
+        nh = qkv_weight.shape[1]
+        w = qkv_weight.reshape([3 * e, e])
+        qkv = ops.matmul(h, w, transpose_y=True)
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias.reshape([-1])
+        qkv = qkv.reshape([b, s, 3, nh, e // nh])
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    ctx = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    ctx = ctx.reshape([b, s, e])
+    out = ops.matmul(ctx, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale,
+                           bias=ln_bias, epsilon=ln_epsilon)
+    return out
+
+
+def fused_multi_transformer(*args, **kwargs):
+    raise NotImplementedError(
+        "fused_multi_transformer is the inference deployment stack's "
+        "N-layer decode kernel (descoped, docs/DECISIONS.md §4); for "
+        "training/eval use nn.TransformerEncoder or the incubate "
+        "Fused* layers")

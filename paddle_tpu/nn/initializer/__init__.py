@@ -223,3 +223,46 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4
     return 1.0
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init (reference initializer/Bilinear):
+    for ConvTranspose weights [C_out, C_in, k, k] — each spatial kernel
+    is the separable triangle filter, the classic learned-upsample
+    warm start."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear init expects a 4-D conv weight")
+        k = shape[-1]
+        if shape[-2] != k:
+            raise ValueError("Bilinear init expects square kernels")
+        # reference semantics (initializer/bilinear.py:116): the SAME
+        # (k, k) interpolation kernel for every (out, in) channel pair,
+        # with normalized coordinates x/f against center c
+        f = np.ceil(k / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        xs = np.arange(k, dtype=np.float32)
+        t = 1 - np.abs(xs / f - c)
+        filt = t[:, None] * t[None, :]   # symmetric: y/x order is moot
+        w = np.broadcast_to(filt, shape).copy().astype(np.float32)
+        return _as_dtype(w, dtype)
+
+
+_GLOBAL_INIT = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference set_global_initializer: default initializers for
+    subsequently created parameters (consumed by
+    Layer.create_parameter via get_global_initializer); pass None to
+    restore the framework defaults."""
+    global _GLOBAL_INIT
+    _GLOBAL_INIT = (None if weight_init is None
+                    else (weight_init, bias_init))
+
+
+def get_global_initializer():
+    return _GLOBAL_INIT

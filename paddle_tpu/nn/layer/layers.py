@@ -130,7 +130,17 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype or self._dtype or get_default_dtype()
-        init = attr.initializer or default_initializer
+        # precedence (reference set_global_initializer contract): a
+        # user-specified attr initializer wins; otherwise an active
+        # global initializer overrides even the layer's own default
+        from ..initializer import get_global_initializer
+
+        glob = get_global_initializer()
+        init = attr.initializer
+        if init is None and glob is not None:
+            init = glob[1] if is_bias else glob[0]
+        if init is None:
+            init = default_initializer
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         data = init(shape, dtype)

@@ -22,3 +22,17 @@ def vector_to_parameters(vec, parameters, name=None):
 
 
 from ..clip import clip_grad_norm_  # noqa: E402,F401  (stub-era export)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """torch/paddle-style utility (reference nn/utils/clip_grad_value_):
+    clamp every parameter's grad to [-clip_value, clip_value] in place."""
+    import jax.numpy as jnp
+
+    if hasattr(parameters, "shape"):
+        parameters = [parameters]
+    cv = float(clip_value)
+    for p in parameters:
+        if p.grad is None:
+            continue
+        p.grad._data = jnp.clip(p.grad._data, -cv, cv)

@@ -106,15 +106,26 @@ class ViterbiDecoder(_nn.Layer):
                               self.include_bos_eos_tag)
 
 
-def __getattr__(name):
-    _datasets = {"Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
-                 "WMT14", "WMT16"}
-    if name in _datasets:
+class _CorpusDataset:
+    """Corpus-downloading dataset (reference text/datasets/*): zero
+    egress here, so CONSTRUCTION raises with guidance — the class
+    attribute exists (API-surface contract)."""
+
+    def __init__(self, *a, **k):
         raise RuntimeError(
-            f"paddle.text.{name} downloads its corpus; this environment "
-            "has no network egress. Load the files locally and feed them "
-            "through paddle.io.Dataset/DataLoader instead.")
-    raise AttributeError(name)
+            f"paddle.text.{type(self).__name__} downloads its corpus; "
+            "this environment has no network egress. Load the files "
+            "locally and feed them through paddle.io.Dataset/DataLoader "
+            "instead.")
+
+
+Conll05st = type("Conll05st", (_CorpusDataset,), {})
+Imdb = type("Imdb", (_CorpusDataset,), {})
+Imikolov = type("Imikolov", (_CorpusDataset,), {})
+Movielens = type("Movielens", (_CorpusDataset,), {})
+UCIHousing = type("UCIHousing", (_CorpusDataset,), {})
+WMT14 = type("WMT14", (_CorpusDataset,), {})
+WMT16 = type("WMT16", (_CorpusDataset,), {})
 
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None,

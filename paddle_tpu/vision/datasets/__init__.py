@@ -159,3 +159,22 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+class _DownloadDataset(Dataset):
+    """Corpus-downloading dataset (zero egress): construction raises
+    with guidance; the class exists for API parity."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"paddle.vision.datasets.{type(self).__name__} downloads "
+            "its archive; this environment has no network egress — "
+            "point DatasetFolder/paddle.io.Dataset at local files")
+
+
+class Flowers(_DownloadDataset):
+    pass
+
+
+class VOC2012(_DownloadDataset):
+    pass

@@ -206,8 +206,10 @@ class TestShims:
         assert paddle.sysconfig.get_include().endswith("csrc")
         assert paddle.callbacks.EarlyStopping is not None
         assert callable(paddle.tensor.math.add)
+        # r5: dataset classes EXIST (API surface) and raise at
+        # CONSTRUCTION instead of attribute access
         with pytest.raises(RuntimeError, match="egress"):
-            paddle.text.Imdb
+            paddle.text.Imdb()
         with pytest.raises(RuntimeError, match="egress"):
             paddle.dataset.mnist
         with pytest.raises(RuntimeError, match="onnx"):

@@ -452,3 +452,157 @@ class TestInplaceAndSparseAttention:
             paddle.to_tensor(v), paddle.to_tensor(offs),
             paddle.to_tensor(cols))
         np.testing.assert_allclose(np.asarray(out._data), v, rtol=1e-5)
+
+
+class TestIncubateFusedFunctionals:
+    """r5: the fused functional batch vs numpy references."""
+
+    def test_rope_neox_and_gptj(self):
+        import scipy  # noqa: F401
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding,
+        )
+
+        rng = np.random.default_rng(0)
+        b, s, h, d = 2, 6, 2, 8
+        q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+
+        def np_rope(x, neox):
+            inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+            freqs = np.outer(np.arange(s), inv)
+            emb = np.repeat(freqs, 2, axis=-1)
+            sin = np.sin(emb)[None, :, None, :]
+            cos = np.cos(emb)[None, :, None, :]
+            if neox:
+                x1, x2 = x[..., 0::2], x[..., 1::2]
+                s1, c1 = sin[..., 0::2], cos[..., 0::2]
+                out = np.empty_like(x)
+                out[..., 0::2] = x1 * c1 - x2 * s1
+                out[..., 1::2] = x2 * c1 + x1 * s1
+                return out
+            half = d // 2
+            x1, x2 = x[..., :half], x[..., half:]
+            s1, c1 = sin[..., :half], cos[..., :half]
+            return np.concatenate([x1 * c1 - x2 * s1,
+                                   x2 * c1 + x1 * s1], -1)
+
+        for neox in (True, False):
+            oq, ok, _ = fused_rotary_position_embedding(
+                paddle.to_tensor(q), paddle.to_tensor(k),
+                use_neox_rotary_style=neox)
+            np.testing.assert_allclose(np.asarray(oq._data),
+                                       np_rope(q, neox), rtol=1e-5,
+                                       atol=1e-5)
+            np.testing.assert_allclose(np.asarray(ok._data),
+                                       np_rope(k, neox), rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_fused_norms(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_layer_norm, fused_rms_norm,
+        )
+
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 4, 8)).astype(np.float32)
+        w = rng.standard_normal(8).astype(np.float32)
+        b = rng.standard_normal(8).astype(np.float32)
+        r = rng.standard_normal((2, 4, 8)).astype(np.float32)
+        out, res = fused_layer_norm(
+            paddle.to_tensor(x), paddle.to_tensor(w),
+            paddle.to_tensor(b), 1e-5, begin_norm_axis=2,
+            residual=paddle.to_tensor(r))
+        pre = x + r
+        mu = pre.mean(-1, keepdims=True)
+        want = (pre - mu) / np.sqrt(pre.var(-1, keepdims=True) + 1e-5) \
+            * w + b
+        np.testing.assert_allclose(np.asarray(out._data), want,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(res._data), pre,
+                                   rtol=1e-6)
+        # reference contract: bare tensor when residual is None
+        out2 = fused_rms_norm(paddle.to_tensor(x),
+                              paddle.to_tensor(w), None, 1e-6,
+                              begin_norm_axis=2)
+        want2 = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(np.asarray(out2._data), want2,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_ffn_and_mha(self):
+        import scipy.special as sps
+
+        from paddle_tpu.incubate.nn.functional import (
+            fused_feedforward, fused_multi_head_attention,
+        )
+
+        rng = np.random.default_rng(2)
+        b, s, e = 2, 4, 8
+        x = rng.standard_normal((b, s, e)).astype(np.float32) * 0.3
+        w1 = rng.standard_normal((e, 16)).astype(np.float32) * 0.3
+        w2 = rng.standard_normal((16, e)).astype(np.float32) * 0.3
+        out = fused_feedforward(
+            paddle.to_tensor(x), paddle.to_tensor(w1),
+            paddle.to_tensor(w2), dropout1_rate=0.0, dropout2_rate=0.0,
+            pre_layer_norm=True, training=False)
+        h = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        want = x + np.maximum(h @ w1, 0) @ w2
+        np.testing.assert_allclose(np.asarray(out._data), want,
+                                   rtol=1e-4, atol=1e-5)
+
+        nh = 2
+        qkvw = rng.standard_normal((3, nh, e // nh, e)) \
+            .astype(np.float32) * 0.3
+        lw = rng.standard_normal((e, e)).astype(np.float32) * 0.3
+        out2 = fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkvw),
+            paddle.to_tensor(lw), pre_layer_norm=True,
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        assert tuple(out2.shape) == (b, s, e)
+        assert np.isfinite(np.asarray(out2._data)).all()
+
+    def test_varlen_attention(self):
+        import scipy.special as sps
+
+        from paddle_tpu.incubate.nn.functional import (
+            variable_length_memory_efficient_attention,
+        )
+
+        rng = np.random.default_rng(3)
+        b, h, s, d = 2, 2, 6, 4
+        q = rng.standard_normal((b, h, s, d)).astype(np.float32)
+        k = rng.standard_normal((b, h, s, d)).astype(np.float32)
+        v = rng.standard_normal((b, h, s, d)).astype(np.float32)
+        lens = np.asarray([4, 6], np.int32)
+        out = variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(lens),
+            paddle.to_tensor(lens))
+        got = np.asarray(out._data)
+        # batch 0: rows/cols beyond len 4 are dead; compare the live
+        # block against dense attention over the first 4 positions
+        sc = np.einsum("hqd,hkd->hqk", q[0, :, :4], k[0, :, :4]) \
+            / np.sqrt(d)
+        want = np.einsum("hqk,hkd->hqd", sps.softmax(sc, -1),
+                         v[0, :, :4])
+        np.testing.assert_allclose(got[0, :, :4], want, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(got[0, :, 4:], 0.0, atol=1e-6)
+
+    def test_global_initializer_honored(self):
+        from paddle_tpu.nn.initializer import (
+            Constant, set_global_initializer,
+        )
+
+        set_global_initializer(Constant(0.5), Constant(-0.25))
+        try:
+            lin = paddle.nn.Linear(3, 3)
+            np.testing.assert_allclose(np.asarray(lin.weight._data), 0.5)
+            np.testing.assert_allclose(np.asarray(lin.bias._data), -0.25)
+        finally:
+            set_global_initializer(None)
+            # set_global_initializer(None, None) clears per reference
+            from paddle_tpu.nn import initializer as I
+            I._GLOBAL_INIT = None
+        lin2 = paddle.nn.Linear(3, 3)
+        assert np.asarray(lin2.weight._data).std() > 0

@@ -56,6 +56,14 @@ NAMESPACES = [
     ("quantization", "quantization/__init__.py"),
     ("profiler", "profiler/__init__.py"),
     ("fft", "fft.py"),
+    ("incubate.nn", "incubate/nn/__init__.py"),
+    ("incubate.nn.functional", "incubate/nn/functional/__init__.py"),
+    ("nn.utils", "nn/utils/__init__.py"),
+    ("nn.initializer", "nn/initializer/__init__.py"),
+    ("vision.datasets", "vision/datasets/__init__.py"),
+    ("text", "text/__init__.py"),
+    ("distributed.fleet", "distributed/fleet/__init__.py"),
+    ("hapi.callbacks", "hapi/callbacks.py"),
 ]
 
 
