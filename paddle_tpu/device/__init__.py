@@ -92,3 +92,135 @@ class tpu:
     @staticmethod
     def device_count():
         return device_count()
+
+
+# -- compiled-with predicates (reference device/__init__.py:37-52): the
+# build ships the XLA:TPU path only
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    """XLA collectives are always in the build."""
+    return True
+
+
+def is_compiled_with_custom_device(device_type):
+    return device_type in get_all_custom_device_type()
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_cudnn_version():
+    return None
+
+
+class XPUPlace(Place):
+    """Attribute-parity Place for reference XPUPlace — constructing one
+    is an error on a TPU-only build."""
+
+    def __init__(self, dev_id=0):
+        raise RuntimeError("XPUPlace: this build targets TPU (XLA) only")
+
+
+class IPUPlace(Place):
+    def __init__(self, dev_id=0):
+        raise RuntimeError("IPUPlace: this build targets TPU (XLA) only")
+
+
+class Stream:
+    """reference device.Stream: an ordered work queue. PJRT owns stream
+    scheduling — one logical stream per device — so Stream objects are
+    ordering tokens: synchronize() is a device sync, record/wait are
+    satisfied by XLA's program order."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def record_event(self, event=None):
+        event = event if event is not None else Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event):
+        pass                         # program order already serializes
+
+    def wait_stream(self, stream):
+        pass
+
+    def query(self):
+        return True
+
+
+class Event:
+    """reference device.Event: marker in a stream. Under PJRT's single
+    in-order queue an event is complete once recorded work is flushed."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+        self._recorded_on = None
+
+    def record(self, stream=None):
+        self._recorded_on = stream
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event):
+        raise NotImplementedError(
+            "Event timing needs device-side timestamps; profile with "
+            "paddle.profiler (jax.profiler traces) instead")
+
+
+_CURRENT_STREAM = {}
+
+
+def current_stream(device=None):
+    key = str(device)
+    if key not in _CURRENT_STREAM:
+        _CURRENT_STREAM[key] = Stream(device)
+    return _CURRENT_STREAM[key]
+
+
+def set_stream(stream):
+    prev = current_stream(stream.device)
+    _CURRENT_STREAM[str(stream.device)] = stream
+    return prev
+
+
+class stream_guard:
+    """Context manager selecting the ambient stream (no-op scheduling-
+    wise; keeps device.current_stream() coherent)."""
+
+    def __init__(self, stream):
+        self._stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False

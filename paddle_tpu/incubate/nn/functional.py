@@ -205,6 +205,14 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     GPT-J style rotates front/back halves."""
     from ...ops._dispatch import nary
 
+    if position_ids is not None and (sin is None or cos is None):
+        # reference fused_rotary_position_embedding.py:96-97: the derived
+        # table would only span the current seq_len, so cached-decode
+        # positions past it would clamp silently
+        raise ValueError(
+            "position_ids requires explicit sin/cos tables (the derived "
+            "table only covers the current sequence length)")
+
     def rope_one(x, sin_b, cos_b):
         if use_neox_rotary_style:
             x1 = x[..., 0::2]
@@ -238,16 +246,18 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             freqs = jnp.outer(t, inv)                     # [s, d/2]
             emb = jnp.repeat(freqs, 2, axis=-1)           # [s, d]
             sv, cv = jnp.sin(emb), jnp.cos(emb)
-        sv = sv.reshape(-1, sv.shape[-1])[:s]             # [s, d]
-        cv = cv.reshape(-1, cv.shape[-1])[:s]
+        sv = sv.reshape(-1, sv.shape[-1])                 # [T, d]
+        cv = cv.reshape(-1, cv.shape[-1])
         if pid is not None:
+            # decode-with-cache: position_ids index the FULL table —
+            # truncating to [:s] first would clamp positions >= s
             sv = sv[pid]                                   # [b, s, d]
             cv = cv[pid]
             sv = sv[:, :, None, :]
             cv = cv[:, :, None, :]
         else:
-            sv = sv[None, :, None, :]
-            cv = cv[None, :, None, :]
+            sv = sv[None, :s, None, :]
+            cv = cv[None, :s, None, :]
 
         def go(t32):
             out = rope_one(t32.astype(jnp.float32), sv, cv)
@@ -495,7 +505,14 @@ def fused_feedforward(x, linear1_weight, linear2_weight,
     h = ops.matmul(h, linear1_weight)
     if linear1_bias is not None:
         h = h + linear1_bias
-    h = F.relu(h) if activation == "relu" else F.gelu(h)
+    if activation == "relu":
+        h = F.relu(h)
+    elif activation == "gelu":
+        h = F.gelu(h)
+    else:
+        raise ValueError(
+            f"fused_feedforward: unsupported activation {activation!r} "
+            "(reference supports 'relu' and 'gelu')")
     h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
     h = ops.matmul(h, linear2_weight)
     if linear2_bias is not None:
@@ -538,6 +555,11 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
                          bias=pre_ln_bias, epsilon=pre_ln_epsilon)
     b, s, e = h.shape
     if transpose_qkv_wb:
+        if num_heads <= 0:
+            raise ValueError(
+                "fused_multi_head_attention(transpose_qkv_wb=True) "
+                "needs an explicit num_heads > 0 (the flat [e, 3e] "
+                "weight layout does not encode the head count)")
         nh = num_heads
         qkv = ops.matmul(h, qkv_weight)          # [b, s, 3e]
         if qkv_bias is not None:

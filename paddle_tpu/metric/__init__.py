@@ -152,7 +152,8 @@ class Auc(Metric):
         fp = np.cumsum(self._stat_neg[::-1])
         tpr = tp / tot_pos
         fpr = fp / tot_neg
-        return float(np.trapz(tpr, fpr))
+        return float(np.trapezoid(tpr, fpr) if hasattr(np, "trapezoid")
+                     else np.trapz(tpr, fpr))
 
     def name(self):
         return self._name

@@ -634,16 +634,22 @@ def batch(reader, batch_size, drop_last=False):
 
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
-    """paddle.create_parameter parity (static+dygraph creation API)."""
+    """paddle.create_parameter parity (static+dygraph creation API).
+    Initializer precedence mirrors Layer.create_parameter:
+    attr.initializer > default_initializer > framework default."""
     from ..nn import initializer as I
+    from ..nn.layer.layers import ParamAttr
 
-    init = default_initializer or (I.Constant(0.0) if is_bias
-                                   else I.XavierNormal())
+    attr = ParamAttr._to_attr(attr) if attr is not None else None
+    init = (getattr(attr, "initializer", None)
+            or default_initializer
+            or (I.Constant(0.0) if is_bias else I.XavierNormal()))
     data = init(shape, dtype)
     p = Tensor._wrap(data)
-    p.stop_gradient = False
-    if name:
-        p.name = name
+    p.stop_gradient = not getattr(attr, "trainable", True)
+    pname = name or getattr(attr, "name", None)
+    if pname:
+        p.name = pname
     return p
 
 

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 from ...nn.layer.layers import Layer
 
 __all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
-           "Conv3D", "SubmConv3D", "MaxPool3D", "functional"]
+           "Conv2D", "SubmConv2D", "Conv3D", "SubmConv3D", "MaxPool3D",
+           "SyncBatchNorm", "functional"]
 
 
 from . import functional  # noqa: E402
@@ -83,7 +84,25 @@ class SubmConv3D(Conv3D):
     pass
 
 
+class Conv2D(Layer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "sparse Conv2D is not in the TPU v1 op set (needs a pallas "
+            "gather-GEMM-scatter kernel pack)")
+
+
+class SubmConv2D(Conv2D):
+    pass
+
+
 class MaxPool3D(Layer):
     def __init__(self, *a, **k):
         raise NotImplementedError(
             "sparse MaxPool3D is not in the TPU v1 op set")
+
+
+class SyncBatchNorm(BatchNorm):
+    """Sparse SyncBatchNorm (reference sparse/nn/layer/norm.py): under
+    the single controller batch statistics are already global — plain
+    sparse BatchNorm IS the synchronized one."""
+

@@ -19,7 +19,19 @@ from ..hapi.model import InputSpec  # noqa: F401  (reference static.InputSpec)
 __all__ = ["InputSpec", "Program", "default_main_program",
            "default_startup_program", "program_guard", "name_scope",
            "device_guard", "data", "py_func", "gradients", "nn",
-           "cpu_places", "cuda_places", "Executor"]
+           "cpu_places", "cuda_places", "Executor",
+           "BuildStrategy", "CompiledProgram",
+           "ExponentialMovingAverage", "IpuCompiledProgram",
+           "IpuStrategy", "Print", "Variable", "WeightNormParamAttr",
+           "accuracy", "append_backward", "auc", "create_global_var",
+           "create_parameter", "ctr_metric_bundle",
+           "deserialize_persistables", "deserialize_program",
+           "global_scope", "ipu_shard_guard", "set_ipu_shard",
+           "load", "load_from_file", "load_inference_model",
+           "load_program_state", "normalize_program", "save",
+           "save_inference_model", "save_to_file", "scope_guard",
+           "serialize_persistables", "serialize_program",
+           "set_program_state", "xpu_places"]
 
 
 class Program:
@@ -183,13 +195,344 @@ class Executor:
         pass
 
 
-class nn:
-    """static.nn namespace: the dygraph functional ops serve both modes."""
-
-    def __getattr__(self, name):
-        import paddle_tpu.nn.functional as F
-
-        return getattr(F, name)
+from . import nn  # noqa: E402,F401
 
 
-nn = nn()
+# -- remaining reference static surface (r5 sweep) --------------------------
+def xpu_places(device_ids=None):
+    return []
+
+
+class BuildStrategy:
+    """Attribute bag (reference BuildStrategy): every toggle the
+    reference exposes is an XLA-owned decision here (fusion, memory
+    planning, reduce strategy); kept so config code parses."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.build_cinn_pass = False
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+
+
+class CompiledProgram:
+    """reference CompiledProgram(program, build_strategy): compilation
+    happens inside jit — this wrapper forwards to the underlying
+    captured Program so Executor.run accepts either."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    @property
+    def _fn(self):
+        return self._program._fn
+
+    @property
+    def _feed_list(self):
+        return self._program._feed_list
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise RuntimeError("IPU backend is not in the TPU build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError("IPU backend is not in the TPU build")
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference static.Print: identity op that prints the tensor.
+    Under trace this must be a host callback — jax.debug.print — so it
+    fires per execution, not per trace."""
+    import jax.debug
+
+    from ..framework.tensor import Tensor
+
+    d = input._data if isinstance(input, Tensor) else input
+    jax.debug.print("{m}: {x}", m=message or "Print", x=d)
+    return input
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    import paddle_tpu as paddle
+
+    return paddle.metric.accuracy(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """reference static.auc -> delegates to paddle.metric.Auc (the one
+    histogram-threshold implementation); returns the reference's
+    (auc, batch_auc, states) tuple shape with the histogram buckets as
+    states."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..framework.tensor import Tensor
+
+    m = paddle.metric.Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    av = paddle.to_tensor(m.accumulate())
+    return av, av, [Tensor._wrap(jnp.asarray(m._stat_pos)),
+                    Tensor._wrap(jnp.asarray(m._stat_neg))]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server CTR stack "
+        "(descoped, docs/DECISIONS.md §3); compute AUC via static.auc")
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference create_global_var: a filled persistent variable."""
+    import paddle_tpu as paddle
+
+    return paddle.create_parameter(
+        list(shape), dtype, name=name,
+        default_initializer=paddle.nn.initializer.Constant(value))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import paddle_tpu as paddle
+
+    return paddle.create_parameter(
+        shape, dtype, name=name, attr=attr, is_bias=is_bias,
+        default_initializer=default_initializer)
+
+
+def _variable_alias():
+    # reference static.Variable — the Tensor type plays both roles, so
+    # isinstance(x, static.Variable) checks in ported code keep working
+    from ..framework.tensor import Tensor
+
+    return Tensor
+
+
+Variable = _variable_alias()
+
+
+class WeightNormParamAttr:
+    """reference WeightNormParamAttr(dim=...): ParamAttr requesting
+    weight-norm reparameterization — consumed by nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..nn.layer.layers import ParamAttr
+
+        self.dim = dim
+        self.attr = ParamAttr(name=name, initializer=initializer,
+                              learning_rate=learning_rate,
+                              regularizer=regularizer,
+                              trainable=trainable)
+
+
+class ExponentialMovingAverage:
+    """reference static ExponentialMovingAverage: shadow weights
+    s = decay*s + (1-decay)*w with the reference's bias correction
+    (incubate/ema.py): apply() swaps shadows in, restore() swaps back.
+
+    Dygraph-native shape: register(parameters) once (or let the first
+    update() take them), call update() per step, wrap evaluation in
+    `with ema.apply():`."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self.thres_steps = thres_steps
+        self._step = 0
+        self._decay_prod = 1.0      # prod of per-step decays (correction)
+        self._shadow = None
+        self._params = None
+        self._backup = None
+
+    def register(self, parameters):
+        import numpy as np
+
+        self._params = list(parameters)
+        # shadows start at ZERO (reference ema: state_0 = 0) — that is
+        # what makes the 1/(1-decay^t) bias correction exact
+        self._shadow = [np.zeros_like(np.asarray(p.numpy()),
+                                      dtype=np.float64)
+                        for p in self._params]
+
+    def update(self, parameters=None):
+        import numpy as np
+
+        if self._params is None:
+            if parameters is None:
+                raise ValueError(
+                    "first update() needs `parameters` (or call "
+                    "register(parameters) beforehand)")
+            self.register(parameters)
+        self._step += 1
+        # reference dynamic decay (common.py EMA with thres_steps):
+        # d_t = min(decay, (1+t)/(10+t)) — warmup toward the target decay
+        d = (min(self.decay, (1.0 + self._step) / (10.0 + self._step))
+             if self.thres_steps is not None else self.decay)
+        self._decay_prod *= d
+        for s, p in zip(self._shadow, self._params):
+            s *= d
+            s += (1.0 - d) * np.asarray(p.numpy(), dtype=np.float64)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import numpy as np
+
+        if self._params is None:
+            raise RuntimeError("EMA has no registered parameters")
+        if self._step == 0:
+            raise RuntimeError(
+                "EMA.apply() before any update(): shadows are zero")
+        self._backup = [np.array(p.numpy()) for p in self._params]
+        # with zero-init shadows, EMA of constant w is (1-prod d_t) w,
+        # so this correction is exact for fixed AND dynamic decay
+        corr = 1.0 - self._decay_prod
+        for p, s in zip(self._params, self._shadow):
+            p.set_value((s / corr).astype(np.asarray(p.numpy()).dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p.set_value(b)
+        self._backup = None
+
+
+# -- scope / program-state / serialization ----------------------------------
+class _Scope:
+    """reference global scope: name -> variable registry. Eager tensors
+    live on python objects, so the scope is an explicit registry ported
+    scripts can populate."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, None)
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _GLOBAL_SCOPE
+    prev, _GLOBAL_SCOPE = _GLOBAL_SCOPE, scope
+    try:
+        yield
+    finally:
+        _GLOBAL_SCOPE = prev
+
+
+def save_to_file(path, content):
+    """reference save_to_file: raw bytes to disk."""
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _graph_serialization_raiser(opname, alt):
+    def fn(*a, **k):
+        raise RuntimeError(
+            f"static.{opname} serializes ProgramDesc protobufs, which "
+            f"do not exist on the TPU backend (programs are jaxpr/XLA, "
+            f"docs/DECISIONS.md §9); use {alt}")
+
+    fn.__name__ = opname
+    return fn
+
+
+serialize_program = _graph_serialization_raiser(
+    "serialize_program", "paddle.jit.save")
+serialize_persistables = _graph_serialization_raiser(
+    "serialize_persistables", "paddle.save(layer.state_dict(), path)")
+deserialize_program = _graph_serialization_raiser(
+    "deserialize_program", "paddle.jit.load")
+deserialize_persistables = _graph_serialization_raiser(
+    "deserialize_persistables", "paddle.load")
+normalize_program = _graph_serialization_raiser(
+    "normalize_program", "paddle.jit.save (pruning happens at trace)")
+append_backward = _graph_serialization_raiser(
+    "append_backward", "paddle.grad / paddle.static.gradients")
+load_program_state = _graph_serialization_raiser(
+    "load_program_state", "paddle.load")
+set_program_state = _graph_serialization_raiser(
+    "set_program_state", "layer.set_state_dict")
+
+
+def save(program, model_path, protocol=4):
+    raise RuntimeError(
+        "static.save persists a ProgramDesc; on the TPU backend save "
+        "the layer: paddle.save(layer.state_dict(), path) or "
+        "paddle.jit.save for the compiled program")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    raise RuntimeError(
+        "static.load restores a ProgramDesc; on the TPU backend use "
+        "paddle.load + layer.set_state_dict or paddle.jit.load")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    raise RuntimeError(
+        "static.save_inference_model: the deployable artifact here is "
+        "paddle.jit.save(layer, path) — StableHLO + weights "
+        "(docs/DECISIONS.md §9)")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise RuntimeError(
+        "static.load_inference_model: load the jit.save artifact with "
+        "paddle.jit.load(path)")

@@ -1,0 +1,362 @@
+"""paddle.static.nn (reference python/paddle/static/nn/__init__.py).
+
+The dygraph functionals serve both modes (module __getattr__ falls back
+to paddle.nn.functional), so this file holds only what is static-graph
+specific: the param-creating builders (fc, embedding,
+bilinear_tensor_product, deform_conv2d, row_conv), the control-flow ops
+(cond / case / switch_case / while_loop — lax-backed under trace,
+python-backed eager), and honest raisers for the LoD-sequence ops and
+PS-era ops the TPU build descopes (docs/DECISIONS.md §3, §9).
+
+Param-creating builders create their parameters at call time (the
+reference creates them in the Program's startup block — here the build
+phase IS the first call; see Program.from_function).
+"""
+from __future__ import annotations
+
+__all__ = [
+    "batch_norm", "bilinear_tensor_product", "case", "cond", "conv2d",
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "data_norm",
+    "deform_conv2d", "embedding", "fc", "group_norm", "instance_norm",
+    "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "sequence_conv", "sequence_enumerate", "sequence_expand",
+    "sequence_expand_as", "sequence_first_step", "sequence_last_step",
+    "sequence_pad", "sequence_pool", "sequence_reshape",
+    "sequence_scatter", "sequence_slice", "sequence_softmax",
+    "sequence_unpad", "sparse_embedding", "spectral_norm",
+    "static_pylayer", "switch_case", "while_loop",
+]
+
+
+def _paddle():
+    import paddle_tpu as paddle
+
+    return paddle
+
+
+def _is_traced(*vals):
+    import jax.core
+
+    from ..framework.tensor import Tensor
+
+    for v in vals:
+        d = v._data if isinstance(v, Tensor) else v
+        if isinstance(d, jax.core.Tracer):
+            return True
+    return False
+
+
+def _unwrap_tree(x):
+    """Tensor leaves -> raw jax arrays so lax control flow can stage the
+    branch outputs (lax sees only jax types)."""
+    import jax
+
+    from ..framework.tensor import Tensor
+
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, Tensor) else v, x,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def _rewrap(x):
+    """jax-array leaves back to Tensor (paddle surface contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.tensor import Tensor
+
+    return jax.tree_util.tree_map(
+        lambda v: Tensor._wrap(v)
+        if isinstance(v, (jax.Array, jnp.ndarray)) or hasattr(v, "aval")
+        else v, x)
+
+
+# -- control flow ----------------------------------------------------------
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """reference static/nn/control_flow.py cond: run true_fn or false_fn
+    by `pred`. Eager concrete pred: plain python dispatch. Traced:
+    jax.lax.cond (both branches must return matching structures —
+    the reference imposes the same constraint)."""
+    false_fn = false_fn if false_fn is not None else (lambda: None)
+    if not _is_traced(pred):
+        return true_fn() if bool(pred) else false_fn()
+    import jax
+
+    from ..framework.tensor import Tensor
+
+    p = pred._data if isinstance(pred, Tensor) else pred
+    return _rewrap(jax.lax.cond(
+        p.reshape(()).astype(bool),
+        lambda _: _unwrap_tree(true_fn()),
+        lambda _: _unwrap_tree(false_fn()), 0))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference case: first pair whose pred is True wins; fall through
+    to `default` (or the LAST pair's fn, reference semantics)."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    if default is None:
+        pairs, (_, default) = pairs[:-1], pairs[-1]
+    # fold into ONE nested-closure chain and call it once: eager short-
+    # circuits at the first true pred (lower conds never run); traced
+    # stages the nest
+    chain = default
+    for pred, fn in reversed(pairs):
+        chain = (lambda p=pred, f=fn, q=chain: lambda: cond(p, f, q))()
+    return chain()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference switch_case: dispatch on an integer index. Traced:
+    jax.lax.switch (one compiled program containing every branch)."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+    else:
+        pairs = [p if isinstance(p, tuple) else (i, p)
+                 for i, p in enumerate(branch_fns)]
+        keys = [k for k, _ in pairs]
+        fns = [f for _, f in pairs]
+    if not _is_traced(branch_index):
+        idx = int(branch_index)
+        for k, f in zip(keys, fns):
+            if k == idx:
+                return f()
+        # reference semantics: fall through to default, else the last fn
+        return default() if default is not None else fns[-1]()
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.tensor import Tensor
+
+    b = branch_index._data if isinstance(branch_index, Tensor) \
+        else branch_index
+    b = b.reshape(()).astype(jnp.int32)
+    table = list(fns) + [default if default is not None else fns[-1]]
+    # map sparse keys -> dense slot, unmatched -> default slot
+    slot = jnp.full((), len(fns), jnp.int32)
+    for i, k in enumerate(keys):
+        slot = jnp.where(b == k, jnp.int32(i), slot)
+    return _rewrap(jax.lax.switch(
+        slot, [lambda _, f=f: _unwrap_tree(f()) for f in table], 0))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """reference while_loop. Eager: python loop. Traced: lax.while_loop
+    over the Tensor pytree (fixed shapes/dtypes across iterations — the
+    same constraint the reference's while op imposes)."""
+    loop_vars = list(loop_vars)
+    first = cond_fn(*loop_vars)        # doubles as the traced-mode probe
+    if not _is_traced(*loop_vars) and not _is_traced(first):
+        keep = bool(first)
+        while keep:
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+            keep = bool(cond_fn(*loop_vars))
+        return loop_vars
+    import jax
+
+    from ..framework.tensor import Tensor
+
+    def unwrap(vs):
+        return [v._data if isinstance(v, Tensor) else v for v in vs]
+
+    def wrap(ds, protos):
+        return [Tensor._wrap(d) if isinstance(p, Tensor) else d
+                for d, p in zip(ds, protos)]
+
+    protos = loop_vars
+
+    def c(carry):
+        r = cond_fn(*wrap(list(carry), protos))
+        r = r._data if isinstance(r, Tensor) else r
+        return r.reshape(()).astype(bool)
+
+    def b(carry):
+        out = body_fn(*wrap(list(carry), protos))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(unwrap(out))
+
+    final = jax.lax.while_loop(c, b, tuple(unwrap(loop_vars)))
+    return wrap(list(final), protos)
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None, name=None):
+    """reference py_func: host-side python op. Eager code just calls the
+    function; under jit use jax.pure_callback directly."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference static_pylayer: custom-vjp block in the static graph.
+    The dygraph PyLayer (paddle.autograd.PyLayer, jax.custom_vjp-backed)
+    serves traced code too — wrap the fns there."""
+    raise RuntimeError(
+        "static_pylayer builds graph ops; define a paddle.autograd."
+        "PyLayer instead — it works under to_static (jax.custom_vjp)")
+
+
+# -- param-creating builders ------------------------------------------------
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference static/nn/common.py fc: flatten trailing dims, create a
+    weight [flat_in, size] (+ bias), matmul, optional activation."""
+    paddle = _paddle()
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = None
+    for xi in xs:
+        shape = list(xi.shape)
+        flat_in = 1
+        for d in shape[num_flatten_dims:]:
+            flat_in *= int(d)
+        xf = xi.reshape(shape[:num_flatten_dims] + [flat_in])
+        w = paddle.create_parameter(
+            [flat_in, size], xi.dtype,
+            attr=weight_attr,
+            default_initializer=paddle.nn.initializer.XavierUniform())
+        y = paddle.matmul(xf, w)
+        outs = y if outs is None else outs + y
+    if bias_attr is not False:
+        b = paddle.create_parameter(
+            [size], xs[0].dtype,
+            attr=bias_attr, is_bias=True,
+            default_initializer=paddle.nn.initializer.Constant(0.0))
+        outs = outs + b
+    if activation:
+        outs = getattr(paddle.nn.functional, activation)(outs)
+    return outs
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference static embedding: create the table, gather rows."""
+    paddle = _paddle()
+    w = paddle.create_parameter(
+        list(size), dtype, attr=param_attr,
+        default_initializer=paddle.nn.initializer.XavierUniform())
+    return paddle.nn.functional.embedding(input, w,
+                                          padding_idx=padding_idx)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference bilinear_tensor_product: out_k = x W_k y^T + b."""
+    paddle = _paddle()
+    dt = x.dtype
+    w = paddle.create_parameter(
+        [size, int(x.shape[-1]), int(y.shape[-1])], dt, attr=param_attr,
+        default_initializer=paddle.nn.initializer.XavierUniform())
+    out = paddle.einsum("bi,kij,bj->bk", x, w, y)
+    if bias_attr is not False:
+        b = paddle.create_parameter(
+            [size], dt, attr=bias_attr, is_bias=True,
+            default_initializer=paddle.nn.initializer.Constant(0.0))
+        out = out + b
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, name=None):
+    """reference static deform_conv2d -> the functional vision op with
+    created parameters."""
+    paddle = _paddle()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    dt = x.dtype
+    w = paddle.create_parameter(
+        [num_filters, int(x.shape[1]) // groups, ks[0], ks[1]], dt,
+        attr=param_attr,
+        default_initializer=paddle.nn.initializer.XavierUniform())
+    b = None
+    if bias_attr is not False:
+        b = paddle.create_parameter(
+            [num_filters], dt, attr=bias_attr, is_bias=True,
+            default_initializer=paddle.nn.initializer.Constant(0.0))
+    return paddle.vision.ops.deform_conv2d(
+        x, offset, w, bias=b, stride=stride, padding=padding,
+        dilation=dilation, deformable_groups=deformable_groups,
+        groups=groups, mask=mask)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference row_conv (lookahead convolution, Deep Speech 2):
+    y[t] = sum_{j=0..k} x[t+j] * w[j]  per feature channel.
+    Batched [B, T, D] layout; shift-and-sum maps to fused XLA adds."""
+    paddle = _paddle()
+    k = int(future_context_size)
+    dt = input.dtype
+    w = paddle.create_parameter(
+        [k + 1, int(input.shape[-1])], dt, attr=param_attr,
+        default_initializer=paddle.nn.initializer.Constant(1.0 / (k + 1)))
+    import jax.numpy as jnp
+
+    from ..framework.tensor import Tensor
+
+    x = input._data
+    T = x.shape[-2]
+    xp = jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, k), (0, 0)])
+    out = sum(xp[..., j:j + T, :] * w._data[j] for j in range(k + 1))
+    out = Tensor._wrap(out)
+    if act:
+        out = getattr(paddle.nn.functional, act)(out)
+    return out
+
+
+# -- descoped: PS-era + LoD-sequence ops ------------------------------------
+def _lod_raiser(opname):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"static.nn.{opname} operates on LoD (ragged) tensors — the "
+            "TPU build is static-shape; use padded batches + "
+            "sequence_mask / masked reductions (docs/DECISIONS.md §3)")
+
+    fn.__name__ = opname
+    return fn
+
+
+for _name in ["sequence_conv", "sequence_enumerate", "sequence_expand",
+              "sequence_expand_as", "sequence_first_step",
+              "sequence_last_step", "sequence_pad", "sequence_pool",
+              "sequence_reshape", "sequence_scatter", "sequence_slice",
+              "sequence_softmax", "sequence_unpad"]:
+    globals()[_name] = _lod_raiser(_name)
+
+
+def sparse_embedding(*a, **k):
+    raise NotImplementedError(
+        "sparse_embedding is the parameter-server distributed lookup "
+        "table (descoped, docs/DECISIONS.md §3); use static.nn.embedding")
+
+
+def data_norm(*a, **k):
+    raise NotImplementedError(
+        "data_norm is a parameter-server CTR op (descoped, docs/"
+        "DECISIONS.md §3); use paddle.nn.BatchNorm1D")
+
+
+def nce(*a, **k):
+    raise NotImplementedError(
+        "nce (noise-contrastive estimation over a sampled softmax) is "
+        "not in the TPU v1 op set; use fused-head chunked softmax "
+        "cross-entropy (jit.fused_scan_step) for large vocabularies")
+
+
+def __getattr__(name):
+    """Everything else (batch_norm, conv2d, prelu, spectral_norm, …):
+    the dygraph functionals serve both modes."""
+    import paddle_tpu.nn.functional as F
+
+    if hasattr(F, name):
+        return getattr(F, name)
+    raise AttributeError(f"module 'paddle.static.nn' has no attribute "
+                         f"{name!r}")

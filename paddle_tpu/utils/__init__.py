@@ -89,3 +89,32 @@ class cpp_extension:
         raise NotImplementedError(
             "use paddle_tpu.utils.cpp_build.build_extension (ctypes-based)"
         )
+
+
+def require_version(min_version, max_version=None):
+    """reference utils.require_version: assert the installed framework
+    version is inside [min_version, max_version]."""
+    from .. import version as _v
+
+    import re as _re
+
+    def parse(s):
+        # numeric prefix of each dotted component ('0-tpu' -> 0); pad to
+        # 3 so '3.0' vs '3.0.0' compare equal
+        parts = []
+        for p in str(s).split(".")[:3]:
+            m = _re.match(r"\d+", p)
+            parts.append(int(m.group()) if m else 0)
+        while len(parts) < 3:
+            parts.append(0)
+        return tuple(parts)
+
+    cur = parse(_v.full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {_v.full_version} < required "
+            f"{min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {_v.full_version} > allowed "
+            f"{max_version}")

@@ -606,3 +606,43 @@ class TestIncubateFusedFunctionals:
             I._GLOBAL_INIT = None
         lin2 = paddle.nn.Linear(3, 3)
         assert np.asarray(lin2.weight._data).std() > 0
+
+
+def test_rope_position_ids_index_full_table():
+    # decode-with-cache: position_ids >= current seq_len must index the
+    # FULL sin/cos table (a [:seq_len] truncation would silently clamp)
+    import numpy as np
+
+    from paddle_tpu.incubate.nn import functional as IF
+
+    d = 8
+    rs = np.random.RandomState(0)
+    table = rs.randn(64, d).astype(np.float32)
+    sin, cos = np.sin(table), np.cos(table)
+    q = rs.randn(1, 4, 2, d).astype(np.float32)
+
+    def run(s, c, p):
+        out = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), sin=paddle.to_tensor(s),
+            cos=paddle.to_tensor(c),
+            position_ids=paddle.to_tensor(p),
+            use_neox_rotary_style=True)
+        t = out[0] if isinstance(out, (tuple, list)) else out
+        return t.numpy()
+
+    a = run(sin, cos, np.array([[10, 11, 12, 13]], dtype=np.int64))
+    b = run(sin[10:14], cos[10:14],
+            np.array([[0, 1, 2, 3]], dtype=np.int64))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_fused_mha_transpose_wb_requires_num_heads():
+    import pytest as _pytest
+
+    from paddle_tpu.incubate.nn import functional as IF
+
+    with _pytest.raises(ValueError, match="num_heads"):
+        IF.fused_multi_head_attention(
+            paddle.randn([2, 3, 8]), qkv_weight=paddle.randn([8, 24]),
+            qkv_bias=None, linear_weight=paddle.randn([8, 8]),
+            linear_bias=None, transpose_qkv_wb=True)

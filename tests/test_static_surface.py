@@ -1,0 +1,290 @@
+"""Functional tests for the r5 static/device surface completion:
+control flow (cond/case/switch_case/while_loop), param-creating
+builders (fc/bilinear/row_conv/embedding), EMA, auc, scope machinery,
+device Stream/Event shims."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def test_cond_eager_and_traced():
+    x = paddle.to_tensor(3.0)
+    out = static.nn.cond(x > 2, lambda: x * 2, lambda: x - 1)
+    assert float(out) == 6.0
+
+    def traced(v):
+        return static.nn.cond(v > 2, lambda: v * 2, lambda: v - 1)
+
+    f = paddle.jit.to_static(traced)
+    assert float(f(paddle.to_tensor(3.0))) == 6.0
+    assert float(f(paddle.to_tensor(1.0))) == 0.0
+
+
+def test_case_first_true_wins():
+    x = paddle.to_tensor(0.5)
+    out = static.nn.case(
+        [(x > 1, lambda: paddle.to_tensor(10.0)),
+         (x > 0, lambda: paddle.to_tensor(20.0))],
+        default=lambda: paddle.to_tensor(30.0))
+    assert float(out) == 20.0
+    out = static.nn.case(
+        [(x > 1, lambda: paddle.to_tensor(10.0)),
+         (x > 0.9, lambda: paddle.to_tensor(20.0))],
+        default=lambda: paddle.to_tensor(30.0))
+    assert float(out) == 30.0
+
+
+def test_switch_case_traced_sparse_keys():
+    def traced(i):
+        return static.nn.switch_case(
+            i, {1: lambda: paddle.to_tensor(11.0),
+                7: lambda: paddle.to_tensor(77.0)},
+            default=lambda: paddle.to_tensor(-1.0))
+
+    f = paddle.jit.to_static(traced)
+    assert float(f(paddle.to_tensor(7, dtype="int32"))) == 77.0
+    assert float(f(paddle.to_tensor(1, dtype="int32"))) == 11.0
+    assert float(f(paddle.to_tensor(4, dtype="int32"))) == -1.0
+
+
+def test_switch_case_no_default_falls_to_last():
+    # reference control_flow.py: unmatched index + no default -> the
+    # LAST branch fn, in both eager and traced modes
+    fns = {1: lambda: paddle.to_tensor(11.0),
+           7: lambda: paddle.to_tensor(77.0)}
+    out = static.nn.switch_case(paddle.to_tensor(4, dtype="int32"), fns)
+    assert float(out) == 77.0
+    f = paddle.jit.to_static(
+        lambda i: static.nn.switch_case(i, fns))
+    assert float(f(paddle.to_tensor(4, dtype="int32"))) == 77.0
+
+
+def test_while_loop_eager_and_traced():
+    i, s = static.nn.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: (i + 1, s + i),
+        [paddle.to_tensor(0), paddle.to_tensor(0)])
+    assert int(i) == 5 and int(s) == 10
+
+    def traced(i0, s0):
+        i, s = static.nn.while_loop(
+            lambda i, s: i < 5, lambda i, s: (i + 1, s + i), [i0, s0])
+        return s
+
+    f = paddle.jit.to_static(traced)
+    assert int(f(paddle.to_tensor(0), paddle.to_tensor(0))) == 10
+
+
+def test_fc_and_bilinear_shapes():
+    x = paddle.randn([4, 3, 5])
+    y = static.nn.fc(x, 7, num_flatten_dims=1, activation="relu")
+    assert list(y.shape) == [4, 7]
+    assert float(y.min()) >= 0.0
+    a = paddle.randn([4, 5])
+    b = paddle.randn([4, 6])
+    out = static.nn.bilinear_tensor_product(a, b, size=3)
+    assert list(out.shape) == [4, 3]
+
+
+def test_row_conv_lookahead():
+    # with weight=const 1/(k+1), row_conv is the forward moving average
+    x = paddle.to_tensor(
+        np.arange(12, dtype=np.float32).reshape(1, 4, 3))
+    y = static.nn.row_conv(x, future_context_size=1)
+    ref = np.asarray(x.numpy())
+    exp = ref.copy()
+    exp[:, :3] = (ref[:, :3] + ref[:, 1:]) / 2
+    exp[:, 3] = ref[:, 3] / 2
+    np.testing.assert_allclose(y.numpy(), exp, rtol=1e-5)
+
+
+def test_static_embedding_lookup():
+    ids = paddle.to_tensor(np.array([[0, 2], [1, 0]], dtype=np.int64))
+    out = static.nn.embedding(ids, size=(4, 8))
+    assert list(out.shape) == [2, 2, 8]
+    np.testing.assert_allclose(out.numpy()[0, 0], out.numpy()[1, 1])
+
+
+def test_lod_sequence_ops_raise():
+    with pytest.raises(NotImplementedError, match="LoD"):
+        static.nn.sequence_pool(paddle.randn([3, 4]), "max")
+
+
+def test_ema_constant_weights_fixed_point():
+    # zero-init shadow + 1/(1-d^t) correction => EMA of CONSTANT weights
+    # is exactly the weights, at any step count (reference common.py EMA)
+    lin = paddle.nn.Linear(4, 4)
+    ema = static.ExponentialMovingAverage(0.9)
+    w0 = np.array(lin.weight.numpy())
+    ema.update(lin.parameters())
+    ema.update()
+    with ema.apply():
+        inside = np.array(lin.weight.numpy())
+    np.testing.assert_allclose(inside, w0, rtol=1e-5)
+    np.testing.assert_allclose(np.array(lin.weight.numpy()), w0,
+                               rtol=1e-6)
+
+
+def test_ema_blend_math():
+    d = 0.5
+    lin = paddle.nn.Linear(3, 3)
+    ema = static.ExponentialMovingAverage(d)
+    w0 = np.array(lin.weight.numpy())
+    ema.update(lin.parameters())          # s1 = (1-d) w0
+    w1 = w0 + 1.0
+    lin.weight.set_value(w1)
+    ema.update()                          # s2 = d(1-d) w0 + (1-d) w1
+    with ema.apply():
+        inside = np.array(lin.weight.numpy())
+    # corr = 1-d^2 = (1-d)(1+d)  =>  inside = (d w0 + w1)/(1+d)
+    np.testing.assert_allclose(inside, (d * w0 + w1) / (1 + d),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.array(lin.weight.numpy()), w1,
+                               rtol=1e-6)
+
+
+def test_auc_perfect_separation():
+    scores = paddle.to_tensor(
+        np.array([[0.9, 0.1], [0.8, 0.2], [0.3, 0.7], [0.2, 0.8]],
+                 dtype=np.float32))
+    labels = paddle.to_tensor(np.array([0, 0, 1, 1], dtype=np.int64))
+    a, _, _ = static.auc(scores, labels)
+    assert abs(float(a) - 1.0) < 1e-3
+    flipped = paddle.to_tensor(np.array([1, 1, 0, 0], dtype=np.int64))
+    a2, _, _ = static.auc(scores, flipped)
+    assert float(a2) < 0.1
+
+
+def test_scope_guard():
+    s = static.global_scope()
+    s.set_var("k", 42)
+    fresh = type(s)()
+    with static.scope_guard(fresh):
+        assert static.global_scope().find_var("k") is None
+    assert static.global_scope().find_var("k") == 42
+
+
+def test_compiled_program_passthrough():
+    prog = static.Program.from_function(
+        lambda x: {"out": x * 2}, feed_list=["x"])
+    cp = static.CompiledProgram(prog, static.BuildStrategy())
+    exe = static.Executor()
+    out, = exe.run(cp, feed={"x": np.ones(3, np.float32)},
+                   fetch_list=["out"])
+    np.testing.assert_allclose(out, 2 * np.ones(3))
+
+
+def test_variable_is_tensor():
+    assert isinstance(paddle.to_tensor(1.0), static.Variable)
+
+
+def test_device_stream_event_shims():
+    from paddle_tpu import device as D
+
+    assert D.is_compiled_with_rocm() is False
+    assert D.is_compiled_with_distribute() is True
+    s = D.Stream()
+    e = s.record_event()
+    assert e.query() is True
+    with D.stream_guard(s) as cur:
+        assert D.current_stream(s.device) is cur
+    with pytest.raises(RuntimeError):
+        D.XPUPlace(0)
+
+
+def test_require_version():
+    paddle.utils.require_version("2.0")
+    with pytest.raises(Exception, match="<"):
+        paddle.utils.require_version("99.0")
+
+
+def test_static_print_is_identity():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = static.Print(x, message="t")
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+
+
+def test_case_single_pair_no_default_calls_fn():
+    x = paddle.to_tensor(1.0)
+    out = static.nn.case([(x > 0, lambda: paddle.to_tensor(20.0))])
+    assert float(out) == 20.0          # called, not the raw lambda
+
+
+def test_case_eager_short_circuits():
+    calls = []
+
+    def mk(tag, val):
+        def f():
+            calls.append(tag)
+            return paddle.to_tensor(val)
+        return f
+
+    x = paddle.to_tensor(1.0)
+    out = static.nn.case([(x > 0, mk("a", 1.0)), (x > -1, mk("b", 2.0))],
+                         default=mk("d", 3.0))
+    assert float(out) == 1.0
+    assert calls == ["a"]              # lower branches never ran
+
+
+def test_rope_position_ids_requires_tables():
+    import pytest as _pytest
+
+    from paddle_tpu.incubate.nn import functional as IF
+
+    with _pytest.raises(ValueError, match="sin/cos"):
+        IF.fused_rotary_position_embedding(
+            paddle.randn([1, 2, 2, 8]),
+            position_ids=paddle.to_tensor([[10, 11]]))
+
+
+def test_fused_feedforward_rejects_unknown_activation():
+    import pytest as _pytest
+
+    from paddle_tpu.incubate.nn import functional as IF
+
+    with _pytest.raises(ValueError, match="activation"):
+        IF.fused_feedforward(
+            paddle.randn([2, 3, 8]), paddle.randn([8, 16]),
+            paddle.randn([16, 8]), activation="swish")
+
+
+def test_create_parameter_honors_attr_initializer():
+    w = paddle.create_parameter(
+        [3, 3], "float32",
+        attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Constant(0.5)))
+    np.testing.assert_allclose(w.numpy(), 0.5)
+    frozen = paddle.create_parameter(
+        [2], "float32", attr=paddle.ParamAttr(trainable=False))
+    assert frozen.stop_gradient
+
+
+def test_fc_weight_attr_initializer():
+    y = static.nn.fc(
+        paddle.randn([2, 4]), 3,
+        weight_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Constant(0.0)),
+        bias_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Constant(7.0)))
+    np.testing.assert_allclose(y.numpy(), 7.0)
+
+
+def test_weight_norm_param_attr_constructs():
+    a = static.WeightNormParamAttr(dim=0)
+    assert a.dim == 0 and a.attr.trainable
+
+
+def test_ema_dynamic_decay_fixed_point():
+    # thres_steps enables the reference warmup decay; the decay-product
+    # correction keeps the constant-weights fixed point exact
+    lin = paddle.nn.Linear(3, 3)
+    ema = static.ExponentialMovingAverage(0.999, thres_steps=True)
+    w0 = np.array(lin.weight.numpy())
+    ema.update(lin.parameters())
+    ema.update()
+    ema.update()
+    with ema.apply():
+        np.testing.assert_allclose(np.array(lin.weight.numpy()), w0,
+                                   rtol=1e-5)

@@ -64,6 +64,15 @@ NAMESPACES = [
     ("text", "text/__init__.py"),
     ("distributed.fleet", "distributed/fleet/__init__.py"),
     ("hapi.callbacks", "hapi/callbacks.py"),
+    ("static", "static/__init__.py"),
+    ("static.nn", "static/nn/__init__.py"),
+    ("device", "device/__init__.py"),
+    ("sparse", "sparse/__init__.py"),
+    ("sparse.nn", "sparse/nn/__init__.py"),
+    ("distribution", "distribution/__init__.py"),
+    ("nn.quant", "nn/quant/__init__.py"),
+    ("utils", "utils/__init__.py"),
+    ("distributed.checkpoint", "distributed/checkpoint/__init__.py"),
 ]
 
 
