@@ -5,3 +5,90 @@ from .hybrid_parallel_util import (  # noqa: F401
     broadcast_mp_parameters,
     broadcast_sharding_parameters,
 )
+
+import os
+import shutil
+
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+
+
+class LocalFS:
+    """reference fleet/utils/fs.py LocalFS: filesystem ops behind the
+    FS interface (checkpoint paths, data staging)."""
+
+    def ls_dir(self, fs_path):
+        if not os.path.exists(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path):
+            if not exist_ok:
+                raise FileExistsError(fs_path)
+            return
+        open(fs_path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def mv(self, src_path, dst_path, overwrite=False):
+        if os.path.exists(dst_path) and not overwrite:
+            raise FileExistsError(dst_path)
+        os.replace(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """reference fleet/utils/fs.py HDFSClient: shells out to a hadoop
+    binary. No hadoop runtime ships in this environment."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **k):
+        hadoop = shutil.which(
+            os.path.join(hadoop_home, "bin", "hadoop")
+            if hadoop_home else "hadoop")
+        if hadoop is None:
+            raise RuntimeError(
+                "HDFSClient needs a hadoop installation (bin/hadoop not "
+                "found); for local/NFS checkpoint storage use LocalFS")
+        self._hadoop = hadoop
+
+
+class DistributedInfer:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "DistributedInfer serves the parameter-server inference "
+            "path (descoped, docs/DECISIONS.md §3); use the Predictor "
+            "(paddle.inference) with sharded weights")

@@ -257,3 +257,11 @@ def shutdown(graceful=True, timeout=60):
     _state.update(store=None, rank=None, world_size=None, name=None,
                   server=None, stop=None, workers={}, epoch=0,
                   owns_store=False)
+
+
+def get_current_worker_info():
+    """reference rpc.get_current_worker_info: the calling process's own
+    WorkerInfo."""
+    if _state.get("name") is None:
+        raise RuntimeError("init_rpc has not been called")
+    return get_worker_info()

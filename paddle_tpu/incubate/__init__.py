@@ -1,5 +1,7 @@
 """paddle.incubate parity — experimental/advanced features."""
+from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from . import nn  # noqa: F401
 # segment reductions at the incubate root (reference incubate/tensor/math.py)
 from ..geometric import (  # noqa: E402,F401

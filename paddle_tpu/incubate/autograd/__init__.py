@@ -1,0 +1,115 @@
+"""paddle.incubate.autograd (reference incubate/autograd/__init__.py:
+Jacobian, Hessian, jvp, vjp, forward_grad, grad, enable_prim,
+disable_prim).
+
+The reference's "prim" switch lowers ops to primitive form so its
+static autodiff can transform them; under jax EVERY program is already
+traced to primitives and jvp/vjp are native program transforms, so
+enable_prim/disable_prim are recorded but change nothing.
+"""
+from __future__ import annotations
+
+from ...autograd import Hessian, Jacobian, hessian, jacobian  # noqa: F401
+
+_PRIM = False
+
+
+def enable_prim():
+    """No-op switch (jaxpr IS the primitive form); recorded for
+    prim_enabled() introspection."""
+    global _PRIM
+    _PRIM = True
+
+
+def disable_prim():
+    global _PRIM
+    _PRIM = False
+
+
+def prim_enabled():
+    return _PRIM
+
+
+def _unwrap(t):
+    from ...framework.tensor import Tensor
+
+    return t._data if isinstance(t, Tensor) else t
+
+
+def _wrap_tree(x):
+    import jax
+
+    from ...framework.tensor import Tensor
+
+    return jax.tree_util.tree_map(Tensor._wrap, x)
+
+
+def _fn_on_arrays(func):
+    from ...framework.tensor import Tensor
+
+    def f(*arrays):
+        out = func(*[Tensor._wrap(a) for a in arrays])
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda v: v._data if isinstance(v, Tensor) else v, out,
+            is_leaf=lambda v: isinstance(v, Tensor))
+
+    return f
+
+
+def jvp(func, xs, v=None):
+    """reference incubate/autograd/functional.py jvp: forward-mode
+    Jacobian-vector product. Returns (func(xs), J @ v)."""
+    import jax
+    import jax.numpy as jnp
+
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    prim = [_unwrap(x) for x in xs]
+    if v is None:
+        tang = [jnp.ones_like(p) for p in prim]
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        tang = [_unwrap(t) for t in v]
+    out, jv = jax.jvp(_fn_on_arrays(func), tuple(prim), tuple(tang))
+    return _wrap_tree(out), _wrap_tree(jv)
+
+
+def vjp(func, xs, v=None):
+    """reference vjp: reverse-mode vector-Jacobian product. Returns
+    (func(xs), v @ J)."""
+    import jax
+    import jax.numpy as jnp
+
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    prim = [_unwrap(x) for x in xs]
+    out, pullback = jax.vjp(_fn_on_arrays(func), *prim)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        cot = [_unwrap(t) for t in v]
+        flat, _ = jax.tree_util.tree_flatten(out)
+        cot = cot[0] if len(cot) == 1 and len(flat) == 1 else tuple(cot)
+    grads = pullback(cot)
+    grads = list(grads) if isinstance(grads, tuple) else [grads]
+    g = _wrap_tree(grads)
+    return _wrap_tree(out), g[0] if len(g) == 1 else g
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """reference primapi.forward_grad — forward-mode grads in the old
+    static-prim style. Eager tensors have no recorded program to
+    transform; use incubate.autograd.jvp(func, xs) on the FUNCTION."""
+    raise RuntimeError(
+        "forward_grad transforms a static prim program, which does not "
+        "exist here; call incubate.autograd.jvp(func, xs, v) instead "
+        "(native jax forward mode)")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """reference primapi.grad -> the live reverse-mode engine."""
+    import paddle_tpu as paddle
+
+    return paddle.grad(outputs, inputs, grad_outputs=grad_outputs,
+                       allow_unused=True)

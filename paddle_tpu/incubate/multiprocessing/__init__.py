@@ -1,0 +1,37 @@
+"""paddle.incubate.multiprocessing (reference
+incubate/multiprocessing/__init__.py): multiprocessing with tensor
+reductions registered. The reference registers its reducers on
+multiprocessing's ForkingPickler — NOT on the global pickle dispatch —
+so plain pickle/deepcopy semantics are untouched; tensors only take the
+numpy round-trip when crossing a process boundary. Same scoping here.
+Reference __all__ is empty; the module re-exports the stdlib namespace
+like the reference does.
+"""
+from __future__ import annotations
+
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing.reduction import ForkingPickler as _ForkingPickler
+
+
+def _reduce_tensor(t):
+    import numpy as np
+
+    arr = np.asarray(t.numpy())
+    return (_rebuild_tensor, (arr, not t.stop_gradient))
+
+
+def _rebuild_tensor(arr, trainable):
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(arr)
+    t.stop_gradient = not trainable
+    return t
+
+
+def _register_reductions():
+    from ...framework.tensor import Tensor
+
+    _ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+_register_reductions()

@@ -186,3 +186,6 @@ def identity_loss(x, reduction="none"):
     if reduction == "sum":
         return x.sum()
     return x
+
+
+from ..optimizer import LBFGS  # noqa: E402,F401  (reference incubate/optimizer/lbfgs.py graduated surface)

@@ -474,3 +474,55 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
             vt, -1, -2)[..., :kq]
 
     return unary(f, x, "pca_lowrank")
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False,
+                            transpose_y=False, bias=None, scale=1.0,
+                            output_dtype="float16", act="identity",
+                            name=None):
+    """fp8 x fp8 -> half GEMM (reference tensor/linalg.py:329
+    fp8_fp8_half_gemm_fused, cuBLASLt fp8 path): inputs are quantized
+    to float8_e4m3, multiplied with a half-precision accumulator,
+    scaled, bias-added, activated.
+
+    TPU formulation: jnp float8_e4m3fn casts give the fp8 value grid;
+    the matmul runs with preferred_element_type from output_dtype so
+    XLA picks the native mixed-precision MXU path where supported.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.dtype import to_jax_dtype
+    from ._dispatch import nary
+
+    out_dt = to_jax_dtype(output_dtype)
+    if out_dt not in (jnp.float16, jnp.bfloat16):
+        raise ValueError(
+            "output_dtype must be 'float16' or 'bfloat16' (reference "
+            f"contract), got {output_dtype!r}")
+
+    def f(a, b, *rest):
+        bb = rest[0] if rest else None
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        if transpose_x:
+            a8 = jnp.swapaxes(a8, -1, -2)
+        if transpose_y:
+            b8 = jnp.swapaxes(b8, -1, -2)
+        try:   # batch-aware; preferred_element_type picks the MXU path
+            out = jnp.matmul(a8, b8, preferred_element_type=out_dt)
+        except Exception:   # backend without native fp8 dot: widen first
+            out = jnp.matmul(a8.astype(out_dt), b8.astype(out_dt))
+        out = out.astype(out_dt) * jnp.asarray(scale, out_dt)
+        if bb is not None:
+            out = out + bb.astype(out_dt)
+        if act in ("identity", "", None):
+            return out
+        if act == "relu":
+            return jax.nn.relu(out)
+        if act == "gelu":
+            return jax.nn.gelu(out, approximate=False)
+        raise ValueError(f"unsupported act {act!r}")
+
+    args = [x, y] + ([bias] if bias is not None else [])
+    return nary(f, args, "fp8_fp8_half_gemm_fused")

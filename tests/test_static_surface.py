@@ -288,3 +288,86 @@ def test_ema_dynamic_decay_fixed_point():
     with ema.apply():
         np.testing.assert_allclose(np.array(lin.weight.numpy()), w0,
                                    rtol=1e-5)
+
+
+def test_incubate_autograd_jvp_vjp():
+    f = lambda t: t * t  # noqa: E731
+
+    x = paddle.to_tensor([2.0, 3.0])
+    v = paddle.to_tensor([1.0, 1.0])
+    out, jv = paddle.incubate.autograd.jvp(f, x, v)
+    np.testing.assert_allclose(out.numpy(), [4.0, 9.0])
+    np.testing.assert_allclose(jv.numpy(), [4.0, 6.0])
+    out, g = paddle.incubate.autograd.vjp(f, x, v)
+    np.testing.assert_allclose(g.numpy(), [4.0, 6.0])
+
+
+def test_fp8_gemm_reference_signature():
+    # reference positional order: (x, y, transpose_x, transpose_y, bias)
+    a = paddle.to_tensor(np.full((4, 2), 1.0, np.float32))
+    b = paddle.to_tensor(np.eye(4, dtype=np.float32))
+    out = paddle.linalg.fp8_fp8_half_gemm_fused(a, b, True, False,
+                                                None, 2.0, "bfloat16",
+                                                "relu")
+    assert str(out.dtype) == "paddle_tpu.bfloat16"
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float32),
+                               2.0 * np.ones((2, 4)), rtol=1e-2)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="output_dtype"):
+        paddle.linalg.fp8_fp8_half_gemm_fused(a, b,
+                                              output_dtype="float32")
+
+
+def test_fp8_gemm_batched_shapes():
+    x = paddle.randn([3, 2, 4])
+    y = paddle.randn([3, 4, 5])
+    out = paddle.linalg.fp8_fp8_half_gemm_fused(x, y)
+    assert list(out.shape) == [3, 2, 5]
+
+
+def test_fp8_gemm_quantizes_inputs():
+    # values on the fp8 e4m3 grid survive exactly; off-grid get rounded
+    a = paddle.to_tensor(np.full((2, 4), 1.5, np.float32))
+    b = paddle.to_tensor(np.eye(4, dtype=np.float32))
+    out = paddle.linalg.fp8_fp8_half_gemm_fused(a, b)
+    assert str(out.dtype) == "paddle_tpu.float16", str(out.dtype)
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float32),
+                               1.5 * np.ones((2, 4)), rtol=1e-3)
+
+
+def test_fleet_local_fs_roundtrip(tmp_path):
+    fs = paddle.distributed.fleet.utils.LocalFS()
+    d = str(tmp_path)
+    import os
+
+    fs.mkdirs(os.path.join(d, "sub"))
+    fs.touch(os.path.join(d, "f.txt"))
+    dirs, files = fs.ls_dir(d)
+    assert dirs == ["sub"] and files == ["f.txt"]
+    fs.mv(os.path.join(d, "f.txt"), os.path.join(d, "g.txt"))
+    assert fs.is_exist(os.path.join(d, "g.txt"))
+    assert not fs.is_exist(os.path.join(d, "f.txt"))
+    fs.delete(os.path.join(d, "sub"))
+    assert fs.list_dirs(d) == []
+
+
+def test_tensor_crosses_process_boundary_via_forking_pickler():
+    # the reducer is scoped to multiprocessing's ForkingPickler (the
+    # reference's scoping) — plain pickle/deepcopy are untouched
+    import copyreg
+    import io
+    import pickle
+    from multiprocessing.reduction import ForkingPickler
+
+    from paddle_tpu.incubate import multiprocessing as imp  # noqa: F401
+    from paddle_tpu.framework.tensor import Tensor
+
+    t = paddle.to_tensor([1.0, 2.0])
+    t.stop_gradient = False
+    buf = io.BytesIO()
+    ForkingPickler(buf).dump(t)
+    t2 = pickle.loads(buf.getvalue())
+    np.testing.assert_allclose(t2.numpy(), t.numpy())
+    assert t2.stop_gradient is False
+    assert Tensor not in copyreg.dispatch_table

@@ -73,6 +73,15 @@ NAMESPACES = [
     ("nn.quant", "nn/quant/__init__.py"),
     ("utils", "utils/__init__.py"),
     ("distributed.checkpoint", "distributed/checkpoint/__init__.py"),
+    ("linalg", "linalg.py"),
+    ("signal", "signal.py"),
+    ("incubate.autograd", "incubate/autograd/__init__.py"),
+    ("incubate.optimizer", "incubate/optimizer/__init__.py"),
+    ("distributed.rpc", "distributed/rpc/__init__.py"),
+    ("distributed.sharding", "distributed/sharding/__init__.py"),
+    ("distributed.fleet.utils", "distributed/fleet/utils/__init__.py"),
+    ("onnx", "onnx/__init__.py"),
+    ("sysconfig", "sysconfig.py"),
 ]
 
 
