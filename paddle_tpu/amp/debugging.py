@@ -38,27 +38,30 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_N
     return tensor
 
 
+def _make_observer(stats):
+    def observer(key, inputs):
+        dtypes = tuple(str(t._data.dtype) for t in inputs
+                       if isinstance(t, Tensor))
+        stats.setdefault(key, {}).setdefault(dtypes, 0)
+        stats[key][dtypes] += 1
+
+    return observer
+
+
 @contextlib.contextmanager
 def collect_operator_stats():
     """Collects per-op dtype stats during the block (reference:
-    paddle/amp/debugging.py enable_operator_stats_collection)."""
-    from ..framework import autograd as ag
+    paddle/amp/debugging.py enable_operator_stats_collection). The
+    observer hook fires inside apply_op itself, so ops from every
+    module are seen regardless of how apply_op was imported."""
+    from ..framework.autograd import set_op_observer
 
     stats = {}
-    orig = ag.apply_op
-
-    def wrapped(fn, inputs, attrs=None, name="", num_outputs=None):
-        key = name or getattr(fn, "__name__", "op")
-        dtypes = tuple(str(t._data.dtype) for t in inputs)
-        stats.setdefault(key, {}).setdefault(dtypes, 0)
-        stats[key][dtypes] += 1
-        return orig(fn, inputs, attrs=attrs, name=name, num_outputs=num_outputs)
-
-    ag.apply_op = wrapped
+    prev = set_op_observer(_make_observer(stats))
     try:
         yield stats
     finally:
-        ag.apply_op = orig
+        set_op_observer(prev)
         _print_stats(stats)
 
 
@@ -150,3 +153,53 @@ def compare_accuracy(dump_path, another_dump_path, output_filename,
         for name in only_b:
             w.writerow({"name": name, "note": "ONLY IN RUN B"})
     return rows
+
+
+def check_layer_numerics(func):
+    """reference amp/debugging.py:78 check_layer_numerics: decorator for
+    a Layer.forward that sweeps inputs and outputs for NaN/Inf."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                check_numerics(a, op_type=type(self).__name__,
+                               var_name=f"input[{i}]")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            if isinstance(o, Tensor):
+                check_numerics(o, op_type=type(self).__name__,
+                               var_name=f"output[{i}]")
+        return out
+
+    return wrapper
+
+
+_OP_STATS = {"active": None}
+
+
+def enable_operator_stats_collection():
+    """reference amp/debugging.py:481: start collecting per-op dtype
+    stats until disable_operator_stats_collection() prints them.
+    (collect_operator_stats is the context-manager form.)"""
+    if _OP_STATS["active"] is not None:
+        raise RuntimeError("operator stats collection already enabled")
+    from ..framework.autograd import set_op_observer
+
+    stats = {}
+    prev = set_op_observer(_make_observer(stats))
+    _OP_STATS["active"] = (prev, stats)
+
+
+def disable_operator_stats_collection():
+    if _OP_STATS["active"] is None:
+        raise RuntimeError("operator stats collection is not enabled")
+    from ..framework.autograd import set_op_observer
+
+    prev, stats = _OP_STATS["active"]
+    set_op_observer(prev)
+    _OP_STATS["active"] = None
+    _print_stats(stats)
+    return stats

@@ -73,17 +73,13 @@ class LocalFS:
 
 class HDFSClient:
     """reference fleet/utils/fs.py HDFSClient: shells out to a hadoop
-    binary. No hadoop runtime ships in this environment."""
+    binary. The hadoop FS interface is not implemented in this build —
+    construction fails fast rather than at the first ls/upload call."""
 
     def __init__(self, hadoop_home=None, configs=None, *a, **k):
-        hadoop = shutil.which(
-            os.path.join(hadoop_home, "bin", "hadoop")
-            if hadoop_home else "hadoop")
-        if hadoop is None:
-            raise RuntimeError(
-                "HDFSClient needs a hadoop installation (bin/hadoop not "
-                "found); for local/NFS checkpoint storage use LocalFS")
-        self._hadoop = hadoop
+        raise NotImplementedError(
+            "HDFSClient (hadoop shell-out FS) is not implemented in the "
+            "TPU build; for local/NFS checkpoint storage use LocalFS")
 
 
 class DistributedInfer:

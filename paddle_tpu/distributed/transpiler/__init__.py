@@ -1,0 +1,33 @@
+"""paddle.distributed.transpiler (reference distributed/transpiler/):
+the pre-fleet DistributeTranspiler that rewrote a Program into
+trainer/pserver halves. Superseded by collective training in the
+reference itself; on the TPU backend programs are partitioned by GSPMD
+(docs/DECISIONS.md §3)."""
+from __future__ import annotations
+
+
+class DistributeTranspiler:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "DistributeTranspiler rewrites ProgramDescs for the "
+            "parameter-server runtime (descoped); partitioning happens "
+            "via GSPMD shardings (paddle.distributed.shard_tensor)")
+
+
+class DistributeTranspilerConfig:
+    """Config value object (scripts construct it before the transpiler;
+    keeping it constructible lets configs parse up to the real call)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+
+
+class HashName:
+    def __init__(self, pserver_endpoints=None):
+        self.pserver_endpoints = pserver_endpoints or []
+
+
+class RoundRobin(HashName):
+    pass

@@ -1,0 +1,13 @@
+"""paddle.distributed.utils (reference distributed/utils/): launch/log
+helpers + the MoE alltoall utilities. The substantive members
+(global_scatter/global_gather) live in incubate.distributed.models.moe
+on this build; log utils are std logging."""
+from __future__ import annotations
+
+
+def get_logger(log_level=20, name="root"):
+    """reference log_utils.get_logger -> the shared log_helper config
+    path (one formatter/propagation policy for the whole framework)."""
+    from ...utils.log_helper import get_logger as _impl
+
+    return _impl(name, level=log_level)

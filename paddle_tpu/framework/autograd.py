@@ -252,6 +252,19 @@ def run_backward(
 _saved_tensor_hooks = None
 
 
+_OP_OBSERVER = None     # set by amp.debugging operator-stats collection
+
+
+def set_op_observer(observer):
+    """Install (or clear, with None) a callback `observer(name, inputs)`
+    invoked for every apply_op call — checked INSIDE apply_op so every
+    module that imported apply_op by value is still observed."""
+    global _OP_OBSERVER
+    prev = _OP_OBSERVER
+    _OP_OBSERVER = observer
+    return prev
+
+
 def apply_op(fn, inputs, attrs=None, name="", num_outputs=None):
     """Execute `fn(*jax_arrays, **attrs)` and record a GradNode if needed.
 
@@ -262,6 +275,8 @@ def apply_op(fn, inputs, attrs=None, name="", num_outputs=None):
     """
     from .tensor import Tensor
 
+    if _OP_OBSERVER is not None:
+        _OP_OBSERVER(name or getattr(fn, "__name__", "op"), inputs)
     attrs = attrs or {}
     datas = [t._data for t in inputs]
     needs_grad = is_grad_enabled() and any(not t.stop_gradient for t in inputs)

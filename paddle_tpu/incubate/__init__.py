@@ -1,7 +1,15 @@
 """paddle.incubate parity — experimental/advanced features."""
+from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
+from . import framework  # noqa: F401
+from . import jit  # noqa: F401
+from . import layers  # noqa: F401
 from . import multiprocessing  # noqa: F401
+from . import operators  # noqa: F401
+from . import passes  # noqa: F401
+from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 # segment reductions at the incubate root (reference incubate/tensor/math.py)
 from ..geometric import (  # noqa: E402,F401

@@ -1,0 +1,111 @@
+"""paddle.incubate.asp — Automatic SParsity (reference incubate/asp/:
+2:4 semi-structured pruning workflow: prune_model computes masks,
+decorate(optimizer) re-applies them after each step so pruned slots
+stay zero through training).
+
+TPU formulation: the MXU has no sparse-tensor-core fast path, so ASP
+here is the PRUNING workflow itself — mask computation (2:4 best-mag
+per group along the input dim), masked weights, and the optimizer
+wrapper that re-masks after updates. The masks are plain multiplies
+that XLA fuses into the surrounding program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_EXCLUDED = {}            # excluded parameter-name sets
+_SUPPORTED_TYPES = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """reference asp.set_excluded_layers: parameter names to skip."""
+    _EXCLUDED.setdefault("default", set()).update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.pop("default", None)
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """reference add_supported_layer: register extra layer types whose
+    weights prune_model should touch."""
+    _SUPPORTED_TYPES.add(layer if isinstance(layer, str)
+                         else getattr(layer, "__name__", str(layer)))
+
+
+def calculate_density(x):
+    """Fraction of non-zero entries (reference asp.calculate_density)."""
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float((arr != 0).sum() / max(arr.size, 1))
+
+
+def _mask_2_4(w):
+    """Best-magnitude 2-of-4 mask along the last axis (reference
+    asp/utils.py get_mask_2d_best / 1d greedy for n:m=2:4)."""
+    flat = w.reshape(-1, w.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % 4
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    g = np.abs(flat).reshape(flat.shape[0], -1, 4)
+    order = np.argsort(g, axis=-1)
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[..., 2:], True, axis=-1)   # top-2 of 4
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
+    return mask.reshape(w.shape)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """reference asp.prune_model: compute and apply n:m masks to every
+    prunable weight (2-D+ params of Linear-like layers, last-dim
+    groups). Returns {param_name: mask}."""
+    if (n, m) != (2, 4):
+        raise NotImplementedError("only 2:4 sparsity is supported")
+    excluded = _EXCLUDED.get("default", set())
+    out = {}
+    for pname, p in model.named_parameters():
+        if p.ndim < 2 or pname in excluded:
+            continue
+        w = np.asarray(p.numpy())
+        mask = _mask_2_4(w)
+        p.set_value((w * mask).astype(w.dtype))
+        p._asp_mask = mask          # lives and dies with the param
+        out[pname] = mask
+    return out
+
+
+class ASPOptimizer:
+    """Optimizer wrapper (reference asp decorate => OptimizerWithSparsityGuarantee):
+    after each step, zero the pruned slots so sparsity survives the
+    update."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _remask(self):
+        for p in getattr(self._inner, "_parameter_list", []) or []:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                w = np.asarray(p.numpy())
+                p.set_value((w * mask).astype(w.dtype))
+
+    def step(self):
+        self._inner.step()
+        self._remask()
+
+    def minimize(self, loss, *a, **k):
+        out = self._inner.minimize(loss, *a, **k)
+        self._remask()
+        return out
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+
+def decorate(optimizer):
+    """reference asp.decorate: wrap the optimizer so masks re-apply
+    after every step."""
+    return ASPOptimizer(optimizer)

@@ -1,0 +1,6 @@
+"""paddle.incubate.framework (reference incubate/framework/__init__.py):
+random-state snapshot helpers, graduated to paddle.framework here."""
+from ...framework.random import (  # noqa: F401
+    get_rng_state,
+    set_rng_state,
+)

@@ -1,0 +1,28 @@
+"""paddle.incubate.jit (reference incubate/jit/inference_decorator.py):
+@inference marks a layer/function for deployment-optimized execution.
+Reference semantics: convert to static, save, reload through the
+inference engine with TRT options. TPU path: paddle.jit.to_static IS
+the compiled inference path (XLA), so the decorator compiles the
+callable and ignores the engine-tuning knobs (they configure
+TensorRT/GPU memory pools)."""
+from __future__ import annotations
+
+
+def inference(function=None, cache_static_model=False,
+              save_model_dir=None, memory_pool_init_size_mb=1000,
+              precision_mode="float32", switch_ir_optim=True,
+              switch_ir_debug=False, enable_cinn=False, with_trt=False,
+              trt_precision_mode="float32", trt_use_static=False,
+              collect_shape=False, skip_prune_program=False,
+              exp_enable_use_cutlass=False, delete_pass_lists=None):
+    from ... import jit as _jit
+
+    if with_trt:
+        raise NotImplementedError(
+            "with_trt requests the TensorRT engine; the TPU build "
+            "compiles through XLA (no TRT)")
+
+    def wrap(fn):
+        return _jit.to_static(fn)
+
+    return wrap if function is None else wrap(function)

@@ -1,0 +1,8 @@
+"""paddle.incubate.layers (reference incubate/layers/nn.py): legacy
+fused CTR/PS layers (fused_embedding_seq_pool, shuffle_batch,
+pull_box_sparse, ...). The parameter-server data stack is descoped
+(docs/DECISIONS.md §3); every name resolves to an informative raiser
+so ported configs fail with guidance, not AttributeError."""
+from __future__ import annotations
+
+from . import nn  # noqa: F401

@@ -1,0 +1,27 @@
+"""Raiser surface for reference incubate/layers/nn.py (PS/CTR-era
+fused layers; LoD + distributed lookup-table dependent)."""
+from __future__ import annotations
+
+_NAMES = [
+    "fused_embedding_seq_pool", "fused_seqpool_cvm", "multiclass_nms2",
+    "search_pyramid_hash", "shuffle_batch", "partial_concat",
+    "partial_sum", "tdm_child", "tdm_sampler", "rank_attention",
+    "batch_fc", "pull_box_sparse", "pull_box_extended_sparse",
+    "pull_gpups_sparse", "pull_sparse", "pull_sparse_v2",
+    "bilateral_slice", "correlation", "fused_bn_add_act",
+]
+
+
+def _raiser(opname):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"incubate.layers.{opname} belongs to the parameter-server/"
+            "CTR stack (LoD tensors + distributed lookup tables), "
+            "descoped on the TPU build (docs/DECISIONS.md §3)")
+
+    fn.__name__ = opname
+    return fn
+
+
+for _n in _NAMES:
+    globals()[_n] = _raiser(_n)

@@ -1,0 +1,37 @@
+"""paddle.incubate.operators (reference incubate/operators/__init__.py):
+graph sampling ops + fused softmax-mask — re-exports of the live
+implementations (geometric / incubate.nn.functional)."""
+from ...geometric import (  # noqa: F401
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+    send_u_recv as graph_send_recv,
+)
+from ..nn.functional import (  # noqa: F401
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+
+
+def graph_khop_sampler(*args, **kwargs):
+    """Late-bound alias of incubate.graph_khop_sampler (defined in the
+    parent package; importing it eagerly would be circular)."""
+    from .. import graph_khop_sampler as _impl
+
+    return _impl(*args, **kwargs)
+
+
+class ResNetUnit:
+    """reference incubate/operators/resnet_unit.py: cuDNN-fused
+    conv+BN+add+relu block. XLA performs this fusion on the plain
+    composition, so the fused layer object has no TPU counterpart."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ResNetUnit is a cuDNN fusion wrapper; compose nn.Conv2D + "
+            "nn.BatchNorm2D + F.relu — XLA fuses the same pattern")
+
+
+def unzip(input, lod, len):
+    raise NotImplementedError(
+        "unzip operates on LoD tensors (parameter-server data path, "
+        "descoped docs/DECISIONS.md §3)")

@@ -1,0 +1,10 @@
+"""paddle.incubate.passes (reference incubate/passes/ir.py): python
+IR-pass authoring over ProgramDesc. Program transformation happens in
+XLA's pass pipeline on this backend; there is no python pass hook."""
+from __future__ import annotations
+
+
+def ir_pass(*a, **k):
+    raise NotImplementedError(
+        "python IR passes rewrite ProgramDesc graphs; the TPU backend "
+        "compiles jaxpr through XLA's pass pipeline (no python hook)")

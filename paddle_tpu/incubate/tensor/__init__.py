@@ -1,0 +1,9 @@
+"""paddle.incubate.tensor (reference incubate/tensor/__init__.py):
+graduated segment reductions, re-exported from geometric (one
+implementation — jax.ops.segment_* backed)."""
+from ...geometric import (  # noqa: F401
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)

@@ -1,0 +1,120 @@
+"""incubate.asp 2:4 sparsity workflow + amp.debugging collectors."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp
+
+
+def test_prune_model_2_4_density():
+    net = paddle.nn.Linear(8, 12)
+    masks = asp.prune_model(net)
+    assert "weight" in next(iter(masks))  # param name keyed
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+    # bias (1-D) untouched
+    assert asp.calculate_density(net.bias) in (0.0, 1.0)
+
+
+def test_mask_keeps_top2_of_each_group():
+    w = paddle.to_tensor(np.array(
+        [[1.0, -9.0, 0.5, 3.0, 2.0, 0.1, -0.2, 4.0]], np.float32))
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter([1, 8])
+            self.weight.set_value(w)
+
+    m = M()
+    asp.prune_model(m)
+    kept = np.asarray(m.weight.numpy())
+    np.testing.assert_allclose(
+        kept, [[0.0, -9.0, 0.0, 3.0, 2.0, 0.0, 0.0, 4.0]])
+
+
+def test_decorate_reapplies_mask_after_step():
+    net = paddle.nn.Linear(8, 8)
+    asp.prune_model(net)
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.5, parameters=net.parameters()))
+    x = paddle.randn([4, 8])
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+
+def test_excluded_layers_skipped():
+    net = paddle.nn.Linear(6, 4)
+    name = dict(net.named_parameters())
+    wname = [k for k in name if k.endswith("weight")][0]
+    asp.set_excluded_layers([wname])
+    try:
+        masks = asp.prune_model(net)
+        assert wname not in masks
+        assert asp.calculate_density(net.weight) == 1.0
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_operator_stats_enable_disable():
+    D = paddle.amp.debugging
+    D.enable_operator_stats_collection()
+    _ = paddle.ones([2]) + paddle.ones([2])
+    stats = D.disable_operator_stats_collection()
+    assert any("add" in k for k in stats)
+    with pytest.raises(RuntimeError):
+        D.disable_operator_stats_collection()
+
+
+def test_collect_operator_stats_context():
+    with paddle.amp.debugging.collect_operator_stats() as s:
+        _ = paddle.ones([2]) * 3
+    assert any("mul" in k for k in s)
+
+
+def test_check_layer_numerics_decorator():
+    class L(paddle.nn.Layer):
+        @paddle.amp.debugging.check_layer_numerics
+        def forward(self, x):
+            return x / 0.0
+
+    with pytest.raises(FloatingPointError):
+        L()(paddle.ones([2]))
+
+
+def test_incubate_jit_inference_compiles():
+    @paddle.incubate.jit.inference
+    def f(x):
+        return x * 2
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor([3.0])).numpy(), [6.0])
+
+
+def test_minimize_reapplies_mask():
+    net = paddle.nn.Linear(8, 8)
+    asp.prune_model(net)
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.5, parameters=net.parameters()))
+    x = paddle.randn([4, 8])
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.minimize(loss)
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+
+def test_operator_stats_see_by_value_imports():
+    # the observer hook lives inside apply_op, so ops from modules that
+    # imported apply_op by value (cast, split) are still recorded
+    with paddle.amp.debugging.collect_operator_stats() as s:
+        t = paddle.ones([4])
+        t.cast("float64")
+        paddle.split(t, 2)
+    assert any("cast" in k for k in s)
+    assert any("split" in k for k in s)
+
+
+def test_hdfs_client_fails_fast():
+    with pytest.raises(NotImplementedError, match="LocalFS"):
+        paddle.distributed.fleet.utils.HDFSClient()

@@ -82,6 +82,8 @@ NAMESPACES = [
     ("distributed.fleet.utils", "distributed/fleet/utils/__init__.py"),
     ("onnx", "onnx/__init__.py"),
     ("sysconfig", "sysconfig.py"),
+    ("incubate.asp", "incubate/asp/__init__.py"),
+    ("amp.debugging", "amp/debugging.py"),
 ]
 
 
