@@ -21,6 +21,9 @@ from ..framework.device import (  # noqa: F401
 )
 
 
+from . import xpu  # noqa: F401
+
+
 def get_all_device_type():
     return sorted({d.platform for d in jax.devices()})
 

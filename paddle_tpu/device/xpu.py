@@ -1,0 +1,18 @@
+"""paddle.device.xpu (reference device/xpu/__init__.py): Kunlun-XPU
+introspection. The TPU build has no XPU runtime — counts are zero and
+device-requiring calls raise."""
+from __future__ import annotations
+
+__all__ = ["synchronize", "device_count", "set_debug_level"]
+
+
+def device_count():
+    return 0
+
+
+def synchronize(device=None):
+    raise RuntimeError("no XPU devices in the TPU build")
+
+
+def set_debug_level(level=1):
+    raise RuntimeError("no XPU runtime in the TPU build")

@@ -19,6 +19,7 @@ from .fleet import (  # noqa: F401
     distributed_optimizer,
 )
 from . import layers  # noqa: F401
+from . import metrics  # noqa: F401
 from . import utils  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import meta_optimizers  # noqa: F401

@@ -1,0 +1,20 @@
+"""paddle.distributed.fleet.metrics (reference fleet/metrics/metric.py):
+globally-reduced training metrics. The reference allreduces numpy
+scalars across trainers through fleet util; under the single
+controller every value is already global, and when a collective world
+IS active (launch multi-process) the values reduce through
+paddle.distributed.all_reduce.
+"""
+from __future__ import annotations
+
+from . import metric  # noqa: F401
+from .metric import (  # noqa: F401
+    acc,
+    auc,
+    mae,
+    max,
+    min,
+    mse,
+    rmse,
+    sum,
+)

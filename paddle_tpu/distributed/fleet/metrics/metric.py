@@ -1,0 +1,114 @@
+"""Globally-reduced metric helpers (reference fleet/metrics/metric.py).
+
+Each function takes a local numpy value / Tensor, reduces it over the
+trainer world, and returns the global result as numpy. Reduction uses
+paddle.distributed.all_reduce when a multi-process world is
+initialized; single-controller (world 1) values are already global.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+
+def _to_np(v):
+    if hasattr(v, "numpy"):
+        return np.asarray(v.numpy(), dtype=np.float64)
+    return np.asarray(v, dtype=np.float64)
+
+
+_GEN = [0]
+
+
+def _allreduce(arr, op="sum"):
+    """Reduce across TRAINER PROCESSES. Under the single controller the
+    local value is already global (device axes don't partial metrics),
+    so this is the identity unless a multi-process gloo world was
+    initialized (gloo_init_parallel_env) — then ranks exchange values
+    through the TCPStore and reduce locally (exact, order-free)."""
+    from ... import compat
+
+    store = getattr(compat, "_GLOO_STORE", None)
+    world = getattr(compat, "_GLOO_WORLD", 0)
+    if store is None or world <= 1:
+        return arr
+    import pickle
+
+    _GEN[0] += 1
+    gen = _GEN[0]
+    rank = getattr(compat, "_GLOO_RANK", 0)   # the gloo world's rank
+    # ONE key per rank, generation-tagged payload: store stays bounded
+    # regardless of how many metric calls the training loop makes
+    store.set(f"fleet/metric/{rank}", pickle.dumps((gen, arr)))
+    compat.gloo_barrier()                     # everyone has written gen
+    vals = []
+    for r in range(world):
+        g, v = pickle.loads(store.get(f"fleet/metric/{r}"))
+        if g != gen:
+            raise RuntimeError(
+                f"fleet.metrics generation skew: rank {r} at {g}, "
+                f"expected {gen} (mismatched metric call sequences "
+                "across ranks)")
+        vals.append(v)
+    compat.gloo_barrier()                     # everyone has read gen
+    red = {"sum": np.sum, "max": np.max, "min": np.min}[op]
+    return red(np.stack([np.asarray(v, np.float64) for v in vals]),
+               axis=0)
+
+
+def sum(input, scope=None, util=None):
+    """Global sum (reference metric.py:26)."""
+    return _allreduce(_to_np(input).sum(keepdims=False), "sum")
+
+
+def max(input, scope=None, util=None):
+    return _allreduce(_to_np(input).max(), "max")
+
+
+def min(input, scope=None, util=None):
+    return _allreduce(_to_np(input).min(), "min")
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from the Auc metric's positive/negative histogram
+    buckets (reference metric.py:149): sum buckets over the world, then
+    the same threshold-sweep trapezoid as metric.Auc."""
+    pos = _allreduce(_to_np(stat_pos), "sum").reshape(-1)
+    neg = _allreduce(_to_np(stat_neg), "sum").reshape(-1)
+    tot_pos, tot_neg = pos.sum(), neg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.0
+    # prepend the (0,0) ROC anchor — without it the leading triangle is
+    # lost and a populated top bucket degenerates the integral to 0
+    tp = np.concatenate([[0.0], np.cumsum(pos[::-1])])
+    fp = np.concatenate([[0.0], np.cumsum(neg[::-1])])
+    tpr = tp / tot_pos
+    fpr = fp / tot_neg
+    trap = np.trapezoid if hasattr(np, "trapezoid") else np.trapz
+    return float(trap(tpr, fpr))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """Global mean absolute error from summed |err| and counts."""
+    e = _allreduce(_to_np(abserr).sum(), "sum")
+    n = _allreduce(_to_np(total_ins_num).sum(), "sum")
+    return float(e / builtins.max(n, 1.0))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    e = _allreduce(_to_np(sqrerr).sum(), "sum")
+    n = _allreduce(_to_np(total_ins_num).sum(), "sum")
+    return float(np.sqrt(e / builtins.max(n, 1.0)))
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    e = _allreduce(_to_np(sqrerr).sum(), "sum")
+    n = _allreduce(_to_np(total_ins_num).sum(), "sum")
+    return float(e / builtins.max(n, 1.0))
+
+
+def acc(correct, total, scope=None, util=None):
+    c = _allreduce(_to_np(correct).sum(), "sum")
+    n = _allreduce(_to_np(total).sum(), "sum")
+    return float(c / builtins.max(n, 1.0))

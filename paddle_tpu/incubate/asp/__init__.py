@@ -5,7 +5,7 @@ stay zero through training).
 
 TPU formulation: the MXU has no sparse-tensor-core fast path, so ASP
 here is the PRUNING workflow itself — mask computation (2:4 best-mag
-per group along the input dim), masked weights, and the optimizer
+per group along the contraction/input dim), masked weights, and the optimizer
 wrapper that re-masks after updates. The masks are plain multiplies
 that XLA fuses into the surrounding program.
 """
@@ -39,37 +39,74 @@ def calculate_density(x):
     return float((arr != 0).sum() / max(arr.size, 1))
 
 
-def _mask_2_4(w):
-    """Best-magnitude 2-of-4 mask along the last axis (reference
-    asp/utils.py get_mask_2d_best / 1d greedy for n:m=2:4)."""
-    flat = w.reshape(-1, w.shape[-1])
-    cols = flat.shape[1]
+def _is_supported_layer(layer):
+    return type(layer).__name__ in (_DEFAULT_SUPPORTED
+                                    | _SUPPORTED_TYPES)
+
+
+def _mask_rows_2_4(rows):
+    """Best-magnitude 2-of-4 mask along the last axis of a 2-D array."""
+    cols = rows.shape[1]
     pad = (-cols) % 4
     if pad:
-        flat = np.pad(flat, [(0, 0), (0, pad)])
-    g = np.abs(flat).reshape(flat.shape[0], -1, 4)
+        rows = np.pad(rows, [(0, 0), (0, pad)])
+    g = np.abs(rows).reshape(rows.shape[0], -1, 4)
     order = np.argsort(g, axis=-1)
     mask = np.zeros_like(g, dtype=bool)
     np.put_along_axis(mask, order[..., 2:], True, axis=-1)   # top-2 of 4
-    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
-    return mask.reshape(w.shape)
+    return mask.reshape(rows.shape[0], -1)[:, :cols]
+
+
+def _mask_2_4(w):
+    """2:4 mask grouped along the INPUT/k dim (reference asp/utils.py
+    _default_pruning: create_mask(w.T).T for [in, out] fc weights —
+    the dim the sparse MMA contracts over). Conv kernels reshape to
+    [out, in*kh*kw] and prune the contraction dim the same way."""
+    if w.ndim == 2:                   # [in, out]: group along axis 0
+        return _mask_rows_2_4(w.T).T
+    flat = w.reshape(w.shape[0], -1)  # [out, in*k...]: contraction dim
+    return _mask_rows_2_4(flat).reshape(w.shape)
+
+
+_DEFAULT_SUPPORTED = {"Linear", "Conv1D", "Conv2D", "Conv3D"}
+
+
+def _prunable_params(model):
+    """(name, param) pairs belonging to supported layer types
+    (reference _is_supported_layer: fc/linear/conv only, plus
+    add_supported_layer registrations) — embeddings, norms etc. are
+    never pruned."""
+    supported = _DEFAULT_SUPPORTED | _SUPPORTED_TYPES
+    seen = set()
+    for lname, layer in model.named_sublayers(include_self=True):
+        if type(layer).__name__ not in supported:
+            continue
+        for pname, p in layer.named_parameters(include_sublayers=False):
+            full = f"{lname}.{pname}" if lname else pname
+            if id(p) not in seen:
+                seen.add(id(p))
+                yield full, p
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
-    """reference asp.prune_model: compute and apply n:m masks to every
-    prunable weight (2-D+ params of Linear-like layers, last-dim
-    groups). Returns {param_name: mask}."""
+    """reference asp.prune_model: compute and apply n:m masks to the
+    prunable weights (2-D+ params of supported layer types, grouped
+    along the contraction dim). Returns {param_name: mask}."""
+    import jax.numpy as jnp
+
     if (n, m) != (2, 4):
         raise NotImplementedError("only 2:4 sparsity is supported")
     excluded = _EXCLUDED.get("default", set())
     out = {}
-    for pname, p in model.named_parameters():
+    for pname, p in _prunable_params(model):
         if p.ndim < 2 or pname in excluded:
             continue
         w = np.asarray(p.numpy())
         mask = _mask_2_4(w)
         p.set_value((w * mask).astype(w.dtype))
-        p._asp_mask = mask          # lives and dies with the param
+        # device-resident mask: step-time re-masking is one fused
+        # multiply, no host round-trip
+        p._asp_mask = jnp.asarray(mask, p._data.dtype)
         out[pname] = mask
     return out
 
@@ -86,11 +123,12 @@ class ASPOptimizer:
         return getattr(self._inner, name)
 
     def _remask(self):
+        from ...framework.tensor import Tensor
+
         for p in getattr(self._inner, "_parameter_list", []) or []:
             mask = getattr(p, "_asp_mask", None)
             if mask is not None:
-                w = np.asarray(p.numpy())
-                p.set_value((w * mask).astype(w.dtype))
+                p.set_value(Tensor._wrap(p._data * mask))  # on device
 
     def step(self):
         self._inner.step()

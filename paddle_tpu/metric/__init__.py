@@ -147,9 +147,10 @@ class Auc(Metric):
         tot_neg = self._stat_neg.sum()
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
-        # trapezoid over thresholds (descending)
-        tp = np.cumsum(self._stat_pos[::-1])
-        fp = np.cumsum(self._stat_neg[::-1])
+        # trapezoid over thresholds (descending), anchored at (0,0) so
+        # a populated top bucket keeps its leading triangle
+        tp = np.concatenate([[0.0], np.cumsum(self._stat_pos[::-1])])
+        fp = np.concatenate([[0.0], np.cumsum(self._stat_neg[::-1])])
         tpr = tp / tot_pos
         fpr = fp / tot_neg
         return float(np.trapezoid(tpr, fpr) if hasattr(np, "trapezoid")

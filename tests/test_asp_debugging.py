@@ -15,21 +15,29 @@ def test_prune_model_2_4_density():
     assert asp.calculate_density(net.bias) in (0.0, 1.0)
 
 
-def test_mask_keeps_top2_of_each_group():
-    w = paddle.to_tensor(np.array(
-        [[1.0, -9.0, 0.5, 3.0, 2.0, 0.1, -0.2, 4.0]], np.float32))
-
-    class M(paddle.nn.Layer):
-        def __init__(self):
-            super().__init__()
-            self.weight = self.create_parameter([1, 8])
-            self.weight.set_value(w)
-
-    m = M()
+def test_mask_groups_along_input_dim():
+    # Linear weight is [in, out]; 2:4 groups run down the INPUT dim
+    # (reference _default_pruning prunes create_mask(w.T).T)
+    m = paddle.nn.Linear(4, 2)
+    w = np.array([[1.0, 0.1],
+                  [-9.0, 0.2],
+                  [0.5, -0.3],
+                  [3.0, 0.05]], np.float32)
+    m.weight.set_value(paddle.to_tensor(w))
     asp.prune_model(m)
     kept = np.asarray(m.weight.numpy())
-    np.testing.assert_allclose(
-        kept, [[0.0, -9.0, 0.0, 3.0, 2.0, 0.0, 0.0, 4.0]])
+    # column 0 keeps |-9|,|3|; column 1 keeps |0.2|,|-0.3|
+    np.testing.assert_allclose(kept, [[0.0, 0.0],
+                                      [-9.0, 0.2],
+                                      [0.0, -0.3],
+                                      [3.0, 0.0]])
+
+
+def test_unsupported_layers_not_pruned():
+    emb = paddle.nn.Embedding(16, 8)
+    masks = asp.prune_model(emb)
+    assert masks == {}
+    assert asp.calculate_density(emb.weight) == 1.0
 
 
 def test_decorate_reapplies_mask_after_step():
@@ -118,3 +126,56 @@ def test_operator_stats_see_by_value_imports():
 def test_hdfs_client_fails_fast():
     with pytest.raises(NotImplementedError, match="LocalFS"):
         paddle.distributed.fleet.utils.HDFSClient()
+
+
+def test_fleet_metrics_single_controller():
+    M = paddle.distributed.fleet.metrics
+    assert M.sum(np.array([1.0, 2.0])) == 3.0
+    assert M.acc(np.array(8.0), np.array(10.0)) == 0.8
+    assert M.mae(np.array([2.0, 2.0]), np.array(4.0)) == 1.0
+    assert abs(M.rmse(np.array(8.0), np.array(2.0)) - 2.0) < 1e-12
+    assert M.max(np.array([3.0, 7.0])) == 7.0
+
+
+def test_fleet_metrics_auc_from_buckets():
+    m = paddle.metric.Auc(num_thresholds=4095)
+    m.update(np.array([[0.9, 0.1], [0.8, 0.2], [0.3, 0.7], [0.2, 0.8]],
+                      np.float32),
+             np.array([0, 0, 1, 1]))
+    a = paddle.distributed.fleet.metrics.auc(m._stat_pos, m._stat_neg)
+    assert abs(a - 1.0) < 1e-3
+
+
+def test_fleet_metrics_cross_process_sum():
+    # two real processes reduce through the TCPStore-backed gloo world
+    import subprocess
+    import sys
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    prog = """
+import sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed import compat
+rank = int(sys.argv[1]); port = sys.argv[2]
+compat.gloo_init_parallel_env(rank, 2, "127.0.0.1:" + port)
+from paddle_tpu.distributed.fleet import metrics
+out = metrics.sum(np.array(float(rank + 1)))
+print("SUM", out)
+compat.gloo_release()
+"""
+    import os
+
+    env = dict(os.environ)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", prog, str(r), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        text=True) for r in range(2)]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for (so, se), p in zip(outs, procs):
+        assert p.returncode == 0, se[-800:]
+        assert "SUM 3.0" in so, (so, se[-400:])

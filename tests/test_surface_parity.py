@@ -84,6 +84,13 @@ NAMESPACES = [
     ("sysconfig", "sysconfig.py"),
     ("incubate.asp", "incubate/asp/__init__.py"),
     ("amp.debugging", "amp/debugging.py"),
+    ("device.xpu", "device/xpu/__init__.py"),
+]
+
+# modules whose reference file has no __all__: hand-listed public names
+EXPLICIT = [
+    ("distributed.fleet.metrics",
+     ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]),
 ]
 
 
@@ -95,6 +102,16 @@ def test_namespace_surface(mod, relpath):
         obj = getattr(obj, part)
     missing = [n for n in _ref_names(relpath) if not hasattr(obj, n)]
     assert not missing, f"paddle.{mod or ''} missing: {missing}"
+
+
+@pytest.mark.parametrize("mod,names", EXPLICIT,
+                         ids=[m for m, _ in EXPLICIT])
+def test_explicit_surface(mod, names):
+    obj = paddle
+    for part in mod.split("."):
+        obj = getattr(obj, part)
+    missing = [n for n in names if not hasattr(obj, n)]
+    assert not missing, f"paddle.{mod} missing: {missing}"
 
 
 def test_tensor_method_surface():
