@@ -142,8 +142,11 @@ _GLOO_GEN = 0
 
 
 def gloo_barrier():
-    """A REAL barrier: arrive (counter add) then wait until the whole
-    world reached this generation's counter."""
+    """A REAL barrier over ONE monotonically-growing counter key:
+    barrier N is complete when the counter reaches N * world (every
+    rank runs the same barrier sequence, which calls already require).
+    One key for the process lifetime — store memory stays bounded no
+    matter how many barriers run."""
     import struct
     import time
 
@@ -151,23 +154,45 @@ def gloo_barrier():
         raise RuntimeError("call gloo_init_parallel_env first")
     global _GLOO_GEN
     _GLOO_GEN += 1
-    key = f"gloo/barrier/{_GLOO_GEN}"
+    key = "gloo/barrier"
     _GLOO_STORE.add(key, 1)
     deadline = time.monotonic() + getattr(_GLOO_STORE, "timeout", 300.0)
     while True:
         raw = _GLOO_STORE.get(key)
         n = struct.unpack("<q", raw)[0] if len(raw) == 8 else 0
-        if n >= _GLOO_WORLD:
+        if n >= _GLOO_GEN * _GLOO_WORLD:
             return
         if time.monotonic() > deadline:
             raise TimeoutError(
-                f"gloo_barrier: {n}/{_GLOO_WORLD} arrived")
+                f"gloo_barrier: counter {n} < "
+                f"{_GLOO_GEN * _GLOO_WORLD}")
         time.sleep(0.02)
 
 
 def gloo_release():
+    """Orderly teardown: every rank announces release; the MASTER rank
+    (which hosts the TCPStore server) waits until the whole world has
+    announced before shutting the server down — otherwise a fast master
+    could kill the store while a peer is still polling its last
+    barrier."""
+    import struct
+    import time
+
     global _GLOO_STORE
-    if _GLOO_STORE is not None:
+    if _GLOO_STORE is None:
+        return
+    try:
+        _GLOO_STORE.add("gloo/released", 1)
+        if _GLOO_RANK == 0 and _GLOO_WORLD > 1:
+            deadline = time.monotonic() + getattr(
+                _GLOO_STORE, "timeout", 300.0)
+            while time.monotonic() < deadline:
+                raw = _GLOO_STORE.get("gloo/released")
+                n = struct.unpack("<q", raw)[0] if len(raw) == 8 else 0
+                if n >= _GLOO_WORLD:
+                    break
+                time.sleep(0.02)
+    finally:
         _GLOO_STORE.shutdown()
         _GLOO_STORE = None
 

@@ -38,8 +38,8 @@ def _allreduce(arr, op="sum"):
     _GEN[0] += 1
     gen = _GEN[0]
     rank = getattr(compat, "_GLOO_RANK", 0)   # the gloo world's rank
-    # ONE key per rank, generation-tagged payload: store stays bounded
-    # regardless of how many metric calls the training loop makes
+    # ONE key per rank (generation-tagged payload) + the single-key
+    # barrier: store memory stays bounded over any number of calls
     store.set(f"fleet/metric/{rank}", pickle.dumps((gen, arr)))
     compat.gloo_barrier()                     # everyone has written gen
     vals = []

@@ -76,10 +76,9 @@ def _prunable_params(model):
     (reference _is_supported_layer: fc/linear/conv only, plus
     add_supported_layer registrations) — embeddings, norms etc. are
     never pruned."""
-    supported = _DEFAULT_SUPPORTED | _SUPPORTED_TYPES
     seen = set()
     for lname, layer in model.named_sublayers(include_self=True):
-        if type(layer).__name__ not in supported:
+        if not _is_supported_layer(layer):
             continue
         for pname, p in layer.named_parameters(include_sublayers=False):
             full = f"{lname}.{pname}" if lname else pname

@@ -498,6 +498,16 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
     x = ensure_tensor(x)
     w = None if weights is None else np.asarray(
         ensure_tensor(weights)._data)
+    if ranges is not None and len(ranges) and np.isscalar(ranges[0]):
+        # reference contract (tensor/linalg.py:5248): FLAT sequence
+        # [l0, r0, l1, r1, ...] — numpy wants per-dim pairs
+        ndim = int(x._data.shape[-1])
+        if len(ranges) != 2 * ndim:
+            raise ValueError(
+                f"histogramdd ranges must hold 2*D={2 * ndim} floats "
+                f"(leftmost/rightmost per dimension), got {len(ranges)}")
+        ranges = [(ranges[i], ranges[i + 1])
+                  for i in range(0, len(ranges), 2)]
     hist, edges = np.histogramdd(np.asarray(x._data), bins=bins,
                                  range=ranges, density=density, weights=w)
     return (Tensor._wrap(jnp.asarray(hist)),

@@ -1032,6 +1032,252 @@ S("pca_lowrank_linalg",
   tols={"float32": dict(rtol=1e-3, atol=1e-4)})
 
 
+
+# --------------------------------------------------------------------------
+# batch 3 (r5 final): remaining mappable surface — structural ops, linalg
+# decompositions (checked via canonical recompositions), scatter family
+# --------------------------------------------------------------------------
+import scipy.linalg as spl
+
+S("add_n", lambda a, b, c: paddle.add_n([a, b, c]),
+  lambda a, b, c: a + b + c, _std(n=3), grad=(0, 1, 2))
+S("inner", lambda x, y: paddle.inner(x, y),
+  np.inner, _std(n=2), grad=(0, 1))
+S("mm", lambda x, y: paddle.mm(x, y),
+  lambda x, y: x @ y,
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.standard_normal((4, 5)).astype("float32")],
+  grad=(0, 1))
+S("dist", lambda x, y: paddle.dist(x, y, p=2),
+  lambda x, y: np.linalg.norm((x - y).ravel(), 2), _std(n=2),
+  grad=(0, 1))
+S("trace", lambda x: paddle.trace(x), np.trace, _std((4, 4)))
+S("t", lambda x: paddle.t(x), np.transpose, _std((3, 5)))
+S("scale", lambda x: paddle.scale(x, scale=2.5, bias=1.0),
+  lambda x: 2.5 * x + 1.0, _std())
+S("floor_mod", lambda x, y: paddle.floor_mod(x, y),
+  lambda x, y: np.mod(x, y),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.uniform(0.5, 2.0, (3, 4)).astype("float32")],
+  grad=None)
+S("reverse", lambda x: paddle.reverse(x, axis=[0]),
+  lambda x: x[::-1].copy(), _std())
+S("expand_as", lambda x, y: paddle.expand_as(x, y),
+  lambda x, y: np.broadcast_to(x, y.shape).copy(),
+  lambda rng: [rng.standard_normal((1, 4)).astype("float32"),
+               rng.standard_normal((3, 4)).astype("float32")],
+  grad=(0,))
+S("atleast_1d", lambda x: paddle.atleast_1d(x), np.atleast_1d,
+  _std((4,)))
+S("atleast_3d", lambda x: paddle.atleast_3d(x), np.atleast_3d,
+  _std((3, 4)))
+S("dsplit_0", lambda x: paddle.dsplit(x, 2)[0],
+  lambda x: np.dsplit(x, 2)[0], _std((2, 3, 4)))
+S("as_complex", lambda x: paddle.as_real(paddle.as_complex(x)),
+  lambda x: x, _std((3, 4, 2)), grad=None, dtypes=("float32",))
+S("complex", lambda re, im: paddle.as_real(paddle.complex(re, im)),
+  lambda re, im: np.stack([re, im], -1), _std(n=2), grad=None,
+  dtypes=("float32",))
+S("polar", lambda r, t: paddle.as_real(paddle.polar(r, t)),
+  lambda r, t: np.stack([r * np.cos(t), r * np.sin(t)], -1),
+  lambda rng: [rng.uniform(0.2, 2.0, (3, 4)).astype("float32"),
+               rng.uniform(-3.0, 3.0, (3, 4)).astype("float32")],
+  grad=None, dtypes=("float32",))
+S("isreal", lambda x: paddle.isreal(x),
+  lambda x: np.isreal(x), _std(), grad=None)
+S("isin", lambda x, t: paddle.isin(x, t),
+  np.isin, _ints(n=2), grad=None, dtypes=("int64",))
+S("pad_constant", lambda x: paddle.nn.functional.pad(
+      x, [1, 2], mode="constant", value=0.5),
+  lambda x: np.pad(x, [(0, 0), (1, 2)], constant_values=0.5),
+  _std(), grad=(0,))
+S("norm_fro", lambda x: paddle.linalg.norm(x),
+  lambda x: np.linalg.norm(x), _std(), grad=(0,),
+  tols={"float32": dict(rtol=2e-5, atol=2e-6)})
+S("vector_norm_1", lambda x: paddle.linalg.vector_norm(x, p=1),
+  lambda x: np.abs(x).sum(), _std(), grad=None)
+S("matrix_norm_nuc",
+  lambda x: paddle.linalg.matrix_norm(x, p="nuc"),
+  lambda x: np.linalg.norm(x, "nuc"), _std((4, 4)), grad=None,
+  dtypes=("float32",), tols={"float32": dict(rtol=1e-4, atol=1e-4)})
+S("matrix_exp", lambda x: paddle.linalg.matrix_exp(0.3 * x),
+  lambda x: spl.expm(0.3 * np.asarray(x, np.float64)).astype(
+      np.float32),
+  _std((4, 4)), grad=None, dtypes=("float32",),
+  tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+S("qr_recompose",
+  lambda x: paddle.matmul(*paddle.linalg.qr(x)),
+  lambda x: x,
+  lambda rng: [rng.standard_normal((5, 3)).astype("float32")],
+  grad=None, dtypes=("float32",),
+  tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+S("svd_recompose",
+  # svd returns (U, S, VH) — reference tensor/linalg.py:2785
+  lambda x: (lambda u, s, vh: paddle.matmul(
+      u * s.unsqueeze(-2), vh))(
+          *paddle.linalg.svd(x, full_matrices=False)),
+  lambda x: x,
+  lambda rng: [rng.standard_normal((4, 3)).astype("float32")],
+  grad=None, dtypes=("float32",),
+  tols={"float32": dict(rtol=1e-4, atol=1e-4)})
+S("eigh_vals",
+  lambda x: paddle.linalg.eigh(
+      paddle.add(x, paddle.t(x)))[0],
+  lambda x: np.linalg.eigvalsh(x + x.T),
+  _std((4, 4)), grad=None, dtypes=("float32",),
+  tols={"float32": dict(rtol=1e-4, atol=1e-4)})
+S("eigvals_sorted",
+  lambda x: paddle.sort(paddle.abs(paddle.linalg.eigvals(
+      paddle.add(x, paddle.t(x))))),
+  lambda x: np.sort(np.abs(np.linalg.eigvals(
+      (x + x.T).astype(np.complex64)))),
+  _std((4, 4)), grad=None, dtypes=("float32",),
+  tols={"float32": dict(rtol=1e-3, atol=1e-3)})
+S("lu_recompose",
+  lambda x: (lambda lu_, piv: (lambda p, l, u: paddle.matmul(
+      paddle.matmul(p, l), u))(*paddle.linalg.lu_unpack(lu_, piv)))(
+          *paddle.linalg.lu(x)[:2]),
+  lambda x: x, _std((4, 4)), grad=None, dtypes=("float32",),
+  tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+def _np_householder_product(a, tau):
+    # H_i = I - tau_i v_i v_i^T with v_i = [0...0, 1, a[i+1:, i]]
+    m, n = a.shape
+    q = np.eye(m, dtype=np.float64)
+    for i in range(n - 1, -1, -1):
+        v = np.zeros(m)
+        v[i] = 1.0
+        v[i + 1:] = a[i + 1:, i]
+        h = np.eye(m) - tau[i] * np.outer(v, v)
+        q = h @ q
+    return q[:, :n].astype(np.float32)
+
+
+S("householder_product",
+  lambda x, tau: paddle.linalg.householder_product(x, tau),
+  _np_householder_product,
+  lambda rng: [np.tril(rng.standard_normal((5, 3)), -1).astype(
+      "float32") + np.eye(5, 3, dtype=np.float32),
+      rng.uniform(0.1, 0.5, (3,)).astype("float32")],
+  grad=None, dtypes=("float32",),
+  tols={"float32": dict(rtol=1e-3, atol=1e-3)})
+S("scatter_overwrite",
+  lambda x, idx, upd: paddle.scatter(x, idx, upd),
+  lambda x, idx, upd: (lambda y: (y.__setitem__(idx, upd), y)[1])(
+      x.copy()),
+  lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
+               np.array([0, 2, 4], np.int64),
+               rng.standard_normal((3, 3)).astype("float32")],
+  grad=None)
+S("scatter_nd_sum",
+  lambda idx, upd: paddle.scatter_nd(idx, upd, [6]),
+  lambda idx, upd: (lambda y: (np.add.at(y, idx[:, 0], upd), y)[1])(
+      np.zeros(6, np.float32)),
+  lambda rng: [np.array([[1], [3], [1]], np.int64),
+               rng.standard_normal((3,)).astype("float32")],
+  grad=None)
+S("select_scatter",
+  lambda x, v: paddle.select_scatter(x, v, axis=0, index=1),
+  lambda x, v: (lambda y: (y.__setitem__(1, v), y)[1])(x.copy()),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.standard_normal((4,)).astype("float32")],
+  grad=(0, 1))
+S("slice_scatter",
+  lambda x, v: paddle.slice_scatter(x, v, axes=[0], starts=[1],
+                                    ends=[3], strides=[1]),
+  lambda x, v: (lambda y: (y.__setitem__(slice(1, 3), v), y)[1])(
+      x.copy()),
+  lambda rng: [rng.standard_normal((4, 3)).astype("float32"),
+               rng.standard_normal((2, 3)).astype("float32")],
+  grad=(0, 1))
+S("diagonal_scatter",
+  lambda x, v: paddle.diagonal_scatter(x, v),
+  lambda x, v: (lambda y: (np.fill_diagonal(y, v), y)[1])(x.copy()),
+  lambda rng: [rng.standard_normal((4, 4)).astype("float32"),
+               rng.standard_normal((4,)).astype("float32")],
+  grad=(0, 1))
+S("fill_diagonal_tensor",
+  lambda x, v: paddle.fill_diagonal_tensor(x, v, offset=0, dim1=0,
+                                           dim2=1),
+  lambda x, v: (lambda y: (np.fill_diagonal(y, v), y)[1])(x.copy()),
+  lambda rng: [rng.standard_normal((4, 4)).astype("float32"),
+               rng.standard_normal((4,)).astype("float32")],
+  grad=None)
+S("index_put",
+  lambda x, v: paddle.index_put(
+      x, [paddle.to_tensor(np.array([0, 2], np.int64))], v),
+  lambda x, v: (lambda y: (y.__setitem__(np.array([0, 2]), v), y)[1])(
+      x.copy()),
+  lambda rng: [rng.standard_normal((4, 3)).astype("float32"),
+               rng.standard_normal((2, 3)).astype("float32")],
+  grad=None)
+S("strided_slice",
+  lambda x: paddle.strided_slice(x, axes=[0, 1], starts=[0, 1],
+                                 ends=[4, 4], strides=[2, 1]),
+  lambda x: x[0:4:2, 1:4].copy(), _std((5, 5)), grad=(0,))
+S("slice_op",
+  lambda x: paddle.slice(x, axes=[0], starts=[1], ends=[3]),
+  lambda x: x[1:3].copy(), _std((5, 4)), grad=(0,))
+S("as_strided_view",
+  lambda x: paddle.as_strided(x, [2, 3], [3, 1]),
+  lambda x: np.lib.stride_tricks.as_strided(
+      x, (2, 3), (3 * x.itemsize, x.itemsize)).copy(),
+  _std((12,)), grad=None)
+S("multiplex",
+  lambda a, b, idx: paddle.multiplex([a, b], idx),
+  lambda a, b, idx: np.stack([a, b])[idx[:, 0],
+                                     np.arange(a.shape[0])],
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.standard_normal((3, 4)).astype("float32"),
+               np.array([[0], [1], [0]], np.int64)],
+  grad=None)
+S("shard_index",
+  lambda x: paddle.shard_index(x, index_num=20, nshards=2,
+                               shard_id=0),
+  lambda x: np.where((x >= 0) & (x < 10), x, -1),
+  lambda rng: [rng.integers(0, 20, (4, 1)).astype("int64")],
+  grad=None, dtypes=("int64",))
+S("reduce_as",
+  lambda x, y: paddle.reduce_as(x, y),
+  lambda x, y: x.sum(0, keepdims=False),
+  lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
+               rng.standard_normal((4,)).astype("float32")],
+  grad=None)
+S("tril_indices",
+  lambda: paddle.tril_indices(4, 4, 0),
+  lambda: np.stack(np.tril_indices(4, 0, 4)).astype(np.int64),
+  lambda rng: [], grad=None, dtypes=("int64",))
+S("triu_indices",
+  lambda: paddle.triu_indices(4, 4, 0),
+  lambda: np.stack(np.triu_indices(4, 0, 4)).astype(np.int64),
+  lambda rng: [], grad=None, dtypes=("int64",))
+S("histogramdd_counts",
+  lambda x: paddle.histogramdd(x, bins=[3, 3],
+                               ranges=[-2.0, 2.0, -2.0, 2.0])[0],
+  lambda x: np.histogramdd(
+      x, bins=[3, 3], range=[(-2, 2), (-2, 2)])[0].astype(np.float32),
+  _unit((20, 2)), grad=None, dtypes=("float32",))
+S("multigammaln",
+  lambda x: paddle.multigammaln(x, p=2),
+  lambda x: sps.multigammaln(np.asarray(x, np.float64), 2).astype(
+      np.float32),
+  _pos(lo=1.2, hi=4.0), grad=None,
+  tols={"float32": dict(rtol=1e-4, atol=1e-4),
+        "bfloat16": dict(rtol=0.1, atol=0.1)})
+S("combinations_pairs",
+  lambda x: paddle.combinations(x, r=2),
+  lambda x: np.array([[x[i], x[j]] for i in range(len(x))
+                      for j in range(i + 1, len(x))], np.float32),
+  _std((5,)), grad=None)
+S("column_stack",
+  lambda a, b: paddle.column_stack([a, b]),
+  lambda a, b: np.column_stack([a, b]), _std((4,), n=2),
+  grad=(0, 1))
+S("cartesian_prod",
+  lambda a, b: paddle.cartesian_prod([a, b]),
+  lambda a, b: np.array([[i, j] for i in a for j in b], np.float32),
+  _std((3,), n=2), grad=None)
+
+
 SKIPPED = {
     "conv2d": "covered by dedicated shape/grad tests (test_ops.py)",
     "rnn/lstm/gru": "stateful multi-output recurrent API (test_nn.py)",
