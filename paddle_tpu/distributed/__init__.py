@@ -71,6 +71,7 @@ def launch():
     main()
 from . import auto_tuner  # noqa: E402,F401
 from . import rpc  # noqa: E402,F401
+from . import passes  # noqa: E402,F401
 from . import transpiler  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from .auto_parallel import DistModel, Strategy, to_static  # noqa: E402,F401

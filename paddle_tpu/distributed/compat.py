@@ -125,12 +125,13 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
 
     host, _, port = server_endpoint.partition(":")
     global _GLOO_STORE, _GLOO_WORLD
-    global _GLOO_RANK
+    global _GLOO_RANK, _GLOO_GEN
     _GLOO_STORE = TCPStore(host or "127.0.0.1", int(port or 8765),
                            world_size=rank_num,
                            is_master=(rank_id == 0))
     _GLOO_WORLD = int(rank_num)
     _GLOO_RANK = int(rank_id)
+    _GLOO_GEN = 0      # fresh store starts a fresh barrier counter
 
 
 _GLOO_STORE = None

@@ -3,7 +3,7 @@
 Strategy layers over the collective core: topology/HCG, distributed_model
 wrappers, hybrid optimizer, sharding stages, recompute.
 """
-from .recompute import recompute, recompute_sequential  # noqa: F401
+from .recompute import recompute, recompute_hybrid, recompute_sequential  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology,
     HybridCommunicateGroup,
@@ -19,6 +19,7 @@ from .fleet import (  # noqa: F401
     distributed_optimizer,
 )
 from . import layers  # noqa: F401
+from . import elastic  # noqa: F401
 from . import metrics  # noqa: F401
 from . import utils  # noqa: F401
 from . import meta_parallel  # noqa: F401

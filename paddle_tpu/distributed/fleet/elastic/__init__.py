@@ -1,1 +1,29 @@
+"""paddle.distributed.fleet.elastic (reference fleet/elastic/):
+etcd-backed elastic training manager. The live elastic path here is
+launch's KV rendezvous (launch/kv.py: generation-counted re-rendezvous
+on membership change; fault-injection tested). This namespace holds
+the reference's entry symbols mapped onto that system."""
+from __future__ import annotations
+
 from .manager import ElasticManager, parse_np_range  # noqa: F401
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+def enable_elastic(args, distribute_mode=None):
+    """reference elastic entry: elasticity is enabled whenever launch
+    runs against an external KV/etcd endpoint (see launch/kv.py)."""
+    return bool(getattr(args, "elastic_server", None))
+
+
+def launch_elastic(args, distribute_mode=None):
+    raise RuntimeError(
+        "use paddle.distributed.launch with --master http://<kv> "
+        "np=<min:max> — elastic re-rendezvous is built into the "
+        "launch Master (launch/kv.py)")

@@ -205,3 +205,20 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         out = out if isinstance(out, tuple) else (out,)
         i += per
     return out if len(out) > 1 else out[0]
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Hybrid-parallel recompute (reference recompute_hybrid.py:265):
+    recompute with mp-aware RNG state and optional activation
+    partitioning/offload hints in `ctx` {mp_group, offload, partition}.
+
+    TPU mapping: jax RNG is functional (key threading reproduces
+    dropout exactly on replay — the reference needs its RNGStatesTracker
+    for this), activation partitioning is what GSPMD already does to
+    sharded intermediates, and offload corresponds to a host
+    memory_kind policy. So the ctx keys are accepted and the remat core
+    is the same `recompute`; `partition`/`offload` do not change
+    numerics, only layout hints the XLA scheduler owns."""
+    # ctx hints (mp_group/offload/partition) are deliberately unused:
+    # functional RNG + GSPMD + XLA host-offload own those concerns
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,42 @@
+"""paddle.distributed.passes (reference distributed/passes/__init__.py):
+program-rewrite pass framework (PassManager/PassContext/new_pass) used
+by the static auto-parallel pipeline. On the TPU backend program
+transformation is XLA's pass pipeline over jaxpr; these objects exist
+so orchestration code parses, and new_pass names raise with the XLA
+mapping (docs/DECISIONS.md §9)."""
+from __future__ import annotations
+
+
+class PassContext:
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self._passes = list(passes or [])
+
+    def append(self, p):
+        self._passes.append(p)
+
+    def apply(self, main_programs, startup_programs=None):
+        raise RuntimeError(
+            "distributed passes rewrite ProgramDescs; the equivalent "
+            "transformations (AMP, recompute, sharding, fusion) are "
+            "applied by XLA/GSPMD at jit time — configure them through "
+            "DistributedStrategy / auto_parallel.Strategy instead")
+
+
+def new_pass(name, pass_attrs=None):
+    raise RuntimeError(
+        f"pass {name!r} rewrites static programs; on the TPU backend "
+        "the same effect comes from jit-time configuration: AMP -> "
+        "paddle.amp.auto_cast, recompute -> paddle.distributed.fleet."
+        "recompute / jax.checkpoint, sharding/comm passes -> GSPMD "
+        "shardings (docs/DECISIONS.md §9)")

@@ -1,0 +1,7 @@
+"""paddle.incubate.distributed.fleet (reference
+incubate/distributed/fleet/__init__.py): recompute re-exports."""
+from ....distributed.fleet.recompute import (  # noqa: F401
+    recompute_hybrid,
+    recompute_sequential,
+)
+

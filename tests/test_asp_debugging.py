@@ -179,3 +179,52 @@ compat.gloo_release()
     for (so, se), p in zip(outs, procs):
         assert p.returncode == 0, se[-800:]
         assert "SUM 3.0" in so, (so, se[-400:])
+
+
+def test_recompute_hybrid_grads_flow():
+    lin = paddle.nn.Linear(8, 8)
+    x = paddle.randn([4, 8])
+    x.stop_gradient = False
+    from paddle_tpu.incubate.distributed.fleet import recompute_hybrid
+
+    y = recompute_hybrid({"mp_group": None}, lambda v: lin(v).tanh(), x)
+    y.sum().backward()
+    assert lin.weight.grad is not None
+    assert x.grad is not None
+
+
+def test_distributed_passes_raise_with_mapping():
+    with pytest.raises(RuntimeError, match="GSPMD|auto_cast|jit"):
+        paddle.distributed.passes.new_pass("auto_parallel_amp")
+    pm = paddle.distributed.passes.PassManager([])
+    with pytest.raises(RuntimeError, match="XLA|GSPMD"):
+        pm.apply([None])
+
+
+def test_elastic_reexports_survive():
+    # the elastic namespace must keep exporting the live manager
+    from paddle_tpu.distributed.fleet.elastic import (
+        ElasticManager,
+        parse_np_range,
+    )
+
+    assert callable(parse_np_range) and ElasticManager is not None
+
+
+def test_gloo_reinit_resets_barrier_generation():
+    from paddle_tpu.distributed import compat
+
+    compat._GLOO_GEN = 7
+    # fresh init must reset the barrier generation or the single-key
+    # counter protocol waits for 8*world on the first barrier
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    compat.gloo_init_parallel_env(0, 1, f"127.0.0.1:{port}")
+    try:
+        assert compat._GLOO_GEN == 0
+        compat.gloo_barrier()        # world 1: passes immediately
+    finally:
+        compat.gloo_release()

@@ -85,6 +85,9 @@ NAMESPACES = [
     ("incubate.asp", "incubate/asp/__init__.py"),
     ("amp.debugging", "amp/debugging.py"),
     ("device.xpu", "device/xpu/__init__.py"),
+    ("distributed.passes", "distributed/passes/__init__.py"),
+    ("incubate.distributed.fleet",
+     "incubate/distributed/fleet/__init__.py"),
 ]
 
 # modules whose reference file has no __all__: hand-listed public names
