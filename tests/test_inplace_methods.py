@@ -1,0 +1,71 @@
+"""Auto-bound in-place Tensor-method semantics (framework/
+tensor_methods.py generated variants): each must (a) return self,
+(b) leave the buffer equal to the out-of-place op, (c) rebind IN PLACE
+so aliases observe the update."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+UNARY = ["abs_", "ceil_", "floor_", "round_", "exp_", "log_", "sqrt_",
+         "tanh_", "sigmoid_", "relu_", "erfinv_", "trunc_", "frac_",
+         "log1p_", "reciprocal_", "rsqrt_"]
+
+
+@pytest.mark.parametrize("name", UNARY)
+def test_unary_inplace_matches_outofplace(name):
+    t = paddle.to_tensor(np.array([0.3, 0.7, 0.9], np.float32))
+    if not hasattr(t, name):
+        pytest.skip(f"{name} not bound")
+    base = getattr(t, name[:-1])()
+    holder = [t]                # a real alias container (optimizer-list
+    ret = getattr(t, name)()    # shape): must observe the mutation
+    assert ret is t
+    np.testing.assert_allclose(holder[0].numpy(), base.numpy(),
+                               rtol=1e-6)
+    assert holder[0] is ret
+
+
+BINARY = ["add_", "subtract_", "multiply_", "divide_", "pow_",
+          "remainder_", "floor_divide_", "maximum_" ]
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_inplace_matches_outofplace(name):
+    t = paddle.to_tensor(np.array([2.0, 5.0, 9.0], np.float32))
+    o = paddle.to_tensor(np.array([2.0, 2.0, 4.0], np.float32))
+    if not hasattr(t, name):
+        pytest.skip(f"{name} not bound")
+    base = getattr(t, name[:-1])(o)
+    ret = getattr(t, name)(o)
+    assert ret is t
+    np.testing.assert_allclose(t.numpy(), base.numpy(), rtol=1e-6)
+
+
+def test_structural_inplace():
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t.transpose_([1, 0])
+    assert list(t.shape) == [3, 2]
+    t.flatten_()
+    assert list(t.shape) == [6]
+    u = paddle.to_tensor(np.ones((3, 3), np.float32))
+    u.tril_()
+    assert u.numpy()[0, 2] == 0.0
+    u.zero_()
+    assert float(u.sum()) == 0.0
+
+
+def test_cast_inplace_changes_dtype():
+    t = paddle.to_tensor(np.array([1.5, 2.5], np.float32))
+    t.cast_("float64")
+    assert "float64" in str(t.dtype)
+
+
+def test_random_inplace_fill_shapes():
+    t = paddle.zeros([64])
+    t.uniform_(min=-2.0, max=-1.0)
+    arr = t.numpy()
+    assert (arr >= -2.0).all() and (arr <= -1.0).all()
+    b = paddle.zeros([1000])
+    b.bernoulli_(p=0.3)
+    assert 0.15 < float(b.mean()) < 0.45
