@@ -15,8 +15,10 @@ import copy
 from .. import nn
 from ..nn import quant as _q
 
-__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
-           "AbsMaxObserver", "quanter", "BaseObserver", "BaseQuanter",]
+__all__ = ["QuantConfig", "SingleLayerConfig", "QAT", "PTQ",
+           "FakeQuanterWithAbsMaxObserver", "AbsMaxObserver",
+           "AbsmaxObserver", "GroupWiseWeightObserver", "quanter",
+           "BaseObserver", "BaseQuanter"]
 
 
 class BaseObserver:
@@ -58,6 +60,47 @@ class AbsMaxObserver(BaseObserver):
         # the observer tracks the absmax scale; quant_bits applies at
         # convert() time (weight_quantize int8)
         return _q.MovingAverageAbsMaxScale()
+
+
+AbsmaxObserver = AbsMaxObserver   # reference spelling (observers/abs_max.py)
+
+
+class SingleLayerConfig:
+    """reference quantization/config.py SingleLayerConfig: the per-layer
+    (activation-quanter, weight-quanter) pair QuantConfig resolves."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class GroupWiseWeightObserver(BaseObserver):
+    """reference observers/groupwise.py: per-group absmax scales along
+    the quantized weight's output axis (group_size channels share a
+    scale) — the observer behind group-wise weight-only quant."""
+
+    def __init__(self, quant_bits=4, group_size=128):
+        self.quant_bits = quant_bits
+        self.group_size = group_size
+        self._scales = None
+
+    def _observe(self, x):
+        import numpy as np
+
+        w = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+        g = self.group_size
+        rows = w.reshape(-1, w.shape[-1])
+        pad = (-rows.shape[0]) % g
+        if pad:
+            rows = np.concatenate(
+                [rows, np.zeros((pad, rows.shape[1]), rows.dtype)])
+        grouped = np.abs(rows).reshape(-1, g, rows.shape[1])
+        self._scales = grouped.max(axis=1) / (
+            2.0 ** (self.quant_bits - 1) - 1)
+        return x
+
+    def scales(self):
+        return self._scales
 
 
 def quanter(name):
@@ -224,3 +267,8 @@ class PTQ(_Quantization):
             return _ObservedLinear(sub, obs)
 
         return _swap_linears(model, make)
+
+
+from . import config  # noqa: E402,F401
+from . import observers  # noqa: E402,F401
+from . import quanters  # noqa: E402,F401

@@ -1,0 +1,4 @@
+"""paddle.quantization.config submodule (reference quantization/
+config.py): re-exports — the implementations live in the package
+__init__ (lean single-module design)."""
+from . import QuantConfig, SingleLayerConfig  # noqa: F401

@@ -1,0 +1,5 @@
+"""paddle.quantization.observers (reference observers/__init__.py)."""
+from .. import (  # noqa: F401
+    AbsmaxObserver,
+    GroupWiseWeightObserver,
+)

@@ -1,0 +1,2 @@
+"""paddle.quantization.quanters (reference quanters/__init__.py)."""
+from .. import FakeQuanterWithAbsMaxObserver  # noqa: F401

@@ -629,3 +629,6 @@ class RandomErasing(BaseTransform):
                 return erase(img, i, j, eh, ew, self.value,
                              inplace=self.inplace)
         return img
+
+
+from . import functional  # noqa: E402,F401

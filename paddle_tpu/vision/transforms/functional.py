@@ -1,0 +1,46 @@
+"""paddle.vision.transforms.functional (reference
+vision/transforms/functional.py): the functional transform surface as
+an importable submodule — scripts commonly do
+`import paddle.vision.transforms.functional as F`. One implementation:
+these names are defined in the package __init__ (shared inverse-map
+sampler); this module re-exports them."""
+from . import (  # noqa: F401
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    affine,
+    center_crop,
+    crop,
+    erase,
+    hflip,
+    normalize,
+    pad,
+    perspective,
+    resize,
+    rotate,
+    to_grayscale,
+    to_tensor,
+    vflip,
+)
+
+
+def _is_pil_image(img):
+    try:
+        from PIL import Image
+
+        return isinstance(img, Image.Image)
+    except ImportError:
+        return False
+
+
+def _is_numpy_image(img):
+    import numpy as np
+
+    return isinstance(img, np.ndarray) and img.ndim in (2, 3)
+
+
+def _is_tensor_image(img):
+    from ...framework.tensor import Tensor
+
+    return isinstance(img, Tensor)

@@ -228,3 +228,25 @@ def test_gloo_reinit_resets_barrier_generation():
         compat.gloo_barrier()        # world 1: passes immediately
     finally:
         compat.gloo_release()
+
+
+def test_groupwise_weight_observer_scales():
+    from paddle_tpu.quantization import observers
+
+    obs = observers.GroupWiseWeightObserver(quant_bits=4, group_size=4)
+    w = paddle.to_tensor(np.arange(48, dtype=np.float32).reshape(8, 6))
+    obs._observe(w)
+    s = obs.scales()
+    assert s.shape == (2, 6)
+    # group 0 = rows 0-3, col 0: absmax 18; int4 positive max 7
+    np.testing.assert_allclose(s[0, 0], 18.0 / 7.0, rtol=1e-6)
+
+
+def test_transforms_functional_submodule():
+    import paddle_tpu.vision.transforms.functional as VF
+
+    img = np.random.rand(8, 8, 3).astype("float32")
+    t = VF.to_tensor(img)
+    assert list(t.shape) == [3, 8, 8]
+    assert VF._is_numpy_image(img)
+    assert VF._is_tensor_image(t)

@@ -94,6 +94,15 @@ NAMESPACES = [
 EXPLICIT = [
     ("distributed.fleet.metrics",
      ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]),
+    ("vision.transforms.functional",
+     ["to_tensor", "resize", "pad", "crop", "center_crop", "hflip",
+      "vflip", "adjust_brightness", "adjust_contrast",
+      "adjust_saturation", "adjust_hue", "affine", "rotate",
+      "perspective", "to_grayscale", "normalize", "erase"]),
+    ("quantization.config", ["QuantConfig", "SingleLayerConfig"]),
+    ("quantization.observers",
+     ["AbsmaxObserver", "GroupWiseWeightObserver"]),
+    ("quantization.quanters", ["FakeQuanterWithAbsMaxObserver"]),
 ]
 
 
