@@ -1,0 +1,93 @@
+"""Statistical moment checks for the top-level stochastic samplers
+(reference tensor/random.py kernels): each sampler's empirical
+mean/variance must match the distribution within generous tolerances —
+no point reference exists, so this is the sweepable contract.
+Deterministically seeded."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+N = 20000
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(1234)
+
+
+def test_bernoulli_mean():
+    p = paddle.full([N], 0.3)
+    s = paddle.bernoulli(p).numpy()
+    assert set(np.unique(s)).issubset({0.0, 1.0})
+    assert abs(s.mean() - 0.3) < 0.02
+
+
+def test_poisson_moments():
+    lam = 4.0
+    s = paddle.poisson(paddle.full([N], lam)).numpy()
+    assert abs(s.mean() - lam) < 0.1
+    assert abs(s.var() - lam) < 0.3
+    assert (s >= 0).all() and np.allclose(s, np.round(s))
+
+
+def test_binomial_moments():
+    n, p = 10, 0.25
+    s = paddle.binomial(paddle.full([N], float(n)),
+                        paddle.full([N], p)).numpy()
+    assert abs(s.mean() - n * p) < 0.1
+    assert abs(s.var() - n * p * (1 - p)) < 0.2
+    assert (s >= 0).all() and (s <= n).all()
+
+
+def test_standard_gamma_moments():
+    alpha = 3.0
+    s = paddle.standard_gamma(paddle.full([N], alpha)).numpy()
+    assert abs(s.mean() - alpha) < 0.1     # mean == shape
+    assert abs(s.var() - alpha) < 0.3      # var == shape
+    assert (s > 0).all()
+
+
+def test_log_normal_moments():
+    mean, std = 0.5, 0.4
+    s = paddle.log_normal(mean=mean, std=std, shape=[N]).numpy()
+    expect = np.exp(mean + std**2 / 2)
+    assert abs(s.mean() - expect) < 0.05
+    assert (s > 0).all()
+
+
+def test_multinomial_frequencies():
+    probs = paddle.to_tensor(
+        np.array([0.1, 0.2, 0.3, 0.4], np.float32))
+    s = paddle.multinomial(probs, num_samples=N,
+                           replacement=True).numpy().ravel()
+    freq = np.bincount(s.astype(np.int64), minlength=4) / s.size
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.3, 0.4], atol=0.02)
+
+
+def test_multinomial_no_replacement_distinct():
+    probs = paddle.to_tensor(np.ones(8, np.float32))
+    s = paddle.multinomial(probs, num_samples=8,
+                           replacement=False).numpy().ravel()
+    assert sorted(s.astype(int).tolist()) == list(range(8))
+
+
+def test_normal_uniform_moments():
+    s = paddle.normal(mean=2.0, std=3.0, shape=[N]).numpy()
+    assert abs(s.mean() - 2.0) < 0.08 and abs(s.std() - 3.0) < 0.08
+    u = paddle.uniform([N], min=-2.0, max=4.0).numpy()
+    assert abs(u.mean() - 1.0) < 0.06
+    assert u.min() >= -2.0 and u.max() < 4.0
+
+
+def test_randperm_is_permutation():
+    s = paddle.randperm(256).numpy()
+    assert sorted(s.tolist()) == list(range(256))
+
+
+def test_seed_reproducibility():
+    paddle.seed(77)
+    a = paddle.poisson(paddle.full([64], 3.0)).numpy()
+    paddle.seed(77)
+    b = paddle.poisson(paddle.full([64], 3.0)).numpy()
+    np.testing.assert_array_equal(a, b)
