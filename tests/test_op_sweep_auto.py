@@ -1278,6 +1278,20 @@ S("cartesian_prod",
   _std((3,), n=2), grad=None)
 
 
+S("nanquantile",
+  lambda x: paddle.nanquantile(x, 0.5, axis=-1),
+  lambda x: np.nanquantile(x, 0.5, axis=-1).astype(np.float32),
+  lambda rng: [np.where(rng.uniform(size=(3, 8)) > 0.8, np.nan,
+                        rng.standard_normal((3, 8))).astype("float32")],
+  grad=None, dtypes=("float32",))
+S("histogram_bin_edges",
+  # min==max==0 selects the data-dependent auto-range branch — the
+  # only path that actually reads the tensor
+  lambda x: x.histogram_bin_edges(bins=6),
+  lambda x: np.histogram_bin_edges(x, bins=6).astype(np.float32),
+  _std(), grad=None, dtypes=("float32",))
+
+
 SKIPPED = {
     "conv2d": "covered by dedicated shape/grad tests (test_ops.py)",
     "rnn/lstm/gru": "stateful multi-output recurrent API (test_nn.py)",

@@ -2,7 +2,8 @@
 # axon-stripped CPU test environment (the dryrun's hermetic recipe)
 env -u PYTHONPATH -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
     -u AXON_LOOPBACK_RELAY -u AXON_POOL_SVC_OVERRIDE -u TPU_SKIP_MDS_QUERY \
-    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 "$@"
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:+$XLA_FLAGS }--xla_force_host_platform_device_count=8" "$@"
 # Usage: tools/cpu_env.sh python -m pytest tests/ -q
 # Why: a wedged axon tunnel (claim-leg kill) hangs EVERY jax backend
 # init that can see the plugin; stripping the env makes
