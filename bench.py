@@ -272,11 +272,61 @@ def run_selftest():
                  for m in opt._master_weights.values()}
         assert kinds == {"pinned_host"}, kinds
 
+    def bucketed_rs_parity():
+        # host-mesh lane: must run under JAX_PLATFORMS=cpu with 8 virtual
+        # devices, which the already-initialized (possibly TPU) backend of
+        # this process cannot provide — so a hermetic subprocess with the
+        # axon env stripped (the cpu_env.sh recipe)
+        rec = _run_cpu_host_mesh_probe(multichip=False)
+        lane = rec.get("bucketed_reduce_scatter_parity", {})
+        assert lane.get("check") == "pass", lane
+        results["bucketed_reduce_scatter_parity_detail"] = lane
+
     check("pallas_flash_single_block_s512", lambda: flash(512))
     check("pallas_flash_tiled_s2048", lambda: flash(2048))
     check("int8_weight_only_matmul", int8_matmul)
     check("master_offload_parity_pinned_host", offload_parity)
+    check("bucketed_reduce_scatter_parity", bucketed_rs_parity)
     return results
+
+
+def _run_cpu_host_mesh_probe(multichip=False, n_devices=8, timeout=600):
+    """Run paddle_tpu.distributed.comm_bucketer's host-mesh probe in a
+    hermetic CPU subprocess (axon env stripped, virtual device count
+    forced) and return its JSON record.
+
+    The env-strip recipe intentionally mirrors tests/conftest.py and
+    tools/cpu_env.sh (conftest cannot import a shared helper — it must
+    strip BEFORE any paddle_tpu/jax import); keep the three in sync."""
+    import subprocess
+
+    env = dict(os.environ)
+    for k in list(env):
+        if k.upper().startswith(("AXON_", "PALLAS_AXON", "TPU_",
+                                 "LIBTPU")):
+            env.pop(k)
+    pyp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+           if p and ".axon_site" not in p.lower()]
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__))] + pyp)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.comm_bucketer"]
+    if multichip:
+        cmd.append("--multichip")
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=timeout,
+                       cwd=os.path.dirname(os.path.abspath(__file__)))
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if r.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"host-mesh probe failed rc={r.returncode}: "
+            f"{r.stderr[-500:]}")
+    return json.loads(line)
 
 
 # Round-5 status: the north star runs LIVE as the default primary — the
@@ -489,7 +539,12 @@ def _windowed_main():
 if __name__ == "__main__":
     import sys
 
-    if "--selftest" in sys.argv:
+    if "--multichip" in sys.argv:
+        # MULTICHIP lane: bucketed vs per-param stage-2 gradient sync on a
+        # host-device-count mesh (collective counts by HLO inspection +
+        # walltime), hermetic CPU subprocess — one JSON line
+        print(json.dumps(_run_cpu_host_mesh_probe(multichip=True)))
+    elif "--selftest" in sys.argv:
         _setup_jax()
         print(json.dumps({"selftest": run_selftest()}))
     elif os.environ.get("_BENCH_CHILD") == "1":

@@ -40,6 +40,16 @@ from .collective import (  # noqa: F401
     get_world_size,
     is_initialized,
     destroy_process_group,
+    all_reduce_quantized,
+    comm_quant_selftest,
+)
+from .comm_bucketer import (  # noqa: F401
+    BucketAssignment,
+    GradBucketer,
+    build_buckets,
+    bucketed_all_reduce,
+    bucketed_reduce_scatter,
+    count_hlo_collectives,
 )
 from .parallel import DataParallel  # noqa: F401
 from . import checkpoint  # noqa: F401

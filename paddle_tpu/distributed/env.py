@@ -55,7 +55,17 @@ def init_parallel_env():
         if _state["initialized"]:
             return
         n_hosts = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-        if n_hosts > 1 and not jax.distributed.is_initialized():
+        # jax < 0.6 has no jax.distributed.is_initialized — probe the
+        # coordination-service client directly there
+        def _dist_up():
+            probe = getattr(jax.distributed, "is_initialized", None)
+            if probe is not None:
+                return probe()
+            from jax._src import distributed as _dist
+
+            return _dist.global_state.client is not None
+
+        if n_hosts > 1 and not _dist_up():
             addr = os.environ.get("MASTER_ADDR")
             port = os.environ.get("MASTER_PORT")
             coord = (

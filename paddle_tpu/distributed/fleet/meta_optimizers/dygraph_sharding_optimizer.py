@@ -67,9 +67,23 @@ class DygraphShardingOptimizer:
                               self._mesh.axis_names else
                               self._mesh.axis_names[0])
         self._sharded_once = False
+        self._comm_bucketer = None
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
+
+    def attach_comm_bucketer(self, bucketer):
+        """Record the stage-2 grad bucketer (its BucketAssignment is the
+        deterministic param→bucket map the scatter-back uses). step()
+        flushes any still-pending bucket collectives first, so an eager
+        `loss.backward(); opt.step()` loop — or a user-jitted step that
+        never calls apply_collective_grads — still syncs at the
+        microbatch boundary."""
+        self._comm_bucketer = bucketer
+
+    def grad_bucket_assignment(self):
+        return (self._comm_bucketer.assignment
+                if self._comm_bucketer is not None else None)
 
     def _apply_shardings(self):
         opt = self._inner_opt
@@ -84,6 +98,9 @@ class DygraphShardingOptimizer:
         opt._rehome_offloaded_masters()
 
     def step(self):
+        if (self._comm_bucketer is not None
+                and self._comm_bucketer.has_pending()):
+            self._comm_bucketer.sync_pending()
         self._inner_opt.step()
         if not self._sharded_once:
             self._apply_shardings()

@@ -50,6 +50,12 @@ class DataParallel(Layer):
         self._axis = (group.axes[0] if group is not None else "dp")
         self.find_unused_parameters = find_unused_parameters
         self._grad_need_sync = True
+        # reference EagerReducer group size (MB): used by the EXPLICIT
+        # sync path (apply_collective_grads over partial-tagged grads) —
+        # one bucketed all-reduce per ~this many MB instead of one per
+        # parameter. The GSPMD path needs no reducer at all (see class
+        # docstring).
+        self._comm_buffer_mb = int(comm_buffer_size)
 
     @property
     def group(self):
@@ -93,7 +99,44 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        pass
+        """Explicit gradient sync (reference parallel.py
+        apply_collective_grads). Under GSPMD the dp reduction already
+        happened inside the compiled backward, so only grads explicitly
+        tagged partial (``grad._is_partial_grad = True`` by a per-rank
+        producer, the hybrid_parallel_util contract) are reduced — as ONE
+        bucketed all-reduce per `comm_buffer_size` MB (quantized payloads
+        per FLAGS_comm_quant), not one collective per parameter."""
+        if not self._grad_need_sync:
+            return
+        grads = [p.grad for p in self.parameters()
+                 if getattr(p, "grad", None) is not None
+                 and getattr(p.grad, "_is_partial_grad", False)]
+        if not grads:
+            return
+        from ..utils import flags as _flags
+        from .collective import new_group
+        from .comm_bucketer import bucketed_all_reduce
+
+        group = self._group
+        if group is None:
+            # reduce over the DP axis only — the world group on a hybrid
+            # mesh (dp×mp, ...) would sum unrelated model-parallel slices
+            mesh = env.get_mesh()
+            if self._axis not in mesh.axis_names:
+                raise ValueError(
+                    f"DataParallel grad sync: axis {self._axis!r} not in "
+                    f"mesh {mesh.axis_names}; pass group= explicitly — "
+                    "falling back to the world group would sum across "
+                    "non-data axes and corrupt gradients")
+            group = new_group(axes=[self._axis], mesh=mesh)
+        # FLAGS_comm_bucket_mb=0 is the documented per-parameter escape
+        # hatch; bucket_mb=0 makes every tensor its own bucket
+        mb = (self._comm_buffer_mb
+              if int(_flags.get_flag("FLAGS_comm_bucket_mb") or 0) > 0
+              else 0)
+        bucketed_all_reduce(grads, group=group, bucket_mb=mb)
+        for g in grads:
+            g._is_partial_grad = False
 
     # delegate everything else to the wrapped layer
     def state_dict(self, *args, **kwargs):

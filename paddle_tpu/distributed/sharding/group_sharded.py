@@ -38,11 +38,23 @@ class GroupShardedStage2:
     pins the accumulated grad to a sharded NamedSharding; under the fused
     TrainStep the constraint makes GSPMD emit reduce-scatter instead of
     all-reduce (verified by the layout asserts in tests/test_distributed).
+
+    Bucketed grad comm (FLAGS_comm_bucket_mb > 0, the default): inside a
+    traced step the hooks only MARK grads pending; at the comm boundary —
+    `apply_collective_grads()`, which TrainStep calls after the last
+    microbatch backward, or the sharding optimizer's step() — the pending
+    grads coalesce into size-capped flat buckets and GSPMD emits ONE
+    reduce-scatter per bucket instead of one per parameter (reference
+    reducer.cc EagerReducer, shaped for ICI). Eager backwards keep the
+    per-parameter pin: per-op dispatch compiles each pin separately, so
+    there is nothing for a bucket to fuse there, and grads stay
+    immediately layout-visible (the tests' eager asserts).
     """
 
     def __init__(self, layer, sharding_optimizer=None, group=None,
                  sync_buffers=False, buffer_max_size=2 ** 23,
-                 auto_refresh_trainable=True, device="tpu", dp_group=None):
+                 auto_refresh_trainable=True, device="tpu", dp_group=None,
+                 comm_bucket_mb=None):
         self._layers = layer
         self._opt = sharding_optimizer
         if group is not None:
@@ -53,16 +65,52 @@ class GroupShardedStage2:
                     else mesh.axis_names[0])
         self._mesh, self._axis = mesh, axis
         self._hook_handles = []
+        self._bucketer = None
+        degree = int(mesh.shape[axis])
+        if comm_bucket_mb is None:
+            from ...utils import flags as _flags
+
+            comm_bucket_mb = int(
+                _flags.get_flag("FLAGS_comm_bucket_mb") or 0)
+        if degree > 1 and comm_bucket_mb > 0:
+            from ..comm_bucketer import GradBucketer
+
+            named = [(n, p) for n, p in self._layers.named_parameters()
+                     if p.trainable]
+            self._bucketer = GradBucketer(named, mesh=mesh, axis=axis,
+                                          bucket_mb=comm_bucket_mb)
+        # deferring a traced grad pin is only safe when SOME comm
+        # boundary is guaranteed to flush it — the sharding optimizer's
+        # step() is that guarantee (TrainStep's apply_collective_grads
+        # call just flushes earlier). Without a flush-capable optimizer
+        # (bare GroupShardedStage2 inside a user jit) the hooks keep the
+        # old per-param pin, or ZeRO-2 sharding would silently be lost.
+        self._defer_ok = (self._bucketer is not None
+                          and hasattr(self._opt, "attach_comm_bucketer"))
         self._register_grad_hooks()
+        if self._defer_ok:
+            self._opt.attach_comm_bucketer(self._bucketer)
 
     def _register_grad_hooks(self):
         degree = int(self._mesh.shape[self._axis])
         if degree <= 1:
             return
         mesh, axis = self._mesh, self._axis
+        bucketer = self._bucketer if self._defer_ok else None
 
-        def make_hook(dim):
+        def make_hook(dim, key):
             def hook(grad):
+                import jax as _jax
+
+                if (bucketer is not None
+                        and isinstance(grad._data, _jax.core.Tracer)):
+                    # traced: defer to the bucket boundary (one
+                    # reduce-scatter per BUCKET, issued by
+                    # apply_collective_grads / the optimizer's step)
+                    bucketer.mark_pending(key)
+                    return grad
+                if dim is None:
+                    return grad          # no divisible dim to pin eagerly
                 axes = [None] * grad.ndim
                 axes[dim] = axis
                 grad._data = env.pin_sharding(
@@ -71,11 +119,23 @@ class GroupShardedStage2:
 
             return hook
 
-        for p in self._layers.parameters():
-            dim = _shardable_dim(p.shape, degree)
-            if dim is None:
+        for name, p in self._layers.named_parameters():
+            if not p.trainable:
                 continue
-            self._hook_handles.append(p.register_hook(make_hook(dim)))
+            dim = _shardable_dim(p.shape, degree)
+            if dim is None and self._bucketer is None:
+                continue   # per-param path cannot shard this one
+            self._hook_handles.append(
+                p.register_hook(make_hook(dim, name)))
+
+    def apply_collective_grads(self):
+        """The gradient-comm boundary (reference EagerReducer finalize):
+        flush the deferred bucket collectives. Called by TrainStep after
+        the last (micro)batch backward; idempotent — pending marks are
+        consumed, so a following sharding-optimizer step() cannot
+        double-sync."""
+        if self._bucketer is not None:
+            self._bucketer.sync_pending()
 
     def __call__(self, *a, **k):
         return self._layers(*a, **k)

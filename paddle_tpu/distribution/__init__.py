@@ -682,8 +682,14 @@ class Binomial(Distribution):
     def sample(self, shape=()):
         key = next_key()
         ext = self._extend(shape)
+        # under x64 (this framework's global default) jax 0.4.x's
+        # binomial kernel clamps f32 operands against f64 literals and
+        # TypeErrors — run it in f64 there; without x64 requesting f64
+        # would only emit truncation warnings, so skip the cast
+        dt = (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
         out = _op(lambda n, p: jax.random.binomial(
-            key, jnp.broadcast_to(n, ext), jnp.broadcast_to(p, ext)
+            key, jnp.broadcast_to(n, ext).astype(dt),
+            jnp.broadcast_to(p, ext).astype(dt), dtype=dt
         ).astype(jnp.float32), self.total_count, self.probs)
         out.stop_gradient = True
         return out
@@ -758,9 +764,19 @@ class Multinomial(Distribution):
         n = self.total_count
 
         def f(p):
-            return jax.random.multinomial(
-                key, n, p, shape=ext + p.shape[-1:] if ext else None
-            ).astype(jnp.float32)
+            out_shape = ext + p.shape[-1:] if ext else None
+            if hasattr(jax.random, "multinomial"):
+                return jax.random.multinomial(
+                    key, n, p, shape=out_shape).astype(jnp.float32)
+            # jax < 0.4.3x: no multinomial — n categorical draws,
+            # histogrammed over the category dim (same distribution)
+            base = jnp.broadcast_to(
+                p, out_shape if out_shape is not None else p.shape)
+            draws = jax.random.categorical(
+                key, jnp.log(base), axis=-1,
+                shape=(int(n),) + base.shape[:-1])
+            return jax.nn.one_hot(
+                draws, base.shape[-1]).sum(0).astype(jnp.float32)
 
         out = _op(f, self.probs)
         out.stop_gradient = True

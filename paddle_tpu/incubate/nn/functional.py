@@ -244,7 +244,15 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                          ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
             t = jnp.arange(s, dtype=jnp.float32)
             freqs = jnp.outer(t, inv)                     # [s, d/2]
-            emb = jnp.repeat(freqs, 2, axis=-1)           # [s, d]
+            if use_neox_rotary_style:
+                # adjacent-pair rotation: pair (2j, 2j+1) shares freq j
+                emb = jnp.repeat(freqs, 2, axis=-1)       # [s, d]
+            else:
+                # half style pairs (j, j+half): table[:half] and
+                # table[half:] must BOTH be freqs — the repeat-interleaved
+                # table paired positions with wrong frequencies here
+                # (ADVICE r5 medium)
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
             sv, cv = jnp.sin(emb), jnp.cos(emb)
         sv = sv.reshape(-1, sv.shape[-1])                 # [T, d]
         cv = cv.reshape(-1, cv.shape[-1])

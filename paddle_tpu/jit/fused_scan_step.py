@@ -429,7 +429,14 @@ class FusedScanTrainStep:
             finally:
                 self._bind(self._buffers, saved_buf)
 
-        self._jitted = jax.jit(step_fn, donate_argnums=(0,))
+        # same legacy-jaxlib donation guard as TrainStep: donation
+        # corrupts buffers on 0.4.x CPU (NaNs + later hard aborts)
+        import sys as _sys
+
+        _legacy = getattr(_sys.modules.get("paddle_tpu"),
+                          "jax_compat_legacy", False)
+        self._jitted = jax.jit(step_fn,
+                               donate_argnums=() if _legacy else (0,))
 
     def ensure_built(self):
         """Create the Adam state and trace the step (idempotent). Split

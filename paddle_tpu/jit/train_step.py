@@ -75,7 +75,7 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, donate=True,
-                 accumulate_steps=1):
+                 accumulate_steps=1, accum_steps=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer             # outer (may be a wrapper)
@@ -85,11 +85,31 @@ class TrainStep:
         self._buffers = None
         self._jitted = None
         self._step_count = 0
-        self._donate = donate
+        # donation is a pure perf lever (aliased state buffers) — on the
+        # legacy jaxlib (0.4.x CPU) it CORRUPTS memory under conv-sized
+        # programs on a host mesh (NaN losses, then hard aborts in later
+        # jits — measured via tests/test_vision.py), so it is forced off
+        # there
+        import sys as _sys
+
+        _legacy = getattr(_sys.modules.get("paddle_tpu"),
+                          "jax_compat_legacy", False)
+        self._donate = donate and not _legacy
         # gradient accumulation INSIDE the fused program (the reference's
         # no_sync/gradient-merge loop, compiled): the batch's dim 0 splits
         # into `accumulate_steps` micro-batches; micro backwards accumulate
-        # on the tape's leaf grads and the optimizer steps once.
+        # on the tape's leaf grads and the optimizer steps once. Gradient
+        # COMM happens only at the boundary: after the last microbatch the
+        # model wrapper's apply_collective_grads() issues the bucket
+        # collectives (stage-2 bucketer), so under GSPMD the per-bucket
+        # reduce-scatters overlap the optimizer/next-step compute instead
+        # of serializing after every microbatch.
+        if accum_steps is not None:
+            if int(accumulate_steps) not in (1, int(accum_steps)):
+                raise ValueError(
+                    f"conflicting accumulate_steps={accumulate_steps} "
+                    f"and accum_steps={accum_steps}")
+            accumulate_steps = accum_steps
         self.accumulate_steps = int(accumulate_steps)
 
     # -- state plumbing -------------------------------------------------
@@ -205,6 +225,11 @@ class TrainStep:
             else:
                 loss = self.loss_fn(self.model, *batch_t)
                 loss.backward()
+            # gradient-comm boundary: all microbatch backwards are done,
+            # flush the deferred bucket collectives (one per bucket)
+            sync = getattr(self.model, "apply_collective_grads", None)
+            if callable(sync):
+                sync()
             # freeze lr at the traced scalar for this step (declared
             # protocol: Optimizer.get_lr honors _lr_override)
             with inner.lr_frozen(lr):

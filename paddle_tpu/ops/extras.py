@@ -136,8 +136,13 @@ def standard_normal(shape, dtype=None, name=None):
 def binomial(count, prob, name=None):
     from ..framework.random import next_key
 
+    # under x64 (the framework default) jax 0.4.x's binomial kernel
+    # clamps f32 operands against f64 literals and TypeErrors — run it
+    # in f64 there; without x64 skip the cast (it would only warn)
+    dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     return nary(lambda n, p: jax.random.binomial(
-        next_key(), n, p).astype(jnp.int64),
+        next_key(), n.astype(dt), p.astype(dt),
+        dtype=dt).astype(jnp.int64),
         [ensure_tensor(count), ensure_tensor(prob)], "binomial")
 
 
@@ -305,14 +310,23 @@ def cummin(x, axis=None, dtype="int64", name=None):
     x = ensure_tensor(x)
     ax = axis if axis is not None else None
     idt = to_jax_dtype(dtype)  # reference honors 'int32'/'int64' for indices
+    # jnp.minimum is a ufunc with .accumulate only on newer jax; lax
+    # cummin is the same scan everywhere
+    def _acc_min(v, axis=0):
+        if hasattr(jnp.minimum, "accumulate"):
+            return jnp.minimum.accumulate(v, axis=axis)
+        import jax as _jax
+
+        return _jax.lax.cummin(v, axis=axis)
+
     if ax is None:
-        flat = unary(lambda v: jnp.minimum.accumulate(v.reshape(-1)), x,
+        flat = unary(lambda v: _acc_min(v.reshape(-1)), x,
                      "cummin")
         vals = flat
         idx_f = unary(lambda v: _cummin_idx(v.reshape(-1)).astype(idt), x,
                       "cummin_idx")
     else:
-        vals = unary(lambda v: jnp.minimum.accumulate(v, axis=ax), x,
+        vals = unary(lambda v: _acc_min(v, axis=ax), x,
                      "cummin")
         idx_f = unary(lambda v: _cummin_idx(v, ax).astype(idt), x,
                       "cummin_idx")

@@ -438,11 +438,12 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
     """reference linalg.vector_norm — the vector-norm half of norm():
     flattens when axis is None (numpy matrix semantics do NOT apply)."""
     def f(v):
-        vv = v.reshape(-1) if axis is None else v
-        return jnp.linalg.norm(vv, ord=p,
-                               axis=None if axis is None else axis,
-                               keepdims=False if axis is None
-                               else keepdim)
+        if axis is None:
+            out = jnp.linalg.norm(v.reshape(-1), ord=p)
+            # reference p_norm(asvector=True, keepdim=True): all dims
+            # collapse to size 1, not dropped
+            return out.reshape((1,) * v.ndim) if keepdim else out
+        return jnp.linalg.norm(v, ord=p, axis=axis, keepdims=keepdim)
 
     return unary(f, x, "vector_norm")
 

@@ -82,6 +82,20 @@ define_flag("FLAGS_pallas_alias_selfcheck", True,
             "backward's aliased dK/dV HBM accumulation matches the "
             "hazard-free per-q-row path; fails loudly if a Mosaic "
             "pipeline-ordering change silently corrupts gradients")
+define_flag("FLAGS_comm_bucket_mb", 25,
+            "gradient-communication bucket size in MB: per-parameter "
+            "grads coalesce into size-capped flat buckets and sync as ONE "
+            "reduce_scatter/all_reduce per bucket (reference "
+            "reducer.cc:484 EagerReducer group_size; 0 disables bucketing "
+            "and restores the per-parameter collectives). DataParallel's "
+            "explicit sync sizes its buckets from its comm_buffer_size "
+            "constructor arg instead, honoring only the 0 kill-switch")
+define_flag("FLAGS_comm_quant", "",
+            "opt-in compressed gradient collectives on the explicit "
+            "bucketed paths: 'int8' (EQuARX-style symmetric per-bucket "
+            "scales on both the scatter and gather legs, ~4x less ICI "
+            "bytes) or 'bf16' (~2x); '' (default) keeps full-precision "
+            "payloads. Accumulation is fp32 in every mode")
 define_flag("FLAGS_pallas_flash_min_seqlen", 1024,
             "min seq len to route scaled_dot_product_attention to the "
             "pallas flash kernel. Measured on v5e (h16 d64 bf16, fwd+bwd "

@@ -52,8 +52,13 @@ class TestEagerCollectiveCacheMultiMesh:
         try:
             self._check(dist, C)
         finally:
+            # ALWAYS drop the test mesh: leaving it ambient poisons later
+            # eager runs (jaxlib 0.4.x segfaults reusing executables over
+            # the dead mesh in test_auto_tuner's engine)
             if prev is not None:
                 denv.set_mesh(prev)
+            else:
+                denv.reset()
 
     def _check(self, dist, C):
         g_sub = dist.new_group(ranks=[0, 1, 2, 3])

@@ -1,0 +1,585 @@
+"""Bucketed / quantized gradient collectives (ISSUE 1 tentpole).
+
+CPU multi-device parity on the conftest 8-device host mesh: bucketed
+reduce_scatter == per-param reduce_scatter == single-process grads (the
+two distributed modes bit-for-bit; single-process to reduction-order
+tolerance), the int8-compressed path within tolerance and OFF by default,
+the backward collective-count bound, the stage-2 layout check with
+bucketing on, and the accumulation comm boundary.
+"""
+import math
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as popt
+from paddle_tpu.distributed import env as denv
+from paddle_tpu.distributed.comm_bucketer import (
+    MB, build_buckets, bucketed_all_reduce, bucketed_reduce_scatter,
+    count_hlo_collectives,
+)
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.utils import flags as _flags
+
+
+@pytest.fixture(autouse=True)
+def reset_env():
+    yield
+    denv.reset()
+    _flags.set_flags({"FLAGS_comm_bucket_mb": 25, "FLAGS_comm_quant": ""})
+    import paddle_tpu.distributed.collective as coll
+
+    coll._default_group = None
+
+
+def cpu8():
+    return jax.devices("cpu")[:8]
+
+
+def mesh8(axis="sharding"):
+    mesh = Mesh(np.asarray(cpu8()), (axis,))
+    denv.set_mesh(mesh)
+    return mesh
+
+
+class TestBucketAssignment:
+    def test_deterministic_packing_and_padding(self):
+        shapes = [("a", (1024,), jnp.float32), ("b", (512, 2), jnp.float32),
+                  ("c", (7, 3), jnp.float32), ("d", (33,), jnp.float32)]
+        asn = build_buckets(shapes, bucket_bytes=8192, pad_multiple=8)
+        # 1024*4 = 4096 bytes, +1024*4 = 8192 fits; "c" would exceed
+        assert [b.keys for b in asn.buckets] == [["a", "b"], ["c", "d"]]
+        for b in asn.buckets:
+            assert b.numel % 8 == 0
+        bkt, entry = asn.bucket_of("d")
+        assert bkt.index == 1 and entry.offset == 21 and entry.numel == 33
+        # same input -> same assignment (determinism is the scatter-back
+        # contract)
+        asn2 = build_buckets(shapes, bucket_bytes=8192, pad_multiple=8)
+        assert asn2 == asn
+
+    def test_dtype_splits_buckets(self):
+        shapes = [("a", (8,), jnp.float32), ("b", (8,), jnp.bfloat16),
+                  ("c", (8,), jnp.bfloat16)]
+        asn = build_buckets(shapes, bucket_bytes=1 << 20)
+        assert [b.keys for b in asn.buckets] == [["a"], ["b", "c"]]
+
+    def test_oversized_param_gets_own_bucket(self):
+        shapes = [("big", (4096,), jnp.float32), ("s", (4,), jnp.float32)]
+        asn = build_buckets(shapes, bucket_bytes=1024)
+        assert [b.keys for b in asn.buckets] == [["big"], ["s"]]
+
+
+class TestBucketedCollectiveParity:
+    """Satellite: bucketed == per-param == single-process in fp32."""
+
+    def test_reduce_scatter_bitwise_vs_per_param(self):
+        mesh8()
+        group = dist.get_group()
+        rng = np.random.default_rng(0)
+        shapes = [(64, 16), (16,), (16, 8), (7, 5), (33,)]  # odd ones too
+        grads = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+        ts = [Tensor(jnp.asarray(g)) for g in grads]
+        bucketed_reduce_scatter(ts, group=group)
+        for g, t in zip(grads, ts):
+            got = np.asarray(t._data)
+            if g.size % 8 == 0:
+                per = np.asarray(dist.reduce_scatter(
+                    None, Tensor(jnp.asarray(g.reshape(-1))),
+                    axis=0)._data).reshape(g.shape)
+                np.testing.assert_array_equal(got, per)
+            # every shape (odd ones only the bucket path can scatter):
+            # value == the sum of 8 replicated rank copies
+            np.testing.assert_allclose(got, g * 8, rtol=1e-6)
+
+    def test_all_reduce_bitwise_vs_per_param(self):
+        mesh8("dp")
+        rng = np.random.default_rng(1)
+        grads = [rng.standard_normal(s).astype(np.float32)
+                 for s in [(32, 8), (11,), (3, 5)]]
+        ts = [Tensor(jnp.asarray(g)) for g in grads]
+        bucketed_all_reduce(ts)
+        for g, t in zip(grads, ts):
+            per = dist.all_reduce(Tensor(jnp.asarray(g)))
+            np.testing.assert_array_equal(np.asarray(t._data),
+                                          np.asarray(per._data))
+
+    def test_int8_within_tolerance_and_off_by_default(self):
+        mesh8("dp")
+        # off by default: flag empty, all_reduce_quantized falls back to
+        # the exact path bit-for-bit
+        assert _flags.get_flag("FLAGS_comm_quant") == ""
+        x = jnp.asarray(np.random.default_rng(2)
+                        .standard_normal(256), jnp.float32)
+        exact = dist.all_reduce(Tensor(x))
+        dflt = dist.all_reduce_quantized(Tensor(x))
+        np.testing.assert_array_equal(np.asarray(dflt._data),
+                                      np.asarray(exact._data))
+        # int8 path: rel error < 1e-2 (the EQuARX-style two-sided scales)
+        rep = dist.comm_quant_selftest(qformat="int8")
+        assert rep["pass"], rep
+        # non-32-aligned sizes must hold the contract too (payload pads
+        # to whole scaling blocks; a chunk-sized fallback scale would
+        # reintroduce the outlier floor)
+        rep = dist.comm_quant_selftest(qformat="int8", numel=1000)
+        assert rep["pass"], rep
+        # and it rides the bucketed path via the flag
+        _flags.set_flags({"FLAGS_comm_quant": "int8"})
+        ts = [Tensor(x)]
+        bucketed_all_reduce(ts)
+        rel = (np.max(np.abs(np.asarray(ts[0]._data)
+                             - np.asarray(exact._data)))
+               / np.max(np.abs(np.asarray(exact._data))))
+        assert rel < 1e-2, rel
+
+    def test_bf16_compressed_path(self):
+        mesh8("dp")
+        rep = dist.comm_quant_selftest(qformat="bf16")
+        assert rep["pass"], rep
+
+    def test_quantized_rejects_non_sum(self):
+        mesh8("dp")
+        with pytest.raises(ValueError, match="SUM"):
+            dist.all_reduce_quantized(Tensor(jnp.ones(8)),
+                                      op=dist.ReduceOp.MAX, qformat="int8")
+
+
+class TestBackwardCollectiveCount:
+    """Acceptance: a ~1M-param model's backward + bucketed sync emits
+    <= ceil(total_grad_bytes / bucket_size) collective ops, vs
+    one-per-parameter before (HLO op-count probe)."""
+
+    def _model_and_batch(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(512, 1024), nn.GELU(),
+                              nn.Linear(1024, 512))
+        params = [p for p in model.parameters() if p.trainable]
+        n = sum(int(np.prod(p.shape)) for p in params)
+        assert n > 1_000_000, n
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((8, 512)), jnp.float32)
+        y = jnp.asarray(np.random.default_rng(1)
+                        .standard_normal((8, 512)), jnp.float32)
+        return model, params, n, x, y
+
+    def test_bucketed_backward_meets_bound(self):
+        mesh8()
+        group = dist.get_group()
+        model, params, n_params, x, y = self._model_and_batch()
+        total_bytes = n_params * 4
+        bound = math.ceil(total_bytes / (25 * MB))
+
+        def f(xd, yd):
+            loss = ((model(Tensor._wrap(xd))
+                     - Tensor._wrap(yd)) ** 2).mean()
+            loss.backward()
+            gs = [p.grad for p in params]
+            bucketed_reduce_scatter(gs, group=group)
+            return [g._data for g in gs]
+
+        try:
+            counts = count_hlo_collectives(f, x, y)
+        finally:
+            for p in params:
+                p.clear_grad()
+        assert counts["reduce_scatter"] <= bound, (counts, bound)
+        assert counts["reduce_scatter"] >= 1
+        assert counts["all_reduce"] == 0, counts
+
+    def test_per_param_backward_is_one_per_parameter(self):
+        mesh8()
+        group = dist.get_group()
+        model, params, _, x, y = self._model_and_batch()
+
+        def f(xd, yd):
+            loss = ((model(Tensor._wrap(xd))
+                     - Tensor._wrap(yd)) ** 2).mean()
+            loss.backward()
+            outs = []
+            for p in params:
+                outs.append(dist.reduce_scatter(
+                    None, Tensor._wrap(p.grad._data.reshape(-1)),
+                    group=group, axis=0)._data)
+            return outs
+
+        try:
+            counts = count_hlo_collectives(f, x, y)
+        finally:
+            for p in params:
+                p.clear_grad()
+        # the "before" this PR replaces: one collective per parameter
+        assert counts["reduce_scatter"] == len(params), counts
+
+
+class TestStage2Bucketed:
+    """Stage-2 ("os_g") with the bucketer: parity with per-param mode
+    bit-for-bit, with single-process to reduction-order tolerance; the
+    layout check of tests/test_distributed.py still holds with bucketing
+    on (grads materialize reduce-scattered, never all-reduce-replicated)."""
+
+    def _grads(self, mode):
+        """mode: None=single-process, 0=per-param stage2, 25=bucketed."""
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        denv.reset()
+        if mode is not None:
+            mesh8()
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(64, 128), nn.GELU(),
+                              nn.Linear(128, 64))
+        params = list(model.parameters())
+        mw = model
+        if mode is not None:
+            _flags.set_flags({"FLAGS_comm_bucket_mb": mode})
+            mw, _, _ = group_sharded_parallel(
+                model, popt.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters()),
+                level="os_g")
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((32, 64)), jnp.float32)
+        y = jnp.asarray(np.random.default_rng(1)
+                        .standard_normal((32, 64)), jnp.float32)
+        if mode is not None:
+            x = jax.device_put(x, NamedSharding(
+                denv.get_mesh(), P("sharding", None)))
+
+        def f(xd, yd):
+            loss = ((mw(Tensor._wrap(xd)) - Tensor._wrap(yd)) ** 2).mean()
+            loss.backward()
+            if hasattr(mw, "apply_collective_grads"):
+                mw.apply_collective_grads()
+            return [p.grad._data for p in params]
+
+        try:
+            return [np.asarray(g) for g in jax.jit(f)(x, y)]
+        finally:
+            for p in params:
+                p.clear_grad()
+
+    def test_bucketed_grads_bitwise_vs_per_param_and_single(self):
+        single = self._grads(None)
+        per_param = self._grads(0)
+        bucketed = self._grads(25)
+        for a, b in zip(per_param, bucketed):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(single, bucketed):
+            # cross-replica reduction tree != single-matmul order: exact
+            # to fp32 reduction-order noise
+            np.testing.assert_allclose(a, b, atol=1e-7)
+
+    def test_training_parity_and_bucketer_engaged(self):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        from paddle_tpu.jit import TrainStep
+
+        def train(bucket_mb):
+            denv.reset()
+            mesh8()
+            _flags.set_flags({"FLAGS_comm_bucket_mb": bucket_mb})
+            paddle.seed(0)
+            model = nn.Linear(16, 8)
+            opt = popt.AdamW(learning_rate=0.01,
+                             parameters=model.parameters())
+            mw, ow, _ = group_sharded_parallel(model, opt, level="os_g")
+            x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16)
+                                 .astype(np.float32))
+            y = paddle.to_tensor(np.random.RandomState(1).randn(8, 8)
+                                 .astype(np.float32))
+
+            def lf(m, a, b):
+                d = m(a) - b
+                return (d * d).mean()
+
+            step = TrainStep(mw, lf, ow)
+            losses = [float(step(x, y)) for _ in range(3)]
+            return losses, mw, model
+
+        l_bucket, mw, model = train(25)
+        assert mw._bucketer is not None and mw._bucketer.num_buckets >= 1
+        # the sharded optimizer records the deterministic assignment for
+        # the scatter-back
+        asn = mw._opt.grad_bucket_assignment()
+        assert asn is not None and asn is mw._bucketer.assignment
+        l_pp, mw_pp, _ = train(0)
+        assert mw_pp._bucketer is None
+        np.testing.assert_allclose(l_bucket, l_pp, rtol=1e-6)
+
+    def test_eager_layout_check_with_bucketing_on(self):
+        """The tests/test_distributed.py stage-2 layout assert, with
+        bucketing explicitly ON: the eager backward still leaves grads
+        reduce-scattered (sharded over the axis), never replicated."""
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        mesh8()
+        assert _flags.get_flag("FLAGS_comm_bucket_mb") > 0
+        paddle.seed(0)
+        model = nn.Linear(16, 8)
+        opt = popt.AdamW(learning_rate=0.01,
+                         parameters=model.parameters())
+        mw, _, _ = group_sharded_parallel(model, opt, level="os_g")
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16)
+                             .astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1).randn(8, 8)
+                             .astype(np.float32))
+        d = mw(x) - y
+        (d * d).mean().backward()
+        g = model.weight.grad
+        assert g is not None
+        assert any(a == "sharding" for a in (g._data.sharding.spec or ())), \
+            f"grad not reduce-scattered: {g._data.sharding}"
+
+
+class TestAccumulationBoundary:
+    """Acceptance: TrainStep(accum_steps=4) grads bit-identical in fp32
+    to 4 summed single-microbatch backwards (momentum velocity after one
+    step IS the accumulated grad, so it is the exact probe)."""
+
+    def _build(self):
+        paddle.seed(3)
+        m = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 8))
+        o = popt.Momentum(learning_rate=0.1, momentum=0.9,
+                          parameters=m.parameters())
+        return m, o
+
+    def test_accum4_bit_identical_to_summed_backwards(self):
+        from paddle_tpu.jit import TrainStep
+
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((8, 16)).astype(np.float32))
+        y = paddle.to_tensor(np.random.default_rng(1)
+                             .standard_normal((8, 8)).astype(np.float32))
+
+        def lf(m, a, b):
+            return ((m(a) - b) ** 2).mean()
+
+        m1, o1 = self._build()
+        TrainStep(m1, lf, o1, accum_steps=4)(x, y)
+        v_fused = list(o1._accumulators["velocity"].values())
+
+        m2, o2 = self._build()
+        for i in range(4):
+            xs = Tensor._wrap(x._data[i * 2:(i + 1) * 2])
+            ys = Tensor._wrap(y._data[i * 2:(i + 1) * 2])
+            (lf(m2, xs, ys) * 0.25).backward()
+        o2.step()
+        v_eager = list(o2._accumulators["velocity"].values())
+        assert len(v_fused) == len(v_eager) >= 4
+        for a, b in zip(v_fused, v_eager):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_accum_steps_alias_and_conflict(self):
+        from paddle_tpu.jit import TrainStep
+
+        m, o = self._build()
+        step = TrainStep(m, lambda mm, a, b: ((mm(a) - b) ** 2).mean(), o,
+                         accum_steps=2)
+        assert step.accumulate_steps == 2
+        with pytest.raises(ValueError, match="conflicting"):
+            TrainStep(m, lambda mm, a, b: ((mm(a) - b) ** 2).mean(), o,
+                      accumulate_steps=2, accum_steps=4)
+
+    def test_stage2_accum_syncs_once_at_boundary(self):
+        """With accum_steps=k the bucket collectives issue ONCE, at the
+        comm boundary after the k-th microbatch backward — not once per
+        microbatch: the traced step invokes the bucketer's sync exactly
+        one time (and the hooks marked grads pending every microbatch),
+        and the losses match the per-param mode."""
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        from paddle_tpu.jit import TrainStep
+
+        def run(bucket_mb, sync_log=None):
+            denv.reset()
+            mesh8()
+            _flags.set_flags({"FLAGS_comm_bucket_mb": bucket_mb})
+            paddle.seed(0)
+            model = nn.Linear(16, 8)
+            opt = popt.AdamW(learning_rate=0.01,
+                             parameters=model.parameters())
+            mw, ow, _ = group_sharded_parallel(model, opt, level="os_g")
+            if sync_log is not None:
+                bucketer = mw._bucketer
+                orig = bucketer.sync_pending
+
+                def counted():
+                    issued = orig()
+                    if issued:
+                        sync_log.append(issued)
+                    return issued
+
+                bucketer.sync_pending = counted
+            x = paddle.to_tensor(np.random.RandomState(0).randn(8, 16)
+                                 .astype(np.float32))
+            y = paddle.to_tensor(np.random.RandomState(1).randn(8, 8)
+                                 .astype(np.float32))
+            step = TrainStep(mw, lambda m, a, b: ((m(a) - b) ** 2).mean(),
+                             ow, accum_steps=4)
+            return float(step(x, y))
+
+        log = []
+        l_bucket = run(25, log)
+        # one sync (of >=1 buckets) per traced step — the boundary, not 4
+        assert len(log) == 1, log
+        l_pp = run(0)
+        np.testing.assert_allclose(l_bucket, l_pp, rtol=1e-6)
+
+
+class TestPartialGradExplicitSync:
+    """The explicit bucketed path for grads tagged partial (per-rank
+    producers): DataParallel.apply_collective_grads and
+    fused_allreduce_gradients coalesce them into one all-reduce per
+    bucket instead of one per parameter."""
+
+    def _partial_grad_model(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.GELU(),
+                              nn.Linear(16, 8))
+        for p in model.parameters():
+            g = Tensor(jnp.asarray(
+                np.random.default_rng(hash(p.name) % 2**31)
+                .standard_normal(p.shape).astype(np.float32)))
+            g._is_partial_grad = True
+            p.grad = g
+        return model
+
+    def test_dataparallel_apply_collective_grads(self):
+        mesh8("dp")
+        model = self._partial_grad_model()
+        want = {p.name: np.asarray(p.grad._data) * 8
+                for p in model.parameters()}
+        dp = dist.DataParallel(model)
+        dp.apply_collective_grads()
+        for p in model.parameters():
+            assert not getattr(p.grad, "_is_partial_grad", False)
+            np.testing.assert_allclose(np.asarray(p.grad._data),
+                                       want[p.name], rtol=1e-6)
+        # untagged grads are untouched (GSPMD already reduced them)
+        before = np.asarray(model[0].weight.grad._data).copy()
+        dp.apply_collective_grads()
+        np.testing.assert_array_equal(
+            np.asarray(model[0].weight.grad._data), before)
+
+    def test_dp_sync_uses_dp_axis_on_hybrid_mesh(self):
+        """group=None on a dp×mp mesh must reduce over dp ONLY (the
+        world group would sum unrelated model-parallel slices)."""
+        mesh = Mesh(np.asarray(cpu8()).reshape(4, 2), ("dp", "mp"))
+        denv.set_mesh(mesh)
+        model = self._partial_grad_model()
+        want = {p.name: np.asarray(p.grad._data) * 4   # dp degree, NOT 8
+                for p in model.parameters()}
+        dist.DataParallel(model).apply_collective_grads()
+        for p in model.parameters():
+            np.testing.assert_allclose(np.asarray(p.grad._data),
+                                       want[p.name], rtol=1e-6)
+
+    def test_bucket_flag_zero_restores_per_param(self):
+        """FLAGS_comm_bucket_mb=0: every tensor becomes its own bucket
+        (the documented per-parameter escape hatch) on both the flag-
+        defaulted and the DataParallel comm_buffer_size paths."""
+        mesh8("dp")
+        _flags.set_flags({"FLAGS_comm_bucket_mb": 0})
+        grads = [np.ones((4,), np.float32), np.ones((6,), np.float32)]
+        asn = build_buckets([(i, g.shape, g.dtype)
+                             for i, g in enumerate(grads)])
+        assert len(asn.buckets) == len(grads)
+        model = self._partial_grad_model()
+        want = {p.name: np.asarray(p.grad._data) * 8
+                for p in model.parameters()}
+        dist.DataParallel(model).apply_collective_grads()
+        for p in model.parameters():
+            np.testing.assert_allclose(np.asarray(p.grad._data),
+                                       want[p.name], rtol=1e-6)
+
+    def test_bare_stage2_wrapper_keeps_traced_per_param_pins(self):
+        """GroupShardedStage2 WITHOUT a flush-capable sharding optimizer
+        (bare wrapper in a user jit, no apply_collective_grads call) must
+        not defer pins it cannot flush — grads still come out sharded."""
+        from paddle_tpu.distributed.sharding import GroupShardedStage2
+
+        mesh8()
+        paddle.seed(0)
+        model = nn.Linear(16, 8)
+        mw = GroupShardedStage2(model)          # sharding_optimizer=None
+        assert not mw._defer_ok
+        params = list(model.parameters())
+
+        def f(xd, yd):
+            loss = ((mw(Tensor._wrap(xd)) - Tensor._wrap(yd)) ** 2).mean()
+            loss.backward()
+            return [p.grad._data for p in params]
+
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 16), jnp.float32)
+        y = jnp.asarray(np.random.RandomState(1).randn(8, 8), jnp.float32)
+        try:
+            txt = jax.jit(f).lower(x, y).compile().as_text()
+        finally:
+            for p in params:
+                p.clear_grad()
+        # the per-param sharding constraints must still be in the program
+        # (sharded grad layout, not lost to an unflushed bucket)
+        assert "sharding={devices=" in txt
+
+    def test_fused_allreduce_gradients_bucketed(self):
+        from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+            fused_allreduce_gradients,
+        )
+
+        mesh8("dp")
+        model = self._partial_grad_model()
+        want = {p.name: np.asarray(p.grad._data) * 8
+                for p in model.parameters()}
+        fused_allreduce_gradients(list(model.parameters()))
+        for p in model.parameters():
+            np.testing.assert_allclose(np.asarray(p.grad._data),
+                                       want[p.name], rtol=1e-6)
+
+
+class TestSatelliteFixes:
+    def test_rope_half_style_derived_table(self):
+        """Regression (ADVICE r5): use_neox_rotary_style=False with
+        sin/cos omitted must pair position j with freq j (table
+        [freqs, freqs]), matching both the numpy reference and an
+        explicitly passed table."""
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding,
+        )
+
+        rng = np.random.default_rng(0)
+        b, s, h, d = 2, 6, 2, 8
+        q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+        freqs = np.outer(np.arange(s), inv).astype(np.float32)
+        half = d // 2
+        x1, x2 = q[..., :half], q[..., half:]
+        s1 = np.sin(freqs)[None, :, None, :]
+        c1 = np.cos(freqs)[None, :, None, :]
+        want = np.concatenate([x1 * c1 - x2 * s1,
+                               x2 * c1 + x1 * s1], -1)
+        derived, _, _ = fused_rotary_position_embedding(
+            paddle.to_tensor(q), use_neox_rotary_style=False)
+        np.testing.assert_allclose(np.asarray(derived._data), want,
+                                   rtol=1e-5, atol=1e-5)
+        # consistency with an explicit [freqs, freqs] table
+        table = np.concatenate([freqs, freqs], -1)
+        explicit, _, _ = fused_rotary_position_embedding(
+            paddle.to_tensor(q), sin=paddle.to_tensor(np.sin(table)),
+            cos=paddle.to_tensor(np.cos(table)),
+            use_neox_rotary_style=False)
+        np.testing.assert_allclose(np.asarray(derived._data),
+                                   np.asarray(explicit._data), atol=1e-6)
+
+    def test_vector_norm_keepdim_axis_none(self):
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((3, 4, 5)).astype(np.float32))
+        out = paddle.linalg.vector_norm(x, p=2.0, axis=None, keepdim=True)
+        assert tuple(out.shape) == (1, 1, 1)
+        np.testing.assert_allclose(
+            float(np.asarray(out._data).reshape(())),
+            np.linalg.norm(np.asarray(x._data).reshape(-1)), rtol=1e-6)
+        # keepdim=False unchanged: scalar
+        flat = paddle.linalg.vector_norm(x, p=2.0)
+        assert tuple(flat.shape) == ()

@@ -14,6 +14,16 @@ import sys
 
 import pytest
 
+import paddle_tpu as paddle
+
+# jaxlib 0.4.x: "Multiprocess computations aren't implemented on the CPU
+# backend" — the 2-process CLUSTER tests (cross-process collectives)
+# cannot run on the legacy toolchain; the RPC test has no collectives
+# and stays live
+_needs_mp_collectives = pytest.mark.skipif(
+    paddle.jax_compat_legacy,
+    reason="jaxlib 0.4.x CPU backend has no multiprocess computations")
+
 
 def _free_port():
     s = socket.socket()
@@ -39,6 +49,7 @@ def _clean_env():
     return env
 
 
+@_needs_mp_collectives
 class TestTwoProcessCluster:
     def test_rank_branch_checkpoint_merge_and_reshard(self, tmp_path):
         worker = os.path.join(os.path.dirname(__file__), "mp2_worker.py")
@@ -68,6 +79,7 @@ class TestTwoProcessCluster:
         assert "MP2-OK rank=2 proc=1" in outs[1]
 
 
+@_needs_mp_collectives
 class TestLauncherSpawnsBothRanks:
     def test_two_launchers_form_cluster(self):
         """Both 'hosts' started via the launcher CLI: master rendezvous on

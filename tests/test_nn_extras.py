@@ -471,19 +471,24 @@ class TestIncubateFusedFunctionals:
         def np_rope(x, neox):
             inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
             freqs = np.outer(np.arange(s), inv)
-            emb = np.repeat(freqs, 2, axis=-1)
-            sin = np.sin(emb)[None, :, None, :]
-            cos = np.cos(emb)[None, :, None, :]
             if neox:
+                emb = np.repeat(freqs, 2, axis=-1)
+                sin = np.sin(emb)[None, :, None, :]
+                cos = np.cos(emb)[None, :, None, :]
                 x1, x2 = x[..., 0::2], x[..., 1::2]
                 s1, c1 = sin[..., 0::2], cos[..., 0::2]
                 out = np.empty_like(x)
                 out[..., 0::2] = x1 * c1 - x2 * s1
                 out[..., 1::2] = x2 * c1 + x1 * s1
                 return out
+            # half (GPT-J) style: pair (j, j+half) rotates by freq j — the
+            # table is [freqs, freqs], NOT the neox interleave (which would
+            # pair positions with wrong frequencies; the r5 ADVICE bug was
+            # exactly that and this reference used to encode it too)
             half = d // 2
+            s1 = np.sin(freqs)[None, :, None, :]
+            c1 = np.cos(freqs)[None, :, None, :]
             x1, x2 = x[..., :half], x[..., half:]
-            s1, c1 = sin[..., :half], cos[..., :half]
             return np.concatenate([x1 * c1 - x2 * s1,
                                    x2 * c1 + x1 * s1], -1)
 

@@ -625,7 +625,11 @@ S("glu", lambda x: F.glu(x, axis=-1),
 S("softmax", lambda x: F.softmax(x, axis=-1),
   lambda x: sps.softmax(x, -1), _std())
 S("log_softmax", lambda x: F.log_softmax(x, axis=-1),
-  lambda x: sps.log_softmax(x, -1), _std())
+  lambda x: sps.log_softmax(x, -1), _std(),
+  # fp32 fd probe: the summed-output quantization floor is ~1e-3 in
+  # grad units here; default atol sat just below it (flaky per jax
+  # version's rounding)
+  grad_kw=dict(atol=2e-3))
 S("prelu", lambda x: F.prelu(x, paddle.to_tensor(
     np.asarray([0.25], np.float32))),
   lambda x: np.where(x > 0, x, 0.25 * x), _std())

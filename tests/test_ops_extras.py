@@ -18,6 +18,10 @@ def _np(t):
 
 
 def test_reference_top_level_all_covered():
+    import os
+
+    if not os.path.exists("/root/reference/python/paddle/__init__.py"):
+        pytest.skip("reference checkout not present")
     src = open("/root/reference/python/paddle/__init__.py").read()
     tree = ast.parse(src)
     names = []

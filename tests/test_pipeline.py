@@ -157,6 +157,10 @@ class TestGPTPipeParity:
         assert abs(float(crit(plain(ids), labels)) -
                    float(crit(pipe(ids), labels))) < 1e-5
 
+    @pytest.mark.skipif(
+        paddle.jax_compat_legacy,
+        reason="old XLA: PartitionId unsupported under SPMD partitioning "
+               "(the pipeline shard_map path needs the new toolchain)")
     def test_train_step_pp_dp_mesh(self):
         """Full fused TrainStep over a dp×pp mesh: loss decreases and the
         jitted step does not retrace."""
@@ -350,6 +354,8 @@ class TestZeroBubbleGPT:
 
         return template, block_fn
 
+    @pytest.mark.slow  # ~15-23s multi-device parity; the dryrun
+    # gate (zero-bubble pipe phase) covers this path in-budget
     def test_gpt_block_parity_pp4(self):
         import jax
         import jax.numpy as jnp
@@ -385,6 +391,8 @@ class TestZeroBubbleGPT:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4)
 
+    @pytest.mark.slow  # ~15-23s multi-device parity; the dryrun
+    # gate (zero-bubble pipe phase) covers this path in-budget
     def test_dw_chunk_variants_agree(self):
         import jax
         import jax.numpy as jnp
@@ -507,6 +515,8 @@ class TestZeroBubbleModelPath:
     match the AD-ring model (r5 review finding: the direct-block test
     could not see these layers)."""
 
+    @pytest.mark.slow  # ~15-23s multi-device parity; the dryrun
+    # gate (zero-bubble pipe phase) covers this path in-budget
     def test_model_loss_and_grads_match_ad_ring(self):
         cfg = _tiny_cfg()
         mesh = _mesh(2)
@@ -553,6 +563,8 @@ class TestVPPTrainParity:
     carrying the same weights (r5 — VERDICT r4 weak #6 named VPP as
     never parity-exercised beyond a forward test)."""
 
+    @pytest.mark.slow  # ~15-23s multi-device parity; the dryrun
+    # gate (zero-bubble pipe phase) covers this path in-budget
     def test_chunks2_loss_and_grads_match_plain(self):
         cfg = _tiny_cfg()                    # 4 layers
         mesh = _mesh(2)
