@@ -167,6 +167,114 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
     }
 
 
+def run_decode_config(model_name=None, prompt_len=None, new_tokens=None,
+                      batches=(1, 8), int8_ab=True):
+    """Inference/decode lane (ISSUE 2): prefill TTFT + steady-state
+    decode tokens/s/chip through the compiled generation engine, paged
+    vs dense A/B, and the int8 weight-only decode A/B that PERF.md
+    measured 5x at the kernel level (bs1 4096x16384)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.decode_step import GenerationEngine
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+
+    model_name = model_name or os.environ.get("BENCH_DECODE_MODEL",
+                                              "gpt3-125m")
+    prompt_len = prompt_len or int(os.environ.get(
+        "BENCH_DECODE_PROMPT", "128"))
+    new_tokens = new_tokens or int(os.environ.get(
+        "BENCH_DECODE_TOKENS", "64"))
+    cfg = gpt_config(model_name,
+                     max_position_embeddings=prompt_len + new_tokens)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    models = {"fp32": model}
+    if int8_ab:
+        from paddle_tpu.nn.quant import quantize_for_decode
+
+        paddle.seed(0)
+        models["int8"] = quantize_for_decode(GPTForCausalLM(cfg))
+        models["int8"].eval()
+
+    rng = np.random.default_rng(0)
+    lanes = {}
+    for bs in batches:
+        ids = rng.integers(1, cfg.vocab_size, (bs, prompt_len))
+        rec = {}
+        for kind in ("dense", "paged"):
+            for tag, m in models.items():
+                if kind == "paged" and tag == "int8":
+                    continue   # the cache A/B, not the weight A/B
+                eng = GenerationEngine(
+                    m, kind=kind, batch=bs,
+                    max_len=prompt_len + new_tokens)
+                eng.generate(ids, 2)             # compile both steps
+                t0 = time.perf_counter()
+                eng.generate(ids, 1)
+                ttft = time.perf_counter() - t0  # prefill + 1 sample
+                t0 = time.perf_counter()
+                eng.generate(ids, new_tokens)
+                total = time.perf_counter() - t0
+                decode_s = max(total - ttft, 1e-9)
+                name = kind if tag == "fp32" else f"{kind}_{tag}"
+                rec[f"{name}_decode_tok_s_chip"] = round(
+                    bs * (new_tokens - 1) / decode_s, 1)
+                if tag == "fp32":
+                    rec[f"{name}_prefill_ttft_ms"] = round(
+                        ttft * 1e3, 2)
+        lanes[f"bs{bs}"] = rec
+    return {
+        "metric": f"{model_name}_decode_tokens_per_sec_per_chip",
+        "unit": "tokens/s",
+        "config": {"model": model_name, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens,
+                   "params": sum(int(np.prod(p.shape))
+                                 for p in model.parameters())},
+        "lanes": lanes,
+    }
+
+
+def run_resnet_config(batch=None, steps=None):
+    """BASELINE metric #2 lane: ResNet-50 training images/sec on one
+    chip (the DP-scaling baseline's per-chip anchor)."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    batch = batch or int(os.environ.get("BENCH_RESNET_BS", "32"))
+    steps = steps or int(os.environ.get("BENCH_RESNET_STEPS", "5"))
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    crit = paddle.nn.CrossEntropyLoss()
+    opt = popt.Momentum(learning_rate=0.1, momentum=0.9,
+                        parameters=model.parameters())
+    step = TrainStep(model, lambda m, x, y: crit(m(x), y), opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((batch, 3, 224, 224)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 1000, (batch,)), dtype="int64")
+    tw = time.perf_counter()
+    loss = step(x, y)
+    _ = float(loss)
+    print(f"[bench] resnet50 warmup {time.perf_counter() - tw:.1f}s",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(batch * steps / dt, 1),
+        "unit": "images/s",
+        "vs_baseline": None,
+        "config": {"batch": batch, "steps": steps},
+    }
+
+
 def run_selftest():
     """On-chip kernel numerics lane (VERDICT r3 Next #9): a small marked
     subset asserting COMPILED-on-chip numerics (not interpret mode) —
@@ -282,18 +390,27 @@ def run_selftest():
         assert lane.get("check") == "pass", lane
         results["bucketed_reduce_scatter_parity_detail"] = lane
 
+    def decode_parity():
+        # hermetic CPU lane: paged == dense == full-sequence forward
+        # within fp32 tolerance + greedy eager==compiled, asserted in a
+        # JAX_PLATFORMS=cpu subprocess so the record is chip-independent
+        rec = _run_cpu_probe("paddle_tpu.inference.decode_selftest",
+                             n_devices=1)
+        assert rec.get("check") == "pass", rec
+        results["decode_parity_detail"] = rec
+
     check("pallas_flash_single_block_s512", lambda: flash(512))
     check("pallas_flash_tiled_s2048", lambda: flash(2048))
     check("int8_weight_only_matmul", int8_matmul)
     check("master_offload_parity_pinned_host", offload_parity)
     check("bucketed_reduce_scatter_parity", bucketed_rs_parity)
+    check("decode_parity", decode_parity)
     return results
 
 
-def _run_cpu_host_mesh_probe(multichip=False, n_devices=8, timeout=600):
-    """Run paddle_tpu.distributed.comm_bucketer's host-mesh probe in a
-    hermetic CPU subprocess (axon env stripped, virtual device count
-    forced) and return its JSON record.
+def _run_cpu_probe(module, extra_args=(), n_devices=8, timeout=600):
+    """Run `python -m <module>` in a hermetic CPU subprocess (axon env
+    stripped, virtual device count forced) and return its JSON record.
 
     The env-strip recipe intentionally mirrors tests/conftest.py and
     tools/cpu_env.sh (conftest cannot import a shared helper — it must
@@ -314,9 +431,7 @@ def _run_cpu_host_mesh_probe(multichip=False, n_devices=8, timeout=600):
              if "xla_force_host_platform_device_count" not in f]
     flags.append(f"--xla_force_host_platform_device_count={n_devices}")
     env["XLA_FLAGS"] = " ".join(flags)
-    cmd = [sys.executable, "-m", "paddle_tpu.distributed.comm_bucketer"]
-    if multichip:
-        cmd.append("--multichip")
+    cmd = [sys.executable, "-m", module, *extra_args]
     r = subprocess.run(cmd, env=env, capture_output=True, text=True,
                        timeout=timeout,
                        cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -324,9 +439,16 @@ def _run_cpu_host_mesh_probe(multichip=False, n_devices=8, timeout=600):
                  if ln.startswith("{")), None)
     if r.returncode != 0 or line is None:
         raise RuntimeError(
-            f"host-mesh probe failed rc={r.returncode}: "
+            f"hermetic CPU probe {module} failed rc={r.returncode}: "
             f"{r.stderr[-500:]}")
     return json.loads(line)
+
+
+def _run_cpu_host_mesh_probe(multichip=False, n_devices=8, timeout=600):
+    return _run_cpu_probe(
+        "paddle_tpu.distributed.comm_bucketer",
+        extra_args=("--multichip",) if multichip else (),
+        n_devices=n_devices, timeout=timeout)
 
 
 # Round-5 status: the north star runs LIVE as the default primary — the
@@ -468,6 +590,27 @@ def main():
     if os.environ.get("BENCH_SELFTEST", "1") == "1":
         result["selftest"] = run_selftest()
 
+    # inference/decode lane (ISSUE 2): compact bs1 record in-window;
+    # `python bench.py --decode` is the full bs1/bs8 A/B
+    elapsed = time.perf_counter() - t_start
+    if os.environ.get("BENCH_DECODE", "1") == "1" and elapsed < float(
+            os.environ.get("BENCH_DECODE_CUTOFF_S", "360")):
+        try:
+            result["decode"] = run_decode_config(batches=(1,))
+        except Exception as e:  # a decode failure must not eat the
+            result["decode"] = {"error": f"{type(e).__name__}: {e}"[
+                :300]}          # training number
+
+    # ResNet-50 images/sec lane (BASELINE metric #2)
+    elapsed = time.perf_counter() - t_start
+    if os.environ.get("BENCH_RESNET", "1") == "1" and elapsed < float(
+            os.environ.get("BENCH_RESNET_CUTOFF_S", "420")):
+        try:
+            result["resnet50"] = run_resnet_config()
+        except Exception as e:
+            result["resnet50"] = {"error":
+                                  f"{type(e).__name__}: {e}"[:300]}
+
     secondary_name = os.environ.get("BENCH_SECONDARY",
                                     "gpt3-350m" if big else "")
     # time-gate the secondary so the primary + selftest always fit the
@@ -544,6 +687,14 @@ if __name__ == "__main__":
         # host-device-count mesh (collective counts by HLO inspection +
         # walltime), hermetic CPU subprocess — one JSON line
         print(json.dumps(_run_cpu_host_mesh_probe(multichip=True)))
+    elif "--decode" in sys.argv:
+        # DECODE lane: prefill TTFT + decode tokens/s/chip at bs1/bs8,
+        # paged vs dense A/B, int8 weight-only A/B — one JSON line
+        _setup_jax()
+        print(json.dumps(run_decode_config(batches=(1, 8))))
+    elif "--resnet" in sys.argv:
+        _setup_jax()
+        print(json.dumps(run_resnet_config()))
     elif "--selftest" in sys.argv:
         _setup_jax()
         print(json.dumps({"selftest": run_selftest()}))

@@ -435,18 +435,238 @@ def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
 
 
 def masked_multihead_attention(x, cache_kv=None, bias=None,
-                               src_mask=None, *args, **kwargs):
-    raise NotImplementedError(
-        "masked_multihead_attention is the CUDA decode-step kernel of "
-        "the inference deployment stack (descoped, docs/DECISIONS.md "
-        "§4); for decoding use nn.MultiHeadAttention with a cache or "
-        "jit-compiled step functions")
+                               src_mask=None, cum_offsets=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, seq_len=1,
+                               rotary_emb_dims=0,
+                               use_neox_rotary_style=False, **kwargs):
+    """The dense-cache decode step (reference
+    masked_multihead_attention_kernel.cu): one new token per sequence,
+    K/V appended into a preallocated dense cache, q attends the cache.
+
+    x: [bsz, 3*num_head*head_dim] fused qkv of the CURRENT token.
+    cache_kv: [2, bsz, num_head, max_seq, head_dim] (reference layout).
+    sequence_lengths: the write position (= tokens already cached) —
+    a python int / 0-d tensor (aligned batch: the update lowers to ONE
+    dynamic_update_slice, the retrace-free jit fast path) or a [bsz] /
+    [bsz, 1] tensor (ragged batch: scatter). src_mask: optional
+    additive float bias broadcastable to [bsz, 1, 1, max_seq].
+
+    Returns (out [bsz, num_head*head_dim], cache_kv_out) — functional:
+    the updated cache is returned, not written in place.
+    """
+    from ...ops._dispatch import nary
+    from ...framework.tensor import Tensor
+
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "apply fused_rotary_position_embedding to q/k before the "
+            "cache append; the in-kernel rotary path is not plumbed")
+    if beam_cache_offset is not None:
+        raise NotImplementedError("beam_cache_offset (beam search decode "
+                                  "cache reordering) is descoped")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention needs cache_kv "
+                         "([2, bsz, num_head, max_seq, head_dim])")
+
+    if sequence_lengths is None:
+        raise ValueError(
+            "sequence_lengths is required (int for an aligned batch, "
+            "[bsz] tensor for ragged positions)")
+    if not isinstance(sequence_lengths, (Tensor, int)):
+        # numpy array / list / jax array: normalize so the ragged
+        # detection below sees it (a raw [bsz] numpy array must route
+        # to the scatter path, not crash the aligned reshape)
+        from ...ops._dispatch import ensure_tensor
+
+        size = getattr(sequence_lengths, "size", None)
+        if size is None:
+            import numpy as _np
+
+            size = _np.asarray(sequence_lengths).size
+        if int(size) > 1:
+            sequence_lengths = ensure_tensor(sequence_lengths)
+    ragged = isinstance(sequence_lengths, Tensor) \
+        and sequence_lengths.size > 1
+
+    def f(xv, cache, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if bias is not None else None
+        mask = rest.pop(0) if src_mask is not None else None
+        pos = rest.pop(0) if ragged else None
+        _, b, nh, ms, d = cache.shape
+        if bv is not None:
+            xv = xv + bv.reshape(1, -1)
+        qkv = xv.reshape(b, 3, nh, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]         # [b, nh, d]
+        if ragged:
+            pos = pos.reshape(b).astype(jnp.int32)
+            iota_b = jnp.arange(b)
+            cache = cache.at[0, iota_b, :, pos].set(
+                k.astype(cache.dtype))
+            cache = cache.at[1, iota_b, :, pos].set(
+                v.astype(cache.dtype))
+        else:
+            p = jnp.asarray(_unwrap_pos(sequence_lengths),
+                            jnp.int32).reshape(())
+            z = jnp.int32(0)
+            upd = jnp.stack([k, v])[:, :, :, None].astype(cache.dtype)
+            cache = jax.lax.dynamic_update_slice(
+                cache, upd, (z, z, z, p, z))
+            pos = jnp.broadcast_to(p, (b,))
+        kc = cache[0].astype(jnp.float32)                  # [b, nh, ms, d]
+        vc = cache[1].astype(jnp.float32)
+        s = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
+                       kc) / (d ** 0.5)
+        visible = jnp.arange(ms)[None, :] <= pos[:, None]  # [b, ms]
+        if mask is not None:
+            # src_mask broadcastable to [b, 1, 1, max_seq] (reference
+            # contract): expand to rank 4, collapse the singleton
+            # middle dims and let the batch dim BROADCAST (a reshape
+            # to b would scramble a [1, 1, 1, ms] mask across rows)
+            mv = mask.astype(jnp.float32)
+            while mv.ndim < 4:
+                mv = mv[None]
+            mv = mv.reshape(mv.shape[0], 1, mv.shape[-1])
+            s = s + mv[:, :, :ms]
+        s = jnp.where(visible[:, None, :], s, -1e9)
+        p_attn = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhk,bhkd->bhd", p_attn, vc)
+        return out.reshape(b, nh * d).astype(xv.dtype), cache
+
+    args = [x, cache_kv]
+    for t in (bias, src_mask):
+        if t is not None:
+            args.append(t)
+    if ragged:
+        args.append(sequence_lengths)
+    return nary(f, args, "masked_multihead_attention")
 
 
-def block_multihead_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "block_multihead_attention (paged KV cache) belongs to the "
-        "inference deployment stack (descoped, docs/DECISIONS.md §4)")
+def _unwrap_pos(p):
+    from ...framework.tensor import Tensor
+
+    return p._data if isinstance(p, Tensor) else p
+
+
+def block_multihead_attention(qkv, key_cache, value_cache,
+                              seq_lens_encoder, seq_lens_decoder,
+                              seq_lens_this_time, padding_offsets=None,
+                              cum_offsets=None, cu_seqlens_q=None,
+                              cu_seqlens_k=None, block_tables=None,
+                              rope_emb=None, mask=None, tgt_mask=None,
+                              max_seq_len=-1, block_size=64,
+                              use_neox_style=False, **kwargs):
+    """Paged-KV attention over a mixed prefill/decode batch (reference
+    block_multihead_attention / blha; PAPERS.md "Ragged Paged
+    Attention" is the TPU-native shape of the same op).
+
+    qkv: [token_num, (num_head + 2*kv_num_head) * head_dim] — new tokens
+    of all sequences packed back to back (cu_seqlens_q: [bsz+1]
+    boundaries). Per sequence i, seq_lens_this_time[i] tokens arrive
+    this call (a prompt during prefill, 1 during decode, 0 = inactive
+    slot); they are written into the paged cache at logical positions
+    seq_lens_decoder[i] + t via block_tables[i], and each token attends
+    every cached key at position <= its own.
+
+    key_cache/value_cache: [max_block_num, kv_num_head, block_size,
+    head_dim] (reference layout); block_tables: [bsz,
+    max_blocks_per_seq] int32. Static-shape XLA formulation (gather +
+    masked attention + scatter with drop-mode for padding); the
+    TPU-optimal decode kernel is ops/pallas/paged_attention.py, which
+    the generation engine (jit/decode_step.py) calls directly.
+
+    Returns (out [token_num, num_head*head_dim], qkv, key_cache_out,
+    value_cache_out) — reference tuple, functional caches.
+    """
+    from ...ops._dispatch import nary
+
+    if rope_emb is not None:
+        raise NotImplementedError(
+            "apply fused_rotary_position_embedding before the op; the "
+            "in-kernel rotary path is not plumbed")
+    if block_tables is None or cu_seqlens_q is None:
+        raise ValueError("block_multihead_attention needs block_tables "
+                         "and cu_seqlens_q")
+
+    def f(qkv_v, kc, vc, enc_l, dec_l, this_l, cu_q, bt, *rest):
+        mask_v = rest[0] if rest else None
+        nblocks, kvh, bs, d = kc.shape
+        tok = qkv_v.shape[0]
+        nh = qkv_v.shape[1] // d - 2 * kvh
+        grp = nh // kvh
+        b = bt.shape[0]
+        qkv_h = qkv_v.reshape(tok, nh + 2 * kvh, d)
+        q = qkv_h[:, :nh]                                  # [tok, nh, d]
+        k_new = qkv_h[:, nh:nh + kvh]                      # [tok, kvh, d]
+        v_new = qkv_h[:, nh + kvh:]
+        # token -> (sequence, offset-in-call, cache position)
+        m = jnp.arange(tok, dtype=jnp.int32)
+        seq = jnp.clip(jnp.searchsorted(cu_q, m, side="right") - 1,
+                       0, b - 1).astype(jnp.int32)
+        t_off = m - cu_q[seq]
+        this = this_l[seq]
+        pos = dec_l[seq] + t_off                           # [tok]
+        valid = t_off < this
+        # paged write: flat pool index, padding rows dropped
+        blk = jnp.take_along_axis(
+            bt[seq], (pos // bs)[:, None], axis=1)[:, 0]
+        # padding rows scatter to nblocks*bs — GENUINELY out of bounds
+        # so mode="drop" discards them (-1 would wrap to the pool's
+        # last row before drop-mode applies and corrupt it)
+        flat = jnp.where(valid, blk * bs + pos % bs, nblocks * bs)
+
+        def wr(cache, upd):
+            # [nblocks, kvh, bs, d] -> token-major [nblocks*bs, kvh, d]
+            # for the scatter, then back to the reference pool layout
+            view = cache.swapaxes(1, 2).reshape(nblocks * bs, kvh, d)
+            view = view.at[flat].set(upd.astype(cache.dtype),
+                                     mode="drop")
+            return view.reshape(nblocks, bs, kvh, d).swapaxes(1, 2)
+
+        kc = wr(kc, k_new)
+        vc = wr(vc, v_new)
+        # densify each sequence's pages ONCE: [b, kvh, Lmax, d]
+        lmax = bt.shape[1] * bs
+        kd = jnp.moveaxis(kc[bt], 2, 1).reshape(b, kvh, lmax, d)
+        vd = jnp.moveaxis(vc[bt], 2, 1).reshape(b, kvh, lmax, d)
+        # scatter queries into a [b, T, ...] per-sequence dense view
+        # (T = token_num is a static per-sequence bound) so attention
+        # batches against kd/vd directly — a per-token kd[seq] gather
+        # would materialize T copies of the full context
+        # (O(T*Lmax*head_dim) HBM at serving shapes)
+        qg = q.reshape(tok, kvh, grp, d)
+        q_dense = jnp.zeros((b, tok, kvh, grp, d), qg.dtype) \
+            .at[seq, t_off].set(qg)
+        s = jnp.einsum("bthgd,bhld->bthgl",
+                       q_dense.astype(jnp.float32),
+                       kd.astype(jnp.float32)) / (d ** 0.5)
+        pos_dense = dec_l[:, None] + jnp.arange(
+            tok, dtype=jnp.int32)[None]                    # [b, T]
+        vis = jnp.arange(lmax)[None, None, :] \
+            <= pos_dense[:, :, None]                       # [b, T, L]
+        s = jnp.where(vis[:, :, None, None, :], s, -1e9)
+        if mask_v is not None:
+            # additive bias broadcastable to
+            # [b, tokens_this_call, kv_len] — broadcast, don't reshape
+            mv = mask_v.astype(jnp.float32)
+            while mv.ndim < 3:
+                mv = mv[None]
+            s = s + mv[:, :, None, None, :lmax]
+        p_attn = jax.nn.softmax(s, axis=-1)
+        out_dense = jnp.einsum("bthgl,bhld->bthgd", p_attn,
+                               vd.astype(jnp.float32))
+        out = out_dense[seq, t_off]                        # re-pack
+        out = jnp.where(valid[:, None, None, None], out, 0.0)
+        return (out.reshape(tok, nh * d).astype(qkv_v.dtype), qkv_v,
+                kc, vc)
+
+    args = [qkv, key_cache, value_cache, seq_lens_encoder,
+            seq_lens_decoder, seq_lens_this_time, cu_seqlens_q,
+            block_tables]
+    if mask is not None:
+        args.append(mask)
+    return nary(f, args, "block_multihead_attention")
 
 
 def variable_length_memory_efficient_attention(

@@ -1,0 +1,72 @@
+"""Hermetic decode-parity probe: paged == dense == full-sequence forward.
+
+Run as ``python -m paddle_tpu.inference.decode_selftest`` in a clean
+JAX_PLATFORMS=cpu subprocess (bench.py --selftest wires this through the
+same env-strip recipe as the host-mesh probes) and prints ONE JSON line:
+
+    {"check": "pass", "max_err_dense_vs_full": ..., ...}
+
+so every BENCH_r*.json records that the decode engine's three paths —
+dense cache (masked_multihead_attention fast path), paged cache (ragged
+paged attention), and the plain full-sequence forward — agree within
+fp32 tolerance, and that greedy generate is identical eager vs compiled.
+"""
+from __future__ import annotations
+
+import json
+
+
+def run_probe(tol=2e-4):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    rng = np.random.default_rng(0)
+    b, s, new = 2, 8, 4
+    ids = rng.integers(1, 64, (b, s))
+    ids_t = paddle.to_tensor(ids, dtype="int64")
+
+    out_d, log_d = m.generate(ids_t, max_new_tokens=new,
+                              use_cache="dense", return_logits=True)
+    out_p, log_p = m.generate(ids_t, max_new_tokens=new,
+                              use_cache="paged", return_logits=True)
+    out_e = m.generate(ids_t, max_new_tokens=new, use_cache="dense",
+                       compiled=False)
+    out_d = np.asarray(out_d._data)
+    log_d = np.asarray(log_d._data, np.float32)
+    log_p = np.asarray(log_p._data, np.float32)
+
+    err_full = 0.0
+    for i in range(b):
+        full = np.concatenate([ids[i], out_d[i][:-1]])
+        want = np.asarray(
+            m(paddle.to_tensor(full[None], dtype="int64"))._data,
+            np.float32)[0]
+        for t in range(new):
+            err_full = max(err_full, float(np.max(np.abs(
+                log_d[i, t] - want[s - 1 + t]))))
+    err_paged = float(np.max(np.abs(log_d - log_p)))
+    eager_ok = bool((out_d == np.asarray(out_e._data)).all())
+    paged_ok = bool((out_d == np.asarray(out_p._data)).all())
+
+    rec = {
+        "max_err_dense_vs_full_forward": err_full,
+        "max_err_paged_vs_dense": err_paged,
+        "greedy_eager_equals_compiled": eager_ok,
+        "paged_tokens_equal_dense": paged_ok,
+        "tol": tol,
+    }
+    ok = (err_full < tol and err_paged < tol and eager_ok and paged_ok)
+    rec["check"] = "pass" if ok else "FAIL: decode parity out of tol"
+    return rec
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_probe()))

@@ -1,0 +1,240 @@
+"""KV caches for the decode engine — dense per-slot and paged-pool forms.
+
+Two cache shapes back `GPTForCausalLM.generate()` (models/gpt.py) and the
+compiled decode step (jit/decode_step.py):
+
+* ``DenseKVCache`` — per layer ``[2, batch, num_heads, max_len,
+  head_dim]`` buffers (the reference `masked_multihead_attention`
+  cache_kv layout) with ONE shared write position. The aligned-batch
+  fast path: each decode step is a single ``dynamic_update_slice`` per
+  layer (no O(seq) concat, no scatter), which is what lets the jitted
+  step stay retrace-free with donated buffers.
+* ``PagedKVCache`` — the Ragged-Paged-Attention layout (PAPERS.md): per
+  layer K/V page pools ``[num_kv_heads, num_pages, page_size,
+  head_dim]`` (the ops/pallas/paged_attention.py contract) + per-slot
+  page tables and ragged ``seq_lens``. Slots allocate/free
+  independently (continuous batching): a finished sequence's pages
+  return to the pool while the rest of the batch keeps decoding, and
+  mixed-length batches waste no cache on padding.
+
+Device state lives in plain jnp arrays exposed via ``state()`` /
+``load_state()`` so the jitted decode step can thread (and donate) it as
+a pytree. Host-side bookkeeping (free lists, slot maps) never enters the
+trace — it only rewrites ``page_tables`` rows between steps, which is an
+ordinary input refresh, not a retrace.
+
+Page 0 of every pool is the **trash page**: ragged writes of padding /
+inactive-slot tokens are routed there so scatters stay static-shape with
+no masking branches. It is never mapped in any page table.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DenseKVCache", "PagedKVCache", "paged_write_decode",
+           "paged_write_prefill", "dense_write_prefill"]
+
+
+# ---------------------------------------------------------------------------
+# pure-jnp write helpers (used inside the jitted decode/prefill steps)
+# ---------------------------------------------------------------------------
+
+def dense_write_prefill(cache_l, k_new, v_new):
+    """Prompt K/V at positions [0, s) of one layer's dense cache.
+
+    cache_l: [2, b, nh, max_len, d]; k_new/v_new: [b, s, nh, d].
+    One dynamic-update-slice (static start)."""
+    upd = jnp.stack([jnp.swapaxes(k_new, 1, 2),
+                     jnp.swapaxes(v_new, 1, 2)]).astype(cache_l.dtype)
+    z = jnp.int32(0)
+    return jax.lax.dynamic_update_slice(cache_l, upd, (z, z, z, z, z))
+
+
+def _page_flat_index(page_tables, pos, page_size):
+    """Flat [num_pages * page_size) pool index of logical position `pos`
+    per slot; pos broadcast against page_tables rows."""
+    page = jnp.take_along_axis(page_tables, pos // page_size, axis=-1)
+    return page * page_size + pos % page_size
+
+
+def paged_write_decode(k_pages, v_pages, page_tables, seq_lens, active,
+                       k_new, v_new):
+    """One decode token per slot at its own ragged position seq_lens[i].
+
+    k_pages/v_pages: [kvh, num_pages, page_size, d] (one layer);
+    k_new/v_new: [b, kvh, d]; active: [b] bool — inactive slots write to
+    the trash page (page 0, never mapped, collisions are garbage-only).
+    Returns the updated pools. Scatter-based (positions differ per slot).
+    """
+    kvh, num_pages, page_size, d = k_pages.shape
+    flat = _page_flat_index(page_tables, seq_lens[:, None],
+                            page_size)[:, 0]                # [b]
+    flat = jnp.where(active, flat, seq_lens % page_size)    # page 0 trash
+
+    def wr(pool, upd):
+        view = pool.reshape(kvh, num_pages * page_size, d)
+        view = view.at[:, flat].set(
+            jnp.moveaxis(upd, 1, 0).astype(pool.dtype))
+        return view.reshape(pool.shape)
+
+    return wr(k_pages, k_new), wr(v_pages, v_new)
+
+
+def paged_write_prefill(k_pages, v_pages, page_tables, slot_ids,
+                        seq_lens_new, k_new, v_new, start=None):
+    """Prompt K/V for `len(slot_ids)` slots, token t of row i landing at
+    logical position start_i + t of slot slot_ids[i]; positions past
+    seq_lens_new[i] (right padding) go to the trash page.
+
+    k_new/v_new: [b, s, kvh, d] (padded); slot_ids/seq_lens_new: [b];
+    start: [b] int32 or None (0 = fresh prompt)."""
+    kvh, num_pages, page_size, d = k_pages.shape
+    b, s = k_new.shape[:2]
+    t = jnp.arange(s, dtype=jnp.int32)[None, :]             # [1, s]
+    pos = t if start is None else start[:, None] + t        # [b, s]
+    flat = _page_flat_index(page_tables[slot_ids], pos, page_size)
+    valid = pos < seq_lens_new[:, None]
+    flat = jnp.where(valid, flat, pos % page_size).reshape(-1)
+
+    def wr(pool, upd):
+        view = pool.reshape(kvh, num_pages * page_size, d)
+        view = view.at[:, flat].set(
+            jnp.moveaxis(upd, 2, 0).reshape(kvh, b * s, d)
+            .astype(pool.dtype))
+        return view.reshape(pool.shape)
+
+    return wr(k_pages, k_new), wr(v_pages, v_new)
+
+
+# ---------------------------------------------------------------------------
+# cache objects: device state + host bookkeeping
+# ---------------------------------------------------------------------------
+
+class DenseKVCache:
+    """Aligned-batch dense cache: shared write position, one DUS/layer."""
+
+    kind = "dense"
+
+    def __init__(self, num_layers, batch, max_len, num_heads, head_dim,
+                 dtype=jnp.float32):
+        self.num_layers = num_layers
+        self.batch = batch
+        self.max_len = max_len
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        shape = (2, batch, num_heads, max_len, head_dim)
+        self.layers = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.pos = jnp.zeros((), jnp.int32)     # tokens already cached
+
+    def layer(self, l):
+        return self.layers[l]
+
+    def set_layer(self, l, value):
+        self.layers[l] = value
+
+    def state(self):
+        return {"layers": list(self.layers), "pos": self.pos}
+
+    def load_state(self, state):
+        self.layers = list(state["layers"])
+        self.pos = state["pos"]
+
+
+class PagedKVCache:
+    """Paged pools + page tables + ragged lengths + slot bookkeeping.
+
+    Host-side: `allocate(prompt_len)` claims a slot and maps enough
+    pages; `reserve(slot, total_len)` maps more as decoding grows a
+    sequence; `free(slot)` returns its pages to the pool. Device-side
+    state (pools, tables, seq_lens, active) threads through the jitted
+    step; only the jitted step mutates seq_lens/pools, only the host
+    bookkeeping mutates page_tables/active.
+    """
+
+    kind = "paged"
+
+    def __init__(self, num_layers, num_kv_heads, head_dim, num_pages,
+                 page_size, max_slots, pages_per_seq,
+                 dtype=jnp.float32):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the trash page)")
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.pages_per_seq = pages_per_seq
+        shape = (num_kv_heads, num_pages, page_size, head_dim)
+        self.k_layers = [jnp.zeros(shape, dtype)
+                         for _ in range(num_layers)]
+        self.v_layers = [jnp.zeros(shape, dtype)
+                         for _ in range(num_layers)]
+        self.page_tables = jnp.zeros((max_slots, pages_per_seq),
+                                     jnp.int32)
+        self.seq_lens = jnp.zeros((max_slots,), jnp.int32)
+        self.active = jnp.zeros((max_slots,), bool)
+        # host bookkeeping — page 0 reserved as trash
+        self._free_pages = list(range(num_pages - 1, 0, -1))
+        self._free_slots = list(range(max_slots - 1, -1, -1))
+        self._slot_pages: dict[int, list[int]] = {}
+
+    # -- host bookkeeping ------------------------------------------------
+    @property
+    def free_page_count(self):
+        return len(self._free_pages)
+
+    def allocate(self, prompt_len: int) -> int:
+        """Claim a slot with pages covering `prompt_len` tokens."""
+        if not self._free_slots:
+            raise RuntimeError("no free cache slots (batch full)")
+        slot = self._free_slots.pop()
+        self._slot_pages[slot] = []
+        self.seq_lens = jnp.asarray(self.seq_lens).at[slot].set(0)
+        self.active = jnp.asarray(self.active).at[slot].set(True)
+        try:
+            self.reserve(slot, prompt_len)
+        except RuntimeError:
+            self.free(slot)
+            raise
+        return slot
+
+    def reserve(self, slot: int, total_len: int):
+        """Map pages so slot `slot` can hold `total_len` tokens."""
+        pages = self._slot_pages[slot]
+        need = -(-int(total_len) // self.page_size)   # ceil
+        if need > self.pages_per_seq:
+            raise RuntimeError(
+                f"sequence of {total_len} tokens exceeds pages_per_seq="
+                f"{self.pages_per_seq} * page_size={self.page_size}")
+        while len(pages) < need:
+            if not self._free_pages:
+                raise RuntimeError("KV page pool exhausted")
+            page = self._free_pages.pop()
+            self.page_tables = jnp.asarray(self.page_tables).at[
+                slot, len(pages)].set(page)
+            pages.append(page)
+
+    def free(self, slot: int):
+        """Return the slot's pages to the pool (continuous batching)."""
+        pages = self._slot_pages.pop(slot, [])
+        self._free_pages.extend(reversed(pages))
+        self._free_slots.append(slot)
+        self.page_tables = jnp.asarray(self.page_tables).at[slot].set(0)
+        self.seq_lens = jnp.asarray(self.seq_lens).at[slot].set(0)
+        self.active = jnp.asarray(self.active).at[slot].set(False)
+
+    # -- device state ------------------------------------------------------
+    def state(self):
+        return {"k_layers": list(self.k_layers),
+                "v_layers": list(self.v_layers),
+                "page_tables": self.page_tables,
+                "seq_lens": self.seq_lens, "active": self.active}
+
+    def load_state(self, state):
+        self.k_layers = list(state["k_layers"])
+        self.v_layers = list(state["v_layers"])
+        self.page_tables = state["page_tables"]
+        self.seq_lens = state["seq_lens"]
+        self.active = state["active"]

@@ -20,10 +20,12 @@ from ..framework.tensor import Tensor
 from . import dy2static
 from .train_step import TrainStep, _tree_data, _tree_wrap
 from .fused_scan_step import FusedScanTrainStep
+from .decode_step import DecodeStep, GenerationEngine, PrefillStep
 
-__all__ = ["to_static", "TrainStep", "FusedScanTrainStep", "not_to_static",
-           "ignore_module", "save", "load", "enable_to_static",
-           "set_code_level", "set_verbosity"]
+__all__ = ["to_static", "TrainStep", "FusedScanTrainStep",
+           "GenerationEngine", "DecodeStep", "PrefillStep",
+           "not_to_static", "ignore_module", "save", "load",
+           "enable_to_static", "set_code_level", "set_verbosity"]
 
 
 class StaticFunction:

@@ -1,0 +1,392 @@
+"""Compiled, retrace-free generation: prefill/decode split over a KV cache.
+
+The serving-side sibling of train_step.py: the eager dygraph decode step
+(embedding, N cached-attention blocks, LM head, sampling) is traced ONCE
+into a jitted function over a (params, cache-state) pytree and then
+executed as one fused XLA program per generated token, with the big KV
+buffers DONATED so steady-state decoding is allocation-free. Everything
+that varies per step — the token ids, the write position, the RNG key —
+is a traced input, so nothing retraces and nothing recompiles after the
+first step (the `trace_count` probe asserts exactly that in tests).
+
+Prefill is the separate compile: the prompt is padded to a length
+BUCKET (powers-of-two by default) and run through the full causal
+forward (the flash/SDPA path) once while every layer's K/V is written
+into the cache. jax.jit's shape-keyed executable cache gives one
+program per bucket; the true prompt length is a traced scalar/vector,
+so any prompt inside a bucket reuses its program.
+
+Cache state is threaded as TWO pytrees: the KV pool buffers (donated —
+they are the HBM-dominant part and are consumed functionally every
+step) and the small metadata (positions, page tables, seq_lens — NOT
+donated, because the host-side continuous-batching bookkeeping reads
+and rewrites page tables between steps and a donated buffer would be
+dead by then).
+
+Two cache shapes (inference/kv_cache.py): "dense" (aligned batch, one
+dynamic_update_slice per layer per step) and "paged" (ragged seq_lens +
+page-pool cache in the Ragged-Paged-Attention layout, slot allocate/
+free continuous-batching bookkeeping on the host side).
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.autograd import no_grad
+from ..framework.tensor import Tensor
+from ..nn.functional.sampling import sample_logits
+from .train_step import _tree_data, _tree_wrap
+
+__all__ = ["GenerationEngine", "DecodeStep", "PrefillStep",
+           "DEFAULT_PREFILL_BUCKETS"]
+
+DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+
+_BUFFER_KEYS = {"dense": ("layers",), "paged": ("k_layers", "v_layers")}
+
+
+def _legacy_jax():
+    return getattr(sys.modules.get("paddle_tpu"), "jax_compat_legacy",
+                   False)
+
+
+def _split_state(kind, state):
+    buf_keys = _BUFFER_KEYS[kind]
+    return ({k: state[k] for k in buf_keys},
+            {k: v for k, v in state.items() if k not in buf_keys})
+
+
+class _Step:
+    """Shared machinery: trace counting, jit/eager dispatch, donation."""
+
+    def __init__(self, engine, donate_cache):
+        self.engine = engine
+        # donation is a pure perf lever; the legacy jaxlib (0.4.x CPU)
+        # corrupts donated buffers under real program sizes (see
+        # TrainStep), so it is forced off there
+        self._donate = (donate_cache and engine.compiled
+                        and not _legacy_jax())
+        self._jitted = None
+        self.trace_count = 0   # traces when compiled, calls when eager
+
+    def _fn(self, *args):
+        raise NotImplementedError
+
+    def cache_size(self):
+        """Number of compiled executables (jax.jit's cache), -1 when the
+        runtime does not expose it."""
+        if self._jitted is None:
+            return 0
+        try:
+            return self._jitted._cache_size()
+        except Exception:
+            return -1
+
+    def lowered_text(self, *args):
+        """StableHLO/HLO text of the step for the given example args
+        (compile-guard tests grep this for dynamic-update-slice).
+        Traces a fresh copy — neither the live jit cache nor the
+        trace_count probe is affected."""
+        saved = self.trace_count
+        try:
+            return jax.jit(self._fn).lower(*args).as_text()
+        finally:
+            self.trace_count = saved
+
+    def __call__(self, *args):
+        if not self.engine.compiled:
+            return self._fn(*args)
+        if self._jitted is None:
+            self._jitted = jax.jit(
+                self._fn,
+                donate_argnums=(1,) if self._donate else ())
+        return self._jitted(*args)
+
+    # -- shared step body helpers ---------------------------------------
+    def _enter(self, params, buffers, meta):
+        eng = self.engine
+        for p, d in zip(eng._params, params):
+            p._data = d
+        eng.cache.load_state(_tree_wrap({**buffers, **meta}))
+
+    def _exit_state(self):
+        """Read back + split the cache state produced by the step."""
+        return _split_state(self.engine.kind,
+                            _tree_data(self.engine.cache.state()))
+
+    def _sample(self, logits, key):
+        eng = self.engine
+        if eng.do_sample:
+            key, sub = jax.random.split(key)
+            ids = sample_logits(logits, key=sub,
+                                temperature=eng.temperature,
+                                top_k=eng.top_k, top_p=eng.top_p)
+        else:
+            ids = sample_logits(logits, key=None)
+        return ids, key
+
+
+class _BindCtx:
+    """Snapshot the live params/cache for the duration of one trace and
+    restore the concrete state after (a tracing error must not leave
+    tracers bound in the model — same contract as TrainStep)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def __enter__(self):
+        eng = self.engine
+        self._saved_params = [p._data for p in eng._params]
+        self._saved_cache = eng.cache.state()
+        return self
+
+    def __exit__(self, *exc):
+        eng = self.engine
+        for p, d in zip(eng._params, self._saved_params):
+            p._data = d
+        eng.cache.load_state(self._saved_cache)
+        return False
+
+
+class PrefillStep(_Step):
+    """Bucketed prompt pass: write all layers' K/V, sample token 0."""
+
+    def _fn(self, params, buffers, meta, ids, lens, slot_ids, key):
+        self.trace_count += 1
+        eng = self.engine
+        with no_grad(), _BindCtx(eng):
+            self._enter(params, buffers, meta)
+            cache = eng.cache
+            b = ids.shape[0]
+            lens_b = jnp.broadcast_to(lens.reshape(-1), (b,)) \
+                .astype(jnp.int32)
+            hidden = eng.model.gpt.prefill(
+                Tensor._wrap(ids), cache,
+                seq_lens=Tensor._wrap(lens_b),
+                slot_ids=Tensor._wrap(slot_ids))
+            # last VALID position per row (traced -> bucket-stable)
+            last = jnp.take_along_axis(
+                hidden._data, (lens_b - 1)[:, None, None]
+                .astype(jnp.int32), axis=1)[:, 0]        # [b, h]
+            logits = eng.model.head(Tensor._wrap(last))._data
+            if cache.kind == "dense":
+                cache.pos = Tensor._wrap(
+                    lens.reshape(()).astype(jnp.int32))
+            else:
+                sl = _data_of(cache.seq_lens)
+                cache.seq_lens = Tensor._wrap(
+                    sl.at[slot_ids].set(lens_b))
+            ids_next, key = self._sample(logits, key)
+            new_buffers, new_meta = self._exit_state()
+        return ids_next, logits, new_buffers, new_meta, key
+
+
+class DecodeStep(_Step):
+    """One-token cached decode step — compiled once, donated KV pools."""
+
+    def _fn(self, params, buffers, meta, tokens, key):
+        self.trace_count += 1
+        eng = self.engine
+        with no_grad(), _BindCtx(eng):
+            self._enter(params, buffers, meta)
+            cache = eng.cache
+            b = tokens.shape[0]
+            if cache.kind == "dense":
+                pos_ids = jnp.broadcast_to(
+                    _data_of(cache.pos).reshape(1, 1),
+                    (b, 1)).astype(jnp.int32)
+            else:
+                pos_ids = _data_of(cache.seq_lens)[:, None] \
+                    .astype(jnp.int32)
+            hidden = eng.model.gpt.decode_step(
+                Tensor._wrap(tokens.reshape(b, 1)), cache,
+                Tensor._wrap(pos_ids))
+            logits = eng.model.head(hidden)._data[:, 0]   # [b, vocab]
+            # advance the write positions
+            if cache.kind == "dense":
+                cache.pos = Tensor._wrap(_data_of(cache.pos) + 1)
+            else:
+                sl = _data_of(cache.seq_lens)
+                act = _data_of(cache.active)
+                cache.seq_lens = Tensor._wrap(
+                    jnp.where(act, sl + 1, sl))
+            ids_next, key = self._sample(logits, key)
+            new_buffers, new_meta = self._exit_state()
+        return ids_next, logits, new_buffers, new_meta, key
+
+
+def _data_of(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+class GenerationEngine:
+    """Prefill + decode orchestration over one (model, cache) pair.
+
+    Construction picks the cache shape; `generate()` runs prompt ->
+    tokens end to end. The jitted steps live on the engine, so holding
+    an engine (models cache them per signature, GPTForCausalLM.generate)
+    means steady-state decoding never retraces or recompiles.
+    """
+
+    def __init__(self, model, kind="dense", batch=1, max_len=128,
+                 do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
+                 compiled=True, cache_dtype=None, page_size=16,
+                 prefill_buckets=DEFAULT_PREFILL_BUCKETS, donate=True):
+        cfg = model.config
+        model.gpt._check_decodable()
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len={max_len} exceeds max_position_embeddings="
+                f"{cfg.max_position_embeddings}")
+        self.model = model
+        self.kind = kind
+        self.batch = batch
+        self.max_len = max_len
+        self.do_sample = bool(do_sample)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.temperature = float(temperature)
+        self.compiled = bool(compiled)
+        # buckets must COVER max_len: a prompt between the largest
+        # power-of-two bucket and max_len is within capacity and must
+        # not fall through _bucket()
+        buckets = tuple(sorted(bkt for bkt in prefill_buckets
+                               if bkt <= max_len))
+        if not buckets or buckets[-1] < max_len:
+            buckets = buckets + (max_len,)
+        self.prefill_buckets = buckets
+        self._params = list(model.parameters())
+        if kind not in ("dense", "paged"):
+            raise ValueError(f"unknown cache kind {kind!r}")
+        self._cache_dtype = cache_dtype or jnp.float32
+        self._page_size = page_size
+        self.cache = self._make_cache()
+        self.prefill_step = PrefillStep(self, donate_cache=donate)
+        self.decode_step = DecodeStep(self, donate_cache=donate)
+
+    def _make_cache(self):
+        """Fresh cache with this engine's geometry — also the recovery
+        path when a failed generate leaves donated buffers dead."""
+        from ..inference.kv_cache import DenseKVCache, PagedKVCache
+
+        cfg = self.model.config
+        nh = cfg.num_attention_heads
+        hd = cfg.hidden_size // nh
+        if self.kind == "dense":
+            return DenseKVCache(cfg.num_layers, self.batch,
+                                self.max_len, nh, hd,
+                                dtype=self._cache_dtype)
+        pages_per_seq = -(-self.max_len // self._page_size)
+        return PagedKVCache(
+            cfg.num_layers, nh, hd,
+            num_pages=1 + self.batch * pages_per_seq,
+            page_size=self._page_size, max_slots=self.batch,
+            pages_per_seq=pages_per_seq, dtype=self._cache_dtype)
+
+    # -- helpers ---------------------------------------------------------
+    def _bucket(self, s):
+        for bkt in self.prefill_buckets:
+            if bkt >= s:
+                return bkt
+        raise ValueError(
+            f"prompt length {s} exceeds the largest prefill bucket "
+            f"{self.prefill_buckets[-1]} (max_len {self.max_len})")
+
+    def _param_data(self):
+        return [p._data for p in self._params]
+
+    def generate(self, input_ids, max_new_tokens, seq_lens=None,
+                 eos_token_id=None, seed=None, return_logits=False):
+        """input_ids: [batch, prompt] int array (right-padded when
+        `seq_lens` gives ragged true lengths — paged cache only).
+        Returns int32 Tensor [batch, max_new_tokens] (plus the per-step
+        logits [batch, max_new_tokens, vocab] when return_logits)."""
+        ids = np.asarray(input_ids)
+        b, s = ids.shape
+        if b != self.batch:
+            raise ValueError(f"engine batch {self.batch}, got {b}")
+        if s + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {s} + {max_new_tokens} new tokens exceeds the "
+                f"engine max_len {self.max_len}")
+        cache = self.cache
+        lens = (np.full((b,), s, np.int32) if seq_lens is None
+                else np.asarray(seq_lens, np.int32).reshape(b))
+        slots = list(range(b))
+        if self.kind == "dense":
+            if len(set(lens.tolist())) > 1:
+                raise ValueError(
+                    "the dense cache needs an aligned batch (one shared "
+                    "prompt length); use use_cache='paged' for ragged "
+                    "prompts")
+            cache.pos = jnp.zeros((), jnp.int32)
+            lens_in = jnp.asarray(lens[0], jnp.int32)
+        else:
+            # fresh slots for this batch (continuous-batching entry)
+            for slot in list(cache._slot_pages):
+                cache.free(slot)
+            slots = [cache.allocate(int(L)) for L in lens]
+            lens_in = jnp.asarray(lens, jnp.int32)
+        slot_arr = jnp.asarray(slots, jnp.int32)
+
+        bucket = self._bucket(s)
+        if bucket > s:
+            ids = np.concatenate(
+                [ids, np.zeros((b, bucket - s), ids.dtype)], axis=1)
+        if seed is None:
+            # draw from the framework RNG stream (eager sampling
+            # semantics): repeated sampled generates must differ
+            from ..framework import random as _random
+
+            key = _random.next_key()
+        else:
+            key = jax.random.PRNGKey(int(seed))
+        buffers, meta = _split_state(self.kind,
+                                     _tree_data(cache.state()))
+        try:
+            tok, logits, buffers, meta, key = self.prefill_step(
+                self._param_data(), buffers, meta, jnp.asarray(ids),
+                lens_in, slot_arr, key)
+            toks, logit_steps = [tok], [logits]
+            cur = lens.copy()
+            for _ in range(int(max_new_tokens) - 1):
+                if self.kind == "paged":
+                    # grow page tables on demand (host bookkeeping;
+                    # the device table is just a refreshed input, not
+                    # a retrace)
+                    for j, slot in enumerate(slots):
+                        cache.reserve(slot, int(cur[j]) + 1)
+                    meta["page_tables"] = cache.page_tables
+                tok, logits, buffers, meta, key = self.decode_step(
+                    self._param_data(), buffers, meta, tok, key)
+                toks.append(tok)
+                if return_logits:
+                    logit_steps.append(logits)
+                cur += 1
+            cache.load_state({**buffers, **meta})
+        except BaseException:
+            # the steps DONATE the KV buffers, and the model keeps this
+            # engine cached — an abort mid-loop would leave the cache
+            # pointing at consumed buffers, so rebuild it pristine
+            self.cache = self._make_cache()
+            raise
+        if self.kind == "paged":
+            for slot in slots:
+                cache.free(slot)
+        out = np.stack([np.asarray(t) for t in toks], axis=1)
+        if eos_token_id is not None:
+            done = np.zeros((b,), bool)
+            for t in range(out.shape[1]):
+                out[done, t] = eos_token_id
+                done |= out[:, t] == eos_token_id
+        out_t = Tensor._wrap(jnp.asarray(out.astype(np.int32)))
+        if return_logits:
+            logits_arr = np.stack(
+                [np.asarray(lg, np.float32) for lg in logit_steps],
+                axis=1)
+            return out_t, Tensor._wrap(jnp.asarray(logits_arr))
+        return out_t

@@ -410,12 +410,43 @@ def _ctx_load_guarded(names):
 # `return`, and returns/breaks/continues appear inside if/while/for
 # bodies. Functions mixing valued returns with an implicit fall-off-None
 # are left to the eager-fallback path (their two return structures can't
-# stage into one program). Known deviation: `break` inside a converted
-# for-range gates the remaining iterations to no-ops instead of
-# terminating the counter, so the loop var's final value differs.
+# stage into one program).
+#
+# Loop-var fidelity (ADVICE r5): a gated `for` still runs its iterator
+# to completion, so the loop target would end at the LAST iterated value
+# instead of the break-time value. Each stop-flagged `for` therefore
+# snapshots its target(s) at the top of the gated body (__d2sf_lvN_*)
+# and restores them after the loop — post-loop reads now match eager
+# Python. The snapshot slots ride the same `ph` zero-fill as __d2sf_rv
+# (they too are only MEANINGFULLY consumed on paths that assigned them;
+# a zero-trip tensor loop reads back the zero-fill, where eager Python
+# would raise NameError on an unbound loop var — loud either way for
+# the python-loop path, which restores the UNDEF sentinel).
 # ---------------------------------------------------------------------------
 
 _RET, _RV = "__d2sf_ret", "__d2sf_rv"
+_LV = "__d2sf_lv"
+
+
+def _loop_target_names(target):
+    """The plain Names a for-loop target binds, or None when the
+    target is fancier (starred/attribute/subscript)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, ast.Tuple) and all(
+            isinstance(e, ast.Name) for e in target.elts):
+        return [e.id for e in target.elts]
+    return None
+
+
+def _load_call(name):
+    """`_jst._load(lambda: name)` — reads tolerating unboundness."""
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
+                           attr="_load", ctx=ast.Load()),
+        args=[ast.Lambda(args=_EMPTY_ARGS,
+                         body=ast.Name(name, ast.Load()))],
+        keywords=[])
 
 
 def _assign(name, value_node):
@@ -535,8 +566,9 @@ class _FlagLower:
 
     def _loop(self, s, outer_loop):
         self.n += 1
-        brk = f"__d2sf_brk{self.n}"
-        cont = f"__d2sf_cont{self.n}"
+        n = self.n   # _block below recurses and bumps self.n for
+        brk = f"__d2sf_brk{n}"      # nested loops: every name of THIS
+        cont = f"__d2sf_cont{n}"    # loop must use the entry value
         body, sets = self._block(s.body, loop=(brk, cont))
         pre, inner_stop = [], []
         if brk in sets:
@@ -572,8 +604,19 @@ class _FlagLower:
             return pre + [ast.While(test=test, body=body,
                                     orelse=[])] + post, escape
         # for: gate the body on the stop flags instead of cutting the
-        # iteration (see the deviation note in the section comment)
+        # iteration; snapshot the loop target(s) at the top of the gated
+        # body and restore after the loop, so post-loop reads see the
+        # break-time value like eager Python (section comment)
         if inner_stop:
+            names = _loop_target_names(s.target)
+            if names:
+                snaps = [f"{_LV}{n}_{j}" for j in range(len(names))]
+                pre += [_assign(sn, _load_call(n))
+                        for n, sn in zip(names, snaps)]
+                body = [_assign(sn, ast.Name(n, ast.Load()))
+                        for n, sn in zip(names, snaps)] + body
+                post = [_assign(n, ast.Name(sn, ast.Load()))
+                        for n, sn in zip(names, snaps)] + post
             body = [ast.If(test=_not_flags(inner_stop), body=body,
                            orelse=[])]
         return pre + [ast.For(target=s.target, iter=s.iter, body=body,
@@ -638,7 +681,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
         tfn = _make_branch_fn(tname, carried, body)
         ffn = _make_branch_fn(
             fname, carried, orelse or [ast.Pass()])
-        ph = tuple(j for j, n in enumerate(carried) if n == _RV)
+        ph = tuple(j for j, n in enumerate(carried)
+                   if n == _RV or n.startswith(_LV))
         call = ast.Call(
             func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
                                attr="convert_ifelse", ctx=ast.Load()),
@@ -667,7 +711,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
         cfn = _make_branch_fn(cname, carried, [])
         cfn.body[-1] = ast.Return(test)  # return COND instead of ctx
         bfn = _make_branch_fn(bname, carried, body)
-        ph = tuple(j for j, n in enumerate(carried) if n == _RV)
+        ph = tuple(j for j, n in enumerate(carried)
+                   if n == _RV or n.startswith(_LV))
         call = ast.Call(
             func=ast.Attribute(value=ast.Name(_JST, ast.Load()),
                                attr="convert_while", ctx=ast.Load()),

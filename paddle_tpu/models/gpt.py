@@ -111,6 +111,85 @@ class GPTAttention(nn.Layer):
                                               causal=True),
             [q, k, v], name="ring_attention")
 
+    def forward_prefill(self, x, cache, layer_idx, seq_lens=None,
+                        slot_ids=None):
+        """Prompt pass: causal self-attention (the flash/SDPA prefill
+        path) + write this layer's K/V into the decode cache.
+
+        x: [b, s, h] post-LN prompt hiddens (right-padded for ragged
+        batches — padding K/V goes to the paged trash page; the dense
+        cache overwrites its tail before any decode step can attend it).
+        """
+        from ..inference import kv_cache as _kv
+        from ..ops._dispatch import nary
+
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape(
+            [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        if cache.kind == "dense":
+            cache.set_layer(layer_idx, nary(
+                _kv.dense_write_prefill, [cache.layer(layer_idx), k, v],
+                "dense_prefill_write"))
+        else:
+            new_k, new_v = nary(
+                _kv.paged_write_prefill,
+                [cache.k_layers[layer_idx], cache.v_layers[layer_idx],
+                 cache.page_tables, slot_ids, seq_lens, k, v],
+                "paged_prefill_write")
+            cache.k_layers[layer_idx] = new_k
+            cache.v_layers[layer_idx] = new_v
+        return self.out_proj(out.reshape([b, s, h]))
+
+    def forward_decode(self, x, cache, layer_idx):
+        """One-token decode step over the cache.
+
+        Dense: the real `incubate.nn.functional.masked_multihead_
+        attention` — fused qkv in, ONE dynamic_update_slice cache
+        append, masked attention over the cache. Paged: scatter the
+        token into this layer's page pool and run the ragged paged
+        attention kernel (ops/pallas/paged_attention.py — Pallas on
+        TPU, XLA gather elsewhere).
+        """
+        import jax.numpy as jnp
+
+        from ..inference import kv_cache as _kv
+        from ..ops._dispatch import nary
+        from ..ops.pallas.paged_attention import paged_attention
+
+        b, _, h = x.shape
+        if cache.kind == "dense":
+            from ..incubate.nn import functional as IF
+
+            qkv_flat = self.qkv(x).reshape([b, 3 * h])
+            out, new_l = IF.masked_multihead_attention(
+                qkv_flat, cache.layer(layer_idx),
+                sequence_lengths=cache.pos)
+            cache.set_layer(layer_idx, new_l)
+            return self.out_proj(out.reshape([b, 1, h]))
+
+        qkv = self.qkv(x).reshape(
+            [b, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [b, nh, hd]
+
+        def step(qq, kk, vv, kp, vp, pt, sl, act):
+            kp2, vp2 = _kv.paged_write_decode(kp, vp, pt, sl, act,
+                                              kk, vv)
+            lens = jnp.where(act, sl + 1, 0)
+            o = paged_attention(qq, kp2, vp2, pt, lens)
+            return o, kp2, vp2
+
+        out, new_k, new_v = nary(
+            step, [q, k, v, cache.k_layers[layer_idx],
+                   cache.v_layers[layer_idx], cache.page_tables,
+                   cache.seq_lens, cache.active],
+            "paged_decode_attention")
+        cache.k_layers[layer_idx] = new_k
+        cache.v_layers[layer_idx] = new_v
+        return self.out_proj(out.reshape([b, 1, h]))
+
     def forward(self, x):
         b, s, h = x.shape
         qkv = self.qkv(x)                              # [b, s, 3h]
@@ -172,6 +251,18 @@ class GPTBlock(nn.Layer):
             return recompute(self._inner, x,
                              policy=self._recompute_policy)
         return self._inner(x)
+
+    # -- decode-engine paths (inference: no dropout, cache-backed attn) --
+    def forward_prefill(self, x, cache, layer_idx, seq_lens=None,
+                        slot_ids=None):
+        x = x + self.attn.forward_prefill(self.ln_1(x), cache, layer_idx,
+                                          seq_lens=seq_lens,
+                                          slot_ids=slot_ids)
+        return x + self.mlp(self.ln_2(x))
+
+    def forward_decode(self, x, cache, layer_idx):
+        x = x + self.attn.forward_decode(self.ln_1(x), cache, layer_idx)
+        return x + self.mlp(self.ln_2(x))
 
 
 class GPTStackedBlocks(nn.Layer):
@@ -302,6 +393,40 @@ class GPTModel(nn.Layer):
                 x = block(x)
         return self.ln_f(x)
 
+    def _check_decodable(self):
+        if self.config.scan_layers:
+            raise NotImplementedError(
+                "generate()/decode over scan_layers=True models is not "
+                "plumbed (the stacked-param scan body has no per-layer "
+                "cache slot yet); build the model with "
+                "scan_layers=False for serving")
+
+    def prefill(self, input_ids, cache, seq_lens=None, slot_ids=None):
+        """Prompt pass writing every layer's K/V into `cache`.
+
+        input_ids: [b, s] (right-padded to the engine's length bucket);
+        seq_lens: true prompt lengths — a 0-d/py int for the aligned
+        dense cache, [b] for the ragged paged cache. Returns the full
+        [b, s, hidden] hiddens (caller gathers the last valid position).
+        """
+        self._check_decodable()
+        b, s = input_ids.shape
+        position_ids = C.arange(0, s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        for l, block in enumerate(self.blocks):
+            x = block.forward_prefill(x, cache, l, seq_lens=seq_lens,
+                                      slot_ids=slot_ids)
+        return self.ln_f(x)
+
+    def decode_step(self, tokens, cache, position_ids):
+        """One cached decode step: tokens [b, 1] -> hiddens [b, 1, h].
+        The caller owns advancing cache.pos / cache.seq_lens."""
+        self._check_decodable()
+        x = self.wte(tokens) + self.wpe(position_ids)
+        for l, block in enumerate(self.blocks):
+            x = block.forward_decode(x, cache, l)
+        return self.ln_f(x)
+
 
 class GPTForCausalLM(nn.Layer):
     """GPT + LM head; forward returns logits, `loss()` the CE training loss."""
@@ -317,14 +442,84 @@ class GPTForCausalLM(nn.Layer):
                                      bias_attr=False)
 
     def forward(self, input_ids, position_ids=None):
-        hidden = self.gpt(input_ids, position_ids)
+        return self.head(self.gpt(input_ids, position_ids))
+
+    def head(self, hidden):
+        """LM head over hiddens [..., hidden] -> logits [..., vocab]."""
         if self.lm_head is None:
             from .. import ops
 
-            logits = ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+            return ops.matmul(hidden, self.gpt.wte.weight,
+                              transpose_y=True)
+        return self.lm_head(hidden)
+
+    def generate(self, input_ids, max_new_tokens=20, seq_lens=None,
+                 use_cache="dense", do_sample=False, top_k=0, top_p=1.0,
+                 temperature=1.0, seed=None, eos_token_id=None,
+                 compiled=True, return_logits=False, **engine_kwargs):
+        """Autoregressive generation with a prefill/decode split.
+
+        Prefill pads the prompt to a length bucket and runs the full
+        causal forward (flash path) once, writing the KV cache; decode
+        then runs a jitted single-token step with donated cache buffers
+        — compiled exactly once per engine (retrace-free steady state).
+
+        use_cache: "dense" (aligned batch, one dynamic_update_slice per
+        layer) or "paged" (ragged seq_lens + page-pool cache, the
+        Ragged-Paged-Attention serving shape). `seq_lens` gives ragged
+        true prompt lengths for right-padded `input_ids` (paged only).
+        do_sample enables temperature/top-k/top-p sampling; otherwise
+        greedy. Returns int32 Tensor [batch, max_new_tokens].
+
+        Engines are cached on the model per (cache kind, batch,
+        lengths, sampling, compiled) signature, so repeated calls reuse
+        the compiled steps.
+        """
+        from ..jit.decode_step import GenerationEngine
+
+        ids = input_ids.numpy() if hasattr(input_ids, "numpy") \
+            else input_ids
+        import numpy as _np
+
+        ids = _np.asarray(ids)
+        b, s = ids.shape
+        # round the cache capacity up to a shared granularity so nearby
+        # (prompt, max_new) shapes REUSE one engine (one KV cache + one
+        # compiled decode step) instead of keying an engine per exact
+        # length; capped at the position-embedding capacity
+        need = s + int(max_new_tokens)
+        cap = self.config.max_position_embeddings
+        max_len = min(cap, -(-need // 64) * 64)
+        if need > cap:
+            raise ValueError(
+                f"prompt {s} + {max_new_tokens} new tokens exceeds "
+                f"max_position_embeddings={cap}")
+        # the param-structure fingerprint keeps a stale engine from
+        # surviving a weight swap (e.g. quantize_for_decode): same
+        # sampling signature, different parameter set -> new engine
+        struct = hash(tuple((n, str(p.dtype), tuple(p.shape))
+                            for n, p in self.named_parameters()))
+        key = (use_cache, b, max_len, bool(do_sample), int(top_k),
+               float(top_p), float(temperature), bool(compiled), struct,
+               tuple(sorted(engine_kwargs.items())))
+        engines = self.__dict__.setdefault("_generation_engines", {})
+        engine = engines.pop(key, None)
+        if engine is not None:
+            engines[key] = engine   # LRU refresh: hits move to the end
         else:
-            logits = self.lm_head(hidden)
-        return logits
+            engine = GenerationEngine(
+                self, kind=use_cache, batch=b, max_len=max_len,
+                do_sample=do_sample, top_k=top_k, top_p=top_p,
+                temperature=temperature, compiled=compiled,
+                **engine_kwargs)
+            engines[key] = engine
+            # bound the cache: each engine owns KV buffers + compiled
+            # programs; evict oldest beyond a small working set
+            while len(engines) > 4:
+                engines.pop(next(iter(engines)))
+        return engine.generate(ids, max_new_tokens, seq_lens=seq_lens,
+                               eos_token_id=eos_token_id, seed=seed,
+                               return_logits=return_logits)
 
     def sharding_rules(self, tp_axis="mp", fsdp_axis=None):
         """Advertise the Megatron TP placement to the auto-parallel

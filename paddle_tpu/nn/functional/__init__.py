@@ -17,6 +17,11 @@ from .flash_attention import (  # noqa: F401
     flash_attention,
     flash_attn_unpadded,
 )
+from .sampling import (  # noqa: F401
+    greedy_sample,
+    sample_logits,
+    top_k_top_p_sampling,
+)
 # long-tail losses/pools/utilities (rnnt_loss with FastEmit, dice/soft-
 # margin/poisson-nll/gaussian-nll/npair losses, fractional max pools,
 # adaptive_log_softmax_with_loss, gather_tree, packed flash variants).

@@ -1,0 +1,69 @@
+"""Token sampling ops for the generation path.
+
+`sample_logits` is the pure-jnp form the compiled decode step traces
+(jit/decode_step.py): greedy argmax, temperature, top-k truncation and
+top-p (nucleus) truncation composed in one pass over [..., vocab]
+logits. The Tensor-level wrappers (`greedy_sample`,
+`top_k_top_p_sampling`) are the eager dygraph surface; `ops.extras.
+top_p_sampling` remains the reference-parity op over probabilities.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._dispatch import ensure_tensor, nary, unary
+
+__all__ = ["sample_logits", "greedy_sample", "top_k_top_p_sampling"]
+
+
+def sample_logits(logits, key=None, temperature=1.0, top_k=0, top_p=1.0):
+    """Sample one token id per row of `logits` [..., vocab] (pure jnp).
+
+    key=None or temperature<=0 → greedy argmax. top_k > 0 keeps only the
+    k largest logits; top_p < 1 keeps the smallest descending-probability
+    prefix with cumulative mass >= p (at least one token). Returns int32
+    ids of shape logits.shape[:-1].
+    """
+    lf = logits.astype(jnp.float32)
+    if key is None or temperature <= 0.0:
+        return jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    lf = lf / float(temperature)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(lf, int(top_k))[0][..., -1:]
+        lf = jnp.where(lf < kth, -jnp.inf, lf)
+    if top_p < 1.0:
+        sort = jnp.sort(lf, axis=-1)[..., ::-1]              # descending
+        probs = jax.nn.softmax(sort, axis=-1)
+        # exclusive cumulative mass of the tokens ABOVE each one: a token
+        # stays while the mass before it is < p (so the boundary token
+        # that crosses p is kept, reference top_p_sampling semantics)
+        before = jnp.cumsum(probs, axis=-1) - probs
+        keep = before < float(top_p)
+        # smallest kept logit is the truncation threshold
+        thresh = jnp.min(jnp.where(keep, sort, jnp.inf), axis=-1,
+                         keepdims=True)
+        lf = jnp.where(lf < thresh, -jnp.inf, lf)
+    return jax.random.categorical(key, lf, axis=-1).astype(jnp.int32)
+
+
+def greedy_sample(logits, name=None):
+    """Argmax token per row (Tensor in, int32 Tensor out)."""
+    return unary(lambda l: jnp.argmax(
+        l.astype(jnp.float32), axis=-1).astype(jnp.int32),
+        ensure_tensor(logits), "greedy_sample")
+
+
+def top_k_top_p_sampling(logits, top_k=0, top_p=1.0, temperature=1.0,
+                         seed=None, name=None):
+    """Eager sampling over LOGITS with temperature + top-k + top-p
+    truncation. Returns an int32 ids Tensor of shape [..., ]."""
+    from ...framework import random as _random
+
+    if seed is not None:
+        key = jax.random.PRNGKey(int(seed))
+    else:
+        key = _random.next_key()
+    return nary(lambda l: sample_logits(
+        l, key=key, temperature=temperature, top_k=top_k, top_p=top_p),
+        [ensure_tensor(logits)], "top_k_top_p_sampling")

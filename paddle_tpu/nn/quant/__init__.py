@@ -32,6 +32,7 @@ __all__ = [
     "FakeQuantChannelWiseAbsMax", "MovingAverageAbsMaxScale",
     "FakeQuantWeightLSQPlus", "FakeQuantActLSQPlus",
     "QuantizedLinear", "QuantStub", "Stub",
+    "WeightOnlyLinear", "quantize_for_decode",
 ]
 
 
@@ -468,3 +469,61 @@ class Stub(nn.Layer):
 
 
 QuantStub = Stub
+
+
+class WeightOnlyLinear(nn.Layer):
+    """Inference Linear with an int8 (or int4-packed) HBM-resident
+    weight: half the weight bytes of bf16, 1/4 of fp32 — the decode
+    regime is memory-bound on the weight stream, so this is the PERF.md
+    "5x at bs1" lever, now reachable end to end via
+    `quantize_for_decode(model)` + `model.generate()`.
+
+    Built from an existing nn.Linear (weights quantized once, eagerly);
+    the quantized weight and scale are registered parameters
+    (trainable=False) so the compiled decode step threads them through
+    its params pytree like any other weight.
+    """
+
+    def __init__(self, linear, algo="weight_only_int8"):
+        super().__init__()
+        if linear.weight is None:
+            raise ValueError("linear has no weight")
+        self.in_features = linear.in_features
+        self.out_features = linear.out_features
+        self.algo = algo
+        self.weight_dtype = "int4" if "int4" in algo else "int8"
+        qw, scale = weight_quantize(linear.weight, algo=algo)
+        from ..layer.layers import Parameter
+
+        self.quant_weight = Parameter(qw._data, trainable=False)
+        self.weight_scale = Parameter(scale._data, trainable=False)
+        self.bias = (None if linear.bias is None
+                     else Parameter(linear.bias._data))
+
+    def forward(self, x):
+        return weight_only_linear(x, self.quant_weight, bias=self.bias,
+                                  weight_scale=self.weight_scale,
+                                  weight_dtype=self.weight_dtype)
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, algo={self.algo}")
+
+
+def quantize_for_decode(model, algo="weight_only_int8",
+                        include=("qkv", "out_proj", "fc1", "fc2",
+                                 "lm_head")):
+    """Swap every matching nn.Linear in `model` for a WeightOnlyLinear
+    (in place). `include` filters by attribute name — the default covers
+    the GPT/LLaMA projection set; tied embeddings (lm_head=None) keep
+    the fp embedding matmul, which the decode step reads once per token
+    anyway. Returns the model for chaining."""
+    from ..layer.common import Linear
+
+    for layer in model.sublayers(include_self=True):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, Linear) and not isinstance(
+                    sub, WeightOnlyLinear) and name in include:
+                layer._sub_layers[name] = WeightOnlyLinear(sub,
+                                                           algo=algo)
+    return model

@@ -7,3 +7,4 @@ TPU-first: the hot fused ops are hand-written Pallas kernels over the MXU
 left to XLA fusion.
 """
 from . import flash_attention  # noqa: F401
+from . import paged_attention  # noqa: F401

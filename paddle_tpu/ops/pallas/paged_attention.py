@@ -1,0 +1,211 @@
+"""Ragged paged decode attention — Pallas TPU kernel + XLA gather fallback.
+
+The decode-step kernel of the serving stack (PAPERS.md "Ragged Paged
+Attention"): each sequence's KV history lives in fixed-size pages drawn
+from a shared pool, a per-sequence page table maps logical positions to
+pages, and per-sequence lengths are ragged — so a mixed batch of short
+and long contexts shares one static-shape kernel with no padding to the
+longest sequence's history.
+
+Layouts (one transformer layer):
+
+* ``k_pages`` / ``v_pages``: ``[num_kv_heads, num_pages, page_size,
+  head_dim]`` — the shared pool. Page 0 is conventionally the trash
+  page (ragged writes of padding tokens land there; see
+  inference/kv_cache.py).
+* ``page_tables``: ``[batch, pages_per_seq] int32`` — pool page ids per
+  sequence slot, position ``t`` of slot ``b`` lives in page
+  ``page_tables[b, t // page_size]`` at offset ``t % page_size``.
+* ``seq_lens``: ``[batch] int32`` — valid keys per slot (ragged).
+* ``q``: ``[batch, num_heads, head_dim]`` — ONE new token per slot (the
+  decode step). GQA is supported (``num_heads`` a multiple of
+  ``num_kv_heads``).
+
+Two paths, one contract:
+
+* **Pallas kernel** (TPU): grid ``(batch, kv_head, page)`` with the page
+  table and seq_lens scalar-prefetched, so each grid step DMAs exactly
+  one page of K/V picked by the table — the pool itself never streams
+  densely. Pages past a slot's length are skipped (``pl.when``), which
+  is where the ragged win comes from: compute per slot is proportional
+  to its own context length, not the batch max.
+* **XLA fallback** (CPU / legacy jax): one gather densifies each slot's
+  pages to ``[batch, pages_per_seq * page_size, ...]`` followed by a
+  masked attention. Same numerics, used for parity tests and
+  non-TPU runs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import (  # noqa: F401  (shared platform probes)
+    _HAS_PALLAS, _LANES, _on_tpu, pl, pltpu,
+)
+
+__all__ = ["paged_attention", "paged_attention_xla", "supports"]
+
+
+def supports(num_heads, num_kv_heads, head_dim, page_size) -> bool:
+    """Whether the Pallas kernel can take this cache geometry."""
+    if not _HAS_PALLAS:
+        return False
+    if num_heads % num_kv_heads:
+        return False
+    if head_dim > 256:
+        return False
+    # Mosaic pads sublane/lane tiles from 8/16 upward; tiny pages would
+    # waste most of each tile anyway
+    return page_size % 8 == 0
+
+
+# ---------------------------------------------------------------------------
+# XLA gather fallback
+# ---------------------------------------------------------------------------
+
+def paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens,
+                        scale=None):
+    """Reference-parity path: densify via gather, mask, one attention."""
+    b, nh, d = q.shape
+    kvh, _, page_size, _ = k_pages.shape
+    grp = nh // kvh
+    pp = page_tables.shape[1]
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    # [kvh, b, pp, ps, d] -> [b, kvh, pp*ps, d]
+    def densify(pages):
+        g = jnp.take(pages, page_tables, axis=1)
+        return jnp.moveaxis(g, 0, 1).reshape(b, kvh, pp * page_size, d)
+
+    k = densify(k_pages)
+    v = densify(v_pages)
+    qg = q.reshape(b, kvh, grp, d)
+    s = jnp.einsum("bhgd,bhkd->bhgk", qg.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sc
+    valid = (jnp.arange(pp * page_size)[None, :]
+             < seq_lens[:, None])                      # [b, L]
+    s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    # all-masked rows (empty slots): zero output, not NaN
+    p = jnp.where(valid[:, None, None, :].any(-1, keepdims=True), p, 0.0)
+    out = jnp.einsum("bhgk,bhkd->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(b, nh, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: grid (batch, kv_head, page), scalar-prefetched page table
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, page_size):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    num_p = pl.num_programs(2)
+    sl = sl_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p * page_size < sl)
+    def _step():
+        q = q_ref[0, 0]                                  # [grp, d]
+        k = k_ref[0, 0]                                  # [ps, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [grp, ps]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < sl, s, -jnp.inf)
+        m_prev = m_ref[...]                              # [grp, LANES]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        corr = jnp.exp(m_prev - m_new)
+        e = jnp.exp(s - m_new[:, :1])
+        l_ref[...] = corr * l_prev + jnp.broadcast_to(
+            jnp.sum(e, axis=1, keepdims=True), l_prev.shape)
+        m_ref[...] = m_new
+        pv = jax.lax.dot_general(
+            e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [grp, d]
+        acc_ref[...] = acc_ref[...] * corr[:, :1] + pv
+
+    @pl.when(p == num_p - 1)
+    def _finish():
+        l = l_ref[...][:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)   # empty slot -> zeros, not NaN
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, page_tables, seq_lens,
+                            scale, interpret):
+    b, nh, d = q.shape
+    kvh, _, page_size, _ = k_pages.shape
+    grp = nh // kvh
+    pp = page_tables.shape[1]
+    qg = q.reshape(b, kvh, grp, d)
+    flat_pt = page_tables.reshape(-1).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # page table + seq_lens
+        grid=(b, kvh, pp),
+        in_specs=[
+            pl.BlockSpec((1, 1, grp, d),
+                         lambda bb, h, p, pt, sl: (bb, h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda bb, h, p, pt, sl: (h, pt[bb * pp + p],
+                                                   0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda bb, h, p, pt, sl: (h, pt[bb * pp + p],
+                                                   0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, grp, d),
+                               lambda bb, h, p, pt, sl: (bb, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((grp, d), jnp.float32),
+            pltpu.VMEM((grp, _LANES), jnp.float32),
+            pltpu.VMEM((grp, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale,
+                          page_size=page_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, grp, d), q.dtype),
+        interpret=interpret,
+    )(flat_pt, seq_lens.astype(jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(b, nh, d)
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
+                    scale=None, interpret=None, use_kernel=None):
+    """Ragged paged decode attention (see module docstring for layouts).
+
+    Routes to the Pallas kernel on TPU when the geometry qualifies
+    (`supports`), the XLA gather fallback otherwise. `interpret=True`
+    forces the kernel in interpret mode (hermetic CPU testing);
+    `use_kernel` overrides the routing outright.
+    """
+    b, nh, d = q.shape
+    kvh, _, page_size, _ = k_pages.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    ok = supports(nh, kvh, d, page_size)
+    if use_kernel is None:
+        use_kernel = ok and (interpret is True or _on_tpu())
+    if use_kernel and not ok:
+        raise ValueError(
+            f"paged_attention kernel does not support heads={nh}/"
+            f"kv_heads={kvh}, head_dim={d}, page_size={page_size}")
+    if use_kernel:
+        return _paged_attention_pallas(
+            q, k_pages, v_pages, page_tables, seq_lens, float(scale),
+            bool(interpret) if interpret is not None else not _on_tpu())
+    return paged_attention_xla(q, k_pages, v_pages, page_tables,
+                               seq_lens, scale=float(scale))

@@ -340,6 +340,88 @@ class TestReturnBreakContinueLowering:
         np.testing.assert_allclose(float(s), float(es))
         np.testing.assert_allclose(float(i), float(ei))
 
+    def test_break_in_for_range_loop_var(self):
+        """ADVICE r5: the loop var read AFTER a broken for-range must
+        hold the break-time value like eager python, not the last range
+        value (the gated no-op iterations kept advancing it before)."""
+        def body(x, n):
+            total = x * 0
+            for i in range(10):
+                if i >= n:
+                    break
+                total = total + i
+            return total, i
+
+        f = to_static(body)
+        x = paddle.to_tensor(1.0)
+        et, ei = body(x, 4)
+        t, i = f(x, 4)
+        np.testing.assert_allclose(float(t), float(et))
+        got = int(i._data) if hasattr(i, "_data") else int(i)
+        assert got == ei == 4, (got, ei)
+
+    def test_break_in_for_range_tensor_cond_loop_var(self):
+        """Same contract when the break condition is tensor-dependent
+        (the gate stages as lax.cond) — still compiled, still eager-
+        faithful loop var."""
+        def body(x):
+            total = x * 0
+            for i in range(10):
+                if total > 5:
+                    break
+                total = total + i
+            return total, i
+
+        f = to_static(body)
+        x = paddle.to_tensor(0.0)
+        et, ei = body(x)
+        t, i = self._assert_compiled(f, x)
+        np.testing.assert_allclose(float(t), float(et))
+        got = int(i._data) if hasattr(i, "_data") else int(i)
+        assert got == int(ei), (got, ei)
+
+    def test_nested_breaks_keep_distinct_loop_vars(self):
+        """Nested broken for-loops must snapshot into DISTINCT slots —
+        the outer restore must not read back the inner loop's var
+        (review fix: snapshot ids captured before the body recursion)."""
+        def body(x):
+            s = x * 0
+            for i in range(5):
+                for j in range(5):
+                    if j >= 2:
+                        break
+                    s = s + 1
+                if i >= 3:
+                    break
+            return s, i, j
+
+        f = to_static(body)
+        x = paddle.to_tensor(0.0)
+        want = body(x)
+        got = f(x)
+        for w, g in zip(want, got):
+            wv = float(w._data) if hasattr(w, "_data") else float(w)
+            gv = float(g._data) if hasattr(g, "_data") else float(g)
+            assert wv == gv, (wv, gv)
+
+    def test_break_tuple_target_loop_vars(self):
+        def body(x):
+            s = x * 0
+            for a, b in [(1, 2), (3, 4), (5, 6)]:
+                if a == 3:
+                    break
+                s = s + a + b
+            return s, a, b
+
+        f = to_static(body)
+        x = paddle.to_tensor(0.0)
+        want = body(x)
+        got = f(x)
+        for w, g in zip(want, got):
+            wv = float(w._data) if hasattr(w, "_data") else float(w)
+            gv = float(g._data) if hasattr(g, "_data") else float(g)
+            assert wv == gv, (wv, gv)
+
     def test_continue_in_tensor_while(self):
         def body(x):
             i = x * 0
