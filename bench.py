@@ -39,7 +39,7 @@ def _is_big(model_name):
 
 
 def run_config(model_name, batch, seq, steps, recompute, remat_policy,
-               offload_masters):
+               offload_masters, scan_unroll=None, layer_chunk=None):
     import jax
 
     import paddle_tpu as paddle
@@ -93,6 +93,7 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
                          offload_master_weights=offload_masters)
 
     fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
+    su = lc = None
     if fused_scan:
         if fused_ce:
             print("[bench] BENCH_FUSED_CE ignored: the fused-scan step "
@@ -100,12 +101,27 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
                   "chunked-CE lever)", file=sys.stderr)
         from paddle_tpu.jit import FusedScanTrainStep
 
+        # scan granularity: explicit arg > env > the code-hash-validated
+        # best from the last `bench.py --sweep` run (canonical configs
+        # only) > per-layer default. The sweep best is a measured PAIR —
+        # it only auto-applies when BOTH knobs are unset (mixing a
+        # pinned unroll with the recorded chunk would run a grid point
+        # the sweep never measured)
+        su = (scan_unroll if scan_unroll is not None
+              else int(os.environ.get("BENCH_SCAN_UNROLL", "0")))
+        lc = (layer_chunk if layer_chunk is not None
+              else int(os.environ.get("BENCH_LAYER_CHUNK", "0")))
+        if not su and not lc:
+            best = _load_sweep_best(model_name, batch, seq, recompute,
+                                    remat_policy, offload_masters)
+            su = int(best.get("scan_unroll", 1))
+            lc = int(best.get("layer_chunk", 1))
+        su, lc = su or 1, lc or 1
         step = FusedScanTrainStep(
             model, opt, criterion=crit,
             fused_head=os.environ.get("BENCH_FUSED_HEAD", "0") == "1",
             compute_dtype="bfloat16",
-            layer_chunk=int(os.environ.get("BENCH_LAYER_CHUNK", "1")),
-            scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")))
+            layer_chunk=lc, scan_unroll=su)
     else:
         if fused_ce:
             # fused LM head: chunked logsumexp, no [tokens, vocab] logits
@@ -163,8 +179,121 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
                                        and not fused_scan),
                    "scan_layers": scan_layers,
                    "fused_scan": fused_scan,
+                   "scan_unroll": su if fused_scan else None,
+                   "layer_chunk": lc if fused_scan else None,
                    "fused_ce": fused_ce and not fused_scan},
     }
+
+
+def _sweep_path(model_name):
+    return os.path.join(_LIVE_DIR, f"scan_sweep_{model_name}.json")
+
+
+def _read_sweep(model_name):
+    """None on missing OR corrupt record — a sweep killed mid-write
+    must degrade to 'no sweep recorded', never brick the bench."""
+    path = _sweep_path(model_name)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+
+
+def _load_sweep_best(model_name, batch, seq, recompute, remat_policy,
+                     offload_masters):
+    """The best (scan_unroll, layer_chunk) from the most recent
+    `bench.py --sweep` run — applied only when the record is
+    code-hash-current AND was measured at this exact (batch, seq,
+    recompute, remat_policy, offload) regime: a sanity sweep at a tiny
+    debug config or under a different memory regime must never steer
+    the flagship run."""
+    rec = _read_sweep(model_name)
+    if rec is None:
+        return {}
+    cfg = rec.get("config", {})
+    if (rec.get("compute_path_hash") != _compute_path_hash()
+            or cfg.get("batch") != batch or cfg.get("seq") != seq
+            or cfg.get("recompute") != bool(recompute)
+            or (cfg.get("remat_policy") or "") != (remat_policy or "")
+            or cfg.get("offload_masters", False) != bool(
+                offload_masters)):
+        return {}
+    return rec.get("best", {})
+
+
+def run_scan_sweep(model_name=None, batch=None, seq=None, steps=None):
+    """ISSUE 3: measured scan_unroll/layer_chunk sweep on the fused-scan
+    path (the r5 per-layer-barrier note's target). One run_config per
+    variant; records the table + best to .bench_live/scan_sweep_*.json
+    with code-hash provenance, which run_config then auto-applies for
+    canonical configs. At gpt3-1.3b each variant is a ~20 min wall run
+    (axon program load dominates), so the full sweep is a manual
+    `BENCH_MODEL=gpt3-1.3b python bench.py --sweep` session, not an
+    in-window lane."""
+    from paddle_tpu.models.gpt import GPT_CONFIGS
+
+    model_name = model_name or os.environ.get("BENCH_MODEL", "gpt3-350m")
+    batch = batch or int(os.environ.get("BENCH_BS", "8"))
+    seq = seq or int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = steps or int(os.environ.get("BENCH_STEPS", "5"))
+    big = _is_big(model_name)
+    recompute = os.environ.get("BENCH_RECOMPUTE",
+                               "1" if big else "0") == "1"
+    n_layers = GPT_CONFIGS[model_name]["num_layers"]
+    variants = [(u, 1) for u in (1, 2, 4, 8)]
+    variants += [(1, c) for c in (2, 3) if n_layers % c == 0]
+    rows = []
+
+    def record():
+        """Write the (possibly partial) record after EVERY variant —
+        a 2h TPU sweep killed by a process-level OOM/libtpu abort at
+        variant 4 keeps its first 3 measurements. Atomic via
+        tmp+replace so no torn record can brick later runs."""
+        ok = [r for r in rows if "tok_s" in r]
+        best = max(ok, key=lambda r: r["tok_s"]) if ok else {}
+        rec = {
+            "metric": f"{model_name}_scan_granularity_sweep",
+            "unit": "tokens/s",
+            "config": {"batch": batch, "seq": seq, "steps": steps,
+                       "recompute": recompute, "remat_policy": "",
+                       "offload_masters": False},
+            "variants": rows,
+            "complete": len(rows) == len(variants),
+            "best": {k: best[k] for k in ("scan_unroll", "layer_chunk")
+                     } if best else {},
+            "best_tok_s": best.get("tok_s"),
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "compute_path_hash": _compute_path_hash(),
+            "provenance": "measured live by this bench on this host; "
+                          "auto-applied to later runs only while the "
+                          "compute-path hash matches and (batch, seq, "
+                          "recompute, remat, offload) are identical",
+        }
+        os.makedirs(_LIVE_DIR, exist_ok=True)
+        tmp = _sweep_path(model_name) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, _sweep_path(model_name))
+        return rec
+
+    for u, c in variants:
+        os.environ["BENCH_FUSED_SCAN"] = "1"
+        try:
+            r = run_config(model_name, batch, seq, steps, recompute, "",
+                           False, scan_unroll=u, layer_chunk=c)
+            rows.append({"scan_unroll": u, "layer_chunk": c,
+                         "tok_s": r["value"], "mfu": r["mfu"]})
+        except Exception as e:   # one OOM variant must not eat the sweep
+            rows.append({"scan_unroll": u, "layer_chunk": c,
+                         "error": f"{type(e).__name__}: {e}"[:200]})
+        print(f"[sweep] {model_name} unroll={u} chunk={c} -> "
+              f"{rows[-1]}", file=sys.stderr)
+        rec = record()
+    return rec
 
 
 def run_decode_config(model_name=None, prompt_len=None, new_tokens=None,
@@ -399,12 +528,23 @@ def run_selftest():
         assert rec.get("check") == "pass", rec
         results["decode_parity_detail"] = rec
 
+    def sharded_scan_parity():
+        # ISSUE 3: sharded fused-scan == single-device fused scan ==
+        # eager TrainStep with ClipGradByGlobalNorm, on an 8-device
+        # host mesh; 1/N opt-state sharding asserted on live shapes;
+        # tolerances land in the record
+        rec = _run_cpu_probe("paddle_tpu.jit.sharded_scan_selftest")
+        lane = rec.get("sharded_scan_parity", {})
+        assert lane.get("check") == "pass", lane
+        results["sharded_scan_parity_detail"] = lane
+
     check("pallas_flash_single_block_s512", lambda: flash(512))
     check("pallas_flash_tiled_s2048", lambda: flash(2048))
     check("int8_weight_only_matmul", int8_matmul)
     check("master_offload_parity_pinned_host", offload_parity)
     check("bucketed_reduce_scatter_parity", bucketed_rs_parity)
     check("decode_parity", decode_parity)
+    check("sharded_scan_parity", sharded_scan_parity)
     return results
 
 
@@ -456,10 +596,14 @@ def _run_cpu_host_mesh_probe(multichip=False, n_devices=8, timeout=600):
 # fit 16G HBM and load in minutes (vs the unrolled step's ~40-min axon
 # program load that forced r4 to embed this block by provenance). The
 # r4 unrolled-step measurement is kept for round-over-round context:
-# the fused-scan number is ~7% below it (the per-layer scan barrier
+# the fused-scan number is ~6% below it (the per-layer scan barrier
 # stops XLA from overlapping one layer's optimizer traffic with the
-# next layer's compute; layer_chunk/scan_unroll variants measured
-# SLOWER still — 10.7k/10.8k vs 12.0k — so per-layer stands).
+# next layer's compute; the r5 hand-measured variants were SLOWER —
+# 10.7k/10.8k vs 12.0k). ISSUE 3 turned that hand A/B into the
+# `bench.py --sweep` lane: a measured scan_unroll x layer_chunk sweep
+# whose code-hash-validated best auto-applies to later canonical runs
+# (run_scan_sweep / _load_sweep_best; residual-barrier accounting in
+# PERF.md "Sharded scan").
 R4_UNROLLED_13B = {
     "metric": "gpt3-1.3b_train_tokens_per_sec_per_chip",
     "value": 12949.4,
@@ -491,6 +635,7 @@ def _compute_path_hash():
     root = os.path.dirname(os.path.abspath(__file__))
     h = hashlib.sha256()
     for rel in ("paddle_tpu/jit/fused_scan_step.py",
+                "paddle_tpu/jit/sharded_scan.py",
                 "paddle_tpu/models/gpt.py",
                 "paddle_tpu/ops/pallas/flash_attention.py",
                 "paddle_tpu/optimizer/__init__.py"):
@@ -555,6 +700,13 @@ def main():
                         remat_policy, offload)
     if big:
         result["r4_unrolled_reference"] = R4_UNROLLED_13B
+        # attach the recorded scan-granularity sweep (ISSUE 3), honestly
+        # labeled stale when the compute path changed since
+        sweep = _read_sweep(model_name)
+        if sweep is not None:
+            sweep["code_current"] = (
+                sweep.get("compute_path_hash") == _compute_path_hash())
+            result["scan_sweep"] = sweep
         # only the CANONICAL north-star config may refresh the published
         # live record — a debug run (tiny batch, altered path) must not
         # overwrite the flagship number (r5 review)
@@ -685,8 +837,23 @@ if __name__ == "__main__":
     if "--multichip" in sys.argv:
         # MULTICHIP lane: bucketed vs per-param stage-2 gradient sync on a
         # host-device-count mesh (collective counts by HLO inspection +
-        # walltime), hermetic CPU subprocess — one JSON line
-        print(json.dumps(_run_cpu_host_mesh_probe(multichip=True)))
+        # walltime), PLUS the sharded fused-scan parity probe and the
+        # tools/hlo_overlap.py collective-overlap verdict (ISSUE 3) —
+        # hermetic CPU subprocesses, one JSON line
+        rec = _run_cpu_host_mesh_probe(multichip=True)
+        try:
+            rec["sharded_scan"] = _run_cpu_probe(
+                "paddle_tpu.jit.sharded_scan_selftest",
+                extra_args=("--multichip",))
+        except Exception as e:
+            rec["sharded_scan"] = {"error":
+                                   f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps(rec))
+    elif "--sweep" in sys.argv:
+        # SWEEP lane: measured scan_unroll/layer_chunk A/B on the
+        # fused-scan path; records + auto-applies the best (ISSUE 3)
+        _setup_jax()
+        print(json.dumps(run_scan_sweep()))
     elif "--decode" in sys.argv:
         # DECODE lane: prefill TTFT + decode tokens/s/chip at bs1/bs8,
         # paged vs dense A/B, int8 weight-only A/B — one JSON line

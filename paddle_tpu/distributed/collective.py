@@ -392,6 +392,57 @@ def _quantized_sum_traced(axes, nranks, qformat):
     return traced
 
 
+QUANT_SCATTER_BLOCK = 32      # int8 scaling-block, same as _quantized_sum
+
+
+def quantized_psum_scatter_traced(axis, nranks, qformat):
+    """The SCATTER LEG of the compressed all-reduce above, as a traced
+    psum_scatter replacement for use INSIDE shard_map (the sharded
+    fused-scan step's per-layer grad reduce-scatter): per-destination
+    chunks ship int8 with per-block symmetric scales (or bf16), the sum
+    accumulates in fp32. Input [..., n*c] on the LAST dim (c must split
+    into whole QUANT_SCATTER_BLOCKs for int8 — callers pad the flat
+    layout to nranks*QUANT_SCATTER_BLOCK); returns the local reduced
+    chunk [..., c], numerically ≈ lax.psum_scatter to the comm_quant
+    tolerance (rel err ~7e-3 int8, bf16 rounding for bf16)."""
+    n = int(nranks)
+    if qformat not in ("int8", "bf16"):
+        raise ValueError(
+            f"unsupported comm quant format {qformat!r} (int8|bf16)")
+    b = QUANT_SCATTER_BLOCK
+
+    def traced(x):
+        lead = x.shape[:-1]
+        c = x.shape[-1] // n
+        chunks = x.astype(jnp.float32).reshape(lead + (n, c))
+        split_ax = len(lead)
+        if qformat == "int8":
+            if c % b:
+                raise ValueError(
+                    f"chunk {c} not a multiple of the {b}-wide int8 "
+                    "scaling block; pad the flat layout to "
+                    "nranks*QUANT_SCATTER_BLOCK")
+            blocks = chunks.reshape(lead + (n, c // b, b))
+            sc = jnp.maximum(jnp.max(jnp.abs(blocks), axis=-1),
+                             1e-30) / 127.0
+            q = jnp.clip(jnp.round(blocks / sc[..., None]),
+                         -127, 127).astype(jnp.int8)
+            recv = jax.lax.all_to_all(q, axis, split_axis=split_ax,
+                                      concat_axis=split_ax)
+            src_sc = jax.lax.all_to_all(sc, axis, split_axis=split_ax,
+                                        concat_axis=split_ax)
+            red = jnp.sum(recv.astype(jnp.float32) * src_sc[..., None],
+                          axis=split_ax)
+            return red.reshape(lead + (c,)).astype(x.dtype)
+        recv = jax.lax.all_to_all(chunks.astype(jnp.bfloat16), axis,
+                                  split_axis=split_ax,
+                                  concat_axis=split_ax)
+        return jnp.sum(recv.astype(jnp.float32),
+                       axis=split_ax).astype(x.dtype)
+
+    return traced
+
+
 def all_reduce_quantized(tensor, op=ReduceOp.SUM, group=None, qformat=None,
                          sync_op=True):
     """Compressed all_reduce (SUM only); in-place on `tensor` like

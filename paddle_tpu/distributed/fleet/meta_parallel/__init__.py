@@ -90,6 +90,18 @@ class ShardingParallel(MetaParallelBase):
         )
         return self._layers(*inputs, **kwargs)
 
+    def train_step(self, optimizer, criterion=None, **kw):
+        """fleet.distributed_model's whole-step entry: scan_layers GPT
+        models get the weight-update-sharded fused scan step over the
+        sharding axis (jit/sharded_scan.py), others the generic
+        TrainStep."""
+        from ....jit.sharded_scan import select_train_step
+
+        return select_train_step(self._layers, optimizer,
+                                 criterion=criterion,
+                                 mesh=self._hcg.mesh, axis="sharding",
+                                 **kw)
+
 
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: E402,F401
 from .pipeline_parallel import PipelineParallel  # noqa: E402,F401

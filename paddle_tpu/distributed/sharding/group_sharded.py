@@ -137,6 +137,18 @@ class GroupShardedStage2:
         if self._bucketer is not None:
             self._bucketer.sync_pending()
 
+    def train_step(self, optimizer=None, criterion=None, **kw):
+        """Build the whole-step program for the wrapped model: a
+        scan_layers GPT on a >1 sharding axis gets the
+        ShardedFusedScanTrainStep (in-scan reduce-scatter + sharded
+        weight update, jit/sharded_scan.py); degree 1 falls back to
+        FusedScanTrainStep, non-scan models to the generic TrainStep."""
+        from ...jit.sharded_scan import select_train_step
+
+        return select_train_step(self._layers, optimizer or self._opt,
+                                 criterion=criterion, mesh=self._mesh,
+                                 axis=self._axis, **kw)
+
     def __call__(self, *a, **k):
         return self._layers(*a, **k)
 

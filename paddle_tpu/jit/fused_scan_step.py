@@ -47,11 +47,32 @@ from jax import lax
 
 from ..framework.tensor import Tensor
 from ..framework.autograd import no_grad
+from ..framework import random as _random
 from ..profiler import RecordEvent
+
+# PRNG draws reserved per layer forward (2 hidden dropouts + attention
+# dropout + slack). The per-layer offset scheme below is
+#   offset = ((step * num_layers + layer) * nranks + rank) * _RNG_SLOTS
+# — collision-free across (step, layer, rank) until int32 wrap (~10^6
+# steps at 24 layers), and identical between the forward trace and the
+# backward's vjp recompute, which is what makes dropout legal inside the
+# manually-rematerialized scan.
+_RNG_SLOTS = 8
 
 
 def _key(p):
     return p.name or str(id(p))
+
+
+def _donate_argnums():
+    """State donation is a pure perf lever — forced off on the legacy
+    jaxlib (0.4.x CPU corrupts donated buffers under scan-sized
+    programs: NaN losses then hard aborts; the TrainStep guard)."""
+    import sys as _sys
+
+    legacy = getattr(_sys.modules.get("paddle_tpu"),
+                     "jax_compat_legacy", False)
+    return () if legacy else (0,)
 
 
 class FusedScanTrainStep:
@@ -63,10 +84,23 @@ class FusedScanTrainStep:
         step = FusedScanTrainStep(model, opt)   # model: scan_layers=True
         loss = step(ids, labels)                # one fused launch
 
-    Constraints (asserted): Adam/AdamW without grad_clip/amsgrad/offload —
-    global-norm clip needs the full grad set the design exists to avoid
-    (a deferred-norm variant is possible but not built), and pinned-host
-    offload was measured counterproductive (docs/DECISIONS.md §8).
+    Constraints (asserted): Adam/AdamW without amsgrad/offload (pinned-host
+    offload was measured counterproductive, docs/DECISIONS.md §8).
+
+    Grad clip: ClipGradByValue applies elementwise inside the scan (free);
+    ClipGradByGlobalNorm runs a DEFERRED-NORM two-pass backward — pass 1
+    re-scans the vjp accumulating only the squared norm in the carry (each
+    layer's grad still dies inside its iteration), pass 2 applies the
+    clipped update. ~2x backward FLOPs, still O(1 layer) grad memory; the
+    sharded step (jit/sharded_scan.py) gets the same clip for one scalar
+    all-reduce instead, because its 1/N grad shards DO fit. Per-tensor
+    ClipGradByNorm would need a whole stacked [L, ...] leaf's grad at
+    once — unsupported here, use ClipGradByGlobalNorm or the sharded step.
+
+    Dropout: supported. Each layer's dropout keys derive from
+    (step, layer, rank) via a generator offset bound inside the scan body
+    (_RNG_SLOTS scheme above), so the backward's recompute of layer i's
+    forward draws exactly the masks the forward used.
     """
 
     def __init__(self, model, optimizer, criterion=None, fused_head=False,
@@ -89,10 +123,34 @@ class FusedScanTrainStep:
             opt = opt._inner_opt
         if not isinstance(opt, Adam):
             raise ValueError("fused scan step supports Adam/AdamW only")
-        if opt._grad_clip is not None:
-            raise ValueError(
-                "grad_clip needs the full gradient set this step exists "
-                "to never materialize; clip is unsupported here")
+        self._clip_global = None      # ClipGradByGlobalNorm clip_norm
+        self._clip_value = None       # ClipGradByValue (min, max)
+        clip = opt._grad_clip
+        if clip is not None:
+            from ..nn.clip import (
+                ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+            )
+
+            if type(clip) is ClipGradByGlobalNorm:
+                self._clip_global = float(clip.clip_norm)
+            elif type(clip) is ClipGradByValue:
+                self._clip_value = (float(clip.min), float(clip.max))
+            elif isinstance(clip, ClipGradByNorm):
+                raise ValueError(
+                    "ClipGradByNorm clips each tensor by its OWN norm, "
+                    "which for a stacked [L, ...] leaf needs all L "
+                    "layers' grads at once — exactly what this step "
+                    "never materializes. Use ClipGradByGlobalNorm "
+                    "(deferred-norm two-pass here, one scalar "
+                    "all-reduce in ShardedFusedScanTrainStep) or "
+                    "ClipGradByValue (elementwise, free in-scan)")
+            else:
+                raise ValueError(
+                    f"unsupported grad_clip {type(clip).__name__}: the "
+                    "fused scan step supports ClipGradByGlobalNorm and "
+                    "ClipGradByValue (subclasses with custom semantics "
+                    "would be silently miscomputed, so they are "
+                    "rejected)")
         if opt._amsgrad:
             raise ValueError("amsgrad moment2_max not supported")
         if opt._offload_masters:
@@ -100,16 +158,12 @@ class FusedScanTrainStep:
                 "master offload defeats the in-scan update (measured "
                 "worse, docs/DECISIONS.md §8)")
         cfg = model.config
-        if getattr(cfg, "hidden_dropout_prob", 0.0) or \
-                getattr(cfg, "attention_dropout_prob", 0.0):
-            # the backward RE-TRACES the block (per-chunk vjp + recompute);
-            # eager dropout draws a fresh PRNG key per trace, so the
-            # backward would differentiate forwards that never ran.
-            # (GPTModel already rejects scan_layers+dropout; this guards
-            # custom configs reaching here another way.)
-            raise ValueError(
-                "FusedScanTrainStep requires zero dropout (the manual "
-                "backward re-traces the block)")
+        # dropout is legal here: the per-layer PRNG offset binding
+        # (_RNG_SLOTS scheme) makes the backward's block recompute draw
+        # the same masks the forward did
+        self._dropout_active = bool(
+            getattr(cfg, "hidden_dropout_prob", 0.0)
+            or getattr(cfg, "attention_dropout_prob", 0.0))
         self._opt = opt
         self._crit = criterion or GPTPretrainingCriterion()
         # fused_head=True routes the LM head through the chunked-logsumexp
@@ -172,6 +226,41 @@ class FusedScanTrainStep:
         # bias corrections to t=1 (r5 review finding)
         self._step_count = int(opt._step_count)
 
+    # -- per-layer PRNG plumbing (dropout inside the scan) --------------
+    # the sharded subclass overrides these with the dp-axis rank so every
+    # rank draws distinct masks for its own batch rows
+    _rng_nranks = 1
+
+    def _rng_rank(self):
+        return 0
+
+    def _rng_base(self, t32, layer):
+        """Traced generator offset for `layer` at step t32 (int32); slot
+        `num_layers` is the embedding dropout. None when the model has no
+        dropout."""
+        if not self._dropout_active:
+            return None
+        n_slots = self.model.config.num_layers + 1
+        return ((t32 * n_slots + layer) * self._rng_nranks
+                + self._rng_rank()) * _RNG_SLOTS
+
+    def _rng_chunk_base(self, t32, chunk_i):
+        if not self._dropout_active:
+            return None
+        return self._rng_base(t32, chunk_i * self._layer_chunk)
+
+    def _chunk_apply(self, chunk_leaves, h, rng0=None):
+        """layer_chunk layers unrolled: chunk_leaves are [K, ...]
+        slices; rng0 is the chunk's first-layer PRNG offset (None
+        without dropout). Shared by the single-device and sharded
+        builds — the rng stride here and _rng_base are one scheme."""
+        stride = self._rng_nranks * _RNG_SLOTS
+        for j in range(self._layer_chunk):
+            off = None if rng0 is None else rng0 + j * stride
+            h = self._block_fn([a[j] for a in chunk_leaves], h,
+                               rng_off=off)
+        return h
+
     # -- pure functional views over the live layers ---------------------
     def _bind(self, params, datas):
         saved = [p._data for p in params]
@@ -187,27 +276,51 @@ class FusedScanTrainStep:
             return datas
         return [d.astype(self._compute_dtype) for d in datas]
 
-    def _block_fn(self, leaf_datas, x):
-        """One decoder block as a pure jax function of (leaves, x)."""
+    def _block_fn(self, leaf_datas, x, rng_off=None):
+        """One decoder block as a pure jax function of (leaves, x).
+
+        `rng_off` (traced int32 or None) pins the global generator's
+        offset for the duration of the block, so every dropout draw
+        inside is a pure function of (seed, rng_off, draw index) — the
+        backward's vjp recompute passes the SAME rng_off and reproduces
+        the forward's masks exactly."""
         tmpl = self._template
+        gen = _random.default_generator()
         with no_grad():
             saved = self._bind(self._t_leaves, self._cc(leaf_datas))
+            saved_off = gen._offset
+            if rng_off is not None:
+                gen._offset = rng_off
             try:
-                tmpl.training = True
+                # train() (not just .training=True): the template is no
+                # registered sublayer, so its Dropout children only see
+                # the mode set this way
+                tmpl.train()
                 return tmpl._inner(Tensor._wrap(x))._data
             finally:
+                gen._offset = saved_off
                 self._bind(self._t_leaves, saved)
 
-    def _embed_fn(self, o_datas, ids, pos):
+    def _embed_fn(self, o_datas, ids, pos, rng_off=None):
         m = self.model
+        gen = _random.default_generator()
         with no_grad():
             saved = self._bind([p for _, p in self._o_params],
                                self._cc(o_datas))
+            saved_off = gen._offset
+            if rng_off is not None:
+                gen._offset = rng_off
             try:
                 x = m.gpt.wte(Tensor._wrap(ids)) + m.gpt.wpe(
                     Tensor._wrap(pos))
+                if self._dropout_active:
+                    # the eager forward applies embedding dropout
+                    # (GPTModel.forward: self.drop) — keep parity
+                    m.gpt.drop.training = True
+                    x = m.gpt.drop(x)
                 return x._data
             finally:
+                gen._offset = saved_off
                 self._bind([p for _, p in self._o_params], saved)
 
     def _head_fn(self, o_datas, xL, labels):
@@ -292,17 +405,27 @@ class FusedScanTrainStep:
         o_hyp = [hyper(p) for _, p in self._o_params]
         n_leaves = len(self._s_params)
         K = self._layer_chunk
-
-        def chunk_apply(chunk_leaves, h):
-            """K layers unrolled: chunk_leaves are [K, ...] slices."""
-            for j in range(K):
-                h = self._block_fn([a[j] for a in chunk_leaves], h)
-            return h
+        chunk_apply = self._chunk_apply
 
         def adam(pv, g32, m, v, lr, tf, wd, l2):
             if l2:
                 g32 = g32 + l2 * pv.astype(jnp.float32)
             return opt._adam_math(pv, g32, m, v, None, lr, tf, wd)
+
+        cv = self._clip_value
+
+        def clip_g32(g32, p):
+            """The per-grad transforms that are legal inside the scan:
+            elementwise value clip, and the deferred global-norm scale
+            (traced scalar, resolved before the update scan runs)."""
+            if cv is not None and getattr(p, "need_clip", True):
+                g32 = jnp.clip(g32, cv[0], cv[1])
+            return g32
+
+        def scaled(g32, p, scale):
+            if scale is not None and getattr(p, "need_clip", True):
+                g32 = g32 * scale
+            return g32
 
         def step_fn(state, lr, ids, labels):
             s, o = state["s"], state["o"]
@@ -313,9 +436,13 @@ class FusedScanTrainStep:
                 b, seq = ids.shape
                 pos = jnp.arange(seq, dtype=ids.dtype)[None, :]
 
+                t32 = t.astype(jnp.int32)
+                n_layers = self.model.config.num_layers
+
                 # ---- forward: embed + scan over chunks of K layers,
                 # saving only each CHUNK's input
-                x0 = self._embed_fn(o["p"], ids, pos)
+                x0 = self._embed_fn(o["p"], ids, pos,
+                                    rng_off=self._rng_base(t32, n_layers))
                 sp_c = tuple(a.reshape((a.shape[0] // K, K)
                                        + tuple(a.shape[1:]))
                              for a in s["p"])
@@ -330,16 +457,74 @@ class FusedScanTrainStep:
                               if a is not None else None
                               for a in s["mw"])
 
-                def fwd_body(h, p_chunk):
-                    return chunk_apply(p_chunk, h), h
+                C = sp_c[0].shape[0]
 
-                xL, xs = lax.scan(fwd_body, x0, sp_c,
-                                  unroll=self._scan_unroll)
+                def fwd_body(h, scanned):
+                    p_chunk, i = scanned
+                    rng0 = self._rng_chunk_base(t32, i)
+                    return chunk_apply(p_chunk, h, rng0), h
+
+                xL, xs = lax.scan(
+                    fwd_body, x0, (sp_c, jnp.arange(C)),
+                    unroll=self._scan_unroll)
 
                 # ---- head (+ its whole vjp: small params, one buffer)
                 loss, head_vjp = jax.vjp(
                     lambda od, x: self._head_fn(od, x, labels), o["p"], xL)
                 d_o_head, dxL = head_vjp(jnp.ones((), loss.dtype))
+
+                # ---- deferred global-norm clip (pass 1 of 2): re-scan
+                # the vjp accumulating ONLY the squared grad norm in the
+                # carry — each layer's grad still dies inside its
+                # iteration, so the memory plan is unchanged; cost is a
+                # second backward (docs/DECISIONS.md §12). The embed-side
+                # outer grads fall out of this pass's dx0 and are reused
+                # by the update below (their math is identical).
+                scale = None
+                d_o_emb = None
+                if self._clip_global is not None:
+                    def norm_body(carry, scanned):
+                        dy, sq = carry
+                        x_i, i = scanned
+                        p_i = tuple(
+                            lax.dynamic_index_in_dim(a, i, keepdims=False)
+                            for a in P0)
+                        rng0 = self._rng_chunk_base(t32, i)
+                        _, vjp = jax.vjp(
+                            lambda pl, xx: chunk_apply(pl, xx, rng0),
+                            p_i, x_i)
+                        dp, dx = vjp(dy)
+                        for j in range(n_leaves):
+                            p = self._s_params[j]
+                            if not p.trainable or not getattr(
+                                    p, "need_clip", True):
+                                continue
+                            sq = sq + jnp.sum(jnp.square(
+                                dp[j].astype(jnp.float32)))
+                        return (dx, sq), None
+
+                    P0 = sp_c
+                    (dx0, sq), _ = lax.scan(
+                        norm_body, (dxL, jnp.float32(0.0)),
+                        (xs, jnp.arange(C)), reverse=True,
+                        unroll=self._scan_unroll)
+                    _, emb_vjp = jax.vjp(
+                        lambda od: self._embed_fn(
+                            od, ids, pos,
+                            rng_off=self._rng_base(t32, n_layers)),
+                        o["p"])
+                    (d_o_emb,) = emb_vjp(dx0)
+                    for j in range(len(o["p"])):
+                        p = self._o_params[j][1]
+                        if not getattr(p, "need_clip", True):
+                            continue
+                        g = (d_o_head[j].astype(jnp.float32)
+                             + d_o_emb[j].astype(jnp.float32))
+                        sq = sq + jnp.sum(jnp.square(g))
+                    gnorm = jnp.sqrt(sq)
+                    scale = jnp.minimum(
+                        jnp.float32(self._clip_global)
+                        / jnp.maximum(gnorm, 1e-12), 1.0)
 
                 # ---- reverse scan: vjp one CHUNK, update its slices
                 def bwd_body(carry, scanned):
@@ -348,8 +533,9 @@ class FusedScanTrainStep:
                     p_i = tuple(
                         lax.dynamic_index_in_dim(a, i, keepdims=False)
                         for a in P)          # [K, ...] slices
+                    rng0 = self._rng_chunk_base(t32, i)
                     _, vjp = jax.vjp(
-                        lambda pl, xx: chunk_apply(pl, xx), p_i, x_i)
+                        lambda pl, xx: chunk_apply(pl, xx, rng0), p_i, x_i)
                     dp, dx = vjp(dy)
                     nP, nM, nV, nMW = [], [], [], []
                     for j in range(n_leaves):
@@ -371,8 +557,12 @@ class FusedScanTrainStep:
                             MW[j], i, keepdims=False)
                             if MW[j] is not None else None)
                         pv = mw_j if mw_j is not None else p_i[j]
+                        g32 = scaled(
+                            clip_g32(dp[j].astype(jnp.float32),
+                                     self._s_params[j]),
+                            self._s_params[j], scale)
                         out, mn, vn, _ = adam(
-                            pv, dp[j].astype(jnp.float32), m_j, v_j,
+                            pv, g32, m_j, v_j,
                             lr * lrs, tf, jnp.float32(wd), l2)
                         nP.append(lax.dynamic_update_index_in_dim(
                             P[j], out.astype(P[j].dtype), i, 0))
@@ -386,7 +576,6 @@ class FusedScanTrainStep:
                     return (dx, tuple(nP), tuple(nM), tuple(nV),
                             tuple(nMW)), None
 
-                C = sp_c[0].shape[0]
                 carry0 = (dxL, sp_c, sm_c, sv_c, smw_c)
                 (dx0, nP, nM, nV, nMW), _ = lax.scan(
                     bwd_body, carry0, (xs, jnp.arange(C)), reverse=True,
@@ -399,14 +588,21 @@ class FusedScanTrainStep:
                        if a is not None else None for a in nMW]
 
                 # ---- embedding-side grads for outer params + update
-                _, emb_vjp = jax.vjp(
-                    lambda od: self._embed_fn(od, ids, pos), o["p"])
-                (d_o_emb,) = emb_vjp(dx0)
+                # (already computed by the norm pass when clipping)
+                if d_o_emb is None:
+                    _, emb_vjp = jax.vjp(
+                        lambda od: self._embed_fn(
+                            od, ids, pos,
+                            rng_off=self._rng_base(t32, n_layers)),
+                        o["p"])
+                    (d_o_emb,) = emb_vjp(dx0)
                 new_o = {"p": [], "m": [], "v": [], "mw": []}
                 for j in range(len(o["p"])):
                     wd, l2, lrs = o_hyp[j]
                     g32 = (d_o_head[j].astype(jnp.float32)
                            + d_o_emb[j].astype(jnp.float32))
+                    g32 = scaled(clip_g32(g32, self._o_params[j][1]),
+                                 self._o_params[j][1], scale)
                     pv = (o["mw"][j] if o["mw"][j] is not None
                           else o["p"][j])
                     out, mn, vn, _ = adam(pv, g32, o["m"][j], o["v"][j],
@@ -429,14 +625,8 @@ class FusedScanTrainStep:
             finally:
                 self._bind(self._buffers, saved_buf)
 
-        # same legacy-jaxlib donation guard as TrainStep: donation
-        # corrupts buffers on 0.4.x CPU (NaNs + later hard aborts)
-        import sys as _sys
-
-        _legacy = getattr(_sys.modules.get("paddle_tpu"),
-                          "jax_compat_legacy", False)
         self._jitted = jax.jit(step_fn,
-                               donate_argnums=() if _legacy else (0,))
+                               donate_argnums=_donate_argnums())
 
     def ensure_built(self):
         """Create the Adam state and trace the step (idempotent). Split

@@ -1,0 +1,733 @@
+"""Sharded fused-scan train step: weight-update sharding INSIDE the scan.
+
+`FusedScanTrainStep` made the 1.3b north star fit one chip by fusing the
+Adam update into a manual per-layer reverse scan. This module is its
+multi-chip form, per Xu et al., "Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training" (PAPERS.md): weights stay
+replicated over the dp/sharding axis, but gradients, moments, masters and
+the update computation are 1/N-sharded per rank —
+
+  backward scan (reverse, per chunk of K layers):
+      dp      = vjp(block chunk)(dy)                 (full, dies here)
+      flat    = bucket-pack(dp)   [K, F]             (comm_bucketer layout)
+      gshard  = reduce_scatter(flat) over the axis   [K, F/N]  <- survives
+      sq     += ||gshard/N||^2                       (in the scan carry)
+  one scalar all-reduce:  gnorm = sqrt(psum(sq));  clip = min(c/gnorm, 1)
+  update scan (per chunk):
+      adam on the 1/N shard (clip applied, moments/masters sharded)
+      all_gather(updated shard) -> write the chunk's param slices
+  outer params (embed/ln_f/head): same, without the scan.
+
+Because only the 1/N grad shard outlives a scan iteration, the whole
+gradient set per rank is full_grads/N — which is what makes the fused
+GLOBAL-NORM CLIP affordable here (the single-device step needs a second
+backward pass for it, docs/DECISIONS.md §12) and keeps grad memory off
+the per-layer OOM cliff. The per-bucket reduce-scatter reuses the
+comm_bucketer packing (deterministic entry offsets, FLAGS_comm_bucket_mb
+cap, padding to the axis degree) and optionally the EQuARX-style
+compressed wire format (FLAGS_comm_quant -> int8/bf16 scatter leg,
+collective.quantized_psum_scatter_traced). Inside one scan iteration the
+reduce-scatter of bucket b is independent of bucket b+1's packing and of
+the norm accumulation, and the update scan's all_gather of bucket b is
+independent of bucket b+1's Adam math — with scan_unroll >= 2 adjacent
+layers' collectives and compute land in ONE while-loop body where XLA's
+latency-hiding scheduler can overlap them (tools/hlo_overlap.py is the
+receipt; the multichip lane records its verdict).
+
+Dropout rides the carry-free per-layer PRNG offset scheme of the base
+class, with the dp-axis rank folded in so each rank draws distinct masks
+for its own batch rows.
+
+Semantics note: the per-rank loss is the criterion's mean over the
+rank's batch shard and the returned loss is their mean — equal to the
+full-batch mean when every rank holds the same number of unmasked
+tokens (the standard data-parallel contract; ragged -100 masks make it
+a weighted mean, same as the reference DataParallel).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .fused_scan_step import FusedScanTrainStep, _donate_argnums, _key
+from ..utils import flags as _flags
+
+
+# ---------------------------------------------------------------------------
+# flat bucket packing (the comm_bucketer layout, applied per layer chunk)
+# ---------------------------------------------------------------------------
+
+def pack_flat(leaf_of_key, bucket, lead=(), dtype=None):
+    """Pack per-leaf arrays (each [*lead, *entry.shape]) into the
+    bucket's flat layout [*lead, bucket.numel] (zero-padded), matching
+    comm_bucketer._flatten_bucket offsets exactly. `dtype` overrides the
+    bucket dtype (moment packing)."""
+    dt = dtype or bucket.dtype
+    parts = []
+    for e in bucket.entries:
+        parts.append(leaf_of_key(e.key).reshape(lead + (-1,)).astype(dt))
+    pad = bucket.numel - sum(e.numel for e in bucket.entries)
+    if pad:
+        parts.append(jnp.zeros(lead + (pad,), dt))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, -1)
+
+
+def unpack_flat(flat, bucket):
+    """[*lead, bucket.numel] -> {entry.key: [*lead, *entry.shape]}."""
+    lead = flat.shape[:-1]
+    return {e.key: flat[..., e.offset:e.offset + e.numel]
+            .reshape(lead + tuple(e.shape)) for e in bucket.entries}
+
+
+def scatter_flat(flat, axis, nranks, quant=""):
+    """Reduce-scatter a packed flat bucket over `axis` along its LAST
+    dim: one collective per bucket (vs one per leaf), bit-identical to
+    comm_bucketer.bucketed_reduce_scatter's per-bucket psum_scatter on
+    the same packing. `quant` routes the compressed scatter leg."""
+    if quant:
+        from ..distributed.collective import quantized_psum_scatter_traced
+
+        return quantized_psum_scatter_traced(axis, nranks, quant)(flat)
+    return lax.psum_scatter(flat, axis, scatter_dimension=flat.ndim - 1,
+                            tiled=True)
+
+
+def _unwrap_layers(model):
+    """Follow wrapper chains (GroupShardedStage2, fleet MetaParallelBase,
+    DataParallel) to the Layer that owns the parameters."""
+    seen = set()
+    while hasattr(model, "_layers") and id(model) not in seen:
+        seen.add(id(model))
+        model = model._layers
+    return model
+
+
+def _vec_or_scalar(values, entries, numel, pad_value=0.0):
+    """Per-entry hyperparameters as ONE flat [numel] fp32 vector — or a
+    python float when uniform (padding entries update to zero regardless
+    of the hyperparameter, so a uniform scalar is exact)."""
+    uniq = set(values)
+    if len(uniq) == 1:
+        return float(values[0])
+    vec = np.full((numel,), pad_value, np.float32)
+    for e, v in zip(entries, values):
+        vec[e.offset:e.offset + e.numel] = v
+    return jnp.asarray(vec)
+
+
+class ShardedFusedScanTrainStep(FusedScanTrainStep):
+    """Multi-chip FusedScanTrainStep over a dp/sharding mesh axis.
+
+    Usage (directly, or via GroupShardedStage2.train_step /
+    fleet ShardingParallel.train_step which resolve mesh+axis)::
+
+        mesh = dist.env.build_mesh({"sharding": 8}); dist.env.set_mesh(mesh)
+        step = ShardedFusedScanTrainStep(model, opt)   # scan_layers model
+        loss = step(ids, labels)       # ids [global_batch, seq]
+
+    Optimizer state (moments + masters) lives as flat bucket-packed
+    arrays sharded 1/N over the axis (inspect
+    `opt._accumulators["moment1"]["__scan_shard_s0__"]` etc.);
+    ClipGradByGlobalNorm costs one scalar all-reduce, ClipGradByValue is
+    elementwise on the shard, and dropout is rank-folded per layer.
+    """
+
+    def __init__(self, model, optimizer, criterion=None, fused_head=False,
+                 compute_dtype=None, layer_chunk=1, scan_unroll=1,
+                 mesh=None, axis=None, group=None, comm_bucket_mb=None,
+                 comm_quant=None):
+        model = _unwrap_layers(model)
+        super().__init__(model, optimizer, criterion=criterion,
+                         fused_head=fused_head,
+                         compute_dtype=compute_dtype,
+                         layer_chunk=layer_chunk, scan_unroll=scan_unroll)
+        from ..distributed import env as denv
+
+        if group is not None:
+            mesh, axis = group.mesh, group.axes[0]
+        if mesh is None:
+            mesh = denv.get_mesh()
+        if axis is None:
+            axis = next((a for a in ("sharding", "dp")
+                         if a in mesh.axis_names and mesh.shape[a] > 1),
+                        mesh.axis_names[0])
+        self._mesh, self._axis = mesh, axis
+        self._degree = int(mesh.shape[axis])
+        if self._degree <= 1:
+            raise ValueError(
+                f"axis {axis!r} has degree {self._degree}; weight-update "
+                "sharding needs a >1 dp/sharding axis — use "
+                "FusedScanTrainStep on one chip")
+        # dp-rank folded into the per-layer dropout offsets
+        self._rng_nranks = self._degree
+        if comm_quant is None:
+            comm_quant = _flags.get_flag("FLAGS_comm_quant") or ""
+        self._comm_quant = comm_quant
+        from ..distributed.collective import QUANT_SCATTER_BLOCK
+        from ..distributed.comm_bucketer import MB, build_buckets
+
+        pad = self._degree * (QUANT_SCATTER_BLOCK if comm_quant else 1)
+        if comm_bucket_mb is None:
+            comm_bucket_mb = int(
+                _flags.get_flag("FLAGS_comm_bucket_mb") or 0)
+        bucket_bytes = (comm_bucket_mb * MB if comm_bucket_mb > 0
+                        else 1 << 62)
+        # stacked leaves bucket by their PER-LAYER shard shape (the scan
+        # scatters one chunk at a time); outer leaves by full shape
+        self._s_train = [(j, p) for j, p in enumerate(self._s_params)
+                         if p.trainable]
+        self._s_assign = build_buckets(
+            [(j, tuple(p.shape[1:]), p._data.dtype)
+             for j, p in self._s_train],
+            bucket_bytes=bucket_bytes, pad_multiple=pad)
+        self._o_assign = build_buckets(
+            [(j, tuple(p.shape), p._data.dtype)
+             for j, (_, p) in enumerate(self._o_params)],
+            bucket_bytes=bucket_bytes, pad_multiple=pad)
+
+    def _rng_rank(self):
+        return lax.axis_index(self._axis)
+
+    # -- flat sharded optimizer state -----------------------------------
+    def _flat_key(self, grp, index):
+        return f"__scan_shard_{grp}{index}__"
+
+    def _bucket_params(self, grp, bucket):
+        src = (dict(self._s_train) if grp == "s"
+               else {j: p for j, (_, p) in enumerate(self._o_params)})
+        return [src[e.key] for e in bucket.entries]
+
+    def _bucket_uses_master(self, grp, bucket):
+        return any(self._opt._use_master(p)
+                   for p in self._bucket_params(grp, bucket))
+
+    def _materialize_flat_state(self):
+        """Build (or repack) the optimizer state as per-bucket flat
+        arrays sharded 1/N over the axis. Fresh state is created
+        SHARDED from the start (jit with out_shardings — zeros for
+        moments, fp32 casts of the params for masters), so the first
+        build never materializes the full replicated optimizer state
+        the sharding exists to avoid; a continuation from per-param
+        state (prior TrainStep run, old checkpoint) packs the existing
+        full-shape entries once. Idempotent: an existing flat entry
+        (second build, checkpoint restore) is reused as-is."""
+        opt = self._opt
+        mesh, ax = self._mesh, self._axis
+        n_layers = self.model.config.num_layers
+        for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
+            stacked = grp == "s"
+            sharding = NamedSharding(
+                mesh, P(None, ax) if stacked else P(ax))
+            lead = (n_layers,) if stacked else ()
+            for bucket in assign.buckets:
+                fkey = self._flat_key(grp, bucket.index)
+                params = dict(zip([e.key for e in bucket.entries],
+                                  self._bucket_params(grp, bucket)))
+                use_mw = self._bucket_uses_master(grp, bucket)
+                md = self._moment_dtype(bucket, use_mw)
+
+                def packed(leaves, dtype):
+                    return jax.jit(
+                        lambda lv: pack_flat(lambda k: lv[k], bucket,
+                                             lead=lead, dtype=dtype),
+                        out_shardings=sharding)(leaves)
+
+                for name in ("moment1", "moment2"):
+                    store = opt._accumulators.setdefault(name, {})
+                    if fkey not in store:
+                        if all(_key(p) in store
+                               for p in params.values()):
+                            store[fkey] = packed(
+                                {k: store[_key(p)]
+                                 for k, p in params.items()}, md)
+                        else:
+                            shape = lead + (bucket.numel,)
+                            store[fkey] = jax.jit(
+                                lambda s=shape, d=md: jnp.zeros(s, d),
+                                out_shardings=sharding)()
+                    for p in params.values():
+                        store.pop(_key(p), None)
+                if use_mw:
+                    if fkey not in opt._master_weights:
+                        opt._master_weights[fkey] = packed(
+                            {k: opt._master_weights.get(_key(p),
+                                                        p._data)
+                             for k, p in params.items()},
+                            jnp.float32)
+                    for p in params.values():
+                        opt._master_weights.pop(_key(p), None)
+
+    def _moment_dtype(self, bucket, use_mw):
+        md = self._opt._moment_dtype
+        if md is not None:
+            return md
+        return jnp.float32 if use_mw else bucket.dtype
+
+    def ensure_built(self):
+        if self._jitted is not None:
+            return
+        self._materialize_flat_state()
+        # canonicalize replicated-state layouts BEFORE the first trace:
+        # the step's outputs come back mesh-committed, so an uncommitted
+        # single-device param on call 1 would key a SECOND executable on
+        # call 2 (the TrainStep._build layout lesson — one extra compile
+        # is minutes of axon program load at 1.3b)
+        rep = NamedSharding(self._mesh, P())
+        for p in self._s_params + [p for _, p in self._o_params]:
+            p._data = jax.device_put(p._data, rep)
+        for b in self._buffers:
+            b._data = jax.device_put(b._data, rep)
+        self._step_count = jax.device_put(
+            jnp.asarray(int(self._step_count), jnp.int32), rep)
+        self._build()
+
+    def _extract_state(self):
+        opt = self._opt
+        st = {
+            "s": {"p": [p._data for p in self._s_params]},
+            "o": {"p": [p._data for _, p in self._o_params]},
+            "buf": [b._data for b in self._buffers],
+            "step": jnp.asarray(self._step_count, jnp.int32),
+        }
+        for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
+            st[grp]["m"] = [opt._accumulators["moment1"]
+                            [self._flat_key(grp, b.index)]
+                            for b in assign.buckets]
+            st[grp]["v"] = [opt._accumulators["moment2"]
+                            [self._flat_key(grp, b.index)]
+                            for b in assign.buckets]
+            st[grp]["mw"] = [opt._master_weights.get(
+                self._flat_key(grp, b.index)) for b in assign.buckets]
+        return st
+
+    def _inject_state(self, state):
+        opt = self._opt
+        for p, d in zip(self._s_params, state["s"]["p"]):
+            p._data = d
+        for (_, p), d in zip(self._o_params, state["o"]["p"]):
+            p._data = d
+        for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
+            for b in assign.buckets:
+                fkey = self._flat_key(grp, b.index)
+                opt._accumulators["moment1"][fkey] = \
+                    state[grp]["m"][b.index]
+                opt._accumulators["moment2"][fkey] = \
+                    state[grp]["v"][b.index]
+                mw = state[grp]["mw"][b.index]
+                if mw is not None:
+                    opt._master_weights[fkey] = mw
+        for b, d in zip(self._buffers, state["buf"]):
+            b._data = d
+        opt._step_count = state["step"]
+        self._step_count = state["step"]
+
+    def _state_specs(self):
+        ax = self._axis
+        rep = P()
+        specs = {
+            "s": {"p": [rep] * len(self._s_params)},
+            "o": {"p": [rep] * len(self._o_params)},
+            "buf": [rep] * len(self._buffers),
+            "step": rep,
+        }
+        for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
+            sp = P(None, ax) if grp == "s" else P(ax)
+            nb = len(assign.buckets)
+            specs[grp]["m"] = [sp] * nb
+            specs[grp]["v"] = [sp] * nb
+            specs[grp]["mw"] = [
+                sp if self._bucket_uses_master(grp, b) else None
+                for b in assign.buckets]
+        return specs
+
+    # -- the compiled sharded step --------------------------------------
+    def _build(self):
+        opt = self._opt
+        mesh, ax, N = self._mesh, self._axis, self._degree
+        K = self._layer_chunk
+        n_layers = self.model.config.num_layers
+        C = n_layers // K
+        quant = self._comm_quant
+        s_assign, o_assign = self._s_assign, self._o_assign
+        inv_n = 1.0 / N
+
+        def hyper(p):
+            return (float(opt._decoupled_wd(p)), float(opt._l2_coeff(p)),
+                    float(opt._param_lr_scale(p)))
+
+        def bucket_hp(grp, bucket):
+            params = self._bucket_params(grp, bucket)
+            hs = [hyper(p) for p in params]
+            ent = bucket.entries
+            wd = _vec_or_scalar([h[0] for h in hs], ent, bucket.numel)
+            l2 = _vec_or_scalar([h[1] for h in hs], ent, bucket.numel)
+            lrs = _vec_or_scalar([h[2] for h in hs], ent, bucket.numel,
+                                 pad_value=1.0)
+            ncs = [1.0 if getattr(p, "need_clip", True) else 0.0
+                   for p in params]
+            # None = "everything clips" (the common case, no masking);
+            # a uniform 0.0 or a mixed vector masks the clip per entry
+            nc = (None if all(v == 1.0 for v in ncs)
+                  else _vec_or_scalar(ncs, ent, bucket.numel))
+            return wd, l2, lrs, nc
+
+        s_hp = [bucket_hp("s", b) for b in s_assign.buckets]
+        o_hp = [bucket_hp("o", b) for b in o_assign.buckets]
+        s_mw = [self._bucket_uses_master("s", b) for b in s_assign.buckets]
+        o_mw = [self._bucket_uses_master("o", b) for b in o_assign.buckets]
+        t_idx = {j: tj for tj, (j, _) in enumerate(self._s_train)}
+        cv = self._clip_value
+        clip_norm = self._clip_global
+
+        def shard_of(vec, rank, shard_len):
+            """Own-rank slice of a replicated flat [F] constant (no-op
+            for uniform scalars)."""
+            if vec is None or isinstance(vec, float):
+                return vec
+            return lax.dynamic_slice_in_dim(vec, rank * shard_len,
+                                            shard_len, 0)
+
+        chunk_apply = self._chunk_apply
+
+        def g_shard_f32(gs, nc_shard, scale):
+            """Scatter output -> the fp32 gradient the update consumes:
+            1/N for the data-parallel mean, value clip, global-norm
+            scale (need_clip-masked)."""
+            g32 = gs.astype(jnp.float32) * inv_n
+            if cv is not None:
+                clipped = jnp.clip(g32, cv[0], cv[1])
+                g32 = (clipped if nc_shard is None
+                       else nc_shard * clipped + (1 - nc_shard) * g32)
+            if scale is not None:
+                eff = (scale if nc_shard is None
+                       else nc_shard * scale + (1 - nc_shard))
+                g32 = g32 * eff
+            return g32
+
+        def sq_of(gs, nc_shard):
+            g32 = gs.astype(jnp.float32) * inv_n
+            if nc_shard is not None:
+                g32 = g32 * nc_shard
+            return jnp.sum(jnp.square(g32))
+
+        def adam_shard(pv, g32, m, v, lr_lrs, tf, wd, l2):
+            if not (isinstance(l2, float) and l2 == 0.0):
+                g32 = g32 + l2 * pv.astype(jnp.float32)
+            return opt._adam_math(pv, g32, m, v, None, lr_lrs, tf, wd)
+
+        def step_fn(state, lr, ids, labels):
+            s, o = state["s"], state["o"]
+            saved_buf = self._bind(self._buffers, state["buf"])
+            try:
+                t = state["step"] + 1
+                tf = t.astype(jnp.float32)
+                t32 = t.astype(jnp.int32)
+                rank = lax.axis_index(ax)
+                b, seq = ids.shape          # LOCAL batch rows
+                pos = jnp.arange(seq, dtype=ids.dtype)[None, :]
+
+                # ---- forward (replicated params, local batch shard)
+                x0 = self._embed_fn(o["p"], ids, pos,
+                                    rng_off=self._rng_base(t32, n_layers))
+                sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
+                             for a in s["p"])
+
+                def fwd_body(h, scanned):
+                    p_chunk, i = scanned
+                    return chunk_apply(p_chunk, h,
+                                       self._rng_chunk_base(t32, i)), h
+
+                xL, xs = lax.scan(fwd_body, x0, (sp_c, jnp.arange(C)),
+                                  unroll=self._scan_unroll)
+
+                loss, head_vjp = jax.vjp(
+                    lambda od, x: self._head_fn(od, x, labels),
+                    o["p"], xL)
+                d_o_head, dxL = head_vjp(jnp.ones((), loss.dtype))
+
+                # ---- backward scan: vjp one chunk, reduce-scatter its
+                # bucket-packed grads; ONLY the 1/N shard and the
+                # running squared norm survive the iteration
+                G0 = tuple(jnp.zeros((C, K, bkt.numel // N), bkt.dtype)
+                           for bkt in s_assign.buckets)
+
+                def bwd_body(carry, scanned):
+                    dy, sq, G = carry
+                    x_i, i = scanned
+                    p_i = tuple(
+                        lax.dynamic_index_in_dim(a, i, keepdims=False)
+                        for a in sp_c)
+                    rng0 = self._rng_chunk_base(t32, i)
+                    _, vjp = jax.vjp(
+                        lambda pl, xx: chunk_apply(pl, xx, rng0),
+                        p_i, x_i)
+                    dp, dx = vjp(dy)
+                    newG = []
+                    for bkt in s_assign.buckets:
+                        flat = pack_flat(lambda j: dp[j], bkt, lead=(K,))
+                        gs = scatter_flat(flat, ax, N, quant)  # [K,F/N]
+                        if clip_norm is not None:
+                            nc = shard_of(s_hp[bkt.index][3], rank,
+                                          bkt.numel // N)
+                            sq = sq + sq_of(gs, nc)
+                        newG.append(lax.dynamic_update_index_in_dim(
+                            G[bkt.index], gs, i, 0))
+                    return (dx, sq, tuple(newG)), None
+
+                (dx0, sq, G), _ = lax.scan(
+                    bwd_body, (dxL, jnp.float32(0.0), G0),
+                    (xs, jnp.arange(C)), reverse=True,
+                    unroll=self._scan_unroll)
+
+                # ---- outer grads: same pack + reduce-scatter
+                _, emb_vjp = jax.vjp(
+                    lambda od: self._embed_fn(
+                        od, ids, pos,
+                        rng_off=self._rng_base(t32, n_layers)), o["p"])
+                (d_o_emb,) = emb_vjp(dx0)
+                o_gs = []
+                for bkt in o_assign.buckets:
+                    flat = pack_flat(
+                        lambda j: (d_o_head[j].astype(jnp.float32)
+                                   + d_o_emb[j].astype(jnp.float32)),
+                        bkt)
+                    gs = scatter_flat(flat, ax, N, quant)      # [F/N]
+                    if clip_norm is not None:
+                        nc = shard_of(o_hp[bkt.index][3], rank,
+                                      bkt.numel // N)
+                        sq = sq + sq_of(gs, nc)
+                    o_gs.append(gs)
+
+                # ---- the fused global-norm clip: ONE scalar all-reduce
+                scale = None
+                if clip_norm is not None:
+                    gnorm = jnp.sqrt(lax.psum(sq, ax))
+                    scale = jnp.minimum(
+                        jnp.float32(clip_norm)
+                        / jnp.maximum(gnorm, 1e-12), 1.0)
+
+                # ---- update scan: sharded Adam on each chunk's grad
+                # shard, then all_gather the updated shard back into the
+                # replicated param stacks. Bucket b's gather is
+                # independent of bucket b+1's math (and, under
+                # scan_unroll>=2, of the next chunk's) — the overlap the
+                # HLO probe checks for.
+                sM = [m.reshape((C, K, -1)) for m in s["m"]]
+                sV = [v.reshape((C, K, -1)) for v in s["v"]]
+                sMW = [mw.reshape((C, K, -1)) if mw is not None else None
+                       for mw in s["mw"]]
+                P_tr0 = tuple(sp_c[j] for j, _ in self._s_train)
+
+                def upd_body(carry, i):
+                    P_tr, M, V, MW = carry
+                    for bkt in s_assign.buckets:
+                        bi = bkt.index
+                        shard_len = bkt.numel // N
+                        wd, l2, lrs, nc = (shard_of(h, rank, shard_len)
+                                           for h in s_hp[bi])
+                        g32 = g_shard_f32(
+                            lax.dynamic_index_in_dim(G[bi], i,
+                                                     keepdims=False),
+                            nc, scale)
+                        m_i = lax.dynamic_index_in_dim(M[bi], i,
+                                                       keepdims=False)
+                        v_i = lax.dynamic_index_in_dim(V[bi], i,
+                                                       keepdims=False)
+                        if MW[bi] is not None:
+                            pv = lax.dynamic_index_in_dim(
+                                MW[bi], i, keepdims=False)
+                        else:
+                            # fp32-stored params ARE the master: slice
+                            # this rank's shard out of the replicated
+                            # chunk (bit-exact round trip via the
+                            # gather below)
+                            flat_p = pack_flat(
+                                lambda j: lax.dynamic_index_in_dim(
+                                    P_tr[t_idx[j]], i, keepdims=False),
+                                bkt, lead=(K,))
+                            pv = lax.dynamic_slice_in_dim(
+                                flat_p, rank * shard_len, shard_len, 1)
+                        out32, mn, vn, _ = adam_shard(
+                            pv, g32, m_i, v_i, lr * lrs, tf, wd, l2)
+                        M[bi] = lax.dynamic_update_index_in_dim(
+                            M[bi], mn.astype(M[bi].dtype), i, 0)
+                        V[bi] = lax.dynamic_update_index_in_dim(
+                            V[bi], vn.astype(V[bi].dtype), i, 0)
+                        if MW[bi] is not None:
+                            MW[bi] = lax.dynamic_update_index_in_dim(
+                                MW[bi], out32, i, 0)
+                        full = lax.all_gather(
+                            out32.astype(bkt.dtype), ax, axis=1,
+                            tiled=True)                     # [K, F]
+                        for e_key, leaf in unpack_flat(full, bkt).items():
+                            tj = t_idx[e_key]
+                            P_tr = P_tr[:tj] + (
+                                lax.dynamic_update_index_in_dim(
+                                    P_tr[tj],
+                                    leaf.astype(P_tr[tj].dtype), i, 0),
+                            ) + P_tr[tj + 1:]
+                    return (P_tr, M, V, MW), None
+
+                (P_tr, sM, sV, sMW), _ = lax.scan(
+                    upd_body, (P_tr0, list(sM), list(sV), list(sMW)),
+                    jnp.arange(C), unroll=self._scan_unroll)
+
+                new_sp = list(s["p"])
+                for tj, (j, _) in enumerate(self._s_train):
+                    new_sp[j] = P_tr[tj].reshape(
+                        (-1,) + tuple(P_tr[tj].shape[2:]))
+
+                # ---- outer update (no scan)
+                new_op = list(o["p"])
+                new_om, new_ov, new_omw = [], [], []
+                for bkt in o_assign.buckets:
+                    bi = bkt.index
+                    shard_len = bkt.numel // N
+                    wd, l2, lrs, nc = (shard_of(h, rank, shard_len)
+                                       for h in o_hp[bi])
+                    g32 = g_shard_f32(o_gs[bi], nc, scale)
+                    m_i, v_i = o["m"][bi], o["v"][bi]
+                    if o["mw"][bi] is not None:
+                        pv = o["mw"][bi]
+                    else:
+                        flat_p = pack_flat(lambda j: o["p"][j], bkt)
+                        pv = lax.dynamic_slice_in_dim(
+                            flat_p, rank * shard_len, shard_len, 0)
+                    out32, mn, vn, _ = adam_shard(
+                        pv, g32, m_i, v_i, lr * lrs, tf, wd, l2)
+                    new_om.append(mn.astype(m_i.dtype))
+                    new_ov.append(vn.astype(v_i.dtype))
+                    new_omw.append(out32 if o["mw"][bi] is not None
+                                   else None)
+                    full = lax.all_gather(out32.astype(bkt.dtype), ax,
+                                          axis=0, tiled=True)
+                    for e_key, leaf in unpack_flat(full, bkt).items():
+                        new_op[e_key] = leaf.astype(
+                            o["p"][e_key].dtype)
+
+                new_state = {
+                    "s": {"p": new_sp,
+                          "m": [m.reshape((n_layers, -1)) for m in sM],
+                          "v": [v.reshape((n_layers, -1)) for v in sV],
+                          "mw": [mw.reshape((n_layers, -1))
+                                 if mw is not None else None
+                                 for mw in sMW]},
+                    "o": {"p": new_op, "m": new_om, "v": new_ov,
+                          "mw": new_omw},
+                    "buf": state["buf"],
+                    "step": t,
+                }
+                return lax.psum(loss, ax) * inv_n, new_state
+            finally:
+                self._bind(self._buffers, saved_buf)
+
+        specs = self._state_specs()
+        batch_spec = P(ax, None)
+        wrapped = jax.shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(specs, P(), batch_spec, batch_spec),
+            out_specs=(P(), specs), check_vma=False)
+        self._jitted = jax.jit(wrapped,
+                               donate_argnums=_donate_argnums())
+
+    def __call__(self, ids, labels):
+        shape = getattr(ids, "shape", None)
+        if shape and shape[0] % self._degree:
+            raise ValueError(
+                f"global batch {shape[0]} is not divisible by the "
+                f"{self._axis!r} degree {self._degree}")
+        return super().__call__(ids, labels)
+
+
+# ---------------------------------------------------------------------------
+# selection wiring (group_sharded / fleet distributed_model entry points)
+# ---------------------------------------------------------------------------
+
+def select_train_step(model, optimizer, criterion=None, mesh=None,
+                      axis=None, **kw):
+    """The train-step chooser GroupShardedStage2 / ShardingParallel use:
+    scan_layers GPT on a >1 sharding/dp axis -> ShardedFusedScanTrainStep;
+    degree 1 -> FusedScanTrainStep; anything else -> the generic
+    TrainStep over `criterion` (or model.loss)."""
+    from ..distributed import env as denv
+    from ..models.gpt import GPTStackedBlocks
+
+    layers = _unwrap_layers(model)
+    blocks = getattr(getattr(layers, "gpt", None), "blocks", None)
+    scan = isinstance(blocks, GPTStackedBlocks)
+    if mesh is None and denv.is_initialized():
+        mesh = denv.get_mesh()
+    degree = 1
+    if mesh is not None:
+        if axis is None:
+            axis = next((a for a in ("sharding", "dp")
+                         if a in mesh.axis_names and mesh.shape[a] > 1),
+                        None)
+        if axis is not None:
+            degree = int(mesh.shape[axis])
+    if scan and degree > 1:
+        return ShardedFusedScanTrainStep(layers, optimizer,
+                                         criterion=criterion, mesh=mesh,
+                                         axis=axis, **kw)
+    if scan:
+        return FusedScanTrainStep(layers, optimizer, criterion=criterion,
+                                  **{k: v for k, v in kw.items()
+                                     if k in ("fused_head",
+                                              "compute_dtype",
+                                              "layer_chunk",
+                                              "scan_unroll")})
+    from .train_step import TrainStep
+
+    if criterion is not None:
+        return TrainStep(model, lambda m, a, b: criterion(m(a), b),
+                         optimizer)
+    return TrainStep(model, lambda m, a, b: m.loss(a, b), optimizer)
+
+
+# ---------------------------------------------------------------------------
+# HLO probe program (tools/hlo_overlap.py --probe, bench --multichip)
+# ---------------------------------------------------------------------------
+
+def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1):
+    """Lower (not run) the sharded step for a tiny scan GPT on an
+    n-device host mesh — the program the overlap checker inspects."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    devs = jax.devices("cpu")[:n_devices] if jax.default_backend() == \
+        "cpu" else jax.devices()[:n_devices]
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"{len(devs)} devices < {n_devices} "
+            "(set --xla_force_host_platform_device_count)")
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devs), ("sharding",))
+    denv.set_mesh(mesh)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                    num_attention_heads=2, max_position_embeddings=32,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    scan_layers=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                     grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    step = ShardedFusedScanTrainStep(model, opt, mesh=mesh,
+                                     axis="sharding",
+                                     scan_unroll=scan_unroll,
+                                     layer_chunk=layer_chunk)
+    step.ensure_built()
+    state = step._extract_state()
+    lr = jnp.float32(1e-3)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (n_devices, 16)),
+                      jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                      (n_devices, 16)), jnp.int32)
+    return step._jitted.lower(state, lr, ids, labels)

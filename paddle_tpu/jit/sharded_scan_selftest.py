@@ -1,0 +1,202 @@
+"""Hermetic parity selftest for the sharded fused-scan train step.
+
+Run under a cpu-forced env (bench.py's stripped subprocess /
+tools/cpu_env.sh) with an 8-virtual-device host platform:
+
+    python -m paddle_tpu.jit.sharded_scan_selftest [--multichip]
+
+Asserts, on one process, the ISSUE 3 acceptance triangle with
+ClipGradByGlobalNorm active and per-rank 1/N optimizer-state sharding
+verified on live shapes:
+
+    eager TrainStep + clip  ==  FusedScanTrainStep (two-pass clip)
+                            ==  ShardedFusedScanTrainStep (8-rank mesh,
+                                in-scan reduce-scatter + fused clip)
+
+loss trajectories within fp32 tolerance, final params within rel tol,
+and the clip ACTIVE (the clipped trajectory must differ from a no-clip
+run — an inert clip would pass trivially). A dropout lane checks the
+sharded step trains deterministically with dropout enabled. Prints ONE
+JSON line with the measured max deviations and the gates, so tolerances
+land verbatim in BENCH_r*.json.
+
+--multichip additionally compiles the sharded probe program
+(scan_unroll=2) and runs tools/hlo_overlap.py's checker over its HLO —
+the async start/done overlap receipt on chips, the scheduled/potential
+interleave proxy on the CPU host mesh (MULTICHIP_r*.json).
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+TOL = {
+    "loss_abs": 5e-4,       # fp32 reduction-order noise over 4 steps
+    "loss_rel": 5e-4,
+    "param_rel": 5e-3,      # amplified by adam's sqrt(v) at aggressive lr
+    "param_abs": 5e-4,
+}
+
+TINY = dict(vocab_size=96, hidden_size=32, num_layers=4,
+            num_attention_heads=2, max_position_embeddings=16,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+def _batch(bs, seq=16, vocab=96, seed=0):
+    import paddle_tpu as paddle
+
+    rng = np.random.default_rng(seed)
+    return (paddle.to_tensor(rng.integers(0, vocab, (bs, seq)),
+                             dtype="int64"),
+            paddle.to_tensor(rng.integers(0, vocab, (bs, seq)),
+                             dtype="int64"))
+
+
+def parity_probe(n_devices=8, steps=4, lr=1e-2, clip_norm=0.05,
+                 seed=0):
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.jit import (
+        FusedScanTrainStep, ShardedFusedScanTrainStep, TrainStep,
+    )
+    from paddle_tpu.models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    devs = jax.devices("cpu")[:n_devices]
+    if len(devs) < n_devices:
+        return {"check": f"FAIL: {len(devs)} cpu devices < {n_devices}"}
+    crit = GPTPretrainingCriterion()
+    ids, labels = _batch(bs=n_devices, vocab=TINY["vocab_size"],
+                         seed=seed)
+
+    def build(step_kind, clip, **kw):
+        cfg = GPTConfig(**{**TINY, **kw.pop("cfg_over", {})},
+                        scan_layers=True)
+        paddle.seed(seed)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(
+            learning_rate=lr, parameters=model.parameters(),
+            grad_clip=(nn.ClipGradByGlobalNorm(clip_norm)
+                       if clip else None))
+        if step_kind == "eager":
+            step = TrainStep(model, lambda m, a, b: crit(m(a), b), opt)
+        elif step_kind == "fused":
+            step = FusedScanTrainStep(model, opt, criterion=crit)
+        else:
+            step = ShardedFusedScanTrainStep(model, opt, criterion=crit,
+                                             **kw)
+        losses = [float(step(ids, labels)) for _ in range(steps)]
+        return losses, model, opt
+
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devs), ("sharding",))
+    denv.set_mesh(mesh)
+
+    eager, m_eager, _ = build("eager", clip=True)
+    noclip, _, _ = build("eager", clip=False)
+    fused, _, _ = build("fused", clip=True)
+    sharded, m_sh, opt_sh = build("sharded", clip=True, mesh=mesh,
+                                  axis="sharding")
+
+    def ldiff(a, b):
+        return float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+
+    def pdiff(m1, m2):
+        worst = 0.0
+        for (n1, p1), (_, p2) in zip(m1.named_parameters(),
+                                     m2.named_parameters()):
+            a = np.asarray(p1._data, np.float32)
+            b = np.asarray(p2._data, np.float32)
+            d = np.abs(a - b) / (np.abs(a) + TOL["param_abs"])
+            worst = max(worst, float(np.max(d)))
+        return worst
+
+    d_fused = ldiff(eager, fused)
+    d_shard = ldiff(eager, sharded)
+    p_shard = pdiff(m_eager, m_sh)
+    clip_active = ldiff(eager, noclip) > 10 * TOL["loss_abs"]
+
+    # per-rank 1/N optimizer-state sharding, asserted on live shapes
+    flat = opt_sh._accumulators["moment1"]["__scan_shard_s0__"]
+    local = flat.addressable_shards[0].data.shape
+    sharded_ok = (local[-1] * n_devices == flat.shape[-1]
+                  and len(flat.addressable_shards) == n_devices)
+
+    # dropout lane: deterministic, finite, distinct from p=0
+    drop1, _, _ = build("sharded", clip=True, mesh=mesh, axis="sharding",
+                        cfg_over=dict(hidden_dropout_prob=0.1))
+    drop2, _, _ = build("sharded", clip=True, mesh=mesh, axis="sharding",
+                        cfg_over=dict(hidden_dropout_prob=0.1))
+    drop_ok = (drop1 == drop2 and np.isfinite(drop1).all()
+               and drop1 != sharded)
+
+    ok = (d_fused < TOL["loss_abs"] and d_shard < TOL["loss_abs"]
+          and p_shard < TOL["param_rel"] and clip_active and sharded_ok
+          and drop_ok)
+    return {
+        "check": "pass" if ok else
+        f"FAIL: fused={d_fused:.2e} sharded={d_shard:.2e} "
+        f"param={p_shard:.2e} clip_active={clip_active} "
+        f"state_sharded={sharded_ok} dropout={drop_ok}",
+        "n_devices": n_devices, "steps": steps,
+        "clip_norm": clip_norm, "lr": lr,
+        "max_abs_loss_diff_fused_vs_eager": round(d_fused, 9),
+        "max_abs_loss_diff_sharded_vs_eager": round(d_shard, 9),
+        "max_param_rel_diff_sharded_vs_eager": round(p_shard, 7),
+        "clip_active": bool(clip_active),
+        "opt_state_flat_shape": list(flat.shape),
+        "opt_state_local_shard": list(local),
+        "dropout_deterministic": bool(drop_ok),
+        "tolerances": TOL,
+    }
+
+
+def _load_hlo_overlap():
+    """tools/ is repo-root only (not a package); load by path with a
+    namespace-package fallback."""
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "tools", "hlo_overlap.py")
+    if os.path.exists(path):
+        spec = importlib.util.spec_from_file_location("hlo_overlap", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    import tools.hlo_overlap as mod  # namespace-package fallback
+
+    return mod
+
+
+def hlo_overlap_probe(n_devices=8, scan_unroll=2):
+    from .sharded_scan import build_probe_lowered
+
+    mod = _load_hlo_overlap()
+    text = build_probe_lowered(n_devices=n_devices,
+                               scan_unroll=scan_unroll).compile() \
+        .as_text()
+    verdict = mod.analyze(text)
+    verdict["probe"] = {"n_devices": n_devices,
+                        "scan_unroll": scan_unroll,
+                        "model": "tiny-gpt L4 h64"}
+    return verdict
+
+
+def _main():
+    out = {"sharded_scan_parity": parity_probe()}
+    if "--multichip" in sys.argv:
+        out["hlo_overlap"] = hlo_overlap_probe()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    _main()

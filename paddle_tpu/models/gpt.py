@@ -297,26 +297,55 @@ class GPTStackedBlocks(nn.Layer):
             self.add_parameter(flat, param)
             self._stacked_names.append((flat, pname))
 
+    # PRNG draws reserved per scanned layer (2 hidden dropouts +
+    # attention dropout + slack): the scan body traces ONCE, so without
+    # a per-layer generator offset every layer would share one dropout
+    # mask. Binding offset = base + layer_index * _RNG_SLOTS inside the
+    # body gives each layer its own key stream — deterministic under
+    # paddle.seed, replayed identically by jax.checkpoint's recompute.
+    _RNG_SLOTS = 8
+
     def forward(self, x):
         import jax
 
         from ..framework.autograd import apply_op, no_grad
         from ..framework.tensor import Tensor
+        from ..framework import random as _random
 
         template = self._template
         leaves = [p for _, p in template.named_parameters()]
         training = self.training
+        # the template is attached via object.__setattr__ (not a
+        # registered sublayer), so model.train()/eval() never reach its
+        # children — propagate the mode explicitly or the template's
+        # Dropout layers would stay training=True in eval forever
+        template.train() if training else template.eval()
         cfg = self.config
+        n = cfg.num_layers
+        drop_active = training and (cfg.hidden_dropout_prob
+                                    or cfg.attention_dropout_prob)
+        gen = _random.default_generator()
+        base_off = None
+        if drop_active:
+            base_off = gen._offset
+            if isinstance(base_off, jax.Array) and not isinstance(
+                    base_off, jax.core.Tracer):
+                base_off = int(base_off)
 
-        def one_layer(h, layer_leaves):
+        def one_layer(h, scanned):
+            idx, layer_leaves = scanned[0], scanned[1:]
             with no_grad():
                 saved = [p._data for p in leaves]
+                saved_off = gen._offset
+                if base_off is not None:
+                    gen._offset = base_off + idx * self._RNG_SLOTS
                 for p, d in zip(leaves, layer_leaves):
                     p._data = d
                 template.training = training
                 try:
                     y = template._inner(Tensor._wrap(h))._data
                 finally:
+                    gen._offset = saved_off
                     for p, d in zip(leaves, saved):
                         p._data = d
             return y, None
@@ -333,10 +362,16 @@ class GPTStackedBlocks(nn.Layer):
                    self._stacked_names]
 
         def scanfn(h, *stk):
-            out, _ = jax.lax.scan(one_layer, h, list(stk))
+            out, _ = jax.lax.scan(one_layer, h,
+                                  (jax.numpy.arange(n),) + tuple(stk))
             return out
 
-        return apply_op(scanfn, [x] + stacked, name="gpt_scan_blocks")
+        out = apply_op(scanfn, [x] + stacked, name="gpt_scan_blocks")
+        if base_off is not None:
+            # reserve the layers' draw window so later eager consumers
+            # (and the next forward) don't collide with in-scan keys
+            gen._offset = base_off + n * self._RNG_SLOTS
+        return out
 
 
 class GPTModel(nn.Layer):
@@ -347,14 +382,6 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(config.max_position_embeddings,
                                 config.hidden_size)
         self.drop = nn.Dropout(config.hidden_dropout_prob)
-        if config.scan_layers and (config.hidden_dropout_prob
-                                   or config.attention_dropout_prob):
-            # the scan body traces once, so eager dropout keys would be
-            # shared by every layer — refuse rather than silently
-            # correlate masks across layers
-            raise ValueError(
-                "scan_layers=True requires zero dropout (per-layer "
-                "RNG is not threaded through the scan yet)")
         if config.scan_layers:
             self.blocks = GPTStackedBlocks(config)
         else:

@@ -120,7 +120,7 @@ def test_loss_decreases_and_state_advances():
     assert fused[-1] < fused[0]
 
 
-def test_rejects_unrolled_model_and_clip():
+def test_rejects_unrolled_model_and_unsupported_clip():
     cfg = GPTConfig(**TINY, scan_layers=False)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -128,14 +128,65 @@ def test_rejects_unrolled_model_and_clip():
     with pytest.raises(ValueError, match="scan_layers"):
         FusedScanTrainStep(model, opt)
 
+    import paddle_tpu.nn as nn
+
+    # ClipGradByGlobalNorm and ClipGradByValue are SUPPORTED now (the
+    # deferred-norm two-pass / elementwise in-scan paths); per-tensor
+    # ClipGradByNorm needs a whole stacked leaf's grad — precise error
     cfg2 = GPTConfig(**TINY, scan_layers=True)
     paddle.seed(0)
     model2 = GPTForCausalLM(cfg2)
-    import paddle_tpu.nn as nn
     opt2 = popt.AdamW(learning_rate=1e-3, parameters=model2.parameters(),
                       grad_clip=nn.ClipGradByGlobalNorm(1.0))
-    with pytest.raises(ValueError, match="clip"):
-        FusedScanTrainStep(model2, opt2)
+    FusedScanTrainStep(model2, opt2)   # accepted
+
+    paddle.seed(0)
+    model3 = GPTForCausalLM(GPTConfig(**TINY, scan_layers=True))
+    opt3 = popt.AdamW(learning_rate=1e-3, parameters=model3.parameters(),
+                      grad_clip=nn.ClipGradByNorm(1.0))
+    with pytest.raises(ValueError, match="ClipGradByNorm"):
+        FusedScanTrainStep(model3, opt3)
+
+
+def test_global_norm_clip_parity():
+    """ClipGradByGlobalNorm via the deferred-norm two-pass must track the
+    eager TrainStep trajectory exactly in fp32. lr is large so the clip
+    is ACTIVE (scale < 1) from step 1 — an inert clip would pass
+    trivially."""
+    import paddle_tpu.nn as nn
+
+    kw = dict(opt_kw=dict(grad_clip=nn.ClipGradByGlobalNorm(0.1)))
+    base, m_base = _run(TrainStep, scan_layers=True, **kw)
+    fused, m_fused = _run(FusedScanTrainStep, scan_layers=True, **kw)
+    np.testing.assert_allclose(base, fused, rtol=2e-5, atol=1e-6)
+    for (n1, p1), (n2, p2) in zip(m_base.named_parameters(),
+                                  m_fused.named_parameters()):
+        np.testing.assert_allclose(
+            np.asarray(p1._data, np.float32),
+            np.asarray(p2._data, np.float32), rtol=1e-4, atol=1e-5,
+            err_msg=n1)
+
+
+def test_value_clip_parity():
+    import paddle_tpu.nn as nn
+
+    kw = dict(opt_kw=dict(grad_clip=nn.ClipGradByValue(0.001)))
+    base, _ = _run(TrainStep, scan_layers=True, **kw)
+    fused, _ = _run(FusedScanTrainStep, scan_layers=True, **kw)
+    np.testing.assert_allclose(base, fused, rtol=2e-5, atol=1e-6)
+
+
+def test_dropout_deterministic_and_trains():
+    """Dropout inside the scan: the per-layer PRNG offset scheme must be
+    deterministic across fresh builds (same seed -> bit-identical
+    trajectory) and actually active (differs from the p=0 trajectory)."""
+    kw = dict(hidden_dropout_prob=0.1, attention_dropout_prob=0.0)
+    a, _ = _run(FusedScanTrainStep, scan_layers=True, steps=3, **kw)
+    b, _ = _run(FusedScanTrainStep, scan_layers=True, steps=3, **kw)
+    assert a == b, (a, b)
+    base, _ = _run(FusedScanTrainStep, scan_layers=True, steps=3)
+    assert a != base
+    assert np.isfinite(a).all()
 
 
 def test_fused_head_parity():

@@ -1,0 +1,218 @@
+"""HLO collective-overlap checker (ISSUE 3 CI/tooling satellite).
+
+Extends the `-start(`/`-done(` counting of
+paddle_tpu/distributed/comm_bucketer._COLLECTIVE_RE into a structural
+checker over the COMPILED (scheduled) HLO: did XLA actually arrange the
+program so collectives can run while compute proceeds?
+
+Two modes, chosen by what the backend emits:
+
+- **async** (TPU, GPU): collectives appear as `<kind>-start` /
+  `<kind>-done` pairs. A pair "brackets compute" when >= 1 real compute
+  instruction (fusion/dot/convolution/reduce/sort) is scheduled between
+  the start and its done — the latency-hiding scheduler's visible
+  receipt that the collective overlaps compute. We count pairs, and the
+  interleave depth (max compute ops bracketed by one pair).
+
+- **sync** (XLA:CPU — the hermetic host-mesh lane): collectives are
+  single sync ops; the thunk runtime overlaps them internally but the
+  HLO shows no start/done. Here the checker measures (a)
+  `scheduled_interleaved`: collectives with >= 1 compute op scheduled
+  between them and their first consumer (the module is
+  `is_scheduled=true`, so order IS execution order), and (b)
+  `overlap_potential`: collectives with >= 1 LATER compute op that is
+  NOT transitively data-dependent on the collective's result — exactly
+  the instructions an async scheduler may slide into the collective's
+  shadow. The multichip lane records both so the CPU record is honest
+  about being a proxy; the async numbers land when the same probe runs
+  on a real chip.
+
+Standalone:
+    python tools/hlo_overlap.py <hlo_text_file> [--assert-overlap]
+    python tools/hlo_overlap.py --probe [--assert-overlap]
+`--probe` builds the sharded fused-scan train step on the host mesh
+(requires JAX_PLATFORMS=cpu + xla_force_host_platform_device_count, the
+bench.py _run_cpu_probe env) and analyzes its compiled HLO. Invoked by
+`bench.py --multichip` via paddle_tpu.jit.sharded_scan_selftest; the
+verdict lands in MULTICHIP_r*.json.
+"""
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+# "real compute" for bracketing purposes: ops that burn cycles, not
+# layout/bookkeeping (bitcast, tuple, get-tuple-element, copy, ...)
+COMPUTE_OPS = ("fusion", "dot", "convolution", "reduce",
+               "reduce-window", "sort", "select-and-scatter", "scatter")
+
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*[^=]*?\s"
+    r"(?P<op>[\w\-]+)\(")
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*->")
+_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def parse_computations(text):
+    """-> {computation_name: [(instr_name, op, [operand_names])]} in
+    scheduled order (compiled modules print is_scheduled=true)."""
+    comps = {}
+    cur = None
+    for line in text.splitlines():
+        if not line.startswith(" ") and _COMP_RE.match(line) \
+                and line.rstrip().endswith("{"):
+            cur = _COMP_RE.match(line).group("name")
+            comps[cur] = []
+            continue
+        if line.startswith("}"):
+            cur = None
+            continue
+        if cur is None:
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, op = m.group("name"), m.group("op")
+        # operands: %refs after the '=' excluding the def itself; strip
+        # metadata= / calls= tails conservatively (calls=%comp refs do
+        # not collide with instruction names in practice)
+        rhs = line.split("=", 1)[1]
+        refs = [r for r in _REF_RE.findall(rhs) if r != name]
+        comps[cur].append((name, op, refs))
+    return comps
+
+
+def _is_compute(op):
+    return op in COMPUTE_OPS
+
+
+def _collective_kind(op):
+    for k in COLLECTIVE_KINDS:
+        if op == k or op == k + "-start":
+            return k
+    return None
+
+
+def analyze(text):
+    comps = parse_computations(text)
+    async_pairs = []
+    sync_colls = []
+    counts = {k: 0 for k in COLLECTIVE_KINDS}
+    for cname, instrs in comps.items():
+        for i, (name, op, refs) in enumerate(instrs):
+            kind = _collective_kind(op)
+            if kind is None:
+                continue
+            counts[kind] += 1
+            if op.endswith("-start"):
+                # find the matching -done consuming this value
+                done_i = None
+                for j in range(i + 1, len(instrs)):
+                    n2, op2, refs2 = instrs[j]
+                    if op2 == kind + "-done" and name in refs2:
+                        done_i = j
+                        break
+                bracketed = 0
+                if done_i is not None:
+                    bracketed = sum(
+                        1 for j in range(i + 1, done_i)
+                        if _is_compute(instrs[j][1]))
+                async_pairs.append({
+                    "kind": kind, "computation": cname, "start": name,
+                    "matched": done_i is not None,
+                    "bracketed_compute": bracketed})
+                continue
+            # sync collective: scheduled window to first consumer +
+            # overlap potential (later compute independent of the result)
+            first_use = None
+            dependent = {name}
+            independent_after = 0
+            window = 0
+            for j in range(i + 1, len(instrs)):
+                n2, op2, refs2 = instrs[j]
+                if any(r in dependent for r in refs2):
+                    dependent.add(n2)
+                    if first_use is None:
+                        first_use = j
+                    continue
+                if _is_compute(op2):
+                    independent_after += 1
+                    if first_use is None:
+                        window += 1
+            sync_colls.append({
+                "kind": kind, "computation": cname, "name": name,
+                "scheduled_window_compute": window,
+                "independent_compute_after": independent_after})
+    n_async_ok = sum(1 for p in async_pairs
+                     if p["matched"] and p["bracketed_compute"] >= 1)
+    scheduled = sum(1 for s in sync_colls
+                    if s["scheduled_window_compute"] >= 1)
+    potential = sum(1 for s in sync_colls
+                    if s["independent_compute_after"] >= 1)
+    depth = max(
+        [p["bracketed_compute"] for p in async_pairs if p["matched"]]
+        + [s["scheduled_window_compute"] for s in sync_colls]
+        + [0])
+    pot_depth = max(
+        [s["independent_compute_after"] for s in sync_colls] + [0])
+    return {
+        "mode": "async" if async_pairs else "sync",
+        "counts": {k: v for k, v in counts.items() if v},
+        "async_pairs": len(async_pairs),
+        "async_pairs_bracketing_compute": n_async_ok,
+        "sync_collectives": len(sync_colls),
+        "sync_scheduled_interleaved": scheduled,
+        "sync_overlap_potential": potential,
+        "interleave_depth": depth,
+        "overlap_potential_depth": pot_depth,
+        "overlap_ok": bool(n_async_ok >= 1 if async_pairs
+                           else potential >= 1),
+    }
+
+
+def assert_overlap(verdict):
+    """Raise unless the program shows overlap: >= 1 async pair
+    bracketing compute (async backends), or >= 1 collective with
+    independent later compute for the scheduler to hide it behind
+    (sync/CPU proxy)."""
+    if not verdict["overlap_ok"]:
+        raise AssertionError(
+            f"no collective/compute overlap in HLO: {verdict}")
+    return verdict
+
+
+def _build_probe_hlo():
+    """Compile the sharded fused-scan step on the ambient host mesh and
+    return its optimized HLO text (caller provides the cpu-forced env)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from paddle_tpu.jit.sharded_scan import build_probe_lowered
+
+    return build_probe_lowered().compile().as_text()
+
+
+def main(argv):
+    do_assert = "--assert-overlap" in argv
+    argv = [a for a in argv if a != "--assert-overlap"]
+    if "--probe" in argv:
+        text = _build_probe_hlo()
+    elif argv:
+        with open(argv[0]) as f:
+            text = f.read()
+    else:
+        text = sys.stdin.read()
+    verdict = analyze(text)
+    print(json.dumps(verdict))
+    if do_assert:
+        assert_overlap(verdict)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
