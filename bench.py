@@ -538,6 +538,17 @@ def run_selftest():
         assert lane.get("check") == "pass", lane
         results["sharded_scan_parity_detail"] = lane
 
+    def fault_tolerance():
+        # ISSUE 4: crash-safe checkpointing — victim subprocess
+        # SIGKILLed mid-save resumes from the last committed step, a
+        # flipped byte is caught by the manifest, save-restore-continue
+        # is bit-identical, async save blocks only for the snapshot
+        rec = _run_cpu_probe(
+            "paddle_tpu.distributed.checkpoint.ft_selftest",
+            extra_args=("--trials", "6"), n_devices=1)
+        assert rec.get("check") == "pass", rec
+        results["fault_tolerance_detail"] = rec
+
     check("pallas_flash_single_block_s512", lambda: flash(512))
     check("pallas_flash_tiled_s2048", lambda: flash(2048))
     check("int8_weight_only_matmul", int8_matmul)
@@ -545,6 +556,7 @@ def run_selftest():
     check("bucketed_reduce_scatter_parity", bucketed_rs_parity)
     check("decode_parity", decode_parity)
     check("sharded_scan_parity", sharded_scan_parity)
+    check("fault_tolerance", fault_tolerance)
     return results
 
 
@@ -627,14 +639,93 @@ _LIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".bench_live")
 
 
+_PATH_HASH_CACHE = None
+
+
+def _lowered_step_text():
+    """Lower (AOT, never execute) miniature versions of BOTH bench step
+    programs — the generic TrainStep (the 350m primary) and the
+    FusedScanTrainStep (the 1.3b north star) — on the CPU backend and
+    return their StableHLO text. Everything that shapes the real
+    programs' HLO (ops dispatch, tensor machinery, model code, optimizer
+    math, the step classes themselves) flows through this text."""
+    import jax
+    import jax.numpy as jnp
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        import paddle_tpu as paddle
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.jit import FusedScanTrainStep, TrainStep
+        from paddle_tpu.models import (
+            GPTForCausalLM, GPTConfig, GPTPretrainingCriterion,
+        )
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_attention_heads=2,
+                        max_position_embeddings=16,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0, scan_layers=True)
+        paddle.seed(0)
+        crit = GPTPretrainingCriterion()
+        ids = jnp.zeros((2, 16), jnp.int32)
+        texts = []
+
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=1e-4,
+                         parameters=model.parameters(),
+                         moment_dtype="bfloat16")
+        fstep = FusedScanTrainStep(model, opt, criterion=crit,
+                                   compute_dtype="bfloat16")
+        fstep.ensure_built()
+        lowered = fstep._jitted.lower(fstep._extract_state(),
+                                      jnp.float32(1e-4), ids, ids)
+        texts.append(lowered.as_text())
+
+        cfg2 = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_attention_heads=2,
+                         max_position_embeddings=16,
+                         hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0, scan_layers=False)
+        paddle.seed(0)
+        model2 = GPTForCausalLM(cfg2)
+        opt2 = popt.AdamW(learning_rate=1e-4,
+                          parameters=model2.parameters())
+        tstep = TrainStep(model2, lambda m, a, b: crit(m(a), b), opt2)
+        tstep._warmup_accumulators()
+        tstep._build([ids, ids])
+        lowered2 = tstep._jitted.lower(tstep._extract_state(),
+                                       jnp.float32(1e-4), [ids, ids])
+        texts.append(lowered2.as_text())
+        return "\n".join(texts)
+
+
 def _compute_path_hash():
-    """Hash of the files that shape the 1.3b step's HLO: a recorded live
-    measurement is only attached as current while these are unchanged."""
+    """Fingerprint of the bench step's LOWERED HLO (VERDICT r5 honesty
+    nit #8b): a recorded live measurement is attached as current only
+    while the fingerprint matches — a perf-relevant change ANYWHERE in
+    the traced compute path (ops/_dispatch, framework/tensor,
+    nn/functional, the jit step classes, the model, the optimizer)
+    changes the lowered text, so `code_current` cannot read true on a
+    stale record. Cached per process (one AOT trace); falls back to
+    hashing the step-shaping source files when lowering is unavailable,
+    with a distinct prefix so the two schemes never collide."""
+    global _PATH_HASH_CACHE
+    if _PATH_HASH_CACHE is not None:
+        return _PATH_HASH_CACHE
     import hashlib
 
-    root = os.path.dirname(os.path.abspath(__file__))
     h = hashlib.sha256()
-    for rel in ("paddle_tpu/jit/fused_scan_step.py",
+    try:
+        h.update(_lowered_step_text().encode())
+        _PATH_HASH_CACHE = "hlo:" + h.hexdigest()[:16]
+        return _PATH_HASH_CACHE
+    except Exception as e:
+        print(f"[bench] HLO fingerprint unavailable "
+              f"({type(e).__name__}: {e}); falling back to source hash",
+              file=sys.stderr)
+    root = os.path.dirname(os.path.abspath(__file__))
+    for rel in ("paddle_tpu/jit/train_step.py",
+                "paddle_tpu/jit/fused_scan_step.py",
                 "paddle_tpu/jit/sharded_scan.py",
                 "paddle_tpu/models/gpt.py",
                 "paddle_tpu/ops/pallas/flash_attention.py",
@@ -644,7 +735,8 @@ def _compute_path_hash():
             return None            # renamed file -> record reads stale
         with open(p, "rb") as f:
             h.update(f.read())
-    return h.hexdigest()[:16]
+    _PATH_HASH_CACHE = "src:" + h.hexdigest()[:16]   # don't re-trace
+    return _PATH_HASH_CACHE
 
 
 def _record_live(result):

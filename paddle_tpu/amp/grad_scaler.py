@@ -2,13 +2,22 @@
 
 Reference parity: AmpScaler / GradScaler (python/paddle/amp/grad_scaler.py:62,
 645): scale -> backward -> unscale (found_inf via check_finite_and_unscale
-kernel) -> conditional step -> scale update. The found_inf device->host sync
-is batched into a single scalar readback per step (SURVEY.md §7 hard-parts).
+kernel) -> conditional step -> scale update. The unscale is ONE fused XLA
+program over all grads (check_finite_and_unscale parity — not a per-param
+dispatch loop), and found_inf stays ON DEVICE until the step decision:
+exactly one scalar readback per step, at the last possible moment
+(SURVEY.md §7 hard-parts).
+
+Compiled path: pass the scaler to TrainStep/FusedScanTrainStep/
+ShardedFusedScanTrainStep (``scaler=``) and the same semantics trace
+into the step program itself (jit/nonfinite_guard.py) — found_inf never
+reaches the host at all and the scale lives as traced state.
 """
 from __future__ import annotations
 
 import enum
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
@@ -18,6 +27,20 @@ class OptimizerState(enum.Enum):
     INIT = 0
     UNSCALED = 1
     STEPPED = 2
+
+
+@jax.jit
+def _fused_unscale(grads, inv):
+    """One XLA program: every grad unscaled + a single fused finiteness
+    reduction. Retraces only per grad-structure (cached by pytree)."""
+    finite = [jnp.isfinite(g).all()
+              if jnp.issubdtype(g.dtype, jnp.floating) else jnp.bool_(True)
+              for g in grads]
+    found = ~jnp.stack(finite).all() if finite else jnp.bool_(False)
+    out = [(g.astype(jnp.float32) * inv).astype(
+        jnp.float32 if g.dtype == jnp.float32 else g.dtype)
+        for g in grads]
+    return out, found
 
 
 class AmpScaler:
@@ -48,30 +71,38 @@ class AmpScaler:
         return var * self._scale
 
     def _unscale(self, optimizer):
-        """check_finite_and_unscale parity: one fused pass over grads computing
-        a single found_inf flag and dividing by the scale."""
+        """check_finite_and_unscale parity: ONE fused XLA program over
+        all grads (unscale + finiteness reduction). found_inf stays a
+        device scalar here — the host readback happens once, at the
+        step/minimize decision."""
         if not self._enable:
             return
         if self._opt_states.get(id(optimizer)) == OptimizerState.UNSCALED:
             return
-        params = optimizer._parameter_list or []
-        inv = 1.0 / self._scale
-        found = jnp.asarray(False)
-        for p in params:
-            if p.grad is None:
-                continue
-            g = p.grad._data.astype(jnp.float32) * inv
-            found = found | ~jnp.all(jnp.isfinite(g))
-            p.grad._data = g.astype(p.grad._data.dtype) if p.grad._data.dtype != jnp.float32 else g
-        self._found_inf = bool(found)  # single device->host sync
+        params = [p for p in (optimizer._parameter_list or [])
+                  if p.grad is not None]
+        if params:
+            inv = jnp.float32(1.0 / float(self._scale))
+            out, found = _fused_unscale([p.grad._data for p in params],
+                                        inv)
+            for p, g in zip(params, out):
+                p.grad._data = g
+            self._found_inf = found     # device scalar, NOT synced yet
+        else:
+            self._found_inf = False
         self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
 
     def unscale_(self, optimizer):
         return self._unscale(optimizer)
 
+    def _found(self):
+        """The single device->host readback of found_inf."""
+        self._found_inf = bool(self._found_inf)
+        return self._found_inf
+
     def minimize(self, optimizer, loss, *args, **kwargs):
         self._unscale(optimizer)
-        if not self._found_inf:
+        if not self._found():
             optimizer.step()
         self._update()
         self._opt_states.pop(id(optimizer), None)
@@ -82,7 +113,7 @@ class AmpScaler:
             optimizer.step()
             return
         self._unscale(optimizer)
-        if not self._found_inf:
+        if not self._found():
             optimizer.step()
         self._opt_states[id(optimizer)] = OptimizerState.STEPPED
 
@@ -95,43 +126,48 @@ class AmpScaler:
     def _update(self):
         if not self._use_dynamic:
             return
-        if self._found_inf:
-            self._bad_steps += 1
+        if self._found():
+            self._bad_steps = int(self._bad_steps) + 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every_n_nan_or_inf:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._scale = max(float(self._scale) * self._decr_ratio,
+                                  1.0)
                 self._bad_steps = 0
         else:
-            self._good_steps += 1
+            self._good_steps = int(self._good_steps) + 1
             self._bad_steps = 0
             if self._good_steps >= self._incr_every_n_steps:
-                self._scale *= self._incr_ratio
+                self._scale = float(self._scale) * self._incr_ratio
                 self._good_steps = 0
 
     # -- introspection ---------------------------------------------------
     def get_loss_scaling(self):
-        return Tensor(self._scale)
+        return Tensor(float(self._scale))
 
     def set_init_loss_scaling(self, value):
         self._scale = float(value)
 
     def state_dict(self):
+        # a compiled step (scaler= binding) mirrors scale/counters back
+        # as DEVICE scalars; the state dict is plain host numbers so it
+        # pickles and rides CheckpointManager saves unchanged
         return {
-            "scale": self._scale,
+            "scale": float(self._scale),
             "incr_ratio": self._incr_ratio,
             "decr_ratio": self._decr_ratio,
             "incr_every_n_steps": self._incr_every_n_steps,
             "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
-            "good_steps": self._good_steps,
-            "bad_steps": self._bad_steps,
-            "use_dynamic_loss_scaling": self._use_dynamic,
+            "good_steps": int(self._good_steps),
+            "bad_steps": int(self._bad_steps),
+            "use_dynamic_loss_scaling": bool(self._use_dynamic),
         }
 
     def load_state_dict(self, state):
-        self._scale = state.get("scale", self._scale)
-        self._good_steps = state.get("good_steps", 0)
-        self._bad_steps = state.get("bad_steps", 0)
-        self._use_dynamic = state.get("use_dynamic_loss_scaling", self._use_dynamic)
+        self._scale = float(state.get("scale", self._scale))
+        self._good_steps = int(state.get("good_steps", 0))
+        self._bad_steps = int(state.get("bad_steps", 0))
+        self._use_dynamic = bool(state.get("use_dynamic_loss_scaling",
+                                           self._use_dynamic))
 
 
 class GradScaler(AmpScaler):

@@ -8,11 +8,16 @@ mesh/layout by chunk-overlap resolution.
 """
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .save_state_dict import save_state_dict
-from .load_state_dict import load_state_dict
-from .utils import flatten_state_dict, unflatten_state_dict
+from .load_state_dict import load_state_dict, verify_checkpoint
+from .manager import CheckpointManager
+from .utils import (
+    CheckpointError, flatten_state_dict, snapshot_to_host,
+    unflatten_state_dict,
+)
 
 __all__ = [
     "LocalTensorIndex", "LocalTensorMetadata", "Metadata",
-    "save_state_dict", "load_state_dict",
-    "flatten_state_dict", "unflatten_state_dict",
+    "save_state_dict", "load_state_dict", "verify_checkpoint",
+    "CheckpointManager", "CheckpointError",
+    "flatten_state_dict", "unflatten_state_dict", "snapshot_to_host",
 ]

@@ -1,0 +1,261 @@
+"""Hermetic fault-tolerance selftest (bench.py ``fault_tolerance`` lane).
+
+Run under a cpu-forced env (bench.py's stripped subprocess /
+tools/cpu_env.sh):
+
+    python -m paddle_tpu.distributed.checkpoint.ft_selftest [--trials N]
+
+Four lanes, one JSON line (landing verbatim in BENCH_r*.json):
+
+  kill      — a victim subprocess saves checkpoints in a tight loop and
+              is SIGKILLed at a randomized point per trial;
+              ``restore_or_init`` must always come back with a complete,
+              checksum-verified checkpoint (never a torn one), at a step
+              the victim actually committed.
+  flip      — one flipped byte in a committed chunk file must fail
+              manifest verification and restore must fall back to the
+              previous valid step.
+  resume    — FusedScanTrainStep: save at step k, restore into a fresh
+              model/optimizer, continue — the continued loss trajectory
+              is BIT-identical to an uninterrupted run.
+  async     — the train loop blocks only for the device→host snapshot;
+              records blocked vs background-IO milliseconds (PERF.md's
+              async-save overlap numbers).
+
+``--victim <dir>`` is the child mode the kill lane spawns: save
+checkpoints 0,1,2,... into <dir> forever, printing ``committed K`` after
+every commit, until killed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_VICTIM_ARRAY_KB = 192      # per-array payload: big enough that a save
+_VICTIM_ARRAYS = 4          # takes ~ms, so random kills land mid-write
+
+
+def _victim_state(step: int):
+    rng = np.random.default_rng(step)
+    n = _VICTIM_ARRAY_KB * 1024 // 4
+    return {f"w{i}": rng.standard_normal(n).astype(np.float32)
+            for i in range(_VICTIM_ARRAYS)} | {"step_scalar": step}
+
+
+def victim_main(root: str):
+    from .manager import CheckpointManager
+
+    extra = _victim_state(0)
+    mgr = CheckpointManager(root, extra_state=extra, max_to_keep=3)
+    step = 0
+    while True:
+        extra.clear()
+        extra.update(_victim_state(step))
+        mgr.save(step)
+        print(f"committed {step}", flush=True)
+        step += 1
+
+
+def run_kill_lane(trials: int = 8, seed: int = 0):
+    """SIGKILL the victim at randomized points; every restore must land
+    on a committed, checksum-verified step with intact payloads."""
+    import shutil
+    import tempfile
+
+    from .load_state_dict import verify_checkpoint
+    from .manager import CheckpointManager
+
+    rng = np.random.default_rng(seed)
+    mid_save_hits = 0
+    for trial in range(trials):
+        root = tempfile.mkdtemp(prefix="ftkill_")
+        try:
+            child = subprocess.Popen(
+                [sys.executable, "-m",
+                 "paddle_tpu.distributed.checkpoint.ft_selftest",
+                 "--victim", root],
+                stdout=subprocess.PIPE, text=True,
+                cwd=os.getcwd(), env=dict(os.environ))
+            # let it commit at least one step, then kill at a random
+            # moment inside the save cadence
+            first = child.stdout.readline()
+            assert first.startswith("committed"), first
+            time.sleep(float(rng.uniform(0.0, 0.25)))
+            child.send_signal(signal.SIGKILL)
+            child.wait()
+            committed = [int(ln.split()[1])
+                         for ln in [first] + child.stdout.read().split("\n")
+                         if ln.startswith("committed")]
+            # a *.tmp_* dir left behind == the kill landed mid-save
+            if any(".tmp_" in n for n in os.listdir(root)):
+                mid_save_hits += 1
+            extra = _victim_state(0)
+            mgr = CheckpointManager(root, extra_state=extra)
+            got = mgr.restore_or_init()
+            if got is None:
+                raise AssertionError(
+                    f"trial {trial}: no restorable checkpoint (victim "
+                    f"committed {committed})")
+            verify_checkpoint(os.path.join(root, f"step_{got}"))
+            # the pipe is a prefix of truth (the victim may have
+            # committed once more between our last read and the kill)
+            if committed and got < max(committed):
+                raise AssertionError(
+                    f"trial {trial}: restored {got} < last confirmed "
+                    f"commit {max(committed)}")
+            want = _victim_state(got)
+            for k, v in want.items():
+                if k == "step_scalar":
+                    assert extra[k] == got, (extra[k], got)
+                elif not np.array_equal(np.asarray(extra[k]), v):
+                    raise AssertionError(
+                        f"trial {trial}: tensor {k} corrupt after "
+                        f"restore of step {got}")
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    return {"trials": trials, "mid_save_kills": mid_save_hits}
+
+
+def run_flip_lane():
+    """One flipped byte in a chunk file -> manifest catches it, restore
+    falls back to the previous committed step."""
+    import glob
+    import shutil
+    import tempfile
+
+    from .load_state_dict import verify_checkpoint
+    from .manager import CheckpointManager
+    from .utils import CheckpointError
+
+    root = tempfile.mkdtemp(prefix="ftflip_")
+    try:
+        extra = _victim_state(0)
+        mgr = CheckpointManager(root, extra_state=extra)
+        for step in (0, 1):
+            extra.clear()
+            extra.update(_victim_state(step))
+            mgr.save(step)
+        chunk = glob.glob(os.path.join(root, "step_1", "*_0.distcp"))[0]
+        raw = bytearray(open(chunk, "rb").read())
+        raw[len(raw) // 2] ^= 0x01
+        open(chunk, "wb").write(bytes(raw))
+        try:
+            verify_checkpoint(os.path.join(root, "step_1"))
+            return {"detected": False}
+        except CheckpointError:
+            pass
+        extra2 = _victim_state(0)
+        mgr2 = CheckpointManager(root, extra_state=extra2)
+        got = mgr2.restore_or_init()
+        ok = (got == 0 and extra2["step_scalar"] == 0
+              and np.array_equal(np.asarray(extra2["w0"]),
+                                 _victim_state(0)["w0"]))
+        return {"detected": True, "fell_back_to": got, "ok": bool(ok)}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _tiny_gpt_step(seed=0, lr=1e-2):
+    import itertools
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    import paddle_tpu.nn.layer.layers as _layers
+    from paddle_tpu.jit import FusedScanTrainStep
+    from paddle_tpu.models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    # auto param names come from a process-global counter; a REAL resume
+    # rebuilds the model in a fresh process (counter back at 0), so an
+    # in-process restore rehearsal must reset it the same way for the
+    # optimizer state keys to line up
+    _layers._param_counter = itertools.count()
+
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                    num_attention_heads=2, max_position_embeddings=16,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    scan_layers=True)
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    opt = popt.AdamW(learning_rate=lr, parameters=model.parameters())
+    step = FusedScanTrainStep(model, opt,
+                              criterion=GPTPretrainingCriterion())
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 96, (4, 12)), dtype="int64")
+    labels = paddle.to_tensor(rng.integers(0, 96, (4, 12)),
+                              dtype="int64")
+    return model, opt, step, ids, labels
+
+
+def run_resume_lane(async_save=True):
+    """Save at step 2, restore into a FRESH model/optimizer, continue:
+    the continued losses must be BIT-identical to an uninterrupted run,
+    and async save must block only for the host snapshot."""
+    import shutil
+    import tempfile
+
+    from .manager import CheckpointManager
+
+    root = tempfile.mkdtemp(prefix="ftresume_")
+    try:
+        model, opt, step, ids, labels = _tiny_gpt_step()
+        straight = [float(step(ids, labels)) for _ in range(5)]
+
+        model, opt, step, ids, labels = _tiny_gpt_step()
+        mgr = CheckpointManager(os.path.join(root, "ck"), model=model,
+                                optimizer=opt, async_save=async_save)
+        part1 = [float(step(ids, labels)) for _ in range(3)]
+        mgr.save(2)
+        mgr.wait()
+        timings = dict(mgr.last_timings)
+
+        model2, opt2, step2, ids, labels = _tiny_gpt_step(seed=123)
+        step2.ensure_built()            # optimizer state slots exist
+        mgr2 = CheckpointManager(os.path.join(root, "ck"), model=model2,
+                                 optimizer=opt2)
+        got = mgr2.restore_or_init()
+        assert got == 2, got
+        part2 = [float(step2(ids, labels)) for _ in range(2)]
+        resumed = part1 + part2
+        bit_identical = all(a == b for a, b in zip(straight, resumed))
+        return {
+            "bit_identical": bool(bit_identical),
+            "straight": straight, "resumed": resumed,
+            "async_blocked_ms": round(timings.get("blocked_s", 0) * 1e3,
+                                      3),
+            "async_snapshot_ms": round(
+                timings.get("snapshot_s", 0) * 1e3, 3),
+            "async_io_ms": round(timings.get("io_s", 0) * 1e3, 3),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv):
+    if "--victim" in argv:
+        victim_main(argv[argv.index("--victim") + 1])
+        return
+    trials = (int(argv[argv.index("--trials") + 1])
+              if "--trials" in argv else 8)
+    rec = {"metric": "fault_tolerance_selftest"}
+    try:
+        rec["kill"] = run_kill_lane(trials=trials)
+        rec["flip"] = run_flip_lane()
+        rec["resume"] = run_resume_lane()
+        ok = (rec["flip"].get("detected") and rec["flip"].get("ok")
+              and rec["resume"]["bit_identical"])
+        rec["check"] = "pass" if ok else f"FAIL: {rec}"[:400]
+    except Exception as e:
+        rec["check"] = f"FAIL: {type(e).__name__}: {e}"[:400]
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

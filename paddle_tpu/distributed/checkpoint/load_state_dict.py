@@ -12,28 +12,99 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Dict
+import zlib
+from typing import Dict, Optional
 
 import numpy as np
 
 import jax
 
 from .metadata import LocalTensorIndex, Metadata
-from .utils import flatten_state_dict, to_jax_array, unpack_numpy
+from .utils import (
+    CheckpointError, flatten_state_dict, to_jax_array, unpack_numpy,
+)
+
+
+def _read_metadata(path: str) -> Metadata:
+    """The manifest, or a CheckpointError naming the file (missing,
+    truncated, or un-unpicklable — never a bare UnpicklingError)."""
+    meta_path = os.path.join(path, "0.metadata")
+    try:
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+    except FileNotFoundError:
+        raise CheckpointError(
+            f"checkpoint {path!r} has no manifest (0.metadata): not a "
+            "committed checkpoint (crash before commit, or wrong path)")
+    except Exception as e:
+        raise CheckpointError(
+            f"checkpoint manifest {meta_path!r} is corrupt or "
+            f"truncated: {type(e).__name__}: {e}") from e
+    if not isinstance(meta, Metadata):
+        raise CheckpointError(
+            f"checkpoint manifest {meta_path!r} does not contain "
+            f"Metadata (got {type(meta).__name__})")
+    return meta
 
 
 class _ChunkReader:
-    """Lazy per-file chunk cache."""
+    """Lazy per-file chunk cache. Every read is verified against the
+    manifest's CRC32/size before any chunk from that file is trusted;
+    failures raise CheckpointError naming the file (and tensor key)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, checksums: Optional[Dict] = None):
         self.path = path
+        self._checksums = checksums or {}
         self._files: Dict[str, dict] = {}
+
+    def _load_file(self, file_name: str) -> dict:
+        full = os.path.join(self.path, file_name)
+        try:
+            with open(full, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise CheckpointError(
+                f"checkpoint chunk file {full!r} unreadable: "
+                f"{type(e).__name__}: {e}") from e
+        want = self._checksums.get(file_name)
+        if want is not None:
+            crc, size = want
+            if len(raw) != size or zlib.crc32(raw) != crc:
+                raise CheckpointError(
+                    f"checkpoint chunk file {full!r} fails its manifest "
+                    f"checksum (size {len(raw)} vs {size}, crc mismatch: "
+                    "truncated write or bit flip after commit)")
+        try:
+            payload = pickle.loads(raw)
+        except Exception as e:
+            raise CheckpointError(
+                f"checkpoint chunk file {full!r} is corrupt or "
+                f"truncated: {type(e).__name__}: {e}") from e
+        if not isinstance(payload, dict):
+            raise CheckpointError(
+                f"checkpoint chunk file {full!r} does not contain a "
+                f"chunk dict (got {type(payload).__name__})")
+        return payload
 
     def chunk(self, file_name: str, key, offset):
         if file_name not in self._files:
-            with open(os.path.join(self.path, file_name), "rb") as f:
-                self._files[file_name] = pickle.load(f)
-        return unpack_numpy(self._files[file_name][(key, offset)])
+            self._files[file_name] = self._load_file(file_name)
+        try:
+            payload = self._files[file_name][(key, offset)]
+        except KeyError:
+            raise CheckpointError(
+                f"tensor {key!r} (offset {offset}) missing from "
+                f"checkpoint chunk file "
+                f"{os.path.join(self.path, file_name)!r} — manifest and "
+                "chunk file disagree (partial or mixed-version "
+                "checkpoint)") from None
+        try:
+            return unpack_numpy(payload)
+        except Exception as e:
+            raise CheckpointError(
+                f"tensor {key!r} in checkpoint chunk file "
+                f"{os.path.join(self.path, file_name)!r} cannot be "
+                f"decoded: {type(e).__name__}: {e}") from e
 
 
 def _assemble(key, region_index, shape, dtype, chunks, storage, reader):
@@ -59,21 +130,54 @@ def _assemble(key, region_index, shape, dtype, chunks, storage, reader):
         out[dst] = data[src]
         filled[dst] = True
     if filled is None or not filled.all():
-        raise ValueError(
+        raise CheckpointError(
             f"checkpoint chunks do not cover tensor {key!r} region "
             f"{region_index} (shape {shape})")
     return out
 
 
+def verify_checkpoint(path: str, deep: bool = True) -> Metadata:
+    """Validate a checkpoint directory without loading tensors: the
+    manifest must unpickle, and every chunk file it names must exist —
+    with its recorded CRC32/size when ``deep`` (the default; pass
+    ``deep=False`` to skip streaming the chunk bytes when the caller
+    will CRC-verify each chunk on read anyway, as load_state_dict
+    does). Returns the Metadata on success; raises CheckpointError
+    naming the first offending file. Manifests from before the
+    checksum field verify structurally only."""
+    meta = _read_metadata(path)
+    checks = getattr(meta, "file_checksums", {}) or {}
+    files = set(meta.storage_metadata.values())
+    for file_name in sorted(files):
+        full = os.path.join(path, file_name)
+        if not os.path.exists(full):
+            raise CheckpointError(
+                f"checkpoint {path!r} is missing chunk file "
+                f"{file_name!r} named by its manifest")
+        want = checks.get(file_name) if deep else None
+        if want is None:
+            continue
+        from .utils import file_crc32_size
+
+        crc, size = file_crc32_size(full)
+        if (crc, size) != tuple(want):
+            raise CheckpointError(
+                f"checkpoint chunk file {full!r} fails its manifest "
+                f"checksum (crc/size {crc}/{size} vs expected "
+                f"{want[0]}/{want[1]}: truncated write or bit flip "
+                "after commit)")
+    return meta
+
+
 def load_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0) -> None:
     """Load into the template ``state_dict`` IN PLACE, resharding saved
-    chunks to each tensor's current sharding (any mesh/layout)."""
-    meta_path = os.path.join(path, "0.metadata")
-    with open(meta_path, "rb") as f:
-        meta: Metadata = pickle.load(f)
+    chunks to each tensor's current sharding (any mesh/layout). Chunk
+    bytes are checksum-verified on read (manifest CRC32/size); corrupt
+    or truncated files raise CheckpointError naming file and tensor."""
+    meta = _read_metadata(path)
     flat, _ = flatten_state_dict(state_dict)
-    reader = _ChunkReader(path)
+    reader = _ChunkReader(path, getattr(meta, "file_checksums", {}))
 
     from ...framework.tensor import Tensor
 

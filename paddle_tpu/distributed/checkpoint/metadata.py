@@ -32,10 +32,20 @@ class LocalTensorIndex:
 
 @dataclass
 class Metadata:
-    """Global checkpoint manifest (written once, by the coordinator)."""
+    """Global checkpoint manifest (written once, by the coordinator).
+
+    ``file_checksums`` maps every chunk file to its ``(crc32, size)`` at
+    write time: a reader verifies bytes before trusting a chunk, and the
+    manager's ``restore_or_init`` uses it to reject a checkpoint whose
+    files were truncated or flipped after commit. Metadata pickled before
+    the field existed unpickles without it — readers use
+    ``getattr(meta, "file_checksums", {})``.
+    """
 
     state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
         default_factory=dict)
     storage_metadata: Dict[LocalTensorIndex, str] = field(
         default_factory=dict)
     flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    file_checksums: Dict[str, Tuple[int, int]] = field(
+        default_factory=dict)

@@ -26,7 +26,8 @@ import jax
 
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .utils import (
-    flatten_state_dict, offsets_of, pack_numpy, to_jax_array,
+    flatten_state_dict, fsync_dir, fsync_write_bytes, offsets_of,
+    pack_numpy, to_jax_array,
 )
 
 
@@ -73,16 +74,19 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             meta.storage_metadata[LocalTensorIndex(key, off)] = file_name
         meta.state_dict_metadata.setdefault(key, []).extend(chunks)
 
-    with open(os.path.join(path, file_name), "wb") as f:
-        pickle.dump(local_chunks, f)
+    # chunk file: durable atomic write, CRC32/size recorded in the
+    # manifest — a crash mid-write leaves only a *.tmp.* file that no
+    # reader opens, and a post-crash flipped byte is caught on read
+    crc, size = fsync_write_bytes(os.path.join(path, file_name),
+                                  pickle.dumps(local_chunks))
+    meta.file_checksums[file_name] = (crc, size)
 
     if jax.process_count() > 1:
         # every process computed the same global chunk list for the
         # addressable part; merge via a metadata file per process and let
         # the coordinator fold them (control plane only, tiny).
         part = f"{proc}.metapart"
-        with open(os.path.join(path, part), "wb") as f:
-            pickle.dump(meta, f)
+        fsync_write_bytes(os.path.join(path, part), pickle.dumps(meta))
         # rendezvous so the coordinator sees all parts
         from ..collective import barrier
 
@@ -101,13 +105,20 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     else:
                         meta.state_dict_metadata[k] = v
                 meta.storage_metadata.update(other.storage_metadata)
+                meta.file_checksums.update(
+                    getattr(other, "file_checksums", {}))
                 os.remove(part_path)
-            with open(os.path.join(path, "0.metadata"), "wb") as f:
-                pickle.dump(meta, f)
+            fsync_write_bytes(os.path.join(path, "0.metadata"),
+                              pickle.dumps(meta))
+            fsync_dir(path)
         # second barrier: no process returns before the manifest exists
         # (a non-coordinator may immediately load/validate the checkpoint)
         barrier()
         return
 
-    with open(os.path.join(path, "0.metadata"), "wb") as f:
-        pickle.dump(meta, f)
+    # the manifest is written LAST: its presence is the commit marker a
+    # validator/manager keys on — chunks without a manifest are garbage,
+    # never a half-readable checkpoint
+    fsync_write_bytes(os.path.join(path, "0.metadata"),
+                      pickle.dumps(meta))
+    fsync_dir(path)

@@ -1,10 +1,17 @@
 """Checkpoint helpers: state-dict flattening + array normalization.
 
 Reference parity: python/paddle/distributed/checkpoint/utils.py
-(flatten_state_dict/unflatten_state_dict).
+(flatten_state_dict/unflatten_state_dict). Fault-tolerance additions:
+``CheckpointError`` (every corrupt/truncated-read failure surfaces as
+this, naming the file and tensor key), durable atomic file writes
+(temp + fsync + ``os.replace``), and host snapshots of device arrays so
+an async save can hand pickling+IO to a background thread after the
+device→host copy — the only part that blocks the train loop.
 """
 from __future__ import annotations
 
+import os
+import zlib
 from typing import Any, Dict, Tuple
 
 import numpy as np
@@ -12,10 +19,118 @@ import numpy as np
 import jax
 
 
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written or read back intact (truncated
+    pickle, checksum mismatch, missing chunk/tensor). The message names
+    the offending file — and the tensor key when one is in play —
+    instead of surfacing a bare ``UnpicklingError``/``KeyError`` from
+    deep inside the reader."""
+
+
 def _is_leaf(v) -> bool:
     from ...framework.tensor import Tensor
 
     return isinstance(v, (Tensor, jax.Array, np.ndarray, int, float))
+
+
+# ---------------------------------------------------------------------------
+# durable writes + checksums
+# ---------------------------------------------------------------------------
+
+def fsync_write_bytes(path: str, data: bytes) -> Tuple[int, int]:
+    """Write ``data`` durably and atomically: same-directory temp file,
+    fsync, ``os.replace``. A reader (or a post-crash scan) can observe
+    the old file or the new file, never a truncated one. Returns
+    ``(crc32, size)`` of the written bytes."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return zlib.crc32(data), len(data)
+
+
+def fsync_dir(path: str) -> None:
+    """Flush directory entries (the renames above) to disk. Best-effort
+    on filesystems that reject directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def file_crc32_size(path: str) -> Tuple[int, int]:
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+            size += len(block)
+    return crc, size
+
+
+# ---------------------------------------------------------------------------
+# host snapshots (async save: device->host now, pickle+IO later)
+# ---------------------------------------------------------------------------
+
+class _HostShard:
+    """Host copy of one addressable shard (the fields save_state_dict
+    reads off a ``jax.Shard``)."""
+
+    __slots__ = ("index", "replica_id", "data")
+
+    def __init__(self, index, replica_id, data):
+        self.index = index
+        self.replica_id = replica_id
+        self.data = data
+
+
+class HostArraySnapshot:
+    """Host-side stand-in for a ``jax.Array`` inside ``save_state_dict``:
+    same shape/dtype/addressable_shards surface, numpy payloads. Built
+    synchronously by ``snapshot_to_host``; consumed by a background
+    writer thread without touching the device again."""
+
+    __slots__ = ("shape", "dtype", "addressable_shards")
+
+    def __init__(self, arr: jax.Array):
+        self.shape = tuple(arr.shape)
+        self.dtype = arr.dtype
+        self.addressable_shards = [
+            _HostShard(s.index, s.replica_id, np.asarray(s.data))
+            for s in arr.addressable_shards
+            if s.replica_id == 0]
+
+
+def snapshot_to_host(state_dict: Dict) -> Dict:
+    """Deep-copy a nested state_dict's device arrays to host snapshots
+    (sharding structure preserved — 1/N shards stay 1/N chunks on disk).
+    This device→host copy is the only part of an async save that blocks
+    the caller."""
+    from ...framework.tensor import Tensor
+
+    def walk(v):
+        if isinstance(v, dict):
+            return {k: walk(x) for k, x in v.items()}
+        if isinstance(v, Tensor):
+            v = v._data
+        if isinstance(v, jax.Array):
+            return HostArraySnapshot(v)
+        if isinstance(v, np.ndarray):
+            return np.array(v)
+        return v
+
+    return walk(state_dict)
 
 
 def flatten_state_dict(state_dict: Dict) -> Tuple[Dict[str, Any],
@@ -56,7 +171,7 @@ def to_jax_array(v) -> jax.Array:
 
     if isinstance(v, Tensor):
         return v._data
-    if isinstance(v, jax.Array):
+    if isinstance(v, (jax.Array, HostArraySnapshot)):
         return v
     import jax.numpy as jnp
 

@@ -62,8 +62,24 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    # crash-safe: serialize fully, write to a same-directory temp file,
+    # fsync, then atomically rename over the target — a reader (or a
+    # process restarted after SIGKILL mid-save) can observe the old file
+    # or the new file, never a truncated pickle
+    data = pickle.dumps(_pack(obj), protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(path, return_numpy=False, **configs):

@@ -122,19 +122,66 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Epoch checkpointing through the crash-safe CheckpointManager
+    (distributed/checkpoint/manager.py): every save is written to a tmp
+    directory and atomically committed with a checksum manifest, so a
+    job killed mid-save never leaves a half-checkpoint where ``resume``
+    (or the next run's ``restore_or_init``) would find it. ``max_to_keep``
+    bounds disk (None keeps everything); ``async_save`` overlaps
+    pickling+IO with the next epoch's training.
+    """
+
+    def __init__(self, save_freq=1, save_dir=None, max_to_keep=None,
+                 async_save=False):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self._mgr = None
+        self._last_epoch = None
+
+    def manager(self):
+        if self._mgr is None and self.save_dir:
+            from ..distributed.checkpoint import CheckpointManager
+
+            self._mgr = CheckpointManager(
+                self.save_dir,
+                model=self.model.network,
+                optimizer=self.model._optimizer,
+                scaler=getattr(self.model, "_scaler", None),
+                max_to_keep=(0 if self.max_to_keep is None
+                             else self.max_to_keep),
+                async_save=self.async_save)
+        return self._mgr
+
+    def resume(self):
+        """Restore the newest valid checkpoint into the bound model;
+        returns the restored epoch or None (fresh run)."""
+        mgr = self.manager()
+        return None if mgr is None else mgr.restore_or_init()
 
     def on_epoch_end(self, epoch, logs=None):
+        self._last_epoch = epoch
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
-            path = os.path.join(self.save_dir, str(epoch))
-            self.model.save(path)
+            self.manager().save(epoch)
 
     def on_train_end(self, logs=None):
-        if self.save_dir:
-            self.model.save(os.path.join(self.save_dir, "final"))
+        mgr = self.manager()
+        if mgr is None:
+            return
+        # join the in-flight async save FIRST: last_saved_step is only
+        # set after the background commit, so reading it before wait()
+        # would re-save an epoch that is already on disk
+        mgr.wait()
+        if self._last_epoch is not None and \
+                mgr.last_saved_step != self._last_epoch:
+            mgr.save(self._last_epoch, sync=True)
+            mgr.wait()
+        # legacy surface: Model.load(os.path.join(save_dir, "final"))
+        # predates the manager and must keep working (model.save is
+        # itself crash-safe now — framework/io.py atomic rename)
+        self.model.save(os.path.join(self.save_dir, "final"))
 
 
 class EarlyStopping(Callback):

@@ -49,6 +49,7 @@ from ..framework.tensor import Tensor
 from ..framework.autograd import no_grad
 from ..framework import random as _random
 from ..profiler import RecordEvent
+from .train_step import _commit_uncommitted
 
 # PRNG draws reserved per layer forward (2 hidden dropouts + attention
 # dropout + slack). The per-layer offset scheme below is
@@ -104,9 +105,22 @@ class FusedScanTrainStep:
     """
 
     def __init__(self, model, optimizer, criterion=None, fused_head=False,
-                 compute_dtype=None, layer_chunk=1, scan_unroll=1):
+                 compute_dtype=None, layer_chunk=1, scan_unroll=1,
+                 scaler=None, guard_nonfinite=None):
         from ..models.gpt import GPTStackedBlocks, GPTPretrainingCriterion
         from ..optimizer import Adam
+        from .nonfinite_guard import GuardSpec
+
+        # in-graph non-finite guard: found_inf rides the backward pass as
+        # a running scalar folded per layer chunk (alongside the squared
+        # norm when clipping); all updates are where-gated so a NaN step
+        # leaves params/moments/step bit-identical. Without a global-norm
+        # clip the guard forces the same two-pass backward the clip uses
+        # (grads must be inspected before the in-scan update consumes
+        # them) — docs/DECISIONS.md §13.
+        self._guard = (GuardSpec(scaler)
+                       if (scaler is not None or guard_nonfinite)
+                       else None)
 
         self.model = model
         blocks = model.gpt.blocks
@@ -221,6 +235,7 @@ class FusedScanTrainStep:
                         "compute_dtype expects fp32-stored params (the "
                         f"param IS the master); got {p._data.dtype}")
         self._jitted = None
+        self._canon_done = False   # one-time layout canon at first call
         # adopt the optimizer's existing step count: continuing a run
         # that already trained under TrainStep must not reset the Adam
         # bias corrections to t=1 (r5 review finding)
@@ -367,12 +382,19 @@ class FusedScanTrainStep:
                 "mw": [opt._master_weights.get(_key(p)) for p in params],
             }
 
-        return {
+        # the optimizer owns the step count: a checkpoint restore writes
+        # opt._step_count (load_opt_state_pytree) and this read is what
+        # makes the next compiled step see it
+        self._step_count = opt._step_count
+        state = {
             "s": pack(self._s_params),
             "o": pack([p for _, p in self._o_params]),
             "buf": [b._data for b in self._buffers],
             "step": jnp.asarray(self._step_count, jnp.int32),
         }
+        if self._guard is not None:
+            state["guard"] = self._guard.init_state()
+        return state
 
     def _inject_state(self, state):
         opt = self._opt
@@ -392,6 +414,8 @@ class FusedScanTrainStep:
             b._data = d
         opt._step_count = state["step"]
         self._step_count = state["step"]
+        if self._guard is not None and "guard" in state:
+            self._guard.writeback(state["guard"])
 
     # -- the compiled step ----------------------------------------------
     def _build(self):
@@ -413,6 +437,8 @@ class FusedScanTrainStep:
             return opt._adam_math(pv, g32, m, v, None, lr, tf, wd)
 
         cv = self._clip_value
+        guard = self._guard
+        scaling = guard is not None and guard.scaling
 
         def clip_g32(g32, p):
             """The per-grad transforms that are legal inside the scan:
@@ -431,6 +457,11 @@ class FusedScanTrainStep:
             s, o = state["s"], state["o"]
             saved_buf = self._bind(self._buffers, state["buf"])
             try:
+                gst = state.get("guard")
+                # loss-scale: seed the head cotangent with the traced
+                # scale instead of 1.0 — every grad in both backward
+                # passes comes out scaled, the loss itself stays unscaled
+                inv_s = (1.0 / gst["scale"]) if scaling else None
                 t = state["step"] + 1
                 tf = t.astype(jnp.float32)
                 b, seq = ids.shape
@@ -471,20 +502,29 @@ class FusedScanTrainStep:
                 # ---- head (+ its whole vjp: small params, one buffer)
                 loss, head_vjp = jax.vjp(
                     lambda od, x: self._head_fn(od, x, labels), o["p"], xL)
-                d_o_head, dxL = head_vjp(jnp.ones((), loss.dtype))
+                ct = (gst["scale"].astype(loss.dtype) if scaling
+                      else jnp.ones((), loss.dtype))
+                d_o_head, dxL = head_vjp(ct)
 
-                # ---- deferred global-norm clip (pass 1 of 2): re-scan
-                # the vjp accumulating ONLY the squared grad norm in the
-                # carry — each layer's grad still dies inside its
-                # iteration, so the memory plan is unchanged; cost is a
-                # second backward (docs/DECISIONS.md §12). The embed-side
-                # outer grads fall out of this pass's dx0 and are reused
-                # by the update below (their math is identical).
+                # ---- deferred global-norm clip / non-finite pre-pass
+                # (pass 1 of 2): re-scan the vjp accumulating ONLY
+                # scalars in the carry — the squared grad norm (clip)
+                # and the finiteness fold (guard) — each layer's grad
+                # still dies inside its iteration, so the memory plan is
+                # unchanged; cost is a second backward
+                # (docs/DECISIONS.md §12, §13). The embed-side outer
+                # grads fall out of this pass's dx0 and are reused by
+                # the update below (their math is identical).
                 scale = None
                 d_o_emb = None
-                if self._clip_global is not None:
+                found = None
+                if self._clip_global is not None or guard is not None:
+                    from .nonfinite_guard import all_finite
+
+                    want_norm = self._clip_global is not None
+
                     def norm_body(carry, scanned):
-                        dy, sq = carry
+                        dy, sq, fin = carry
                         x_i, i = scanned
                         p_i = tuple(
                             lax.dynamic_index_in_dim(a, i, keepdims=False)
@@ -494,18 +534,24 @@ class FusedScanTrainStep:
                             lambda pl, xx: chunk_apply(pl, xx, rng0),
                             p_i, x_i)
                         dp, dx = vjp(dy)
-                        for j in range(n_leaves):
-                            p = self._s_params[j]
-                            if not p.trainable or not getattr(
-                                    p, "need_clip", True):
-                                continue
-                            sq = sq + jnp.sum(jnp.square(
-                                dp[j].astype(jnp.float32)))
-                        return (dx, sq), None
+                        if guard is not None:
+                            fin = fin & all_finite(
+                                [dp[j] for j in range(n_leaves)
+                                 if self._s_params[j].trainable])
+                        if want_norm:
+                            for j in range(n_leaves):
+                                p = self._s_params[j]
+                                if not p.trainable or not getattr(
+                                        p, "need_clip", True):
+                                    continue
+                                sq = sq + jnp.sum(jnp.square(
+                                    dp[j].astype(jnp.float32)))
+                        return (dx, sq, fin), None
 
                     P0 = sp_c
-                    (dx0, sq), _ = lax.scan(
-                        norm_body, (dxL, jnp.float32(0.0)),
+                    (dx0, sq, fin), _ = lax.scan(
+                        norm_body,
+                        (dxL, jnp.float32(0.0), jnp.bool_(True)),
                         (xs, jnp.arange(C)), reverse=True,
                         unroll=self._scan_unroll)
                     _, emb_vjp = jax.vjp(
@@ -514,17 +560,25 @@ class FusedScanTrainStep:
                             rng_off=self._rng_base(t32, n_layers)),
                         o["p"])
                     (d_o_emb,) = emb_vjp(dx0)
-                    for j in range(len(o["p"])):
-                        p = self._o_params[j][1]
-                        if not getattr(p, "need_clip", True):
-                            continue
-                        g = (d_o_head[j].astype(jnp.float32)
-                             + d_o_emb[j].astype(jnp.float32))
-                        sq = sq + jnp.sum(jnp.square(g))
-                    gnorm = jnp.sqrt(sq)
-                    scale = jnp.minimum(
-                        jnp.float32(self._clip_global)
-                        / jnp.maximum(gnorm, 1e-12), 1.0)
+                    o_g32 = [(d_o_head[j].astype(jnp.float32)
+                              + d_o_emb[j].astype(jnp.float32))
+                             for j in range(len(o["p"]))]
+                    if guard is not None:
+                        found = ~(fin & all_finite(o_g32))
+                    if want_norm:
+                        for j in range(len(o["p"])):
+                            if not getattr(self._o_params[j][1],
+                                           "need_clip", True):
+                                continue
+                            sq = sq + jnp.sum(jnp.square(o_g32[j]))
+                        # grads (hence sq) carry the loss scale: the
+                        # true norm is sqrt(sq)/loss_scale
+                        gnorm = jnp.sqrt(sq)
+                        if inv_s is not None:
+                            gnorm = gnorm * inv_s
+                        scale = jnp.minimum(
+                            jnp.float32(self._clip_global)
+                            / jnp.maximum(gnorm, 1e-12), 1.0)
 
                 # ---- reverse scan: vjp one CHUNK, update its slices
                 def bwd_body(carry, scanned):
@@ -557,19 +611,31 @@ class FusedScanTrainStep:
                             MW[j], i, keepdims=False)
                             if MW[j] is not None else None)
                         pv = mw_j if mw_j is not None else p_i[j]
-                        g32 = scaled(
-                            clip_g32(dp[j].astype(jnp.float32),
-                                     self._s_params[j]),
-                            self._s_params[j], scale)
+                        g32 = dp[j].astype(jnp.float32)
+                        if inv_s is not None:
+                            g32 = g32 * inv_s
+                        g32 = scaled(clip_g32(g32, self._s_params[j]),
+                                     self._s_params[j], scale)
                         out, mn, vn, _ = adam(
                             pv, g32, m_j, v_j,
                             lr * lrs, tf, jnp.float32(wd), l2)
+                        out_p = out.astype(P[j].dtype)
+                        mn_c = mn.astype(M[j].dtype)
+                        vn_c = vn.astype(V[j].dtype)
+                        if found is not None:
+                            # bad step: every slot passes through
+                            # bit-identical (selection, not arithmetic)
+                            out_p = jnp.where(found, p_i[j], out_p)
+                            mn_c = jnp.where(found, m_j, mn_c)
+                            vn_c = jnp.where(found, v_j, vn_c)
+                            if mw_j is not None:
+                                out = jnp.where(found, mw_j, out)
                         nP.append(lax.dynamic_update_index_in_dim(
-                            P[j], out.astype(P[j].dtype), i, 0))
+                            P[j], out_p, i, 0))
                         nM.append(lax.dynamic_update_index_in_dim(
-                            M[j], mn.astype(M[j].dtype), i, 0))
+                            M[j], mn_c, i, 0))
                         nV.append(lax.dynamic_update_index_in_dim(
-                            V[j], vn.astype(V[j].dtype), i, 0))
+                            V[j], vn_c, i, 0))
                         nMW.append(lax.dynamic_update_index_in_dim(
                             MW[j], out, i, 0)
                             if MW[j] is not None else None)
@@ -601,6 +667,8 @@ class FusedScanTrainStep:
                     wd, l2, lrs = o_hyp[j]
                     g32 = (d_o_head[j].astype(jnp.float32)
                            + d_o_emb[j].astype(jnp.float32))
+                    if inv_s is not None:
+                        g32 = g32 * inv_s
                     g32 = scaled(clip_g32(g32, self._o_params[j][1]),
                                  self._o_params[j][1], scale)
                     pv = (o["mw"][j] if o["mw"][j] is not None
@@ -608,9 +676,18 @@ class FusedScanTrainStep:
                     out, mn, vn, _ = adam(pv, g32, o["m"][j], o["v"][j],
                                           lr * lrs, tf, jnp.float32(wd),
                                           l2)
-                    new_o["p"].append(out.astype(o["p"][j].dtype))
-                    new_o["m"].append(mn.astype(o["m"][j].dtype))
-                    new_o["v"].append(vn.astype(o["v"][j].dtype))
+                    out_p = out.astype(o["p"][j].dtype)
+                    mn_c = mn.astype(o["m"][j].dtype)
+                    vn_c = vn.astype(o["v"][j].dtype)
+                    if found is not None:
+                        out_p = jnp.where(found, o["p"][j], out_p)
+                        mn_c = jnp.where(found, o["m"][j], mn_c)
+                        vn_c = jnp.where(found, o["v"][j], vn_c)
+                        if o["mw"][j] is not None:
+                            out = jnp.where(found, o["mw"][j], out)
+                    new_o["p"].append(out_p)
+                    new_o["m"].append(mn_c)
+                    new_o["v"].append(vn_c)
                     new_o["mw"].append(out if o["mw"][j] is not None
                                        else None)
 
@@ -619,8 +696,11 @@ class FusedScanTrainStep:
                           "mw": list(nMW)},
                     "o": new_o,
                     "buf": state["buf"],
-                    "step": t,
+                    "step": (t if found is None
+                             else jnp.where(found, state["step"], t)),
                 }
+                if guard is not None:
+                    new_state["guard"] = guard.update(gst, found)
                 return loss, new_state
             finally:
                 self._bind(self._buffers, saved_buf)
@@ -649,6 +729,16 @@ class FusedScanTrainStep:
         lab_d = labels._data if isinstance(labels, Tensor) else labels
         if self._jitted is None:
             self.ensure_built()
+        if not self._canon_done:
+            # first call AFTER any restore (ensure_built may predate it,
+            # quickstart order): a restored checkpoint leaves the params
+            # device-committed while fresh scalars are uncommitted, which
+            # would key one extra executable on the second call
+            # (train_step._commit_uncommitted)
+            canon = _commit_uncommitted(self._extract_state())
+            if canon is not None:
+                self._inject_state(canon)
+            self._canon_done = True
         state = self._extract_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         with RecordEvent("FusedScanTrainStep"):

@@ -1,0 +1,121 @@
+"""Traced non-finite step guard + dynamic loss scale.
+
+The eager GradScaler (amp/grad_scaler.py) reads ``found_inf`` back to
+the host every step to decide whether to call ``optimizer.step()`` —
+one device→host sync per step, and a step the compiler cannot see
+through. Inside the compiled train steps the same semantics trace
+directly: ``found_inf`` is a reduction over the gradients, the
+optimizer update is gated with ``jnp.where`` (params, moments, and the
+step count pass through BIT-IDENTICAL on a bad step), and the dynamic
+loss scale lives in the step's state pytree as a traced f32 scalar
+(halve on inf per ``decr_every_n_nan_or_inf``, grow ``incr_ratio``×
+after ``incr_every_n_steps`` good steps). Zero extra host syncs, zero
+retraces: the flag never leaves the device and the program is the same
+executable for good and bad steps.
+
+Why traced rather than eager (docs/DECISIONS.md §13): an eager skip
+needs the host to see found_inf before launching the update, which
+serializes the pipeline every step to save work on the rare bad step;
+the traced ``where`` costs a predicated copy only when a step is
+actually bad and nothing when it isn't.
+
+Reference parity: check_finite_and_unscale + update_loss_scaling
+kernels (paddle/phi/kernels/check_finite_and_unscale_kernel.h,
+update_loss_scaling_kernel.h) — fused into the step program instead of
+launched as separate ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def all_finite(leaves) -> jax.Array:
+    """ONE fused finiteness reduction over a list of arrays: a traced
+    scalar bool, True iff every element of every leaf is finite."""
+    leaves = [g for g in leaves if g is not None]
+    if not leaves:
+        return jnp.bool_(True)
+    flags = [jnp.isfinite(g).all() if jnp.issubdtype(g.dtype, jnp.floating)
+             else jnp.bool_(True) for g in leaves]
+    return jnp.stack(flags).all() if len(flags) > 1 else flags[0]
+
+
+def gate(found_inf, new_tree, old_tree):
+    """``jnp.where`` every leaf: old value on a bad step, new otherwise.
+    Selection, not arithmetic — NaN/inf candidates cannot leak through."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(found_inf, o, n), new_tree, old_tree)
+
+
+class GuardSpec:
+    """Static configuration of the in-graph guard, mirrored from a
+    GradScaler when one is bound (its scale/counters become traced state
+    carried in the step's state pytree and written back as device
+    scalars after every call — no host sync until someone reads them).
+    Without a scaler the guard only gates: scale pinned to 1.0."""
+
+    def __init__(self, scaler=None):
+        self.scaler = scaler if (scaler is not None
+                                 and scaler.is_enable()) else None
+        s = self.scaler
+        self.scaling = s is not None
+        self.use_dynamic = bool(s and s._use_dynamic)
+        self.incr_ratio = float(s._incr_ratio) if s else 2.0
+        self.decr_ratio = float(s._decr_ratio) if s else 0.5
+        self.incr_every_n = int(s._incr_every_n_steps) if s else 0
+        self.decr_every_n = int(s._decr_every_n_nan_or_inf) if s else 1
+
+    # -- traced state ----------------------------------------------------
+    def init_state(self):
+        """The guard's entry in the step state pytree, seeded from the
+        live scaler (so checkpoint restore flows through). Device-array
+        mirrors written back by a previous step pass through without a
+        host sync."""
+        s = self.scaler
+
+        def dev(v, dt):
+            if isinstance(v, jax.Array):
+                return v if v.dtype == dt else v.astype(dt)
+            return jnp.asarray(v, dt)
+
+        return {
+            "scale": dev(s._scale if s else 1.0, jnp.float32),
+            "good": dev(s._good_steps if s else 0, jnp.int32),
+            "bad": dev(s._bad_steps if s else 0, jnp.int32),
+            "found": dev(s._found_inf if s is not None else False,
+                         jnp.bool_),
+        }
+
+    def writeback(self, gst):
+        """Mirror the traced guard state back into the scaler as device
+        scalars (read lazily by state_dict/get_loss_scaling)."""
+        if self.scaler is not None:
+            self.scaler._scale = gst["scale"]
+            self.scaler._good_steps = gst["good"]
+            self.scaler._bad_steps = gst["bad"]
+            self.scaler._found_inf = gst["found"]
+
+    # -- traced update rule (the eager _update, word for word) ----------
+    def update(self, gst, found_inf):
+        scale, good, bad = gst["scale"], gst["good"], gst["bad"]
+        found = jnp.asarray(found_inf, jnp.bool_)
+        if not self.use_dynamic:
+            return {"scale": scale,
+                    "good": jnp.where(found, 0, good + 1),
+                    "bad": jnp.where(found, bad + 1, 0),
+                    "found": found}
+        bad1 = bad + 1
+        good1 = good + 1
+        dec = bad1 >= self.decr_every_n
+        inc = (good1 >= self.incr_every_n) if self.incr_every_n > 0 \
+            else jnp.bool_(False)
+        new_scale = jnp.where(
+            found,
+            jnp.where(dec, jnp.maximum(scale * self.decr_ratio, 1.0),
+                      scale),
+            jnp.where(inc, scale * self.incr_ratio, scale))
+        new_good = jnp.where(found, 0, jnp.where(inc, 0, good1))
+        new_bad = jnp.where(found, jnp.where(dec, 0, bad1), 0)
+        return {"scale": new_scale, "good": new_good, "bad": new_bad,
+                "found": found}

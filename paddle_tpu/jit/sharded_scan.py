@@ -138,12 +138,13 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
     def __init__(self, model, optimizer, criterion=None, fused_head=False,
                  compute_dtype=None, layer_chunk=1, scan_unroll=1,
                  mesh=None, axis=None, group=None, comm_bucket_mb=None,
-                 comm_quant=None):
+                 comm_quant=None, scaler=None, guard_nonfinite=None):
         model = _unwrap_layers(model)
         super().__init__(model, optimizer, criterion=criterion,
                          fused_head=fused_head,
                          compute_dtype=compute_dtype,
-                         layer_chunk=layer_chunk, scan_unroll=scan_unroll)
+                         layer_chunk=layer_chunk, scan_unroll=scan_unroll,
+                         scaler=scaler, guard_nonfinite=guard_nonfinite)
         from ..distributed import env as denv
 
         if group is not None:
@@ -281,11 +282,19 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         for b in self._buffers:
             b._data = jax.device_put(b._data, rep)
         self._step_count = jax.device_put(
-            jnp.asarray(int(self._step_count), jnp.int32), rep)
+            jnp.asarray(int(self._opt._step_count), jnp.int32), rep)
+        self._opt._step_count = self._step_count
+        if self._guard is not None and self._guard.scaler is not None:
+            # the scaler's traced mirrors must start mesh-committed too,
+            # or call 2 (committed jit outputs) keys a second executable
+            self._guard.writeback(jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, rep),
+                self._guard.init_state()))
         self._build()
 
     def _extract_state(self):
         opt = self._opt
+        self._step_count = opt._step_count   # restore-aware (base class)
         st = {
             "s": {"p": [p._data for p in self._s_params]},
             "o": {"p": [p._data for _, p in self._o_params]},
@@ -301,6 +310,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                             for b in assign.buckets]
             st[grp]["mw"] = [opt._master_weights.get(
                 self._flat_key(grp, b.index)) for b in assign.buckets]
+        if self._guard is not None:
+            st["guard"] = self._guard.init_state()
         return st
 
     def _inject_state(self, state):
@@ -323,6 +334,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             b._data = d
         opt._step_count = state["step"]
         self._step_count = state["step"]
+        if self._guard is not None and "guard" in state:
+            self._guard.writeback(state["guard"])
 
     def _state_specs(self):
         ax = self._axis
@@ -333,6 +346,9 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             "buf": [rep] * len(self._buffers),
             "step": rep,
         }
+        if self._guard is not None:
+            specs["guard"] = {"scale": rep, "good": rep, "bad": rep,
+                              "found": rep}
         for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
             sp = P(None, ax) if grp == "s" else P(ax)
             nb = len(assign.buckets)
@@ -381,6 +397,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         t_idx = {j: tj for tj, (j, _) in enumerate(self._s_train)}
         cv = self._clip_value
         clip_norm = self._clip_global
+        guard = self._guard
+        scaling = guard is not None and guard.scaling
 
         def shard_of(vec, rank, shard_len):
             """Own-rank slice of a replicated flat [F] constant (no-op
@@ -392,11 +410,13 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
         chunk_apply = self._chunk_apply
 
-        def g_shard_f32(gs, nc_shard, scale):
+        def g_shard_f32(gs, nc_shard, scale, inv_s=None):
             """Scatter output -> the fp32 gradient the update consumes:
-            1/N for the data-parallel mean, value clip, global-norm
-            scale (need_clip-masked)."""
+            1/N for the data-parallel mean, loss-scale unscale, value
+            clip, global-norm scale (need_clip-masked)."""
             g32 = gs.astype(jnp.float32) * inv_n
+            if inv_s is not None:
+                g32 = g32 * inv_s
             if cv is not None:
                 clipped = jnp.clip(g32, cv[0], cv[1])
                 g32 = (clipped if nc_shard is None
@@ -422,6 +442,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             s, o = state["s"], state["o"]
             saved_buf = self._bind(self._buffers, state["buf"])
             try:
+                gst = state.get("guard")
+                inv_s = (1.0 / gst["scale"]) if scaling else None
                 t = state["step"] + 1
                 tf = t.astype(jnp.float32)
                 t32 = t.astype(jnp.int32)
@@ -446,16 +468,25 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 loss, head_vjp = jax.vjp(
                     lambda od, x: self._head_fn(od, x, labels),
                     o["p"], xL)
-                d_o_head, dxL = head_vjp(jnp.ones((), loss.dtype))
+                ct = (gst["scale"].astype(loss.dtype) if scaling
+                      else jnp.ones((), loss.dtype))
+                d_o_head, dxL = head_vjp(ct)
 
                 # ---- backward scan: vjp one chunk, reduce-scatter its
-                # bucket-packed grads; ONLY the 1/N shard and the
-                # running squared norm survive the iteration
+                # bucket-packed grads; ONLY the 1/N shard, the running
+                # squared norm, and the finiteness fold survive the
+                # iteration. Unlike the single-device step, the guard
+                # needs NO second backward here: the shards it must
+                # inspect all outlive the scan anyway (sum-reductions
+                # preserve non-finiteness, so checking the post-scatter
+                # 1/N shard covers every element at 1/N the cost).
+                from .nonfinite_guard import all_finite
+
                 G0 = tuple(jnp.zeros((C, K, bkt.numel // N), bkt.dtype)
                            for bkt in s_assign.buckets)
 
                 def bwd_body(carry, scanned):
-                    dy, sq, G = carry
+                    dy, sq, fin, G = carry
                     x_i, i = scanned
                     p_i = tuple(
                         lax.dynamic_index_in_dim(a, i, keepdims=False)
@@ -473,12 +504,15 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                             nc = shard_of(s_hp[bkt.index][3], rank,
                                           bkt.numel // N)
                             sq = sq + sq_of(gs, nc)
+                        if guard is not None:
+                            fin = fin & all_finite([gs])
                         newG.append(lax.dynamic_update_index_in_dim(
                             G[bkt.index], gs, i, 0))
-                    return (dx, sq, tuple(newG)), None
+                    return (dx, sq, fin, tuple(newG)), None
 
-                (dx0, sq, G), _ = lax.scan(
-                    bwd_body, (dxL, jnp.float32(0.0), G0),
+                (dx0, sq, fin, G), _ = lax.scan(
+                    bwd_body,
+                    (dxL, jnp.float32(0.0), jnp.bool_(True), G0),
                     (xs, jnp.arange(C)), reverse=True,
                     unroll=self._scan_unroll)
 
@@ -499,15 +533,30 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                         nc = shard_of(o_hp[bkt.index][3], rank,
                                       bkt.numel // N)
                         sq = sq + sq_of(gs, nc)
+                    if guard is not None:
+                        fin = fin & all_finite([gs])
                     o_gs.append(gs)
 
-                # ---- the fused global-norm clip: ONE scalar all-reduce
+                # ---- the fused global-norm clip + cross-rank found_inf:
+                # still ONE scalar all-reduce (a length-2 psum when the
+                # guard is on — norm and finiteness ride together)
                 scale = None
-                if clip_norm is not None:
-                    gnorm = jnp.sqrt(lax.psum(sq, ax))
-                    scale = jnp.minimum(
-                        jnp.float32(clip_norm)
-                        / jnp.maximum(gnorm, 1e-12), 1.0)
+                found = None
+                if clip_norm is not None or guard is not None:
+                    bad_local = (jnp.float32(0.0) if guard is None
+                                 else (~fin).astype(jnp.float32))
+                    tot = lax.psum(jnp.stack([sq, bad_local]), ax)
+                    if guard is not None:
+                        found = tot[1] > 0
+                    if clip_norm is not None:
+                        # shard grads carry the loss scale: true norm is
+                        # sqrt(psum(sq))/loss_scale
+                        gnorm = jnp.sqrt(tot[0])
+                        if inv_s is not None:
+                            gnorm = gnorm * inv_s
+                        scale = jnp.minimum(
+                            jnp.float32(clip_norm)
+                            / jnp.maximum(gnorm, 1e-12), 1.0)
 
                 # ---- update scan: sharded Adam on each chunk's grad
                 # shard, then all_gather the updated shard back into the
@@ -531,7 +580,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                         g32 = g_shard_f32(
                             lax.dynamic_index_in_dim(G[bi], i,
                                                      keepdims=False),
-                            nc, scale)
+                            nc, scale, inv_s)
                         m_i = lax.dynamic_index_in_dim(M[bi], i,
                                                        keepdims=False)
                         v_i = lax.dynamic_index_in_dim(V[bi], i,
@@ -552,6 +601,15 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                                 flat_p, rank * shard_len, shard_len, 1)
                         out32, mn, vn, _ = adam_shard(
                             pv, g32, m_i, v_i, lr * lrs, tf, wd, l2)
+                        if found is not None:
+                            # bad step: shard passes through bit-
+                            # identical; the gather below then rebuilds
+                            # the OLD params exactly (astype(master) is
+                            # the same deterministic cast that produced
+                            # them)
+                            out32 = jnp.where(found, pv, out32)
+                            mn = jnp.where(found, m_i, mn)
+                            vn = jnp.where(found, v_i, vn)
                         M[bi] = lax.dynamic_update_index_in_dim(
                             M[bi], mn.astype(M[bi].dtype), i, 0)
                         V[bi] = lax.dynamic_update_index_in_dim(
@@ -588,7 +646,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                     shard_len = bkt.numel // N
                     wd, l2, lrs, nc = (shard_of(h, rank, shard_len)
                                        for h in o_hp[bi])
-                    g32 = g_shard_f32(o_gs[bi], nc, scale)
+                    g32 = g_shard_f32(o_gs[bi], nc, scale, inv_s)
                     m_i, v_i = o["m"][bi], o["v"][bi]
                     if o["mw"][bi] is not None:
                         pv = o["mw"][bi]
@@ -598,6 +656,10 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                             flat_p, rank * shard_len, shard_len, 0)
                     out32, mn, vn, _ = adam_shard(
                         pv, g32, m_i, v_i, lr * lrs, tf, wd, l2)
+                    if found is not None:
+                        out32 = jnp.where(found, pv, out32)
+                        mn = jnp.where(found, m_i, mn)
+                        vn = jnp.where(found, v_i, vn)
                     new_om.append(mn.astype(m_i.dtype))
                     new_ov.append(vn.astype(v_i.dtype))
                     new_omw.append(out32 if o["mw"][bi] is not None
@@ -618,8 +680,11 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                     "o": {"p": new_op, "m": new_om, "v": new_ov,
                           "mw": new_omw},
                     "buf": state["buf"],
-                    "step": t,
+                    "step": (t if found is None
+                             else jnp.where(found, state["step"], t)),
                 }
+                if guard is not None:
+                    new_state["guard"] = guard.update(gst, found)
                 return lax.psum(loss, ax) * inv_n, new_state
             finally:
                 self._bind(self._buffers, saved_buf)

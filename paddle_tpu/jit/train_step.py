@@ -50,6 +50,33 @@ def _tree_wrap(x):
     return x
 
 
+def _commit_uncommitted(state):
+    """Single-device flavor of the layout canonicalization: a checkpoint
+    restore leaves the params committed to their device while freshly
+    created scalars (guard state, rng offset, step count) are uncommitted.
+    jit keys committed and uncommitted arguments differently, and every
+    output of the first call comes back committed — so the second call
+    after a restore would compile one extra executable. Returns the state
+    with the uncommitted leaves committed to the same device, or None when
+    nothing is committed (fresh run: leave everything uncommitted, jit
+    outputs then stay uncommitted too and the cache key is stable)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(state)
+              if isinstance(l, jax.Array)]
+    dev = next((next(iter(l.devices())) for l in leaves
+                if getattr(l, "_committed", False)), None)
+    if dev is None or not all(
+            len(l.devices()) == 1 for l in leaves):   # mesh programs: no-op
+        return None
+
+    def _commit(leaf):
+        if isinstance(leaf, jax.Array) and not getattr(
+                leaf, "_committed", True):
+            return jax.device_put(leaf, dev)
+        return leaf
+
+    return jax.tree_util.tree_map(_commit, state)
+
+
 def _unwrap_optimizer(opt):
     """Follow wrapper chains (HybridParallelOptimizer, sharding wrappers) to
     the Optimizer that owns the state dicts."""
@@ -75,11 +102,22 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, donate=True,
-                 accumulate_steps=1, accum_steps=None):
+                 accumulate_steps=1, accum_steps=None, scaler=None,
+                 guard_nonfinite=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer             # outer (may be a wrapper)
         self._opt = _unwrap_optimizer(optimizer)  # state owner
+        # in-graph non-finite guard (jit/nonfinite_guard.py): gate the
+        # whole state update on a traced found_inf so one NaN/inf step
+        # cannot destroy the only copy of the donated params; a bound
+        # GradScaler additionally runs its dynamic loss scale as traced
+        # state (zero host syncs, zero retraces)
+        from .nonfinite_guard import GuardSpec
+
+        self._guard = (GuardSpec(scaler)
+                       if (scaler is not None or guard_nonfinite)
+                       else None)
 
         self._params = None   # resolved lazily: optimizer may create accums on 1st step
         self._buffers = None
@@ -118,13 +156,16 @@ class TrainStep:
         self._buffers = list(self.model.buffers())
 
     def _extract_state(self):
-        return {
+        state = {
             "params": [p._data for p in self._params],
             "buffers": [b._data for b in self._buffers],
             "opt": self._opt.opt_state_pytree(),
             "rng_offset": jnp.asarray(_random.default_generator()._offset, jnp.int64
                                       if jax.config.jax_enable_x64 else jnp.int32),
         }
+        if self._guard is not None:
+            state["guard"] = self._guard.init_state()
+        return state
 
     def _inject_state(self, state):
         for p, d in zip(self._params, state["params"]):
@@ -133,6 +174,8 @@ class TrainStep:
             b._data = d
         self._opt.load_opt_state_pytree(state["opt"])
         _random.default_generator()._offset = state["rng_offset"]
+        if self._guard is not None and "guard" in state:
+            self._guard.writeback(state["guard"])
 
     # -- the traced step ------------------------------------------------
     def _build(self, example_batch):
@@ -177,6 +220,10 @@ class TrainStep:
             canon_state = jax.tree_util.tree_map(_canon,
                                                  self._extract_state())
             self._inject_state(canon_state)
+        else:
+            canon_state = _commit_uncommitted(self._extract_state())
+            if canon_state is not None:
+                self._inject_state(canon_state)
 
         ref_state = self._extract_state()
         ref_shardings = jax.tree_util.tree_map(
@@ -207,9 +254,26 @@ class TrainStep:
                     f"batch size {next(iter(sizes))} is not divisible by "
                     f"accumulate_steps={acc}")
 
+        guard = self._guard
+        scaling = guard is not None and guard.scaling
+
         def step_fn(state, lr, batch):
             self._inject_state(state)
+            gst = state.get("guard")
+            scale_t = gst["scale"] if scaling else None
             batch_t = _tree_wrap(batch)
+
+            def backward(loss_tensor):
+                # dynamic loss scaling: backward through loss*scale, so
+                # small bf16 grads survive; the unscale happens on the
+                # grads below, fused into the same program
+                if scale_t is None:
+                    loss_tensor.backward()
+                else:
+                    (loss_tensor
+                     * Tensor._wrap(scale_t.astype(
+                         loss_tensor._data.dtype))).backward()
+
             if acc > 1:
                 losses = []
                 for m in range(acc):
@@ -219,17 +283,34 @@ class TrainStep:
                             + tuple(t._data.shape[1:]))[m])
                         if isinstance(t, Tensor) else t for t in batch_t]
                     ml = self.loss_fn(self.model, *micro) * (1.0 / acc)
-                    ml.backward()
+                    backward(ml)
                     losses.append(ml._data)
                 loss = Tensor._wrap(sum(losses))
             else:
                 loss = self.loss_fn(self.model, *batch_t)
-                loss.backward()
+                backward(loss)
             # gradient-comm boundary: all microbatch backwards are done,
             # flush the deferred bucket collectives (one per bucket)
             sync = getattr(self.model, "apply_collective_grads", None)
             if callable(sync):
                 sync()
+            # the in-graph guard: ONE fused finiteness reduction over
+            # the (still scaled) grads; unscale in the same program
+            found = None
+            if guard is not None:
+                from .nonfinite_guard import all_finite
+
+                grads = [p.grad._data for p in self._params
+                         if p.grad is not None]
+                found = ~all_finite(grads)
+                if scale_t is not None:
+                    inv = 1.0 / scale_t
+                    for p in self._params:
+                        if p.grad is None:
+                            continue
+                        g = p.grad._data
+                        p.grad._data = (g.astype(jnp.float32)
+                                        * inv).astype(g.dtype)
             # freeze lr at the traced scalar for this step (declared
             # protocol: Optimizer.get_lr honors _lr_override)
             with inner.lr_frozen(lr):
@@ -243,6 +324,14 @@ class TrainStep:
                 opt.step()
             opt.clear_grad()
             new_state = _repin(self._extract_state())
+            if guard is not None:
+                from .nonfinite_guard import gate
+
+                core = {k: v for k, v in new_state.items()
+                        if k != "guard"}
+                old = {k: v for k, v in state.items() if k != "guard"}
+                new_state = gate(found, core, old)
+                new_state["guard"] = guard.update(gst, found)
             return loss._data, new_state
 
         donate = (0,) if self._donate else ()

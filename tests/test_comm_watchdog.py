@@ -1,0 +1,167 @@
+"""Collective watchdog (distributed/comm_watchdog.py): timeout
+detection, main-thread interrupt, stand-down after unwind, and
+escalation arming.
+
+Reference test strategy: the CommTaskManager timeout tests
+(test/cpp/fluid/platform/collective/*), blocking-wait edition. Every
+manager here runs with ``hard_exit_grace=None`` so no test can ever
+reach the ``os._exit`` escalation path — arming is asserted via the
+manager's ``_interrupted_at`` state, never by letting it fire.
+"""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.comm_watchdog import (
+    CommTaskManager, get_comm_task_manager, watch,
+)
+
+
+@pytest.fixture
+def mgr():
+    m = CommTaskManager(interval=0.02, hard_exit_grace=None)
+    yield m
+    m.abort_on_timeout = False
+    m.shutdown()
+
+
+def _wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestTimeoutDetection:
+    def test_overrun_is_reported(self, mgr):
+        """A wait exceeding its deadline lands in ``timed_out`` (tagged),
+        even with abort disabled."""
+        mgr.abort_on_timeout = False
+        with mgr.watch("step#7", timeout=0.05):
+            assert _wait_until(lambda: "step#7" in mgr.timed_out)
+        assert mgr.timed_out.count("step#7") == 1
+
+    def test_fast_wait_is_silent(self, mgr):
+        mgr.abort_on_timeout = False
+        for i in range(3):
+            with mgr.watch(f"ok#{i}", timeout=5.0):
+                time.sleep(0.01)
+        time.sleep(0.1)
+        assert mgr.timed_out == []
+        with mgr._lock:
+            assert not mgr._tasks       # exits always cancel their task
+
+    def test_expired_entry_kept_until_unwind(self, mgr):
+        """After expiry the task entry stays (deadline -> inf) so the
+        escalation's did-it-unwind check can see the stuck wait."""
+        mgr.abort_on_timeout = False
+        with mgr.watch("stuck", timeout=0.03):
+            assert _wait_until(lambda: "stuck" in mgr.timed_out)
+            with mgr._lock:
+                deadlines = [dl for _, _, dl in mgr._tasks.values()]
+            assert deadlines == [float("inf")]
+        with mgr._lock:
+            assert not mgr._tasks
+
+
+class TestMainThreadInterrupt:
+    def test_interrupts_main_thread(self, mgr):
+        """abort_on_timeout raises KeyboardInterrupt in the main thread —
+        the only way out of a wait stuck at the Python level."""
+        with pytest.raises(KeyboardInterrupt):
+            with mgr.watch("dead-collective", timeout=0.05):
+                for _ in range(500):        # interruptible blocking wait
+                    time.sleep(0.01)
+        assert "dead-collective" in mgr.timed_out
+
+    def test_no_interrupt_when_disabled(self, mgr):
+        mgr.abort_on_timeout = False
+        with mgr.watch("slow-but-tolerated", timeout=0.03):
+            time.sleep(0.15)                # would raise if interrupted
+        assert "slow-but-tolerated" in mgr.timed_out
+
+
+class TestStandDownAndEscalation:
+    def test_stand_down_after_unwind(self, mgr):
+        """Once every expired wait unwound, the escalation disarms —
+        healthy concurrent waits must not keep it armed."""
+        with pytest.raises(KeyboardInterrupt):
+            with mgr.watch("unwinds", timeout=0.05):
+                for _ in range(500):
+                    time.sleep(0.01)
+        # the watch exited -> its entry is gone -> monitor stands down
+        assert _wait_until(lambda: mgr._interrupted_at is None)
+
+    def test_escalation_armed_while_stuck(self, mgr, monkeypatch):
+        """A wait that never unwinds keeps the escalation armed
+        (_interrupted_at set); hard_exit_grace=None must never fire it.
+        The interrupt is captured instead of delivered so this test's
+        own thread is never actually interrupted."""
+        hits = []
+        import _thread
+
+        monkeypatch.setattr(_thread, "interrupt_main",
+                            lambda *a: hits.append(time.monotonic()))
+        exited = []
+        import os as _os
+
+        monkeypatch.setattr(_os, "_exit",
+                            lambda code: exited.append(code))
+        done = threading.Event()
+
+        def stuck_wait():
+            with mgr.watch("never-unwinds", timeout=0.03):
+                done.wait(2.0)
+
+        t = threading.Thread(target=stuck_wait, daemon=True)
+        t.start()
+        assert _wait_until(lambda: hits)            # interrupt issued
+        assert _wait_until(lambda: mgr._interrupted_at is not None)
+        armed_at = mgr._interrupted_at
+        time.sleep(0.2)                 # >> any plausible grace window
+        assert mgr._interrupted_at == armed_at      # still armed
+        assert exited == []             # grace=None: no hard exit, ever
+        done.set()
+        t.join(timeout=2)
+        assert _wait_until(lambda: mgr._interrupted_at is None)
+
+    def test_concurrent_healthy_wait_not_blamed(self, mgr, monkeypatch):
+        """Only the expired wait is reported; an overlapping healthy
+        wait neither times out nor re-arms after the stuck one exits."""
+        import _thread
+
+        monkeypatch.setattr(_thread, "interrupt_main", lambda *a: None)
+        release = threading.Event()
+
+        def slow():
+            with mgr.watch("the-stuck-one", timeout=0.03):
+                release.wait(2.0)
+
+        t = threading.Thread(target=slow, daemon=True)
+        t.start()
+        assert _wait_until(lambda: "the-stuck-one" in mgr.timed_out)
+        with mgr.watch("healthy", timeout=5.0):
+            time.sleep(0.05)
+        release.set()
+        t.join(timeout=2)
+        assert "healthy" not in mgr.timed_out
+        assert _wait_until(lambda: mgr._interrupted_at is None)
+
+
+class TestModuleSurface:
+    def test_global_manager_singleton_and_watch(self):
+        m = get_comm_task_manager()
+        assert m is get_comm_task_manager()
+        # module-level watch() routes through the singleton
+        saved, m.abort_on_timeout = m.abort_on_timeout, False
+        try:
+            with watch("module-level", timeout=5.0):
+                pass
+            with m._lock:
+                assert not m._tasks
+        finally:
+            m.abort_on_timeout = saved
+            m.shutdown()
