@@ -180,3 +180,27 @@ def get_rank() -> int:
 
 def device_count() -> int:
     return len(jax.devices())
+
+
+def data_sharding(mesh=None, axis=None):
+    """The batch-input sharding for a data-parallel mesh: dim 0 split over
+    the dp-like axis (first of sharding/dp/data with degree > 1), all
+    other dims replicated — what `io.DevicePrefetcher` and the train
+    steps' `input_sharding()` place batches on, so each device receives
+    only its 1/N shard of every batch. Returns a fully-replicated sharding
+    when the mesh has no >1 data axis, and None when no mesh is installed
+    (single chip: default-device placement)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        if not is_initialized():
+            return None
+        mesh = get_mesh()
+        if mesh is None:
+            return None
+    if axis is None:
+        axis = next((a for a in ("sharding", "dp", "data")
+                     if a in mesh.axis_names and mesh.shape[a] > 1), None)
+    if axis is None:
+        return NamedSharding(mesh, PartitionSpec())
+    return NamedSharding(mesh, PartitionSpec(axis))

@@ -76,7 +76,12 @@ class Model:
         loss = self._loss(*(outs + lbls))
         return loss
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True, sync=True):
+        """One training step. `sync=False` returns the loss as a Tensor
+        WITHOUT reading it back to the host — the readback is a hidden
+        device sync that serializes dispatch against compute, so `fit`
+        only syncs at log boundaries (the input-pipeline audit: dispatch
+        stays async between steps)."""
         self.network.train()
         self.mode = "train"
         inputs = _to_list(inputs)
@@ -98,7 +103,7 @@ class Model:
         for m in self._metrics:
             m.update(*_to_list(m.compute(*( _to_list(outputs) + labels))))
             metrics.append(m.accumulate())
-        out = [float(loss)]
+        out = [float(loss) if sync else loss.detach()]
         return (out, metrics) if metrics else out
 
     @no_grad()
@@ -145,11 +150,30 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None,
+            prefetch=False, prefetch_depth=2):
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        prefetcher = None
+        if prefetch and loader is not None:
+            # device-side input prefetch (io.DevicePrefetcher): batches
+            # stage onto the device on a background thread while the
+            # previous step computes; stats land in
+            # `self.input_pipeline_stats` after fit
+            from ..io.device_prefetcher import DevicePrefetcher
+
+            if isinstance(loader, DevicePrefetcher):
+                prefetcher = loader
+            else:
+                prefetcher = loader = DevicePrefetcher(
+                    loader, depth=prefetch_depth)
 
         cbks = _to_list(callbacks)
+        # user-supplied callbacks read logs['loss'] every batch and have
+        # always seen host floats — defer the loss readback only when the
+        # batch-end consumers are our own (ProgBarLogger syncs at the same
+        # log_freq boundaries; ModelCheckpoint only acts at epoch end)
+        has_user_cbks = bool(cbks)
         if verbose:
             cbks.append(ProgBarLogger(log_freq, verbose=verbose))
         if save_dir:
@@ -168,30 +192,59 @@ class Model:
         self.stop_training = False
         cbk_list.on_train_begin()
         global_step = 0
-        for epoch in range(epochs):
-            cbk_list.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbk_list.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                update = (step + 1) % accumulate_grad_batches == 0
-                result = self.train_batch(inputs, labels, update=update)
-                logs = self._result_to_logs(result)
-                cbk_list.on_train_batch_end(step, logs)
-                global_step += 1
-                if num_iters is not None and global_step >= num_iters:
-                    self.stop_training = True
+        try:
+            for epoch in range(epochs):
+                cbk_list.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(loader):
+                    cbk_list.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    update = (step + 1) % accumulate_grad_batches == 0
+                    # host-sync audit: read the loss back only at log
+                    # boundaries (and when metrics need outputs anyway) so
+                    # dispatch of step N+1 overlaps step N's device compute
+                    sync = (bool(self._metrics)
+                            or has_user_cbks
+                            or (bool(verbose) and (step + 1) % log_freq == 0)
+                            or (steps is not None and step == steps - 1))
+                    result = self.train_batch(inputs, labels, update=update,
+                                              sync=sync)
+                    logs = self._result_to_logs(result)
+                    cbk_list.on_train_batch_end(step, logs)
+                    global_step += 1
+                    if num_iters is not None and global_step >= num_iters:
+                        self.stop_training = True
+                        break
+                logs = self._sync_logs(logs)
+                cbk_list.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, batch_size=batch_size,
+                                  verbose=0, callbacks=cbks,
+                                  num_workers=num_workers)
+                if self.stop_training:
                     break
-            cbk_list.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, batch_size=batch_size, verbose=0,
-                              callbacks=cbks, num_workers=num_workers)
-            if self.stop_training:
-                break
-        cbk_list.on_train_end(logs)
+            cbk_list.on_train_end(logs)
+        finally:
+            # runs even when a step/callback raises mid-epoch: stop the
+            # producer thread and release the staged device ring
+            if prefetcher is not None:
+                self.input_pipeline_stats = prefetcher.get_stats()
+                prefetcher.close()
         return self
+
+    def _sync_logs(self, logs):
+        """Force any deferred (Tensor) loss values in `logs` to host
+        floats — epoch/train-end callbacks see concrete numbers."""
+        out = {}
+        for k, v in (logs or {}).items():
+            if isinstance(v, list):
+                out[k] = [float(x) if isinstance(x, Tensor) else x
+                          for x in v]
+            else:
+                out[k] = float(v) if isinstance(v, Tensor) else v
+        return out
 
     def _result_to_logs(self, result):
         logs = {}

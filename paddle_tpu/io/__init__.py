@@ -267,6 +267,8 @@ def numpy_collate_fn(batch):
     they never import jax (spawned workers stay lightweight; the parent
     wraps arrays into Tensors on arrival)."""
     sample = batch[0]
+    if hasattr(sample, "_data"):   # Tensor samples, duck-typed so worker
+        return np.stack([np.asarray(s._data) for s in batch])  # stays jax-free
     if isinstance(sample, np.ndarray):
         return np.stack(batch)
     if isinstance(sample, (int, float)):
@@ -381,28 +383,53 @@ class DataLoader:
                 yield first
                 yield from gen
                 return
-        # thread-prefetch pipeline: overlap host batch assembly with compute
+        # thread-prefetch pipeline: overlap host batch assembly with compute.
+        # The stop event + bounded puts make abandonment clean: a consumer
+        # that breaks/raises mid-epoch closes this generator, the finally
+        # signals the producer (which may be blocked on a full queue),
+        # drains, and joins — no orphaned producer threads.
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
+        stop = threading.Event()
         error = []
 
         def producer():
             try:
                 for b in self._batches():
-                    q.put(b)
+                    while not stop.is_set():
+                        try:
+                            q.put(b, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    else:
+                        return
             except Exception as e:  # surface worker errors on the consumer
                 error.append(e)
             finally:
-                q.put(sentinel)
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            stop.set()
+            while True:  # unblock a producer waiting on a full queue
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=10)
         if error:
             raise error[0]
 
@@ -446,3 +473,4 @@ class DataLoader:
 from pickle import PicklingError as _PickleError  # noqa: E402
 
 from .worker import get_worker_info  # noqa: E402,F401
+from .device_prefetcher import DevicePrefetcher  # noqa: E402,F401

@@ -1,0 +1,225 @@
+"""Hermetic input-pipeline selftest (bench.py `input_pipeline` lane).
+
+Run as `python -m paddle_tpu.io.input_pipeline_selftest` in a
+JAX_PLATFORMS=cpu subprocess (bench._run_cpu_probe); prints ONE JSON line.
+
+Asserts the ISSUE-5 acceptance bundle:
+ 1. throttled A/B — on a loader throttled to ~half the step time, the
+    prefetched path's input stall is <= 10% of the sync path's (the
+    prefetcher genuinely overlaps host batch production with compute);
+ 2. bit-identity — training over a deterministic multi-epoch stream is
+    bit-identical sync vs prefetched (staging must not perturb numerics);
+ 3. zero added retraces — the whole prefetched run compiles exactly one
+    executable (compile-count probe on TrainStep._jitted);
+ 4. donation safety — a host loader that REUSES one mutable buffer still
+    delivers every batch intact (staging copies; a ring slot can never be
+    rewritten while in flight), and a batch held across later prefetches
+    keeps its values;
+ 5. sharded staging — on an 8-device dp mesh each device receives exactly
+    its 1/N shard of the batch, placed on the dp sharding.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH, DIM, HIDDEN = 256, 256, 1024
+
+
+def _make_step(seed=0):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(DIM, HIDDEN), nn.GELU(),
+                      nn.Linear(HIDDEN, HIDDEN), nn.GELU(),
+                      nn.Linear(HIDDEN, DIM))
+    opt = popt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = TrainStep(m, lambda mm, x, y: ((mm(x) - y) ** 2).mean(), opt)
+    return m, step
+
+
+def _batches(n, seed=0, throttle_s=0.0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        if throttle_s:
+            time.sleep(throttle_s)
+        yield (rng.standard_normal((BATCH, DIM)).astype(np.float32),
+               rng.standard_normal((BATCH, DIM)).astype(np.float32))
+
+
+class _SyncMeter:
+    """The no-prefetch baseline with the same stall accounting: time
+    blocked pulling + transferring a batch on the step loop's thread."""
+
+    def __init__(self, it):
+        self._it = it
+        self.stall_ms = []
+
+    def __iter__(self):
+        import jax
+
+        for _ in iter(int, 1):
+            t0 = time.perf_counter()
+            try:
+                batch = next(self._it)
+            except StopIteration:
+                return
+            staged = tuple(jax.device_put(b) for b in batch)
+            for s in staged:
+                s.block_until_ready()
+            self.stall_ms.append((time.perf_counter() - t0) * 1e3)
+            yield staged
+
+
+def _params_bytes(model):
+    return [np.asarray(p._data).tobytes() for p in model.parameters()]
+
+
+def run():
+    import jax
+
+    from paddle_tpu.io.device_prefetcher import DevicePrefetcher
+
+    rec = {}
+
+    # -- calibrate: step time on this host ------------------------------
+    # take the MIN over several rounds: a transiently loaded host can
+    # inflate one measurement 5x, and an overestimated step sets a
+    # throttle the producer physically cannot hide (false stall)
+    model, step = _make_step()
+    warm = list(_batches(2, seed=9))
+    for x, y in warm:
+        loss = step(x, y)
+    jax.block_until_ready(loss._data)
+    rounds = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for x, y in warm * 2:
+            loss = step(x, y)
+        jax.block_until_ready(loss._data)
+        rounds.append((time.perf_counter() - t0) * 1e3 / 4)
+    step_ms = min(rounds)
+    # throttle well under the step time: a correct prefetcher fully hides
+    # it, the sync path pays it on every pull; the margin absorbs host
+    # jitter between calibration and the measured lanes
+    throttle_s = max(0.004, 0.4 * step_ms / 1e3)
+    rec["step_ms"] = round(step_ms, 2)
+    rec["throttle_ms"] = round(throttle_s * 1e3, 2)
+    n = 16
+
+    # Both lanes block on the loss every step (a device-bound loop: the
+    # host waits for the chip, the chip must never wait for the host) —
+    # the stall metric then measures exactly what the prefetcher hides.
+    # -- sync lane ------------------------------------------------------
+    model_s, step_s = _make_step()
+    meter = _SyncMeter(_batches(n, seed=1, throttle_s=throttle_s))
+    t0 = time.perf_counter()
+    for x, y in meter:
+        loss = step_s(x, y)
+        jax.block_until_ready(loss._data)
+    rec["sync_wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    sync_stall = float(np.mean(meter.stall_ms))
+    rec["sync_stall_ms"] = round(sync_stall, 3)
+
+    # -- prefetched lane (+ retrace probe) ------------------------------
+    model_p, step_p = _make_step()
+    pf = DevicePrefetcher(_batches(n, seed=1, throttle_s=throttle_s),
+                          depth=3)
+    first_cache = None
+    t0 = time.perf_counter()
+    for x, y in pf:
+        loss = step_p(x, y)
+        jax.block_until_ready(loss._data)
+        if first_cache is None:
+            first_cache = step_p._jitted._cache_size()
+    rec["prefetch_wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    stats = pf.get_stats()
+    per_step = stats["per_step_input_stall_ms"]
+    # steady-state stall: batch 0 pays the one-time pipeline fill
+    # (throttle + h2d before anything is staged) — that is latency, not
+    # recurring stall, so the <=10% gate judges batches 1..n
+    pf_stall = float(np.mean(per_step[1:]))
+    rec["prefetch_stall_ms"] = round(pf_stall, 3)
+    rec["prefetch_fill_ms"] = round(per_step[0], 3)
+    rec["h2d_ms"] = stats["h2d_ms"]["mean"]
+    rec["stall_ratio"] = round(pf_stall / max(sync_stall, 1e-9), 4)
+    # <=10% of sync, with a 1ms absolute floor so scheduler noise on a
+    # shared CPU host can't flake a genuinely-overlapped run
+    assert pf_stall <= max(0.10 * sync_stall, 1.0), (
+        f"prefetched steady-state stall {pf_stall:.3f}ms > 10% of sync "
+        f"{sync_stall:.3f}ms")
+    final_cache = step_p._jitted._cache_size()
+    rec["compile_count"] = final_cache
+    assert final_cache == first_cache == 1, (
+        f"prefetcher added retraces: {first_cache} -> {final_cache}")
+
+    # -- bit-identity over a multi-epoch stream -------------------------
+    epochs, per_epoch = 3, 6
+    model_a, step_a = _make_step(seed=7)
+    for e in range(epochs):
+        for x, y in _batches(per_epoch, seed=100 + e):
+            step_a(x, y)
+    want = _params_bytes(model_a)
+
+    model_b, step_b = _make_step(seed=7)
+    for e in range(epochs):
+        pf = DevicePrefetcher(_batches(per_epoch, seed=100 + e), depth=2)
+        for x, y in pf:
+            step_b(x, y)
+    got = _params_bytes(model_b)
+    rec["bit_identical"] = want == got
+    assert want == got, "sync vs prefetched training diverged bitwise"
+
+    # -- donation safety: reused + mutated host buffer ------------------
+    buf = np.zeros((8, 4), np.float32)
+
+    def reusing_loader():
+        for i in range(6):
+            buf[:] = i                 # rewrites the SAME host memory
+            yield (buf,)
+
+    pf = DevicePrefetcher(reusing_loader(), depth=3, to_tensor=False)
+    it = iter(pf)
+    held = next(it)                    # hold batch 0 across later stages
+    rest = list(it)
+    assert float(np.asarray(held[0]).mean()) == 0.0, (
+        "a staged buffer was rewritten while held — staging must copy")
+    for i, b in enumerate(rest, start=1):
+        assert float(np.asarray(b[0]).mean()) == float(i), (
+            f"batch {i} corrupted by host-buffer reuse")
+    rec["donation_safe"] = True
+
+    # -- sharded staging: 1/N per device --------------------------------
+    if len(jax.devices()) >= 8:
+        from paddle_tpu.distributed import env as denv
+
+        mesh = denv.build_mesh({"dp": 8})
+        pf = DevicePrefetcher(_batches(2, seed=3), depth=2, mesh=mesh,
+                              to_tensor=False)
+        b = next(iter(pf))[0]
+        shards = b.addressable_shards
+        assert len(shards) == 8 and shards[0].data.shape[0] == BATCH // 8
+        pf.close()
+        rec["sharded_1_over_n"] = True
+
+    rec["check"] = "pass"
+    return rec
+
+
+def main():
+    try:
+        rec = run()
+    except Exception as e:
+        rec = {"check": f"FAIL: {type(e).__name__}: {e}"[:300]}
+    print(json.dumps(rec))
+    return 0 if rec.get("check") == "pass" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

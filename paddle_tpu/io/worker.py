@@ -181,15 +181,54 @@ class WorkerPool:
         return out
 
     def shutdown(self):
-        for _ in self._procs:
+        """Stop workers and release every shared resource (idempotent).
+
+        Called from the loader's iterator `finally`, so it must be safe
+        MID-EPOCH — when the consumer raised/broke with batches still in
+        flight: workers blocked in a ring `send` are unstuck by draining,
+        stragglers are terminated after a short join, and the shm ring
+        segments are always unlinked (no leaked /dev/shm segments)."""
+        if not self._procs:
+            return
+        procs, self._procs = self._procs, []
+        for _ in procs:
             self._index_queue.put(None)
-        for p in self._procs:
-            p.join(timeout=10)
+        deadline = None
+        for p in procs:
+            p.join(timeout=2)
+        if any(p.is_alive() for p in procs):
+            # a worker mid-send on a full ring can't see the sentinel yet:
+            # drain the transports so it completes, then re-join briefly
+            import time
+
+            deadline = time.monotonic() + 5.0
+            while any(p.is_alive() for p in procs) \
+                    and time.monotonic() < deadline:
+                for c in self._channels:
+                    try:
+                        c.recv(timeout_ms=1)
+                    except Exception:
+                        pass
+                try:
+                    self._result_queue.get(timeout=0.01)
+                except Exception:
+                    pass
+                for p in procs:
+                    if not p.is_alive():
+                        p.join(timeout=0)
+        for p in procs:
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=2)
         for c in self._channels:
             try:
                 c.free()
             except Exception:
                 pass
         self._channels = []
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

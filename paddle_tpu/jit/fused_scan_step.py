@@ -241,6 +241,22 @@ class FusedScanTrainStep:
         # bias corrections to t=1 (r5 review finding)
         self._step_count = int(opt._step_count)
 
+    # -- input pipeline -------------------------------------------------
+    def input_sharding(self):
+        """Single-chip step: None → default-device placement (identical
+        to `paddle.to_tensor`, so prefetched batches hit the same
+        executable). ShardedFusedScanTrainStep overrides with its
+        dp-sharded batch spec."""
+        return None
+
+    def prefetch(self, loader, depth=2, **kw):
+        """Wrap `loader` in an `io.DevicePrefetcher` bound to this step's
+        input sharding (see TrainStep.prefetch)."""
+        from ..io.device_prefetcher import DevicePrefetcher
+
+        kw.setdefault("sharding", self.input_sharding())
+        return DevicePrefetcher(loader, depth=depth, **kw)
+
     # -- per-layer PRNG plumbing (dropout inside the scan) --------------
     # the sharded subclass overrides these with the dp-axis rank so every
     # rank draws distinct masks for its own batch rows

@@ -192,6 +192,13 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
     def _rng_rank(self):
         return lax.axis_index(self._axis)
 
+    def input_sharding(self):
+        """Batches stage dim-0-sharded 1/N over the dp axis — each device
+        receives only its shard of the global batch (the weight-update
+        sharding lesson applied to ingestion), and the placement matches
+        the step's shard_map batch spec so jit never reshards."""
+        return NamedSharding(self._mesh, P(self._axis))
+
     # -- flat sharded optimizer state -----------------------------------
     def _flat_key(self, grp, index):
         return f"__scan_shard_{grp}{index}__"

@@ -150,6 +150,39 @@ class TrainStep:
             accumulate_steps = accum_steps
         self.accumulate_steps = int(accumulate_steps)
 
+    # -- input pipeline -------------------------------------------------
+    def input_sharding(self):
+        """The placement batches should be staged on so the compiled step
+        never reshards its inputs: on a dp/sharding mesh, dim 0 split 1/N
+        over the data axis; on one chip, None (default-device placement —
+        identical to what `paddle.to_tensor` produces, so prefetched and
+        hand-fed batches hit the same executable)."""
+        from jax.sharding import NamedSharding
+
+        from ..distributed import env as denv
+
+        mesh = next(
+            (p._data.sharding.mesh for p in self.model.parameters()
+             if isinstance(getattr(p._data, "sharding", None),
+                           NamedSharding)), None)
+        if mesh is None:
+            return None
+        return denv.data_sharding(mesh=mesh)
+
+    def prefetch(self, loader, depth=2, **kw):
+        """Wrap `loader` in an `io.DevicePrefetcher` bound to this step's
+        input sharding — batches land on device, already placed, while
+        the previous step computes (zero-stall input delivery)::
+
+            step = TrainStep(model, loss_fn, opt)
+            for ids, labels in step.prefetch(loader):
+                loss = step(ids, labels)
+        """
+        from ..io.device_prefetcher import DevicePrefetcher
+
+        kw.setdefault("sharding", self.input_sharding())
+        return DevicePrefetcher(loader, depth=depth, **kw)
+
     # -- state plumbing -------------------------------------------------
     def _resolve_slots(self):
         self._params = [p for p in self.model.parameters() if p.trainable]

@@ -531,9 +531,12 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
 def pdist(x, p=2.0, name=None):
     def f(v):
         n = v.shape[0]
-        d = jnp.linalg.norm(v[:, None, :] - v[None, :, :], ord=p, axis=-1)
         iu = np.triu_indices(n, 1)
-        return d[iu]
+        # gather the i<j pairs BEFORE the norm: the full n x n distance
+        # matrix puts norm(0) on the diagonal, whose backward is
+        # 0 * (0/0) = NaN even though triu discards it — grads through
+        # pdist were NaN for every input
+        return jnp.linalg.norm(v[iu[0], :] - v[iu[1], :], ord=p, axis=-1)
 
     return unary(f, x, "pdist")
 

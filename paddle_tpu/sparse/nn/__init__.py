@@ -73,11 +73,17 @@ class BatchNorm(Layer):
                                coalesced=x._coalesced)
 
 
+_CONV_DESCOPE = (
+    "is descoped in TPU v1 — see docs/OP_COVERAGE.md, the "
+    "`sparse/conv_kernel.h` row: the cuSPARSE gather-scatter kernels "
+    "have no XLA analogue; the implementation path is a static-capacity pallas "
+    "gather-GEMM-scatter pack over SparseCooTensor (the layout exists)")
+
+
 class Conv3D(Layer):
     def __init__(self, *a, **k):
         raise NotImplementedError(
-            "sparse Conv3D is not in the TPU v1 op set (needs a pallas "
-            "gather-GEMM-scatter kernel pack)")
+            f"sparse.nn.{type(self).__name__} {_CONV_DESCOPE}")
 
 
 class SubmConv3D(Conv3D):
@@ -87,8 +93,7 @@ class SubmConv3D(Conv3D):
 class Conv2D(Layer):
     def __init__(self, *a, **k):
         raise NotImplementedError(
-            "sparse Conv2D is not in the TPU v1 op set (needs a pallas "
-            "gather-GEMM-scatter kernel pack)")
+            f"sparse.nn.{type(self).__name__} {_CONV_DESCOPE}")
 
 
 class SubmConv2D(Conv2D):
@@ -98,7 +103,8 @@ class SubmConv2D(Conv2D):
 class MaxPool3D(Layer):
     def __init__(self, *a, **k):
         raise NotImplementedError(
-            "sparse MaxPool3D is not in the TPU v1 op set")
+            "sparse.nn.MaxPool3D is descoped in TPU v1 — see "
+            "docs/OP_COVERAGE.md, the `sparse/pool_kernel.h` row")
 
 
 class SyncBatchNorm(BatchNorm):

@@ -22,7 +22,8 @@ from op_test import OpTest
 
 class Spec:
     def __init__(self, name, op, ref, inputs, grad=(0,), tols=None,
-                 dtypes=("float32", "bfloat16"), grad_kw=None):
+                 dtypes=("float32", "bfloat16"), grad_kw=None,
+                 grad_skip=None):
         self.name = name
         self.op = op
         self.ref = ref
@@ -31,6 +32,11 @@ class Spec:
         self.tols = tols or {}
         self.dtypes = dtypes
         self.grad_kw = grad_kw or {}
+        # forward-only specs must say WHY in one word (boolean / integer /
+        # indices / zerograd / discontinuous / constant / counting /
+        # nogradrule / nangrad / complex / unstable / aliasing / dynshape) — the
+        # explicit coverage boundary of the grad sweep
+        self.grad_skip = grad_skip
 
 
 def _pos(shape=(3, 4), lo=0.2, hi=2.0):
@@ -84,7 +90,7 @@ S("asin", lambda x: paddle.asin(x), np.arcsin, _unit())
 S("asinh", lambda x: paddle.asinh(x), np.arcsinh, _std())
 S("atan", lambda x: paddle.atan(x), np.arctan, _std())
 S("atanh", lambda x: paddle.atanh(x), np.arctanh, _unit(lo=-0.8, hi=0.8))
-S("ceil", lambda x: paddle.ceil(x), np.ceil, _std(scale=3), grad=None)
+S("ceil", lambda x: paddle.ceil(x), np.ceil, _std(scale=3), grad=None, grad_skip="zerograd")
 S("cos", lambda x: paddle.cos(x), np.cos, _std())
 S("cosh", lambda x: paddle.cosh(x), np.cosh, _std())
 S("deg2rad", lambda x: paddle.deg2rad(x), np.deg2rad, _std(scale=90))
@@ -93,7 +99,7 @@ S("erf", lambda x: paddle.erf(x), sps.erf, _std())
 S("erfinv", lambda x: paddle.erfinv(x), sps.erfinv, _unit(lo=-0.7, hi=0.7))
 S("exp", lambda x: paddle.exp(x), np.exp, _std())
 S("expm1", lambda x: paddle.expm1(x), np.expm1, _std())
-S("floor", lambda x: paddle.floor(x), np.floor, _std(scale=3), grad=None)
+S("floor", lambda x: paddle.floor(x), np.floor, _std(scale=3), grad=None, grad_skip="zerograd")
 S("frac", lambda x: paddle.frac(x), lambda x: x - np.trunc(x),
   _std(scale=3))
 S("i0", lambda x: paddle.i0(x), sps.i0, _std())
@@ -109,35 +115,35 @@ S("logit", lambda x: paddle.logit(x), sps.logit, _unit(lo=0.1, hi=0.9))
 S("neg", lambda x: paddle.neg(x), np.negative, _std())
 S("rad2deg", lambda x: paddle.rad2deg(x), np.rad2deg, _std())
 S("reciprocal", lambda x: paddle.reciprocal(x), np.reciprocal, _pos())
-S("round", lambda x: paddle.round(x), np.round, _std(scale=3), grad=None)
+S("round", lambda x: paddle.round(x), np.round, _std(scale=3), grad=None, grad_skip="zerograd")
 S("rsqrt", lambda x: paddle.rsqrt(x), lambda x: 1 / np.sqrt(x), _pos())
 S("sigmoid", lambda x: F.sigmoid(x), sps.expit, _std())
-S("sign", lambda x: paddle.sign(x), np.sign, _std(), grad=None)
-S("sgn", lambda x: paddle.sgn(x), np.sign, _std(), grad=None)
+S("sign", lambda x: paddle.sign(x), np.sign, _std(), grad=None, grad_skip="zerograd")
+S("sgn", lambda x: paddle.sgn(x), np.sign, _std(), grad=None, grad_skip="zerograd")
 S("sin", lambda x: paddle.sin(x), np.sin, _std())
 S("sinh", lambda x: paddle.sinh(x), np.sinh, _std())
 S("sqrt", lambda x: paddle.sqrt(x), np.sqrt, _pos())
 S("square", lambda x: paddle.square(x), np.square, _std())
 S("tan", lambda x: paddle.tan(x), np.tan, _unit())
 S("tanh", lambda x: paddle.tanh(x), np.tanh, _std())
-S("trunc", lambda x: paddle.trunc(x), np.trunc, _std(scale=3), grad=None)
+S("trunc", lambda x: paddle.trunc(x), np.trunc, _std(scale=3), grad=None, grad_skip="zerograd")
 S("isnan", lambda x: paddle.isnan(x),
   np.isnan, lambda rng: [np.asarray([[1.0, np.nan, 2.0]], np.float32)],
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("isinf", lambda x: paddle.isinf(x),
   np.isinf, lambda rng: [np.asarray([[1.0, np.inf, 2.0]], np.float32)],
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("isfinite", lambda x: paddle.isfinite(x),
   np.isfinite,
   lambda rng: [np.asarray([[1.0, np.inf, np.nan]], np.float32)],
-  grad=None)
-S("angle", lambda x: paddle.angle(x), np.angle, _std(), grad=None)
+  grad=None, grad_skip="boolean")
+S("angle", lambda x: paddle.angle(x), np.angle, _std(), grad=None, grad_skip="complex")
 S("conj", lambda x: paddle.conj(x), np.conj, _std())
-S("real", lambda x: paddle.real(x), np.real, _std(), grad=None)
-S("imag", lambda x: paddle.imag(x), np.imag, _std(), grad=None)
+S("real", lambda x: paddle.real(x), np.real, _std(), grad=None, grad_skip="complex")
+S("imag", lambda x: paddle.imag(x), np.imag, _std(), grad=None, grad_skip="complex")
 S("nan_to_num", lambda x: paddle.nan_to_num(x), np.nan_to_num,
   lambda rng: [np.asarray([[1.0, np.nan, -np.inf, np.inf]], np.float32)],
-  grad=None)
+  grad=None, grad_skip="nangrad")
 S("clip", lambda x: paddle.clip(x, -0.5, 0.5),
   lambda x: np.clip(x, -0.5, 0.5), _std())
 S("polygamma", lambda x: paddle.polygamma(x, 1),
@@ -165,11 +171,11 @@ S("pow", lambda x, y: paddle.pow(x, y), np.power,
   grad=(0, 1))
 S("mod", lambda x, y: paddle.mod(x, y), np.mod,
   lambda rng: [rng.uniform(-3, 3, (3, 4)).astype("float32"),
-               rng.uniform(0.5, 2, (3, 4)).astype("float32")], grad=None)
+               rng.uniform(0.5, 2, (3, 4)).astype("float32")], grad=None, grad_skip="discontinuous")
 S("floor_divide", lambda x, y: paddle.floor_divide(x, y),
   np.floor_divide,
   lambda rng: [rng.uniform(-3, 3, (3, 4)).astype("float32"),
-               rng.uniform(0.5, 2, (3, 4)).astype("float32")], grad=None)
+               rng.uniform(0.5, 2, (3, 4)).astype("float32")], grad=None, grad_skip="zerograd")
 S("maximum", lambda x, y: paddle.maximum(x, y), np.maximum, _std(n=2),
   grad=(0, 1))
 S("minimum", lambda x, y: paddle.minimum(x, y), np.minimum, _std(n=2),
@@ -185,22 +191,22 @@ S("hypot", lambda x, y: paddle.hypot(x, y), np.hypot, _std(n=2),
 S("logaddexp", lambda x, y: paddle.logaddexp(x, y), np.logaddexp,
   _std(n=2), grad=(0, 1))
 S("heaviside", lambda x, y: paddle.heaviside(x, y), np.heaviside,
-  _std(n=2), grad=None)
+  _std(n=2), grad=None, grad_skip="zerograd")
 S("copysign", lambda x, y: paddle.copysign(x, y), np.copysign, _std(n=2),
-  grad=None)
+  grad=(0,))
 S("nextafter", lambda x, y: paddle.nextafter(x, y), np.nextafter,
-  _std(n=2), grad=None, dtypes=("float32",))
+  _std(n=2), grad=None, grad_skip="nogradrule", dtypes=("float32",))
 S("ldexp", lambda x, y: paddle.ldexp(x, y),
   lambda x, y: np.ldexp(x, y),
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
-               rng.integers(-2, 3, (3, 4)).astype("int32")], grad=None)
+               rng.integers(-2, 3, (3, 4)).astype("int32")], grad=None, grad_skip="nogradrule")
 S("remainder", lambda x, y: paddle.remainder(x, y), np.remainder,
   lambda rng: [rng.uniform(-3, 3, (3, 4)).astype("float32"),
-               rng.uniform(0.5, 2, (3, 4)).astype("float32")], grad=None)
+               rng.uniform(0.5, 2, (3, 4)).astype("float32")], grad=None, grad_skip="discontinuous")
 S("gcd", lambda x, y: paddle.gcd(x, y), np.gcd, _ints(lo=1, hi=30, n=2),
-  grad=None)
+  grad=None, grad_skip="integer")
 S("lcm", lambda x, y: paddle.lcm(x, y), np.lcm, _ints(lo=1, hi=12, n=2),
-  grad=None)
+  grad=None, grad_skip="integer")
 S("inner_product", lambda x, y: paddle.inner(x, y), np.inner, _std(n=2),
   grad=(0, 1))
 S("outer", lambda x, y: paddle.outer(x, y), np.outer,
@@ -216,40 +222,40 @@ S("dot", lambda x, y: paddle.dot(x, y),
 
 # comparisons / logical / bitwise
 S("equal", lambda x, y: paddle.equal(x, y), np.equal,
-  _ints(lo=0, hi=3, n=2), grad=None)
+  _ints(lo=0, hi=3, n=2), grad=None, grad_skip="boolean")
 S("not_equal", lambda x, y: paddle.not_equal(x, y), np.not_equal,
-  _ints(lo=0, hi=3, n=2), grad=None)
+  _ints(lo=0, hi=3, n=2), grad=None, grad_skip="boolean")
 S("less_than", lambda x, y: paddle.less_than(x, y), np.less, _std(n=2),
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("less_equal", lambda x, y: paddle.less_equal(x, y), np.less_equal,
-  _std(n=2), grad=None)
+  _std(n=2), grad=None, grad_skip="boolean")
 S("greater_than", lambda x, y: paddle.greater_than(x, y), np.greater,
-  _std(n=2), grad=None)
+  _std(n=2), grad=None, grad_skip="boolean")
 S("greater_equal", lambda x, y: paddle.greater_equal(x, y),
-  np.greater_equal, _std(n=2), grad=None)
+  np.greater_equal, _std(n=2), grad=None, grad_skip="boolean")
 S("logical_and", lambda x, y: paddle.logical_and(x, y), np.logical_and,
-  _bools(n=2), grad=None)
+  _bools(n=2), grad=None, grad_skip="boolean")
 S("logical_or", lambda x, y: paddle.logical_or(x, y), np.logical_or,
-  _bools(n=2), grad=None)
+  _bools(n=2), grad=None, grad_skip="boolean")
 S("logical_xor", lambda x, y: paddle.logical_xor(x, y), np.logical_xor,
-  _bools(n=2), grad=None)
+  _bools(n=2), grad=None, grad_skip="boolean")
 S("logical_not", lambda x: paddle.logical_not(x), np.logical_not,
-  _bools(), grad=None)
+  _bools(), grad=None, grad_skip="boolean")
 S("bitwise_and", lambda x, y: paddle.bitwise_and(x, y), np.bitwise_and,
-  _ints(n=2, dtype="int32"), grad=None)
+  _ints(n=2, dtype="int32"), grad=None, grad_skip="integer")
 S("bitwise_or", lambda x, y: paddle.bitwise_or(x, y), np.bitwise_or,
-  _ints(n=2, dtype="int32"), grad=None)
+  _ints(n=2, dtype="int32"), grad=None, grad_skip="integer")
 S("bitwise_xor", lambda x, y: paddle.bitwise_xor(x, y), np.bitwise_xor,
-  _ints(n=2, dtype="int32"), grad=None)
+  _ints(n=2, dtype="int32"), grad=None, grad_skip="integer")
 S("bitwise_not", lambda x: paddle.bitwise_not(x), np.invert,
-  _ints(dtype="int32"), grad=None)
+  _ints(dtype="int32"), grad=None, grad_skip="integer")
 S("isclose", lambda x, y: paddle.isclose(x, y), np.isclose, _std(n=2),
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("allclose", lambda x, y: paddle.allclose(x, y),
-  lambda x, y: np.asarray(np.allclose(x, y)), _std(n=2), grad=None)
+  lambda x, y: np.asarray(np.allclose(x, y)), _std(n=2), grad=None, grad_skip="boolean")
 S("equal_all", lambda x, y: paddle.equal_all(x, y),
   lambda x, y: np.asarray(np.array_equal(x, y)),
-  _ints(lo=0, hi=2, n=2), grad=None)
+  _ints(lo=0, hi=2, n=2), grad=None, grad_skip="boolean")
 
 # --------------------------------------------------------------------------
 # reductions
@@ -261,13 +267,13 @@ S("min", lambda x: paddle.min(x, axis=1), lambda x: x.min(1), _std())
 S("prod", lambda x: paddle.prod(x, axis=1), lambda x: x.prod(1),
   _pos())
 S("amax", lambda x: paddle.amax(x, axis=1), lambda x: x.max(1), _std(),
-  grad=None)
+  grad=(0,))
 S("amin", lambda x: paddle.amin(x, axis=1), lambda x: x.min(1), _std(),
-  grad=None)
+  grad=(0,))
 S("all", lambda x: paddle.all(x, axis=1), lambda x: x.all(1), _bools(),
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("any", lambda x: paddle.any(x, axis=1), lambda x: x.any(1), _bools(),
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("logsumexp", lambda x: paddle.logsumexp(x, axis=1),
   lambda x: np.log(np.exp(x).sum(1)), _std())
 S("std", lambda x: paddle.std(x, axis=1),
@@ -275,42 +281,42 @@ S("std", lambda x: paddle.std(x, axis=1),
 S("var", lambda x: paddle.var(x, axis=1),
   lambda x: x.var(1, ddof=1), _std())
 S("median", lambda x: paddle.median(x, axis=1),
-  lambda x: np.median(x, 1), _std(shape=(3, 5)), grad=None)
+  lambda x: np.median(x, 1), _std(shape=(3, 5)), grad=(0,))
 S("nanmean", lambda x: paddle.nanmean(x, axis=0),
   lambda x: np.nanmean(x, 0),
   lambda rng: [np.asarray([[1.0, np.nan], [2.0, 3.0]], np.float32)],
-  grad=None)
+  grad=(0,))
 S("nansum", lambda x: paddle.nansum(x, axis=0),
   lambda x: np.nansum(x, 0),
   lambda rng: [np.asarray([[1.0, np.nan], [2.0, 3.0]], np.float32)],
-  grad=None)
+  grad=(0,))
 S("count_nonzero", lambda x: paddle.count_nonzero(x, axis=1),
   lambda x: np.count_nonzero(x, 1),
   lambda rng: [np.asarray([[0.0, 1.0, 2.0], [0.0, 0.0, 3.0]],
-                          np.float32)], grad=None)
+                          np.float32)], grad=None, grad_skip="integer")
 S("cumsum", lambda x: paddle.cumsum(x, axis=1),
   lambda x: np.cumsum(x, 1), _std())
 S("cumprod", lambda x: paddle.cumprod(x, dim=1),
   lambda x: np.cumprod(x, 1), _pos())
 S("cummax", lambda x: paddle.cummax(x, axis=1)[0],
-  lambda x: np.maximum.accumulate(x, 1), _std(), grad=None)
+  lambda x: np.maximum.accumulate(x, 1), _std(), grad=(0,))
 S("cummax_idx", lambda x: paddle.cummax(x, axis=1)[1],
   lambda x: np.asarray([[int(np.argmax(r[:j + 1])) for j in range(len(r))]
-                        for r in x]), _std(), grad=None)
+                        for r in x]), _std(), grad=None, grad_skip="indices")
 S("cummin_idx", lambda x: paddle.cummin(x, axis=1)[1],
   lambda x: np.asarray([[int(np.argmin(r[:j + 1])) for j in range(len(r))]
-                        for r in x]), _std(), grad=None)
+                        for r in x]), _std(), grad=None, grad_skip="indices")
 S("cummin", lambda x: paddle.cummin(x, axis=1)[0],
-  lambda x: np.minimum.accumulate(x, 1), _std(), grad=None)
+  lambda x: np.minimum.accumulate(x, 1), _std(), grad=(0,))
 S("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=1),
   lambda x: np.log(np.cumsum(np.exp(x), 1)), _std())
 S("quantile", lambda x: paddle.quantile(x, 0.5, axis=1),
-  lambda x: np.quantile(x, 0.5, axis=1), _std(shape=(3, 5)), grad=None)
+  lambda x: np.quantile(x, 0.5, axis=1), _std(shape=(3, 5)), grad=(0,))
 S("kthvalue", lambda x: paddle.kthvalue(x, 2, axis=1)[0],
-  lambda x: np.sort(x, 1)[:, 1], _std(shape=(3, 5)), grad=None)
+  lambda x: np.sort(x, 1)[:, 1], _std(shape=(3, 5)), grad=(0,))
 S("mode", lambda x: paddle.mode(x, axis=1)[0],
   lambda x: np.asarray([np.bincount(r).argmax() for r in x]),
-  _ints(shape=(3, 6), lo=0, hi=3), grad=None)
+  _ints(shape=(3, 6), lo=0, hi=3), grad=None, grad_skip="integer")
 S("trace_op", lambda x: paddle.trace(x), lambda x: np.asarray(np.trace(x)),
   _std(shape=(4, 4)))
 S("diagonal", lambda x: paddle.diagonal(x),
@@ -386,7 +392,8 @@ S("gather_nd", lambda x, i: paddle.gather_nd(x, i),
 S("masked_select", lambda x, m: paddle.masked_select(x, m),
   lambda x, m: x[m],
   lambda rng: [np.arange(12, dtype=np.float32).reshape(3, 4),
-               (np.arange(12).reshape(3, 4) % 2 == 0)], grad=None)
+               (np.arange(12).reshape(3, 4) % 2 == 0)], grad=None,
+  grad_skip="dynshape")
 S("where", lambda c, x, y: paddle.where(c, x, y), np.where,
   lambda rng: [(rng.uniform(size=(3, 4)) > 0.5),
                rng.standard_normal((3, 4)).astype("float32"),
@@ -398,24 +405,24 @@ S("repeat_interleave",
 S("meshgrid", lambda x, y: paddle.meshgrid(x, y),
   lambda x, y: np.meshgrid(x, y, indexing="ij"),
   lambda rng: [rng.standard_normal(3).astype("float32"),
-               rng.standard_normal(4).astype("float32")], grad=None)
+               rng.standard_normal(4).astype("float32")], grad=(0, 1))
 S("one_hot", lambda x: F.one_hot(x, 5),
   lambda x: np.eye(5, dtype=np.float32)[x],
-  _ints(shape=(4,), lo=0, hi=5), grad=None)
+  _ints(shape=(4,), lo=0, hi=5), grad=None, grad_skip="integer")
 S("as_strided_t", lambda x: paddle.t(x), lambda x: x.T, _std())
 S("moveaxis", lambda x: paddle.moveaxis(x, 0, 1),
   lambda x: np.moveaxis(x, 0, 1), _std())
 S("swapaxes", lambda x: paddle.transpose(x, [1, 0]),
   lambda x: np.swapaxes(x, 0, 1), _std())
 S("dstack", lambda x, y: paddle.dstack([x, y]),
-  lambda x, y: np.dstack([x, y]), _std(n=2), grad=None)
+  lambda x, y: np.dstack([x, y]), _std(n=2), grad=(0, 1))
 S("hstack", lambda x, y: paddle.hstack([x, y]),
-  lambda x, y: np.hstack([x, y]), _std(n=2), grad=None)
+  lambda x, y: np.hstack([x, y]), _std(n=2), grad=(0, 1))
 S("vstack", lambda x, y: paddle.vstack([x, y]),
-  lambda x, y: np.vstack([x, y]), _std(n=2), grad=None)
+  lambda x, y: np.vstack([x, y]), _std(n=2), grad=(0, 1))
 S("atleast_2d", lambda x: paddle.atleast_2d(x),
   lambda x: np.atleast_2d(x),
-  lambda rng: [rng.standard_normal(4).astype("float32")], grad=None)
+  lambda rng: [rng.standard_normal(4).astype("float32")], grad=(0,))
 S("crop", lambda x: paddle.crop(x, shape=[2, 2], offsets=[1, 1]),
   lambda x: x[1:3, 1:3], _std(shape=(4, 4)))
 
@@ -423,37 +430,37 @@ S("crop", lambda x: paddle.crop(x, shape=[2, 2], offsets=[1, 1]),
 # creation (output-only: compare values; no grads)
 # --------------------------------------------------------------------------
 S("zeros_like", lambda x: paddle.zeros_like(x), np.zeros_like, _std(),
-  grad=None)
+  grad=None, grad_skip="zerograd")
 S("ones_like", lambda x: paddle.ones_like(x), np.ones_like, _std(),
-  grad=None)
+  grad=None, grad_skip="zerograd")
 S("full_like", lambda x: paddle.full_like(x, 2.5),
-  lambda x: np.full_like(x, 2.5), _std(), grad=None)
+  lambda x: np.full_like(x, 2.5), _std(), grad=None, grad_skip="zerograd")
 S("arange", lambda x: paddle.arange(0, 10, 2, dtype="float32") + 0 * x,
   lambda x: np.arange(0, 10, 2, dtype=np.float32) + 0 * x,
-  lambda rng: [np.zeros(5, np.float32)], grad=None)
+  lambda rng: [np.zeros(5, np.float32)], grad=None, grad_skip="constant")
 S("linspace", lambda x: paddle.linspace(0, 1, 5) + 0 * x,
   lambda x: np.linspace(0, 1, 5, dtype=np.float32) + 0 * x,
-  lambda rng: [np.zeros(5, np.float32)], grad=None)
+  lambda rng: [np.zeros(5, np.float32)], grad=None, grad_skip="constant")
 S("logspace", lambda x: paddle.logspace(0, 2, 5) + 0 * x,
   lambda x: np.logspace(0, 2, 5, dtype=np.float32) + 0 * x,
-  lambda rng: [np.zeros(5, np.float32)], grad=None,
+  lambda rng: [np.zeros(5, np.float32)], grad=None, grad_skip="constant",
   tols={"float32": dict(rtol=1e-4, atol=1e-4)})
 S("eye", lambda x: paddle.eye(4) + 0 * x,
   lambda x: np.eye(4, dtype=np.float32) + 0 * x,
-  lambda rng: [np.zeros((4, 4), np.float32)], grad=None)
+  lambda rng: [np.zeros((4, 4), np.float32)], grad=None, grad_skip="constant")
 S("diag_embed", lambda x: paddle.diag_embed(x),
   lambda x: np.stack([np.diag(r) for r in x]), _std(shape=(3, 4)),
-  grad=None)
+  grad=(0,))
 
 # --------------------------------------------------------------------------
 # search / sort
 # --------------------------------------------------------------------------
 S("argmax", lambda x: paddle.argmax(x, axis=1),
-  lambda x: x.argmax(1), _std(), grad=None)
+  lambda x: x.argmax(1), _std(), grad=None, grad_skip="indices")
 S("argmin", lambda x: paddle.argmin(x, axis=1),
-  lambda x: x.argmin(1), _std(), grad=None)
+  lambda x: x.argmin(1), _std(), grad=None, grad_skip="indices")
 S("argsort", lambda x: paddle.argsort(x, axis=1),
-  lambda x: np.argsort(x, 1, kind="stable"), _std(), grad=None)
+  lambda x: np.argsort(x, 1, kind="stable"), _std(), grad=None, grad_skip="indices")
 S("sort", lambda x: paddle.sort(x, axis=1),
   lambda x: np.sort(x, 1), _std())
 S("topk", lambda x: paddle.topk(x, 3, axis=1)[0],
@@ -462,25 +469,25 @@ S("searchsorted", lambda s, v: paddle.searchsorted(s, v),
   lambda s, v: np.stack([np.searchsorted(s[i], v[i])
                          for i in range(s.shape[0])]),
   lambda rng: [np.sort(rng.standard_normal((2, 6)).astype("float32"), 1),
-               rng.standard_normal((2, 3)).astype("float32")], grad=None)
+               rng.standard_normal((2, 3)).astype("float32")], grad=None, grad_skip="indices")
 S("bucketize", lambda x, e: paddle.bucketize(x, e),
   lambda x, e: np.searchsorted(e, x),
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
-               np.asarray([-1.0, 0.0, 1.0], np.float32)], grad=None)
+               np.asarray([-1.0, 0.0, 1.0], np.float32)], grad=None, grad_skip="indices")
 S("nonzero", lambda x: paddle.nonzero(x),
   lambda x: np.stack(np.nonzero(x), 1),
   lambda rng: [np.asarray([[0.0, 1.0], [2.0, 0.0]], np.float32)],
-  grad=None)
+  grad=None, grad_skip="indices")
 S("unique", lambda x: paddle.unique(x),
-  lambda x: np.unique(x), _ints(shape=(8,), lo=0, hi=4), grad=None)
+  lambda x: np.unique(x), _ints(shape=(8,), lo=0, hi=4), grad=None, grad_skip="indices")
 S("unique_consecutive", lambda x: paddle.unique_consecutive(x),
   lambda x: np.asarray([k for k, g in __import__("itertools")
                         .groupby(x.tolist())]),
-  lambda rng: [np.asarray([1, 1, 2, 2, 3, 1, 1], np.int64)], grad=None)
+  lambda rng: [np.asarray([1, 1, 2, 2, 3, 1, 1], np.int64)], grad=None, grad_skip="indices")
 S("index_sample", lambda x, i: paddle.index_sample(x, i),
   lambda x, i: np.take_along_axis(x, i, 1),
   lambda rng: [rng.standard_normal((3, 5)).astype("float32"),
-               rng.integers(0, 5, (3, 2)).astype("int64")], grad=None)
+               rng.integers(0, 5, (3, 2)).astype("int64")], grad=(0,))
 
 # --------------------------------------------------------------------------
 # linalg
@@ -513,7 +520,7 @@ S("inv", lambda x: paddle.linalg.inv(x),
 S("pinv", lambda x: paddle.linalg.pinv(x),
   lambda x: np.linalg.pinv(x),
   lambda rng: [rng.standard_normal((4, 3)).astype("float32")],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=(0,),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("det", lambda x: paddle.linalg.det(x),
   lambda x: np.asarray(np.linalg.det(x)),
@@ -523,13 +530,13 @@ S("slogdet", lambda x: paddle.linalg.slogdet(x),
   lambda x: [np.asarray(v) for v in np.linalg.slogdet(x)],
   lambda rng: [(rng.standard_normal((3, 3))
                 + 3 * np.eye(3)).astype("float32")], dtypes=("float32",),
-  grad=None)
+  grad=(0,))
 S("solve", lambda a, b: paddle.linalg.solve(a, b),
   lambda a, b: np.linalg.solve(a, b),
   lambda rng: [(rng.standard_normal((3, 3))
                 + 3 * np.eye(3)).astype("float32"),
                rng.standard_normal((3, 2)).astype("float32")],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=(0, 1),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("triangular_solve",
   lambda a, b: paddle.linalg.triangular_solve(a, b, upper=False),
@@ -537,7 +544,7 @@ S("triangular_solve",
   lambda rng: [(np.tril(rng.standard_normal((3, 3)))
                 + 2 * np.eye(3)).astype("float32"),
                rng.standard_normal((3, 2)).astype("float32")],
-  dtypes=("float32",), grad=None)
+  dtypes=("float32",), grad=(0, 1))
 S("matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
   lambda x: np.linalg.matrix_power(x, 3),
   _std(shape=(3, 3), scale=0.5), dtypes=("float32",),
@@ -545,27 +552,27 @@ S("matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
 S("matrix_rank", lambda x: paddle.linalg.matrix_rank(x),
   lambda x: np.asarray(np.linalg.matrix_rank(x)),
   lambda rng: [rng.standard_normal((4, 3)).astype("float32")],
-  dtypes=("float32",), grad=None)
+  dtypes=("float32",), grad=None, grad_skip="integer")
 S("qr_r", lambda x: paddle.abs(paddle.linalg.qr(x)[1]),
   lambda x: np.abs(np.linalg.qr(x)[1]),
   lambda rng: [rng.standard_normal((4, 3)).astype("float32")],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=None, grad_skip="unstable",
   tols={"float32": dict(rtol=1e-4, atol=1e-4)})
 S("svdvals", lambda x: paddle.linalg.svd(x)[1],
   lambda x: np.linalg.svd(x)[1],
   lambda rng: [rng.standard_normal((4, 3)).astype("float32")],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=(0,),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("eigvalsh", lambda x: paddle.linalg.eigvalsh(x),
   lambda x: np.linalg.eigvalsh(x),
   lambda rng: [(lambda a: ((a + a.T) / 2).astype("float32"))(
-      rng.standard_normal((3, 3)))], dtypes=("float32",), grad=None,
+      rng.standard_normal((3, 3)))], dtypes=("float32",), grad=None, grad_skip="unstable",
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("lstsq", lambda a, b: paddle.linalg.lstsq(a, b)[0],
   lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
   lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
                rng.standard_normal((5, 2)).astype("float32")],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=None, grad_skip="unstable",
   tols={"float32": dict(rtol=1e-3, atol=1e-4)})
 S("multi_dot", lambda x, y, z: paddle.linalg.multi_dot([x, y, z]),
   lambda x, y, z: x @ y @ z,
@@ -575,10 +582,10 @@ S("multi_dot", lambda x, y, z: paddle.linalg.multi_dot([x, y, z]),
   grad=(0, 1, 2))
 S("histogram", lambda x: paddle.histogram(x, bins=4, min=-2.0, max=2.0),
   lambda x: np.histogram(x, bins=4, range=(-2, 2))[0],
-  _std(), grad=None)
+  _std(), grad=None, grad_skip="counting")
 S("bincount", lambda x: paddle.bincount(x, minlength=5),
   lambda x: np.bincount(x, minlength=5),
-  _ints(shape=(10,), lo=0, hi=5), grad=None)
+  _ints(shape=(10,), lo=0, hi=5), grad=None, grad_skip="counting")
 
 # --------------------------------------------------------------------------
 # activations & nn.functional
@@ -709,10 +716,10 @@ S("pdist", lambda x: paddle.pdist(x),
   lambda x: np.asarray([np.linalg.norm(x[i] - x[j])
                         for i in range(len(x))
                         for j in range(i + 1, len(x))]),
-  _std(shape=(4, 3)), dtypes=("float32",), grad=None)
+  _std(shape=(4, 3)), dtypes=("float32",), grad=(0,))
 S("cdist", lambda x, y: paddle.cdist(x, y),
   lambda x, y: np.linalg.norm(x[:, None] - y[None], axis=-1),
-  _std(shape=(3, 4), n=2), grad=None,
+  _std(shape=(3, 4), n=2), grad=(0, 1),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 
 # norm / pooling / conv
@@ -722,7 +729,7 @@ S("layer_norm",
   / np.sqrt(x.var(-1, keepdims=True) + 1e-5), _std())
 S("rms_norm_f", lambda x: F.rms_norm(x),
   lambda x: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6),
-  _std(), grad=None)
+  _std(), grad=(0,))
 S("normalize_l2", lambda x: F.normalize(x, axis=-1),
   lambda x: x / np.maximum(np.linalg.norm(x, axis=-1, keepdims=True),
                            1e-12), _std())
@@ -766,7 +773,7 @@ S("temporal_shift", lambda x: F.temporal_shift(x, 2, 0.25),
       np.concatenate([x.reshape(1, 2, 4, 2, 2)[:, 1:, 1:2],
                       np.zeros((1, 1, 1, 2, 2), np.float32)], 1),
       x.reshape(1, 2, 4, 2, 2)[:, :, 2:]], 2).reshape(2, 4, 2, 2),
-  _std(shape=(2, 4, 2, 2)), grad=None)
+  _std(shape=(2, 4, 2, 2)), grad=(0,))
 
 
 
@@ -781,14 +788,14 @@ S("put_along_axis",
   lambda rng: [rng.standard_normal((3, 5)).astype("float32"),
                rng.integers(0, 5, (3, 2)).astype("int64"),
                rng.standard_normal((3, 2)).astype("float32")],
-  grad=None)
+  grad=(0,))
 S("scatter_overwrite",
   lambda x, i, u: paddle.scatter(x, i, u),
   lambda x, i, u: (lambda y: (y.__setitem__(i, u), y)[1])(x.copy()),
   lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
                np.asarray([0, 2, 4], np.int64),
                rng.standard_normal((3, 3)).astype("float32")],
-  grad=None)
+  grad=(0, 2))
 S("scatter_nd_add",
   lambda x, i, u: paddle.scatter_nd_add(x, i, u),
   lambda x, i, u: (lambda y: (np.add.at(y, tuple(i.T), u), y)[1])(
@@ -796,40 +803,40 @@ S("scatter_nd_add",
   lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
                rng.integers(0, 5, (4, 1)).astype("int64"),
                rng.standard_normal((4, 3)).astype("float32")],
-  grad=None)
+  grad=(0, 2))
 S("index_add",
   lambda x, i, v: paddle.index_add(x, i, 0, v),
   lambda x, i, v: (lambda y: (np.add.at(y, i, v), y)[1])(x.copy()),
   lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
                np.asarray([0, 2, 2], np.int64),
                rng.standard_normal((3, 3)).astype("float32")],
-  grad=None)
+  grad=(0, 2))
 S("masked_fill",
   lambda x, m: paddle.masked_fill(x, m, 7.5),
   lambda x, m: np.where(m, 7.5, x), 
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
-               rng.uniform(size=(3, 4)) > 0.5], grad=None)
+               rng.uniform(size=(3, 4)) > 0.5], grad=(0,))
 S("masked_scatter",
   lambda x, m, v: paddle.masked_scatter(x, m, v),
   lambda x, m, v: (lambda y: (y.__setitem__(m, v[:m.sum()]), y)[1])(
       x.copy()),
   lambda rng: [np.zeros((3, 4), np.float32),
                np.tile(np.asarray([True, False, True, False]), (3, 1)),
-               np.arange(12, dtype=np.float32)], grad=None)
+               np.arange(12, dtype=np.float32)], grad=(0, 2))
 S("index_fill",
   lambda x, i: paddle.index_fill(x, i, 0, -1.0),
   lambda x, i: (lambda y: (y.__setitem__(i, -1.0), y)[1])(x.copy()),
   lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
-               np.asarray([1, 3], np.int64)], grad=None)
+               np.asarray([1, 3], np.int64)], grad=(0,))
 S("take", lambda x, i: paddle.take(x, i),
   lambda x, i: x.reshape(-1)[i],
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
-               rng.integers(0, 12, (5,)).astype("int64")], grad=None)
+               rng.integers(0, 12, (5,)).astype("int64")], grad=(0,))
 S("renorm", lambda x: paddle.renorm(x, 2.0, 0, 1.0),
   lambda x: x * np.minimum(
       1.0, 1.0 / np.maximum(
           np.sqrt((x ** 2).sum(axis=(1,), keepdims=True)), 1e-7)),
-  _std(shape=(3, 4)), grad=None,
+  _std(shape=(3, 4)), grad=(0,),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("diff", lambda x: paddle.diff(x, axis=1),
   lambda x: np.diff(x, axis=1), _std())
@@ -842,12 +849,12 @@ S("cumulative_trapezoid",
       (y[:, 1:] + y[:, :-1]) / 2.0, axis=1)), _std())
 S("vander", lambda x: paddle.vander(x, 4),
   lambda x: np.vander(x, 4, increasing=False),
-  lambda rng: [rng.standard_normal(5).astype("float32")], grad=None)
+  lambda rng: [rng.standard_normal(5).astype("float32")], grad=(0,))
 S("unflatten", lambda x: paddle.unflatten(x, 1, [2, 2]),
   lambda x: x.reshape(3, 2, 2), _std(shape=(3, 4)))
 S("as_complex_real_roundtrip",
   lambda x: paddle.as_real(paddle.as_complex(x)),
-  lambda x: x, _std(shape=(3, 4, 2)), grad=None)
+  lambda x: x, _std(shape=(3, 4, 2)), grad=None, grad_skip="complex")
 S("cholesky_solve",
   lambda b, l: paddle.cholesky_solve(b, l, upper=False),
   lambda b, l: np.linalg.solve(l @ l.T, b),
@@ -855,39 +862,39 @@ S("cholesky_solve",
                (lambda a: np.linalg.cholesky(
                    a @ a.T + 3 * np.eye(3)).astype("float32"))(
                    rng.standard_normal((3, 3)))],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=(0, 1),
   tols={"float32": dict(rtol=1e-4, atol=1e-4)})
 S("cov", lambda x: paddle.cov(x),
   lambda x: np.cov(x), _std(shape=(3, 6)), dtypes=("float32",),
-  grad=None, tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+  grad=(0,), tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("corrcoef", lambda x: paddle.corrcoef(x),
   lambda x: np.corrcoef(x), _std(shape=(3, 6)), dtypes=("float32",),
-  grad=None, tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+  grad=(0,), tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("nanmedian", lambda x: paddle.nanmedian(x, axis=1),
   lambda x: np.nanmedian(x, 1),
   lambda rng: [np.asarray([[1.0, np.nan, 3.0, 2.0],
                            [5.0, 4.0, np.nan, np.nan]], np.float32)],
-  grad=None)
+  grad=None, grad_skip="nangrad")
 S("frexp", lambda x: paddle.frexp(x),
-  lambda x: list(np.frexp(x)), _pos(), grad=None)
+  lambda x: list(np.frexp(x)), _pos(), grad=None, grad_skip="nogradrule")
 S("signbit", lambda x: paddle.signbit(x), np.signbit, _std(),
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("isneginf", lambda x: paddle.isneginf(x), np.isneginf,
   lambda rng: [np.asarray([[1.0, -np.inf, np.inf]], np.float32)],
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("isposinf", lambda x: paddle.isposinf(x), np.isposinf,
   lambda rng: [np.asarray([[1.0, -np.inf, np.inf]], np.float32)],
-  grad=None)
+  grad=None, grad_skip="boolean")
 S("lerp", lambda x, y: paddle.lerp(x, y, 0.3),
   lambda x, y: x + 0.3 * (y - x), _std(n=2), grad=(0, 1))
 S("bitwise_left_shift",
   lambda x, y: paddle.bitwise_left_shift(x, y), np.left_shift,
   lambda rng: [rng.integers(0, 8, (3, 4)).astype("int32"),
-               rng.integers(0, 4, (3, 4)).astype("int32")], grad=None)
+               rng.integers(0, 4, (3, 4)).astype("int32")], grad=None, grad_skip="integer")
 S("bitwise_right_shift",
   lambda x, y: paddle.bitwise_right_shift(x, y), np.right_shift,
   lambda rng: [rng.integers(0, 64, (3, 4)).astype("int32"),
-               rng.integers(0, 4, (3, 4)).astype("int32")], grad=None)
+               rng.integers(0, 4, (3, 4)).astype("int32")], grad=None, grad_skip="integer")
 S("tensordot", lambda x, y: paddle.tensordot(x, y, axes=1),
   lambda x, y: np.tensordot(x, y, axes=1),
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
@@ -895,33 +902,33 @@ S("tensordot", lambda x, y: paddle.tensordot(x, y, axes=1),
   grad=(0, 1))
 S("block_diag", lambda x, y: paddle.block_diag([x, y]),
   lambda x, y: __import__("scipy.linalg", fromlist=["block_diag"])
-  .block_diag(x, y), _std(shape=(2, 3), n=2), grad=None)
+  .block_diag(x, y), _std(shape=(2, 3), n=2), grad=(0, 1))
 S("column_stack", lambda x, y: paddle.column_stack([x, y]),
-  lambda x, y: np.column_stack([x, y]), _std(n=2), grad=None)
+  lambda x, y: np.column_stack([x, y]), _std(n=2), grad=(0, 1))
 S("row_stack", lambda x, y: paddle.row_stack([x, y]),
-  lambda x, y: np.vstack([x, y]), _std(n=2), grad=None)
+  lambda x, y: np.vstack([x, y]), _std(n=2), grad=(0, 1))
 S("tensor_split", lambda x: paddle.tensor_split(x, 3, axis=1),
   lambda x: np.array_split(x, 3, axis=1), _std(shape=(2, 7)),
-  grad=None)
+  grad=(0,))
 S("hsplit", lambda x: paddle.hsplit(x, 2),
-  lambda x: np.hsplit(x, 2), _std(shape=(2, 6)), grad=None)
+  lambda x: np.hsplit(x, 2), _std(shape=(2, 6)), grad=(0,))
 S("vsplit", lambda x: paddle.vsplit(x, 2),
-  lambda x: np.vsplit(x, 2), _std(shape=(4, 3)), grad=None)
+  lambda x: np.vsplit(x, 2), _std(shape=(4, 3)), grad=(0,))
 S("gammainc", lambda x, y: paddle.gammainc(x, y),
   lambda x, y: sps.gammainc(x, y),
   lambda rng: [rng.uniform(0.5, 3, (3, 4)).astype("float32"),
                rng.uniform(0.5, 3, (3, 4)).astype("float32")],
-  grad=None)
+  grad=None, grad_skip="nogradrule")
 S("gammaincc", lambda x, y: paddle.gammaincc(x, y),
   lambda x, y: sps.gammaincc(x, y),
   lambda rng: [rng.uniform(0.5, 3, (3, 4)).astype("float32"),
                rng.uniform(0.5, 3, (3, 4)).astype("float32")],
-  grad=None)
+  grad=None, grad_skip="nogradrule")
 S("cartesian_prod", lambda x, y: paddle.cartesian_prod([x, y]),
   lambda x, y: np.stack(np.meshgrid(x, y, indexing="ij"),
                         -1).reshape(-1, 2),
   lambda rng: [rng.standard_normal(3).astype("float32"),
-               rng.standard_normal(2).astype("float32")], grad=None)
+               rng.standard_normal(2).astype("float32")], grad=(0, 1))
 S("margin_ranking_loss",
   lambda a, b, y: F.margin_ranking_loss(a, b, y),
   lambda a, b, y: np.asarray(np.maximum(0, -y * (a - b)).mean()),
@@ -947,7 +954,7 @@ S("log_loss", lambda x, y: F.log_loss(x, y),
 S("label_smooth", lambda x: F.label_smooth(x, epsilon=0.1),
   lambda x: x * 0.9 + 0.1 / x.shape[-1],
   lambda rng: [np.eye(4, dtype=np.float32)[
-      rng.integers(0, 4, (3,))]], grad=None)
+      rng.integers(0, 4, (3,))]], grad=(0,))
 S("poisson_nll_loss",
   lambda x, y: F.poisson_nll_loss(x, y, log_input=True, full=False),
   lambda x, y: np.asarray((np.exp(x) - y * x).mean()),
@@ -962,7 +969,7 @@ S("gaussian_nll_loss",
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
                rng.standard_normal((3, 4)).astype("float32"),
                rng.uniform(0.5, 2.0, (3, 4)).astype("float32")],
-  grad=None)
+  grad=(0, 1, 2))
 S("multi_label_soft_margin",
   lambda x, y: F.multi_label_soft_margin_loss(x, y),
   lambda x, y: np.asarray(
@@ -981,7 +988,7 @@ S("npair_loss",
           for i in range(len(l))])),
   lambda rng: [rng.standard_normal((3, 4)).astype("float32") * 0.3,
                rng.standard_normal((3, 4)).astype("float32") * 0.3,
-               np.arange(3).astype("int64")], grad=None,
+               np.arange(3).astype("int64")], grad=(0, 1),
   tols={"float32": dict(rtol=1e-3, atol=1e-4)})
 S("local_response_norm",
   lambda x: F.local_response_norm(x, size=3, alpha=1e-4, beta=0.75,
@@ -989,11 +996,11 @@ S("local_response_norm",
   lambda x: x / (1.0 + (1e-4 / 3) * np.stack([
       (x ** 2)[:, max(0, c - 1):c + 2].sum(1)
       for c in range(x.shape[1])], 1)) ** 0.75,
-  _std(shape=(2, 4, 3, 3)), grad=None,
+  _std(shape=(2, 4, 3, 3)), grad=(0,),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("zeropad2d", lambda x: F.zeropad2d(x, [1, 2, 0, 1]),
   lambda x: np.pad(x, ((0, 0), (0, 0), (0, 1), (1, 2))),
-  _std(shape=(1, 2, 3, 3)), grad=None)
+  _std(shape=(1, 2, 3, 3)), grad=(0,))
 S("alpha_dropout_eval",
   lambda x: F.alpha_dropout(x, 0.5, training=False),
   lambda x: x, _std())
@@ -1007,15 +1014,15 @@ S("cholesky_inverse",
   lambda rng: [(lambda a: np.linalg.cholesky(
       a @ a.T + 3 * np.eye(3)).astype("float32"))(
       rng.standard_normal((3, 3)))],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=(0,),
   tols={"float32": dict(rtol=1e-4, atol=1e-4)})
 S("matrix_norm_fro",
   lambda x: paddle.linalg.matrix_norm(x),
-  lambda x: np.asarray(np.linalg.norm(x)), _std(), grad=None)
+  lambda x: np.asarray(np.linalg.norm(x)), _std(), grad=(0,))
 S("vector_norm_l3",
   lambda x: paddle.linalg.vector_norm(x, p=3.0),
   lambda x: np.asarray((np.abs(x) ** 3).sum() ** (1 / 3)), _std(),
-  grad=None, tols={"float32": dict(rtol=1e-4, atol=1e-5)})
+  grad=(0,), tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("svd_lowrank_reconstruct",
   lambda x: (lambda u, s, v: paddle.matmul(
       u * s.unsqueeze(-2), v, transpose_y=True))(
@@ -1023,7 +1030,7 @@ S("svd_lowrank_reconstruct",
   lambda x: x,
   lambda rng: [(rng.standard_normal((6, 2))
                 @ rng.standard_normal((2, 4))).astype("float32")],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=None, grad_skip="unstable",
   tols={"float32": dict(rtol=1e-3, atol=1e-4)})
 S("pca_lowrank_linalg",
   lambda x: (lambda u, s, v: paddle.matmul(
@@ -1032,7 +1039,7 @@ S("pca_lowrank_linalg",
   lambda x: x,
   lambda rng: [(rng.standard_normal((6, 3))
                 @ rng.standard_normal((3, 4))).astype("float32")],
-  dtypes=("float32",), grad=None,
+  dtypes=("float32",), grad=None, grad_skip="unstable",
   tols={"float32": dict(rtol=1e-3, atol=1e-4)})
 
 
@@ -1063,7 +1070,7 @@ S("floor_mod", lambda x, y: paddle.floor_mod(x, y),
   lambda x, y: np.mod(x, y),
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
                rng.uniform(0.5, 2.0, (3, 4)).astype("float32")],
-  grad=None)
+  grad=None, grad_skip="discontinuous")
 S("reverse", lambda x: paddle.reverse(x, axis=[0]),
   lambda x: x[::-1].copy(), _std())
 S("expand_as", lambda x, y: paddle.expand_as(x, y),
@@ -1078,19 +1085,19 @@ S("atleast_3d", lambda x: paddle.atleast_3d(x), np.atleast_3d,
 S("dsplit_0", lambda x: paddle.dsplit(x, 2)[0],
   lambda x: np.dsplit(x, 2)[0], _std((2, 3, 4)))
 S("as_complex", lambda x: paddle.as_real(paddle.as_complex(x)),
-  lambda x: x, _std((3, 4, 2)), grad=None, dtypes=("float32",))
+  lambda x: x, _std((3, 4, 2)), grad=None, grad_skip="complex", dtypes=("float32",))
 S("complex", lambda re, im: paddle.as_real(paddle.complex(re, im)),
-  lambda re, im: np.stack([re, im], -1), _std(n=2), grad=None,
+  lambda re, im: np.stack([re, im], -1), _std(n=2), grad=None, grad_skip="complex",
   dtypes=("float32",))
 S("polar", lambda r, t: paddle.as_real(paddle.polar(r, t)),
   lambda r, t: np.stack([r * np.cos(t), r * np.sin(t)], -1),
   lambda rng: [rng.uniform(0.2, 2.0, (3, 4)).astype("float32"),
                rng.uniform(-3.0, 3.0, (3, 4)).astype("float32")],
-  grad=None, dtypes=("float32",))
+  grad=None, grad_skip="complex", dtypes=("float32",))
 S("isreal", lambda x: paddle.isreal(x),
-  lambda x: np.isreal(x), _std(), grad=None)
+  lambda x: np.isreal(x), _std(), grad=None, grad_skip="boolean")
 S("isin", lambda x, t: paddle.isin(x, t),
-  np.isin, _ints(n=2), grad=None, dtypes=("int64",))
+  np.isin, _ints(n=2), grad=None, grad_skip="boolean", dtypes=("int64",))
 S("pad_constant", lambda x: paddle.nn.functional.pad(
       x, [1, 2], mode="constant", value=0.5),
   lambda x: np.pad(x, [(0, 0), (1, 2)], constant_values=0.5),
@@ -1099,21 +1106,21 @@ S("norm_fro", lambda x: paddle.linalg.norm(x),
   lambda x: np.linalg.norm(x), _std(), grad=(0,),
   tols={"float32": dict(rtol=2e-5, atol=2e-6)})
 S("vector_norm_1", lambda x: paddle.linalg.vector_norm(x, p=1),
-  lambda x: np.abs(x).sum(), _std(), grad=None)
+  lambda x: np.abs(x).sum(), _std(), grad=(0,))
 S("matrix_norm_nuc",
   lambda x: paddle.linalg.matrix_norm(x, p="nuc"),
-  lambda x: np.linalg.norm(x, "nuc"), _std((4, 4)), grad=None,
+  lambda x: np.linalg.norm(x, "nuc"), _std((4, 4)), grad=(0,),
   dtypes=("float32",), tols={"float32": dict(rtol=1e-4, atol=1e-4)})
 S("matrix_exp", lambda x: paddle.linalg.matrix_exp(0.3 * x),
   lambda x: spl.expm(0.3 * np.asarray(x, np.float64)).astype(
       np.float32),
-  _std((4, 4)), grad=None, dtypes=("float32",),
+  _std((4, 4)), grad=(0,), dtypes=("float32",),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("qr_recompose",
   lambda x: paddle.matmul(*paddle.linalg.qr(x)),
   lambda x: x,
   lambda rng: [rng.standard_normal((5, 3)).astype("float32")],
-  grad=None, dtypes=("float32",),
+  grad=None, grad_skip="unstable", dtypes=("float32",),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 S("svd_recompose",
   # svd returns (U, S, VH) — reference tensor/linalg.py:2785
@@ -1122,26 +1129,26 @@ S("svd_recompose",
           *paddle.linalg.svd(x, full_matrices=False)),
   lambda x: x,
   lambda rng: [rng.standard_normal((4, 3)).astype("float32")],
-  grad=None, dtypes=("float32",),
+  grad=None, grad_skip="unstable", dtypes=("float32",),
   tols={"float32": dict(rtol=1e-4, atol=1e-4)})
 S("eigh_vals",
   lambda x: paddle.linalg.eigh(
       paddle.add(x, paddle.t(x)))[0],
   lambda x: np.linalg.eigvalsh(x + x.T),
-  _std((4, 4)), grad=None, dtypes=("float32",),
+  _std((4, 4)), grad=None, grad_skip="unstable", dtypes=("float32",),
   tols={"float32": dict(rtol=1e-4, atol=1e-4)})
 S("eigvals_sorted",
   lambda x: paddle.sort(paddle.abs(paddle.linalg.eigvals(
       paddle.add(x, paddle.t(x))))),
   lambda x: np.sort(np.abs(np.linalg.eigvals(
       (x + x.T).astype(np.complex64)))),
-  _std((4, 4)), grad=None, dtypes=("float32",),
+  _std((4, 4)), grad=None, grad_skip="unstable", dtypes=("float32",),
   tols={"float32": dict(rtol=1e-3, atol=1e-3)})
 S("lu_recompose",
   lambda x: (lambda lu_, piv: (lambda p, l, u: paddle.matmul(
       paddle.matmul(p, l), u))(*paddle.linalg.lu_unpack(lu_, piv)))(
           *paddle.linalg.lu(x)[:2]),
-  lambda x: x, _std((4, 4)), grad=None, dtypes=("float32",),
+  lambda x: x, _std((4, 4)), grad=None, grad_skip="unstable", dtypes=("float32",),
   tols={"float32": dict(rtol=1e-4, atol=1e-5)})
 def _np_householder_product(a, tau):
     # H_i = I - tau_i v_i v_i^T with v_i = [0...0, 1, a[i+1:, i]]
@@ -1162,7 +1169,7 @@ S("householder_product",
   lambda rng: [np.tril(rng.standard_normal((5, 3)), -1).astype(
       "float32") + np.eye(5, 3, dtype=np.float32),
       rng.uniform(0.1, 0.5, (3,)).astype("float32")],
-  grad=None, dtypes=("float32",),
+  grad=None, grad_skip="unstable", dtypes=("float32",),
   tols={"float32": dict(rtol=1e-3, atol=1e-3)})
 S("scatter_overwrite",
   lambda x, idx, upd: paddle.scatter(x, idx, upd),
@@ -1171,14 +1178,14 @@ S("scatter_overwrite",
   lambda rng: [rng.standard_normal((5, 3)).astype("float32"),
                np.array([0, 2, 4], np.int64),
                rng.standard_normal((3, 3)).astype("float32")],
-  grad=None)
+  grad=(0, 2))
 S("scatter_nd_sum",
   lambda idx, upd: paddle.scatter_nd(idx, upd, [6]),
   lambda idx, upd: (lambda y: (np.add.at(y, idx[:, 0], upd), y)[1])(
       np.zeros(6, np.float32)),
   lambda rng: [np.array([[1], [3], [1]], np.int64),
                rng.standard_normal((3,)).astype("float32")],
-  grad=None)
+  grad=(1,))
 S("select_scatter",
   lambda x, v: paddle.select_scatter(x, v, axis=0, index=1),
   lambda x, v: (lambda y: (y.__setitem__(1, v), y)[1])(x.copy()),
@@ -1205,7 +1212,7 @@ S("fill_diagonal_tensor",
   lambda x, v: (lambda y: (np.fill_diagonal(y, v), y)[1])(x.copy()),
   lambda rng: [rng.standard_normal((4, 4)).astype("float32"),
                rng.standard_normal((4,)).astype("float32")],
-  grad=None)
+  grad=(0, 1))
 S("index_put",
   lambda x, v: paddle.index_put(
       x, [paddle.to_tensor(np.array([0, 2], np.int64))], v),
@@ -1213,7 +1220,7 @@ S("index_put",
       x.copy()),
   lambda rng: [rng.standard_normal((4, 3)).astype("float32"),
                rng.standard_normal((2, 3)).astype("float32")],
-  grad=None)
+  grad=(0, 1))
 S("strided_slice",
   lambda x: paddle.strided_slice(x, axes=[0, 1], starts=[0, 1],
                                  ends=[4, 4], strides=[2, 1]),
@@ -1225,7 +1232,7 @@ S("as_strided_view",
   lambda x: paddle.as_strided(x, [2, 3], [3, 1]),
   lambda x: np.lib.stride_tricks.as_strided(
       x, (2, 3), (3 * x.itemsize, x.itemsize)).copy(),
-  _std((12,)), grad=None)
+  _std((12,)), grad=None, grad_skip="aliasing")
 S("multiplex",
   lambda a, b, idx: paddle.multiplex([a, b], idx),
   lambda a, b, idx: np.stack([a, b])[idx[:, 0],
@@ -1233,45 +1240,45 @@ S("multiplex",
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
                rng.standard_normal((3, 4)).astype("float32"),
                np.array([[0], [1], [0]], np.int64)],
-  grad=None)
+  grad=(0, 1))
 S("shard_index",
   lambda x: paddle.shard_index(x, index_num=20, nshards=2,
                                shard_id=0),
   lambda x: np.where((x >= 0) & (x < 10), x, -1),
   lambda rng: [rng.integers(0, 20, (4, 1)).astype("int64")],
-  grad=None, dtypes=("int64",))
+  grad=None, grad_skip="integer", dtypes=("int64",))
 S("reduce_as",
   lambda x, y: paddle.reduce_as(x, y),
   lambda x, y: x.sum(0, keepdims=False),
   lambda rng: [rng.standard_normal((3, 4)).astype("float32"),
                rng.standard_normal((4,)).astype("float32")],
-  grad=None)
+  grad=(0,))
 S("tril_indices",
   lambda: paddle.tril_indices(4, 4, 0),
   lambda: np.stack(np.tril_indices(4, 0, 4)).astype(np.int64),
-  lambda rng: [], grad=None, dtypes=("int64",))
+  lambda rng: [], grad=None, grad_skip="constant", dtypes=("int64",))
 S("triu_indices",
   lambda: paddle.triu_indices(4, 4, 0),
   lambda: np.stack(np.triu_indices(4, 0, 4)).astype(np.int64),
-  lambda rng: [], grad=None, dtypes=("int64",))
+  lambda rng: [], grad=None, grad_skip="constant", dtypes=("int64",))
 S("histogramdd_counts",
   lambda x: paddle.histogramdd(x, bins=[3, 3],
                                ranges=[-2.0, 2.0, -2.0, 2.0])[0],
   lambda x: np.histogramdd(
       x, bins=[3, 3], range=[(-2, 2), (-2, 2)])[0].astype(np.float32),
-  _unit((20, 2)), grad=None, dtypes=("float32",))
+  _unit((20, 2)), grad=None, grad_skip="counting", dtypes=("float32",))
 S("multigammaln",
   lambda x: paddle.multigammaln(x, p=2),
   lambda x: sps.multigammaln(np.asarray(x, np.float64), 2).astype(
       np.float32),
-  _pos(lo=1.2, hi=4.0), grad=None,
+  _pos(lo=1.2, hi=4.0), grad=None, grad_skip="nogradrule",
   tols={"float32": dict(rtol=1e-4, atol=1e-4),
         "bfloat16": dict(rtol=0.1, atol=0.1)})
 S("combinations_pairs",
   lambda x: paddle.combinations(x, r=2),
   lambda x: np.array([[x[i], x[j]] for i in range(len(x))
                       for j in range(i + 1, len(x))], np.float32),
-  _std((5,)), grad=None)
+  _std((5,)), grad=(0,))
 S("column_stack",
   lambda a, b: paddle.column_stack([a, b]),
   lambda a, b: np.column_stack([a, b]), _std((4,), n=2),
@@ -1279,7 +1286,7 @@ S("column_stack",
 S("cartesian_prod",
   lambda a, b: paddle.cartesian_prod([a, b]),
   lambda a, b: np.array([[i, j] for i in a for j in b], np.float32),
-  _std((3,), n=2), grad=None)
+  _std((3,), n=2), grad=(0, 1))
 
 
 S("nanquantile",
@@ -1287,13 +1294,13 @@ S("nanquantile",
   lambda x: np.nanquantile(x, 0.5, axis=-1).astype(np.float32),
   lambda rng: [np.where(rng.uniform(size=(3, 8)) > 0.8, np.nan,
                         rng.standard_normal((3, 8))).astype("float32")],
-  grad=None, dtypes=("float32",))
+  grad=None, grad_skip="nangrad", dtypes=("float32",))
 S("histogram_bin_edges",
   # min==max==0 selects the data-dependent auto-range branch — the
   # only path that actually reads the tensor
   lambda x: x.histogram_bin_edges(bins=6),
   lambda x: np.histogram_bin_edges(x, bins=6).astype(np.float32),
-  _std(), grad=None, dtypes=("float32",))
+  _std(), grad=None, grad_skip="counting", dtypes=("float32",))
 
 
 SKIPPED = {
@@ -1328,6 +1335,21 @@ def test_op_sweep(spec):
     t.check_output()
     if spec.grad is not None:
         t.check_grad(wrt=spec.grad, **spec.grad_kw)
+
+
+def test_grad_coverage_boundary():
+    """Every forward-only spec carries a one-word reason, and the
+    grad-checked majority stays large (the r5 'forward-only tail'
+    finding: >160 specs skipped grads with no stated cause)."""
+    unexplained = [s.name for s in SPECS
+                   if s.grad is None and not (
+                       isinstance(s.grad_skip, str)
+                       and s.grad_skip.isidentifier())]
+    assert unexplained == [], unexplained
+    spurious = [s.name for s in SPECS
+                if s.grad is not None and s.grad_skip is not None]
+    assert spurious == [], spurious
+    assert sum(1 for s in SPECS if s.grad is not None) >= 200
 
 
 def test_sweep_count():

@@ -340,6 +340,11 @@ ALIASES = {
 
 # descope classes: (path-regex, reason)
 DESCOPES = [
+    (r"^sparse/(conv|pool)_", "sparse point-cloud conv/pool pack "
+     "(sparse.nn.Conv3D/SubmConv3D/MaxPool3D) descoped in TPU v1: the "
+     "cuSPARSE gather-scatter kernels have no XLA analogue; the "
+     "implementation path is a static-capacity pallas gather-GEMM-scatter pack over "
+     "SparseCooTensor (the sparse/nn raisers point at this row)"),
     (r"^strings/", "string tensors descoped (docs/DECISIONS.md — no string "
                    "dtype on TPU/XLA; python-side text utils in paddle.text)"),
     (r"^selected_rows/", "SelectedRows descoped: XLA has no dynamic-row "
