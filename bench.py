@@ -596,6 +596,19 @@ def run_selftest():
         assert rec.get("check") == "pass", rec
         results["input_pipeline_detail"] = rec
 
+    def serving():
+        # ISSUE 6: continuous-batching serving tier — Poisson arrivals
+        # on a tiny model: per-request token parity vs generate(),
+        # preempt-then-resume bit-parity on an oversubscribed page
+        # pool, bounded TTFT under load via chunked prefill, zero
+        # leaked pages/slots at drain, decode compile-count stable
+        # under mid-flight admission, and the continuous-vs-static
+        # batching A/B at 3 concurrency levels
+        rec = _run_cpu_probe("paddle_tpu.serving.selftest",
+                             n_devices=1, timeout=900)
+        assert rec.get("check") == "pass", rec
+        results["serving_detail"] = rec
+
     check("pallas_flash_single_block_s512", lambda: flash(512))
     check("pallas_flash_tiled_s2048", lambda: flash(2048))
     check("int8_weight_only_matmul", int8_matmul)
@@ -605,6 +618,7 @@ def run_selftest():
     check("sharded_scan_parity", sharded_scan_parity)
     check("fault_tolerance", fault_tolerance)
     check("input_pipeline", input_pipeline)
+    check("serving", serving)
     return results
 
 
@@ -1008,6 +1022,17 @@ if __name__ == "__main__":
         print(json.dumps(
             {"input_pipeline":
              _run_cpu_probe("paddle_tpu.io.input_pipeline_selftest")}))
+    elif "--serve" in sys.argv:
+        # SERVING lane (ISSUE 6): continuous-batching vs static
+        # generate-and-wait on Poisson traffic at >= 3 concurrency
+        # levels — p50/p99 TTFT, aggregate tok/s, preemption counters,
+        # retrace-free decode proof. Hermetic CPU subprocess (the lane
+        # measures the scheduler, not matmuls); BENCH_SERVE_MODEL /
+        # BENCH_SERVE_USERS / BENCH_SERVE_RATE_PER_USER tune the load
+        print(json.dumps(
+            {"serving": _run_cpu_probe("paddle_tpu.serving.selftest",
+                                       extra_args=("--bench",),
+                                       n_devices=1, timeout=900)}))
     elif "--selftest" in sys.argv:
         _setup_jax()
         print(json.dumps({"selftest": run_selftest()}))

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["DenseKVCache", "PagedKVCache", "paged_write_decode",
            "paged_write_prefill", "dense_write_prefill"]
@@ -171,59 +172,119 @@ class PagedKVCache:
                          for _ in range(num_layers)]
         self.v_layers = [jnp.zeros(shape, dtype)
                          for _ in range(num_layers)]
-        self.page_tables = jnp.zeros((max_slots, pages_per_seq),
-                                     jnp.int32)
-        self.seq_lens = jnp.zeros((max_slots,), jnp.int32)
-        self.active = jnp.zeros((max_slots,), bool)
+        # host-mutated metadata lives as NUMPY between steps: the slot
+        # bookkeeping (allocate/reserve/free/set_active) runs every
+        # scheduler iteration, and a jnp `.at[].set` per call would be
+        # an XLA dispatch each — measured ~5x the whole serving step on
+        # the continuous-batching loop. jax converts these small arrays
+        # at jit dispatch; the compiled steps hand back device arrays,
+        # which `_host()` pulls down again on the next host mutation.
+        self.page_tables = np.zeros((max_slots, pages_per_seq),
+                                    np.int32)
+        self.seq_lens = np.zeros((max_slots,), np.int32)
+        self.active = np.zeros((max_slots,), bool)
         # host bookkeeping — page 0 reserved as trash
         self._free_pages = list(range(num_pages - 1, 0, -1))
         self._free_slots = list(range(max_slots - 1, -1, -1))
         self._slot_pages: dict[int, list[int]] = {}
 
     # -- host bookkeeping ------------------------------------------------
+    def _host(self, name):
+        """Writable host copy of a metadata array (seq_lens/active/
+        page_tables may hold the device output of the last compiled
+        step — never mutated during a trace, so the pull-down here is
+        always a concrete tiny D2H)."""
+        arr = getattr(self, name)
+        if not isinstance(arr, np.ndarray):
+            arr = np.array(getattr(arr, "_data", arr))
+            setattr(self, name, arr)
+        return arr
+
     @property
     def free_page_count(self):
         return len(self._free_pages)
 
+    @property
+    def free_slot_count(self):
+        return len(self._free_slots)
+
+    def pages_needed(self, total_len: int) -> int:
+        """Pages required to hold `total_len` tokens of one sequence."""
+        return -(-int(total_len) // self.page_size)   # ceil
+
+    def can_allocate(self, prompt_len: int) -> bool:
+        """Admission probe: would `allocate(prompt_len)` succeed? Pure
+        host check — no state is touched, so the serving scheduler can
+        make admission decisions without try/except control flow."""
+        need = self.pages_needed(prompt_len)
+        return (bool(self._free_slots) and need <= self.pages_per_seq
+                and need <= len(self._free_pages))
+
+    def can_reserve(self, slot: int, total_len: int) -> bool:
+        """Growth probe: would `reserve(slot, total_len)` succeed?"""
+        pages = self._slot_pages.get(slot)
+        if pages is None:
+            return False
+        need = self.pages_needed(total_len)
+        return (need <= self.pages_per_seq
+                and need - len(pages) <= len(self._free_pages))
+
     def allocate(self, prompt_len: int) -> int:
-        """Claim a slot with pages covering `prompt_len` tokens."""
+        """Claim a slot with pages covering `prompt_len` tokens.
+
+        Atomic: a failed allocation (no slot / pool dry / over
+        pages_per_seq) raises BEFORE any state is touched — page
+        tables, seq_lens, active and the free lists read exactly as
+        they did on entry."""
         if not self._free_slots:
             raise RuntimeError("no free cache slots (batch full)")
+        self._check_reservable(self.pages_needed(prompt_len), 0,
+                               prompt_len)
         slot = self._free_slots.pop()
         self._slot_pages[slot] = []
-        self.seq_lens = jnp.asarray(self.seq_lens).at[slot].set(0)
-        self.active = jnp.asarray(self.active).at[slot].set(True)
-        try:
-            self.reserve(slot, prompt_len)
-        except RuntimeError:
-            self.free(slot)
-            raise
+        self._host("seq_lens")[slot] = 0
+        self._host("active")[slot] = True
+        self.reserve(slot, prompt_len)
         return slot
 
-    def reserve(self, slot: int, total_len: int):
-        """Map pages so slot `slot` can hold `total_len` tokens."""
-        pages = self._slot_pages[slot]
-        need = -(-int(total_len) // self.page_size)   # ceil
+    def _check_reservable(self, need, have, total_len):
         if need > self.pages_per_seq:
             raise RuntimeError(
                 f"sequence of {total_len} tokens exceeds pages_per_seq="
                 f"{self.pages_per_seq} * page_size={self.page_size}")
+        if need - have > len(self._free_pages):
+            raise RuntimeError("KV page pool exhausted")
+
+    def reserve(self, slot: int, total_len: int):
+        """Map pages so slot `slot` can hold `total_len` tokens.
+
+        Atomic like `allocate`: the capacity check happens before the
+        first page is mapped, so a failed reserve leaves the slot, the
+        page tables and the free list untouched."""
+        pages = self._slot_pages[slot]
+        need = self.pages_needed(total_len)
+        self._check_reservable(need, len(pages), total_len)
+        pt = self._host("page_tables")
         while len(pages) < need:
-            if not self._free_pages:
-                raise RuntimeError("KV page pool exhausted")
             page = self._free_pages.pop()
-            self.page_tables = jnp.asarray(self.page_tables).at[
-                slot, len(pages)].set(page)
+            pt[slot, len(pages)] = page
             pages.append(page)
+
+    def set_active(self, slot: int, flag: bool):
+        """Host toggle for decode participation: the serving tier keeps
+        a slot inactive while its prompt is still chunk-prefilling so
+        the decode step neither advances its seq_len nor attends its
+        half-written context."""
+        self._host("active")[slot] = bool(flag)
 
     def free(self, slot: int):
         """Return the slot's pages to the pool (continuous batching)."""
         pages = self._slot_pages.pop(slot, [])
         self._free_pages.extend(reversed(pages))
         self._free_slots.append(slot)
-        self.page_tables = jnp.asarray(self.page_tables).at[slot].set(0)
-        self.seq_lens = jnp.asarray(self.seq_lens).at[slot].set(0)
-        self.active = jnp.asarray(self.active).at[slot].set(False)
+        self._host("page_tables")[slot] = 0
+        self._host("seq_lens")[slot] = 0
+        self._host("active")[slot] = False
 
     # -- device state ------------------------------------------------------
     def state(self):

@@ -38,10 +38,11 @@ import numpy as np
 
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
-from ..nn.functional.sampling import sample_logits
+from ..nn.functional.sampling import sample_logits, sample_logits_per_slot
 from .train_step import _tree_data, _tree_wrap
 
 __all__ = ["GenerationEngine", "DecodeStep", "PrefillStep",
+           "ChunkPrefillStep", "ServeDecodeStep",
            "DEFAULT_PREFILL_BUCKETS"]
 
 DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
@@ -62,6 +63,18 @@ def _split_state(kind, state):
 
 class _Step:
     """Shared machinery: trace counting, jit/eager dispatch, donation."""
+
+    # serving steps set this: the continuous-batching bookkeeping
+    # rewrites SOME metadata leaves between calls (a freed slot pulls
+    # seq_lens to host, an untouched step leaves it on device), and a
+    # call-to-call varying numpy/device mix PER LEAF keys a fresh
+    # executable per combination (measured: silent mid-serve
+    # recompiles). Pinning every leaf to host numpy = one cache key;
+    # the D2H is a few hundred bytes on arrays the serving loop reads
+    # synchronously anyway. The GenerationEngine steps keep it off —
+    # their meta leaves are already call-to-call consistent, and the
+    # pull-down would serialize decode dispatch per token.
+    _pin_meta_host = False
 
     def __init__(self, engine, donate_cache):
         self.engine = engine
@@ -99,11 +112,19 @@ class _Step:
 
     def __call__(self, *args):
         if not self.engine.compiled:
+            # eager: the paged metadata lives as host numpy between
+            # steps and the step bodies index it with `.at[]` — lift
+            # it to jax arrays (a no-op for leaves already on device)
+            args = list(args)
+            args[2] = {k: jnp.asarray(v) for k, v in args[2].items()}
             return self._fn(*args)
         if self._jitted is None:
             self._jitted = jax.jit(
                 self._fn,
                 donate_argnums=(1,) if self._donate else ())
+        if self._pin_meta_host:
+            args = list(args)
+            args[2] = {k: np.asarray(v) for k, v in args[2].items()}
         return self._jitted(*args)
 
     # -- shared step body helpers ---------------------------------------
@@ -221,6 +242,107 @@ class DecodeStep(_Step):
 
 def _data_of(x):
     return x._data if isinstance(x, Tensor) else x
+
+
+# ---------------------------------------------------------------------------
+# serving-tier steps (paddle_tpu/serving): chunked prefill + per-slot RNG
+# ---------------------------------------------------------------------------
+
+class ChunkPrefillStep(_Step):
+    """One bounded chunk of one prompt (continuous batching): write the
+    chunk's K/V at positions [start, start+c) of its slot, attending
+    over the context cached so far, and sample the prefill-complete
+    token with the request's OWN RNG stream.
+
+    Chunks are padded to a small set of chunk buckets, so jax.jit's
+    shape-keyed cache holds one program per bucket and long prompts
+    interleave with decode steps at a bounded per-chunk cost (TTFT for
+    resident sequences stays bounded while a long prompt prefills).
+    The sampled token is only meaningful when this was the final chunk
+    — the host discards it otherwise. Paged cache only."""
+
+    _pin_meta_host = True
+
+    def _fn(self, params, buffers, meta, ids, slot_ids, start, lens_new,
+            seeds):
+        self.trace_count += 1
+        eng = self.engine
+        with no_grad(), _BindCtx(eng):
+            self._enter(params, buffers, meta)
+            cache = eng.cache
+            hidden = eng.model.gpt.prefill_chunk(
+                Tensor._wrap(ids), cache, Tensor._wrap(slot_ids),
+                Tensor._wrap(start), Tensor._wrap(lens_new))
+            # last VALID chunk position per row (traced, bucket-stable)
+            last = jnp.take_along_axis(
+                hidden._data,
+                (lens_new - start - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                             # [b, h]
+            logits = eng.model.head(Tensor._wrap(last))._data
+            sl = _data_of(cache.seq_lens)
+            cache.seq_lens = Tensor._wrap(
+                sl.at[slot_ids].set(lens_new))
+            # sample position = total context length after this chunk —
+            # identical to what the decode step would use at the same
+            # context, which is what makes preempt-resume re-prefill
+            # reproduce the original stream (exactly, wherever this
+            # path's logits match the decode path's — bitwise on the
+            # shared XLA fallback; kernel-level numerics on chip)
+            ids_next = sample_logits_per_slot(
+                logits, seeds, lens_new, temperature=eng.temperature,
+                top_k=eng.top_k, top_p=eng.top_p,
+                greedy=not eng.do_sample)
+            new_buffers, new_meta = self._exit_state()
+        return ids_next, logits, new_buffers, new_meta
+
+
+class ServeDecodeStep(_Step):
+    """`decode_burst` one-token decode steps over the full slot batch,
+    fused into ONE compiled program: one dispatch + one host sync
+    yields k tokens per slot (multi-step scheduling — the per-call
+    host cost is what dominates a continuous-batching loop on small
+    steps). Sampling uses PER-SLOT RNG streams: slot i samples with
+    fold_in(PRNGKey(seeds[i]), ctx_len_i), so a request's tokens are
+    bit-reproducible no matter which other sequences share the batch
+    (admissions/retirements around it cannot perturb its stream).
+    Inactive slots (free, or still chunk-prefilling) write to the
+    trash page, attend nothing and keep their seq_lens — their sampled
+    output is garbage the host discards. A slot whose request finishes
+    mid-burst saturates its seq_len at the engine window and writes
+    past its reserved pages onto the trash page — more host-discarded
+    garbage."""
+
+    _pin_meta_host = True
+
+    def _fn(self, params, buffers, meta, tokens, seeds):
+        self.trace_count += 1
+        eng = self.engine
+        with no_grad(), _BindCtx(eng):
+            self._enter(params, buffers, meta)
+            cache = eng.cache
+            b = tokens.shape[0]
+            cur, toks = tokens, []
+            # unrolled: burst length is a small engine constant, so
+            # this stays one trace / one executable
+            for _ in range(eng.decode_burst):
+                pos_ids = _data_of(cache.seq_lens)[:, None] \
+                    .astype(jnp.int32)
+                hidden = eng.model.gpt.decode_step(
+                    Tensor._wrap(jnp.reshape(cur, (b, 1))), cache,
+                    Tensor._wrap(pos_ids))
+                logits = eng.model.head(hidden)._data[:, 0]  # [b, v]
+                sl = _data_of(cache.seq_lens)
+                act = _data_of(cache.active)
+                new_sl = jnp.where(act,
+                                   jnp.minimum(sl + 1, eng.max_len), sl)
+                cache.seq_lens = Tensor._wrap(new_sl)
+                cur = sample_logits_per_slot(
+                    logits, seeds, new_sl, temperature=eng.temperature,
+                    top_k=eng.top_k, top_p=eng.top_p,
+                    greedy=not eng.do_sample)
+                toks.append(cur)
+            new_buffers, new_meta = self._exit_state()
+        return jnp.stack(toks), logits, new_buffers, new_meta
 
 
 class GenerationEngine:

@@ -190,6 +190,71 @@ class GPTAttention(nn.Layer):
         cache.v_layers[layer_idx] = new_v
         return self.out_proj(out.reshape([b, 1, h]))
 
+    def forward_prefill_chunk(self, x, cache, layer_idx, slot_ids,
+                              start, seq_lens_new):
+        """One bounded chunk of a long prompt (serving tier, paged
+        cache only): write the chunk's K/V at logical positions
+        [start, start+c) of each slot, then attend the chunk's queries
+        over the slot's FULL paged context so far (earlier chunks +
+        this one, causal within the chunk).
+
+        x: [b, c, h] chunk hiddens (right-padded to the chunk bucket);
+        start/seq_lens_new: [b] int32 — chunk offset and the total
+        cached length after this chunk (= start + true chunk length);
+        padded positions land on the trash page and padded queries'
+        outputs are discarded by the caller. The context gather is
+        static-shape ([pages_per_seq * page_size]) so every chunk in a
+        bucket shares one compiled program.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..inference import kv_cache as _kv
+        from ..ops._dispatch import nary
+
+        b, c, h = x.shape
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv(x).reshape([b, c, 3, nh, hd])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        def step(qq, kk, vv, kp, vp, pt, sid, st, ln):
+            kp2, vp2 = _kv.paged_write_prefill(kp, vp, pt, sid, ln,
+                                               kk, vv, start=st)
+            kvh, num_pages, page_size, d = kp2.shape
+            grp = nh // kvh
+            rows = pt[sid]                       # [b, pages_per_seq]
+            L = rows.shape[1] * page_size
+
+            def densify(pool):
+                g = jnp.take(pool, rows, axis=1)     # [kvh, b, pp, ps, d]
+                return jnp.moveaxis(g, 1, 0).reshape(b, kvh, L, d)
+
+            ctx_k, ctx_v = densify(kp2), densify(vp2)
+            qg = jnp.moveaxis(qq, 1, 2).reshape(b, kvh, grp, c, d)
+            s = jnp.einsum("bhgcd,bhld->bhgcl",
+                           qg.astype(jnp.float32),
+                           ctx_k.astype(jnp.float32)) / (d ** 0.5)
+            # query i (abs pos st+i) sees ctx positions j <= st+i; the
+            # rest of the gathered window is stale/unwritten pool data
+            jpos = jnp.arange(L, dtype=jnp.int32)
+            ipos = st[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
+            mask = jpos[None, None, :] <= ipos[:, :, None]  # [b, c, L]
+            s = jnp.where(mask[:, None, None], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhgcl,bhld->bhgcd", p,
+                           ctx_v.astype(jnp.float32))
+            o = jnp.moveaxis(o.reshape(b, nh, c, d), 1, 2)
+            return o.astype(qq.dtype), kp2, vp2
+
+        out, new_k, new_v = nary(
+            step, [q, k, v, cache.k_layers[layer_idx],
+                   cache.v_layers[layer_idx], cache.page_tables,
+                   slot_ids, start, seq_lens_new],
+            "paged_prefill_chunk")
+        cache.k_layers[layer_idx] = new_k
+        cache.v_layers[layer_idx] = new_v
+        return self.out_proj(out.reshape([b, c, h]))
+
     def forward(self, x):
         b, s, h = x.shape
         qkv = self.qkv(x)                              # [b, s, 3h]
@@ -262,6 +327,13 @@ class GPTBlock(nn.Layer):
 
     def forward_decode(self, x, cache, layer_idx):
         x = x + self.attn.forward_decode(self.ln_1(x), cache, layer_idx)
+        return x + self.mlp(self.ln_2(x))
+
+    def forward_prefill_chunk(self, x, cache, layer_idx, slot_ids,
+                              start, seq_lens_new):
+        x = x + self.attn.forward_prefill_chunk(
+            self.ln_1(x), cache, layer_idx, slot_ids, start,
+            seq_lens_new)
         return x + self.mlp(self.ln_2(x))
 
 
@@ -452,6 +524,30 @@ class GPTModel(nn.Layer):
         x = self.wte(tokens) + self.wpe(position_ids)
         for l, block in enumerate(self.blocks):
             x = block.forward_decode(x, cache, l)
+        return self.ln_f(x)
+
+    def prefill_chunk(self, input_ids, cache, slot_ids, start,
+                      seq_lens_new):
+        """Chunked prompt pass (serving tier, paged cache): process one
+        bounded chunk of each slot's prompt at logical positions
+        [start, start+c), attending over the context cached so far.
+
+        input_ids: [b, c] chunk tokens right-padded to the chunk
+        bucket; start/seq_lens_new: [b] int32 Tensors. Returns the
+        chunk hiddens [b, c, hidden] (caller gathers the last valid
+        position for the prefill-complete logits). The caller owns
+        advancing cache.seq_lens to seq_lens_new."""
+        self._check_decodable()
+        b, c = input_ids.shape
+        pos = start.unsqueeze(1) + C.arange(0, c, dtype="int32") \
+            .unsqueeze(0)
+        # padded tail positions of the last chunk can poke past the
+        # position table — clamp them (their outputs are discarded)
+        pos = pos.clip(0, self.config.max_position_embeddings - 1)
+        x = self.wte(input_ids) + self.wpe(pos.astype("int64"))
+        for l, block in enumerate(self.blocks):
+            x = block.forward_prefill_chunk(x, cache, l, slot_ids,
+                                            start, seq_lens_new)
         return self.ln_f(x)
 
 

@@ -14,20 +14,13 @@ import jax.numpy as jnp
 
 from ...ops._dispatch import ensure_tensor, nary, unary
 
-__all__ = ["sample_logits", "greedy_sample", "top_k_top_p_sampling"]
+__all__ = ["sample_logits", "sample_logits_per_slot", "per_slot_keys",
+           "greedy_sample", "top_k_top_p_sampling"]
 
 
-def sample_logits(logits, key=None, temperature=1.0, top_k=0, top_p=1.0):
-    """Sample one token id per row of `logits` [..., vocab] (pure jnp).
-
-    key=None or temperature<=0 → greedy argmax. top_k > 0 keeps only the
-    k largest logits; top_p < 1 keeps the smallest descending-probability
-    prefix with cumulative mass >= p (at least one token). Returns int32
-    ids of shape logits.shape[:-1].
-    """
-    lf = logits.astype(jnp.float32)
-    if key is None or temperature <= 0.0:
-        return jnp.argmax(lf, axis=-1).astype(jnp.int32)
+def _truncate_logits(lf, temperature, top_k, top_p):
+    """Temperature + top-k + top-p truncation over fp32 logits [..., v]
+    (shared by the single-key and per-slot samplers)."""
     lf = lf / float(temperature)
     if top_k and top_k > 0:
         kth = jax.lax.top_k(lf, int(top_k))[0][..., -1:]
@@ -44,7 +37,59 @@ def sample_logits(logits, key=None, temperature=1.0, top_k=0, top_p=1.0):
         thresh = jnp.min(jnp.where(keep, sort, jnp.inf), axis=-1,
                          keepdims=True)
         lf = jnp.where(lf < thresh, -jnp.inf, lf)
+    return lf
+
+
+def sample_logits(logits, key=None, temperature=1.0, top_k=0, top_p=1.0):
+    """Sample one token id per row of `logits` [..., vocab] (pure jnp).
+
+    key=None or temperature<=0 → greedy argmax. top_k > 0 keeps only the
+    k largest logits; top_p < 1 keeps the smallest descending-probability
+    prefix with cumulative mass >= p (at least one token). Returns int32
+    ids of shape logits.shape[:-1].
+    """
+    lf = logits.astype(jnp.float32)
+    if key is None or temperature <= 0.0:
+        return jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    lf = _truncate_logits(lf, temperature, top_k, top_p)
     return jax.random.categorical(key, lf, axis=-1).astype(jnp.int32)
+
+
+def per_slot_keys(seeds, positions):
+    """[b, 2]-ish PRNG keys for per-request sampling streams: row i gets
+    fold_in(PRNGKey(seeds[i]), positions[i]).
+
+    The continuous-batching contract (serving tier) hangs off this: a
+    request's stream depends only on its OWN seed and the number of
+    context tokens behind each sample, never on which other sequences
+    share the batch — so admissions, preemptions and resumes around it
+    cannot change its sampled tokens."""
+    seeds = jnp.asarray(seeds).astype(jnp.uint32)
+    positions = jnp.asarray(positions).astype(jnp.uint32)
+    return jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+    )(seeds, positions)
+
+
+def sample_logits_per_slot(logits, seeds, positions, temperature=1.0,
+                           top_k=0, top_p=1.0, greedy=False):
+    """Per-slot sampling for a continuous batch: logits [b, vocab], one
+    independent RNG stream per row keyed on (seeds[i], positions[i]).
+
+    `positions[i]` must be the number of context tokens that produced
+    row i's logits (prompt_len at prefill, the post-increment seq_len at
+    decode) — the same (seed, position) pair then yields the same token
+    whether it is sampled by a decode step or by the re-prefill of a
+    preempted-and-resumed request. greedy=True (or temperature<=0) is
+    plain argmax."""
+    lf = logits.astype(jnp.float32)
+    if greedy or temperature <= 0.0:
+        return jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    lf = _truncate_logits(lf, temperature, top_k, top_p)
+    keys = per_slot_keys(seeds, positions)
+    return jax.vmap(
+        lambda k, l: jax.random.categorical(k, l)
+    )(keys, lf).astype(jnp.int32)
 
 
 def greedy_sample(logits, name=None):

@@ -1,0 +1,379 @@
+"""ServingEngine: the continuous-batching loop over the compiled steps.
+
+One engine owns one (model, PagedKVCache) pair and exactly TWO compiled
+programs in steady state: a `ServeDecodeStep` over the full slot batch
+(traced once — admissions, preemptions and retirements only refresh its
+inputs) and a `ChunkPrefillStep` per chunk bucket (a handful of
+power-of-two sizes). Every `step()`:
+
+1. **admit** — the scheduler moves queue-head requests into free slots
+   (capacity probed via `can_allocate` before commit);
+2. **chunk-prefill** — at most `prefill_chunks_per_step` bounded chunks
+   of the oldest resident prompt run between decode steps, so TTFT for
+   new arrivals stays bounded while resident sequences keep streaming;
+3. **decode** — one token for every decode-active slot (per-slot RNG
+   streams keyed on (request seed, context length): a request's tokens
+   never depend on its batch neighbours);
+4. **stream/retire** — tokens push to handles (callback / poll /
+   `stream()` iterator); EOS or token-budget retirement frees pages
+   immediately.
+
+The cache's device state threads functionally through the steps with
+the KV pools donated (HBM-neutral steady state); the host bookkeeping
+(page tables, active flags, free lists) is refreshed into the step
+inputs each call — an input refresh, never a retrace.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..inference.kv_cache import PagedKVCache
+from ..jit.decode_step import (ChunkPrefillStep, ServeDecodeStep,
+                               _split_state)
+from ..jit.train_step import _tree_data
+from .metrics import ServingMetrics
+from .request import FinishReason, Request, RequestHandle, RequestState
+from .scheduler import RequestScheduler
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    def __init__(self, model, max_slots=8, max_len=256, page_size=16,
+                 num_pages=None, chunk_size=64,
+                 prefill_chunks_per_step=1, prefill_batch=4,
+                 decode_burst=1, do_sample=False, top_k=0, top_p=1.0,
+                 temperature=1.0, compiled=True, cache_dtype=None,
+                 donate=True, admit_watermark="auto",
+                 clock=time.perf_counter):
+        import jax.numpy as jnp
+
+        cfg = model.config
+        model.gpt._check_decodable()
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len={max_len} exceeds max_position_embeddings="
+                f"{cfg.max_position_embeddings}")
+        self.model = model
+        self.kind = "paged"
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        self.chunk_size = int(chunk_size)
+        self.prefill_chunks_per_step = int(prefill_chunks_per_step)
+        # one chunk-prefill call advances up to this many prompts at
+        # once (fixed batch dim, dummy rows masked to the trash page) —
+        # amortizes the per-call cost that otherwise serializes
+        # admissions under a deep queue
+        self.prefill_batch = max(1, min(int(prefill_batch),
+                                        self.max_slots))
+        # decode_burst > 1 fuses that many decode steps INSIDE the
+        # compiled ServeDecodeStep: one dispatch + one host sync per k
+        # tokens (multi-step scheduling) — the host loop's per-call
+        # cost is what dominates small decode steps. Streaming and
+        # admission granularity coarsen to k steps; tokens a request
+        # samples past its EOS/budget inside a burst are discarded.
+        self.decode_burst = max(1, int(decode_burst))
+        self.do_sample = bool(do_sample)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.temperature = float(temperature)
+        self.compiled = bool(compiled)
+        self.clock = clock
+        self._cache_dtype = cache_dtype or jnp.float32
+        self.pages_per_seq = -(-self.max_len // self.page_size)
+        # full provisioning by default; pass a smaller pool to
+        # oversubscribe (preemption reclaims pages under pressure)
+        self.num_pages = int(num_pages or
+                             1 + self.max_slots * self.pages_per_seq)
+        self._params = list(model.parameters())
+        self.cache = self._make_cache()
+        self.metrics = ServingMetrics(clock=clock)
+        self.scheduler = RequestScheduler(
+            self.cache, self.metrics, admit_watermark=admit_watermark)
+        self.prefill_step = ChunkPrefillStep(self, donate_cache=donate)
+        self.decode_step = ServeDecodeStep(self, donate_cache=donate)
+        bkts, b = [], 8
+        while b < self.chunk_size:
+            bkts.append(b)
+            b *= 2
+        self.chunk_buckets = tuple(bkts) + (self.chunk_size,)
+        self._buffers, _ = _split_state(
+            "paged", _tree_data(self.cache.state()))
+        # per-slot host mirrors refreshed every step (plain input data)
+        self._tokens = np.zeros((self.max_slots,), np.int32)
+        self._seeds = np.zeros((self.max_slots,), np.uint32)
+        self._rid = 0
+
+    def _make_cache(self):
+        cfg = self.model.config
+        nh = cfg.num_attention_heads
+        return PagedKVCache(
+            cfg.num_layers, nh, cfg.hidden_size // nh,
+            num_pages=self.num_pages, page_size=self.page_size,
+            max_slots=self.max_slots, pages_per_seq=self.pages_per_seq,
+            dtype=self._cache_dtype)
+
+    # -- client surface ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens, priority=0,
+               eos_token_id=None, seed=None, on_token=None
+               ) -> RequestHandle:
+        """Queue a request; returns a streaming handle immediately.
+        Tokens arrive as the engine steps (`step()`/`run()`/`stream()`).
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = int(prompt.size) + int(max_new_tokens)
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + {max_new_tokens} new tokens "
+                f"exceeds the engine max_len {self.max_len}")
+        if self.cache.pages_needed(total) > self.num_pages - 1:
+            raise ValueError(
+                f"request needs {self.cache.pages_needed(total)} pages "
+                f"but the pool only has {self.num_pages - 1}")
+        rid = self._rid
+        self._rid += 1
+        req = Request(rid, prompt, int(max_new_tokens),
+                      priority=int(priority), eos_token_id=eos_token_id,
+                      seed=int(seed) if seed is not None else rid)
+        handle = RequestHandle(req, on_token=on_token)
+        handle.arrival_seq = rid
+        handle.submit_time = self.clock()
+        self.scheduler.enqueue(handle)
+        self.metrics.on_submit()
+        return handle
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, <=N prefill chunks, one
+        decode for all running sequences. Returns False when idle."""
+        sched = self.scheduler
+        try:
+            for h in sched.admit():
+                # full-width uint32: distinct seeds stay distinct
+                # streams (per_slot_keys folds the raw 32-bit value)
+                self._seeds[h.slot] = np.uint32(
+                    h.request.seed & 0xFFFFFFFF)
+            worked = False
+            for _ in range(self.prefill_chunks_per_step):
+                heads = sched.prefill_heads(self.prefill_batch)
+                if not heads:
+                    break
+                self._run_prefill_chunk(heads)
+                worked = True
+            if sched.decode_slots():
+                worked |= self._run_decode()
+        except BaseException:
+            self._recover()
+            raise
+        self.metrics.observe(len(sched.waiting), len(sched.running))
+        return worked
+
+    def run(self, max_steps=1_000_000):
+        """Drive the loop until every submitted request finished."""
+        steps = 0
+        while self.scheduler.has_work():
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"serving loop did not drain in {max_steps} steps")
+        return self.metrics.snapshot()
+
+    def stream(self, handle: RequestHandle):
+        """Generator yielding `handle`'s tokens as they are produced,
+        stepping the engine (and every other resident request) along."""
+        while True:
+            for t in handle.new_tokens():
+                yield t
+            if handle.done:
+                return
+            if not self.scheduler.has_work():
+                raise RuntimeError("request is not resident and the "
+                                   "engine is idle")
+            self.step()
+
+    def compile_counts(self) -> dict:
+        """Retrace probe surface: decode must stay at ONE trace across
+        arbitrary admit/preempt/retire churn; prefill at most one trace
+        per chunk bucket."""
+        return {
+            "decode_traces": self.decode_step.trace_count,
+            "decode_executables": self.decode_step.cache_size(),
+            "prefill_traces": self.prefill_step.trace_count,
+            "prefill_executables": self.prefill_step.cache_size(),
+            "chunk_buckets": list(self.chunk_buckets),
+        }
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def reset_metrics(self):
+        """Fresh counters (e.g. after a compile warmup run) — the bench
+        lanes measure steady-state serving, not trace time."""
+        self.metrics = ServingMetrics(clock=self.clock)
+        self.scheduler.metrics = self.metrics
+
+    def warmup(self):
+        """Compile every program the serving loop can hit — the decode
+        step and one prefill program per chunk bucket — then reset the
+        counters, so a measured window never eats a trace. Buckets warm
+        one at a time (a joint batch would only compile the largest)."""
+        for b in self.chunk_buckets:
+            plen = max(1, min(b, self.max_len - 2))
+            self.submit(np.ones((plen,), np.int32), 2)
+            self.run()
+        self.reset_metrics()
+        return self
+
+    # -- step mechanics ---------------------------------------------------
+    def _param_data(self):
+        return [p._data for p in self._params]
+
+    def _meta(self):
+        c = self.cache
+        return _tree_data({"page_tables": c.page_tables,
+                           "seq_lens": c.seq_lens,
+                           "active": c.active})
+
+    def _commit(self, buffers, meta):
+        self._buffers = buffers
+        self.cache.load_state({**buffers, **meta})
+
+    def _chunk_bucket(self, n):
+        for b in self.chunk_buckets:
+            if b >= n:
+                return b
+        return self.chunk_buckets[-1]
+
+    def _run_prefill_chunk(self, heads: list):
+        """One compiled call advances the next chunk of up to
+        `prefill_batch` prompts. Rows beyond `len(heads)` are dummies:
+        their slot id is max_slots (out of bounds — the seq_lens
+        scatter drops, the page-table gather clamps harmlessly) and
+        their zero-length chunk routes every write to the trash page.
+        """
+        B = self.prefill_batch
+        heads = heads[:B]
+        chunks = [h.pending[h.prefill_pos:
+                            h.prefill_pos + self.chunk_size]
+                  for h in heads]
+        bucket = self._chunk_bucket(max(len(c) for c in chunks))
+        ids = np.zeros((B, bucket), np.int32)
+        slot_ids = np.full((B,), self.max_slots, np.int32)
+        start = np.zeros((B,), np.int32)
+        lens_new = np.zeros((B,), np.int32)
+        seeds = np.zeros((B,), np.uint32)
+        for j, (h, chunk) in enumerate(zip(heads, chunks)):
+            ids[j, :len(chunk)] = chunk
+            slot_ids[j] = h.slot
+            start[j] = h.prefill_pos
+            lens_new[j] = h.prefill_pos + len(chunk)
+            seeds[j] = self._seeds[h.slot]
+        ids_next, _logits, buffers, meta = self.prefill_step(
+            self._param_data(), self._buffers, self._meta(),
+            ids, slot_ids, start, lens_new, seeds)
+        self._commit(buffers, meta)
+        tok = None
+        for j, (h, chunk) in enumerate(zip(heads, chunks)):
+            self.metrics.prefill_chunks += 1
+            h.prefill_pos += len(chunk)
+            if h.prefill_pos < len(h.pending):
+                continue
+            # prompt fully cached: the sampled token is the request's
+            # next real token (its FIRST on a fresh admission -> TTFT)
+            if tok is None:
+                tok = np.asarray(ids_next)
+            self.cache.set_active(h.slot, True)
+            h.state = RequestState.RUNNING
+            token = int(tok[j])
+            self._tokens[h.slot] = token
+            self._emit(h, token)
+
+    def _run_decode(self) -> bool:
+        sched = self.scheduler
+        # highest priority first so page pressure lands on the lowest
+        order = sorted(sched.decode_slots(),
+                       key=lambda s: sched._key(sched.running[s]))
+        # burst length k is uniform, but the PAGE lookahead is capped
+        # per slot by the request's remaining token budget (and the
+        # engine window): tokens a request samples past its budget
+        # inside a burst are garbage the host discards, and their
+        # writes land on the trash page (unmapped page-table entries
+        # are 0) — reserving real pages for them could force a
+        # preemption purely to hold discarded tokens
+        k = self.decode_burst
+        live = []
+        for slot in order:
+            h = sched.running.get(slot)
+            if h is None or h.state is not RequestState.RUNNING:
+                continue   # preempted as a victim earlier in this loop
+            remaining = h.request.max_new_tokens - len(h.output_tokens)
+            ahead = max(1, min(k, remaining,
+                               self.max_len - sched._context_len(h)))
+            if sched.ensure_token_capacity(slot, lookahead=ahead):
+                live.append(slot)
+        # a slot approved early can still be sacrificed to a later
+        # (higher-priority-tied) slot's reservation — keep only slots
+        # that survived the whole capacity pass
+        live = [s for s in live
+                if sched.running.get(s) is not None
+                and sched.running[s].state is RequestState.RUNNING]
+        if not live:
+            return False
+        out, _logits, buffers, meta = self.decode_step(
+            self._param_data(), self._buffers, self._meta(),
+            self._tokens, self._seeds)
+        self._commit(buffers, meta)
+        # ONE host sync per burst: [k, b] sampled ids (the in-graph
+        # burst re-feeds them without the host round-trip)
+        step_tokens = np.asarray(out)
+        self.metrics.decode_steps += k
+        for tok in step_tokens:
+            for slot in live:
+                handle = sched.running.get(slot)
+                if (handle is None
+                        or handle.state is not RequestState.RUNNING):
+                    continue   # retired earlier in this burst
+                token = int(tok[slot])
+                self._tokens[slot] = token
+                self._emit(handle, token)
+        return True
+
+    def _emit(self, handle: RequestHandle, token: int):
+        now = self.clock()
+        handle._push_token(token, now)
+        self.metrics.on_token()
+        req = handle.request
+        if (req.eos_token_id is not None
+                and token == req.eos_token_id):
+            self.scheduler.retire(handle.slot, FinishReason.EOS, now)
+        elif len(handle.output_tokens) >= req.max_new_tokens:
+            self.scheduler.retire(handle.slot, FinishReason.LENGTH, now)
+
+    def _recover(self):
+        """A failed step leaves donated buffers dead — rebuild the cache
+        pristine and requeue every resident request for resume."""
+        self.scheduler.abort_all()
+        self.cache = self._make_cache()
+        self.scheduler.cache = self.cache
+        self._buffers, _ = _split_state(
+            "paged", _tree_data(self.cache.state()))
+
+    # -- introspection ----------------------------------------------------
+    def leak_check(self) -> dict:
+        """Post-drain invariant surface: every page and slot is back in
+        the pool once no request is resident."""
+        c = self.cache
+        return {
+            "free_pages": c.free_page_count,
+            "total_pages": self.num_pages - 1,   # page 0 is trash
+            "free_slots": c.free_slot_count,
+            "total_slots": self.max_slots,
+            "resident_slot_pages": len(c._slot_pages),
+        }

@@ -1,0 +1,96 @@
+"""Serving metrics: counters + per-request latency aggregation.
+
+One ``ServingMetrics`` lives on the engine; the scheduler and the step
+loop feed it events, and ``snapshot()`` renders the surface the bench
+lane records (queue depth, running/waiting, per-request TTFT and
+inter-token latency percentiles, aggregate tok/s, preemption and
+page-reclaim counters). Everything is host-side and O(1) per event —
+no device sync is ever added for metrics.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["ServingMetrics", "percentile"]
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in [0, 100]) of a list, None if empty."""
+    if not values:
+        return None
+    xs = sorted(values)
+    k = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+class ServingMetrics:
+    def __init__(self, clock=time.perf_counter):
+        self.clock = clock
+        self.start_time = clock()
+        # counters
+        self.submitted = 0
+        self.admitted = 0
+        self.resumed = 0          # re-admissions of preempted requests
+        self.finished = 0
+        self.preemptions = 0
+        self.evicted_pages = 0    # pages reclaimed by preemption
+        self.prefill_chunks = 0
+        self.decode_steps = 0
+        self.generated_tokens = 0
+        # gauges (refreshed every engine step)
+        self.queue_depth = 0
+        self.running = 0
+        # per-request latency samples (appended at finish)
+        self.ttft_s: list[float] = []
+        self.itl_s: list[float] = []      # all inter-token gaps
+        self.request_preemptions: list[int] = []
+
+    # -- event feeds ------------------------------------------------------
+    def on_submit(self):
+        self.submitted += 1
+
+    def on_admit(self, resumed: bool):
+        self.admitted += 1
+        if resumed:
+            self.resumed += 1
+
+    def on_preempt(self, pages_reclaimed: int):
+        self.preemptions += 1
+        self.evicted_pages += int(pages_reclaimed)
+
+    def on_token(self):
+        self.generated_tokens += 1
+
+    def on_finish(self, handle):
+        self.finished += 1
+        if handle.ttft is not None:
+            self.ttft_s.append(handle.ttft)
+        self.itl_s.extend(handle.inter_token_latencies)
+        self.request_preemptions.append(handle.preemptions)
+
+    def observe(self, queue_depth: int, running: int):
+        self.queue_depth = queue_depth
+        self.running = running
+
+    # -- surface ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        elapsed = max(self.clock() - self.start_time, 1e-9)
+        return {
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "resumed": self.resumed,
+            "finished": self.finished,
+            "preemptions": self.preemptions,
+            "evicted_pages": self.evicted_pages,
+            "prefill_chunks": self.prefill_chunks,
+            "decode_steps": self.decode_steps,
+            "generated_tokens": self.generated_tokens,
+            "queue_depth": self.queue_depth,
+            "running": self.running,
+            "elapsed_s": round(elapsed, 4),
+            "tok_s": round(self.generated_tokens / elapsed, 2),
+            "ttft_p50_s": percentile(self.ttft_s, 50),
+            "ttft_p99_s": percentile(self.ttft_s, 99),
+            "itl_p50_s": percentile(self.itl_s, 50),
+            "itl_p99_s": percentile(self.itl_s, 99),
+        }

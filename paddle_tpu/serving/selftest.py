@@ -1,0 +1,276 @@
+"""Hermetic serving selftest: continuous batching proven on a tiny model.
+
+Run as ``python -m paddle_tpu.serving.selftest`` in a clean
+JAX_PLATFORMS=cpu subprocess (bench.py run_selftest wires it through
+the same env-strip recipe as the other lanes) and prints ONE JSON line
+for BENCH_r*.json:
+
+* **parity/churn** — Poisson arrivals admitted mid-flight produce, per
+  request, exactly the tokens `model.generate()` produces for that
+  request alone (continuous batching must not change anyone's output);
+  zero leaked pages/slots at drain; the decode step stays at ONE trace
+  while sequences are admitted, preempted and retired mid-flight.
+* **preempt/resume** — an oversubscribed page pool forces preemptions;
+  outputs stay identical to the fully-provisioned run (sampled, not
+  greedy, so the per-request RNG streams are what is being proven).
+* **bounded TTFT** — under saturating load with chunked prefill, p99
+  TTFT stays within a budget derived from the measured decode step
+  time (chunks interleave with decode, so arrivals never wait for a
+  whole long prompt to prefill).
+* **traffic A/B** — continuous vs static generate-and-wait batching at
+  three concurrency levels: p50/p99 TTFT and aggregate tok/s, with
+  continuous required to win on tok/s at the highest level.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def _tiny_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=192,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def run_probe():
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.traffic import (poisson_traffic,
+                                            run_continuous, run_static)
+
+    m, cfg = _tiny_model()
+    rec, fails = {}, []
+
+    def check(name, fn):
+        try:
+            fn()
+            rec[name] = "pass"
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            rec[name] = f"FAIL: {type(e).__name__}: {e}"[:300]
+            fails.append(name)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, (pl,))
+               for pl in (5, 11, 19, 26, 8, 14)]
+
+    # -- parity + churn + retrace stability -------------------------------
+    def churn_parity():
+        eng = ServingEngine(m, max_slots=3, max_len=64, page_size=8,
+                            chunk_size=8)
+        handles = []
+        # staggered submits: later requests join while earlier ones
+        # decode (admission mid-flight), slots churn through 6 requests
+        for i, p in enumerate(prompts):
+            handles.append(eng.submit(p, 6 + (i % 3) * 3))
+            for _ in range(2):
+                eng.step()
+        eng.run(max_steps=5000)
+        for h in handles:
+            ref = m.generate(np.asarray(h.request.prompt)[None],
+                             max_new_tokens=h.request.max_new_tokens,
+                             use_cache="paged")
+            assert np.asarray(ref._data)[0].tolist() == \
+                h.output_tokens, f"rid {h.request.rid} diverged"
+        leaks = eng.leak_check()
+        assert leaks["free_pages"] == leaks["total_pages"], leaks
+        assert leaks["free_slots"] == leaks["total_slots"], leaks
+        cc = eng.compile_counts()
+        assert cc["decode_traces"] == 1, cc
+        assert cc["prefill_traces"] <= len(cc["chunk_buckets"]), cc
+        rec["churn_compile"] = cc
+        rec["churn_metrics"] = {
+            k: eng.metrics_snapshot()[k]
+            for k in ("finished", "preemptions", "decode_steps",
+                      "prefill_chunks")}
+
+    # -- preempt -> resume bit-parity (sampled) ---------------------------
+    def preempt_resume():
+        def serve(num_pages):
+            eng = ServingEngine(m, max_slots=4, max_len=48, page_size=8,
+                                chunk_size=8, num_pages=num_pages,
+                                do_sample=True, temperature=1.0)
+            hs = [eng.submit(p, 12, seed=100 + i)
+                  for i, p in enumerate(prompts[:4])]
+            eng.run(max_steps=5000)
+            return eng, hs
+
+        full_eng, full = serve(None)
+        tight_eng, tight = serve(9)    # 8 usable pages -> pool dries up
+        assert tight_eng.metrics.preemptions >= 1, \
+            "pool never dried — selftest is not exercising preemption"
+        assert full_eng.metrics.preemptions == 0
+        for a, b in zip(full, tight):
+            assert a.output_tokens == b.output_tokens, \
+                f"rid {a.request.rid}: resume changed the stream"
+        leaks = tight_eng.leak_check()
+        assert leaks["free_pages"] == leaks["total_pages"], leaks
+        rec["preemptions"] = tight_eng.metrics.preemptions
+
+    # -- bounded TTFT under load -----------------------------------------
+    def bounded_ttft():
+        eng = ServingEngine(m, max_slots=4, max_len=128, page_size=8,
+                            chunk_size=8).warmup()
+        t0 = time.perf_counter()
+        eng.submit(prompts[1], 4)
+        eng.run(max_steps=400)
+        step_s = (time.perf_counter() - t0) / 6
+        eng.reset_metrics()
+        traffic = poisson_traffic(16, rate_rps=400.0,
+                                  vocab_size=cfg.vocab_size,
+                                  prompt_lens=(6, 80),
+                                  out_lens=(6, 24), seed=2)
+        recc, handles = run_continuous(eng, traffic)
+        assert recc["finished"] == 16, recc
+        assert all(h.done for h in handles)
+        # chunked prefill bounds TTFT: even the worst arrival waits at
+        # most a queue of bounded chunks + decode steps, never a whole
+        # long prefill per resident sequence; 400 engine steps of slack
+        # is orders looser than that but catches a stalled scheduler
+        budget = max(step_s * 400, 2.0)
+        assert recc["ttft_p99_s"] < budget, (recc, step_s)
+        leaks = eng.leak_check()
+        assert leaks["free_pages"] == leaks["total_pages"], leaks
+        assert eng.compile_counts()["decode_traces"] == 1
+        rec["ttft_under_load"] = {
+            "ttft_p50_s": recc["ttft_p50_s"],
+            "ttft_p99_s": recc["ttft_p99_s"],
+            "budget_s": round(budget, 3),
+            "tok_s": recc["tok_s"],
+        }
+
+    # -- continuous vs static A/B at 3 concurrency levels -----------------
+    def traffic_ab():
+        levels = {}
+        win = 0
+        for users in (2, 4, 8):
+            # realistic serving shape: short prompts, heavy-tailed
+            # output budgets — generate-and-wait pays the batch max for
+            # every member, continuous batching recycles the slot
+            traffic = poisson_traffic(
+                3 * users, rate_rps=200.0, vocab_size=cfg.vocab_size,
+                prompt_lens=(4, 24), out_lens=(4, 96), seed=10 + users)
+            eng = ServingEngine(m, max_slots=users, max_len=120,
+                                page_size=8, chunk_size=16,
+                                prefill_chunks_per_step=2,
+                                decode_burst=4).warmup()
+            cont, _ = run_continuous(eng, traffic)
+            stat = run_static(m, traffic, concurrency=users,
+                              max_len=120, page_size=8)
+            win += cont["tok_s"] > stat["tok_s"]
+            levels[f"users{users}"] = {
+                "continuous": {k: cont[k] for k in
+                               ("tok_s", "ttft_p50_s", "ttft_p99_s",
+                                "finished", "preemptions")},
+                "static": stat,
+            }
+        rec["traffic_ab"] = levels
+        assert levels["users8"]["continuous"]["tok_s"] > \
+            levels["users8"]["static"]["tok_s"], levels["users8"]
+        rec["continuous_wins"] = f"{win}/3"
+
+    check("serving_churn_parity", churn_parity)
+    check("serving_preempt_resume", preempt_resume)
+    check("serving_bounded_ttft", bounded_ttft)
+    check("serving_traffic_ab", traffic_ab)
+    rec["check"] = ("pass" if not fails
+                    else "FAIL: " + ", ".join(fails))
+    return rec
+
+
+def run_bench():
+    """bench.py --serve lane: p50/p99 TTFT + aggregate tok/s at >= 3
+    concurrency levels, continuous batching vs static generate-and-wait
+    on the same Poisson traffic, plus the retrace-free proof. Model and
+    load are env-tunable (BENCH_SERVE_MODEL, BENCH_SERVE_USERS,
+    BENCH_SERVE_REQS_PER_USER, BENCH_SERVE_RATE_PER_USER); the default
+    is a
+    tiny model because the lane measures the SCHEDULER — admission,
+    chunked prefill, slot recycling — not matmul throughput."""
+    import os
+
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.traffic import (poisson_traffic,
+                                            run_continuous, run_static)
+
+    model_name = os.environ.get("BENCH_SERVE_MODEL", "tiny")
+    if model_name == "tiny":
+        m, cfg = _tiny_model()
+    else:
+        import paddle_tpu as paddle
+        from paddle_tpu.models import GPTForCausalLM, gpt_config
+
+        cfg = gpt_config(model_name, max_position_embeddings=256)
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+    levels = tuple(int(u) for u in os.environ.get(
+        "BENCH_SERVE_USERS", "4,8,16").split(","))
+    n_per = int(os.environ.get("BENCH_SERVE_REQS_PER_USER", "6"))
+    # offered load scales with the concurrency level, so every level
+    # saturates its slots instead of measuring the arrival process
+    rate_per = float(os.environ.get("BENCH_SERVE_RATE_PER_USER", "25"))
+    max_len = 160
+    lanes, wins = {}, 0
+    tot = {"continuous": [0, 0.0], "static": [0, 0.0]}  # tokens, secs
+    for users in levels:
+        traffic = poisson_traffic(
+            n_per * users, rate_rps=rate_per * users,
+            vocab_size=cfg.vocab_size,
+            prompt_lens=(8, 48), out_lens=(8, 96), seed=7 + users)
+        eng = ServingEngine(m, max_slots=users, max_len=max_len,
+                            page_size=16, chunk_size=32,
+                            prefill_chunks_per_step=2,
+                            decode_burst=4).warmup()
+        cont, _ = run_continuous(eng, traffic)
+        stat = run_static(m, traffic, concurrency=users,
+                          max_len=max_len, page_size=16)
+        wins += cont["tok_s"] > stat["tok_s"]
+        tot["continuous"][0] += cont["generated_tokens"]
+        tot["continuous"][1] += cont["elapsed_s"]
+        tot["static"][0] += stat["generated_tokens"]
+        tot["static"][1] += stat["elapsed_s"]
+        lanes[f"users{users}"] = {
+            "continuous": {k: cont[k] for k in
+                           ("tok_s", "ttft_p50_s", "ttft_p99_s",
+                            "itl_p50_s", "finished", "preemptions",
+                            "decode_steps", "prefill_chunks")},
+            "static": stat,
+            "tok_s_speedup": round(
+                cont["tok_s"] / max(stat["tok_s"], 1e-9), 3),
+            "retrace_free": cont["compile"]["decode_traces"] == 1,
+        }
+    agg = {side: round(v[0] / max(v[1], 1e-9), 1)
+           for side, v in tot.items()}
+    return {
+        "metric": "serving_continuous_vs_static",
+        "config": {"model": model_name, "levels": list(levels),
+                   "reqs_per_user": n_per, "rate_per_user": rate_per,
+                   "max_len": max_len,
+                   "params": sum(int(np.prod(p.shape))
+                                 for p in m.parameters())},
+        "continuous_wins": f"{wins}/{len(levels)}",
+        "aggregate_tok_s": agg,
+        "aggregate_speedup": round(
+            agg["continuous"] / max(agg["static"], 1e-9), 3),
+        "lanes": lanes,
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--bench" in sys.argv:
+        print(json.dumps(run_bench()))
+    else:
+        print(json.dumps(run_probe()))

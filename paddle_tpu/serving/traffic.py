@@ -1,0 +1,149 @@
+"""Synthetic serving traffic + the static-batching baseline.
+
+The bench lane (bench.py --serve) and the hermetic serving selftest
+both drive the engine with Poisson arrivals over mixed prompt/output
+length distributions — the shape TPU serving papers measure TTFT and
+throughput curves against — and A/B the continuous-batching engine
+against **static generate-and-wait batching**: requests grouped into
+fixed batches in arrival order, each batch running `generate()` to the
+LONGEST requested length, every sequence waiting for the slowest and
+tokens delivered only when the batch returns. That is exactly the
+pre-serving-tier behavior of the PR-2 engine, so the A/B isolates what
+the scheduler buys.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from .metrics import percentile
+
+__all__ = ["TrafficRequest", "poisson_traffic", "run_continuous",
+           "run_static"]
+
+
+@dataclass
+class TrafficRequest:
+    arrival_s: float
+    prompt: np.ndarray
+    max_new_tokens: int
+    priority: int = 0
+
+
+def _mixed_len(rng, bounds, long_frac):
+    """Short/long mixture over [lo, hi]: most draws from the lower
+    half, `long_frac` from the upper half — the heavy-tailed shape real
+    prompt AND output length distributions have (and exactly what
+    generate-and-wait batching is worst at: one long member makes the
+    whole batch pay its length)."""
+    lo, hi = int(bounds[0]), int(bounds[1])
+    mid = max(lo + 1, (lo + hi) // 2)
+    if rng.random() < long_frac:
+        return int(rng.integers(mid, hi + 1))
+    return int(rng.integers(lo, mid))
+
+
+def poisson_traffic(n, rate_rps, vocab_size, prompt_lens=(8, 48),
+                    out_lens=(8, 32), long_frac=0.25, seed=0):
+    """`n` requests with exponential inter-arrival times (Poisson
+    process at `rate_rps`) and short/long mixtures over both prompt
+    lengths and output budgets (`long_frac` of each draws from the
+    upper half of its range)."""
+    rng = np.random.default_rng(seed)
+    t, out = 0.0, []
+    for _ in range(n):
+        t += float(rng.exponential(1.0 / rate_rps))
+        plen = _mixed_len(rng, prompt_lens, long_frac)
+        prompt = rng.integers(1, vocab_size, (plen,)).astype(np.int32)
+        out.append(TrafficRequest(
+            t, prompt, _mixed_len(rng, out_lens, long_frac)))
+    return out
+
+
+def run_continuous(engine, traffic, max_steps=2_000_000):
+    """Serve `traffic` through a ServingEngine with real-time Poisson
+    arrivals: each request is submitted when its arrival time passes,
+    mid-flight, while earlier requests are prefilling/decoding. Returns
+    (record, handles)."""
+    pending = sorted(traffic, key=lambda r: r.arrival_s)
+    handles, i, steps = [], 0, 0
+    t0 = engine.clock()
+    while i < len(pending) or engine.scheduler.has_work():
+        now = engine.clock() - t0
+        while i < len(pending) and pending[i].arrival_s <= now:
+            r = pending[i]
+            handles.append(engine.submit(
+                r.prompt, r.max_new_tokens, priority=r.priority))
+            i += 1
+        if engine.scheduler.has_work():
+            engine.step()
+        elif i < len(pending):
+            time.sleep(min(0.002,
+                           max(0.0, pending[i].arrival_s - now)))
+        steps += 1
+        if steps >= max_steps:
+            raise RuntimeError("continuous traffic run did not drain")
+    elapsed = engine.clock() - t0
+    rec = engine.metrics_snapshot()
+    rec["elapsed_s"] = round(elapsed, 4)
+    rec["tok_s"] = round(rec["generated_tokens"] / max(elapsed, 1e-9), 2)
+    rec["compile"] = engine.compile_counts()
+    return rec, handles
+
+
+def run_static(model, traffic, concurrency, max_len, page_size=16,
+               clock=time.perf_counter):
+    """Generate-and-wait baseline: batches of `concurrency` in strict
+    arrival order through the PR-2 GenerationEngine (paged cache); a
+    batch starts when its last member has arrived and the previous
+    batch finished, runs to the batch-max token budget, and delivers
+    every member's tokens only when it returns (so TTFT = completion -
+    arrival: that is what "no serving tier" means)."""
+    from ..jit.decode_step import GenerationEngine
+
+    reqs = sorted(traffic, key=lambda r: r.arrival_s)
+    eng = GenerationEngine(model, kind="paged", batch=concurrency,
+                           max_len=max_len, page_size=page_size)
+    # warm the compiled steps (decode + every prefill bucket the
+    # traffic can hit) outside the measured window, same deal as
+    # ServingEngine.warmup()
+    width = max(len(r.prompt) for r in reqs)
+    for b in eng.prefill_buckets:
+        if b > eng._bucket(width):
+            break
+        eng.generate(np.ones((concurrency, b), np.int64), 2)
+
+    t0 = clock()
+    ttfts, useful_tokens = [], 0
+    for g0 in range(0, len(reqs), concurrency):
+        group = reqs[g0:g0 + concurrency]
+        # the batch cannot form before its last member arrives
+        gate = t0 + max(r.arrival_s for r in group)
+        now = clock()
+        if now < gate:
+            time.sleep(gate - now)
+        plens = [len(r.prompt) for r in group]
+        width = max(plens)
+        ids = np.zeros((concurrency, width), np.int64)
+        lens = np.ones((concurrency,), np.int32)
+        for j, r in enumerate(group):
+            ids[j, :plens[j]] = r.prompt
+            lens[j] = plens[j]
+        ids[len(group):, 0] = 1          # dummy pad rows (len 1)
+        new = max(r.max_new_tokens for r in group)
+        eng.generate(ids, new, seq_lens=lens)
+        tb = clock()
+        for r in group:
+            ttfts.append(tb - (t0 + r.arrival_s))
+            useful_tokens += r.max_new_tokens   # the rest is padding
+    elapsed = clock() - t0
+    return {
+        "finished": len(reqs),
+        "generated_tokens": useful_tokens,
+        "elapsed_s": round(elapsed, 4),
+        "tok_s": round(useful_tokens / max(elapsed, 1e-9), 2),
+        "ttft_p50_s": percentile(ttfts, 50),
+        "ttft_p99_s": percentile(ttfts, 99),
+    }

@@ -426,7 +426,9 @@ class TestCacheSlotReuse:
                 cache.page_tables, jnp.asarray([slot], jnp.int32),
                 jnp.asarray([length], jnp.int32), k, k)
             cache.k_layers[0], cache.v_layers[0] = nk, nv
-            cache.seq_lens = cache.seq_lens.at[slot].set(length)
+            # metadata is host numpy between steps (serving tier)
+            cache.seq_lens = np.asarray(cache.seq_lens)
+            cache.seq_lens[slot] = length
             return np.asarray(k[0])
 
         def read(slot, length):

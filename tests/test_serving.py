@@ -1,0 +1,497 @@
+"""Continuous-batching serving tier tests (ISSUE 6).
+
+Scheduler invariants over the paged KV cache: no slot or page leaks
+across admit/evict/retire churn, preempt-then-resume token parity,
+chunked-prefill logits parity vs the one-shot prefill, FIFO fairness
+under saturation, metrics counters consistent with observed events —
+plus the satellite regressions: non-raising capacity probes with
+atomic rollback on failed allocate/reserve, and per-request RNG
+streams that make a request's tokens independent of its batch
+neighbours.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=96,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(n, seed=0, lens=(5, 11, 19, 8, 14, 26)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 64, (lens[i % len(lens)],)).astype(np.int32)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# satellite: capacity probes + atomic rollback (kv_cache)
+# ---------------------------------------------------------------------------
+
+class TestCapacityProbes:
+    def _cache(self, num_pages=9, max_slots=2, pages_per_seq=4,
+               page_size=8):
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+
+        return PagedKVCache(num_layers=1, num_kv_heads=2, head_dim=4,
+                            num_pages=num_pages, page_size=page_size,
+                            max_slots=max_slots,
+                            pages_per_seq=pages_per_seq)
+
+    def test_can_allocate_matches_allocate(self):
+        c = self._cache()          # 8 usable pages, 2 slots
+        assert c.can_allocate(8 * 4)          # pages_per_seq cap
+        assert not c.can_allocate(8 * 4 + 1)  # over per-seq cap
+        s0 = c.allocate(8 * 4)                # 4 pages
+        assert c.can_allocate(32)             # 4 pages left
+        s1 = c.allocate(32)
+        assert not c.can_allocate(1)          # no slots left
+        c.free(s1)
+        assert c.can_allocate(32) and not c.can_allocate(33)
+        c.free(s0)
+
+    def test_can_reserve(self):
+        c = self._cache()
+        s = c.allocate(8)                     # 1 page
+        assert c.can_reserve(s, 32)
+        assert not c.can_reserve(s, 33)       # pages_per_seq
+        assert not c.can_reserve(999, 8)      # unknown slot
+        other = c.allocate(8 * 4)
+        # pool: 8 - 1 - 4 = 3 free; growing to 4 pages needs 3 more
+        assert c.can_reserve(s, 32)
+        c.free(other)
+
+    def _snapshot(self, c):
+        return (np.array(c.page_tables), np.array(c.seq_lens),
+                np.array(c.active), list(c._free_pages),
+                list(c._free_slots),
+                {k: list(v) for k, v in c._slot_pages.items()})
+
+    def _assert_unchanged(self, c, snap):
+        pt, sl, act, fp, fs, sp = snap
+        np.testing.assert_array_equal(np.asarray(c.page_tables), pt)
+        np.testing.assert_array_equal(np.asarray(c.seq_lens), sl)
+        np.testing.assert_array_equal(np.asarray(c.active), act)
+        assert c._free_pages == fp
+        assert c._free_slots == fs
+        assert {k: list(v) for k, v in c._slot_pages.items()} == sp
+
+    def test_failed_allocate_is_atomic(self):
+        c = self._cache()
+        s = c.allocate(8 * 3)                 # 3 of 8 pages
+        snap = self._snapshot(c)
+        with pytest.raises(RuntimeError):
+            c.allocate(8 * 6)                 # needs 6, only 5 free
+        self._assert_unchanged(c, snap)
+        with pytest.raises(RuntimeError):
+            c.allocate(8 * 4 + 1)             # over pages_per_seq
+        self._assert_unchanged(c, snap)
+        c.allocate(1)
+        snap = self._snapshot(c)
+        with pytest.raises(RuntimeError):
+            c.allocate(1)                     # no slots
+        self._assert_unchanged(c, snap)
+
+    def test_failed_reserve_is_atomic(self):
+        c = self._cache()
+        s0 = c.allocate(8)                    # 1 page
+        s1 = c.allocate(8 * 4)                # 4 pages -> 3 free
+        snap = self._snapshot(c)
+        with pytest.raises(RuntimeError, match="exceeds"):
+            c.reserve(s0, 8 * 4 + 8)          # over pages_per_seq cap
+        self._assert_unchanged(c, snap)
+        c.free(s1)                            # 7 free
+        c2 = self._cache(num_pages=4, pages_per_seq=4)  # 3 usable
+        sa = c2.allocate(8)
+        sb = c2.allocate(8)
+        snap2 = self._snapshot(c2)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            c2.reserve(sa, 8 * 3)             # needs 2 more, 1 free
+        self._assert_unchanged(c2, snap2)
+
+    def test_probes_do_not_mutate(self):
+        c = self._cache()
+        s = c.allocate(8)
+        snap = self._snapshot(c)
+        c.can_allocate(64)
+        c.can_reserve(s, 64)
+        c.pages_needed(100)
+        self._assert_unchanged(c, snap)
+
+
+# ---------------------------------------------------------------------------
+# satellite: per-request RNG streams
+# ---------------------------------------------------------------------------
+
+class TestPerSlotSampling:
+    def test_greedy_is_argmax(self):
+        from paddle_tpu.nn.functional.sampling import \
+            sample_logits_per_slot
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((4, 32)), jnp.float32)
+        got = sample_logits_per_slot(
+            logits, jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32),
+            greedy=True)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.argmax(np.asarray(logits), -1))
+
+    def test_stream_depends_only_on_seed_and_position(self):
+        """Row i's sample is a function of (logits_i, seed_i, pos_i) —
+        shuffling the other rows must not change it."""
+        from paddle_tpu.nn.functional.sampling import \
+            sample_logits_per_slot
+
+        rng = np.random.default_rng(1)
+        logits = rng.standard_normal((4, 32)).astype(np.float32)
+        seeds = np.asarray([7, 8, 9, 10], np.int32)
+        pos = np.asarray([3, 5, 9, 2], np.int32)
+        a = np.asarray(sample_logits_per_slot(
+            jnp.asarray(logits), seeds, pos, temperature=1.0))
+        perm = [2, 0, 3, 1]
+        b = np.asarray(sample_logits_per_slot(
+            jnp.asarray(logits[perm]), seeds[perm], pos[perm],
+            temperature=1.0))
+        np.testing.assert_array_equal(a[perm], b)
+        # and the same (seed, pos) reproduces bit-identically
+        c = np.asarray(sample_logits_per_slot(
+            jnp.asarray(logits), seeds, pos, temperature=1.0))
+        np.testing.assert_array_equal(a, c)
+
+    def test_position_advances_stream(self):
+        from paddle_tpu.nn.functional.sampling import \
+            sample_logits_per_slot
+
+        rng = np.random.default_rng(2)
+        logits = jnp.asarray(rng.standard_normal((1, 500)), jnp.float32)
+        seeds = jnp.zeros(1, jnp.int32)
+        draws = {int(np.asarray(sample_logits_per_slot(
+            logits, seeds, jnp.asarray([p], jnp.int32)))[0])
+            for p in range(8)}
+        assert len(draws) > 1    # positions decorrelate the stream
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill
+# ---------------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    def test_chunked_logits_match_one_shot(self, model):
+        """Three 8-token chunks of a 19-token prompt produce the same
+        next-token logits as the full forward pass."""
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(model, max_slots=2, max_len=64, page_size=8,
+                            chunk_size=8, prefill_batch=1)
+        prompt = _prompts(1, seed=3, lens=(19,))[0]
+        slot = eng.cache.allocate(len(prompt))
+        logits = None
+        for start in range(0, len(prompt), 8):
+            chunk = prompt[start:start + 8]
+            bucket = eng._chunk_bucket(len(chunk))
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :len(chunk)] = chunk
+            out = eng.prefill_step(
+                eng._param_data(), eng._buffers, eng._meta(), ids,
+                np.asarray([slot], np.int32),
+                np.asarray([start], np.int32),
+                np.asarray([start + len(chunk)], np.int32),
+                np.asarray([0], np.int32))
+            _tok, logits, buffers, meta = out
+            eng._commit(buffers, meta)
+        want = np.asarray(
+            model(paddle.to_tensor(prompt[None].astype(np.int64)))
+            ._data, np.float32)[0, -1]
+        got = np.asarray(logits, np.float32)[0]
+        assert float(np.max(np.abs(got - want))) < 2e-4
+
+    def test_serve_matches_generate(self, model):
+        """Greedy continuous serving with mid-flight admission equals
+        per-request generate() — and the decode step never retraces."""
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(model, max_slots=3, max_len=64, page_size=8,
+                            chunk_size=8)
+        handles = []
+        for i, p in enumerate(_prompts(5, seed=4)):
+            handles.append(eng.submit(p, 5 + (i % 3)))
+            eng.step()                        # admissions interleave
+        eng.run(max_steps=3000)
+        for h in handles:
+            ref = model.generate(
+                np.asarray(h.request.prompt)[None],
+                max_new_tokens=h.request.max_new_tokens,
+                use_cache="paged")
+            assert np.asarray(ref._data)[0].tolist() == h.output_tokens
+        assert eng.compile_counts()["decode_traces"] == 1
+        leaks = eng.leak_check()
+        assert leaks["free_pages"] == leaks["total_pages"]
+        assert leaks["free_slots"] == leaks["total_slots"]
+        assert leaks["resident_slot_pages"] == 0
+
+
+# ---------------------------------------------------------------------------
+# scheduler invariants
+# ---------------------------------------------------------------------------
+
+class TestSchedulerInvariants:
+    def _serve(self, model, num_pages=None, seeds=True, max_new=10,
+               slots=4, burst=1):
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(model, max_slots=slots, max_len=48,
+                            page_size=8, chunk_size=8,
+                            num_pages=num_pages, do_sample=True,
+                            temperature=1.0, decode_burst=burst)
+        hs = [eng.submit(p, max_new, seed=100 + i)
+              for i, p in enumerate(_prompts(4, seed=5))]
+        eng.run(max_steps=5000)
+        return eng, hs
+
+    def test_preempt_resume_token_parity(self, model):
+        full_eng, full = self._serve(model, num_pages=None)
+        tight_eng, tight = self._serve(model, num_pages=9)
+        assert tight_eng.metrics.preemptions >= 1
+        assert full_eng.metrics.preemptions == 0
+        for a, b in zip(full, tight):
+            assert a.output_tokens == b.output_tokens
+        # preempted requests recorded a resume admission
+        assert tight_eng.metrics.resumed == sum(
+            h.preemptions for h in tight)
+
+    def test_no_leaks_after_churn_with_preemptions(self, model):
+        eng, hs = self._serve(model, num_pages=9, burst=2)
+        assert all(h.done for h in hs)
+        leaks = eng.leak_check()
+        assert leaks["free_pages"] == leaks["total_pages"]
+        assert leaks["free_slots"] == leaks["total_slots"]
+        assert leaks["resident_slot_pages"] == 0
+        assert eng.compile_counts()["decode_traces"] == 1
+
+    def test_fifo_under_saturation(self, model):
+        """Equal-length requests on a saturated engine finish in
+        arrival order — nobody bypasses the queue head."""
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(model, max_slots=2, max_len=32, page_size=8,
+                            chunk_size=8)
+        hs = [eng.submit(p, 6) for p in _prompts(6, seed=6, lens=(9,))]
+        eng.run(max_steps=3000)
+        finish = [(h.finish_time, h.request.rid) for h in hs]
+        assert [rid for _, rid in sorted(finish)] == \
+            [h.request.rid for h in hs]
+
+    def test_priority_picks_victim(self, model):
+        """When the pool dries up, the LOW priority sequence is the one
+        preempted."""
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(model, max_slots=2, max_len=48, page_size=8,
+                            chunk_size=8, num_pages=7)   # 6 usable
+        lo = eng.submit(_prompts(1, seed=7, lens=(16,))[0], 20,
+                        priority=0)
+        hi = eng.submit(_prompts(1, seed=8, lens=(16,))[0], 20,
+                        priority=1)
+        eng.run(max_steps=4000)
+        assert lo.preemptions >= 1
+        assert hi.preemptions == 0
+        assert lo.done and hi.done
+
+    def test_metrics_consistency(self, model):
+        eng, hs = self._serve(model, num_pages=9)
+        snap = eng.metrics_snapshot()
+        assert snap["submitted"] == len(hs) == snap["finished"]
+        assert snap["generated_tokens"] == sum(
+            len(h.output_tokens) for h in hs)
+        assert snap["admitted"] == snap["finished"] + snap["resumed"]
+        assert snap["preemptions"] == sum(h.preemptions for h in hs)
+        assert snap["queue_depth"] == 0 and snap["running"] == 0
+        assert snap["ttft_p50_s"] is not None
+        assert snap["ttft_p99_s"] >= snap["ttft_p50_s"]
+
+    def test_burst_matches_single_step(self, model):
+        """decode_burst only changes scheduling granularity, never the
+        tokens."""
+        a_eng, a = self._serve(model, burst=1)
+        b_eng, b = self._serve(model, burst=4)
+        for x, y in zip(a, b):
+            assert x.output_tokens == y.output_tokens
+
+    def test_burst_lookahead_respects_budget(self, model):
+        """A pool sized exactly for prompt+budget never preempts: the
+        burst lookahead is capped by the remaining token budget, so no
+        pages are reserved for post-retirement garbage tokens
+        (regression: that used to force a self-preemption + full
+        re-prefill on the last burst)."""
+        from paddle_tpu.serving import ServingEngine
+
+        p = _prompts(1, seed=14, lens=(5,))[0]
+        # prompt 5 + budget 6 = 11 tokens = 3 pages of 4 — the pool
+        # has exactly those 3 (+ trash), while max_len leaves room for
+        # the uncapped lookahead to ask for a 4th
+        eng = ServingEngine(model, max_slots=1, max_len=32, page_size=4,
+                            num_pages=4, chunk_size=8, decode_burst=4)
+        h = eng.submit(p, 6)
+        eng.run(max_steps=500)
+        assert h.done and len(h.output_tokens) == 6
+        assert eng.metrics.preemptions == 0
+
+    def test_priority_never_inverted_on_growth(self, model):
+        """ensure_token_capacity must not evict a higher-priority
+        neighbour to grow a lower-priority slot — the low one
+        sacrifices itself (regression: priority inversion)."""
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+        from paddle_tpu.serving.metrics import ServingMetrics
+        from paddle_tpu.serving.request import (Request, RequestHandle,
+                                                RequestState)
+        from paddle_tpu.serving.scheduler import RequestScheduler
+
+        cache = PagedKVCache(num_layers=1, num_kv_heads=2, head_dim=4,
+                             num_pages=3, page_size=8, max_slots=3,
+                             pages_per_seq=4)   # 2 usable pages
+        sched = RequestScheduler(cache, ServingMetrics())
+
+        def resident(prio, seq):
+            h = RequestHandle(Request(seq, np.ones(8, np.int32), 8,
+                                      priority=prio))
+            h.arrival_seq = seq
+            h.slot = cache.allocate(8)        # 1 page, context full
+            h.state = RequestState.RUNNING
+            h.output_tokens = [1]             # context = 8
+            sched.running[h.slot] = h
+            return h
+
+        lo = resident(0, 0)
+        hi = resident(1, 1)
+        assert not cache.can_reserve(lo.slot, 9)   # pool dry
+        # low-priority growth: self-preempt, never evict hi
+        assert sched.ensure_token_capacity(lo.slot, 1) is False
+        assert lo.state is RequestState.WAITING and lo.preemptions == 1
+        assert hi.preemptions == 0 and hi.slot in sched.running
+        # converse: high-priority growth DOES evict the low neighbour
+        lo2 = resident(0, 2)
+        assert sched.ensure_token_capacity(hi.slot, 8) is True
+        assert lo2.state is RequestState.WAITING
+        assert hi.preemptions == 0
+
+
+# ---------------------------------------------------------------------------
+# streaming + client surface
+# ---------------------------------------------------------------------------
+
+class TestStreaming:
+    def test_callback_and_poll(self, model):
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(model, max_slots=2, max_len=48, page_size=8,
+                            chunk_size=8)
+        seen = []
+        h = eng.submit(_prompts(1, seed=9)[0], 6,
+                       on_token=lambda hh, t: seen.append(t))
+        polled = []
+        while not h.done:
+            eng.step()
+            polled.extend(h.new_tokens())
+        assert seen == h.output_tokens == polled
+        assert len(seen) == 6
+        assert h.ttft is not None and h.ttft > 0
+        assert len(h.inter_token_latencies) == 5
+
+    def test_stream_iterator(self, model):
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(model, max_slots=2, max_len=48, page_size=8,
+                            chunk_size=8)
+        h = eng.submit(_prompts(1, seed=10)[0], 5)
+        toks = list(eng.stream(h))
+        assert toks == h.output_tokens and len(toks) == 5
+        assert h.finish_reason is not None
+
+    def test_eos_retires_early(self, model):
+        from paddle_tpu.serving import ServingEngine
+        from paddle_tpu.serving.request import FinishReason
+
+        eng = ServingEngine(model, max_slots=2, max_len=48, page_size=8,
+                            chunk_size=8)
+        p = _prompts(1, seed=11)[0]
+        probe = eng.submit(p, 8)
+        eng.run(max_steps=2000)
+        eos = probe.output_tokens[2]
+        stop_at = probe.output_tokens.index(eos) + 1   # first hit
+        h = eng.submit(p, 8, eos_token_id=int(eos))
+        eng.run(max_steps=2000)
+        assert h.finish_reason is FinishReason.EOS
+        assert len(h.output_tokens) == stop_at
+        assert h.output_tokens[-1] == eos
+        leaks = eng.leak_check()
+        assert leaks["free_pages"] == leaks["total_pages"]
+
+    def test_eager_serving_matches_compiled(self, model):
+        """compiled=False runs the same step bodies eagerly over the
+        host-numpy cache metadata (regression: `.at[]` on numpy)."""
+        from paddle_tpu.serving import ServingEngine
+
+        def serve(compiled):
+            eng = ServingEngine(model, max_slots=2, max_len=48,
+                                page_size=8, chunk_size=8,
+                                compiled=compiled)
+            hs = [eng.submit(p, 4) for p in _prompts(2, seed=12)]
+            eng.run(max_steps=2000)
+            return [h.output_tokens for h in hs]
+
+        assert serve(True) == serve(False)
+
+    def test_eager_paged_generate(self, model):
+        """GenerationEngine(kind='paged', compiled=False) still works
+        with the host-numpy page tables (regression)."""
+        from paddle_tpu.jit.decode_step import GenerationEngine
+
+        prompt = _prompts(1, seed=13)[0]
+        eager = GenerationEngine(model, kind="paged", batch=1,
+                                 max_len=48, page_size=8,
+                                 compiled=False)
+        out = eager.generate(prompt[None].astype(np.int64), 5)
+        ref = model.generate(np.asarray(prompt)[None], max_new_tokens=5,
+                             use_cache="paged")
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+    def test_seed_full_width(self, model):
+        """Seeds are not masked to 31 bits: s and s + 2**31 are
+        distinct RNG streams, and the same seed reproduces."""
+        from paddle_tpu.serving import ServingEngine
+
+        def toks(seed):
+            eng = ServingEngine(model, max_slots=1, max_len=48,
+                                page_size=8, chunk_size=8,
+                                do_sample=True, temperature=1.0)
+            h = eng.submit(_prompts(1, seed=15)[0], 8, seed=seed)
+            eng.run(max_steps=1000)
+            return h.output_tokens
+
+        base = toks(123)
+        assert toks(123) == base
+        assert toks(123 + 2 ** 31) != base
+
+    def test_submit_validation(self, model):
+        from paddle_tpu.serving import ServingEngine
+
+        eng = ServingEngine(model, max_slots=2, max_len=32, page_size=8,
+                            chunk_size=8)
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit(np.zeros((0,), np.int32), 4)
+        with pytest.raises(ValueError, match="max_len"):
+            eng.submit(np.ones((30,), np.int32), 8)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit(np.ones((4,), np.int32), 0)
