@@ -92,13 +92,15 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
                          moment_dtype=moment_dtype,
                          offload_master_weights=offload_masters)
 
-    fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
+    # fused CE (vocab-tiled streaming kernel, ISSUE 7) defaults ON: the
+    # [tokens, vocab] logits no longer exist in the head/loss path.
+    # BENCH_FUSED_CE=0 restores the dense criterion path; on the
+    # fused-scan step the head routing is BENCH_FUSED_HEAD (also ON).
+    fused_ce = os.environ.get("BENCH_FUSED_CE", "1") == "1"
+    fused_head = os.environ.get(
+        "BENCH_FUSED_HEAD", "1" if fused_ce else "0") == "1"
     su = lc = None
     if fused_scan:
-        if fused_ce:
-            print("[bench] BENCH_FUSED_CE ignored: the fused-scan step "
-                  "uses the criterion path (BENCH_FUSED_HEAD=1 is its "
-                  "chunked-CE lever)", file=sys.stderr)
         from paddle_tpu.jit import FusedScanTrainStep
 
         # scan granularity: explicit arg > env > the code-hash-validated
@@ -119,14 +121,14 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
         su, lc = su or 1, lc or 1
         step = FusedScanTrainStep(
             model, opt, criterion=crit,
-            fused_head=os.environ.get("BENCH_FUSED_HEAD", "0") == "1",
+            fused_head=fused_head,
             compute_dtype="bfloat16",
             layer_chunk=lc, scan_unroll=su)
     else:
         if fused_ce:
-            # fused LM head: chunked logsumexp, no [tokens, vocab] logits
-            # at all. Measured slower than the dense lse-CE path at every
-            # config that fits (PERF.md) — opt-in for regimes that don't
+            # fused LM head (model.loss → fused_linear_cross_entropy):
+            # vocab-tiled streaming CE by default (FLAGS_fused_ce), no
+            # [tokens, vocab] logits in forward or backward
             def loss_fn(m, ids, labels):
                 return m.loss(ids, labels)
         else:
@@ -172,6 +174,27 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
     # MFU: model flops per token = 6N (fwd+bwd matmuls) + attention
     # 12*L*h*s (QK^T + PV, fwd+bwd, causal ~halves but count full per
     # PaLM-appendix convention); peak from the chip generation.
+    # training-kernel routing actually in effect for this run (ISSUE 7
+    # acceptance keys): fused_ce = the head/loss path streams vocab
+    # tiles (no [tokens, vocab] logits); splash_attn = the splash
+    # Pallas kernel serves the training attention on this chip/config
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import splash_attention as _splash
+    from paddle_tpu.utils import flags as _flags
+
+    ce_active = bool(_flags.get_flag("FLAGS_fused_ce")) and (
+        fused_head if fused_scan else fused_ce)
+    # mirror the FULL scaled_dot_product_attention routing gates (incl.
+    # the min-seqlen threshold and no-dropout requirement), not just the
+    # kernel capability — the record must only say true when the splash
+    # kernel actually serves this run's attention
+    splash_active = (
+        _splash.kernel_active(
+            (batch, seq, cfg.num_attention_heads,
+             cfg.hidden_size // cfg.num_attention_heads),
+            cfg.num_attention_heads, jnp.bfloat16)
+        and seq >= int(_flags.get_flag("FLAGS_pallas_flash_min_seqlen"))
+        and not cfg.attention_dropout_prob)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops_per_token = (6 * n_params
                        + 12 * cfg.num_layers * cfg.hidden_size * seq)
@@ -200,7 +223,8 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
                    "fused_scan": fused_scan,
                    "scan_unroll": su if fused_scan else None,
                    "layer_chunk": lc if fused_scan else None,
-                   "fused_ce": fused_ce and not fused_scan},
+                   "fused_ce": ce_active,
+                   "splash_attn": splash_active},
     }
 
 
@@ -596,6 +620,19 @@ def run_selftest():
         assert rec.get("check") == "pass", rec
         results["input_pipeline_detail"] = rec
 
+    def training_kernels():
+        # ISSUE 7: splash training attention + vocab-tiled fused CE —
+        # interpret-mode kernels == XLA fallbacks == dense references
+        # (fwd + bwd, causal/GQA/segment masks), segment attention ==
+        # per-document dense attention, fused-scan step parity vs the
+        # unfused path with the kernels engaged, compile_count == 1,
+        # and the HLO probe: no [tokens, vocab] / [b, h, s, s] buffer
+        # in the compiled train step
+        rec = _run_cpu_probe("paddle_tpu.ops.pallas.training_selftest",
+                             n_devices=1, timeout=900)
+        assert rec.get("check") == "pass", rec
+        results["training_kernels_detail"] = rec
+
     def serving():
         # ISSUE 6: continuous-batching serving tier — Poisson arrivals
         # on a tiny model: per-request token parity vs generate(),
@@ -619,6 +656,7 @@ def run_selftest():
     check("fault_tolerance", fault_tolerance)
     check("input_pipeline", input_pipeline)
     check("serving", serving)
+    check("training_kernels", training_kernels)
     return results
 
 
@@ -1033,6 +1071,14 @@ if __name__ == "__main__":
             {"serving": _run_cpu_probe("paddle_tpu.serving.selftest",
                                        extra_args=("--bench",),
                                        n_devices=1, timeout=900)}))
+    elif "--training-kernels" in sys.argv:
+        # TRAINING-KERNELS lane (ISSUE 7): splash attention + fused CE
+        # interpret-mode parity (fwd+bwd, segment masks), scan-step
+        # integration, HLO no-logits/no-scores probe — hermetic CPU
+        print(json.dumps(
+            {"training_kernels":
+             _run_cpu_probe("paddle_tpu.ops.pallas.training_selftest",
+                            n_devices=1, timeout=900)}))
     elif "--selftest" in sys.argv:
         _setup_jax()
         print(json.dumps({"selftest": run_selftest()}))

@@ -469,9 +469,18 @@ class FusedScanTrainStep:
                 g32 = g32 * scale
             return g32
 
-        def step_fn(state, lr, ids, labels):
+        from ..nn.functional.flash_attention import attention_segments
+
+        def step_fn(state, lr, ids, labels, seg=None):
             s, o = state["s"], state["o"]
             saved_buf = self._bind(self._buffers, state["buf"])
+            # publish packed-sequence segment ids to every attention
+            # layer traced in this step (forward scan, the norm/guard
+            # pre-pass, and the backward recompute all see the same
+            # traced value — the vjp replays attention with the same
+            # mask the forward used)
+            seg_ctx = attention_segments(seg)
+            seg_ctx.__enter__()
             try:
                 gst = state.get("guard")
                 # loss-scale: seed the head cotangent with the traced
@@ -719,6 +728,7 @@ class FusedScanTrainStep:
                     new_state["guard"] = guard.update(gst, found)
                 return loss, new_state
             finally:
+                seg_ctx.__exit__(None, None, None)
                 self._bind(self._buffers, saved_buf)
 
         self._jitted = jax.jit(step_fn,
@@ -740,9 +750,11 @@ class FusedScanTrainStep:
             opt._get_accumulator("moment2", p, dtype=opt._moment_dtype)
         self._build()
 
-    def __call__(self, ids, labels):
+    def __call__(self, ids, labels, segment_ids=None):
         ids_d = ids._data if isinstance(ids, Tensor) else ids
         lab_d = labels._data if isinstance(labels, Tensor) else labels
+        seg_d = (segment_ids._data if isinstance(segment_ids, Tensor)
+                 else segment_ids)
         if self._jitted is None:
             self.ensure_built()
         if not self._canon_done:
@@ -758,7 +770,8 @@ class FusedScanTrainStep:
         state = self._extract_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         with RecordEvent("FusedScanTrainStep"):
-            loss, new_state = self._jitted(state, lr, ids_d, lab_d)
+            loss, new_state = self._jitted(state, lr, ids_d, lab_d,
+                                           seg_d)
         self._inject_state(new_state)
         sched = getattr(self._opt, "_learning_rate", None)
         if hasattr(sched, "step"):

@@ -445,9 +445,15 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 g32 = g32 + l2 * pv.astype(jnp.float32)
             return opt._adam_math(pv, g32, m, v, None, lr_lrs, tf, wd)
 
-        def step_fn(state, lr, ids, labels):
+        from ..nn.functional.flash_attention import attention_segments
+
+        def step_fn(state, lr, ids, labels, seg=None):
             s, o = state["s"], state["o"]
             saved_buf = self._bind(self._buffers, state["buf"])
+            # packed-sequence segment ids (local batch rows, sharded
+            # like ids) published to the in-scan attention layers
+            seg_ctx = attention_segments(seg)
+            seg_ctx.__enter__()
             try:
                 gst = state.get("guard")
                 inv_s = (1.0 / gst["scale"]) if scaling else None
@@ -694,24 +700,27 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                     new_state["guard"] = guard.update(gst, found)
                 return lax.psum(loss, ax) * inv_n, new_state
             finally:
+                seg_ctx.__exit__(None, None, None)
                 self._bind(self._buffers, saved_buf)
 
         specs = self._state_specs()
         batch_spec = P(ax, None)
+        # the trailing batch_spec covers the optional segment-id arg —
+        # a None there is an empty pytree, so the spec binds no leaves
         wrapped = jax.shard_map(
             step_fn, mesh=mesh,
-            in_specs=(specs, P(), batch_spec, batch_spec),
+            in_specs=(specs, P(), batch_spec, batch_spec, batch_spec),
             out_specs=(P(), specs), check_vma=False)
         self._jitted = jax.jit(wrapped,
                                donate_argnums=_donate_argnums())
 
-    def __call__(self, ids, labels):
+    def __call__(self, ids, labels, segment_ids=None):
         shape = getattr(ids, "shape", None)
         if shape and shape[0] % self._degree:
             raise ValueError(
                 f"global batch {shape[0]} is not divisible by the "
                 f"{self._axis!r} degree {self._degree}")
-        return super().__call__(ids, labels)
+        return super().__call__(ids, labels, segment_ids=segment_ids)
 
 
 # ---------------------------------------------------------------------------
@@ -802,4 +811,4 @@ def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1):
                       jnp.int32)
     labels = jnp.asarray(rng.integers(0, cfg.vocab_size,
                                       (n_devices, 16)), jnp.int32)
-    return step._jitted.lower(state, lr, ids, labels)
+    return step._jitted.lower(state, lr, ids, labels, None)

@@ -263,17 +263,22 @@ class GPTAttention(nn.Layer):
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]                               # [b, s, nh, hd]
         ring_mesh = self._ring_mesh()
-        # ring requirements: seq divisible by the ring, and no attention
-        # dropout (the ring kernel has no dropout plumbing) — otherwise
-        # fall back to the dense path rather than diverge or crash
+        # packed-sequence segment ids published by GPTModel.forward
+        # (attention_segments context): each document attends only
+        # itself — routed through the splash kernel / its XLA fallback
+        seg = F.current_segment_ids()
+        # ring requirements: seq divisible by the ring, no attention
+        # dropout (the ring kernel has no dropout plumbing), and no
+        # segment mask — otherwise fall back to the dense path rather
+        # than diverge or crash
         drop_active = self.dropout_p > 0.0 and self.training
-        if (ring_mesh is not None and not drop_active
+        if (ring_mesh is not None and not drop_active and seg is None
                 and s % int(ring_mesh.shape["sep"]) == 0):
             out = self._ring_attention(q, k, v, ring_mesh)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True, dropout_p=self.dropout_p,
-                training=self.training,
+                training=self.training, segment_ids=seg,
             )                                           # [b, s, nh, hd]
         out = out.reshape([b, s, h])
         return self.out_proj(out)
@@ -479,17 +484,22 @@ class GPTModel(nn.Layer):
                     # GPT-2 residual-scaled init
                     p._data = p._data / math.sqrt(2.0 * config.num_layers)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, segment_ids=None):
+        """`segment_ids` ([b, s] int) marks packed-sequence document
+        boundaries: published to every attention layer for this forward
+        (attention_segments context), so tokens attend only within
+        their own document. None = plain causal attention."""
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = C.arange(0, s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
-        if self.config.scan_layers:
-            x = self.blocks(x)
-        else:
-            for block in self.blocks:
-                x = block(x)
+        with F.attention_segments(segment_ids):
+            if self.config.scan_layers:
+                x = self.blocks(x)
+            else:
+                for block in self.blocks:
+                    x = block(x)
         return self.ln_f(x)
 
     def _check_decodable(self):
@@ -564,8 +574,9 @@ class GPTForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids, position_ids=None):
-        return self.head(self.gpt(input_ids, position_ids))
+    def forward(self, input_ids, position_ids=None, segment_ids=None):
+        return self.head(self.gpt(input_ids, position_ids,
+                                  segment_ids=segment_ids))
 
     def head(self, hidden):
         """LM head over hiddens [..., hidden] -> logits [..., vocab]."""
@@ -649,12 +660,16 @@ class GPTForCausalLM(nn.Layer):
         planner (distributed/auto_parallel/planner.py)."""
         return gpt_sharding_rules(tp_axis=tp_axis, fsdp_axis=fsdp_axis)
 
-    def loss(self, input_ids, labels, loss_mask=None, position_ids=None):
+    def loss(self, input_ids, labels, loss_mask=None, position_ids=None,
+             segment_ids=None):
         """Training loss via the fused LM head: hidden states go straight
-        into F.fused_linear_cross_entropy, so the [tokens, vocab] logits are
-        never materialized (chunked logsumexp + recompute-in-backward).
-        Numerically equal to GPTPretrainingCriterion(self(ids), labels)."""
-        hidden = self.gpt(input_ids, position_ids)
+        into F.fused_linear_cross_entropy, so the [tokens, vocab] logits
+        are never materialized (vocab-tiled streaming CE by default —
+        FLAGS_fused_ce — else chunked logsumexp). `segment_ids` packs
+        multiple documents per row (see GPTModel.forward). Numerically
+        equal to GPTPretrainingCriterion(self(ids), labels)."""
+        hidden = self.gpt(input_ids, position_ids,
+                          segment_ids=segment_ids)
         if self.lm_head is None:
             w, t_y = self.gpt.wte.weight, True
         else:

@@ -16,6 +16,8 @@ from .flash_attention import (  # noqa: F401
     scaled_dot_product_attention,
     flash_attention,
     flash_attn_unpadded,
+    attention_segments,
+    current_segment_ids,
 )
 from .sampling import (  # noqa: F401
     greedy_sample,

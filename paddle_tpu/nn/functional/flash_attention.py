@@ -11,6 +11,8 @@ Layouts follow the reference: q/k/v are [batch, seqlen, num_heads, head_dim].
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
@@ -18,6 +20,29 @@ from ...framework.tensor import Tensor
 from ...framework.random import next_key
 from ...ops._dispatch import nary, ensure_tensor
 
+
+# -- packed-sequence segment context ----------------------------------------
+# Layers deep inside a model (GPTAttention under the scan template) have
+# no signature room for per-batch segment ids; the model's outer forward
+# publishes them here for the duration of its trace and attention layers
+# pick them up. The value is a [batch, seq] int Tensor/array (tokens
+# attend only within their own segment) or None (dense attention).
+_segment_ctx = [None]
+
+
+@contextlib.contextmanager
+def attention_segments(segment_ids):
+    """Publish packed-sequence segment ids to every attention layer
+    traced inside the block (None = plain dense/causal attention)."""
+    _segment_ctx.append(segment_ids)
+    try:
+        yield
+    finally:
+        _segment_ctx.pop()
+
+
+def current_segment_ids():
+    return _segment_ctx[-1]
 
 
 def _sdpa_ref(q, k, v, mask, scale, causal, dropout_p, key):
@@ -61,7 +86,13 @@ def _sdpa_ref(q, k, v, mask, scale, causal, dropout_p, key):
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, name=None):
+                                 is_causal=False, training=True,
+                                 segment_ids=None, name=None):
+    """`segment_ids` ([batch, seq] int, or unset to consult the ambient
+    `attention_segments` context) restricts attention to within-segment
+    pairs — the packed-sequence training mask. Routed through the splash
+    kernel (TPU) or its XLA fallback; with dropout active it lowers to a
+    dense boolean mask instead."""
     query = ensure_tensor(query)
     key_t = ensure_tensor(key)
     value = ensure_tensor(value)
@@ -71,11 +102,71 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     rng = next_key() if drop > 0.0 else None
 
     from ...ops.pallas import flash_attention as pallas_flash
+    from ...ops.pallas import splash_attention as pallas_splash
     from ...utils import flags as _flags
 
     seqlen = query.shape[1]
     min_seq = int(_flags.get_flags(["FLAGS_pallas_flash_min_seqlen"])
                   ["FLAGS_pallas_flash_min_seqlen"])
+
+    if segment_ids is None:
+        segment_ids = current_segment_ids()
+    splash_on = bool(_flags.get_flag("FLAGS_splash_attn"))
+    force_interp = bool(_flags.get_flag("FLAGS_pallas_force_interpret"))
+    kvh = key_t.shape[2]
+
+    if segment_ids is not None and attn_mask is not None:
+        # combining an arbitrary user mask with the document-isolation
+        # mask is not plumbed; dropping either silently would train
+        # across document boundaries (or without the user's mask)
+        raise ValueError(
+            "scaled_dot_product_attention got both attn_mask and "
+            "segment_ids (explicit or via attention_segments): the "
+            "masks are not combinable — fold the segment mask into "
+            "attn_mask yourself, or drop one")
+
+    if segment_ids is not None:
+        seg = ensure_tensor(segment_ids)
+        if splash_on and drop == 0.0:
+            # splash owns the segment mask: fused into the score tiles
+            # on TPU (or interpret mode), dense-equivalent XLA fallback
+            # elsewhere — no [s, s] mask tensor either way
+            interp = True if force_interp else None
+
+            def f_seg(q, k, v, s):
+                return pallas_splash.splash_attention(
+                    q, k, v, causal=is_causal, segment_ids=s,
+                    scale=scale, interpret=interp)
+
+            return nary(f_seg, [query, key_t, value, seg],
+                        "splash_attention_segments")
+        # dropout (or splash off): lower segments to a dense bool mask
+        segd = seg.astype("int32")
+
+        def f_mask(q, k, v, s):
+            m = (s[:, None, :, None] == s[:, None, None, :])
+            return _sdpa_ref(q, k, v, m, scale, is_causal, drop, rng)
+
+        return nary(f_mask, [query, key_t, value, segd],
+                    "sdpa_segment_mask")
+
+    # splash takes the long-seq training slot ahead of flash: same
+    # routing conditions, tiled fwd + stats-recompute bwd, GQA-capable
+    use_splash = (
+        splash_on and seqlen >= min_seq and attn_mask is None
+        and drop == 0.0
+        and pallas_splash.supports(tuple(query.shape), kvh,
+                                   query._data.dtype)
+        and (force_interp or pallas_splash._on_tpu())
+    )
+    if use_splash:
+        interp = True if force_interp else None
+        return nary(
+            lambda q, k, v: pallas_splash.splash_attention(
+                q, k, v, causal=is_causal, scale=scale,
+                interpret=interp),
+            [query, key_t, value], "splash_attention")
+
     use_pallas = (
         seqlen >= min_seq and attn_mask is None and drop == 0.0
         and query.shape == key_t.shape == value.shape

@@ -23,6 +23,14 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                   name=None):
     """softmax_with_cross_entropy parity. Computed in fp32 via log_softmax
     (numerically-stable fused form — XLA fuses the exp/sum/sub chain)."""
+    if soft_label and ignore_index != -100:
+        # reference cross_entropy raises here (python/paddle/nn/functional/
+        # loss.py): with soft labels there is no integer class to compare
+        # against ignore_index, silently ignoring it would hide a bug
+        raise ValueError(
+            "When soft_label == True, the value of ignore_index should "
+            f"be -100 (got {ignore_index}): ignore_index is only usable "
+            "with hard (integer) labels")
     input = ensure_tensor(input)
     label = ensure_tensor(label)
 
@@ -476,9 +484,20 @@ _fused_linear_ce.defvjp(_fused_linear_ce_fwd, _fused_linear_ce_bwd)
 
 def fused_linear_cross_entropy(hidden, weight, labels, transpose_y=True,
                                ignore_index=-100, reduction="mean",
-                               n_chunks=None, name=None):
-    """Cross entropy of `softmax(hidden @ weight)` computed chunkwise so the
-    full logits matrix never hits HBM (see module comment above).
+                               n_chunks=None, vocab_tiled=None,
+                               name=None):
+    """Cross entropy of `softmax(hidden @ weight)` with the full logits
+    matrix never hitting HBM. Two fused implementations:
+
+    * **vocab-tiled streaming** (default, `FLAGS_fused_ce`): logits
+      stream through vocab tiles — online logsumexp + gathered label
+      logit in forward, d_logits folded into dhidden/dweight per tile in
+      backward (ops/pallas/fused_cross_entropy.py — Pallas kernel on
+      TPU, lax.scan tiles elsewhere). No [tokens, vocab] array exists in
+      either pass.
+    * **token-chunked logsumexp** (flag off, or `vocab_tiled=False`):
+      the round-4 scheme — full-vocab logits per token chunk, discarded
+      after reduction (see module comment above; FLAGS_fused_ce_chunks).
 
     hidden: [..., H] activations; weight: [V, H] (transpose_y=True — the
     tied-embedding layout) or [H, V]; labels: int [...] matching hidden's
@@ -493,12 +512,26 @@ def fused_linear_cross_entropy(hidden, weight, labels, transpose_y=True,
         n_chunks = int(_flags.get_flags(["FLAGS_fused_ce_chunks"])
                        ["FLAGS_fused_ce_chunks"])
     n_chunks = max(1, int(n_chunks))
+    if vocab_tiled is None:
+        vocab_tiled = bool(_flags.get_flag("FLAGS_fused_ce"))
+    force_interp = bool(_flags.get_flag("FLAGS_pallas_force_interpret"))
 
     def f(h, w, lbl):
         hsz = h.shape[-1]
-        losses = _fused_linear_ce(h.reshape(-1, hsz), w,
-                                  lbl.reshape(-1).astype(jnp.int32),
-                                  transpose_y, ignore_index, n_chunks)
+        flat_h = h.reshape(-1, hsz)
+        flat_l = lbl.reshape(-1).astype(jnp.int32)
+        if vocab_tiled:
+            from ...ops.pallas import fused_cross_entropy as _fce
+
+            # kernel layout is [vocab, hidden]; an [H, V] head transposes
+            # outside (AD routes dweight back through the transpose)
+            w_vh = w if transpose_y else w.T
+            losses = _fce.fused_cross_entropy(
+                flat_h, w_vh, flat_l, ignore_index=ignore_index,
+                interpret=True if force_interp else None)
+        else:
+            losses = _fused_linear_ce(flat_h, w, flat_l, transpose_y,
+                                      ignore_index, n_chunks)
         if reduction == "none":
             return losses.reshape(lbl.shape)
         if reduction == "sum":

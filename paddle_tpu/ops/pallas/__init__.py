@@ -7,4 +7,6 @@ TPU-first: the hot fused ops are hand-written Pallas kernels over the MXU
 left to XLA fusion.
 """
 from . import flash_attention  # noqa: F401
+from . import fused_cross_entropy  # noqa: F401
 from . import paged_attention  # noqa: F401
+from . import splash_attention  # noqa: F401

@@ -1,0 +1,352 @@
+"""Hermetic parity selftest for the training kernels (ISSUE 7).
+
+Run under a cpu-forced env (bench.py's stripped subprocess /
+tools/cpu_env.sh):
+
+    python -m paddle_tpu.ops.pallas.training_selftest
+
+Asserts, on one CPU process with the kernels in interpret mode:
+
+* **splash attention**: interpret-mode kernel == XLA fallback == dense
+  reference, forward AND backward, across causal/non-causal, GQA, and
+  segment-id configs; packed-sequence segment attention == running each
+  document through dense attention separately (logits and grads).
+* **fused cross entropy**: interpret-mode kernel == XLA vocab-tiled
+  fallback == unfused dense CE (loss, dhidden, dweight).
+* **scan-step integration**: a tiny FusedScanTrainStep with BOTH
+  kernels engaged (FLAGS_pallas_force_interpret) trains bit-close to
+  the eager TrainStep on the stock dense paths — loss trajectory and
+  final params at fp32 tolerance — and compiles exactly once.
+* **HLO probe**: the compiled fused train step contains NO
+  [tokens, vocab]-shaped buffer (the logits never exist) and NO
+  [b, heads, s, s] buffer (the attention scores never exist).
+
+Prints ONE JSON line with the measured deviations so the tolerances
+land verbatim in BENCH_r*.json.
+"""
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+import numpy as np
+
+TOL = {
+    "attn_fwd": 3e-5,
+    "attn_bwd": 5e-4,
+    "ce_loss": 1e-4,
+    "ce_grad": 2e-4,
+    "step_loss": 5e-4,
+    "step_param_rel": 5e-3,
+}
+
+
+def _maxdiff(a, b):
+    import jax.numpy as jnp
+
+    return float(jnp.max(jnp.abs(jnp.asarray(a, jnp.float32)
+                                 - jnp.asarray(b, jnp.float32))))
+
+
+def splash_parity():
+    """Interpret kernel vs XLA fallback, fwd + grads, across configs."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import splash_attention as sa
+
+    rng = np.random.default_rng(0)
+    worst = {"fwd": 0.0, "bwd": 0.0}
+    for (b, s, h, kvh, causal, docs) in [
+        (2, 256, 2, 2, True, 0),
+        (1, 256, 4, 2, True, 0),      # GQA
+        (2, 256, 2, 2, False, 0),
+        (2, 256, 2, 1, True, 3),      # segments + GQA
+        (1, 128, 2, 2, True, 2),      # single-tile + segments
+    ]:
+        d = 32
+        mk = lambda hh: jnp.asarray(  # noqa: E731
+            rng.standard_normal((b, s, hh, d)) * 0.5, jnp.float32)
+        q, k, v = mk(h), mk(kvh), mk(kvh)
+        seg = None
+        if docs:
+            bounds = np.sort(rng.integers(1, s, docs - 1))
+            seg = jnp.asarray(np.broadcast_to(
+                np.searchsorted(bounds, np.arange(s), side="right"),
+                (b, s)).copy(), jnp.int32)
+
+        def lk(q, k, v):
+            return jnp.sum(jnp.sin(sa.splash_attention(
+                q, k, v, causal=causal, segment_ids=seg,
+                interpret=True)))
+
+        def lx(q, k, v):
+            return jnp.sum(jnp.sin(sa.splash_attention_xla(
+                q, k, v, causal=causal, segment_ids=seg)))
+
+        ok = sa.splash_attention(q, k, v, causal=causal,
+                                 segment_ids=seg, interpret=True)
+        ox = sa.splash_attention_xla(q, k, v, causal=causal,
+                                     segment_ids=seg)
+        worst["fwd"] = max(worst["fwd"], _maxdiff(ok, ox))
+        gk = jax.grad(lk, (0, 1, 2))(q, k, v)
+        gx = jax.grad(lx, (0, 1, 2))(q, k, v)
+        worst["bwd"] = max(worst["bwd"],
+                           *[_maxdiff(a, bb) for a, bb in zip(gk, gx)])
+    assert worst["fwd"] < TOL["attn_fwd"], worst
+    assert worst["bwd"] < TOL["attn_bwd"], worst
+    return worst
+
+
+def segment_docs():
+    """Packed segments == per-document dense attention (out + grads)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import splash_attention as sa
+
+    rng = np.random.default_rng(1)
+    b, s, h, d = 1, 256, 2, 32
+    lens = [96, 64, 96]
+    mk = lambda ss: jnp.asarray(  # noqa: E731
+        rng.standard_normal((b, ss, h, d)) * 0.5, jnp.float32)
+    q, k, v = mk(s), mk(s), mk(s)
+    seg = jnp.asarray(np.repeat(np.arange(len(lens)), lens)[None],
+                      jnp.int32)
+
+    def packed(q, k, v):
+        return sa.splash_attention(q, k, v, causal=True,
+                                   segment_ids=seg, interpret=True)
+
+    def perdoc(q, k, v):
+        outs, off = [], 0
+        for ln in lens:
+            sl = slice(off, off + ln)
+            outs.append(sa.splash_attention_xla(
+                q[:, sl], k[:, sl], v[:, sl], causal=True))
+            off += ln
+        return jnp.concatenate(outs, axis=1)
+
+    fwd = _maxdiff(packed(q, k, v), perdoc(q, k, v))
+    gk = jax.grad(lambda *a: jnp.sum(jnp.sin(packed(*a))), (0, 1, 2))(
+        q, k, v)
+    gx = jax.grad(lambda *a: jnp.sum(jnp.sin(perdoc(*a))), (0, 1, 2))(
+        q, k, v)
+    bwd = max(_maxdiff(a, bb) for a, bb in zip(gk, gx))
+    assert fwd < TOL["attn_fwd"] and bwd < TOL["attn_bwd"], (fwd, bwd)
+    return {"fwd": fwd, "bwd": bwd}
+
+
+def fused_ce_parity():
+    """Interpret kernel == XLA tiles == unfused dense CE (loss+grads)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import fused_cross_entropy as fce
+
+    rng = np.random.default_rng(2)
+    n, H, V, ii = 100, 32, 384, -1      # n%bn != 0: exercises padding
+    h = jnp.asarray(rng.standard_normal((n, H)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((V, H)) * 0.1, jnp.float32)
+    lbl = rng.integers(0, V, (n,))
+    lbl[::7] = ii
+    lbl = jnp.asarray(lbl, jnp.int32)
+
+    def dense(h, w):
+        logits = h @ w.T
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        safe = jnp.where(lbl == ii, 0, lbl)
+        picked = jnp.take_along_axis(logits, safe[:, None], -1)[:, 0]
+        return jnp.sum(jnp.sin(jnp.where(lbl != ii, lse - picked, 0.0)))
+
+    def kern(h, w):
+        return jnp.sum(jnp.sin(fce.fused_cross_entropy(
+            h, w, lbl, ignore_index=ii, interpret=True)))
+
+    def xla(h, w):
+        return jnp.sum(jnp.sin(fce.fused_cross_entropy(
+            h, w, lbl, ignore_index=ii, use_kernel=False)))
+
+    lk, lx, ld = kern(h, w), xla(h, w), dense(h, w)
+    worst = {"loss": max(_maxdiff(lk, lx), _maxdiff(lk, ld)), "grad": 0.0}
+    gk = jax.grad(kern, (0, 1))(h, w)
+    gx = jax.grad(xla, (0, 1))(h, w)
+    gd = jax.grad(dense, (0, 1))(h, w)
+    for a, bb, c in zip(gk, gx, gd):
+        worst["grad"] = max(worst["grad"], _maxdiff(a, bb),
+                            _maxdiff(a, c))
+    assert worst["loss"] < TOL["ce_loss"], worst
+    assert worst["grad"] < TOL["ce_grad"], worst
+    return worst
+
+
+TINY = dict(vocab_size=384, hidden_size=32, num_layers=2,
+            num_attention_heads=2, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+def _train(kind, steps, ids, labels, lr=1e-2, **cfg_over):
+    """Both kinds train the SAME scan_layers architecture (identical
+    init draws); only the step machinery differs — eager TrainStep over
+    the generic scan forward vs the fused in-scan-update step."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from ...models import GPTConfig, GPTForCausalLM, \
+        GPTPretrainingCriterion
+
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(scan_layers=True,
+                                     **{**TINY, **cfg_over}))
+    opt = popt.AdamW(learning_rate=lr, parameters=model.parameters())
+    if kind == "fused":
+        from ...jit import FusedScanTrainStep
+
+        step = FusedScanTrainStep(model, opt, fused_head=True)
+    else:
+        from ...jit import TrainStep
+
+        crit = GPTPretrainingCriterion()
+        step = TrainStep(model, lambda m, a, b: crit(m(a), b), opt)
+    losses = [float(step(ids, labels)) for _ in range(steps)]
+    return model, step, losses
+
+
+def scan_step_integration(steps=3):
+    """FusedScanTrainStep with both kernels engaged (interpret mode) ==
+    eager TrainStep on the stock dense paths, at fp32 tolerance;
+    compile_count == 1 for the fused step."""
+    import paddle_tpu as paddle
+    from ...utils import flags as _flags
+
+    rng = np.random.default_rng(3)
+    b, s = 2, 128
+    ids = paddle.to_tensor(rng.integers(0, TINY["vocab_size"], (b, s)),
+                           dtype="int64")
+    labels = paddle.to_tensor(
+        rng.integers(0, TINY["vocab_size"], (b, s)), dtype="int64")
+
+    saved = {k: _flags.get_flag(k) for k in
+             ("FLAGS_splash_attn", "FLAGS_fused_ce",
+              "FLAGS_pallas_force_interpret",
+              "FLAGS_pallas_flash_min_seqlen")}
+    try:
+        # kernels ON, interpret-forced so the CPU lane runs the real
+        # kernel code paths (not the XLA fallbacks)
+        _flags.set_flags({"FLAGS_splash_attn": True,
+                          "FLAGS_fused_ce": True,
+                          "FLAGS_pallas_force_interpret": True,
+                          "FLAGS_pallas_flash_min_seqlen": 128})
+        m_f, step_f, loss_f = _train("fused", steps, ids, labels)
+        cache = step_f._jitted._cache_size()
+        # kernels OFF: the stock dense attention + dense-logits CE path
+        _flags.set_flags({"FLAGS_splash_attn": False,
+                          "FLAGS_fused_ce": False,
+                          "FLAGS_pallas_force_interpret": False})
+        m_e, _, loss_e = _train("eager", steps, ids, labels)
+    finally:
+        _flags.set_flags(saved)
+
+    worst_loss = max(abs(a - bb) for a, bb in zip(loss_f, loss_e))
+    worst_p = 0.0
+    pe = dict(m_e.named_parameters())
+    for name, p in m_f.named_parameters():
+        q = pe[name]
+        num = _maxdiff(p._data, q._data)
+        den = max(float(abs(np.asarray(q._data)).max()), 1e-6)
+        worst_p = max(worst_p, num / den)
+    assert cache == 1, f"fused step compiled {cache}x"
+    assert worst_loss < TOL["step_loss"], worst_loss
+    assert worst_p < TOL["step_param_rel"], worst_p
+    return {"loss_abs": worst_loss, "param_rel": worst_p,
+            "compile_count": cache}
+
+
+_SHAPE_RE = re.compile(r"(?:f32|f16|bf16|f64)\[([0-9,]+)\]")
+
+
+def forbidden_shapes(hlo_text, batch, seq, vocab):
+    """Buffers the ISSUE 7 memory claim forbids in the train step HLO:
+    logits-shaped (last dim == vocab with >= batch*seq rows behind it)
+    and attention-scores-shaped (>=3d trailing [seq, seq])."""
+    bad = []
+    for m in _SHAPE_RE.finditer(hlo_text):
+        dims = [int(x) for x in m.group(1).split(",") if x]
+        if len(dims) >= 2 and dims[-1] == vocab \
+                and int(np.prod(dims[:-1])) >= batch * seq:
+            bad.append(dims)
+        if len(dims) >= 3 and dims[-1] == seq and dims[-2] == seq:
+            bad.append(dims)
+    return bad
+
+
+def hlo_probe():
+    """Compile the fused train step with both kernels engaged and assert
+    the [tokens, vocab] logits and [b, h, s, s] scores never exist.
+    seq=256 here so score-shaped [s, s] is distinguishable from the
+    lane-replicated [*, 128] kernel stat planes."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from ...models import GPTConfig, GPTForCausalLM
+    from ...jit import FusedScanTrainStep
+    from ...utils import flags as _flags
+
+    b, s = 2, 256
+    saved = {k: _flags.get_flag(k) for k in
+             ("FLAGS_splash_attn", "FLAGS_fused_ce",
+              "FLAGS_pallas_force_interpret",
+              "FLAGS_pallas_flash_min_seqlen")}
+    try:
+        _flags.set_flags({"FLAGS_splash_attn": True,
+                          "FLAGS_fused_ce": True,
+                          "FLAGS_pallas_force_interpret": True,
+                          "FLAGS_pallas_flash_min_seqlen": 128})
+        paddle.seed(0)
+        model = GPTForCausalLM(GPTConfig(
+            scan_layers=True, **{**TINY, "max_position_embeddings": s}))
+        opt = popt.AdamW(learning_rate=1e-3,
+                         parameters=model.parameters())
+        step = FusedScanTrainStep(model, opt, fused_head=True)
+        step.ensure_built()
+        state = step._extract_state()
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, TINY["vocab_size"], (b, s)),
+                          jnp.int32)
+        text = step._jitted.lower(
+            state, jnp.float32(1e-3), ids, ids, None).compile().as_text()
+    finally:
+        _flags.set_flags(saved)
+    bad = forbidden_shapes(text, b, s, TINY["vocab_size"])
+    assert not bad, f"forbidden buffers in train-step HLO: {bad[:5]}"
+    # the probe must be able to FAIL: the dense path trips it
+    dense = forbidden_shapes(
+        f"fusion f32[{b},{s},{TINY['vocab_size']}] dummy", b, s,
+        TINY["vocab_size"])
+    assert dense, "probe self-check failed (dense logits not flagged)"
+    return {"buffers_checked": len(_SHAPE_RE.findall(text)),
+            "forbidden": 0}
+
+
+def _main():
+    lanes = [("splash_parity", splash_parity),
+             ("segment_docs", segment_docs),
+             ("fused_ce_parity", fused_ce_parity),
+             ("scan_step_integration", scan_step_integration),
+             ("hlo_probe", hlo_probe)]
+    out = {"tolerances": TOL}
+    ok = True
+    for name, fn in lanes:
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001 - selftest surface
+            ok = False
+            out[name] = f"FAIL: {type(e).__name__}: {e}"[:300]
+    out["check"] = "pass" if ok else "FAIL"
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

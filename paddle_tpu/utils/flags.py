@@ -96,6 +96,26 @@ define_flag("FLAGS_comm_quant", "",
             "scales on both the scatter and gather legs, ~4x less ICI "
             "bytes) or 'bf16' (~2x); '' (default) keeps full-precision "
             "payloads. Accumulation is fp32 in every mode")
+define_flag("FLAGS_splash_attn", True,
+            "route training attention (causal/plain, no mask, no "
+            "dropout) through the splash Pallas kernel "
+            "(ops/pallas/splash_attention.py: tiled online-softmax "
+            "fwd, stats-recompute bwd, GQA, segment IDs) on TPU when "
+            "the geometry qualifies, and packed-sequence segment "
+            "attention through it on every backend (XLA fallback off "
+            "TPU). Off restores the round-3 flash/XLA routing.")
+define_flag("FLAGS_fused_ce", True,
+            "route fused_linear_cross_entropy through the vocab-tiled "
+            "streaming CE (ops/pallas/fused_cross_entropy.py: Pallas "
+            "kernel on TPU, lax.scan tiles elsewhere) — the "
+            "[tokens, vocab] logits never exist in forward or "
+            "backward. Off restores the token-chunked logsumexp path "
+            "(FLAGS_fused_ce_chunks).")
+define_flag("FLAGS_pallas_force_interpret", False,
+            "testing: route the splash-attention / fused-CE Pallas "
+            "kernels in interpret mode even off-TPU, so hermetic CPU "
+            "lanes (training_kernels selftest, HLO probes) exercise "
+            "the kernel code paths instead of the XLA fallbacks")
 define_flag("FLAGS_pallas_flash_min_seqlen", 1024,
             "min seq len to route scaled_dot_product_attention to the "
             "pallas flash kernel. Measured on v5e (h16 d64 bf16, fwd+bwd "

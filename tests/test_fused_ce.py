@@ -97,3 +97,125 @@ def test_gpt_model_fused_loss_parity():
     got = model.loss(ids, labels, mask)
     np.testing.assert_allclose(float(got._data), float(want._data),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# vocab-tiled streaming CE (ops/pallas/fused_cross_entropy.py, ISSUE 7):
+# interpret-mode kernel == XLA tile scan == the unfused dense path, for
+# loss AND both gradients; plus the FLAGS_fused_ce routing surface.
+# ---------------------------------------------------------------------------
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import fused_cross_entropy as fce
+from paddle_tpu.utils import flags as _flags
+
+
+def _dense_ref(h, w, lbl, ii):
+    logits = h.astype(jnp.float32) @ w.astype(jnp.float32).T
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    safe = jnp.where(lbl == ii, 0, lbl)
+    picked = jnp.take_along_axis(logits, safe[:, None], -1)[:, 0]
+    return jnp.where(lbl != ii, lse - picked, 0.0)
+
+
+@pytest.mark.parametrize("n,vocab,ii", [(64, 256, -100), (100, 384, -1)])
+def test_vocab_tiled_kernel_parity(n, vocab, ii):
+    """Interpret kernel vs XLA tiles vs dense: loss, dhidden, dweight.
+    n=100 exercises the token-tile padding path."""
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.standard_normal((n, 32)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((vocab, 32)) * 0.1, jnp.float32)
+    lbl = rng.integers(0, vocab, (n,))
+    lbl[::5] = ii
+    lbl = jnp.asarray(lbl, jnp.int32)
+
+    def kern(h, w):
+        return jnp.sum(jnp.sin(fce.fused_cross_entropy(
+            h, w, lbl, ignore_index=ii, interpret=True)))
+
+    def xla(h, w):
+        return jnp.sum(jnp.sin(fce.fused_cross_entropy(
+            h, w, lbl, ignore_index=ii, use_kernel=False)))
+
+    def dense(h, w):
+        return jnp.sum(jnp.sin(_dense_ref(h, w, lbl, ii)))
+
+    lk, lx, ld = kern(h, w), xla(h, w), dense(h, w)
+    assert abs(float(lk) - float(lx)) < 1e-4
+    assert abs(float(lk) - float(ld)) < 1e-4
+    gk = jax.grad(kern, (0, 1))(h, w)
+    gx = jax.grad(xla, (0, 1))(h, w)
+    gd = jax.grad(dense, (0, 1))(h, w)
+    for a, b, c in zip(gk, gx, gd):
+        assert float(jnp.max(jnp.abs(a - b))) < 2e-4
+        assert float(jnp.max(jnp.abs(a - c))) < 2e-4
+
+
+def test_vocab_tiled_ignored_rows_zero_grads():
+    """An all-ignored batch must yield exactly zero dh/dw (the masked
+    cotangent can't leak the recomputed softmax term)."""
+    rng = np.random.default_rng(1)
+    h = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256, 8)), jnp.float32)
+    lbl = jnp.full((16,), -100, jnp.int32)
+    gh, gw = jax.grad(
+        lambda h, w: jnp.sum(fce.fused_cross_entropy(
+            h, w, lbl, interpret=True)), (0, 1))(h, w)
+    assert float(jnp.max(jnp.abs(gh))) == 0.0
+    assert float(jnp.max(jnp.abs(gw))) == 0.0
+
+
+def test_vocab_tiled_bf16():
+    rng = np.random.default_rng(2)
+    h = jnp.asarray(rng.standard_normal((32, 16)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((256, 16)) * 0.1, jnp.bfloat16)
+    lbl = jnp.asarray(rng.integers(0, 256, (32,)), jnp.int32)
+    got = fce.fused_cross_entropy(h, w, lbl, interpret=True)
+    want = _dense_ref(h, w, lbl, -100)
+    assert float(jnp.max(jnp.abs(got - want))) < 3e-2
+
+
+def test_fused_linear_ce_routing_flag():
+    """F.fused_linear_cross_entropy: FLAGS_fused_ce on (vocab-tiled) and
+    off (token-chunked) agree with each other and the unfused path —
+    both reductions, both weight layouts."""
+    hidden, weight, labels = _setup(n=37, h=16, v=53)
+    want = _unfused(hidden, weight, labels, "mean", -100)
+    for tiled in (True, False):
+        _flags.set_flags({"FLAGS_fused_ce": tiled})
+        try:
+            got = F.fused_linear_cross_entropy(hidden, weight, labels)
+            np.testing.assert_allclose(float(got._data),
+                                       float(want._data), rtol=2e-5,
+                                       atol=2e-5)
+            w_hv = paddle.to_tensor(np.asarray(weight._data).T.copy())
+            got_t = F.fused_linear_cross_entropy(hidden, w_hv, labels,
+                                                 transpose_y=False)
+            np.testing.assert_allclose(float(got_t._data),
+                                       float(want._data), rtol=2e-5,
+                                       atol=2e-5)
+        finally:
+            _flags.set_flags({"FLAGS_fused_ce": True})
+
+
+def test_supports_gate():
+    assert fce.supports(50304, 2048, jnp.bfloat16)   # the bench vocab
+    assert fce.supports(384, 32, jnp.float32)
+    assert not fce.supports(53, 32, jnp.float32)     # vocab % 128 != 0
+    assert not fce.supports(256, 32, jnp.int32)
+
+
+def test_cross_entropy_soft_label_ignore_index_raises():
+    """Reference parity regression (ISSUE 7 satellite): ignore_index has
+    no meaning for soft labels — the reference raises, we silently
+    ignored it."""
+    logits = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((4, 8)), dtype="float32")
+    soft = paddle.to_tensor(np.full((4, 8), 1 / 8), dtype="float32")
+    with pytest.raises(ValueError, match="ignore_index"):
+        F.cross_entropy(logits, soft, soft_label=True, ignore_index=3)
+    # the default -100 sentinel stays legal with soft labels
+    loss = F.cross_entropy(logits, soft, soft_label=True)
+    assert np.isfinite(float(loss._data))

@@ -90,6 +90,31 @@ class TestFlashAttentionKernel:
         for g, w in zip(got, want):
             assert float(jnp.max(jnp.abs(g - w))) < 3e-4
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("seq", [80, 208])
+    def test_backward_non_tile_multiple_seq(self, causal, seq):
+        """Non-multiple-of-128 (but %16) lengths take the single-block
+        path; parity-check BACKWARD there too, not just tile-aligned
+        forward shapes (ISSUE 7 satellite)."""
+        q, k, v = _rand_qkv(b=2, s=seq, h=2, d=32, seed=11)
+        scale = 1.0 / 32 ** 0.5
+        out = fa.flash_attention(q, k, v, causal=causal, scale=scale,
+                                 interpret=True)
+        want = _ref(q, k, v, causal, scale)
+        assert float(jnp.max(jnp.abs(out - want))) < 2e-5
+
+        def loss_fa(q, k, v):
+            return jnp.sum(jnp.sin(fa.flash_attention(
+                q, k, v, causal=causal, scale=scale, interpret=True)))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(_ref(q, k, v, causal, scale)))
+
+        got = jax.grad(loss_fa, (0, 1, 2))(q, k, v)
+        wantg = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+        for g, w in zip(got, wantg):
+            assert float(jnp.max(jnp.abs(g - w))) < 3e-4
+
     def test_bf16(self):
         q, k, v = _rand_qkv(dtype=jnp.bfloat16)
         out = fa.flash_attention(q, k, v, causal=True, interpret=True)

@@ -1,0 +1,230 @@
+"""Splash training attention (ops/pallas/splash_attention.py): kernel
+(interpret mode) vs XLA fallback vs dense reference — forward + custom
+backward — across causal/non-causal, GQA, and packed-sequence segment
+masks; plus the F.scaled_dot_product_attention routing surface."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import splash_attention as sa
+
+HP = jax.lax.Precision.HIGHEST
+
+
+def _ref(q, k, v, causal, scale, seg=None):
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    grp = h // kvh
+    qg = q.reshape(b, sq, kvh, grp, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                   precision=HP).astype(jnp.float32) * scale
+    mask = jnp.ones((b, sq, sq), bool)
+    if causal:
+        mask = mask & jnp.tril(jnp.ones((sq, sq), bool))[None]
+    if seg is not None:
+        mask = mask & (seg[:, :, None] == seg[:, None, :])
+    s = jnp.where(mask[:, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, -1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v,
+                     precision=HP)
+    return out.reshape(b, sq, h, d)
+
+
+def _rand(b, s, h, kvh, d, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda hh: jnp.asarray(  # noqa: E731
+        rng.standard_normal((b, s, hh, d)) * 0.5, dtype)
+    return mk(h), mk(kvh), mk(kvh)
+
+
+def _segments(b, s, docs, seed=0):
+    rng = np.random.default_rng(seed)
+    bounds = np.sort(rng.integers(1, s, docs - 1))
+    return jnp.asarray(np.broadcast_to(
+        np.searchsorted(bounds, np.arange(s), side="right"),
+        (b, s)).copy(), jnp.int32)
+
+
+class TestSplashKernel:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("h,kvh", [(2, 2), (4, 2)])
+    def test_forward_and_grads_match_dense(self, causal, h, kvh):
+        q, k, v = _rand(2, 256, h, kvh, 32)
+        scale = 1.0 / 32 ** 0.5
+        out = sa.splash_attention(q, k, v, causal=causal, scale=scale,
+                                  interpret=True)
+        want = _ref(q, k, v, causal, scale)
+        assert float(jnp.max(jnp.abs(out - want))) < 3e-5
+
+        def loss_k(q, k, v):
+            return jnp.sum(jnp.sin(sa.splash_attention(
+                q, k, v, causal=causal, scale=scale, interpret=True)))
+
+        def loss_r(q, k, v):
+            return jnp.sum(jnp.sin(_ref(q, k, v, causal, scale)))
+
+        gk = jax.grad(loss_k, (0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gr):
+            assert float(jnp.max(jnp.abs(a - b))) < 5e-4
+
+    @pytest.mark.parametrize("h,kvh", [(2, 2), (2, 1)])
+    def test_segment_mask_matches_dense(self, h, kvh):
+        q, k, v = _rand(2, 256, h, kvh, 32, seed=3)
+        seg = _segments(2, 256, 3, seed=3)
+        scale = 0.2
+        out = sa.splash_attention(q, k, v, causal=True, scale=scale,
+                                  segment_ids=seg, interpret=True)
+        want = _ref(q, k, v, True, scale, seg=seg)
+        assert float(jnp.max(jnp.abs(out - want))) < 3e-5
+
+        def loss_k(q, k, v):
+            return jnp.sum(jnp.sin(sa.splash_attention(
+                q, k, v, causal=True, scale=scale, segment_ids=seg,
+                interpret=True)))
+
+        def loss_r(q, k, v):
+            return jnp.sum(jnp.sin(_ref(q, k, v, True, scale, seg=seg)))
+
+        gk = jax.grad(loss_k, (0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gr):
+            assert float(jnp.max(jnp.abs(a - b))) < 5e-4
+
+    def test_segments_equal_per_document_attention(self):
+        """The packed-sequence contract: one splash call over packed
+        docs == each document attended separately (out AND grads)."""
+        b, s, h, d = 1, 256, 2, 32
+        lens = [96, 64, 96]
+        q, k, v = _rand(b, s, h, h, d, seed=4)
+        seg = jnp.asarray(np.repeat(np.arange(len(lens)), lens)[None],
+                          jnp.int32)
+
+        def packed(q, k, v):
+            return sa.splash_attention(q, k, v, causal=True,
+                                       segment_ids=seg, interpret=True)
+
+        def perdoc(q, k, v):
+            outs, off = [], 0
+            for ln in lens:
+                sl = slice(off, off + ln)
+                outs.append(_ref(q[:, sl], k[:, sl], v[:, sl], True,
+                                 1.0 / d ** 0.5))
+                off += ln
+            return jnp.concatenate(outs, axis=1)
+
+        assert float(jnp.max(jnp.abs(
+            packed(q, k, v) - perdoc(q, k, v)))) < 3e-5
+        gk = jax.grad(lambda *a: jnp.sum(jnp.sin(packed(*a))),
+                      (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(jnp.sin(perdoc(*a))),
+                      (0, 1, 2))(q, k, v)
+        for a, bb in zip(gk, gr):
+            assert float(jnp.max(jnp.abs(a - bb))) < 5e-4
+
+    def test_xla_fallback_matches_kernel(self):
+        q, k, v = _rand(2, 256, 2, 2, 32, seed=5)
+        seg = _segments(2, 256, 2, seed=5)
+        out_k = sa.splash_attention(q, k, v, causal=True,
+                                    segment_ids=seg, interpret=True)
+        out_x = sa.splash_attention(q, k, v, causal=True,
+                                    segment_ids=seg, use_kernel=False)
+        assert float(jnp.max(jnp.abs(out_k - out_x))) < 3e-5
+
+    def test_bf16(self):
+        q, k, v = _rand(1, 256, 2, 2, 32, dtype=jnp.bfloat16, seed=6)
+        out = sa.splash_attention(q, k, v, causal=True, interpret=True)
+        want = _ref(q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32), True, 1.0 / 32 ** 0.5)
+        assert out.dtype == jnp.bfloat16
+        assert float(jnp.max(jnp.abs(
+            out.astype(jnp.float32) - want))) < 3e-2
+
+    def test_supports_gate(self):
+        assert sa.supports((2, 1024, 8, 64), 8, jnp.bfloat16)
+        assert sa.supports((2, 256, 8, 64), 4, jnp.float32)     # GQA
+        assert not sa.supports((2, 1021, 8, 64), 8, jnp.float32)
+        assert not sa.supports((2, 256, 8, 64), 3, jnp.float32)
+        assert not sa.supports((2, 256, 8, 512), 8, jnp.float32)
+        assert not sa.supports((2, 256, 8, 64), 8, jnp.int8)
+
+
+class TestFunctionalRouting:
+    def test_sdpa_segments_route_to_splash(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(7)
+        qn = rng.standard_normal((1, 256, 2, 32)).astype(np.float32)
+        seg = _segments(1, 256, 2, seed=7)
+        q = paddle.to_tensor(qn)
+        out = F.scaled_dot_product_attention(
+            q, q, q, is_causal=True, segment_ids=paddle.to_tensor(
+                np.asarray(seg)))
+        want = _ref(jnp.asarray(qn), jnp.asarray(qn), jnp.asarray(qn),
+                    True, 1.0 / 32 ** 0.5, seg=seg)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(want), atol=3e-5)
+
+    def test_sdpa_segments_with_dropout_use_dense_mask(self):
+        """Dropout forces the dense segment-mask path (splash has no
+        dropout plumbing) — output rows still never cross a segment."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.default_rng(8)
+        s = 64   # any length: the dense path has no tiling constraint
+        qn = rng.standard_normal((1, s, 2, 16)).astype(np.float32)
+        vn = np.zeros((1, s, 2, 16), np.float32)
+        vn[0, :32] = 1.0    # doc 0's values are 1, doc 1's are 0
+        seg = jnp.asarray(np.repeat([0, 1], s // 2)[None], jnp.int32)
+        q = paddle.to_tensor(qn)
+        v = paddle.to_tensor(vn)
+        out = F.scaled_dot_product_attention(
+            q, q, v, is_causal=True, dropout_p=0.5, training=True,
+            segment_ids=paddle.to_tensor(np.asarray(seg)))
+        o = np.asarray(out._data)
+        # doc-1 queries can only see doc-1 keys, whose values are all 0
+        assert np.abs(o[0, 32:]).max() == 0.0
+
+    def test_segment_context_threads_through_model(self):
+        """GPTModel.forward publishes segment_ids to every attention
+        layer: packed forward == per-document forward."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=2,
+                        max_position_embeddings=32)
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        rng = np.random.default_rng(9)
+        ids = rng.integers(0, 97, (1, 32))
+        seg = np.repeat([0, 1], 16)[None]
+        packed = m(paddle.to_tensor(ids, dtype="int64"),
+                   segment_ids=paddle.to_tensor(seg, dtype="int32"))
+        parts = []
+        for sl in (slice(0, 16), slice(16, 32)):
+            # per-doc forward at positions matching the packed layout
+            pos = paddle.to_tensor(np.arange(32)[None, sl],
+                                   dtype="int64")
+            parts.append(np.asarray(m(
+                paddle.to_tensor(ids[:, sl], dtype="int64"),
+                position_ids=pos)._data))
+        want = np.concatenate(parts, axis=1)
+        np.testing.assert_allclose(np.asarray(packed._data), want,
+                                   atol=2e-4)
+
+    def test_sdpa_rejects_mask_plus_segments(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        q = paddle.to_tensor(np.zeros((1, 16, 2, 8), np.float32))
+        mask = paddle.to_tensor(np.zeros((1, 1, 16, 16), np.float32))
+        seg = paddle.to_tensor(np.zeros((1, 16), np.int32))
+        with pytest.raises(ValueError, match="segment_ids"):
+            F.scaled_dot_product_attention(q, q, q, attn_mask=mask,
+                                           segment_ids=seg)

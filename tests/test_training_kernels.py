@@ -1,0 +1,148 @@
+"""Training-kernel integration (ISSUE 7): the splash-attention + fused-CE
+kernels wired into the scan train steps — parity vs the unfused paths,
+zero added retraces (with and without segment ids), and the HLO probe
+asserting the [tokens, vocab] logits / [b, h, s, s] scores never exist
+in the compiled step."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as popt
+from paddle_tpu.models import (
+    GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+)
+from paddle_tpu.ops.pallas import training_selftest as ts
+from paddle_tpu.utils import flags as _flags
+
+TINY = dict(vocab_size=384, hidden_size=32, num_layers=2,
+            num_attention_heads=2, max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+KERNEL_FLAGS = {"FLAGS_splash_attn": True, "FLAGS_fused_ce": True,
+                "FLAGS_pallas_force_interpret": True,
+                "FLAGS_pallas_flash_min_seqlen": 128}
+STOCK_FLAGS = {"FLAGS_splash_attn": False, "FLAGS_fused_ce": False,
+               "FLAGS_pallas_force_interpret": False,
+               "FLAGS_pallas_flash_min_seqlen": 128}
+
+
+@pytest.fixture
+def restore_flags():
+    saved = {k: _flags.get_flag(k) for k in KERNEL_FLAGS}
+    yield
+    _flags.set_flags(saved)
+
+
+def _batch(b=2, s=128, seed=3):
+    rng = np.random.default_rng(seed)
+    return (paddle.to_tensor(rng.integers(0, TINY["vocab_size"], (b, s)),
+                             dtype="int64"),
+            paddle.to_tensor(rng.integers(0, TINY["vocab_size"], (b, s)),
+                             dtype="int64"))
+
+
+def test_fused_scan_step_kernel_parity(restore_flags):
+    """FusedScanTrainStep with BOTH kernels engaged (interpret mode) ==
+    eager TrainStep on the stock dense paths over the SAME scan model:
+    loss trajectory + final params at fp32 tolerance, compile count 1
+    (the training_selftest lane, run in-process)."""
+    rec = ts.scan_step_integration(steps=3)
+    assert rec["compile_count"] == 1
+    assert rec["loss_abs"] < ts.TOL["step_loss"]
+    assert rec["param_rel"] < ts.TOL["step_param_rel"]
+
+
+def test_fused_scan_step_segments_no_retrace(restore_flags):
+    """Segment ids ride the compiled step as a normal traced arg: the
+    same executable serves every step with segments (one trace for the
+    no-seg signature, one for the seg signature, none beyond)."""
+    from paddle_tpu.jit import FusedScanTrainStep
+
+    _flags.set_flags(KERNEL_FLAGS)
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(scan_layers=True, **TINY))
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = FusedScanTrainStep(model, opt, fused_head=True)
+    ids, labels = _batch()
+    seg = paddle.to_tensor(
+        np.repeat([[0] * 64 + [1] * 64], 2, 0), dtype="int32")
+    losses_seg = [float(step(ids, labels, segment_ids=seg))
+                  for _ in range(2)]
+    assert step._jitted._cache_size() == 1
+    losses = [float(step(ids, labels)) for _ in range(2)]
+    assert step._jitted._cache_size() == 2   # one more for the no-seg sig
+    float(step(ids, labels, segment_ids=seg))
+    assert step._jitted._cache_size() == 2   # both signatures stay warm
+    # the segment mask must actually change the math
+    assert abs(losses_seg[0] - losses[0]) > 1e-6
+    assert all(np.isfinite(losses_seg + losses))
+
+
+def test_segmented_scan_step_matches_eager_segmented(restore_flags):
+    """Packed-sequence training end to end: the fused scan step with
+    segment ids == eager TrainStep feeding the same segments through
+    model.loss, at fp32 tolerance."""
+    from paddle_tpu.jit import FusedScanTrainStep, TrainStep
+
+    ids, labels = _batch()
+    seg_np = np.repeat([[0] * 48 + [1] * 80], 2, 0)
+    seg = paddle.to_tensor(seg_np, dtype="int32")
+
+    def build():
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(scan_layers=True, **TINY))
+        opt = popt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        return m, opt
+
+    _flags.set_flags(KERNEL_FLAGS)
+    m_f, opt_f = build()
+    step_f = FusedScanTrainStep(m_f, opt_f, fused_head=True)
+    loss_f = [float(step_f(ids, labels, segment_ids=seg))
+              for _ in range(2)]
+
+    _flags.set_flags(STOCK_FLAGS)
+    m_e, opt_e = build()
+    crit = GPTPretrainingCriterion()
+    step_e = TrainStep(
+        m_e, lambda m, a, b: crit(m(a, segment_ids=seg), b), opt_e)
+    loss_e = [float(step_e(ids, labels)) for _ in range(2)]
+
+    assert max(abs(a - b) for a, b in zip(loss_f, loss_e)) < 5e-4
+    pe = dict(m_e.named_parameters())
+    for name, p in m_f.named_parameters():
+        a, b = np.asarray(p._data), np.asarray(pe[name]._data)
+        rel = np.abs(a - b).max() / max(np.abs(b).max(), 1e-6)
+        assert rel < 5e-3, (name, rel)
+
+
+def test_hlo_probe_no_logits_no_scores(restore_flags):
+    rec = ts.hlo_probe()
+    assert rec["forbidden"] == 0
+
+
+def test_forbidden_shapes_probe_detects_dense():
+    """The probe itself must flag the buffers it exists to forbid."""
+    assert ts.forbidden_shapes("f32[2,128,384] x", 2, 128, 384)
+    assert ts.forbidden_shapes("f32[256,384] x", 2, 128, 384)
+    assert ts.forbidden_shapes("bf16[2,2,128,128] x", 2, 128, 384)
+    # params, grads and kernel tiles stay legal
+    assert not ts.forbidden_shapes(
+        "f32[384,32] f32[128,384] f32[2,128,32] f32[128,128] x",
+        2, 128, 384)
+
+
+def test_kernels_under_checkpoint_scan(restore_flags):
+    """Custom-VJP kernels must trace under jax.checkpoint + lax.scan
+    (the recompute path): the remat replay re-runs the splash/CE
+    forwards inside the stored jaxpr."""
+    from paddle_tpu.jit import TrainStep
+
+    _flags.set_flags(KERNEL_FLAGS)
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(scan_layers=True, use_recompute=True,
+                                 **TINY))
+    opt = popt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = TrainStep(m, lambda mm, a, b: mm.loss(a, b), opt)
+    ids, labels = _batch(seed=5)
+    losses = [float(step(ids, labels)) for _ in range(2)]
+    assert all(np.isfinite(losses)) and losses[1] < losses[0]
