@@ -599,6 +599,19 @@ def run_selftest():
         assert lane.get("check") == "pass", lane
         results["sharded_scan_parity_detail"] = lane
 
+    def hybrid_parallel():
+        # ISSUE 8: full hybrid parallelism — dp4×mp2 (Megatron block
+        # slicing + vocab-parallel sharded CE) and dp2×pp2 (ring
+        # pipeline, micro-batch accumulation) both match the dp-only
+        # sharded scan on the 8-device host mesh within the
+        # sharded-scan tolerances, one compiled executable per mesh
+        # signature, and the planner returns a pruning-clean layout
+        rec = _run_cpu_probe("paddle_tpu.jit.hybrid_selftest",
+                             timeout=900)
+        lane = rec.get("hybrid_parallel", {})
+        assert lane.get("check") == "pass", lane
+        results["hybrid_parallel_detail"] = lane
+
     def fault_tolerance():
         # ISSUE 4: crash-safe checkpointing — victim subprocess
         # SIGKILLed mid-save resumes from the last committed step, a
@@ -653,6 +666,7 @@ def run_selftest():
     check("bucketed_reduce_scatter_parity", bucketed_rs_parity)
     check("decode_parity", decode_parity)
     check("sharded_scan_parity", sharded_scan_parity)
+    check("hybrid_parallel", hybrid_parallel)
     check("fault_tolerance", fault_tolerance)
     check("input_pipeline", input_pipeline)
     check("serving", serving)
@@ -1041,6 +1055,13 @@ if __name__ == "__main__":
             rec["sharded_scan"] = {"error":
                                    f"{type(e).__name__}: {e}"[:300]}
         print(json.dumps(rec))
+    elif "--hybrid" in sys.argv:
+        # HYBRID lane (ISSUE 8): dp4×mp2 + dp2×pp2 parity vs the
+        # dp-only sharded scan, compile-count probes, planner pick —
+        # hermetic CPU subprocess, one JSON line (the probe already
+        # prints under the "hybrid_parallel" key)
+        print(json.dumps(_run_cpu_probe("paddle_tpu.jit.hybrid_selftest",
+                                        timeout=900)))
     elif "--sweep" in sys.argv:
         # SWEEP lane: measured scan_unroll/layer_chunk A/B on the
         # fused-scan path; records + auto-applies the best (ISSUE 3)

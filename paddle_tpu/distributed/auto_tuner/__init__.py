@@ -4,3 +4,6 @@
 from .tuner import AutoTuner, Candidate, estimate_memory_gb  # noqa: F401
 from .prune import prune_candidates  # noqa: F401
 from .search import grid_candidates  # noqa: F401
+from .select import (  # noqa: F401
+    calibrate_backend_cached, pick_layout, spec_of_model,
+)

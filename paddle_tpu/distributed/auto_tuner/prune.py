@@ -15,6 +15,11 @@ def prune_candidates(cands, spec, hbm_gb):
         if spec.hidden_size % c.mp:
             c.pruned_reason = f"hidden {spec.hidden_size} % mp {c.mp}"
             continue
+        if spec.vocab_size % c.mp:
+            # the vocab-parallel LM head (sharded fused CE) slices the
+            # [vocab, H] head by rows — ragged shards are not supported
+            c.pruned_reason = f"vocab {spec.vocab_size} % mp {c.mp}"
+            continue
         if spec.global_batch % max(c.dp, 1):
             c.pruned_reason = f"batch {spec.global_batch} % dp {c.dp}"
             continue

@@ -1,0 +1,248 @@
+"""Layout selection: the validated cost-model planner promoted from
+validation artifact (docs/PLANNER_VALIDATION.md, Spearman 0.90 on the
+host mesh) to DECISION-MAKER.
+
+`pick_layout` enumerates (dp, mp, pp, micro) factorizations of the
+device count, prunes infeasible ones with the reference pruning rules
+(`prune.prune_candidates` — divisibility + HBM-fit), ranks the
+survivors with `tuner.estimate_step_ms` under BACKEND-CALIBRATED
+collective constants, and returns the winner plus the scan-granularity
+knobs (`scan_unroll` / `layer_chunk` from the measured `bench.py
+--sweep` grid when a code-current record exists, defaults otherwise)
+and the comm bucket size. `jit.select_train_step(auto=True)` consumes
+this to build the mesh + hybrid step end-to-end.
+
+Env override (preserved per ISSUE 8): ``PADDLE_HYBRID_LAYOUT=
+"dp=4,mp=2"`` (optionally ``pp=``/``micro=``) skips the planner and
+forces the layout — still validated against the pruning rules so an
+impossible forced layout fails loudly, not numerically.
+
+Calibration staleness (satellite): `calibrate_backend_cached` persists
+`calibrate_backend()`'s measured constants under ``.bench_live/`` keyed
+by (backend platform, device count) with an invalidation hash over the
+calibration code + jax version — re-measured only when missing or
+stale, so planner callers stop paying the ~1s probe per process and
+ad-hoc consumers stop silently mixing constants from different
+toolchains.
+"""
+from __future__ import annotations
+
+import hashlib
+import inspect
+import json
+import os
+
+from .prune import prune_candidates
+from .search import grid_candidates
+from .tuner import (
+    Candidate, ModelSpec, calibrate_backend, estimate_memory_gb,
+    estimate_step_ms,
+)
+
+__all__ = ["pick_layout", "calibrate_backend_cached", "spec_of_model",
+           "LAYOUT_ENV"]
+
+LAYOUT_ENV = "PADDLE_HYBRID_LAYOUT"
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _calib_hash():
+    """Invalidation hash: the calibration + cost-model code and the jax
+    version. A change to either re-measures instead of reusing."""
+    import jax
+
+    from . import tuner as _tuner
+
+    h = hashlib.sha256()
+    h.update(inspect.getsource(_tuner.calibrate_backend).encode())
+    h.update(inspect.getsource(_tuner.estimate_step_ms).encode())
+    h.update(jax.__version__.encode())
+    return h.hexdigest()[:16]
+
+
+def calibrate_backend_cached(devices=None, cache_dir=None, refresh=False):
+    """`tuner.calibrate_backend` behind a keyed on-disk cache.
+
+    Key: (backend platform, device count); file:
+    ``.bench_live/backend_calib_<platform>_<n>.json``; entries carry the
+    invalidation hash from `_calib_hash` and are re-measured when it
+    mismatches (stale toolchain/code) or the file is unreadable.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    platform = devices[0].platform if devices else "none"
+    n = len(devices)
+    if cache_dir is None:
+        cache_dir = os.path.join(_repo_root(), ".bench_live")
+    path = os.path.join(cache_dir, f"backend_calib_{platform}_{n}.json")
+    want = _calib_hash()
+    if not refresh and os.path.exists(path):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if rec.get("calib_hash") == want:
+                return rec["constants"]
+        except (OSError, ValueError, KeyError):
+            pass
+    constants = calibrate_backend(devices)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"calib_hash": want, "platform": platform,
+                       "n_devices": n, "constants": constants}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass                       # cache is an optimization, not truth
+    return constants
+
+
+def spec_of_model(config, global_batch, seq_len=None, params=None):
+    """Build a `ModelSpec` from a GPTConfig-shaped config object."""
+    h = int(config.hidden_size)
+    L = int(config.num_layers)
+    V = int(config.vocab_size)
+    inter = int(getattr(config, "intermediate_size", 4 * h) or 4 * h)
+    if params is None:
+        # transformer param count: embeddings + per-layer qkv/proj/mlp/ln
+        params = (V * h + int(config.max_position_embeddings) * h
+                  + L * (4 * h * h + 2 * h * inter + 9 * h) + 2 * h)
+    return ModelSpec(
+        params=int(params), num_layers=L, hidden_size=h,
+        num_heads=int(config.num_attention_heads), vocab_size=V,
+        seq_len=int(seq_len or config.max_position_embeddings),
+        global_batch=int(global_batch),
+        use_recompute=bool(getattr(config, "use_recompute", False)),
+    )
+
+
+def _parse_env_layout(text):
+    out = {}
+    for part in text.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        k = k.strip().lower()
+        if k not in ("dp", "mp", "pp", "micro"):
+            raise ValueError(
+                f"{LAYOUT_ENV}: unknown key {k!r} (dp/mp/pp/micro; "
+                "weight-update sharding always rides the dp axis — "
+                "there is no separate sharding degree to force)")
+        out[k] = int(v)
+    return out
+
+
+def _sweep_knobs(spec):
+    """scan_unroll / layer_chunk from the newest code-matching measured
+    sweep record (`bench.py --sweep` writes
+    .bench_live/scan_sweep_*.json); defaults otherwise. The sweep is the
+    planner's measured calibration grid for the in-scan knobs the cost
+    model does not capture."""
+    import glob
+
+    best = {"scan_unroll": 2, "layer_chunk": 1, "source": "default"}
+    pat = os.path.join(_repo_root(), ".bench_live", "scan_sweep_*.json")
+    recs = []
+    for p in glob.glob(pat):
+        try:
+            with open(p) as f:
+                recs.append((os.path.getmtime(p), json.load(f)))
+        except (OSError, ValueError):
+            continue
+    for _, rec in sorted(recs, reverse=True):
+        b = rec.get("best") or {}
+        if "scan_unroll" in b:
+            best.update({"scan_unroll": int(b["scan_unroll"]),
+                         "layer_chunk": int(b.get("layer_chunk", 1)),
+                         "source": "measured-sweep"})
+            break
+    if spec.num_layers % best["layer_chunk"]:
+        best["layer_chunk"] = 1
+    return best
+
+
+def pick_layout(spec, n_devices, hbm_gb=16.0, backend=None,
+                max_micro=32, env=None, top_k=5):
+    """Choose a runnable hybrid layout for `spec` on `n_devices` chips.
+
+    Returns a dict: ``candidate`` (the winning `Candidate`),
+    ``mesh_degrees`` ({axis: degree} for `env.build_mesh`),
+    ``scan_unroll``/``layer_chunk``/``comm_bucket_mb``, ``source``
+    ("planner" or "env"), and ``ranking`` (the top-k (name, est_ms)
+    table the decision came from). Raises if nothing feasible survives
+    pruning (including a forced env layout that fails the rules).
+    """
+    env_map = os.environ if env is None else env
+    forced = env_map.get(LAYOUT_ENV, "").strip()
+    from ...utils import flags as _flags
+
+    bucket_mb = int(_flags.get_flag("FLAGS_comm_bucket_mb") or 25)
+    knobs = _sweep_knobs(spec)
+
+    def finish(cand, source, ranking):
+        return {
+            "candidate": cand,
+            "mesh_degrees": {k: v for k, v in
+                             (("dp", cand.dp), ("pp", cand.pp),
+                              ("mp", cand.mp)) if v > 1 or k == "dp"},
+            "num_micro": int(cand.micro_batch),
+            "scan_unroll": knobs["scan_unroll"],
+            "layer_chunk": knobs["layer_chunk"],
+            "knob_source": knobs["source"],
+            "comm_bucket_mb": bucket_mb,
+            "source": source,
+            "ranking": ranking,
+        }
+
+    if forced:
+        kv = _parse_env_layout(forced)
+        dp = kv.get("dp", 0) or max(
+            1, n_devices // (kv.get("mp", 1) * kv.get("pp", 1)))
+        cand = Candidate(dp=dp, mp=kv.get("mp", 1), pp=kv.get("pp", 1),
+                         sharding_stage=1,
+                         micro_batch=kv.get("micro",
+                                            2 if kv.get("pp", 1) > 1
+                                            else 1))
+        if cand.degree > n_devices:
+            raise ValueError(
+                f"{LAYOUT_ENV}={forced!r} needs {cand.degree} devices, "
+                f"have {n_devices}")
+        pruned = prune_candidates([cand], spec, hbm_gb)[0]
+        if pruned.pruned_reason:
+            raise ValueError(
+                f"{LAYOUT_ENV}={forced!r} is infeasible: "
+                f"{pruned.pruned_reason}")
+        return finish(cand, "env", [])
+
+    cands = grid_candidates(n_devices, sharding_stages=(1,),
+                            max_micro=max_micro,
+                            global_batch=spec.global_batch)
+    # restrict to what the hybrid steps actually run today: no sep ring
+    # here (dp×mp, dp×pp and the full dp×mp×pp composition all run);
+    # C % pp falls out of the num_layers % pp pruning rule
+    cands = [c for c in cands
+             if c.sep == 1 and c.degree == n_devices]
+    cands = prune_candidates(cands, spec, hbm_gb)
+    live = [c for c in cands if c.pruned_reason is None]
+    if not live:
+        reasons = sorted({c.pruned_reason for c in cands
+                          if c.pruned_reason})
+        raise ValueError(
+            f"no feasible hybrid layout for {n_devices} devices "
+            f"(pruned: {reasons[:6]})")
+    for c in live:
+        c.estimated_mem_gb = estimate_memory_gb(spec, c)
+        c.estimated_step_ms = estimate_step_ms(spec, c, backend=backend)
+    live.sort(key=lambda c: (c.estimated_step_ms,
+                             c.mp + c.pp))  # tie-break: simpler layout
+    ranking = [(f"dp{c.dp}xmp{c.mp}xpp{c.pp}m{c.micro_batch}",
+                round(c.estimated_step_ms, 3)) for c in live[:top_k]]
+    return finish(live[0], "planner", ranking)

@@ -91,7 +91,13 @@ def calibrate_backend(devices=None, probe_elems=262144, reps=5):
       ici_gbps    — effective allreduce bandwidth from a bigger probe;
       pp_tick_ms  — wall cost of ONE ppermute ring-scan tick (the
                     pipeline's unit of serialization), measured from a
-                    jitted lax.scan of 8 ticks.
+                    jitted lax.scan of 8 ticks;
+      peak_flops  — EFFECTIVE matmul throughput of one device (r6
+                    planner promotion: on the emulated host mesh real
+                    compute is ~4 orders below the v5e MXU constant, so
+                    without this the compute term — and the pp BUBBLE
+                    that multiplies it — vanish from every ranking and
+                    pipeline configs rank absurdly fast).
 
     Returns a dict consumable by estimate_step_ms(backend=...) /
     AutoTuner(backend_constants=...). Costs ~1s on CPU, less on TPU.
@@ -106,10 +112,6 @@ def calibrate_backend(devices=None, probe_elems=262144, reps=5):
     if devices is None:
         devices = jax.devices()
     devices = list(devices)[:2]
-    if len(devices) < 2:
-        return {"coll_lat_us": 10.0, "ici_gbps": 400e9,
-                "pp_tick_ms": 10.0 * 1e-3}
-    mesh = Mesh(np.asarray(devices), ("cal",))
 
     def timed(fn, *args):
         out = fn(*args)
@@ -119,6 +121,17 @@ def calibrate_backend(devices=None, probe_elems=262144, reps=5):
             out = fn(*args)
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / reps
+
+    n = 512
+    with jax.default_device(devices[0]):
+        a = jnp.ones((n, n), jnp.float32)
+        t_mm = timed(jax.jit(lambda x: (x @ x) @ x), a)
+    peak_flops = float(min(max(2 * 2 * n ** 3 / max(t_mm, 1e-9), 1e9),
+                           1e16))
+    if len(devices) < 2:
+        return {"coll_lat_us": 10.0, "ici_gbps": 400e9,
+                "pp_tick_ms": 10.0 * 1e-3, "peak_flops": peak_flops}
+    mesh = Mesh(np.asarray(devices), ("cal",))
 
     small = jnp.zeros((8, 16), jnp.float32)
     big = jnp.zeros((probe_elems,), jnp.float32)
@@ -149,6 +162,7 @@ def calibrate_backend(devices=None, probe_elems=262144, reps=5):
         "coll_lat_us": t_small * 1e6,
         "ici_gbps": float(max(bw, 1e6)),
         "pp_tick_ms": t_ring / n_ticks * 1e3,
+        "peak_flops": peak_flops,
     }
 
 
@@ -165,6 +179,7 @@ def estimate_step_ms(spec: ModelSpec, c: Candidate, *,
         coll_lat_us = float(backend.get("coll_lat_us", coll_lat_us))
         ici_gbps = float(backend.get("ici_gbps", ici_gbps))
         pp_tick_ms = float(backend.get("pp_tick_ms", pp_tick_ms))
+        peak_flops = float(backend.get("peak_flops", peak_flops))
     tokens = spec.global_batch * spec.seq_len
     flops = 6 * spec.params * tokens * (4 / 3 if spec.use_recompute else 1)
     compute_ms = flops / (c.degree * peak_flops) * 1e3

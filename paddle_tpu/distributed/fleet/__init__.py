@@ -27,6 +27,7 @@ from . import meta_optimizers  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     LayerDesc,
     SharedLayerDesc,
+    HybridParallel,
     PipelineLayer,
     PipelineParallel,
     TensorParallel,

@@ -115,6 +115,13 @@ class Fleet:
             model, PipelineLayer
         ):
             return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_pipe_parallel_world_size() > 1:
+            # non-PipelineLayer model on a pp mesh (e.g. a scan_layers
+            # GPT): the compiled ring step owns the schedule —
+            # HybridParallel.train_step builds it via select_train_step
+            from .meta_parallel import HybridParallel
+
+            return HybridParallel(model, hcg, strategy=self._strategy)
         if hcg.get_model_parallel_world_size() > 1:
             return TensorParallel(model, hcg, strategy=self._strategy)
         if hcg.get_sep_parallel_world_size() > 1:

@@ -29,6 +29,29 @@ class MetaParallelBase(Layer):
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
+    def train_step(self, optimizer, criterion=None, **kw):
+        """Whole-step entry shared by the hybrid wrappers: dispatch on
+        the mesh's active axes via jit.select_train_step — a >1 ``pp``
+        axis gets the ring `PipelineScanTrainStep`, a >1 ``mp`` axis the
+        dp×mp `ShardedFusedScanTrainStep`, a dp/sharding axis the
+        dp-only sharded scan (micro-batch count for pp comes from the
+        strategy's pipeline_configs accumulate_steps unless overridden).
+        """
+        from ....jit.sharded_scan import select_train_step
+
+        hcg = self._hcg
+        if "num_micro" not in kw and hcg is not None and \
+                hcg.get_pipe_parallel_world_size() > 1:
+            cfg = (getattr(self._strategy, "pipeline_configs", None)
+                   or {})
+            accum = int(cfg.get("accumulate_steps", 1) or 1)
+            if accum > 1:
+                kw["num_micro"] = accum
+        return select_train_step(self._layers, optimizer,
+                                 criterion=criterion,
+                                 mesh=hcg.mesh if hcg is not None
+                                 else None, **kw)
+
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)
 
@@ -101,6 +124,24 @@ class ShardingParallel(MetaParallelBase):
                                  criterion=criterion,
                                  mesh=self._hcg.mesh, axis="sharding",
                                  **kw)
+
+
+class HybridParallel(MetaParallelBase):
+    """Generic hybrid wrapper for models that are not PipelineLayers
+    (e.g. a scan_layers GPT) on a mesh with >1 mp and/or pp degrees:
+    batch shards on the dp-like axis, `train_step()` builds the
+    matching dp×mp / dp×pp compiled step via select_train_step."""
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._hcg.mesh
+        axis = next((a for a in ("sharding", "dp")
+                     if a in mesh.axis_names and mesh.shape[a] > 1),
+                    None)
+        if axis is not None:
+            inputs = tuple(
+                _shard_batch(x, mesh, axis) if isinstance(x, Tensor)
+                else x for x in inputs)
+        return self._layers(*inputs, **kwargs)
 
 
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: E402,F401

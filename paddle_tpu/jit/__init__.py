@@ -21,10 +21,12 @@ from . import dy2static
 from .train_step import TrainStep, _tree_data, _tree_wrap
 from .fused_scan_step import FusedScanTrainStep
 from .sharded_scan import ShardedFusedScanTrainStep, select_train_step
+from .pipeline_step import PipelineScanTrainStep
 from .decode_step import DecodeStep, GenerationEngine, PrefillStep
 
 __all__ = ["to_static", "TrainStep", "FusedScanTrainStep",
-           "ShardedFusedScanTrainStep", "select_train_step",
+           "ShardedFusedScanTrainStep", "PipelineScanTrainStep",
+           "select_train_step",
            "GenerationEngine", "DecodeStep", "PrefillStep",
            "not_to_static", "ignore_module", "save", "load",
            "enable_to_static", "set_code_level", "set_verbosity"]

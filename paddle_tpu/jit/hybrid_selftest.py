@@ -1,0 +1,162 @@
+"""Hermetic parity selftest for the HYBRID (dp×mp / dp×pp) train steps.
+
+Run under a cpu-forced env (bench.py's stripped subprocess /
+tools/cpu_env.sh) with an 8-virtual-device host platform:
+
+    python -m paddle_tpu.jit.hybrid_selftest
+
+Asserts, on one process, the ISSUE 8 acceptance triangle with
+ClipGradByGlobalNorm active:
+
+    dp-only ShardedFusedScanTrainStep (8-rank mesh)
+        ==  dp4×mp2 (Megatron column/row block slicing, in-block mp
+            psums, vocab-parallel sharded fused CE, grads scattered
+            over the flattened dp×mp product)
+        ==  dp2×pp2 (ring pipeline: layer chunks round-robined over pp,
+            micro-batch accumulation, grads scattered over dp×pp)
+
+loss trajectories within the sharded_scan_selftest tolerances, final
+params within rel tol, ONE compiled executable per mesh signature
+(compile-count probes), and the planner (`pick_layout`) returning a
+pruning-clean layout for the 8-device host. Prints ONE JSON line so the
+record lands verbatim in BENCH_r*.json.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+TOL = {
+    "loss_abs": 5e-4,
+    "param_rtol": 5e-3,
+    "param_atol": 5e-5,
+}
+
+TINY = dict(vocab_size=96, hidden_size=32, num_layers=4,
+            num_attention_heads=2, max_position_embeddings=16,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+def hybrid_probe(n_devices=8, steps=4, lr=1e-2, clip_norm=0.05, seed=0):
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.jit import (
+        PipelineScanTrainStep, ShardedFusedScanTrainStep,
+    )
+    from paddle_tpu.models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    devs = jax.devices("cpu")[:n_devices]
+    if len(devs) < n_devices:
+        return {"check": f"FAIL: {len(devs)} cpu devices < {n_devices}"}
+    crit = GPTPretrainingCriterion()
+    rng = np.random.default_rng(seed)
+    ids = paddle.to_tensor(
+        rng.integers(0, TINY["vocab_size"], (n_devices, 16)),
+        dtype="int64")
+    labels = paddle.to_tensor(
+        rng.integers(0, TINY["vocab_size"], (n_devices, 16)),
+        dtype="int64")
+
+    def build(mesh, cls, **kw):
+        cfg = GPTConfig(**TINY, scan_layers=True)
+        paddle.seed(seed)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=lr,
+                         parameters=model.parameters(),
+                         grad_clip=nn.ClipGradByGlobalNorm(clip_norm))
+        denv.set_mesh(mesh)
+        step = cls(model, opt, criterion=crit, mesh=mesh, **kw)
+        losses = [float(step(ids, labels)) for _ in range(steps)]
+        return losses, model, step
+
+    from jax.sharding import Mesh
+
+    mesh_dp = Mesh(np.asarray(devs), ("sharding",))
+    ref, m_ref, s_ref = build(mesh_dp, ShardedFusedScanTrainStep,
+                              axis="sharding")
+    mesh_mp = Mesh(np.asarray(devs).reshape(n_devices // 2, 2),
+                   ("dp", "mp"))
+    mp, m_mp, s_mp = build(mesh_mp, ShardedFusedScanTrainStep,
+                           axis="dp", mp_axis="mp")
+    mesh_pp = denv.build_mesh({"dp": 2, "pp": 2}, devices=devs[:4])
+    pp, m_pp, s_pp = build(mesh_pp, PipelineScanTrainStep, num_micro=2)
+
+    def ldiff(a, b):
+        return float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+
+    def pdiff(m1, m2):
+        worst = 0.0
+        for (_, p1), (_, p2) in zip(m1.named_parameters(),
+                                    m2.named_parameters()):
+            a = np.asarray(p1._data, np.float32)
+            b = np.asarray(p2._data, np.float32)
+            denom = TOL["param_rtol"] * np.abs(a) + TOL["param_atol"]
+            worst = max(worst, float(np.max(np.abs(a - b) / denom)))
+        return worst
+
+    d_mp, d_pp = ldiff(ref, mp), ldiff(ref, pp)
+    p_mp, p_pp = pdiff(m_ref, m_mp), pdiff(m_ref, m_pp)
+    compiles = {"dp4xmp2": s_mp._jitted._cache_size(),
+                "dp2xpp2": s_pp._jitted._cache_size()}
+
+    # planner: a pruning-clean layout for this host
+    from ..distributed.auto_tuner import pick_layout, spec_of_model
+    from ..distributed.auto_tuner.prune import prune_candidates
+
+    cfg = GPTConfig(**TINY, scan_layers=True)
+    spec = spec_of_model(cfg, global_batch=n_devices, seq_len=16)
+    try:
+        dec = pick_layout(spec, n_devices,
+                          backend={"coll_lat_us": 300.0,
+                                   "ici_gbps": 2e9,
+                                   "pp_tick_ms": 0.2,
+                                   "peak_flops": 2e11}, env={})
+        cand = dec["candidate"]
+        planner_ok = (cand.degree == n_devices
+                      and prune_candidates([cand], spec, 16.0)[0]
+                      .pruned_reason is None)
+        planner_pick = dec["mesh_degrees"]
+    except Exception as e:
+        planner_ok, planner_pick = False, f"{type(e).__name__}: {e}"
+
+    bubble = s_pp.schedule_stats()
+    ok = (d_mp < TOL["loss_abs"] and d_pp < TOL["loss_abs"]
+          and p_mp < 1.0 and p_pp < 1.0
+          and compiles["dp4xmp2"] == 1 and compiles["dp2xpp2"] == 1
+          and planner_ok)
+    return {
+        "check": "pass" if ok else
+        f"FAIL: mp={d_mp:.2e} pp={d_pp:.2e} p_mp={p_mp:.2f} "
+        f"p_pp={p_pp:.2f} compiles={compiles} planner={planner_ok}",
+        "n_devices": n_devices, "steps": steps,
+        "max_abs_loss_diff_dp4xmp2_vs_dp8": round(d_mp, 9),
+        "max_abs_loss_diff_dp2xpp2_vs_dp8": round(d_pp, 9),
+        "param_tol_violation_dp4xmp2": round(p_mp, 4),
+        "param_tol_violation_dp2xpp2": round(p_pp, 4),
+        "compile_count_per_signature": compiles,
+        "pipeline_schedule": bubble,
+        "planner_pick": planner_pick,
+        "tolerances": TOL,
+    }
+
+
+def _main():
+    denv_ok = True
+    try:
+        out = {"hybrid_parallel": hybrid_probe()}
+    except Exception as e:
+        denv_ok = False
+        out = {"hybrid_parallel": {
+            "check": f"FAIL: {type(e).__name__}: {e}"[:300]}}
+    print(json.dumps(out))
+    return 0 if denv_ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -1,0 +1,253 @@
+"""Pipeline-parallel fused-scan train step: the ppermute ring schedule
+over the layer-chunk scan structure, with the sharded weight update.
+
+`ShardedFusedScanTrainStep` splits the GRADIENT/OPTIMIZER work over the
+mesh; this step additionally splits the LAYERS. The model's layer chunks
+(`layer_chunk` layers each, C chunks total) are round-robined over the
+``pp`` mesh axis as VIRTUAL STAGES — chunk ``c`` lives on stage
+``c % pp`` in ring pass ``c // pp`` — the VPP placement of
+docs/pipeline_schedules.md, realized on the compiled ppermute ring of
+`fleet/meta_parallel/spmd_pipeline.py`:
+
+  forward:   microbatch the local (dp-shard) batch into M pieces; for
+             each of the V = C/pp ring passes, run ``pp + M - 1`` scan
+             ticks — every stage applies ITS chunk of the pass to the
+             micro-batch it holds and ppermutes the activation to the
+             next stage. Stage 0 injects fresh micro-batches and
+             collects finished ones; warmup/steady/cooldown fall out of
+             the ring (bubble fraction (pp-1)/(pp+M-1) per pass).
+  head:      the collected hiddens re-assemble to the full local batch
+             (one psum over pp) and the LM-head loss is the same
+             masked-mean the dp-only step computes — so micro-batch
+             accumulation is exact by construction: the gradient IS the
+             gradient of the one global mean, the `TrainStep
+             (accum_steps=k)` contract without a separate accumulator.
+  backward:  jax AD of the ring — the reverse ring, 1F1B's backward —
+             yields each rank's OWN chunks' grads ([V, K, ...] per
+             leaf, 1/pp of the layers: the pipeline-parallel memory
+             contract). Each chunk's bucket-packed grad then
+             reduce-scatters over the flattened (dp, pp) axes exactly
+             like the base step's in-scan scatter: the pp leg of the
+             sum SELECTS the owner stage (others contribute zeros), the
+             dp leg is the data-parallel reduction, and the optimizer
+             shards stay 1/(dp·pp) flat buckets. The update scan,
+             fused global-norm clip, and non-finite guard are inherited
+             unchanged.
+
+Per-rank loss/grads carry the uniform ×pp joint-vjp replication factor
+(every pp rank computes the identical loss); the base step's
+1/(dp·pp) normalization divides it back out — the same algebra the
+dp×mp leg uses (see jit/sharded_scan.py).
+
+Dropout is rejected here (a per-(micro, chunk, stage) PRNG offset
+scheme is wholly expressible but not yet wired); use the dp/mp steps
+for dropout models.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .sharded_scan import (
+    ShardedFusedScanTrainStep, pack_flat, scatter_flat,
+)
+
+
+class PipelineScanTrainStep(ShardedFusedScanTrainStep):
+    """Hybrid (dp, pp) train step for a scan_layers GPT model.
+
+    Usage::
+
+        mesh = dist.env.build_mesh({"dp": 2, "pp": 2})
+        dist.env.set_mesh(mesh)
+        step = PipelineScanTrainStep(model, opt, mesh=mesh,
+                                     num_micro=4)
+        loss = step(ids, labels)     # ids [global_batch, seq]
+
+    The ``pp`` axis must divide C = num_layers / layer_chunk (virtual
+    stages round-robin exactly); ``num_micro`` must divide the local
+    (per-dp-rank) batch. `schedule_stats()` reports the analytic bubble
+    ratio of the configured schedule.
+    """
+
+    # a dp1×pp1 mesh is a legitimate REFERENCE configuration (the ring
+    # degenerates to the sequential microbatch-accumulation loop — the
+    # "accumulated single-stage grads" side of the bit-identity test)
+    _allow_degree_one = True
+
+    def __init__(self, model, optimizer, criterion=None, pp_axis=None,
+                 num_micro=2, mesh=None, axis=None, **kw):
+        # consumed by the _extra_reduction_axes hook during super init
+        self._pp_axis_arg = pp_axis
+        self._num_micro = int(num_micro)
+        super().__init__(model, optimizer, criterion=criterion,
+                         mesh=mesh, axis=axis, **kw)
+        if self._pp_axis is None:
+            raise ValueError(
+                "PipelineScanTrainStep needs a 'pp' mesh axis (the ring "
+                "ppermutes over it; degree 1 is allowed as the "
+                "sequential-accumulation reference); use "
+                "ShardedFusedScanTrainStep on a dp-only mesh")
+        C = self.model.config.num_layers // self._layer_chunk
+        if C % self._pp_degree:
+            raise ValueError(
+                f"chunk count {C} (= num_layers/layer_chunk) not "
+                f"divisible by pp degree {self._pp_degree}: the "
+                "round-robin virtual-stage placement needs C % pp == 0")
+        if self._num_micro < 1:
+            raise ValueError("num_micro must be >= 1")
+        if self._dropout_active:
+            raise ValueError(
+                "dropout inside the pipeline ring is not supported "
+                "(no per-(micro, stage) PRNG offset scheme yet); set "
+                "hidden/attention dropout to 0 or use the dp/mp steps")
+
+    def _extra_reduction_axes(self, mesh):
+        pp_axis = self._pp_axis_arg
+        if pp_axis is None:
+            pp_axis = "pp" if "pp" in mesh.axis_names else None
+        elif pp_axis not in mesh.axis_names:
+            pp_axis = None
+        self._pp_axis = pp_axis
+        self._pp_degree = int(mesh.shape[pp_axis]) if pp_axis else 1
+        return (pp_axis,) if pp_axis else ()
+
+    def schedule_stats(self):
+        """Analytic schedule accounting (the bubble-ratio probe): the
+        ring runs V serial passes of pp + M - 1 ticks; a stage computes
+        usefully on M of each pass's ticks."""
+        pp, M = self._pp_degree, self._num_micro
+        C = self.model.config.num_layers // self._layer_chunk
+        V = C // pp
+        ticks = V * (pp + M - 1)
+        return {
+            "pp": pp, "num_micro": M, "layer_chunks": C,
+            "virtual_stages_per_rank": V,
+            "ring_ticks": ticks,
+            "useful_ticks_per_stage": V * M,
+            "bubble_ratio": (pp - 1) / (pp + M - 1),
+        }
+
+    # -- the ring forward/backward (replaces the base backward scan) ----
+    def _grads(self, state, ids, labels, t32, ct):
+        from .nonfinite_guard import all_finite
+
+        s, o = state["s"], state["o"]
+        axes, N = self._axes, self._degree
+        K = self._layer_chunk
+        n_layers = self.model.config.num_layers
+        C = n_layers // K
+        pp, M = self._pp_degree, self._num_micro
+        V = C // pp
+        quant = self._comm_quant
+        s_assign, o_assign = self._s_assign, self._o_assign
+        clip_norm = self._clip_global
+        guard = self._guard
+        rank = self._flat_rank()
+        chunk_apply = self._chunk_apply
+        pp_axis = self._pp_axis
+        stage = lax.axis_index(pp_axis)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        b, seq = ids.shape              # LOCAL (dp-shard) batch rows
+        if b % M:
+            raise ValueError(
+                f"local batch {b} not divisible by num_micro {M}")
+        mb = b // M
+        pos = jnp.arange(seq, dtype=ids.dtype)[None, :]
+
+        sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
+                     for a in s["p"])
+        own_idx = stage + pp * jnp.arange(V)   # round-robin ownership
+        own0 = tuple(jnp.take(a, own_idx, axis=0) for a in sp_c)
+
+        def one_pass(p_v, xs):
+            """One ring pass: every micro-batch through this pass's pp
+            stages. xs [M, mb, seq, h]; collected outputs land on stage
+            0 (the ring wraps the last stage back there)."""
+
+            def tick(carry, t):
+                st, outs = carry
+                take = jnp.clip(t, 0, M - 1)
+                fresh = lax.dynamic_index_in_dim(xs, take, 0,
+                                                 keepdims=False)
+                inp = jnp.where(stage == 0, fresh, st)
+                y = chunk_apply(p_v, inp, None)
+                passed = lax.ppermute(y, pp_axis, perm)
+                done = t - (pp - 1)
+                slot = jnp.clip(done, 0, M - 1)
+                outs = lax.cond(
+                    done >= 0,
+                    lambda o_: lax.dynamic_update_index_in_dim(
+                        o_, passed, slot, 0),
+                    lambda o_: o_, outs)
+                return (passed, outs), None
+
+            (_, outs), _ = lax.scan(
+                tick, (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+                jnp.arange(pp + M - 1))
+            return outs
+
+        def fwd_loss(own_p, o_p):
+            # embedding is pointwise over tokens: embed the full local
+            # batch once, then view as micro-batches
+            x0 = self._embed_fn(o_p, ids, pos)
+            xs = x0.reshape((M, mb) + tuple(x0.shape[1:]))
+            for v in range(V):
+                p_v = tuple(a[v] for a in own_p)
+                xs = one_pass(p_v, xs)
+                # between passes only stage 0's collected buffer is
+                # meaningful — and only stage 0 reads it (fresh inject)
+            # replicate the finished hiddens to every pp rank for the
+            # head (outer params are replicated; each rank computes the
+            # identical loss — the uniform ×pp joint factor)
+            y = lax.psum(jnp.where(stage == 0, xs, jnp.zeros_like(xs)),
+                         pp_axis)
+            yb = y.reshape((b,) + tuple(y.shape[2:]))
+            return self._head_fn(o_p, yb, labels)
+
+        loss, vjpf = jax.vjp(fwd_loss, own0, o["p"])
+        d_own, d_o = vjpf(ct.astype(loss.dtype))
+
+        # ---- per-chunk scatter over (dp..., pp): the pp leg of the sum
+        # selects the owner stage, the dp leg reduces data-parallel;
+        # only 1/pp of the layers' grads ever exist on a rank (d_own)
+        # and only the 1/N flat shards survive this loop
+        sq = jnp.float32(0.0)
+        fin = jnp.bool_(True)
+        G = []
+        for bkt in s_assign.buckets:
+            rows = []
+            for c in range(C):
+                v, owner = c // pp, c % pp
+                flat = pack_flat(lambda j, v=v: d_own[j][v], bkt,
+                                 lead=(K,))
+                contrib = jnp.where(stage == owner, flat,
+                                    jnp.zeros_like(flat))
+                gs = scatter_flat(contrib, axes, N, quant)   # [K, F/N]
+                if clip_norm is not None:
+                    nc = self._shard_of(self._s_hp[bkt.index][3], rank,
+                                        bkt.numel // N)
+                    sq = sq + self._sq_of(gs, nc)
+                if guard is not None:
+                    fin = fin & all_finite([gs])
+                rows.append(gs)
+            G.append(jnp.stack(rows))                        # [C, K, F/N]
+        G = tuple(G)
+
+        # ---- outer grads (embed cotangents are zero off stage 0, head
+        # cotangents live on every rank — the ×pp factor is uniform,
+        # see the module docstring)
+        o_gs = []
+        for bkt in o_assign.buckets:
+            flat = pack_flat(
+                lambda j: d_o[j].astype(jnp.float32), bkt)
+            gs = scatter_flat(flat, axes, N, quant)          # [F/N]
+            if clip_norm is not None:
+                nc = self._shard_of(self._o_hp[bkt.index][3], rank,
+                                    bkt.numel // N)
+                sq = sq + self._sq_of(gs, nc)
+            if guard is not None:
+                fin = fin & all_finite([gs])
+            o_gs.append(gs)
+        return loss, G, o_gs, sq, fin

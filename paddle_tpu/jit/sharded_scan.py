@@ -43,6 +43,25 @@ rank's batch shard and the returned loss is their mean — equal to the
 full-batch mean when every rank holds the same number of unmasked
 tokens (the standard data-parallel contract; ragged -100 masks make it
 a weighted mean, same as the reference DataParallel).
+
+Round 12 (ISSUE 8) generalized the whole machinery from ONE mesh axis
+to an axis tuple: grads scatter and params gather over the flattened
+(dp, mp[, pp]) product (first axis major — `_flat_rank` mirrors the
+tuple-collective split order), optimizer shards are 1/(dp·mp·pp), and
+the per-rank loss/grad carry a uniform ×(mp·pp) joint-vjp replication
+factor (every mp/pp rank computes the identical loss) that the 1/N
+normalization divides back out. On top of that ride:
+
+* dp×mp Megatron tensor parallelism (`mp_axis=`): `_setup_mp` compiles
+  the spmd_rules role table into per-leaf slicers (head-interleaved
+  qkv / column fc1 / row out_proj+fc2 with bias/mp) bound into the
+  SAME block template at trace time, one psum per row-parallel
+  projection, and the vocab-parallel sharded fused CE as `_head_fn` —
+  each rank's grads cover its slice (zero-padded), so the axis-tuple
+  scatter IS the tensor-parallel gradient assembly.
+* dp×pp ring pipelining: jit/pipeline_step.py overrides `_grads` (the
+  seam this module exposes) with the ppermute ring schedule and reuses
+  the clip/guard/update machinery unchanged.
 """
 from __future__ import annotations
 
@@ -82,17 +101,35 @@ def unpack_flat(flat, bucket):
             .reshape(lead + tuple(e.shape)) for e in bucket.entries}
 
 
-def scatter_flat(flat, axis, nranks, quant=""):
-    """Reduce-scatter a packed flat bucket over `axis` along its LAST
-    dim: one collective per bucket (vs one per leaf), bit-identical to
+def scatter_flat(flat, axes, nranks, quant=""):
+    """Reduce-scatter a packed flat bucket over `axes` (a single axis
+    name or a tuple — the dp×mp/pp hybrid steps scatter over the
+    FLATTENED product, first axis major) along its LAST dim: one
+    collective per bucket (vs one per leaf), bit-identical to
     comm_bucketer.bucketed_reduce_scatter's per-bucket psum_scatter on
-    the same packing. `quant` routes the compressed scatter leg."""
+    the same packing for the single-axis case. `quant` routes the
+    compressed scatter leg (single-axis only — the all_to_all wire
+    format is not defined over a flattened product)."""
+    if isinstance(axes, (tuple, list)) and len(axes) == 1:
+        axes = axes[0]
     if quant:
+        if isinstance(axes, (tuple, list)):
+            raise ValueError(
+                "FLAGS_comm_quant scatter supports a single mesh axis; "
+                "disable comm quant for dp×mp / dp×pp hybrid steps")
         from ..distributed.collective import quantized_psum_scatter_traced
 
-        return quantized_psum_scatter_traced(axis, nranks, quant)(flat)
-    return lax.psum_scatter(flat, axis, scatter_dimension=flat.ndim - 1,
+        return quantized_psum_scatter_traced(axes, nranks, quant)(flat)
+    return lax.psum_scatter(flat, axes, scatter_dimension=flat.ndim - 1,
                             tiled=True)
+
+
+def gather_flat(shard, axes, axis):
+    """Inverse of `scatter_flat`'s split: tiled all_gather over the same
+    (possibly flattened) axes."""
+    if isinstance(axes, (tuple, list)) and len(axes) == 1:
+        axes = axes[0]
+    return lax.all_gather(shard, axes, axis=axis, tiled=True)
 
 
 def _unwrap_layers(model):
@@ -119,26 +156,36 @@ def _vec_or_scalar(values, entries, numel, pad_value=0.0):
 
 
 class ShardedFusedScanTrainStep(FusedScanTrainStep):
-    """Multi-chip FusedScanTrainStep over a dp/sharding mesh axis.
+    """Multi-chip FusedScanTrainStep over a dp/sharding mesh axis —
+    and, with ``mp_axis``, a 2-D dp×mp mesh with Megatron tensor
+    parallelism inside the scan body.
 
-    Usage (directly, or via GroupShardedStage2.train_step /
-    fleet ShardingParallel.train_step which resolve mesh+axis)::
+    Usage (directly, or via fleet distributed_model /
+    jit.select_train_step which resolve mesh+axes)::
 
         mesh = dist.env.build_mesh({"sharding": 8}); dist.env.set_mesh(mesh)
         step = ShardedFusedScanTrainStep(model, opt)   # scan_layers model
         loss = step(ids, labels)       # ids [global_batch, seq]
 
+        mesh = dist.env.build_mesh({"dp": 4, "mp": 2})  # dp×mp hybrid
+        step = ShardedFusedScanTrainStep(model, opt, mesh=mesh,
+                                         axis="dp", mp_axis="mp")
+
     Optimizer state (moments + masters) lives as flat bucket-packed
-    arrays sharded 1/N over the axis (inspect
-    `opt._accumulators["moment1"]["__scan_shard_s0__"]` etc.);
+    arrays sharded 1/N over the FLATTENED reduction axes (N = dp·mp;
+    inspect `opt._accumulators["moment1"]["__scan_shard_s0__"]` etc.);
     ClipGradByGlobalNorm costs one scalar all-reduce, ClipGradByValue is
     elementwise on the shard, and dropout is rank-folded per layer.
+    Under mp the block compute runs head-/column-/row-sliced per rank
+    with one psum per row-parallel projection, and the LM head is the
+    vocab-parallel sharded fused CE (see _setup_mp / _head_fn).
     """
 
     def __init__(self, model, optimizer, criterion=None, fused_head=False,
                  compute_dtype=None, layer_chunk=1, scan_unroll=1,
-                 mesh=None, axis=None, group=None, comm_bucket_mb=None,
-                 comm_quant=None, scaler=None, guard_nonfinite=None):
+                 mesh=None, axis=None, mp_axis=None, group=None,
+                 comm_bucket_mb=None, comm_quant=None, scaler=None,
+                 guard_nonfinite=None):
         model = _unwrap_layers(model)
         super().__init__(model, optimizer, criterion=criterion,
                          fused_head=fused_head,
@@ -152,20 +199,82 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         if mesh is None:
             mesh = denv.get_mesh()
         if axis is None:
+            # prefer a >1 data axis; else a PRESENT degree-1 dp/sharding
+            # axis (a dp1×pp2 mesh still batches over "dp", not "pp");
+            # else the first mesh axis
             axis = next((a for a in ("sharding", "dp")
                          if a in mesh.axis_names and mesh.shape[a] > 1),
-                        mesh.axis_names[0])
-        self._mesh, self._axis = mesh, axis
-        self._degree = int(mesh.shape[axis])
-        if self._degree <= 1:
+                        None) or next(
+                (a for a in ("sharding", "dp")
+                 if a in mesh.axis_names), mesh.axis_names[0])
+        if mp_axis is None:
+            mp_axis = next((a for a in ("mp",)
+                            if a in mesh.axis_names and a != axis
+                            and mesh.shape[a] > 1), None)
+        elif mp_axis not in mesh.axis_names or \
+                int(mesh.shape[mp_axis]) <= 1:
+            mp_axis = None
+        if mp_axis is not None and mp_axis == axis:
             raise ValueError(
-                f"axis {axis!r} has degree {self._degree}; weight-update "
-                "sharding needs a >1 dp/sharding axis — use "
-                "FusedScanTrainStep on one chip")
-        # dp-rank folded into the per-layer dropout offsets
-        self._rng_nranks = self._degree
+                f"mp_axis {mp_axis!r} is also the batch/data axis — a "
+                "pure-mp mesh has no axis to shard the batch over; "
+                "build the mesh with an explicit (degree-1 is fine) "
+                "data axis, e.g. build_mesh({'dp': 1, 'mp': N})")
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"batch/data axis {axis!r} is not a mesh axis "
+                f"(mesh axes: {mesh.axis_names}); include it in the "
+                "mesh (degree 1 is fine) or pass axis= explicitly")
+        self._mesh, self._axis = mesh, axis
+        self._mp_axis = mp_axis
+        self._dp_degree = int(mesh.shape[axis])
+        self._mp_degree = int(mesh.shape[mp_axis]) if mp_axis else 1
+        # grad-reduction axes, FIRST AXIS MAJOR: every flat bucket
+        # scatters/gathers over the flattened product, so optimizer
+        # shards are 1/(dp*mp); the flat rank below must match the
+        # tuple-collective split order. Subclasses (the pipeline step)
+        # append further axes via _extra_reduction_axes.
+        self._axes = (axis,) if mp_axis is None else (axis, mp_axis)
+        self._degree = self._dp_degree * self._mp_degree
+        for a in self._extra_reduction_axes(mesh):
+            if a in self._axes:
+                raise ValueError(
+                    f"reduction axis {a!r} doubles as the batch/data "
+                    f"axis (resolved axes {self._axes}) — a pp-only "
+                    "mesh has no axis to shard the batch over; build "
+                    "the mesh with an explicit (degree-1 is fine) data "
+                    "axis, e.g. build_mesh({'dp': 1, 'pp': N})")
+            self._axes = self._axes + (a,)
+            self._degree *= int(mesh.shape[a])
+        if self._degree <= 1 and not getattr(
+                self, "_allow_degree_one", False):
+            raise ValueError(
+                f"axes {self._axes!r} have total degree {self._degree}; "
+                "weight-update sharding needs a >1 dp/sharding (or mp) "
+                "axis — use FusedScanTrainStep on one chip")
+        # dp-rank folded into the per-layer dropout offsets. mp ranks
+        # MUST draw identical masks (they jointly compute the same batch
+        # rows; divergent hidden-dropout masks would desynchronize the
+        # replicated residual stream), so only the dp index folds in.
+        self._rng_nranks = self._dp_degree
+        if mp_axis is not None:
+            self._setup_mp()
+        from_flag = comm_quant is None
         if comm_quant is None:
             comm_quant = _flags.get_flag("FLAGS_comm_quant") or ""
+        if comm_quant and len(self._axes) > 1:
+            if not from_flag:
+                raise ValueError(
+                    "comm_quant int8/bf16 scatter is single-axis; the "
+                    "all_to_all wire format is not defined over the "
+                    "flattened dp×mp/pp product")
+            import warnings
+
+            warnings.warn(
+                "FLAGS_comm_quant is single-axis; disabled for this "
+                f"hybrid step over {self._axes}", RuntimeWarning,
+                stacklevel=2)
+            comm_quant = ""
         self._comm_quant = comm_quant
         from ..distributed.collective import QUANT_SCATTER_BLOCK
         from ..distributed.comm_bucketer import MB, build_buckets
@@ -191,6 +300,230 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
     def _rng_rank(self):
         return lax.axis_index(self._axis)
+
+    def _extra_reduction_axes(self, mesh):
+        """Hook: further mesh axes the grad scatter / optimizer shard
+        should flatten in (the pipeline step adds its pp axis)."""
+        return ()
+
+    def _flat_rank(self):
+        """Flattened rank over the grad-reduction axes, first axis
+        major — the split order of tuple-axis psum_scatter/all_gather
+        (verified against jax's flattened-product layout)."""
+        r = lax.axis_index(self._axes[0])
+        for a in self._axes[1:]:
+            r = r * int(self._mesh.shape[a]) + lax.axis_index(a)
+        return r
+
+    # -- Megatron tensor parallelism over the mp axis --------------------
+    # Storage stays replicated (the weight-update-sharding design:
+    # optimizer state, grads and the update are what shard); COMPUTE is
+    # tensor-parallel: each mp rank binds head-/column-sliced views of
+    # qkv+fc1 and row-sliced views of out_proj+fc2 into the block
+    # template, and the two row-parallel outputs psum over mp inside the
+    # block — the Megatron layout the SPMD rule table
+    # (distributed/auto_parallel/spmd_rules.py) assigns, realized as
+    # manual collectives inside the scan body.
+    def _setup_mp(self):
+        from ..distributed.auto_parallel.spmd_rules import (
+            _assign_roles, _is_fused_proj,
+        )
+
+        mp = self._mp_degree
+        tmpl = self._template
+        cfg = self.model.config
+        if cfg.num_attention_heads % mp:
+            raise ValueError(
+                f"num_attention_heads {cfg.num_attention_heads} not "
+                f"divisible by mp degree {mp}")
+        if cfg.vocab_size % mp:
+            raise ValueError(
+                f"vocab_size {cfg.vocab_size} not divisible by mp "
+                f"degree {mp} (vocab-parallel LM head)")
+        if getattr(cfg, "attention_dropout_prob", 0.0):
+            raise ValueError(
+                "attention dropout under mp>1 would draw the same mask "
+                "stream for every rank's head slice; train with "
+                "attention_dropout_prob=0 (hidden dropout is fine)")
+        from ..models.gpt import GPTPretrainingCriterion
+
+        if not isinstance(self._crit, GPTPretrainingCriterion):
+            raise ValueError(
+                "mp>1 routes the LM head through the vocab-parallel "
+                "sharded fused CE; custom criteria are not representable "
+                "there — use the default GPTPretrainingCriterion")
+        # sublayer path -> object, for role/ownership lookups
+        subs = dict(tmpl.named_sublayers(include_self=True))
+        roles = _assign_roles(tmpl)
+
+        def owner_of(pname):
+            path = pname.rsplit(".", 1)[0] if "." in pname else ""
+            return subs.get(path), path
+
+        def head_slicer(nh, hd, dim):
+            """Head-interleaved slice of a fused multi-projection dim
+            (qkv [.., 3*nh*hd]): view [.., 3, nh, hd], slice nh."""
+            nh_loc = nh // mp
+
+            def fn(d, r):
+                lead = d.shape[:dim]
+                k = d.shape[dim] // (nh * hd)
+                v = d.reshape(lead + (k, nh, hd))
+                v = lax.dynamic_slice_in_dim(v, r * nh_loc, nh_loc,
+                                             dim + 1)
+                return v.reshape(lead + (k * nh_loc * hd,))
+
+            return fn
+
+        def dim_slicer(dim, degree=mp):
+            def fn(d, r):
+                loc = d.shape[dim] // degree
+                return lax.dynamic_slice_in_dim(d, r * loc, loc, dim)
+
+            return fn
+
+        slicers = []
+        row_parallel = []          # (parent path, attr name)
+        for pname, p in tmpl.named_parameters():
+            sub, path = owner_of(pname)
+            role = roles.get(id(sub)) if sub is not None else None
+            tname = type(sub).__name__ if sub is not None else ""
+            leaf = pname.rsplit(".", 1)[-1]
+            if tname == "Linear" and role == "column":
+                parent_path = path.rsplit(".", 1)[0] if "." in path \
+                    else ""
+                parent = subs.get(parent_path)
+                nh = getattr(parent, "num_heads", None)
+                hd = getattr(parent, "head_dim", None)
+                fused = _is_fused_proj(sub, attr_name=path.rsplit(
+                    ".", 1)[-1])
+                if fused and not (nh and hd):
+                    raise ValueError(
+                        f"{pname}: fused multi-projection column layer "
+                        "needs a parent exposing num_heads/head_dim for "
+                        "the head-interleaved mp slice (a contiguous "
+                        "column slice would split q|k|v wrongly)")
+                if fused:
+                    slicers.append(head_slicer(nh, hd,
+                                               0 if leaf == "bias"
+                                               else 1))
+                elif leaf == "weight":
+                    if sub.weight.shape[1] % mp:
+                        raise ValueError(
+                            f"{pname}: out dim {sub.weight.shape[1]} "
+                            f"not divisible by mp {mp}")
+                    slicers.append(dim_slicer(1))
+                else:
+                    slicers.append(dim_slicer(0))
+            elif tname == "Linear" and role == "row":
+                if leaf == "weight":
+                    slicers.append(dim_slicer(0))
+                else:
+                    # row-parallel bias: every rank adds bias/mp, the
+                    # in-block psum reconstructs it once (exact in real
+                    # arithmetic; fp noise is far under the parity bar)
+                    inv = 1.0 / mp
+                    slicers.append(lambda d, r, inv=inv: d * inv)
+                if leaf == "weight":
+                    parent_path = path.rsplit(".", 1)[0] if "." in path \
+                        else ""
+                    row_parallel.append((subs.get(parent_path),
+                                         path.rsplit(".", 1)[-1]))
+            else:
+                slicers.append(None)       # replicated (norms etc.)
+        self._mp_slicers = slicers
+        self._mp_row_parallel = [(o, a) for o, a in row_parallel
+                                 if o is not None]
+        # attention modules whose head count narrows to nh/mp while the
+        # local views are bound
+        self._mp_heads = [
+            (s, int(s.num_heads)) for _, s in subs.items()
+            if hasattr(s, "num_heads") and hasattr(s, "head_dim")
+            and isinstance(getattr(s, "num_heads"), int)
+            and s.num_heads % mp == 0
+        ]
+
+    class _RowParallelPsum:
+        """Call-through shim over a row-parallel Linear: local partial
+        matmul (+ bias/mp), then one psum over the mp axis — the
+        Megatron g-operator, inserted at trace time."""
+
+        __slots__ = ("_inner", "_axis")
+
+        def __init__(self, inner, axis):
+            self._inner, self._axis = inner, axis
+
+        def __call__(self, x):
+            from ..framework.tensor import Tensor
+
+            y = self._inner(x)
+            return Tensor._wrap(lax.psum(y._data, self._axis))
+
+    def _block_fn(self, leaf_datas, x, rng_off=None):
+        if self._mp_axis is None:
+            return super()._block_fn(leaf_datas, x, rng_off=rng_off)
+        r = lax.axis_index(self._mp_axis)
+        local = [d if fn is None else fn(d, r)
+                 for fn, d in zip(self._mp_slicers, leaf_datas)]
+        mp = self._mp_degree
+        patched = []
+        try:
+            for obj, attr in self._mp_row_parallel:
+                inner = getattr(obj, attr)
+                object.__setattr__(
+                    obj, attr, self._RowParallelPsum(inner,
+                                                     self._mp_axis))
+                patched.append((obj, attr))
+            for obj, nh in self._mp_heads:
+                object.__setattr__(obj, "num_heads", nh // mp)
+            return super()._block_fn(local, x, rng_off=rng_off)
+        finally:
+            for obj, attr in patched:
+                object.__delattr__(obj, attr)
+            for obj, nh in self._mp_heads:
+                object.__setattr__(obj, "num_heads", nh)
+
+    def _head_fn(self, o_datas, xL, labels):
+        """Vocab-parallel LM head under mp: ln_f on the replicated
+        hiddens, then the PR-7 vocab-tiled fused CE over THIS rank's
+        [vocab/mp, H] row shard of the head — per-rank losses are
+        identical (the shard stats combine over mp inside the kernel's
+        custom vjp), and the head grads each rank produces cover exactly
+        its shard rows (zero-padded elsewhere), which is what lets the
+        ordinary (dp, mp) grad scatter reassemble them with no full
+        [vocab, H] gradient ever built."""
+        if self._mp_axis is None:
+            return super()._head_fn(o_datas, xL, labels)
+        import jax.numpy as jnp
+
+        from ..framework.autograd import no_grad
+        from ..framework.tensor import Tensor
+        from ..ops.pallas.fused_cross_entropy import (
+            sharded_fused_cross_entropy,
+        )
+
+        m = self.model
+        with no_grad():
+            saved = self._bind([p for _, p in self._o_params],
+                               self._cc(o_datas))
+            try:
+                h = m.gpt.ln_f(Tensor._wrap(xL))._data
+                if m.lm_head is None:
+                    w = m.gpt.wte.weight._data           # [V, H]
+                else:
+                    w = m.lm_head.weight._data.T         # [H, V] -> [V, H]
+                vloc = w.shape[0] // self._mp_degree
+                r = lax.axis_index(self._mp_axis)
+                wl = lax.dynamic_slice_in_dim(w, r * vloc, vloc, 0)
+                hid = h.reshape(-1, h.shape[-1])
+                lbl = labels.reshape(-1)
+                losses = sharded_fused_cross_entropy(
+                    hid, wl, lbl, r * vloc, self._mp_axis)
+                mask = (lbl != -100).astype(losses.dtype)
+                return jnp.sum(losses * mask) / jnp.clip(
+                    jnp.sum(mask), 1.0, None)
+            finally:
+                self._bind([p for _, p in self._o_params], saved)
 
     def input_sharding(self):
         """Batches stage dim-0-sharded 1/N over the dp axis — each device
@@ -223,7 +556,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         full-shape entries once. Idempotent: an existing flat entry
         (second build, checkpoint restore) is reused as-is."""
         opt = self._opt
-        mesh, ax = self._mesh, self._axis
+        mesh = self._mesh
+        ax = self._axes if len(self._axes) > 1 else self._axis
         n_layers = self.model.config.num_layers
         for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
             stacked = grp == "s"
@@ -345,7 +679,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             self._guard.writeback(state["guard"])
 
     def _state_specs(self):
-        ax = self._axis
+        ax = self._axes if len(self._axes) > 1 else self._axis
         rep = P()
         specs = {
             "s": {"p": [rep] * len(self._s_params)},
@@ -367,15 +701,10 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         return specs
 
     # -- the compiled sharded step --------------------------------------
-    def _build(self):
+    def _build_prologue(self):
+        """Host-side per-bucket hyperparameter tables shared by the
+        grads pass and the update scan (built once per _build)."""
         opt = self._opt
-        mesh, ax, N = self._mesh, self._axis, self._degree
-        K = self._layer_chunk
-        n_layers = self.model.config.num_layers
-        C = n_layers // K
-        quant = self._comm_quant
-        s_assign, o_assign = self._s_assign, self._o_assign
-        inv_n = 1.0 / N
 
         def hyper(p):
             return (float(opt._decoupled_wd(p)), float(opt._l2_coeff(p)),
@@ -397,30 +726,156 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                   else _vec_or_scalar(ncs, ent, bucket.numel))
             return wd, l2, lrs, nc
 
-        s_hp = [bucket_hp("s", b) for b in s_assign.buckets]
-        o_hp = [bucket_hp("o", b) for b in o_assign.buckets]
-        s_mw = [self._bucket_uses_master("s", b) for b in s_assign.buckets]
-        o_mw = [self._bucket_uses_master("o", b) for b in o_assign.buckets]
-        t_idx = {j: tj for tj, (j, _) in enumerate(self._s_train)}
+        self._s_hp = [bucket_hp("s", b) for b in self._s_assign.buckets]
+        self._o_hp = [bucket_hp("o", b) for b in self._o_assign.buckets]
+        self._t_idx = {j: tj for tj, (j, _)
+                       in enumerate(self._s_train)}
+
+    @staticmethod
+    def _shard_of(vec, rank, shard_len):
+        """Own-rank slice of a replicated flat [F] constant (no-op for
+        uniform scalars)."""
+        if vec is None or isinstance(vec, float):
+            return vec
+        return lax.dynamic_slice_in_dim(vec, rank * shard_len,
+                                        shard_len, 0)
+
+    def _sq_of(self, gs, nc_shard):
+        g32 = gs.astype(jnp.float32) * (1.0 / self._degree)
+        if nc_shard is not None:
+            g32 = g32 * nc_shard
+        return jnp.sum(jnp.square(g32))
+
+    def _grads(self, state, ids, labels, t32, ct):
+        """Forward + backward producing the SCATTERED gradient shards:
+        returns (loss, G, o_gs, sq, fin) where G[bucket] is [C, K, F/N]
+        (this rank's 1/N shard per layer chunk), o_gs[bucket] is [F/N],
+        sq the local shard's squared-norm contribution and fin the local
+        finiteness fold. Default implementation is the in-scan
+        reduce-scatter backward; the pipeline step overrides this with
+        the ring schedule while reusing everything downstream."""
+        from .nonfinite_guard import all_finite
+
+        s, o = state["s"], state["o"]
+        axes, N = self._axes, self._degree
+        K = self._layer_chunk
+        n_layers = self.model.config.num_layers
+        C = n_layers // K
+        quant = self._comm_quant
+        s_assign, o_assign = self._s_assign, self._o_assign
+        clip_norm = self._clip_global
+        guard = self._guard
+        rank = self._flat_rank()
+        chunk_apply = self._chunk_apply
+        b, seq = ids.shape          # LOCAL batch rows
+        pos = jnp.arange(seq, dtype=ids.dtype)[None, :]
+
+        # ---- forward (replicated params, local batch shard)
+        x0 = self._embed_fn(o["p"], ids, pos,
+                            rng_off=self._rng_base(t32, n_layers))
+        sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
+                     for a in s["p"])
+
+        def fwd_body(h, scanned):
+            p_chunk, i = scanned
+            return chunk_apply(p_chunk, h,
+                               self._rng_chunk_base(t32, i)), h
+
+        xL, xs = lax.scan(fwd_body, x0, (sp_c, jnp.arange(C)),
+                          unroll=self._scan_unroll)
+
+        loss, head_vjp = jax.vjp(
+            lambda od, x: self._head_fn(od, x, labels),
+            o["p"], xL)
+        d_o_head, dxL = head_vjp(ct.astype(loss.dtype))
+
+        # ---- backward scan: vjp one chunk, reduce-scatter its
+        # bucket-packed grads over the FLATTENED reduction axes (dp, or
+        # dp×mp); ONLY the 1/N shard, the running squared norm, and the
+        # finiteness fold survive the iteration. Under mp the per-rank
+        # dp covers only the rank's head/column slice (zero-padded
+        # elsewhere), so the axis-tuple sum is simultaneously the
+        # data-parallel reduction AND the tensor-parallel grad
+        # assembly — no full-gradient gather exists at any point.
+        G0 = tuple(jnp.zeros((C, K, bkt.numel // N), bkt.dtype)
+                   for bkt in s_assign.buckets)
+
+        def bwd_body(carry, scanned):
+            dy, sq, fin, G = carry
+            x_i, i = scanned
+            p_i = tuple(
+                lax.dynamic_index_in_dim(a, i, keepdims=False)
+                for a in sp_c)
+            rng0 = self._rng_chunk_base(t32, i)
+            _, vjp = jax.vjp(
+                lambda pl, xx: chunk_apply(pl, xx, rng0),
+                p_i, x_i)
+            dp, dx = vjp(dy)
+            newG = []
+            for bkt in s_assign.buckets:
+                flat = pack_flat(lambda j: dp[j], bkt, lead=(K,))
+                gs = scatter_flat(flat, axes, N, quant)  # [K,F/N]
+                if clip_norm is not None:
+                    nc = self._shard_of(self._s_hp[bkt.index][3], rank,
+                                        bkt.numel // N)
+                    sq = sq + self._sq_of(gs, nc)
+                if guard is not None:
+                    fin = fin & all_finite([gs])
+                newG.append(lax.dynamic_update_index_in_dim(
+                    G[bkt.index], gs, i, 0))
+            return (dx, sq, fin, tuple(newG)), None
+
+        (dx0, sq, fin, G), _ = lax.scan(
+            bwd_body,
+            (dxL, jnp.float32(0.0), jnp.bool_(True), G0),
+            (xs, jnp.arange(C)), reverse=True,
+            unroll=self._scan_unroll)
+
+        # ---- outer grads: same pack + reduce-scatter
+        _, emb_vjp = jax.vjp(
+            lambda od: self._embed_fn(
+                od, ids, pos,
+                rng_off=self._rng_base(t32, n_layers)), o["p"])
+        (d_o_emb,) = emb_vjp(dx0)
+        o_gs = []
+        for bkt in o_assign.buckets:
+            flat = pack_flat(
+                lambda j: (d_o_head[j].astype(jnp.float32)
+                           + d_o_emb[j].astype(jnp.float32)),
+                bkt)
+            gs = scatter_flat(flat, axes, N, quant)      # [F/N]
+            if clip_norm is not None:
+                nc = self._shard_of(self._o_hp[bkt.index][3], rank,
+                                    bkt.numel // N)
+                sq = sq + self._sq_of(gs, nc)
+            if guard is not None:
+                fin = fin & all_finite([gs])
+            o_gs.append(gs)
+        return loss, G, o_gs, sq, fin
+
+    def _build(self):
+        opt = self._opt
+        mesh, N = self._mesh, self._degree
+        axes = self._axes
+        K = self._layer_chunk
+        n_layers = self.model.config.num_layers
+        C = n_layers // K
+        s_assign, o_assign = self._s_assign, self._o_assign
+        inv_n = 1.0 / N
+        self._build_prologue()
+        s_hp, o_hp = self._s_hp, self._o_hp
+        t_idx = self._t_idx
         cv = self._clip_value
         clip_norm = self._clip_global
         guard = self._guard
         scaling = guard is not None and guard.scaling
-
-        def shard_of(vec, rank, shard_len):
-            """Own-rank slice of a replicated flat [F] constant (no-op
-            for uniform scalars)."""
-            if vec is None or isinstance(vec, float):
-                return vec
-            return lax.dynamic_slice_in_dim(vec, rank * shard_len,
-                                            shard_len, 0)
-
-        chunk_apply = self._chunk_apply
+        shard_of = self._shard_of
 
         def g_shard_f32(gs, nc_shard, scale, inv_s=None):
             """Scatter output -> the fp32 gradient the update consumes:
-            1/N for the data-parallel mean, loss-scale unscale, value
-            clip, global-norm scale (need_clip-masked)."""
+            1/N for the data-parallel mean (and the uniform replication
+            factor the joint mp/pp vjp carries), loss-scale unscale,
+            value clip, global-norm scale (need_clip-masked)."""
             g32 = gs.astype(jnp.float32) * inv_n
             if inv_s is not None:
                 g32 = g32 * inv_s
@@ -433,12 +888,6 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                        else nc_shard * scale + (1 - nc_shard))
                 g32 = g32 * eff
             return g32
-
-        def sq_of(gs, nc_shard):
-            g32 = gs.astype(jnp.float32) * inv_n
-            if nc_shard is not None:
-                g32 = g32 * nc_shard
-            return jnp.sum(jnp.square(g32))
 
         def adam_shard(pv, g32, m, v, lr_lrs, tf, wd, l2):
             if not (isinstance(l2, float) and l2 == 0.0):
@@ -460,95 +909,14 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 t = state["step"] + 1
                 tf = t.astype(jnp.float32)
                 t32 = t.astype(jnp.int32)
-                rank = lax.axis_index(ax)
-                b, seq = ids.shape          # LOCAL batch rows
-                pos = jnp.arange(seq, dtype=ids.dtype)[None, :]
+                rank = self._flat_rank()
+                ct = (gst["scale"] if scaling
+                      else jnp.ones((), jnp.float32))
 
-                # ---- forward (replicated params, local batch shard)
-                x0 = self._embed_fn(o["p"], ids, pos,
-                                    rng_off=self._rng_base(t32, n_layers))
+                loss, G, o_gs, sq, fin = self._grads(
+                    state, ids, labels, t32, ct)
                 sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
                              for a in s["p"])
-
-                def fwd_body(h, scanned):
-                    p_chunk, i = scanned
-                    return chunk_apply(p_chunk, h,
-                                       self._rng_chunk_base(t32, i)), h
-
-                xL, xs = lax.scan(fwd_body, x0, (sp_c, jnp.arange(C)),
-                                  unroll=self._scan_unroll)
-
-                loss, head_vjp = jax.vjp(
-                    lambda od, x: self._head_fn(od, x, labels),
-                    o["p"], xL)
-                ct = (gst["scale"].astype(loss.dtype) if scaling
-                      else jnp.ones((), loss.dtype))
-                d_o_head, dxL = head_vjp(ct)
-
-                # ---- backward scan: vjp one chunk, reduce-scatter its
-                # bucket-packed grads; ONLY the 1/N shard, the running
-                # squared norm, and the finiteness fold survive the
-                # iteration. Unlike the single-device step, the guard
-                # needs NO second backward here: the shards it must
-                # inspect all outlive the scan anyway (sum-reductions
-                # preserve non-finiteness, so checking the post-scatter
-                # 1/N shard covers every element at 1/N the cost).
-                from .nonfinite_guard import all_finite
-
-                G0 = tuple(jnp.zeros((C, K, bkt.numel // N), bkt.dtype)
-                           for bkt in s_assign.buckets)
-
-                def bwd_body(carry, scanned):
-                    dy, sq, fin, G = carry
-                    x_i, i = scanned
-                    p_i = tuple(
-                        lax.dynamic_index_in_dim(a, i, keepdims=False)
-                        for a in sp_c)
-                    rng0 = self._rng_chunk_base(t32, i)
-                    _, vjp = jax.vjp(
-                        lambda pl, xx: chunk_apply(pl, xx, rng0),
-                        p_i, x_i)
-                    dp, dx = vjp(dy)
-                    newG = []
-                    for bkt in s_assign.buckets:
-                        flat = pack_flat(lambda j: dp[j], bkt, lead=(K,))
-                        gs = scatter_flat(flat, ax, N, quant)  # [K,F/N]
-                        if clip_norm is not None:
-                            nc = shard_of(s_hp[bkt.index][3], rank,
-                                          bkt.numel // N)
-                            sq = sq + sq_of(gs, nc)
-                        if guard is not None:
-                            fin = fin & all_finite([gs])
-                        newG.append(lax.dynamic_update_index_in_dim(
-                            G[bkt.index], gs, i, 0))
-                    return (dx, sq, fin, tuple(newG)), None
-
-                (dx0, sq, fin, G), _ = lax.scan(
-                    bwd_body,
-                    (dxL, jnp.float32(0.0), jnp.bool_(True), G0),
-                    (xs, jnp.arange(C)), reverse=True,
-                    unroll=self._scan_unroll)
-
-                # ---- outer grads: same pack + reduce-scatter
-                _, emb_vjp = jax.vjp(
-                    lambda od: self._embed_fn(
-                        od, ids, pos,
-                        rng_off=self._rng_base(t32, n_layers)), o["p"])
-                (d_o_emb,) = emb_vjp(dx0)
-                o_gs = []
-                for bkt in o_assign.buckets:
-                    flat = pack_flat(
-                        lambda j: (d_o_head[j].astype(jnp.float32)
-                                   + d_o_emb[j].astype(jnp.float32)),
-                        bkt)
-                    gs = scatter_flat(flat, ax, N, quant)      # [F/N]
-                    if clip_norm is not None:
-                        nc = shard_of(o_hp[bkt.index][3], rank,
-                                      bkt.numel // N)
-                        sq = sq + sq_of(gs, nc)
-                    if guard is not None:
-                        fin = fin & all_finite([gs])
-                    o_gs.append(gs)
 
                 # ---- the fused global-norm clip + cross-rank found_inf:
                 # still ONE scalar all-reduce (a length-2 psum when the
@@ -558,7 +926,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 if clip_norm is not None or guard is not None:
                     bad_local = (jnp.float32(0.0) if guard is None
                                  else (~fin).astype(jnp.float32))
-                    tot = lax.psum(jnp.stack([sq, bad_local]), ax)
+                    tot = lax.psum(jnp.stack([sq, bad_local]), axes)
                     if guard is not None:
                         found = tot[1] > 0
                     if clip_norm is not None:
@@ -630,9 +998,9 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                         if MW[bi] is not None:
                             MW[bi] = lax.dynamic_update_index_in_dim(
                                 MW[bi], out32, i, 0)
-                        full = lax.all_gather(
-                            out32.astype(bkt.dtype), ax, axis=1,
-                            tiled=True)                     # [K, F]
+                        full = gather_flat(
+                            out32.astype(bkt.dtype), axes,
+                            axis=1)                         # [K, F]
                         for e_key, leaf in unpack_flat(full, bkt).items():
                             tj = t_idx[e_key]
                             P_tr = P_tr[:tj] + (
@@ -677,8 +1045,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                     new_ov.append(vn.astype(v_i.dtype))
                     new_omw.append(out32 if o["mw"][bi] is not None
                                    else None)
-                    full = lax.all_gather(out32.astype(bkt.dtype), ax,
-                                          axis=0, tiled=True)
+                    full = gather_flat(out32.astype(bkt.dtype), axes,
+                                       axis=0)
                     for e_key, leaf in unpack_flat(full, bkt).items():
                         new_op[e_key] = leaf.astype(
                             o["p"][e_key].dtype)
@@ -698,13 +1066,16 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 }
                 if guard is not None:
                     new_state["guard"] = guard.update(gst, found)
-                return lax.psum(loss, ax) * inv_n, new_state
+                # loss identical across mp/pp ranks -> the axis-tuple
+                # psum over-counts by exactly the replication factor the
+                # inv_n (= 1/(dp*mp)) divides back out: a dp-mean
+                return lax.psum(loss, axes) * inv_n, new_state
             finally:
                 seg_ctx.__exit__(None, None, None)
                 self._bind(self._buffers, saved_buf)
 
         specs = self._state_specs()
-        batch_spec = P(ax, None)
+        batch_spec = P(self._axis, None)
         # the trailing batch_spec covers the optional segment-id arg —
         # a None there is an empty pytree, so the spec binds no leaves
         wrapped = jax.shard_map(
@@ -714,12 +1085,56 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         self._jitted = jax.jit(wrapped,
                                donate_argnums=_donate_argnums())
 
+    def grads_probe(self, ids, labels):
+        """Test/debug surface: run ONLY the grads pass and return
+        (loss, stacked_grads, outer_grads) as FULL (gathered, 1/N-
+        normalized = dp-mean) fp32 flat buckets — stacked_grads[b] is
+        [C, K, bucket.numel], outer_grads[b] is [bucket.numel]. Lets
+        tests compare gradient content across mesh layouts without
+        reverse-engineering shard layouts. Not used by training."""
+        from ..framework.tensor import Tensor
+
+        self.ensure_built()
+        state = self._extract_state()
+        ids_d = ids._data if isinstance(ids, Tensor) else ids
+        lab_d = labels._data if isinstance(labels, Tensor) else labels
+        specs = self._state_specs()
+        axes = self._axes
+        inv = 1.0 / self._degree
+        ns = len(self._s_assign.buckets)
+        no = len(self._o_assign.buckets)
+
+        def fn(state, ids, labels):
+            saved_buf = self._bind(self._buffers, state["buf"])
+            try:
+                t32 = state["step"].astype(jnp.int32) + 1
+                ct = jnp.ones((), jnp.float32)
+                loss, G, o_gs, _, _ = self._grads(state, ids, labels,
+                                                  t32, ct)
+                Gf = tuple(
+                    gather_flat(g.astype(jnp.float32) * inv, axes,
+                                axis=g.ndim - 1) for g in G)
+                of = tuple(
+                    gather_flat(g.astype(jnp.float32) * inv, axes,
+                                axis=0) for g in o_gs)
+                return lax.psum(loss, axes) * inv, Gf, of
+            finally:
+                self._bind(self._buffers, saved_buf)
+
+        batch_spec = P(self._axis, None)
+        wrapped = jax.shard_map(
+            fn, mesh=self._mesh,
+            in_specs=(specs, batch_spec, batch_spec),
+            out_specs=(P(), (P(),) * ns, (P(),) * no),
+            check_vma=False)
+        return jax.jit(wrapped)(state, ids_d, lab_d)
+
     def __call__(self, ids, labels, segment_ids=None):
         shape = getattr(ids, "shape", None)
-        if shape and shape[0] % self._degree:
+        if shape and shape[0] % self._dp_degree:
             raise ValueError(
                 f"global batch {shape[0]} is not divisible by the "
-                f"{self._axis!r} degree {self._degree}")
+                f"{self._axis!r} degree {self._dp_degree}")
         return super().__call__(ids, labels, segment_ids=segment_ids)
 
 
@@ -728,20 +1143,95 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 # ---------------------------------------------------------------------------
 
 def select_train_step(model, optimizer, criterion=None, mesh=None,
-                      axis=None, **kw):
-    """The train-step chooser GroupShardedStage2 / ShardingParallel use:
-    scan_layers GPT on a >1 sharding/dp axis -> ShardedFusedScanTrainStep;
-    degree 1 -> FusedScanTrainStep; anything else -> the generic
-    TrainStep over `criterion` (or model.loss)."""
+                      axis=None, auto=False, global_batch=None,
+                      hbm_gb=16.0, **kw):
+    """The train-step chooser (GroupShardedStage2 / fleet
+    ShardingParallel / TensorParallel / PipelineParallel entry point).
+
+    Explicit mesh: scan_layers GPT dispatches by the mesh's active axes
+    — a >1 ``pp`` axis -> `PipelineScanTrainStep`, a >1 ``mp`` axis ->
+    `ShardedFusedScanTrainStep` in dp×mp mode, a >1 dp/sharding axis ->
+    the dp-only sharded scan, degree 1 -> `FusedScanTrainStep`;
+    non-scan models get the generic `TrainStep`.
+
+    ``auto=True`` promotes the validated cost-model planner to the
+    decision-maker (ISSUE 8): given the model + ``global_batch`` and
+    the available device count, `auto_tuner.pick_layout` prunes the
+    (dp, mp, pp, micro) grid with the reference feasibility rules,
+    ranks survivors with `estimate_step_ms` under cached
+    backend-calibrated constants, BUILDS the winning mesh (installed
+    via `distributed.env.set_mesh`) and returns the matching step with
+    the sweep-calibrated scan_unroll/layer_chunk. The
+    ``PADDLE_HYBRID_LAYOUT`` env override is honored. The decision
+    record lands on ``step.layout_decision``.
+    """
     from ..distributed import env as denv
     from ..models.gpt import GPTStackedBlocks
 
     layers = _unwrap_layers(model)
     blocks = getattr(getattr(layers, "gpt", None), "blocks", None)
     scan = isinstance(blocks, GPTStackedBlocks)
+
+    if auto:
+        if not scan:
+            raise ValueError(
+                "select_train_step(auto=True) plans layouts for "
+                "scan_layers GPT models; build with "
+                "GPTConfig(scan_layers=True)")
+        if global_batch is None:
+            raise ValueError(
+                "auto layout planning needs global_batch (the pruning "
+                "rules and the cost model are batch-dependent)")
+        import jax as _jax
+
+        from ..distributed.auto_tuner.select import (
+            calibrate_backend_cached, pick_layout, spec_of_model,
+        )
+
+        if mesh is not None:
+            devices = list(mesh.devices.flat)
+        else:
+            devices = list(_jax.devices())
+            if len(devices) == 1:
+                cpus = _jax.devices("cpu")
+                if len(cpus) > 1:
+                    devices = cpus
+        spec = spec_of_model(layers.config, global_batch=global_batch)
+        backend = calibrate_backend_cached(devices)
+        decision = pick_layout(spec, len(devices), hbm_gb=hbm_gb,
+                               backend=backend)
+        cand = decision["candidate"]
+        mesh = denv.build_mesh(decision["mesh_degrees"], devices=devices)
+        denv.set_mesh(mesh)
+        step_kw = dict(kw)
+        step_kw.setdefault("scan_unroll", decision["scan_unroll"])
+        step_kw.setdefault("layer_chunk", decision["layer_chunk"])
+        step_kw.setdefault("comm_bucket_mb", decision["comm_bucket_mb"])
+        if cand.pp > 1:
+            from .pipeline_step import PipelineScanTrainStep
+
+            step = PipelineScanTrainStep(
+                layers, optimizer, criterion=criterion, mesh=mesh,
+                axis="dp", pp_axis="pp",
+                num_micro=decision["num_micro"], **step_kw)
+        elif cand.degree > 1:
+            step = ShardedFusedScanTrainStep(
+                layers, optimizer, criterion=criterion, mesh=mesh,
+                axis="dp", mp_axis="mp" if cand.mp > 1 else None,
+                **step_kw)
+        else:
+            step = FusedScanTrainStep(
+                layers, optimizer, criterion=criterion,
+                **{k: v for k, v in step_kw.items()
+                   if k in ("fused_head", "compute_dtype",
+                            "layer_chunk", "scan_unroll")})
+        step.layout_decision = decision
+        return step
+
     if mesh is None and denv.is_initialized():
         mesh = denv.get_mesh()
-    degree = 1
+    degree = mp_degree = pp_degree = 1
+    mp_axis = pp_axis = None
     if mesh is not None:
         if axis is None:
             axis = next((a for a in ("sharding", "dp")
@@ -749,10 +1239,36 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
                         None)
         if axis is not None:
             degree = int(mesh.shape[axis])
-    if scan and degree > 1:
+        if "mp" in mesh.axis_names and int(mesh.shape["mp"]) > 1 \
+                and axis != "mp":
+            mp_axis, mp_degree = "mp", int(mesh.shape["mp"])
+        if "pp" in mesh.axis_names and int(mesh.shape["pp"]) > 1 \
+                and axis != "pp":
+            pp_axis, pp_degree = "pp", int(mesh.shape["pp"])
+    if scan and pp_degree > 1:
+        from .pipeline_step import PipelineScanTrainStep
+
+        if axis is None:
+            # a degree-1 dp/sharding axis still names the batch axis; a
+            # mesh with NEITHER cannot place the batch — say so rather
+            # than let the constructor trip over a duplicate-axis error
+            axis = next((a for a in ("sharding", "dp")
+                         if a in mesh.axis_names), None)
+            if axis is None:
+                raise ValueError(
+                    f"pp mesh {mesh.axis_names} has no dp/sharding "
+                    "axis to place the batch on; build it with one "
+                    "(degree 1 is fine): build_mesh({'dp': 1, "
+                    "'pp': N})")
+        return PipelineScanTrainStep(layers, optimizer,
+                                     criterion=criterion, mesh=mesh,
+                                     axis=axis, pp_axis=pp_axis,
+                                     **kw)
+    if scan and (degree > 1 or mp_degree > 1):
         return ShardedFusedScanTrainStep(layers, optimizer,
                                          criterion=criterion, mesh=mesh,
-                                         axis=axis, **kw)
+                                         axis=axis, mp_axis=mp_axis,
+                                         **kw)
     if scan:
         return FusedScanTrainStep(layers, optimizer, criterion=criterion,
                                   **{k: v for k, v in kw.items()
@@ -772,9 +1288,12 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
 # HLO probe program (tools/hlo_overlap.py --probe, bench --multichip)
 # ---------------------------------------------------------------------------
 
-def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1):
+def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1,
+                        mp=1, pp=1, num_micro=2):
     """Lower (not run) the sharded step for a tiny scan GPT on an
-    n-device host mesh — the program the overlap checker inspects."""
+    n-device host mesh — the program the overlap checker inspects.
+    ``mp``/``pp`` > 1 build the hybrid variants (dp×mp Megatron
+    sharding / the dp×pp ring pipeline) instead of the dp-only step."""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.optimizer as popt
@@ -789,7 +1308,16 @@ def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1):
             "(set --xla_force_host_platform_device_count)")
     from jax.sharding import Mesh
 
-    mesh = Mesh(np.asarray(devs), ("sharding",))
+    if mp > 1 and pp > 1:
+        raise NotImplementedError("combined mp×pp probe")
+    if mp > 1:
+        dp = n_devices // mp
+        mesh = Mesh(np.asarray(devs).reshape(dp, mp), ("dp", "mp"))
+    elif pp > 1:
+        dp = n_devices // pp
+        mesh = denv.build_mesh({"dp": dp, "pp": pp}, devices=devs)
+    else:
+        mesh = Mesh(np.asarray(devs), ("sharding",))
     denv.set_mesh(mesh)
     cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
                     num_attention_heads=2, max_position_embeddings=32,
@@ -799,10 +1327,19 @@ def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1):
     model = GPTForCausalLM(cfg)
     opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters(),
                      grad_clip=nn.ClipGradByGlobalNorm(1.0))
-    step = ShardedFusedScanTrainStep(model, opt, mesh=mesh,
-                                     axis="sharding",
+    if pp > 1:
+        from .pipeline_step import PipelineScanTrainStep
+
+        step = PipelineScanTrainStep(model, opt, mesh=mesh, axis="dp",
+                                     pp_axis="pp", num_micro=num_micro,
                                      scan_unroll=scan_unroll,
                                      layer_chunk=layer_chunk)
+    else:
+        step = ShardedFusedScanTrainStep(
+            model, opt, mesh=mesh,
+            axis="dp" if mp > 1 else "sharding",
+            mp_axis="mp" if mp > 1 else None,
+            scan_unroll=scan_unroll, layer_chunk=layer_chunk)
     step.ensure_built()
     state = step._extract_state()
     lr = jnp.float32(1e-3)

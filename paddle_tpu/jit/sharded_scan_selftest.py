@@ -177,16 +177,25 @@ def _load_hlo_overlap():
     return mod
 
 
-def hlo_overlap_probe(n_devices=8, scan_unroll=2):
+def hlo_overlap_probe(n_devices=8, scan_unroll=2, mp=1, pp=1):
     from .sharded_scan import build_probe_lowered
 
     mod = _load_hlo_overlap()
     text = build_probe_lowered(n_devices=n_devices,
-                               scan_unroll=scan_unroll).compile() \
-        .as_text()
-    verdict = mod.analyze(text)
+                               scan_unroll=scan_unroll, mp=mp,
+                               pp=pp).compile().as_text()
+    # axis degrees in MESH order (build_probe_lowered's layouts) so the
+    # per-axis classifier numbers devices the way the mesh does
+    if mp > 1:
+        degrees = {"dp": n_devices // mp, "mp": mp}
+    elif pp > 1:
+        degrees = {"pp": pp, "dp": n_devices // pp}   # build_mesh order
+    else:
+        degrees = {"sharding": n_devices}
+    verdict = mod.analyze(text, axis_degrees=degrees)
     verdict["probe"] = {"n_devices": n_devices,
                         "scan_unroll": scan_unroll,
+                        "mp": mp, "pp": pp,
                         "model": "tiny-gpt L4 h64"}
     return verdict
 
@@ -195,6 +204,16 @@ def _main():
     out = {"sharded_scan_parity": parity_probe()}
     if "--multichip" in sys.argv:
         out["hlo_overlap"] = hlo_overlap_probe()
+        # hybrid variants: per-axis collective counts distinguish dp vs
+        # mp traffic (and show the pp ring's collective-permutes); the
+        # verdicts ride the same MULTICHIP record
+        for key, kw in (("hlo_overlap_dp4mp2", {"mp": 2}),
+                        ("hlo_overlap_dp4pp2", {"pp": 2})):
+            try:
+                out[key] = hlo_overlap_probe(**kw)
+            except Exception as e:   # a probe failure must not eat the
+                out[key] = {"error":  # baseline overlap verdict
+                            f"{type(e).__name__}: {e}"[:300]}
     print(json.dumps(out))
 
 

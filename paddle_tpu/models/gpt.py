@@ -280,7 +280,11 @@ class GPTAttention(nn.Layer):
                 q, k, v, is_causal=True, dropout_p=self.dropout_p,
                 training=self.training, segment_ids=seg,
             )                                           # [b, s, nh, hd]
-        out = out.reshape([b, s, h])
+        # num_heads * head_dim, NOT h: under tensor parallelism the
+        # sharded step binds this layer with a head-sliced qkv (local
+        # num_heads = nh/mp), so the attention output is narrower than
+        # the residual-stream hidden size
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.out_proj(out)
 
 

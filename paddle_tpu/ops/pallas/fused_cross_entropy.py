@@ -48,7 +48,8 @@ from .flash_attention import (  # noqa: F401  (shared probes + helpers)
     _HAS_PALLAS, _LANES, _REVISIT_MIN, _Z, _dot, _on_tpu, pl, pltpu,
 )
 
-__all__ = ["fused_cross_entropy", "supports", "kernel_active"]
+__all__ = ["fused_cross_entropy", "sharded_fused_cross_entropy",
+           "supports", "kernel_active"]
 
 
 def supports(vocab, hidden, dtype) -> bool:
@@ -447,3 +448,141 @@ def fused_cross_entropy(hidden, weight, labels, ignore_index=-100,
         impl, bn = "xla", 1
     return _fused_ce(hidden, weight, labels.astype(jnp.int32),
                      int(ignore_index), int(bn), int(block_v), impl)
+
+
+# ---------------------------------------------------------------------------
+# vocab-PARALLEL variant: each mesh rank holds a [vocab/mp, H] row shard
+# of the head and tiles ONLY its shard; the online-logsumexp stats and
+# the picked label logit combine across the `axis` ranks with one pmax +
+# one (stacked) psum. Used inside jax.shard_map by the dp×mp hybrid
+# train step (jit/sharded_scan.py) — the PR-7 vocab-tiled CE applied to
+# the LOCAL vocab shard, so no rank ever materializes [tokens, vocab] OR
+# [tokens, vocab/mp] logits.
+# ---------------------------------------------------------------------------
+
+def _fwd_xla_sharded(h, w, labels, off, bv, ignore_index):
+    """Local online pass over the rank's vocab shard — same tiles, same
+    order, same fp32 accumulation as `_fwd_xla`, with global column ids
+    `off + tile columns` so label matching uses GLOBAL label values.
+    Returns the PRE-combine per-rank stats (m, l, pk)."""
+    vloc = w.shape[0]
+    wt, nv, pad = _tiles_xla(w, bv)
+    lbl = labels[:, None]
+    n = h.shape[0]
+
+    def body(carry, xs):
+        m, l, pk = carry
+        w_t, t = xs
+        logits = _dot(h, w_t, ((1,), (1,)))              # [n, bv] fp32
+        col = off + t * bv + jnp.arange(bv, dtype=jnp.int32)[None]
+        # padded columns carry GLOBAL ids beyond this shard's range —
+        # which ALIAS the next rank's real ids, so the label match must
+        # be masked to valid local columns, not just the logits
+        valid = col < off + vloc
+        if pad:
+            logits = jnp.where(valid, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[:, None])
+        l = corr * l + jnp.sum(p, axis=1)
+        pk = pk + jnp.sum(jnp.where((col == lbl) & valid, logits, 0.0),
+                          axis=1)
+        return (m_new, l, pk), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+    (m, l, pk), _ = jax.lax.scan(
+        body, init, (wt, jnp.arange(nv, dtype=jnp.int32)))
+    return m, l, pk
+
+
+def _bwd_xla_sharded(h, w, labels, off, lse, g_all, bv):
+    """Local tiled backward against the GLOBAL lse: d_logits_t =
+    (softmax_t - onehot_t) * g for the rank's tiles only. dh is the
+    rank's PARTIAL contribution (the caller's grad reduction sums the
+    mp ranks); dw covers exactly the local shard rows."""
+    n, hidden = h.shape
+    vloc = w.shape[0]
+    wt, nv, pad = _tiles_xla(w, bv)
+    lbl = labels[:, None]
+
+    def body(dh, xs):
+        w_t, t = xs
+        logits = _dot(h, w_t, ((1,), (1,)))
+        col = off + t * bv + jnp.arange(bv, dtype=jnp.int32)[None]
+        valid = col < off + vloc
+        if pad:
+            logits = jnp.where(valid, logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])
+        d = (p - jnp.where((col == lbl) & valid, 1.0, 0.0)) \
+            * g_all[:, None]
+        dlow = d.astype(h.dtype)
+        dh = dh + _dot(dlow, w_t, ((1,), (0,)))
+        dw_t = _dot(dlow, h, ((0,), (0,)))               # [bv, H] fp32
+        return dh, dw_t
+
+    dh, dws = jax.lax.scan(
+        body, jnp.zeros((n, hidden), jnp.float32),
+        (wt, jnp.arange(nv, dtype=jnp.int32)))
+    dw = dws.reshape(nv * bv, hidden)[:vloc]
+    return dh.astype(h.dtype), dw.astype(w.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _sharded_ce(h, w, labels, off, axis, ignore_index, bv):
+    losses, _ = _sharded_ce_fwd(h, w, labels, off, axis, ignore_index,
+                                bv)
+    return losses
+
+
+def _sharded_ce_fwd(h, w, labels, off, axis, ignore_index, bv):
+    m, l, pk = _fwd_xla_sharded(h, w, labels, off, bv, ignore_index)
+    # cross-shard combine: one pmax for the running max, then the
+    # sumexp correction and the picked logit ride ONE stacked psum
+    mg = jax.lax.pmax(m, axis)
+    both = jax.lax.psum(jnp.stack([jnp.exp(m - mg) * l, pk]), axis)
+    lse = mg + jnp.log(both[0])
+    losses = jnp.where(labels != ignore_index, lse - both[1], 0.0)
+    return losses, (h, w, labels, off, lse)
+
+
+def _sharded_ce_bwd(axis, ignore_index, bv, res, g):
+    h, w, labels, off, lse = res
+    g_eff = jnp.where(labels != ignore_index, g.astype(jnp.float32), 0.0)
+    # joint-function transpose of the forward psums: every rank's loss
+    # row consumed this rank's local stats, so the effective cotangent
+    # is the axis-sum of the per-rank seeds (identical seeds -> mp * g;
+    # the caller's 1/(dp*mp) grad normalization divides it back out —
+    # the same uniform factor every replicated-compute grad carries)
+    g_all = jax.lax.psum(g_eff, axis)
+    dh, dw = _bwd_xla_sharded(h, w, labels.astype(jnp.int32), off, lse,
+                              g_all, bv)
+    ct_labels = np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    ct_off = np.zeros((), dtype=jax.dtypes.float0)
+    return dh.astype(h.dtype), dw, ct_labels, ct_off
+
+
+_sharded_ce.defvjp(_sharded_ce_fwd, _sharded_ce_bwd)
+
+
+def sharded_fused_cross_entropy(hidden, weight_local, labels,
+                                vocab_start, axis, ignore_index=-100,
+                                block_v=None):
+    """Vocab-parallel `fused_cross_entropy` for use inside `shard_map`.
+
+    hidden: [N, H] (replicated over `axis`); weight_local:
+    [vocab/mp, H] — this rank's row shard of the [vocab, H] head;
+    labels: GLOBAL int labels [N]; vocab_start: traced int32 scalar, the
+    first global vocab id of this rank's shard; axis: the mesh axis name
+    the vocab is sharded over. Returns fp32 losses [N] (0 at
+    ignore_index rows), identical across ranks. Differentiable in
+    hidden (partial per-rank contribution) and weight_local (exactly
+    the shard's rows) via the custom tiled backward — the joint
+    collective transpose is exact under shard_map (check_vma=False).
+    """
+    vloc = weight_local.shape[0]
+    if block_v is None:
+        block_v = _pick_block_v(vloc) or _LANES
+    return _sharded_ce(hidden, weight_local, labels.astype(jnp.int32),
+                       jnp.asarray(vocab_start, jnp.int32), axis,
+                       int(ignore_index), int(block_v))

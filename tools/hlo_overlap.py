@@ -54,11 +54,87 @@ _INSTR_RE = re.compile(
     r"(?P<op>[\w\-]+)\(")
 _COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*->")
 _REF_RE = re.compile(r"%([\w.\-]+)")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,{} ]*\})\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+
+
+def _parse_groups(line):
+    """Replica groups of a collective instruction line, as a frozenset
+    of frozensets of device ids — both the literal `{{0,1},{2,3}}` form
+    and the iota `[groups,size]<=[dims]T(perm)` form — or None."""
+    m = _GROUPS_RE.search(line)
+    if m:
+        groups = []
+        for grp in re.findall(r"\{([\d, ]*)\}", m.group(1)):
+            ids = [int(x) for x in grp.replace(" ", "").split(",") if x]
+            if ids:
+                groups.append(frozenset(ids))
+        return frozenset(groups) if groups else None
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        n_groups, size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        total = 1
+        for d in dims:
+            total *= d
+        ids = list(range(total))
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            import itertools as _it
+
+            arr = ids
+            # reshape to dims, transpose by perm, flatten — pure python
+            def strides(ds):
+                s, out = 1, []
+                for d in reversed(ds):
+                    out.append(s)
+                    s *= d
+                return list(reversed(out))
+
+            st = strides(dims)
+            tdims = [dims[p] for p in perm]
+            tst = [st[p] for p in perm]
+            arr = []
+            for coord in _it.product(*(range(d) for d in tdims)):
+                arr.append(sum(c * s for c, s in zip(coord, tst)))
+            ids = arr
+        return frozenset(
+            frozenset(ids[g * size:(g + 1) * size])
+            for g in range(n_groups))
+    return None
+
+
+def expected_axis_groups(axis_degrees):
+    """{axes_label: frozenset of replica groups} for every non-empty
+    subset of mesh axes, devices numbered row-major over the given
+    (ordered) axis -> degree mapping — the layout jax meshes lower to.
+    Labels join subset axis names with '+' in mesh order."""
+    import itertools as _it
+
+    names = list(axis_degrees)
+    degrees = [int(axis_degrees[n]) for n in names]
+    out = {}
+    for r in range(1, len(names) + 1):
+        for subset in _it.combinations(range(len(names)), r):
+            groups = {}
+            for coord in _it.product(*(range(d) for d in degrees)):
+                key = tuple(c for i, c in enumerate(coord)
+                            if i not in subset)
+                rank = 0
+                for c, d in zip(coord, degrees):
+                    rank = rank * d + c
+                groups.setdefault(key, []).append(rank)
+            label = "+".join(names[i] for i in subset)
+            out[label] = frozenset(frozenset(g)
+                                   for g in groups.values())
+    return out
 
 
 def parse_computations(text):
-    """-> {computation_name: [(instr_name, op, [operand_names])]} in
-    scheduled order (compiled modules print is_scheduled=true)."""
+    """-> {computation_name: [(instr_name, op, [operand_names],
+    replica_groups)]} in scheduled order (compiled modules print
+    is_scheduled=true)."""
     comps = {}
     cur = None
     for line in text.splitlines():
@@ -81,7 +157,7 @@ def parse_computations(text):
         # not collide with instruction names in practice)
         rhs = line.split("=", 1)[1]
         refs = [r for r in _REF_RE.findall(rhs) if r != name]
-        comps[cur].append((name, op, refs))
+        comps[cur].append((name, op, refs, _parse_groups(line)))
     return comps
 
 
@@ -96,22 +172,45 @@ def _collective_kind(op):
     return None
 
 
-def analyze(text):
+def analyze(text, axis_degrees=None):
+    """Structural overlap verdict over compiled HLO. `axis_degrees`
+    (ordered {axis_name: degree}, MESH order) additionally classifies
+    every collective's replica groups per mesh axis (or axis product)
+    so dp vs mp vs flattened-dp×mp traffic is distinguishable in the
+    multichip record (ISSUE 8 satellite)."""
     comps = parse_computations(text)
     async_pairs = []
     sync_colls = []
     counts = {k: 0 for k in COLLECTIVE_KINDS}
+    axis_expected = (expected_axis_groups(axis_degrees)
+                     if axis_degrees else None)
+    per_axis = {}
+
+    def classify(groups):
+        if axis_expected is None or groups is None:
+            return None
+        for label, want in axis_expected.items():
+            if groups == want:
+                return label
+        # single-group collectives over the whole mesh match the full
+        # product label above; anything else is an unexpected pattern
+        return "other"
+
     for cname, instrs in comps.items():
-        for i, (name, op, refs) in enumerate(instrs):
+        for i, (name, op, refs, groups) in enumerate(instrs):
             kind = _collective_kind(op)
             if kind is None:
                 continue
             counts[kind] += 1
+            label = classify(groups)
+            if label is not None:
+                per_axis.setdefault(label, {}).setdefault(kind, 0)
+                per_axis[label][kind] += 1
             if op.endswith("-start"):
                 # find the matching -done consuming this value
                 done_i = None
                 for j in range(i + 1, len(instrs)):
-                    n2, op2, refs2 = instrs[j]
+                    n2, op2, refs2, _ = instrs[j]
                     if op2 == kind + "-done" and name in refs2:
                         done_i = j
                         break
@@ -132,7 +231,7 @@ def analyze(text):
             independent_after = 0
             window = 0
             for j in range(i + 1, len(instrs)):
-                n2, op2, refs2 = instrs[j]
+                n2, op2, refs2, _ = instrs[j]
                 if any(r in dependent for r in refs2):
                     dependent.add(n2)
                     if first_use is None:
@@ -161,6 +260,7 @@ def analyze(text):
     return {
         "mode": "async" if async_pairs else "sync",
         "counts": {k: v for k, v in counts.items() if v},
+        **({"per_axis_counts": per_axis} if axis_expected else {}),
         "async_pairs": len(async_pairs),
         "async_pairs_bracketing_compute": n_async_ok,
         "sync_collectives": len(sync_colls),
