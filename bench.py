@@ -646,6 +646,26 @@ def run_selftest():
         assert rec.get("check") == "pass", rec
         results["training_kernels_detail"] = rec
 
+    def distributed_linalg():
+        # ISSUE 9: paddle.linalg.distributed — SUMMA matmul (incl.
+        # non-divisible + block-cyclic), blocked Cholesky, TSQR QR and
+        # the subspace-iteration eigensolver vs jnp.linalg on the
+        # 8-device host mesh, plus the no-full-matrix HLO receipt per op
+        rec = _run_cpu_probe("paddle_tpu.linalg.distributed.selftest")
+        lane = rec.get("distributed_linalg", {})
+        assert lane.get("check") == "pass", lane
+        results["distributed_linalg_detail"] = lane
+
+    def moe():
+        # ISSUE 9: expert-parallel MoE — dp4×ep2 scan step == dp8
+        # dense-equivalent routing <= 1e-5 over 4 steps, 1 compile per
+        # signature, >= 2 ep-axis all-to-alls in the compiled HLO, and
+        # exact aux-loss plumbing through the fused scan
+        rec = _run_cpu_probe("paddle_tpu.jit.moe_selftest", timeout=900)
+        lane = rec.get("moe", {})
+        assert lane.get("check") == "pass", lane
+        results["moe_detail"] = lane
+
     def serving():
         # ISSUE 6: continuous-batching serving tier — Poisson arrivals
         # on a tiny model: per-request token parity vs generate(),
@@ -671,6 +691,8 @@ def run_selftest():
     check("input_pipeline", input_pipeline)
     check("serving", serving)
     check("training_kernels", training_kernels)
+    check("distributed_linalg", distributed_linalg)
+    check("moe", moe)
     return results
 
 
@@ -1092,6 +1114,20 @@ if __name__ == "__main__":
             {"serving": _run_cpu_probe("paddle_tpu.serving.selftest",
                                        extra_args=("--bench",),
                                        n_devices=1, timeout=900)}))
+    elif "--linalg" in sys.argv:
+        # DISTRIBUTED-LINALG lane (ISSUE 9): SUMMA / blocked Cholesky /
+        # TSQR / subspace-iteration parity vs jnp.linalg on the 8-dev
+        # host mesh + the no-full-matrix collective receipts — hermetic
+        # CPU subprocess, one JSON line
+        print(json.dumps(_run_cpu_probe(
+            "paddle_tpu.linalg.distributed.selftest")))
+    elif "--moe" in sys.argv:
+        # MOE lane (ISSUE 9): dp4×ep2 expert-parallel scan step vs the
+        # dp8 dense-equivalent routing reference, compile-count probes,
+        # ep all-to-all census, aux-loss plumbing — hermetic CPU
+        # subprocess, one JSON line
+        print(json.dumps(_run_cpu_probe("paddle_tpu.jit.moe_selftest",
+                                        timeout=900)))
     elif "--training-kernels" in sys.argv:
         # TRAINING-KERNELS lane (ISSUE 7): splash attention + fused CE
         # interpret-mode parity (fwd+bwd, segment masks), scan-step

@@ -119,6 +119,14 @@ from . import dataset  # noqa: E402,F401
 from . import geometric  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+# the ops star-import above already bound `linalg` to ops.linalg (the
+# reference tensor.linalg surface); the PACKAGE paddle_tpu.linalg
+# wraps that same surface and adds `.distributed` — import it
+# explicitly (a plain `from . import linalg` would see the existing
+# attribute and skip the submodule import) and rebind
+import importlib as _importlib  # noqa: E402
+
+linalg = _importlib.import_module(".linalg", __name__)
 from . import onnx  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import reader  # noqa: E402,F401

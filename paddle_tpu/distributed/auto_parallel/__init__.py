@@ -232,8 +232,53 @@ def get_placements(tensor) -> list:
     return [Replicate()]
 
 
-def moe_global_mesh_tensor(*args, **kwargs):
-    raise NotImplementedError("MoE mesh tensors land with the EP module")
+def moe_global_mesh_tensor(local_tensor_list, mesh=None, placements=None,
+                           local_mesh_dim=-1):
+    """Reference api.py moe_global_mesh_tensor — assemble per-EP-rank
+    expert tensors into ONE global dist tensor sharded over the
+    expert-parallel mesh dim (ISSUE 9 satellite; the EP module's storage
+    convention: expert params stacked on a leading num_experts dim,
+    sharded 1/ep).
+
+    ``local_tensor_list``: each EP rank's slice of the stacked expert
+    tensor (e.g. [E/ep, ...]); ``local_mesh_dim`` names (index or dim
+    name) the mesh dim the experts split over — its placement must be a
+    ``Shard`` giving the concat dim. The result is the concatenated
+    global tensor placed per ``placements`` (expert dim sharded over the
+    ep axis, everything else as given), so GSPMD sees exactly the
+    1/ep-expert layout `MoELayer` computes with.
+    """
+    if not local_tensor_list:
+        raise ValueError("moe_global_mesh_tensor needs a non-empty "
+                         "local_tensor_list")
+    if mesh is None:
+        jm = env.get_mesh()
+        mesh = ProcessMesh(
+            np.arange(jm.devices.size).reshape(jm.devices.shape),
+            list(jm.axis_names))
+    if isinstance(local_mesh_dim, str):
+        local_mesh_dim = mesh.dim_names.index(local_mesh_dim)
+    local_mesh_dim = local_mesh_dim % mesh.ndim
+    if placements is None:
+        # default EP layout: experts split on dim 0 over the local mesh
+        # dim, replicated elsewhere
+        placements = [Replicate()] * mesh.ndim
+        placements[local_mesh_dim] = Shard(0)
+    pl = placements[local_mesh_dim]
+    if not isinstance(pl, Shard):
+        raise ValueError(
+            f"the expert-parallel mesh dim "
+            f"{mesh.dim_names[local_mesh_dim]!r} must carry a Shard "
+            f"placement (the expert concat dim); got {pl!r}")
+    degree = mesh.shape[local_mesh_dim]
+    if len(local_tensor_list) != degree:
+        raise ValueError(
+            f"{len(local_tensor_list)} local tensors for an ep degree "
+            f"of {degree} (one slice per EP rank)")
+    datas = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+             for t in local_tensor_list]
+    global_data = jnp.concatenate(datas, axis=pl.dim)
+    return shard_tensor(Tensor._wrap(global_data), mesh, placements)
 
 
 from .engine import DistModel, Strategy, to_static  # noqa: E402,F401

@@ -11,6 +11,17 @@ params STACKED on a leading E dim sharded over the ``ep`` mesh axis, and a
 vmap over experts; XLA GSPMD lowers the dispatch/combine einsums to the
 all-to-alls on ICI. Static shapes (capacity) keep it jit-compilable; drops
 are mask zeros, not ragged buffers.
+
+Real expert parallelism (ISSUE 9): when the forward traces inside a
+`shard_map` that binds the ``ep`` axis AND the bound expert stacks are the
+rank's 1/ep slice (the dp×ep scan train step's layout —
+jit/sharded_scan.py `_setup_ep`), the dispatch/combine become EXPLICIT
+`jax.lax.all_to_all`s: the [E, C, H] capacity-padded dispatch buffer
+splits its expert dim over ep and concatenates capacity, each rank runs
+its E/ep local experts over the ep·C tokens it received, and the inverse
+all_to_all brings expert outputs home. Capacity padding is what makes the
+equal-split wire format legal for ragged per-expert token counts — the
+same trick `global_scatter`/`global_gather` use for ragged count vectors.
 """
 from __future__ import annotations
 
@@ -59,7 +70,8 @@ class MoELayer(nn.Layer):
     """
 
     def __init__(self, d_model, experts, gate="gshard",
-                 capacity_factor=1.25, axis="ep", mesh=None, group=None):
+                 capacity_factor=1.25, axis="ep", mesh=None, group=None,
+                 ep_degree=None):
         super().__init__()
         self.d_model = int(d_model)
         self.num_experts = len(experts)
@@ -67,6 +79,16 @@ class MoELayer(nn.Layer):
         self.gate = gate if isinstance(gate, NaiveGate) else NaiveGate(gate)
         self._axis = axis
         self._mesh = group.mesh if group is not None else mesh
+        # ep_degree: declared expert-parallel degree (validated here so a
+        # bad layout fails at construction, not at trace time); None =
+        # whatever the ambient mesh's ep axis provides
+        if ep_degree is not None:
+            ep_degree = int(ep_degree)
+            if ep_degree < 1 or self.num_experts % ep_degree:
+                raise ValueError(
+                    f"num_experts {self.num_experts} not divisible by "
+                    f"ep_degree {ep_degree}")
+        self.ep_degree = ep_degree
         self.gate_weight = self.create_parameter(
             [self.d_model, self.num_experts])
 
@@ -141,6 +163,8 @@ class MoELayer(nn.Layer):
                         p._data = d
             return out
 
+        num_experts = self.num_experts
+
         def moe_fn(xa, wg, *stacked_leaves):
             xt = xa.reshape(num_tokens, hidden)
             logits = (xt.astype(jnp.float32)
@@ -149,14 +173,40 @@ class MoELayer(nn.Layer):
             combine = combine.astype(xt.dtype)
             expert_in = jnp.einsum(
                 "tec,th->ech", dispatch.astype(xt.dtype), xt)
-            if mesh is not None:
-                from .....distributed.env import pin_sharding
+            e_loc = int(stacked_leaves[0].shape[0])
+            if e_loc != num_experts:
+                # REAL expert parallelism: the bound stacks are this
+                # rank's 1/ep expert slice inside a shard_map binding the
+                # ep axis (the dp×ep scan step). Ship each expert's
+                # capacity-padded token block to its owner (split the
+                # expert dim, concatenate capacity), run the local
+                # experts over the ep·C tokens received, and all_to_all
+                # the outputs home. Shapes are static — capacity padding
+                # is what makes the equal-split wire format legal.
+                from .....distributed.collective import _axis_bound
 
-                spec = P(axis, *([None] * (expert_in.ndim - 1)))
-                expert_in = pin_sharding(expert_in,
-                                         NamedSharding(mesh, spec))
-            expert_out = jax.vmap(expert_apply)(list(stacked_leaves),
-                                                expert_in)
+                if not _axis_bound(axis):
+                    raise RuntimeError(
+                        f"MoELayer bound {e_loc}/{num_experts} expert "
+                        f"slices but mesh axis {axis!r} is not bound in "
+                        "this trace — expert-parallel dispatch needs the "
+                        "shard_map context that sliced the experts")
+                recv = jax.lax.all_to_all(
+                    expert_in, axis, split_axis=0, concat_axis=1,
+                    tiled=True)                   # [E/ep, ep*C, H]
+                out = jax.vmap(expert_apply)(list(stacked_leaves), recv)
+                expert_out = jax.lax.all_to_all(
+                    out, axis, split_axis=1, concat_axis=0,
+                    tiled=True)                   # [E, C, H]
+            else:
+                if mesh is not None:
+                    from .....distributed.env import pin_sharding
+
+                    spec = P(axis, *([None] * (expert_in.ndim - 1)))
+                    expert_in = pin_sharding(expert_in,
+                                             NamedSharding(mesh, spec))
+                expert_out = jax.vmap(expert_apply)(list(stacked_leaves),
+                                                    expert_in)
             y = jnp.einsum("tec,ech->th", combine, expert_out)
             return y.reshape(orig_shape), aux.astype(jnp.float32)
 
@@ -181,12 +231,19 @@ def _default_group():
 def _validated_counts(local_count, global_count, name, x=None, group=None):
     """The reference kernels move count-shaped ragged buffers
     (distributed/utils/moe_utils.py global_scatter/global_gather). The XLA
-    all_to_all path is equal-split, so the counts are VERIFIED rather than
-    silently ignored: uniform counts run (they describe exactly the
-    equal-split exchange), ragged counts raise with guidance to the
-    TPU-native dense-capacity einsum dispatch (MoELayer), which is this
-    framework's ragged-routing mechanism (static shapes, GSPMD all-to-all).
-    """
+    all_to_all wire is equal-split, so the counts are VERIFIED rather than
+    silently ignored, then routed: uniform counts describe exactly the
+    equal-split exchange (fast path); ragged counts run through the
+    capacity-padded equal-split exchange (`_ragged_exchange` — pad every
+    bucket to the max count, all_to_all the padded blocks, compact). The
+    remaining errors mark genuinely unsupported shapes: traced counts
+    (the layout must be host-known to build the pad/compact maps),
+    local/global count vectors that disagree (the single-controller
+    global view runs every rank's identical program, so the receive
+    layout IS derived from the send layout), mismatched totals, and
+    count vectors that don't tile over the group.
+
+    Returns (lc, gc) as host numpy arrays (or None)."""
     import numpy as np
 
     counts = []
@@ -197,25 +254,28 @@ def _validated_counts(local_count, global_count, name, x=None, group=None):
         data = c._data if isinstance(c, Tensor) else c
         if isinstance(data, jax.core.Tracer):
             raise NotImplementedError(
-                f"{name} with traced counts cannot be validated; use "
-                "MoELayer's dense capacity dispatch inside jit")
+                f"{name} with traced counts cannot drive the host-built "
+                "pad/compact maps; use MoELayer's dense capacity "
+                "dispatch inside jit")
         counts.append(np.asarray(data))
     lc, gc = counts
     if lc is not None and gc is not None and lc.sum() != gc.sum():
         raise ValueError(
             f"{name}: local_count total ({int(lc.sum())}) != global_count "
             f"total ({int(gc.sum())}) — the exchange would lose tokens")
-    for label, c in (("local_count", lc), ("global_count", gc)):
-        if c is not None and len(set(c.tolist())) > 1:
-            raise NotImplementedError(
-                f"{name} with ragged {label} ({c.tolist()}) is not "
-                "supported on the XLA equal-split all_to_all path; route "
-                "tokens with MoELayer's capacity-slot einsum dispatch "
-                "(the TPU-native ragged mechanism) or pad buckets to "
-                "uniform counts")
-    # counts must actually describe the exchange (not just be uniform):
-    # length a multiple of nranks (n_expert * world entries) and totals
-    # covering x's rows (global leading dim = nranks * per-rank rows)
+    if lc is not None and gc is not None and (
+            lc.size != gc.size or not np.array_equal(lc, gc)):
+        raise ValueError(
+            f"{name}: local_count {lc.tolist()} and global_count "
+            f"{gc.tolist()} disagree. In the single-controller global "
+            "view every rank runs the same program over the same count "
+            "vector, so the receive layout is derived from the send "
+            "layout — per-rank-distinct count vectors are not "
+            "representable here (run the reference per-rank API under "
+            "multi-process SPMD for that)")
+    # counts must actually describe the exchange: length a multiple of
+    # nranks (n_expert * world entries) and totals covering x's rows
+    # (global leading dim = nranks * per-rank rows)
     if group is not None and lc is not None:
         nranks = group.nranks
         if lc.size % nranks:
@@ -229,16 +289,119 @@ def _validated_counts(local_count, global_count, name, x=None, group=None):
                 raise ValueError(
                     f"{name}: counts route {int(lc.sum())} rows/rank x "
                     f"{nranks} ranks but x has {rows} rows")
+    return lc, gc
+
+
+def _ragged_exchange(x, counts, group, inverse=False):
+    """Capacity-padded equal-split exchange of ragged per-expert buckets
+    (single-controller global view).
+
+    Layout contract (destination-major, the reference moe_utils layout):
+    rank r's section of `x` holds, for each bucket b = d*n_e + e,
+    ``counts[b]`` rows destined to rank d's local expert e
+    (``inverse=False``); the result is source-major — rank r's section
+    holds, for each source s and local expert e, the ``counts[r*n_e+e]``
+    rows s sent it. ``inverse=True`` applies the exact inverse map (the
+    gather direction). The wire carries ONE equal-split all_to_all of
+    [nranks · n_expert · capacity] blocks, capacity = max(counts); pad
+    rows are zeros and never reach the output.
+    """
+    import numpy as np
+
+    from .....distributed.collective import alltoall_single
+
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    W = group.nranks
+    counts = np.asarray(counts, np.int64)
+    B = counts.size
+    n_e = B // W
+    S = int(counts.sum())
+    cap = max(1, int(counts.max()))
+    feat = data.shape[1:]
+    off = np.zeros(B, np.int64)
+    off[1:] = np.cumsum(counts)[:-1]
+    grp_sum = counts.reshape(W, n_e).sum(axis=1)          # per-rank-group
+    grp_off = np.zeros((W, n_e), np.int64)
+    grp_off[:, 1:] = np.cumsum(counts.reshape(W, n_e), axis=1)[:, :-1]
+    # scattered-layout section offsets (sections are W*sum(group_r) rows)
+    sec = np.zeros(W + 1, np.int64)
+    sec[1:] = np.cumsum(W * grp_sum)
+
+    # pack map: padded[r, d, e, c] <- x row (or -1 = zero pad).
+    pack = np.full((W, W, n_e, cap), -1, np.int64)
+    # unpack map: out_row <- padded-recv flat index (r, s, e, c)
+    if inverse:
+        total_out = W * S
+    else:
+        total_out = int(sec[-1])
+    unpack = np.zeros(total_out, np.int64)
+    for r in range(W):
+        for d in range(W):
+            for e in range(n_e):
+                if inverse:
+                    cnt = int(counts[r * n_e + e])
+                    src = (sec[r] + d * grp_sum[r] + grp_off[r, e]
+                           + np.arange(cnt))
+                else:
+                    cnt = int(counts[d * n_e + e])
+                    src = r * S + off[d * n_e + e] + np.arange(cnt)
+                pack[r, d, e, :cnt] = src
+                # receive side of block (r<-s=d): where its rows land
+                if inverse:
+                    # gather: rows return to destination-major order
+                    cnt_in = int(counts[d * n_e + e])
+                    dst = r * S + off[d * n_e + e] + np.arange(cnt_in)
+                    flat = (((r * W + d) * n_e + e) * cap
+                            + np.arange(cnt_in))
+                else:
+                    cnt_in = int(counts[r * n_e + e])
+                    dst = (sec[r] + d * grp_sum[r] + grp_off[r, e]
+                           + np.arange(cnt_in))
+                    flat = (((r * W + d) * n_e + e) * cap
+                            + np.arange(cnt_in))
+                unpack[dst] = flat
+
+    pack_flat = pack.reshape(-1)
+    mask = jnp.asarray((pack_flat >= 0).reshape(-1, *([1] * len(feat))),
+                       data.dtype)
+    pack_idx = jnp.asarray(np.maximum(pack_flat, 0))
+    unpack_idx = jnp.asarray(unpack)
+
+    def pad_fn(d):
+        return jnp.take(d, pack_idx, axis=0) * mask
+
+    padded = apply_op(pad_fn, [x if isinstance(x, Tensor)
+                               else Tensor._wrap(data)], name="moe_pad")
+    # shard the rank-major padded buffer over the group axis and run the
+    # REAL equal-split collective
+    if len(group.axes) == 1:
+        spec = P(group.axes[0], *([None] * len(feat)))
+        padded._data = jax.device_put(
+            padded._data, NamedSharding(group.mesh, spec))
+    exchanged = alltoall_single(None, padded, group=group)
+
+    def compact_fn(d):
+        return jnp.take(d, unpack_idx, axis=0)
+
+    return apply_op(compact_fn, [exchanged], name="moe_compact")
 
 
 def global_scatter(x, local_count, global_count, group=None):
     """Reference moe_layer.py:119 — alltoall token push. Counts are
-    validated (uniform -> equal-split all_to_all; ragged -> error), never
-    silently ignored."""
+    validated, never silently ignored: uniform counts ride the direct
+    equal-split all_to_all; ragged per-expert counts ride the
+    capacity-padded equal-split exchange (`_ragged_exchange`)."""
     from .....distributed.collective import alltoall_single
 
-    _validated_counts(local_count, global_count, "global_scatter", x=x,
-                      group=group or _default_group())
+    group = group or _default_group()
+    lc, _ = _validated_counts(local_count, global_count,
+                              "global_scatter", x=x, group=group)
+    if lc is not None and len(set(lc.tolist())) > 1:
+        if group is None:
+            raise ValueError(
+                "global_scatter with ragged counts needs a group/mesh "
+                "(the exchange layout depends on nranks)")
+        return _ragged_exchange(x, lc, group, inverse=False)
     out = Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor)
                                 else jnp.asarray(x)))
     alltoall_single(out, x, group=group)
@@ -246,12 +409,20 @@ def global_scatter(x, local_count, global_count, group=None):
 
 
 def global_gather(x, local_count, global_count, group=None):
-    """Reference moe_layer.py:140 — inverse alltoall pull (counts
-    validated, equal splits only; see global_scatter)."""
+    """Reference moe_layer.py:140 — inverse alltoall pull (the exact
+    inverse of `global_scatter`, incl. the ragged capacity-padded
+    path)."""
     from .....distributed.collective import alltoall_single
 
-    _validated_counts(local_count, global_count, "global_gather", x=x,
-                      group=group or _default_group())
+    group = group or _default_group()
+    lc, _ = _validated_counts(local_count, global_count,
+                              "global_gather", x=x, group=group)
+    if lc is not None and len(set(lc.tolist())) > 1:
+        if group is None:
+            raise ValueError(
+                "global_gather with ragged counts needs a group/mesh "
+                "(the exchange layout depends on nranks)")
+        return _ragged_exchange(x, lc, group, inverse=True)
     out = Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor)
                                 else jnp.asarray(x)))
     alltoall_single(out, x, group=group)

@@ -203,6 +203,19 @@ class FusedScanTrainStep:
         self._blocks = blocks
         self._template = blocks._template
         self._t_leaves = [p for _, p in self._template.named_parameters()]
+        # MoE blocks (ISSUE 9): the template's MoE layers publish a
+        # load-balance aux loss per forward; it rides the scan as a ys
+        # output and is folded into the training loss with weight
+        # moe_aux_weight/num_layers (the model-level layer mean), with
+        # matching cotangents injected into every chunk vjp
+        from ..incubate.distributed.models.moe.moe_layer import MoELayer
+
+        self._aux_layers = [
+            s for _, s in self._template.named_sublayers(
+                include_self=True) if isinstance(s, MoELayer)]
+        self._aux_active = bool(self._aux_layers)
+        self._aux_weight = (float(getattr(cfg, "moe_aux_weight", 0.0))
+                            if self._aux_active else 0.0)
         self._s_params = [blocks._parameters[flat]
                           for flat, _ in blocks._stacked_names]
         self._o_params = [(n, p) for n, p in model.named_parameters()
@@ -284,13 +297,23 @@ class FusedScanTrainStep:
         """layer_chunk layers unrolled: chunk_leaves are [K, ...]
         slices; rng0 is the chunk's first-layer PRNG offset (None
         without dropout). Shared by the single-device and sharded
-        builds — the rng stride here and _rng_base are one scheme."""
+        builds — the rng stride here and _rng_base are one scheme.
+        MoE templates return (h, aux_sum) — the chunk's summed
+        load-balance loss rides alongside the activations."""
         stride = self._rng_nranks * _RNG_SLOTS
+        if not self._aux_active:
+            for j in range(self._layer_chunk):
+                off = None if rng0 is None else rng0 + j * stride
+                h = self._block_fn([a[j] for a in chunk_leaves], h,
+                                   rng_off=off)
+            return h
+        aux = jnp.float32(0.0)
         for j in range(self._layer_chunk):
             off = None if rng0 is None else rng0 + j * stride
-            h = self._block_fn([a[j] for a in chunk_leaves], h,
-                               rng_off=off)
-        return h
+            h, a = self._block_fn([a2[j] for a2 in chunk_leaves], h,
+                                  rng_off=off)
+            aux = aux + a
+        return h, aux
 
     # -- pure functional views over the live layers ---------------------
     def _bind(self, params, datas):
@@ -327,7 +350,13 @@ class FusedScanTrainStep:
                 # registered sublayer, so its Dropout children only see
                 # the mode set this way
                 tmpl.train()
-                return tmpl._inner(Tensor._wrap(x))._data
+                out = tmpl._inner(Tensor._wrap(x))._data
+                if self._aux_active:
+                    aux = self._aux_layers[0].l_aux._data
+                    for lyr in self._aux_layers[1:]:
+                        aux = aux + lyr.l_aux._data
+                    return out, aux.astype(jnp.float32)
+                return out
             finally:
                 gen._offset = saved_off
                 self._bind(self._t_leaves, saved)
@@ -455,6 +484,10 @@ class FusedScanTrainStep:
         cv = self._clip_value
         guard = self._guard
         scaling = guard is not None and guard.scaling
+        aux_active = self._aux_active
+        # per-chunk aux cotangent: total loss adds
+        # (moe_aux_weight / L) * sum(per-layer aux)
+        aux_w = self._aux_weight / self.model.config.num_layers
 
         def clip_g32(g32, p):
             """The per-grad transforms that are legal inside the scan:
@@ -518,11 +551,15 @@ class FusedScanTrainStep:
                 def fwd_body(h, scanned):
                     p_chunk, i = scanned
                     rng0 = self._rng_chunk_base(t32, i)
+                    if aux_active:
+                        h2, aux = chunk_apply(p_chunk, h, rng0)
+                        return h2, (h, aux)
                     return chunk_apply(p_chunk, h, rng0), h
 
-                xL, xs = lax.scan(
+                xL, ys = lax.scan(
                     fwd_body, x0, (sp_c, jnp.arange(C)),
                     unroll=self._scan_unroll)
+                xs, auxs = ys if aux_active else (ys, None)
 
                 # ---- head (+ its whole vjp: small params, one buffer)
                 loss, head_vjp = jax.vjp(
@@ -530,6 +567,12 @@ class FusedScanTrainStep:
                 ct = (gst["scale"].astype(loss.dtype) if scaling
                       else jnp.ones((), loss.dtype))
                 d_o_head, dxL = head_vjp(ct)
+                aux_ct = None
+                if aux_active:
+                    # total loss = CE + (w/L) * sum(aux); the chunk vjps
+                    # below receive the matching (loss-scaled) cotangent
+                    loss = loss + jnp.float32(aux_w) * jnp.sum(auxs)
+                    aux_ct = jnp.float32(aux_w) * ct.astype(jnp.float32)
 
                 # ---- deferred global-norm clip / non-finite pre-pass
                 # (pass 1 of 2): re-scan the vjp accumulating ONLY
@@ -558,7 +601,7 @@ class FusedScanTrainStep:
                         _, vjp = jax.vjp(
                             lambda pl, xx: chunk_apply(pl, xx, rng0),
                             p_i, x_i)
-                        dp, dx = vjp(dy)
+                        dp, dx = vjp((dy, aux_ct) if aux_active else dy)
                         if guard is not None:
                             fin = fin & all_finite(
                                 [dp[j] for j in range(n_leaves)
@@ -615,7 +658,7 @@ class FusedScanTrainStep:
                     rng0 = self._rng_chunk_base(t32, i)
                     _, vjp = jax.vjp(
                         lambda pl, xx: chunk_apply(pl, xx, rng0), p_i, x_i)
-                    dp, dx = vjp(dy)
+                    dp, dx = vjp((dy, aux_ct) if aux_active else dy)
                     nP, nM, nV, nMW = [], [], [], []
                     for j in range(n_leaves):
                         if not self._s_params[j].trainable:

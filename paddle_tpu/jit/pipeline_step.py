@@ -102,6 +102,13 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                 "dropout inside the pipeline ring is not supported "
                 "(no per-(micro, stage) PRNG offset scheme yet); set "
                 "hidden/attention dropout to 0 or use the dp/mp steps")
+        if self._aux_active:
+            raise ValueError(
+                "MoE blocks under pipeline parallelism are not "
+                "supported: the ring schedule does not thread the "
+                "per-chunk aux-loss output (and expert all_to_alls "
+                "inside ring ticks are unvalidated) — train MoE models "
+                "on a dp or dp×ep mesh (ShardedFusedScanTrainStep)")
 
     def _extra_reduction_axes(self, mesh):
         pp_axis = self._pp_axis_arg

@@ -183,9 +183,9 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
     def __init__(self, model, optimizer, criterion=None, fused_head=False,
                  compute_dtype=None, layer_chunk=1, scan_unroll=1,
-                 mesh=None, axis=None, mp_axis=None, group=None,
-                 comm_bucket_mb=None, comm_quant=None, scaler=None,
-                 guard_nonfinite=None):
+                 mesh=None, axis=None, mp_axis=None, ep_axis=None,
+                 group=None, comm_bucket_mb=None, comm_quant=None,
+                 scaler=None, guard_nonfinite=None):
         model = _unwrap_layers(model)
         super().__init__(model, optimizer, criterion=criterion,
                          fused_head=fused_head,
@@ -225,17 +225,70 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 f"batch/data axis {axis!r} is not a mesh axis "
                 f"(mesh axes: {mesh.axis_names}); include it in the "
                 "mesh (degree 1 is fine) or pass axis= explicitly")
+        # expert parallelism (ISSUE 9): an ``ep`` axis shards the
+        # template's MoE expert stacks 1/ep and splits the batch over
+        # the FLATTENED (dp, ep) product — every (dp, ep) rank sees
+        # distinct rows, and the MoE dispatch/combine become explicit
+        # ep-axis all_to_alls inside the scan body (moe_layer's EP
+        # path). Auto-detected from the mesh for MoE templates only.
+        moe_template = bool(self._aux_layers)
+        if ep_axis is None:
+            ep_axis = next(
+                (a for a in ("ep",) if a in mesh.axis_names
+                 and int(mesh.shape[a]) > 1 and a != axis), None)
+            if ep_axis is not None and not moe_template:
+                ep_axis = None      # dense model: ep replicates
+        elif ep_axis not in mesh.axis_names:
+            # an explicit but unknown axis name is a config typo — the
+            # silent fallback would train with experts fully replicated
+            # while the user believes EP is active
+            raise ValueError(
+                f"ep_axis {ep_axis!r} is not a mesh axis (mesh axes: "
+                f"{mesh.axis_names}); include it in the mesh or drop "
+                "ep_axis")
+        elif int(mesh.shape[ep_axis]) <= 1:
+            ep_axis = None
+        if ep_axis is not None:
+            if not moe_template:
+                raise ValueError(
+                    f"ep_axis {ep_axis!r} given but the block template "
+                    "has no MoE layers to expert-shard; build the model "
+                    "with GPTConfig(num_experts=...) or drop ep_axis")
+            if ep_axis == axis:
+                raise ValueError(
+                    f"ep_axis {ep_axis!r} is also the batch/data axis; "
+                    "build the mesh with distinct dp and ep axes, e.g. "
+                    "build_mesh({'dp': N, 'ep': E})")
+            if mp_axis is not None:
+                raise NotImplementedError(
+                    "mp×ep composition is not supported: the Megatron "
+                    "block slicing and the expert all_to_all dispatch "
+                    "have not been validated together — use dp×ep or "
+                    "dp×mp")
         self._mesh, self._axis = mesh, axis
         self._mp_axis = mp_axis
+        self._ep_axis = ep_axis
         self._dp_degree = int(mesh.shape[axis])
         self._mp_degree = int(mesh.shape[mp_axis]) if mp_axis else 1
+        self._ep_degree = int(mesh.shape[ep_axis]) if ep_axis else 1
         # grad-reduction axes, FIRST AXIS MAJOR: every flat bucket
         # scatters/gathers over the flattened product, so optimizer
-        # shards are 1/(dp*mp); the flat rank below must match the
+        # shards are 1/(dp*mp*ep); the flat rank below must match the
         # tuple-collective split order. Subclasses (the pipeline step)
         # append further axes via _extra_reduction_axes.
-        self._axes = (axis,) if mp_axis is None else (axis, mp_axis)
-        self._degree = self._dp_degree * self._mp_degree
+        self._axes = (axis,)
+        if mp_axis is not None:
+            self._axes = self._axes + (mp_axis,)
+        if ep_axis is not None:
+            self._axes = self._axes + (ep_axis,)
+        self._degree = (self._dp_degree * self._mp_degree
+                        * self._ep_degree)
+        # the batch splits over (dp, ep) — under ep every rank holds
+        # distinct rows (pure data parallelism everywhere except the
+        # expert FFN, where the all_to_all exchanges tokens)
+        self._batch_axes = ((axis,) if ep_axis is None
+                            else (axis, ep_axis))
+        self._batch_degree = self._dp_degree * self._ep_degree
         for a in self._extra_reduction_axes(mesh):
             if a in self._axes:
                 raise ValueError(
@@ -255,10 +308,14 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         # dp-rank folded into the per-layer dropout offsets. mp ranks
         # MUST draw identical masks (they jointly compute the same batch
         # rows; divergent hidden-dropout masks would desynchronize the
-        # replicated residual stream), so only the dp index folds in.
-        self._rng_nranks = self._dp_degree
+        # replicated residual stream), so only the dp index folds in —
+        # but ep ranks hold DISTINCT rows, so under ep the flattened
+        # (dp, ep) batch rank folds in instead.
+        self._rng_nranks = self._batch_degree
         if mp_axis is not None:
             self._setup_mp()
+        if ep_axis is not None:
+            self._setup_ep()
         from_flag = comm_quant is None
         if comm_quant is None:
             comm_quant = _flags.get_flag("FLAGS_comm_quant") or ""
@@ -299,7 +356,10 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             bucket_bytes=bucket_bytes, pad_multiple=pad)
 
     def _rng_rank(self):
-        return lax.axis_index(self._axis)
+        r = lax.axis_index(self._axis)
+        if self._ep_axis is not None:
+            r = r * self._ep_degree + lax.axis_index(self._ep_axis)
+        return r
 
     def _extra_reduction_axes(self, mesh):
         """Hook: further mesh axes the grad scatter / optimizer shard
@@ -443,6 +503,57 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             and s.num_heads % mp == 0
         ]
 
+    # -- expert parallelism over the ep axis -----------------------------
+    # Storage stays replicated (same weight-update-sharding design as
+    # mp); COMPUTE is expert-parallel: each ep rank binds the 1/ep slice
+    # of every MoE expert stack into the template, and the MoE layer —
+    # seeing sliced stacks inside a shard_map that binds the axis —
+    # dispatches tokens to expert owners with explicit capacity-padded
+    # lax.all_to_alls (moe_layer.py's EP path). Per-rank expert grads
+    # are zero outside the rank's slice, so the (dp, ep) axis-tuple
+    # scatter is simultaneously the data-parallel reduction and the
+    # expert-parallel gradient assembly.
+    def _setup_ep(self):
+        from ..incubate.distributed.models.moe.moe_layer import MoELayer
+
+        ep = self._ep_degree
+        tmpl = self._template
+        subs = dict(tmpl.named_sublayers(include_self=True))
+        for path, sub in subs.items():
+            if isinstance(sub, MoELayer):
+                if sub.num_experts % ep:
+                    raise ValueError(
+                        f"{path or 'moe'}: num_experts "
+                        f"{sub.num_experts} not divisible by ep degree "
+                        f"{ep}")
+                if sub.ep_degree not in (None, ep):
+                    raise ValueError(
+                        f"{path or 'moe'}: MoELayer(ep_degree="
+                        f"{sub.ep_degree}) disagrees with the mesh's "
+                        f"ep degree {ep}")
+        def expert_slicer(degree):
+            def fn(d, r):
+                loc = d.shape[0] // degree
+                return lax.dynamic_slice_in_dim(d, r * loc, loc, 0)
+
+            return fn
+
+        slicers = []
+        for pname, p in tmpl.named_parameters():
+            path = pname.rsplit(".", 1)[0] if "." in pname else ""
+            leaf = pname.rsplit(".", 1)[-1]
+            owner = subs.get(path)
+            if isinstance(owner, MoELayer) and \
+                    leaf.startswith("experts__"):
+                slicers.append(expert_slicer(ep))
+            else:
+                slicers.append(None)     # gate weight, attention, norms
+        if not any(s is not None for s in slicers):
+            raise ValueError(
+                "ep axis active but no expert-stacked parameters found "
+                "in the block template")
+        self._ep_slicers = slicers
+
     class _RowParallelPsum:
         """Call-through shim over a row-parallel Linear: local partial
         matmul (+ bias/mp), then one psum over the mp axis — the
@@ -460,6 +571,13 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             return Tensor._wrap(lax.psum(y._data, self._axis))
 
     def _block_fn(self, leaf_datas, x, rng_off=None):
+        if self._ep_axis is not None:
+            r = lax.axis_index(self._ep_axis)
+            local = [d if fn is None else fn(d, r)
+                     for fn, d in zip(self._ep_slicers, leaf_datas)]
+            # the bound 1/ep expert slices + the bound ep axis are what
+            # flip MoELayer.forward onto its all_to_all dispatch path
+            return super()._block_fn(local, x, rng_off=rng_off)
         if self._mp_axis is None:
             return super()._block_fn(leaf_datas, x, rng_off=rng_off)
         r = lax.axis_index(self._mp_axis)
@@ -526,11 +644,15 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 self._bind([p for _, p in self._o_params], saved)
 
     def input_sharding(self):
-        """Batches stage dim-0-sharded 1/N over the dp axis — each device
-        receives only its shard of the global batch (the weight-update
-        sharding lesson applied to ingestion), and the placement matches
-        the step's shard_map batch spec so jit never reshards."""
-        return NamedSharding(self._mesh, P(self._axis))
+        """Batches stage dim-0-sharded 1/N over the batch axes (dp, or
+        the flattened dp×ep product under expert parallelism) — each
+        device receives only its shard of the global batch (the
+        weight-update sharding lesson applied to ingestion), and the
+        placement matches the step's shard_map batch spec so jit never
+        reshards."""
+        ba = (self._batch_axes if len(self._batch_axes) > 1
+              else self._axis)
+        return NamedSharding(self._mesh, P(ba))
 
     # -- flat sharded optimizer state -----------------------------------
     def _flat_key(self, grp, index):
@@ -770,6 +892,9 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         b, seq = ids.shape          # LOCAL batch rows
         pos = jnp.arange(seq, dtype=ids.dtype)[None, :]
 
+        aux_active = self._aux_active
+        aux_w = self._aux_weight / n_layers
+
         # ---- forward (replicated params, local batch shard)
         x0 = self._embed_fn(o["p"], ids, pos,
                             rng_off=self._rng_base(t32, n_layers))
@@ -778,16 +903,26 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
         def fwd_body(h, scanned):
             p_chunk, i = scanned
-            return chunk_apply(p_chunk, h,
-                               self._rng_chunk_base(t32, i)), h
+            rng0 = self._rng_chunk_base(t32, i)
+            if aux_active:
+                h2, aux = chunk_apply(p_chunk, h, rng0)
+                return h2, (h, aux)
+            return chunk_apply(p_chunk, h, rng0), h
 
-        xL, xs = lax.scan(fwd_body, x0, (sp_c, jnp.arange(C)),
+        xL, ys = lax.scan(fwd_body, x0, (sp_c, jnp.arange(C)),
                           unroll=self._scan_unroll)
+        xs, auxs = ys if aux_active else (ys, None)
 
         loss, head_vjp = jax.vjp(
             lambda od, x: self._head_fn(od, x, labels),
             o["p"], xL)
         d_o_head, dxL = head_vjp(ct.astype(loss.dtype))
+        aux_ct = None
+        if aux_active:
+            # total per-rank loss = CE + (w/L)*sum(aux); the chunk vjps
+            # get the matching loss-scaled cotangent
+            loss = loss + jnp.float32(aux_w) * jnp.sum(auxs)
+            aux_ct = jnp.float32(aux_w) * ct.astype(jnp.float32)
 
         # ---- backward scan: vjp one chunk, reduce-scatter its
         # bucket-packed grads over the FLATTENED reduction axes (dp, or
@@ -810,7 +945,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             _, vjp = jax.vjp(
                 lambda pl, xx: chunk_apply(pl, xx, rng0),
                 p_i, x_i)
-            dp, dx = vjp(dy)
+            dp, dx = vjp((dy, aux_ct) if aux_active else dy)
             newG = []
             for bkt in s_assign.buckets:
                 flat = pack_flat(lambda j: dp[j], bkt, lead=(K,))
@@ -1075,7 +1210,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 self._bind(self._buffers, saved_buf)
 
         specs = self._state_specs()
-        batch_spec = P(self._axis, None)
+        batch_spec = P(self._batch_axes if len(self._batch_axes) > 1
+                       else self._axis, None)
         # the trailing batch_spec covers the optional segment-id arg —
         # a None there is an empty pytree, so the spec binds no leaves
         wrapped = jax.shard_map(
@@ -1121,7 +1257,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             finally:
                 self._bind(self._buffers, saved_buf)
 
-        batch_spec = P(self._axis, None)
+        batch_spec = P(self._batch_axes if len(self._batch_axes) > 1
+                       else self._axis, None)
         wrapped = jax.shard_map(
             fn, mesh=self._mesh,
             in_specs=(specs, batch_spec, batch_spec),
@@ -1131,10 +1268,11 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
     def __call__(self, ids, labels, segment_ids=None):
         shape = getattr(ids, "shape", None)
-        if shape and shape[0] % self._dp_degree:
+        if shape and shape[0] % self._batch_degree:
             raise ValueError(
                 f"global batch {shape[0]} is not divisible by the "
-                f"{self._axis!r} degree {self._dp_degree}")
+                f"batch-axis degree {self._batch_degree} "
+                f"(axes {self._batch_axes})")
         return super().__call__(ids, labels, segment_ids=segment_ids)
 
 
@@ -1230,8 +1368,8 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
 
     if mesh is None and denv.is_initialized():
         mesh = denv.get_mesh()
-    degree = mp_degree = pp_degree = 1
-    mp_axis = pp_axis = None
+    degree = mp_degree = pp_degree = ep_degree = 1
+    mp_axis = pp_axis = ep_axis = None
     if mesh is not None:
         if axis is None:
             axis = next((a for a in ("sharding", "dp")
@@ -1245,6 +1383,11 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
         if "pp" in mesh.axis_names and int(mesh.shape["pp"]) > 1 \
                 and axis != "pp":
             pp_axis, pp_degree = "pp", int(mesh.shape["pp"])
+        if "ep" in mesh.axis_names and int(mesh.shape["ep"]) > 1 \
+                and axis != "ep" \
+                and getattr(getattr(layers, "config", None),
+                            "num_experts", 0):
+            ep_axis, ep_degree = "ep", int(mesh.shape["ep"])
     if scan and pp_degree > 1:
         from .pipeline_step import PipelineScanTrainStep
 
@@ -1264,11 +1407,22 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
                                      criterion=criterion, mesh=mesh,
                                      axis=axis, pp_axis=pp_axis,
                                      **kw)
-    if scan and (degree > 1 or mp_degree > 1):
+    if scan and (degree > 1 or mp_degree > 1 or ep_degree > 1):
+        if ep_degree > 1 and axis is None:
+            # a dp1×epN mesh still batches over "dp" — the constructor
+            # needs the (degree-1) data axis named
+            axis = next((a for a in ("sharding", "dp")
+                         if a in mesh.axis_names), None)
+            if axis is None:
+                raise ValueError(
+                    f"ep mesh {mesh.axis_names} has no dp/sharding "
+                    "axis to place the batch on; build it with one "
+                    "(degree 1 is fine): build_mesh({'dp': 1, "
+                    "'ep': N})")
         return ShardedFusedScanTrainStep(layers, optimizer,
                                          criterion=criterion, mesh=mesh,
                                          axis=axis, mp_axis=mp_axis,
-                                         **kw)
+                                         ep_axis=ep_axis, **kw)
     if scan:
         return FusedScanTrainStep(layers, optimizer, criterion=criterion,
                                   **{k: v for k, v in kw.items()
@@ -1289,11 +1443,12 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
 # ---------------------------------------------------------------------------
 
 def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1,
-                        mp=1, pp=1, num_micro=2):
+                        mp=1, pp=1, num_micro=2, ep=1):
     """Lower (not run) the sharded step for a tiny scan GPT on an
     n-device host mesh — the program the overlap checker inspects.
-    ``mp``/``pp`` > 1 build the hybrid variants (dp×mp Megatron
-    sharding / the dp×pp ring pipeline) instead of the dp-only step."""
+    ``mp``/``pp``/``ep`` > 1 build the hybrid variants (dp×mp Megatron
+    sharding / the dp×pp ring pipeline / the dp×ep expert-parallel MoE
+    step) instead of the dp-only step."""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.optimizer as popt
@@ -1308,21 +1463,25 @@ def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1,
             "(set --xla_force_host_platform_device_count)")
     from jax.sharding import Mesh
 
-    if mp > 1 and pp > 1:
-        raise NotImplementedError("combined mp×pp probe")
+    if sum(int(d) > 1 for d in (mp, pp, ep)) > 1:
+        raise NotImplementedError("combined mp×pp×ep probe")
     if mp > 1:
         dp = n_devices // mp
         mesh = Mesh(np.asarray(devs).reshape(dp, mp), ("dp", "mp"))
     elif pp > 1:
         dp = n_devices // pp
         mesh = denv.build_mesh({"dp": dp, "pp": pp}, devices=devs)
+    elif ep > 1:
+        dp = n_devices // ep
+        mesh = Mesh(np.asarray(devs).reshape(dp, ep), ("dp", "ep"))
     else:
         mesh = Mesh(np.asarray(devs), ("sharding",))
     denv.set_mesh(mesh)
     cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
                     num_attention_heads=2, max_position_embeddings=32,
                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                    scan_layers=True)
+                    scan_layers=True,
+                    num_experts=(2 * ep if ep > 1 else 0))
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters(),
@@ -1337,8 +1496,9 @@ def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1,
     else:
         step = ShardedFusedScanTrainStep(
             model, opt, mesh=mesh,
-            axis="dp" if mp > 1 else "sharding",
+            axis="dp" if (mp > 1 or ep > 1) else "sharding",
             mp_axis="mp" if mp > 1 else None,
+            ep_axis="ep" if ep > 1 else None,
             scan_unroll=scan_unroll, layer_chunk=layer_chunk)
     step.ensure_built()
     state = step._extract_state()

@@ -177,26 +177,38 @@ def _load_hlo_overlap():
     return mod
 
 
-def hlo_overlap_probe(n_devices=8, scan_unroll=2, mp=1, pp=1):
+def hlo_overlap_probe(n_devices=8, scan_unroll=2, mp=1, pp=1, ep=1):
     from .sharded_scan import build_probe_lowered
 
     mod = _load_hlo_overlap()
     text = build_probe_lowered(n_devices=n_devices,
                                scan_unroll=scan_unroll, mp=mp,
-                               pp=pp).compile().as_text()
+                               pp=pp, ep=ep).compile().as_text()
     # axis degrees in MESH order (build_probe_lowered's layouts) so the
     # per-axis classifier numbers devices the way the mesh does
     if mp > 1:
         degrees = {"dp": n_devices // mp, "mp": mp}
     elif pp > 1:
         degrees = {"pp": pp, "dp": n_devices // pp}   # build_mesh order
+    elif ep > 1:
+        degrees = {"dp": n_devices // ep, "ep": ep}
     else:
         degrees = {"sharding": n_devices}
     verdict = mod.analyze(text, axis_degrees=degrees)
     verdict["probe"] = {"n_devices": n_devices,
                         "scan_unroll": scan_unroll,
-                        "mp": mp, "pp": pp,
+                        "mp": mp, "pp": pp, "ep": ep,
                         "model": "tiny-gpt L4 h64"}
+    if ep > 1:
+        # the MoE dispatch receipt: >= 2 ep-axis all-to-alls (dispatch +
+        # combine per forward; the bwd transposes add more) and NO
+        # unclassified traffic
+        ep_a2a = verdict.get("per_axis_counts", {}) \
+            .get("ep", {}).get("all-to-all", 0)
+        verdict["ep_all_to_all"] = ep_a2a
+        verdict["ep_dispatch_ok"] = bool(
+            ep_a2a >= 2
+            and "other" not in verdict.get("per_axis_counts", {}))
     return verdict
 
 
@@ -208,7 +220,8 @@ def _main():
         # mp traffic (and show the pp ring's collective-permutes); the
         # verdicts ride the same MULTICHIP record
         for key, kw in (("hlo_overlap_dp4mp2", {"mp": 2}),
-                        ("hlo_overlap_dp4pp2", {"pp": 2})):
+                        ("hlo_overlap_dp4pp2", {"pp": 2}),
+                        ("hlo_overlap_dp4ep2", {"ep": 2})):
             try:
                 out[key] = hlo_overlap_probe(**kw)
             except Exception as e:   # a probe failure must not eat the

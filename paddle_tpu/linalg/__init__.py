@@ -1,0 +1,23 @@
+"""``paddle.linalg`` — linear algebra namespace.
+
+Reference parity: python/paddle/linalg.py (the reference re-exports the
+tensor.linalg surface under ``paddle.linalg``); the whole
+`paddle_tpu.ops.linalg` surface is re-exported here so the two spellings
+stay interchangeable. TPU-first addition: the
+``paddle.linalg.distributed`` subsystem (SUMMA matmul, blocked Cholesky,
+TSQR QR, subspace-iteration eigensolvers over a 2-D device grid) — the
+"Large Scale Distributed Linear Algebra With TPUs" workload tier
+(PAPERS.md, arXiv 2112.09017) on the same mesh/PartitionSpec substrate
+the training stack uses.
+"""
+import sys as _sys
+
+from ..ops import linalg as _ops_linalg
+
+_this = _sys.modules[__name__]
+for _n in dir(_ops_linalg):
+    if not _n.startswith("_"):
+        setattr(_this, _n, getattr(_ops_linalg, _n))
+del _n
+
+from . import distributed  # noqa: E402,F401

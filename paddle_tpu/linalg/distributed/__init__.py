@@ -1,0 +1,41 @@
+"""``paddle.linalg.distributed`` — dense linear algebra as a TPU
+workload tier (ROADMAP item 5; "Large Scale Distributed Linear Algebra
+With TPUs", PAPERS.md arXiv 2112.09017).
+
+Everything runs on a ``(rows, cols)`` jax Mesh (`build_grid`) through
+`shard_map` — the same NamedSharding/PartitionSpec substrate the
+training stack uses — and every op carries two contracts:
+
+* **reference parity**: matches the single-device `jnp.linalg` answer
+  (fp32 tol ≤ 1e-4 on the test sizes);
+* **no full-matrix gather**: no rank's compiled program ever holds a
+  buffer the size of a global operand — panels move, matrices don't
+  (`probe.assert_no_full_matrix` over the compiled HLO).
+
+Quickstart::
+
+    import paddle_tpu as paddle
+    from paddle_tpu.linalg import distributed as dla
+
+    grid = dla.build_grid()              # e.g. 4x2 over 8 devices
+    c = dla.matmul(a, b, grid=grid)      # SUMMA
+    l = dla.cholesky(spd)                # blocked, square grid
+    q, r = dla.qr(tall)                  # TSQR
+    w, v = dla.eigsh(sym, k=4)           # subspace iteration
+"""
+from ._grid import (  # noqa: F401
+    block_cyclic_permutation, build_grid, default_grid, grid_shape,
+)
+from .summa import matmul, summa_lowered  # noqa: F401
+from .factorizations import (  # noqa: F401
+    cholesky, cholesky_lowered, qr, qr_lowered,
+)
+from .eigen import eigsh, eigsh_lowered, power_iteration  # noqa: F401
+from . import probe  # noqa: F401
+
+__all__ = [
+    "block_cyclic_permutation", "build_grid", "cholesky",
+    "cholesky_lowered", "default_grid", "eigsh", "eigsh_lowered",
+    "grid_shape", "matmul", "power_iteration", "probe", "qr",
+    "qr_lowered", "summa_lowered",
+]

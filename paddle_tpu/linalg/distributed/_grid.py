@@ -1,0 +1,140 @@
+"""Shared substrate for ``paddle.linalg.distributed``: the 2-D device
+grid, block/block-cyclic layouts, padding, and the compiled-callable
+cache.
+
+The grid is an ordinary ``jax.sharding.Mesh`` with axes ``("rows",
+"cols")`` — the same NamedSharding/PartitionSpec machinery the training
+stack runs on (SURVEY.md §5.8), just with linear-algebra axis names. All
+ops are `shard_map` programs over this mesh: every rank holds ONE local
+block (or a block-cyclic set folded into its block, see
+`block_cyclic_permutation`), and the per-rank program moves PANELS, never
+whole matrices — the contract `probe.assert_no_full_matrix` checks on the
+compiled HLO.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+
+ROWS, COLS = "rows", "cols"
+
+
+def build_grid(rows=None, cols=None, devices=None, square=False) -> Mesh:
+    """A ``(rows, cols)`` device grid. With no degrees given, factors the
+    device count as close to square as possible (rows >= cols);
+    ``square=True`` instead takes the largest g×g subset (blocked
+    Cholesky needs aligned row/col block indexing)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if rows is None and cols is None:
+        if square:
+            g = int(math.isqrt(n))
+            rows = cols = g
+        else:
+            rows = next(d for d in range(int(math.isqrt(n)), 0, -1)
+                        if n % d == 0)
+            rows, cols = n // rows, rows
+    elif rows is None:
+        rows = n // cols
+    elif cols is None:
+        cols = n // rows
+    need = rows * cols
+    if need > n:
+        raise ValueError(
+            f"grid {rows}x{cols} needs {need} devices, have {n}")
+    arr = np.asarray(devices[:need]).reshape(rows, cols)
+    return Mesh(arr, (ROWS, COLS))
+
+
+def grid_shape(grid: Mesh):
+    return int(grid.shape[ROWS]), int(grid.shape[COLS])
+
+
+def default_grid(square=False) -> Mesh:
+    return build_grid(square=square)
+
+
+# ---------------------------------------------------------------------------
+# data plumbing
+# ---------------------------------------------------------------------------
+
+def as_array(x):
+    """-> (jnp array, was_tensor)."""
+    if isinstance(x, Tensor):
+        return x._data, True
+    return jnp.asarray(x), False
+
+
+def wrap_like(data, was_tensor):
+    return Tensor._wrap(data) if was_tensor else data
+
+
+def pad_dim(n, mult):
+    return (-n) % mult
+
+
+def pad2(x, row_mult, col_mult):
+    """Zero-pad the trailing 2 dims up to multiples; returns (padded,
+    (rows, cols) original)."""
+    m, n = x.shape[-2], x.shape[-1]
+    pr, pc = pad_dim(m, row_mult), pad_dim(n, col_mult)
+    if pr or pc:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, pr), (0, pc)])
+    return x, (m, n)
+
+
+def place(x, grid, spec):
+    return jax.device_put(x, NamedSharding(grid, spec))
+
+
+def block_cyclic_permutation(n, degree, block):
+    """Gather indices realizing the ScaLAPACK block-cyclic layout along
+    one dim: row g belongs to block b = g // block, owned by rank
+    b % degree; the permutation groups each rank's cyclic block set
+    contiguously (rank-major, cycle order preserved), so the plain
+    block-sharded mesh layout of the PERMUTED matrix IS the block-cyclic
+    layout of the original. `n` must divide by block*degree."""
+    if n % (block * degree):
+        raise ValueError(
+            f"dim {n} not divisible by block*degree "
+            f"({block}*{degree})")
+    nb = n // block
+    owners = np.arange(nb) % degree
+    order = np.argsort(owners, kind="stable")
+    return np.concatenate(
+        [np.arange(b * block, (b + 1) * block) for b in order])
+
+
+def inverse_permutation(idx):
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(idx.size)
+    return inv
+
+
+# ---------------------------------------------------------------------------
+# compiled-callable cache (one executable per op/grid/shape signature —
+# the eager-collective _eager_fn_cache lesson: a fresh shard_map wrapper
+# per call would retrace every call)
+# ---------------------------------------------------------------------------
+
+_jit_cache: dict = {}
+_JIT_CACHE_CAP = 64
+
+
+def cached_jit(key, build):
+    fn = _jit_cache.get(key)
+    if fn is None:
+        while len(_jit_cache) >= _JIT_CACHE_CAP:
+            _jit_cache.pop(next(iter(_jit_cache)))
+        fn = build()
+        _jit_cache[key] = fn
+    else:
+        _jit_cache[key] = _jit_cache.pop(key)   # LRU refresh
+    return fn

@@ -1,0 +1,188 @@
+"""Blocked Cholesky + TSQR QR on the distributed grid.
+
+Cholesky (right-looking, square g×g grid): the classic blocked loop —
+factor the diagonal block, triangular-solve the panel below it, rank-k
+update the trailing matrix — with each block owned by one rank. Per
+iteration the wire carries the [nb, nb] diagonal block (two one-axis
+broadcasts) and the column-k panel ([n, nb] — an all_gather along
+``rows``); the trailing update is local. No rank ever holds more than
+its block plus one panel.
+
+QR (TSQR, 1-D row layout over the flattened grid): each rank QRs its
+row block, the [w·n, n] stack of local R factors is gathered (n is the
+SKINNY dim — the tall dim never gathers) and QR'd redundantly, and the
+final thin Q is the local Q times this rank's block of the second-stage
+Q. Communication: ONE all_gather of n×n factors. Requires full column
+rank (the standard TSQR contract; rank-deficient inputs should go
+through svd).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._grid import (
+    COLS, ROWS, as_array, build_grid, cached_jit, grid_shape, pad2,
+    place, wrap_like,
+)
+
+__all__ = ["cholesky", "qr", "cholesky_lowered", "qr_lowered"]
+
+
+# ---------------------------------------------------------------------------
+# blocked Cholesky
+# ---------------------------------------------------------------------------
+
+def _chol_fn(g):
+    """Per-rank body over one [nb, nb] block of the padded SPD matrix."""
+
+    def fn(a):
+        i = lax.axis_index(ROWS)
+        j = lax.axis_index(COLS)
+        L = jnp.zeros_like(a)
+        for k in range(g):
+            # diagonal block -> everyone (two one-axis broadcasts)
+            akk = lax.psum(jnp.where((i == k) & (j == k), a,
+                                     jnp.zeros_like(a)), ROWS)
+            akk = lax.psum(akk, COLS)
+            lkk = jnp.linalg.cholesky(akk)
+            # panel below the diagonal: L_ik = A_ik @ L_kk^{-T}
+            # (computed by every rank; only column k's blocks are real)
+            pan = jax.scipy.linalg.solve_triangular(
+                lkk, a.swapaxes(-1, -2), lower=True).swapaxes(-1, -2)
+            pan = jnp.where(i == k, lkk, pan)
+            # broadcast column k's blocks across the grid row...
+            pan = lax.psum(jnp.where(j == k, pan, jnp.zeros_like(pan)),
+                           COLS)
+            # ...and gather the whole column-k panel along rows: every
+            # rank sees L_{*,k} ([g, nb, nb] = an [n, nb] panel)
+            panel = lax.all_gather(pan, ROWS, axis=0, tiled=False)
+            l_ik = pan                       # block (i, k)
+            l_jk = jnp.take(panel, j, axis=0)  # block (j, k)
+            L = jnp.where((j == k) & (i >= k), l_ik, L)
+            upd = jnp.dot(l_ik, l_jk.swapaxes(-1, -2),
+                          preferred_element_type=jnp.float32)
+            a = jnp.where((i > k) & (j > k), a - upd.astype(a.dtype), a)
+        return L
+
+    return fn
+
+
+def _build_chol(grid, g):
+    spec = P(ROWS, COLS)
+    return jax.jit(jax.shard_map(_chol_fn(g), mesh=grid,
+                                 in_specs=(spec,), out_specs=spec,
+                                 check_vma=False))
+
+
+def _chol_grid(grid):
+    if grid is None:
+        grid = build_grid(square=True)
+    r, c = grid_shape(grid)
+    if r != c:
+        raise ValueError(
+            f"blocked Cholesky needs a square grid (block (i,k)/(j,k) "
+            f"indexing aligns row and column blocks); got {r}x{c} — "
+            "build_grid(square=True)")
+    return grid, r
+
+
+def cholesky(x, upper=False, grid=None):
+    """Distributed lower Cholesky of an SPD matrix on a g×g grid.
+
+    Non-divisible sizes are padded with an identity tail (keeps the
+    padded matrix SPD; the pad factors to itself and is sliced away).
+    """
+    a, wrap = as_array(x)
+    if a.ndim != 2 or a.shape[0] != a.shape[1]:
+        raise ValueError(f"cholesky needs a square matrix, got {a.shape}")
+    grid, g = _chol_grid(grid)
+    a_p, (n, _) = pad2(a, g, g)
+    if a_p.shape[0] != n:
+        pad_idx = jnp.arange(n, a_p.shape[0])
+        a_p = a_p.at[pad_idx, pad_idx].set(jnp.asarray(1, a_p.dtype))
+    a_p = place(a_p, grid, P(ROWS, COLS))
+    fn = cached_jit(("chol", grid, a_p.shape, str(a_p.dtype)),
+                    lambda: _build_chol(grid, g))
+    L = fn(a_p)[:n, :n]
+    if upper:
+        L = L.swapaxes(-1, -2)
+    return wrap_like(L, wrap)
+
+
+def cholesky_lowered(n, grid=None, dtype=jnp.float32):
+    grid, g = _chol_grid(grid)
+    a = jnp.zeros((n + (-n) % g,) * 2, dtype)
+    return _build_chol(grid, g).lower(place(a, grid, P(ROWS, COLS)))
+
+
+# ---------------------------------------------------------------------------
+# TSQR
+# ---------------------------------------------------------------------------
+
+def _tsqr_fn(w, c):
+    """Per-rank body over one [m/w, n] row block."""
+
+    def fn(a):
+        # flattened (rows, cols) rank, first axis major — matches the
+        # P((ROWS, COLS), ...) split order
+        rank = lax.axis_index(ROWS) * c + lax.axis_index(COLS)
+        q1, r1 = jnp.linalg.qr(a, mode="reduced")      # [mL, n], [n, n]
+        # the ONLY collective: stack the skinny R factors everywhere
+        rs = lax.all_gather(r1, (ROWS, COLS), axis=0,
+                            tiled=False)               # [w, n, n]
+        n = a.shape[1]
+        q2, r2 = jnp.linalg.qr(rs.reshape(w * n, n), mode="reduced")
+        q2_block = lax.dynamic_slice_in_dim(q2, rank * n, n, 0)
+        return jnp.dot(q1, q2_block,
+                       preferred_element_type=jnp.float32) \
+            .astype(a.dtype), r2
+
+    return fn
+
+
+def _build_tsqr(grid, w):
+    row_spec = P((ROWS, COLS), None)
+    _, c = grid_shape(grid)
+    return jax.jit(jax.shard_map(_tsqr_fn(w, c), mesh=grid,
+                                 in_specs=(row_spec,),
+                                 out_specs=(row_spec, P()),
+                                 check_vma=False))
+
+
+def qr(x, mode="reduced", grid=None):
+    """Distributed thin QR of a tall [m, n] matrix (TSQR): A row-sharded
+    over ALL grid devices, one n×n-factor all_gather, full-rank
+    contract. Returns (Q [m, n], R [n, n])."""
+    if mode != "reduced":
+        raise NotImplementedError(
+            f"distributed.qr supports mode='reduced' (thin TSQR); "
+            f"got {mode!r}")
+    a, wrap = as_array(x)
+    if a.ndim != 2:
+        raise ValueError(f"qr needs a 2-D matrix, got {a.shape}")
+    if grid is None:
+        grid = build_grid()
+    r, c = grid_shape(grid)
+    w = r * c
+    a_p, (m, n) = pad2(a, w, 1)
+    if m < n:
+        raise ValueError(
+            f"TSQR is for tall matrices (m >= n), got {a.shape}")
+    a_p = place(a_p, grid, P((ROWS, COLS), None))
+    fn = cached_jit(("tsqr", grid, a_p.shape, str(a_p.dtype)),
+                    lambda: _build_tsqr(grid, w))
+    q, r_out = fn(a_p)
+    return wrap_like(q[:m], wrap), wrap_like(r_out, wrap)
+
+
+def qr_lowered(m, n, grid=None, dtype=jnp.float32):
+    if grid is None:
+        grid = build_grid()
+    r, c = grid_shape(grid)
+    w = r * c
+    a = jnp.zeros((m + (-m) % w, n), dtype)
+    return _build_tsqr(grid, w).lower(
+        place(a, grid, P((ROWS, COLS), None)))

@@ -1,0 +1,131 @@
+"""Hermetic distributed-linalg selftest lane (ISSUE 9 CI satellite).
+
+Run under a cpu-forced env (bench.py's stripped subprocess /
+tools/cpu_env.sh) with an 8-virtual-device host platform:
+
+    python -m paddle_tpu.linalg.distributed.selftest
+
+Asserts, on the 8-device host mesh, the tentpole contracts:
+
+  * SUMMA matmul (incl. a non-divisible shape and the block-cyclic
+    layout), blocked Cholesky, TSQR QR and the subspace-iteration
+    eigensolver each match the single-device jnp.linalg reference at
+    fp32 tol <= 1e-4;
+  * each op's compiled per-device program holds NO buffer the size of a
+    full global matrix, and its per-axis collective census (from
+    tools/hlo_overlap.py) matches the algorithm's shape — the "panels
+    move, matrices don't" receipt.
+
+Prints ONE JSON line so the record lands verbatim in BENCH_r*.json.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+TOL = 1e-4
+
+
+def linalg_probe(n_devices=8):
+    import jax
+
+    import paddle_tpu as paddle  # noqa: F401  (installs jax shims)
+    from paddle_tpu.linalg import distributed as dla
+    from paddle_tpu.linalg.distributed import probe
+
+    devs = jax.devices("cpu")[:n_devices]
+    if len(devs) < n_devices:
+        return {"check": f"FAIL: {len(devs)} cpu devices < {n_devices}"}
+    grid = dla.build_grid(devices=devs)
+    g2 = dla.build_grid(2, 2, devices=devs)
+    rng = np.random.default_rng(0)
+    errs = {}
+    t0 = time.perf_counter()
+
+    # SUMMA (divisible, non-divisible, block-cyclic)
+    a = rng.standard_normal((96, 80)).astype(np.float32)
+    b = rng.standard_normal((80, 64)).astype(np.float32)
+    errs["summa"] = float(np.abs(
+        np.asarray(dla.matmul(a, b, grid=grid)) - a @ b).max())
+    a2 = rng.standard_normal((37, 53)).astype(np.float32)
+    b2 = rng.standard_normal((53, 29)).astype(np.float32)
+    errs["summa_nondivisible"] = float(np.abs(
+        np.asarray(dla.matmul(a2, b2, grid=grid)) - a2 @ b2).max())
+    a3 = rng.standard_normal((40, 24)).astype(np.float32)
+    b3 = rng.standard_normal((24, 36)).astype(np.float32)
+    errs["summa_block_cyclic"] = float(np.abs(
+        np.asarray(dla.matmul(a3, b3, grid=g2, block_size=4))
+        - a3 @ b3).max())
+
+    # blocked Cholesky
+    x = rng.standard_normal((48, 48)).astype(np.float32)
+    spd = x @ x.T + 48 * np.eye(48, dtype=np.float32)
+    errs["cholesky"] = float(np.abs(
+        np.asarray(dla.cholesky(spd, grid=g2))
+        - np.linalg.cholesky(spd)).max())
+
+    # TSQR
+    t = rng.standard_normal((128, 16)).astype(np.float32)
+    q, r = dla.qr(t, grid=grid)
+    q, r = np.asarray(q), np.asarray(r)
+    errs["qr_reconstruct"] = float(np.abs(q @ r - t).max())
+    errs["qr_orthonormal"] = float(np.abs(q.T @ q - np.eye(16)).max())
+
+    # subspace iteration
+    qm, _ = np.linalg.qr(rng.standard_normal((48, 48)))
+    lam = np.array([10.0, 8.0, 6.0, 4.5]
+                   + list(0.5 * rng.random(44)))
+    sym = ((qm * lam) @ qm.T).astype(np.float32)
+    sym = 0.5 * (sym + sym.T)
+    w, v = dla.eigsh(sym, k=4, iters=60, grid=grid)
+    ref = np.sort(np.linalg.eigvalsh(sym))[::-1][:4]
+    errs["eigsh_evals"] = float(np.abs(np.asarray(w) - ref).max())
+    errs["eigsh_residual"] = float(np.abs(
+        sym @ np.asarray(v) - np.asarray(v)
+        * np.asarray(w)[None, :]).max())
+
+    # HLO receipts: no rank ever materializes a full matrix
+    receipts = {}
+    receipts["summa"] = probe.collective_receipt(
+        dla.summa_lowered(64, 64, 64, grid=grid), grid,
+        full_elems=64 * 64)
+    receipts["cholesky"] = probe.collective_receipt(
+        dla.cholesky_lowered(32, grid=g2), g2, full_elems=32 * 32)
+    receipts["qr"] = probe.collective_receipt(
+        dla.qr_lowered(1024, 16, grid=grid), grid,
+        full_elems=1024 * 16)
+    receipts["eigsh"] = probe.collective_receipt(
+        dla.eigsh_lowered(64, k=4, iters=8, grid=grid), grid,
+        full_elems=64 * 64)
+    no_full = all(r.get("no_full_matrix") for r in receipts.values())
+    census = {k: r.get("per_axis_counts") for k, r in receipts.items()}
+
+    worst = max(errs.values())
+    ok = worst <= TOL and no_full
+    return {
+        "check": "pass" if ok else
+        f"FAIL: worst_err={worst:.2e} no_full_matrix={no_full}",
+        "n_devices": n_devices,
+        "grid": list(dla.grid_shape(grid)),
+        "max_abs_err": errs,
+        "tolerance": TOL,
+        "no_full_matrix": no_full,
+        "per_axis_collectives": census,
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+def _main():
+    try:
+        out = {"distributed_linalg": linalg_probe()}
+    except Exception as e:
+        out = {"distributed_linalg": {
+            "check": f"FAIL: {type(e).__name__}: {e}"[:300]}}
+    print(json.dumps(out))
+    return 0 if out["distributed_linalg"].get("check") == "pass" else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

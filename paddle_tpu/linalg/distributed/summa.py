@@ -1,0 +1,160 @@
+"""SUMMA matmul over the ``(rows, cols)`` grid.
+
+Van de Geijn & Watts' Scalable Universal Matrix Multiplication Algorithm,
+the workhorse of "Large Scale Distributed Linear Algebra With TPUs"
+(PAPERS.md, arXiv 2112.09017): C = A @ B with A, B, C all 2-D
+block-sharded — rank (i, j) holds A_ij [M/r, K/c], B_ij [K/r, N/c] and
+produces C_ij [M/r, N/c]. The contraction dim is walked in `npanels`
+panels of width kb = K/npanels; each step broadcasts A's panel along the
+``cols`` axis (owner block-column) and B's panel along the ``rows`` axis
+(owner block-row) and accumulates the local [M/r, kb] x [kb, N/c]
+product in fp32. Only panel-sized buffers ever cross the wire or live
+per rank — no rank materializes a full operand or result
+(`probe.assert_no_full_matrix` is the receipt).
+
+The broadcast is the shard_map idiom `psum(where(owner, panel, 0))` —
+one all-reduce per panel per operand over ONE mesh axis, which is what
+`tools/hlo_overlap.py` counts per axis in the collective receipt.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ._grid import (
+    COLS, ROWS, as_array, block_cyclic_permutation, cached_jit,
+    default_grid, grid_shape, inverse_permutation, pad2, place, wrap_like,
+)
+
+__all__ = ["matmul", "summa_lowered"]
+
+
+def _npanels(r, c, panels):
+    """Panel count: a common multiple of r and c, so every panel sits
+    inside one block-column of A AND one block-row of B."""
+    base = (r * c) // math.gcd(r, c)
+    if panels is None:
+        return base
+    return max(1, -(-int(panels) // base)) * base
+
+
+def _summa_fn(r, c, npanels, out_dtype):
+    """The per-rank SUMMA body: a [mL, K/c], b [K/r, nL] -> c [mL, nL]."""
+
+    def fn(a, b):
+        i = lax.axis_index(ROWS)
+        j = lax.axis_index(COLS)
+        kb_a = npanels // c          # panels per block-column of A
+        kb_b = npanels // r          # panels per block-row of B
+        kb = (a.shape[1] * c) // npanels
+        acc = jnp.zeros((a.shape[0], b.shape[1]), jnp.float32)
+        for t in range(npanels):
+            jt, oa = divmod(t, kb_a)
+            it, ob = divmod(t, kb_b)
+            a_pan = lax.dynamic_slice_in_dim(a, oa * kb, kb, 1)
+            a_pan = jnp.where(j == jt, a_pan, jnp.zeros_like(a_pan))
+            a_pan = lax.psum(a_pan, COLS)
+            b_pan = lax.dynamic_slice_in_dim(b, ob * kb, kb, 0)
+            b_pan = jnp.where(i == it, b_pan, jnp.zeros_like(b_pan))
+            b_pan = lax.psum(b_pan, ROWS)
+            acc = acc + jnp.dot(a_pan, b_pan,
+                                preferred_element_type=jnp.float32)
+        return acc.astype(out_dtype)
+
+    return fn
+
+
+def _build_summa(grid, npanels, a_shape, b_shape, dtype):
+    r, c = grid_shape(grid)
+    spec = P(ROWS, COLS)
+    fn = _summa_fn(r, c, npanels, dtype)
+    return jax.jit(jax.shard_map(fn, mesh=grid, in_specs=(spec, spec),
+                                 out_specs=spec, check_vma=False))
+
+
+def _prepare(a, b, grid, panels):
+    """Pad operands to grid/panel multiples; returns everything the
+    compiled call and the probe need."""
+    if grid is None:
+        grid = default_grid()
+    r, c = grid_shape(grid)
+    np_ = _npanels(r, c, panels)
+    # np_ is a common multiple of r and c, so padding K to np_ also
+    # makes the K/c and K/r local splits exact
+    kmul = np_
+    a_p, (m, k) = pad2(a, r, kmul)
+    b_p, (k2, n) = pad2(b, kmul, c)
+    if k != k2:
+        raise ValueError(
+            f"matmul inner dims disagree: {a.shape} @ {b.shape}")
+    spec = P(ROWS, COLS)
+    a_p = place(a_p, grid, spec)
+    b_p = place(b_p, grid, spec)
+    return grid, np_, a_p, b_p, (m, k, n)
+
+
+def matmul(a, b, grid=None, panels=None, block_size=None):
+    """Distributed C = A @ B via SUMMA on a ``(rows, cols)`` grid.
+
+    ``panels`` raises the panel count (finer pipelining; rounded up to a
+    common multiple of the grid degrees). ``block_size`` distributes the
+    operands BLOCK-CYCLICALLY with that block edge (ScaLAPACK layout —
+    load-balances triangular/banded structure; square grids only): the
+    cyclic layout is realized as a pure index permutation of each global
+    dim, SUMMA runs on the permuted blocks, and the result permutes
+    back — bit-identical math, different rank ownership.
+    """
+    a_d, wrap_a = as_array(a)
+    b_d, wrap_b = as_array(b)
+    if a_d.ndim != 2 or b_d.ndim != 2:
+        raise ValueError(
+            f"distributed.matmul is 2-D (got {a_d.shape} @ {b_d.shape});"
+            " batch with a vmap over the leading dims")
+    if grid is None:
+        grid = default_grid(square=block_size is not None)
+    r, c = grid_shape(grid)
+    perms = None
+    if block_size is not None:
+        if r != c:
+            raise ValueError(
+                "block-cyclic layout needs a square grid (the one "
+                f"K-permutation must be cyclic over both the {c} "
+                f"block-columns of A and the {r} block-rows of B); got "
+                f"{r}x{c} — build_grid(square=True)")
+        bs = int(block_size)
+        # pad every dim to block*degree multiples before permuting
+        a_d, (m0, k0) = pad2(a_d, bs * r, bs * c)
+        b_d, (_, n0) = pad2(b_d, bs * r, bs * c)
+        pm = block_cyclic_permutation(a_d.shape[0], r, bs)
+        pk = block_cyclic_permutation(a_d.shape[1], c, bs)
+        pn = block_cyclic_permutation(b_d.shape[1], c, bs)
+        a_d = jnp.take(jnp.take(a_d, pm, 0), pk, 1)
+        b_d = jnp.take(jnp.take(b_d, pk, 0), pn, 1)
+        perms = (pm, pn, m0, n0)
+    grid, np_, a_p, b_p, (m, k, n) = _prepare(a_d, b_d, grid, panels)
+    fn = cached_jit(
+        ("summa", grid, np_, a_p.shape, b_p.shape, str(a_p.dtype)),
+        lambda: _build_summa(grid, np_, a_p.shape, b_p.shape,
+                             a_p.dtype))
+    out = fn(a_p, b_p)
+    if perms is not None:
+        pm, pn, m0, n0 = perms
+        out = jnp.take(jnp.take(out, inverse_permutation(pm), 0),
+                       inverse_permutation(pn), 1)[:m0, :n0]
+    else:
+        out = out[:m, :n]
+    return wrap_like(out, wrap_a or wrap_b)
+
+
+def summa_lowered(m, k, n, grid=None, panels=None, dtype=jnp.float32):
+    """Lower (never run) the SUMMA program for the given global shapes —
+    the compiled text is what the collective receipt inspects."""
+    a = jnp.zeros((m, k), dtype)
+    b = jnp.zeros((k, n), dtype)
+    grid, np_, a_p, b_p, _ = _prepare(a, b, grid, panels)
+    jit_fn = _build_summa(grid, np_, a_p.shape, b_p.shape, a_p.dtype)
+    return jit_fn.lower(a_p, b_p)

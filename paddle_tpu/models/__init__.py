@@ -7,6 +7,7 @@ from .gpt import (  # noqa: F401
     GPTModel,
     GPTForCausalLM,
     GPTPretrainingCriterion,
+    MoEBlock,
     gpt_config,
     gpt_sharding_rules,
     match_sharding,

@@ -55,6 +55,17 @@ class GPTConfig:
     # drops from ~17 min to minutes; see PERF.md). Same math; param names
     # become blocks__<template-name> with a stacked leading dim.
     scan_layers: bool = False
+    # Mixture-of-experts FFN (ISSUE 9): num_experts > 0 swaps every
+    # block's GPTMLP for an MoEBlock (top-k gated ExpertFFNs, GShard
+    # capacity dropping). Expert stacks shard 1/ep over a dp×ep mesh in
+    # ShardedFusedScanTrainStep (token dispatch via lax.all_to_all); the
+    # load-balance aux loss (weight moe_aux_weight, mean over MoE
+    # layers) is added to the training loss by `loss()` and by the scan
+    # train steps.
+    num_experts: int = 0
+    moe_capacity_factor: float = 2.0
+    moe_gate: str = "gshard"        # "gshard" (top-2) | "switch" (top-1)
+    moe_aux_weight: float = 1e-2
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -298,6 +309,31 @@ class GPTMLP(nn.Layer):
         return self.fc2(F.gelu(self.fc1(x), approximate=True))
 
 
+class MoEBlock(nn.Layer):
+    """MoE variant of the GPT FFN (ISSUE 9): a `MoELayer` over
+    num_experts `ExpertFFN`s in the GPTMLP geometry. Slots into GPTBlock
+    wherever GPTMLP does; after forward, ``l_aux`` holds the layer's
+    load-balance loss (collected by `GPTModel`/the scan train steps)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        from ..incubate.distributed.models.moe import ExpertFFN, MoELayer
+
+        self.moe = MoELayer(
+            config.hidden_size,
+            [ExpertFFN(config.hidden_size, config.intermediate_size)
+             for _ in range(config.num_experts)],
+            gate=config.moe_gate,
+            capacity_factor=config.moe_capacity_factor)
+
+    @property
+    def l_aux(self):
+        return self.moe.l_aux
+
+    def forward(self, x):
+        return self.moe(x)
+
+
 class GPTBlock(nn.Layer):
     """Pre-LN transformer decoder block."""
 
@@ -308,7 +344,8 @@ class GPTBlock(nn.Layer):
         self.attn = GPTAttention(config)
         self.ln_2 = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_epsilon)
-        self.mlp = GPTMLP(config)
+        self.mlp = (MoEBlock(config) if config.num_experts
+                    else GPTMLP(config))
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self._use_recompute = config.use_recompute
         self._recompute_policy = config.recompute_policy
@@ -364,9 +401,16 @@ class GPTStackedBlocks(nn.Layer):
         std = config.initializer_range
         for pname, p in self._template.named_parameters():
             shape = (n,) + tuple(p.shape)
-            if p.ndim >= 2:
+            # name-gated, not ndim-gated: MoE expert biases are stacked
+            # to [E, dim] (ndim 2) but must keep their zero init like
+            # the dense twin's 1-D biases
+            if p.ndim >= 2 and not pname.endswith("bias"):
                 data = host_normal(shape, std)
-                if re.search(r"(out_proj|fc2)\.weight$", pname):
+                # residual-scaled init for the projections feeding the
+                # residual stream — incl. the MoE experts' second linear
+                # (stacked under the flat experts__fc2__weight name)
+                if re.search(r"(out_proj\.weight|fc2\.weight"
+                             r"|__fc2__weight)$", pname):
                     data = data / (2.0 * n) ** 0.5
             else:
                 data = jnp.broadcast_to(p._data, shape)
@@ -413,6 +457,8 @@ class GPTStackedBlocks(nn.Layer):
                     base_off, jax.core.Tracer):
                 base_off = int(base_off)
 
+        moe = isinstance(getattr(template, "mlp", None), MoEBlock)
+
         def one_layer(h, scanned):
             idx, layer_leaves = scanned[0], scanned[1:]
             with no_grad():
@@ -425,11 +471,12 @@ class GPTStackedBlocks(nn.Layer):
                 template.training = training
                 try:
                     y = template._inner(Tensor._wrap(h))._data
+                    aux = template.mlp.l_aux._data if moe else None
                 finally:
                     gen._offset = saved_off
                     for p, d in zip(leaves, saved):
                         p._data = d
-            return y, None
+            return y, aux
 
         if cfg.use_recompute and training:
             policy = (jax.checkpoint_policies
@@ -442,12 +489,25 @@ class GPTStackedBlocks(nn.Layer):
         stacked = [self._parameters[flat] for flat, _ in
                    self._stacked_names]
 
-        def scanfn(h, *stk):
-            out, _ = jax.lax.scan(one_layer, h,
-                                  (jax.numpy.arange(n),) + tuple(stk))
-            return out
+        if moe:
+            def scanfn(h, *stk):
+                out, auxs = jax.lax.scan(
+                    one_layer, h, (jax.numpy.arange(n),) + tuple(stk))
+                # per-layer MoE aux losses escape the scan as ys — mean
+                # over layers is the model-level aux loss loss() consumes
+                return out, jax.numpy.sum(auxs) / n
 
-        out = apply_op(scanfn, [x] + stacked, name="gpt_scan_blocks")
+            out, aux = apply_op(scanfn, [x] + stacked,
+                                name="gpt_scan_blocks")
+            self.last_moe_aux = aux
+        else:
+            def scanfn(h, *stk):
+                out, _ = jax.lax.scan(
+                    one_layer, h, (jax.numpy.arange(n),) + tuple(stk))
+                return out
+
+            out = apply_op(scanfn, [x] + stacked, name="gpt_scan_blocks")
+            self.last_moe_aux = None
         if base_off is not None:
             # reserve the layers' draw window so later eager consumers
             # (and the next forward) don't collide with in-scan keys
@@ -482,10 +542,13 @@ class GPTModel(nn.Layer):
         for name, p in self.named_parameters():
             if "blocks__" in name:
                 continue  # stacked scan params init in GPTStackedBlocks
-            if p.ndim >= 2:
+            # bias params keep zeros even when expert-stacked to ndim 2
+            if p.ndim >= 2 and not name.endswith("bias"):
                 p._data = host_normal(p._data.shape, std)
-                if re.search(r"(out_proj|fc2)\.weight$", name):
-                    # GPT-2 residual-scaled init
+                if re.search(r"(out_proj\.weight|fc2\.weight"
+                             r"|__fc2__weight)$", name):
+                    # GPT-2 residual-scaled init (incl. MoE expert fc2
+                    # stacks)
                     p._data = p._data / math.sqrt(2.0 * config.num_layers)
 
     def forward(self, input_ids, position_ids=None, segment_ids=None):
@@ -505,6 +568,20 @@ class GPTModel(nn.Layer):
                 for block in self.blocks:
                     x = block(x)
         return self.ln_f(x)
+
+    def moe_aux(self):
+        """Mean per-layer MoE load-balance loss of the last forward
+        (None for dense models) — what `GPTForCausalLM.loss` weights by
+        ``moe_aux_weight`` and adds to the CE loss."""
+        if not self.config.num_experts:
+            return None
+        if self.config.scan_layers:
+            return self.blocks.last_moe_aux
+        auxs = [b.mlp.l_aux for b in self.blocks]
+        total = auxs[0]
+        for a in auxs[1:]:
+            total = total + a
+        return total / len(auxs)
 
     def _check_decodable(self):
         if self.config.scan_layers:
@@ -678,7 +755,11 @@ class GPTForCausalLM(nn.Layer):
             w, t_y = self.gpt.wte.weight, True
         else:
             w, t_y = self.lm_head.weight, False
-        return fused_lm_loss(hidden, w, t_y, labels, loss_mask)
+        loss = fused_lm_loss(hidden, w, t_y, labels, loss_mask)
+        aux = self.gpt.moe_aux()
+        if aux is not None:
+            loss = loss + self.config.moe_aux_weight * aux
+        return loss
 
 
 def fused_lm_loss(hidden, weight, transpose_y, labels, loss_mask=None):

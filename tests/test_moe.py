@@ -53,17 +53,97 @@ class TestGating:
 
 
 class TestGlobalScatterGather:
-    def test_ragged_counts_raise(self):
-        """Counts must never be silently ignored (reference
-        moe_utils.global_scatter moves count-shaped ragged buffers)."""
+    @pytest.fixture(autouse=True)
+    def _clean_mesh(self):
+        from paddle_tpu.distributed import env as denv
+
+        yield
+        denv.reset()
+
+    def _ep_group(self, n=2):
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.distributed.collective import new_group
+
+        mesh = denv.build_mesh({"ep": n}, devices=jax.devices("cpu")[:n])
+        denv.set_mesh(mesh)
+        return new_group(axes=["ep"], mesh=mesh)
+
+    def test_ragged_counts_exchange(self):
+        """ISSUE 9 satellite: ragged per-expert counts ride the
+        capacity-padded equal-split exchange instead of raising —
+        checked against a numpy model of the reference exchange."""
         from paddle_tpu.incubate.distributed.models.moe import moe_layer
 
-        x = paddle.to_tensor(np.ones((4, 8), np.float32))
-        ragged = paddle.to_tensor(np.array([3, 1], np.int64))
-        with pytest.raises(NotImplementedError, match="ragged"):
-            moe_layer.global_scatter(x, ragged, ragged)
-        with pytest.raises(NotImplementedError, match="ragged"):
-            moe_layer.global_gather(x, ragged, ragged)
+        grp = self._ep_group(2)
+        counts = paddle.to_tensor(np.array([3, 1], np.int64))
+        S = 4
+        x = paddle.to_tensor(
+            np.arange(2 * S * 2, dtype=np.float32).reshape(2 * S, 2))
+        out = moe_layer.global_scatter(x, counts, counts, group=grp)
+        # numpy reference: rank r receives, source-major, the
+        # counts[r] rows each source sent it (destination-major send)
+        xa = np.asarray(x._data)
+        lc, off = np.array([3, 1]), [0, 3]
+        ref = np.concatenate([
+            xa[s * S + off[r]: s * S + off[r] + lc[r]]
+            for r in range(2) for s in range(2)])
+        np.testing.assert_allclose(np.asarray(out._data), ref)
+
+    def test_ragged_roundtrip(self):
+        """gather(scatter(x)) == x for ragged counts (the inverse-map
+        contract), incl. zero-count buckets and multi-expert groups."""
+        from paddle_tpu.incubate.distributed.models.moe import moe_layer
+
+        grp = self._ep_group(2)
+        for raw in ([2, 0], [4, 1, 2, 3]):
+            counts = paddle.to_tensor(np.array(raw, np.int64))
+            S = int(np.sum(raw))
+            x = paddle.to_tensor(np.random.default_rng(0)
+                                 .standard_normal((2 * S, 3))
+                                 .astype(np.float32))
+            out = moe_layer.global_scatter(x, counts, counts, group=grp)
+            assert tuple(out.shape) == tuple(x.shape)
+            back = moe_layer.global_gather(out, counts, counts,
+                                           group=grp)
+            np.testing.assert_allclose(np.asarray(back._data),
+                                       np.asarray(x._data),
+                                       err_msg=str(raw))
+
+    def test_disagreeing_counts_raise(self):
+        """Genuinely unsupported group shape: per-rank-distinct count
+        vectors are not representable in the single-controller global
+        view — a clear ValueError, not silence."""
+        from paddle_tpu.incubate.distributed.models.moe import moe_layer
+
+        grp = self._ep_group(2)
+        x = paddle.to_tensor(np.ones((8, 2), np.float32))
+        lc = paddle.to_tensor(np.array([3, 1], np.int64))
+        gc = paddle.to_tensor(np.array([1, 3], np.int64))
+        with pytest.raises(ValueError, match="disagree"):
+            moe_layer.global_scatter(x, lc, gc, group=grp)
+
+    def test_traced_counts_raise(self):
+        from paddle_tpu.framework.tensor import Tensor
+        from paddle_tpu.incubate.distributed.models.moe import moe_layer
+
+        grp = self._ep_group(2)
+        x = paddle.to_tensor(np.ones((8, 2), np.float32))
+
+        def f(c):
+            return moe_layer.global_scatter(
+                x, Tensor._wrap(c), Tensor._wrap(c), group=grp)._data
+
+        with pytest.raises(NotImplementedError, match="traced"):
+            jax.jit(f)(jnp.asarray(np.array([3, 1], np.int64)))
+
+    def test_counts_length_not_multiple_raises(self):
+        from paddle_tpu.incubate.distributed.models.moe import moe_layer
+
+        grp = self._ep_group(2)
+        x = paddle.to_tensor(np.ones((6, 2), np.float32))
+        c = paddle.to_tensor(np.array([1, 1, 1], np.int64))
+        with pytest.raises(ValueError, match="not a multiple"):
+            moe_layer.global_scatter(x, c, c, group=grp)
 
     def test_mismatched_totals_raise(self):
         from paddle_tpu.incubate.distributed.models.moe import moe_layer
@@ -250,6 +330,345 @@ class TestMoEGradClip:
                                        err_msg=k)
         # and the clip actually clipped (norm above the 0.05 bound)
         assert n_dense > 0.05
+
+
+class TestExpertParallelDispatch:
+    """ISSUE 9: the REAL expert-parallel path — sliced expert stacks
+    inside a shard_map binding the ep axis flip MoELayer onto explicit
+    capacity-padded lax.all_to_all dispatch/combine."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_mesh(self):
+        from paddle_tpu.distributed import env as denv
+
+        denv.reset()
+        yield
+        denv.reset()
+
+    def _ep_forward(self, moe, x, ep=2):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.framework.tensor import Tensor
+
+        mesh = Mesh(np.array(jax.devices("cpu")[:ep]), ("ep",))
+        leaves = [moe._parameters[f]._data for f, _ in
+                  moe._stacked_names]
+        params = [moe._parameters[f] for f, _ in moe._stacked_names]
+        gw = moe.gate_weight._data
+
+        def f(xl, gwl, *lv):
+            saved = [p._data for p in params]
+            saved_g = moe.gate_weight._data
+            for p, d in zip(params, lv):
+                p._data = d
+            moe.gate_weight._data = gwl
+            try:
+                y = moe.forward(Tensor._wrap(xl))._data
+                aux = moe.l_aux._data
+            finally:
+                for p, d in zip(params, saved):
+                    p._data = d
+                moe.gate_weight._data = saved_g
+            return y, aux
+
+        sm = jax.jit(jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P("ep"), P(), *[P("ep") for _ in leaves]),
+            out_specs=(P("ep"), P()), check_vma=False))
+        return sm, (x, gw, *leaves)
+
+    def test_dispatch_combine_roundtrip_matches_dense(self):
+        """EP output == per-shard dense routing, bit-for-bit: the
+        all_to_all dispatch/combine is a pure re-homing of the same
+        expert computation."""
+        paddle.seed(20)
+        moe = MoELayer(16, [ExpertFFN(16, 32) for _ in range(4)],
+                       gate="gshard", capacity_factor=2.0)
+        rng = np.random.default_rng(21)
+        x = rng.standard_normal((4, 8, 16)).astype(np.float32)
+        ref = np.concatenate(
+            [np.asarray(moe(paddle.to_tensor(x[i * 2:(i + 1) * 2]))
+                        ._data) for i in range(2)])
+        sm, args = self._ep_forward(moe, x)
+        y, aux = sm(*args)
+        np.testing.assert_array_equal(np.asarray(y), ref)
+        assert np.isfinite(float(aux))
+
+    def test_ep_hlo_has_all_to_alls(self):
+        paddle.seed(22)
+        moe = MoELayer(16, [ExpertFFN(16, 32) for _ in range(4)],
+                       gate="switch", capacity_factor=2.0)
+        x = np.zeros((4, 8, 16), np.float32)
+        sm, args = self._ep_forward(moe, x)
+        txt = sm.lower(*args).compile().as_text()
+        # dispatch + combine >= 2 ep all-to-alls
+        assert txt.count("all-to-all(") >= 2
+
+    def test_capacity_drop_determinism(self):
+        """Same inputs -> identical routing and outputs across repeated
+        EP forwards (drops are a pure function of the gate cumsum, no
+        RNG)."""
+        paddle.seed(23)
+        moe = MoELayer(8, [ExpertFFN(8, 16) for _ in range(2)],
+                       gate="switch", capacity_factor=0.25)
+        rng = np.random.default_rng(24)
+        x = rng.standard_normal((2, 16, 8)).astype(np.float32)
+        sm, args = self._ep_forward(moe, x)
+        y1, _ = sm(*args)
+        y2, _ = sm(*args)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        # drops actually happened (zero rows) and are zeros, not garbage
+        out = np.asarray(y1).reshape(-1, 8)
+        assert np.sum(np.all(np.abs(out) < 1e-7, axis=-1)) > 0
+        assert np.isfinite(out).all()
+
+    def test_ep_degree_validation(self):
+        with pytest.raises(ValueError, match="divisible"):
+            MoELayer(8, [ExpertFFN(8, 8) for _ in range(3)],
+                     ep_degree=2)
+
+
+class TestMoEGlobalMeshTensor:
+    @pytest.fixture(autouse=True)
+    def _clean_mesh(self):
+        from paddle_tpu.distributed import env as denv
+
+        yield
+        denv.reset()
+
+    def test_assembles_and_shards(self):
+        """The planted NotImplementedError is gone: per-EP-rank expert
+        slices assemble into one global tensor sharded over ep."""
+        from paddle_tpu.distributed.auto_parallel import (
+            ProcessMesh, Replicate, Shard, moe_global_mesh_tensor,
+        )
+        from paddle_tpu.distributed import env as denv
+
+        denv.set_mesh(denv.build_mesh(
+            {"ep": 2}, devices=jax.devices("cpu")[:2]))
+        mesh = ProcessMesh(np.arange(2).reshape(2), ["ep"])
+        locals_ = [paddle.to_tensor(np.full((2, 4), float(r),
+                                            np.float32))
+                   for r in range(2)]
+        out = moe_global_mesh_tensor(locals_, mesh,
+                                     [Shard(0)], local_mesh_dim="ep")
+        assert tuple(out.shape) == (4, 4)
+        got = np.asarray(out._data)
+        np.testing.assert_allclose(got[:2], 0.0)
+        np.testing.assert_allclose(got[2:], 1.0)
+        assert "ep" in str(out._data.sharding)
+
+    def test_replicate_placement_rejected(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            ProcessMesh, Replicate, moe_global_mesh_tensor,
+        )
+
+        mesh = ProcessMesh(np.arange(2).reshape(2), ["ep"])
+        with pytest.raises(ValueError, match="Shard"):
+            moe_global_mesh_tensor(
+                [paddle.to_tensor(np.zeros((2, 2), np.float32))] * 2,
+                mesh, [Replicate()])
+
+
+class TestMoEScanTrainStep:
+    """MoEBlock inside FusedScanTrainStep/ShardedFusedScanTrainStep
+    (ISSUE 9 acceptance): dp×ep == dp-only dense-equivalent routing
+    <= 1e-5 over 4 steps, one compile per signature, aux loss folded
+    into the training loss."""
+
+    TINY = dict(vocab_size=96, hidden_size=32, num_layers=2,
+                num_attention_heads=2, max_position_embeddings=16,
+                hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                num_experts=4, moe_capacity_factor=2.0)
+
+    def _data(self, rows=8):
+        rng = np.random.default_rng(30)
+        ids = paddle.to_tensor(rng.integers(0, 96, (rows, 8)),
+                               dtype="int64")
+        labels = paddle.to_tensor(rng.integers(0, 96, (rows, 8)),
+                                  dtype="int64")
+        return ids, labels
+
+    def _build_sharded(self, mesh, steps=4, **kw):
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.jit.sharded_scan import ShardedFusedScanTrainStep
+        from paddle_tpu.models import (
+            GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+        )
+        import paddle_tpu.nn as nn
+
+        cfg = GPTConfig(**self.TINY, scan_layers=True)
+        paddle.seed(31)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters(),
+                         grad_clip=nn.ClipGradByGlobalNorm(0.05))
+        denv.set_mesh(mesh)
+        step = ShardedFusedScanTrainStep(
+            model, opt, criterion=GPTPretrainingCriterion(), mesh=mesh,
+            **kw)
+        ids, labels = self._data()
+        losses = [float(step(ids, labels)) for _ in range(steps)]
+        return losses, model, step
+
+    def test_dp_ep_matches_dp_only(self):
+        """The acceptance triangle: dp4×ep2 (real all_to_all expert
+        parallelism) == dp8 (dense-equivalent routing: same per-rank
+        token pools, full expert stacks everywhere)."""
+        from jax.sharding import Mesh
+
+        devs = jax.devices("cpu")[:8]
+        ref, m_ref, s_ref = self._build_sharded(
+            Mesh(np.array(devs), ("sharding",)), axis="sharding")
+        epl, m_ep, s_ep = self._build_sharded(
+            Mesh(np.array(devs).reshape(4, 2), ("dp", "ep")),
+            axis="dp", ep_axis="ep")
+        diff = max(abs(a - b) for a, b in zip(ref, epl))
+        assert diff <= 1e-5, (ref, epl)
+        # exactly one compiled executable per mesh signature
+        assert s_ref._jitted._cache_size() == 1
+        assert s_ep._jitted._cache_size() == 1
+        # final params agree too (the grads assembled identically)
+        for (n1, p1), (_, p2) in zip(m_ref.named_parameters(),
+                                     m_ep.named_parameters()):
+            np.testing.assert_allclose(
+                np.asarray(p1._data, np.float32),
+                np.asarray(p2._data, np.float32),
+                rtol=5e-3, atol=5e-5, err_msg=n1)
+
+    @pytest.mark.slow
+    def test_ep_step_hlo_all_to_all_count(self):
+        """>= 2 ep-axis all-to-alls counted by tools/hlo_overlap.py's
+        per-axis classifier (the ISSUE acceptance receipt). Marked slow:
+        the hermetic `moe` selftest lane asserts the same census on
+        every bench run (tier-1 keeps the parity + compile probes)."""
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from paddle_tpu.jit.sharded_scan_selftest import (
+            _load_hlo_overlap,
+        )
+
+        devs = jax.devices("cpu")[:8]
+        _, _, step = self._build_sharded(
+            Mesh(np.array(devs).reshape(4, 2), ("dp", "ep")),
+            steps=1, axis="dp", ep_axis="ep")
+        ids, labels = self._data()
+        state = step._extract_state()
+        txt = step._jitted.lower(
+            state, jnp.float32(1e-2), ids._data, labels._data,
+            None).compile().as_text()
+        v = _load_hlo_overlap().analyze(
+            txt, axis_degrees={"dp": 4, "ep": 2})
+        ep_counts = v["per_axis_counts"].get("ep", {})
+        assert ep_counts.get("all-to-all", 0) >= 2, v["per_axis_counts"]
+        # grads scatter over the flattened dp×ep product, nothing
+        # unclassified
+        assert "other" not in v["per_axis_counts"]
+
+    def test_aux_loss_in_fused_step_matches_eager(self):
+        """Single-device FusedScanTrainStep loss == eager
+        model.loss() (CE + weighted layer-mean aux) on the same model —
+        the aux plumbing through the scan carries the exact value."""
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.jit.fused_scan_step import FusedScanTrainStep
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(**self.TINY, scan_layers=True)
+        paddle.seed(33)
+        model = GPTForCausalLM(cfg)
+        ids, labels = self._data(rows=4)
+        eager = float(model.loss(ids, labels))
+        opt = popt.AdamW(learning_rate=0.0,
+                         parameters=model.parameters())
+        step = FusedScanTrainStep(model, opt)
+        got = float(step(ids, labels))
+        assert abs(got - eager) < 1e-5, (got, eager)
+
+    def test_moe_under_pipeline_rejected(self):
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.jit.pipeline_step import PipelineScanTrainStep
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(**self.TINY, scan_layers=True)
+        paddle.seed(34)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters())
+        mesh = denv.build_mesh({"dp": 2, "pp": 2},
+                               devices=jax.devices("cpu")[:4])
+        with pytest.raises(ValueError, match="MoE"):
+            PipelineScanTrainStep(model, opt, mesh=mesh, num_micro=2)
+
+    def test_ep_axis_on_dense_model_rejected(self):
+        import paddle_tpu.optimizer as popt
+        from jax.sharding import Mesh
+        from paddle_tpu.jit.sharded_scan import ShardedFusedScanTrainStep
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        tiny = dict(self.TINY)
+        tiny["num_experts"] = 0
+        cfg = GPTConfig(**tiny, scan_layers=True)
+        paddle.seed(35)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters())
+        mesh = Mesh(np.array(jax.devices("cpu")[:8]).reshape(4, 2),
+                    ("dp", "ep"))
+        with pytest.raises(ValueError, match="no MoE"):
+            ShardedFusedScanTrainStep(model, opt, mesh=mesh, axis="dp",
+                                      ep_axis="ep")
+
+    def test_select_train_step_dispatches_ep(self):
+        import paddle_tpu.optimizer as popt
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.jit.sharded_scan import (
+            ShardedFusedScanTrainStep, select_train_step,
+        )
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(**self.TINY, scan_layers=True)
+        paddle.seed(36)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters())
+        mesh = Mesh(np.array(jax.devices("cpu")[:8]).reshape(4, 2),
+                    ("dp", "ep"))
+        denv.set_mesh(mesh)
+        step = select_train_step(model, opt, mesh=mesh)
+        assert isinstance(step, ShardedFusedScanTrainStep)
+        assert step._ep_axis == "ep" and step._ep_degree == 2
+        assert step._batch_degree == 8
+
+
+class TestAuxLossValue:
+    """Aux-loss value vs an independent numpy model of the GShard
+    formula (E * sum(mean_prob * frac_routed), switch eq. 4)."""
+
+    def test_top1_aux_vs_numpy(self):
+        import scipy.special as sps
+
+        rng = np.random.default_rng(40)
+        logits = rng.standard_normal((24, 4)).astype(np.float32)
+        _, _, aux = top1_gating(jnp.asarray(logits), capacity=24)
+        probs = sps.softmax(logits, axis=-1)
+        sel = np.eye(4)[np.argmax(probs, axis=-1)]
+        want = 4 * np.sum(probs.mean(0) * sel.mean(0))
+        np.testing.assert_allclose(float(aux), want, rtol=1e-5)
+
+    def test_top2_aux_vs_numpy(self):
+        import scipy.special as sps
+
+        rng = np.random.default_rng(41)
+        logits = rng.standard_normal((16, 4)).astype(np.float32)
+        _, _, aux = top2_gating(jnp.asarray(logits), capacity=16)
+        probs = sps.softmax(logits, axis=-1)
+        sel = np.eye(4)[np.argmax(probs, axis=-1)]   # first choice
+        want = 4 * np.sum(probs.mean(0) * sel.mean(0))
+        np.testing.assert_allclose(float(aux), want, rtol=1e-5)
 
 
 class TestFusedMoEFunctional:

@@ -27,14 +27,24 @@ Two modes, chosen by what the backend emits:
   about being a proxy; the async numbers land when the same probe runs
   on a real chip.
 
+Per-axis classification covers every COLLECTIVE_KINDS entry — including
+``all-to-all`` (both the single-operand and the tuple form XLA emits for
+multi-array exchanges), so the MoE expert-parallel dispatch/combine get
+the same per-axis HLO receipt the mp/pp paths have (ISSUE 9): a dp×ep
+train step shows its all-to-alls under the ``ep`` label and its grad
+scatter under ``dp+ep``.
+
 Standalone:
     python tools/hlo_overlap.py <hlo_text_file> [--assert-overlap]
     python tools/hlo_overlap.py --probe [--assert-overlap]
+    python tools/hlo_overlap.py --probe-ep
 `--probe` builds the sharded fused-scan train step on the host mesh
 (requires JAX_PLATFORMS=cpu + xla_force_host_platform_device_count, the
-bench.py _run_cpu_probe env) and analyzes its compiled HLO. Invoked by
-`bench.py --multichip` via paddle_tpu.jit.sharded_scan_selftest; the
-verdict lands in MULTICHIP_r*.json.
+bench.py _run_cpu_probe env) and analyzes its compiled HLO; `--probe-ep`
+builds the dp4×ep2 expert-parallel MoE variant and reports the ep-axis
+all-to-all census. Invoked by `bench.py --multichip` via
+paddle_tpu.jit.sharded_scan_selftest; the verdicts land in
+MULTICHIP_r*.json.
 """
 from __future__ import annotations
 
@@ -300,6 +310,24 @@ def _build_probe_hlo():
 def main(argv):
     do_assert = "--assert-overlap" in argv
     argv = [a for a in argv if a != "--assert-overlap"]
+    if "--probe-ep" in argv:
+        # dp4×ep2 MoE probe: per-axis census incl. the ep all-to-alls
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from paddle_tpu.jit.sharded_scan_selftest import (
+            hlo_overlap_probe,
+        )
+
+        verdict = hlo_overlap_probe(ep=2)
+        print(json.dumps(verdict))
+        if do_assert and not verdict.get("ep_dispatch_ok"):
+            raise AssertionError(
+                f"ep all-to-all receipt failed: {verdict}")
+        return 0
     if "--probe" in argv:
         text = _build_probe_hlo()
     elif argv:
