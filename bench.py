@@ -666,6 +666,19 @@ def run_selftest():
         assert lane.get("check") == "pass", lane
         results["moe_detail"] = lane
 
+    def sharded_storage():
+        # ISSUE 11: sharded parameter storage — gather-on-use bit-parity
+        # vs replicated storage on dp/dp×mp/dp×pp host meshes, live 1/N
+        # param shards, the no-full-parameter-buffer HLO receipt with a
+        # measured peak-buffer reduction, dp8->dp4 resharding restore,
+        # quantized multi-axis scatter+gather legs, dropout under pp,
+        # and the step-time A/B (all numbers land in the record)
+        rec = _run_cpu_probe("paddle_tpu.jit.sharded_storage_selftest",
+                             timeout=900)
+        lane = rec.get("sharded_storage", {})
+        assert lane.get("check") == "pass", lane
+        results["sharded_storage_detail"] = lane
+
     def serving():
         # ISSUE 6: continuous-batching serving tier — Poisson arrivals
         # on a tiny model: per-request token parity vs generate(),
@@ -693,6 +706,7 @@ def run_selftest():
     check("training_kernels", training_kernels)
     check("distributed_linalg", distributed_linalg)
     check("moe", moe)
+    check("sharded_storage", sharded_storage)
     return results
 
 
@@ -1128,6 +1142,15 @@ if __name__ == "__main__":
         # subprocess, one JSON line
         print(json.dumps(_run_cpu_probe("paddle_tpu.jit.moe_selftest",
                                         timeout=900)))
+    elif "--param-storage" in sys.argv:
+        # PARAM-STORAGE lane (ISSUE 11): sharded vs replicated
+        # parameter storage — bit-parity on dp/dp×mp/dp×pp host meshes,
+        # live 1/N param-shard shapes, peak-live-bytes HLO receipt,
+        # dp8->dp4 resharding checkpoint restore, quantized multi-axis
+        # scatter+gather rel-err, dropout-under-pp determinism, and the
+        # min-of-reps step-time A/B — hermetic CPU subprocess
+        print(json.dumps(_run_cpu_probe(
+            "paddle_tpu.jit.sharded_storage_selftest", timeout=900)))
     elif "--training-kernels" in sys.argv:
         # TRAINING-KERNELS lane (ISSUE 7): splash attention + fused CE
         # interpret-mode parity (fwd+bwd, segment masks), scan-step

@@ -6,6 +6,19 @@ def prune_candidates(cands, spec, hbm_gb):
     from .tuner import estimate_memory_gb
 
     for c in cands:
+        if c.ep > 1:
+            experts = getattr(spec, "num_experts", 0)
+            if not experts:
+                c.pruned_reason = "ep on a dense model"
+                continue
+            if experts % c.ep:
+                c.pruned_reason = f"experts {experts} % ep {c.ep}"
+                continue
+            if c.mp > 1 or c.pp > 1:
+                # mp×ep and pp×MoE compositions are rejected by the
+                # train steps today (ROADMAP item 5) — prune, don't OOM
+                c.pruned_reason = "ep composes with dp only"
+                continue
         if spec.num_heads % c.mp:
             c.pruned_reason = f"heads {spec.num_heads} % mp {c.mp}"
             continue
@@ -20,10 +33,12 @@ def prune_candidates(cands, spec, hbm_gb):
             # [vocab, H] head by rows — ragged shards are not supported
             c.pruned_reason = f"vocab {spec.vocab_size} % mp {c.mp}"
             continue
-        if spec.global_batch % max(c.dp, 1):
-            c.pruned_reason = f"batch {spec.global_batch} % dp {c.dp}"
+        batch_ways = max(c.dp, 1) * c.ep   # the batch splits over dp×ep
+        if spec.global_batch % batch_ways:
+            c.pruned_reason = (f"batch {spec.global_batch} % dp*ep "
+                               f"{batch_ways}")
             continue
-        per_dp = spec.global_batch // max(c.dp, 1)
+        per_dp = spec.global_batch // batch_ways
         if per_dp % max(c.micro_batch, 1):
             c.pruned_reason = (f"per-dp batch {per_dp} % micro "
                                f"{c.micro_batch}")

@@ -7,25 +7,33 @@ def _divisors(n):
 
 
 def grid_candidates(n_devices, sharding_stages, max_micro, global_batch,
-                    enable_sep=False):
+                    enable_sep=False, num_experts=0):
+    """``num_experts > 0`` (a MoE workload) additionally grid-searches
+    the expert-parallel ``ep`` axis (ISSUE 11 satellite / ROADMAP item
+    5); infeasible ep combinations are left to the pruning rules."""
     from .tuner import Candidate
 
     out = []
     for mp in _divisors(n_devices):
         for pp in _divisors(n_devices // mp):
-            for sep in (_divisors(n_devices // (mp * pp))
-                        if enable_sep else [1]):
-                dp = n_devices // (mp * pp * sep)
-                micros = [m for m in
-                          _divisors(max(global_batch // max(dp, 1), 1))
-                          if m <= max_micro]
-                for stage in sharding_stages:
-                    if stage and dp == 1:
-                        continue  # nothing to shard over
-                    for micro in (micros or [1]):
-                        if pp > 1 and micro == 1:
-                            continue  # pipeline needs micro-batches
-                        out.append(Candidate(dp=dp, mp=mp, pp=pp, sep=sep,
-                                             sharding_stage=stage,
-                                             micro_batch=micro))
+            for ep in (_divisors(n_devices // (mp * pp))
+                       if num_experts else [1]):
+                for sep in (_divisors(n_devices // (mp * pp * ep))
+                            if enable_sep else [1]):
+                    dp = n_devices // (mp * pp * sep * ep)
+                    batch_ways = max(dp, 1) * ep   # batch splits dp×ep
+                    micros = [m for m in
+                              _divisors(max(global_batch
+                                            // batch_ways, 1))
+                              if m <= max_micro]
+                    for stage in sharding_stages:
+                        if stage and dp * ep == 1:
+                            continue  # nothing to shard over
+                        for micro in (micros or [1]):
+                            if pp > 1 and micro == 1:
+                                continue  # pipeline needs micro-batches
+                            out.append(Candidate(
+                                dp=dp, mp=mp, pp=pp, sep=sep, ep=ep,
+                                sharding_stage=stage,
+                                micro_batch=micro))
     return out

@@ -110,16 +110,28 @@ def spec_of_model(config, global_batch, seq_len=None, params=None):
     L = int(config.num_layers)
     V = int(config.vocab_size)
     inter = int(getattr(config, "intermediate_size", 4 * h) or 4 * h)
+    experts = int(getattr(config, "num_experts", 0) or 0)
+    ffn = 2 * h * inter
     if params is None:
         # transformer param count: embeddings + per-layer qkv/proj/mlp/ln
+        # (MoE: num_experts expert FFNs replace the single dense one)
+        per_layer_ffn = ffn * max(experts, 1)
         params = (V * h + int(config.max_position_embeddings) * h
-                  + L * (4 * h * h + 2 * h * inter + 9 * h) + 2 * h)
+                  + L * (4 * h * h + per_layer_ffn + 9 * h) + 2 * h)
+    expert_frac = 0.0
+    if experts:
+        expert_frac = (L * ffn * experts) / max(int(params), 1)
     return ModelSpec(
         params=int(params), num_layers=L, hidden_size=h,
         num_heads=int(config.num_attention_heads), vocab_size=V,
         seq_len=int(seq_len or config.max_position_embeddings),
         global_batch=int(global_batch),
         use_recompute=bool(getattr(config, "use_recompute", False)),
+        num_experts=experts, expert_param_frac=expert_frac,
+        # the steps select_train_step builds default to sharded param
+        # storage (ISSUE 11) — the cost/memory model should rank what
+        # will actually run
+        sharded_param_storage=True,
     )
 
 
@@ -131,9 +143,9 @@ def _parse_env_layout(text):
             continue
         k, _, v = part.partition("=")
         k = k.strip().lower()
-        if k not in ("dp", "mp", "pp", "micro"):
+        if k not in ("dp", "mp", "pp", "ep", "micro"):
             raise ValueError(
-                f"{LAYOUT_ENV}: unknown key {k!r} (dp/mp/pp/micro; "
+                f"{LAYOUT_ENV}: unknown key {k!r} (dp/mp/pp/ep/micro; "
                 "weight-update sharding always rides the dp axis — "
                 "there is no separate sharding degree to force)")
         out[k] = int(v)
@@ -192,7 +204,8 @@ def pick_layout(spec, n_devices, hbm_gb=16.0, backend=None,
             "candidate": cand,
             "mesh_degrees": {k: v for k, v in
                              (("dp", cand.dp), ("pp", cand.pp),
-                              ("mp", cand.mp)) if v > 1 or k == "dp"},
+                              ("mp", cand.mp), ("ep", cand.ep))
+                             if v > 1 or k == "dp"},
             "num_micro": int(cand.micro_batch),
             "scan_unroll": knobs["scan_unroll"],
             "layer_chunk": knobs["layer_chunk"],
@@ -205,8 +218,10 @@ def pick_layout(spec, n_devices, hbm_gb=16.0, backend=None,
     if forced:
         kv = _parse_env_layout(forced)
         dp = kv.get("dp", 0) or max(
-            1, n_devices // (kv.get("mp", 1) * kv.get("pp", 1)))
+            1, n_devices // (kv.get("mp", 1) * kv.get("pp", 1)
+                             * kv.get("ep", 1)))
         cand = Candidate(dp=dp, mp=kv.get("mp", 1), pp=kv.get("pp", 1),
+                         ep=kv.get("ep", 1),
                          sharding_stage=1,
                          micro_batch=kv.get("micro",
                                             2 if kv.get("pp", 1) > 1
@@ -224,9 +239,11 @@ def pick_layout(spec, n_devices, hbm_gb=16.0, backend=None,
 
     cands = grid_candidates(n_devices, sharding_stages=(1,),
                             max_micro=max_micro,
-                            global_batch=spec.global_batch)
+                            global_batch=spec.global_batch,
+                            num_experts=getattr(spec, "num_experts", 0))
     # restrict to what the hybrid steps actually run today: no sep ring
-    # here (dp×mp, dp×pp and the full dp×mp×pp composition all run);
+    # here (dp×mp, dp×pp, dp×ep and the full dp×mp×pp composition all
+    # run; mp×ep / pp×ep fall out of the pruning rules);
     # C % pp falls out of the num_layers % pp pruning rule
     cands = [c for c in cands
              if c.sep == 1 and c.degree == n_devices]

@@ -24,6 +24,7 @@ class Candidate:
     mp: int = 1
     pp: int = 1
     sep: int = 1                 # sequence/context-parallel (ring) degree
+    ep: int = 1                  # expert-parallel degree (MoE models)
     sharding_stage: int = 0      # 0=none, 1/2=state/grad shard, 3=param
     micro_batch: int = 1
     estimated_step_ms: float = 0.0
@@ -33,11 +34,12 @@ class Candidate:
 
     @property
     def degree(self):
-        return self.dp * self.mp * self.pp * self.sep
+        return self.dp * self.mp * self.pp * self.sep * self.ep
 
     def hybrid_configs(self):
         return {"dp_degree": self.dp, "mp_degree": self.mp,
                 "pp_degree": self.pp, "sep_degree": self.sep,
+                "ep_degree": self.ep,
                 "sharding_degree": self.dp if self.sharding_stage else 1}
 
 
@@ -55,20 +57,43 @@ class ModelSpec:
     param_bytes: int = 2             # bf16 params
     master_bytes: int = 12           # fp32 master + 2 adam moments
     use_recompute: bool = True
+    num_experts: int = 0             # MoE expert count (0 = dense)
+    expert_param_frac: float = 0.0   # fraction of params in expert FFNs
+    # ISSUE 11: the sharded train steps store params as 1/N flat shards
+    # (gather-on-use); `spec_of_model` opts in because that is what
+    # `select_train_step` actually builds. False keeps the classic
+    # stage-semantics memory model (stage 3 = param sharding) for
+    # generic AutoTuner use.
+    sharded_param_storage: bool = False
 
 
 def estimate_memory_gb(spec: ModelSpec, c: Candidate) -> float:
     """Per-chip HBM estimate (the pruner's core).
 
-    params shard over mp*pp (+ dp when stage 3); optimizer state over
-    mp*pp (* dp when stage>=1); activations over dp (batch) and pp
-    (layers), ~2 bytes/elem with remat keeping ~4 tensors/layer live.
+    Replicated storage: params shard over mp*pp (+ dp when stage 3);
+    sharded storage (ISSUE 11 default for the sharded steps) shards
+    params over the FULL flattened degree like the optimizer state —
+    gather-on-use keeps at most ~2 layer chunks of full params live,
+    which the activation term's per-layer window already dwarfs.
+    Optimizer state over mp*pp (* dp when stage>=1); ep shards the
+    expert fraction of params/state 1/ep; activations over dp (batch)
+    and pp (layers), ~2 bytes/elem with remat keeping ~4 tensors/layer
+    live.
     """
-    p_shard = c.mp * c.pp * (c.dp if c.sharding_stage == 3 else 1)
-    o_shard = c.mp * c.pp * (c.dp if c.sharding_stage >= 1 else 1)
-    param_gb = spec.params * spec.param_bytes / p_shard / 1e9
+    sharded_params = spec.sharded_param_storage and c.sharding_stage >= 1
+    p_shard = (c.dp * c.mp * c.pp * c.ep if sharded_params
+               else c.mp * c.pp * (c.dp if c.sharding_stage == 3 else 1))
+    o_shard = c.mp * c.pp * c.ep * (c.dp if c.sharding_stage >= 1 else 1)
+    dense_frac = 1.0 - spec.expert_param_frac
+    # without sharded storage the expert stacks still replicate over dp
+    # but shard 1/ep (the MoELayer EP slicing)
+    exp_p_shard = p_shard if sharded_params else max(p_shard, 1) * c.ep
+    param_gb = spec.params * spec.param_bytes * (
+        dense_frac / p_shard
+        + spec.expert_param_frac / exp_p_shard) / 1e9
     opt_gb = spec.params * spec.master_bytes / o_shard / 1e9
-    mb = max(1, spec.global_batch // max(c.dp, 1) // max(c.micro_batch, 1))
+    mb = max(1, spec.global_batch // max(c.dp * c.ep, 1)
+             // max(c.micro_batch, 1))
     live_per_layer = 4 if spec.use_recompute else 34
     # sep shards the sequence dim of every activation (ring attention
     # keeps attention memory O(seq/sep) too — meta_parallel/ring_attention)
@@ -224,11 +249,34 @@ def estimate_step_ms(spec: ModelSpec, c: Candidate, *,
             / ici_gbps * 1e3 + coll_lat_us * 1e-3
     else:
         dp_ms = 0.0
+    # EP: capacity-padded dispatch+combine all_to_alls per MoE layer
+    # (2 fwd + 2 bwd), each moving ~the local token activations once
+    if c.ep > 1 and spec.num_experts:
+        tok_bytes = (spec.global_batch // max(c.dp * c.ep, 1)) \
+            * spec.seq_len * spec.hidden_size * 2
+        ep_ms = (4 * tok_bytes * (c.ep - 1) / c.ep / ici_gbps) \
+            * spec.num_layers / c.pp * 1e3 \
+            + 4 * spec.num_layers // c.pp * coll_lat_us * 1e-3
+    else:
+        ep_ms = 0.0
+    # Sharded param storage (ISSUE 11): the freed HBM is bought with
+    # gather-on-use traffic — the fwd scan and the bwd recompute each
+    # all_gather every param once, while the replicated layout's single
+    # update-scan gather disappears: net +1 full-param gather per step
+    # over the flattened axes. Overlappable (the double-buffered
+    # prefetch slot), so charge half the wire time as exposed.
+    N = c.dp * c.mp * c.pp * c.ep
+    if spec.sharded_param_storage and c.sharding_stage >= 1 and N > 1:
+        gather_ms = 0.5 * spec.params * spec.param_bytes * (N - 1) / N \
+            / ici_gbps * 1e3
+    else:
+        gather_ms = 0.0
     # HBM floor: optimizer sweep
     hbm_ms = spec.params * spec.master_bytes / (
-        c.mp * c.pp * (c.dp if c.sharding_stage >= 1 else 1)) / hbm_gbps * 1e3
-    return (compute_ms * (1 + bubble) + tp_ms + sep_ms + dp_ms
-            + pp_lat_ms + hbm_ms)
+        c.mp * c.pp * c.ep
+        * (c.dp if c.sharding_stage >= 1 else 1)) / hbm_gbps * 1e3
+    return (compute_ms * (1 + bubble) + tp_ms + sep_ms + dp_ms + ep_ms
+            + gather_ms + pp_lat_ms + hbm_ms)
 
 
 class AutoTuner:

@@ -195,6 +195,22 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
             continue
         target = to_jax_array(value)
         shape = tuple(target.shape)
+        # resharding restore of __scan_shard_*__ flat buckets onto a
+        # DIFFERENT mesh shape (ISSUE 11): the bucket's entry layout is
+        # independent of the device count, but its trailing zero pad is
+        # rounded up to the flattened mesh degree — so a dp8-saved
+        # [L, numel8] flat array restores into a dp4 template's
+        # [L, numel4] (and vice versa) by copying the common prefix of
+        # the LAST dim and zero-filling the rest. Only the pad region
+        # differs; the data region is bit-identical.
+        saved_shape = (tuple(
+            max(c.global_offset[d] + c.local_shape[d] for c in saved)
+            for d in range(len(saved[0].local_shape)))
+            if saved else shape)
+        reshard_pad = ("__scan_shard_" in key.rsplit(".", 1)[-1]
+                       and len(saved_shape) == len(shape)
+                       and saved_shape[:-1] == shape[:-1]
+                       and saved_shape[-1] != shape[-1])
         saved_dtype = np.dtype(saved[0].dtype) if saved else target.dtype
         if saved_dtype.name == "bfloat16":
             import ml_dtypes
@@ -202,13 +218,28 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
             saved_dtype = np.dtype(ml_dtypes.bfloat16)
 
         def cb(index, _key=key, _saved=saved, _shape=shape,
-               _dtype=saved_dtype):
+               _dtype=saved_dtype, _reshard=reshard_pad,
+               _saved_shape=saved_shape):
             full = tuple(
                 slice(sl.start or 0,
                       sl.stop if sl.stop is not None else dim)
                 for sl, dim in zip(index, _shape))
-            return _assemble(_key, full, _shape, _dtype, _saved,
-                             meta.storage_metadata, reader)
+            if not _reshard:
+                return _assemble(_key, full, _shape, _dtype, _saved,
+                                 meta.storage_metadata, reader)
+            # pad-resharding path: assemble the overlap of the
+            # requested region with the saved extent, zero-fill the
+            # requested tail beyond it (the flat bucket's pad region)
+            out = np.zeros(tuple(sl.stop - sl.start for sl in full),
+                           _dtype)
+            lo, hi = full[-1].start, min(full[-1].stop,
+                                         _saved_shape[-1])
+            if hi > lo:
+                clipped = full[:-1] + (slice(lo, hi),)
+                out[..., :hi - lo] = _assemble(
+                    _key, clipped, _saved_shape, _dtype, _saved,
+                    meta.storage_metadata, reader)
+            return out
 
         new = jax.make_array_from_callback(shape, target.sharding, cb)
         if new.dtype != target.dtype:

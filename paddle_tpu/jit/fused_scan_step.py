@@ -777,6 +777,21 @@ class FusedScanTrainStep:
         self._jitted = jax.jit(step_fn,
                                donate_argnums=_donate_argnums())
 
+    def _pre_step(self):
+        """Hook: runs at the top of __call__, before state extraction.
+        The sharded-parameter-storage step folds external `p._data`
+        writes (checkpoint restore, test poking) back into its 1/N
+        flat shards here."""
+
+    def _step_guard(self):
+        """Hook: context wrapping the compiled-step dispatch (and its
+        first-call trace). The sharded-parameter-storage step returns
+        its raw-access guard so `_bind`'s tracer shuffling through the
+        live Parameters bypasses the lazy shard machinery."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
     def ensure_built(self):
         """Create the Adam state and trace the step (idempotent). Split
         out so diagnostics can AOT-lower the program (memory_analysis)
@@ -800,6 +815,7 @@ class FusedScanTrainStep:
                  else segment_ids)
         if self._jitted is None:
             self.ensure_built()
+        self._pre_step()
         if not self._canon_done:
             # first call AFTER any restore (ensure_built may predate it,
             # quickstart order): a restored checkpoint leaves the params
@@ -812,7 +828,7 @@ class FusedScanTrainStep:
             self._canon_done = True
         state = self._extract_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-        with RecordEvent("FusedScanTrainStep"):
+        with RecordEvent("FusedScanTrainStep"), self._step_guard():
             loss, new_state = self._jitted(state, lr, ids_d, lab_d,
                                            seg_d)
         self._inject_state(new_state)

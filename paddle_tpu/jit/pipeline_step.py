@@ -39,9 +39,28 @@ Per-rank loss/grads carry the uniform ×pp joint-vjp replication factor
 1/(dp·pp) normalization divides it back out — the same algebra the
 dp×mp leg uses (see jit/sharded_scan.py).
 
-Dropout is rejected here (a per-(micro, chunk, stage) PRNG offset
-scheme is wholly expressible but not yet wired); use the dp/mp steps
-for dropout models.
+Dropout (ISSUE 11 satellite): legal inside the ring via a
+per-(micro, stage) PRNG offset scheme extending the base per-layer
+formula — a tick computing chunk ``c`` (= layer ``c*K``) on the
+micro-batch ``m`` that entered the ring ``stage`` ticks ago draws at
+
+    offset = ((step*(L+1) + layer) * (dp*M) + (dp_rank*M + m)) * 8
+
+i.e. the (dp_rank, micro) pair takes the rank slot of the base scheme
+(micro-batches are disjoint row sets of the local batch, exactly like
+dp shards are of the global batch), so masks are distinct per
+(step, layer, dp_rank, micro) and collision-free against the
+embedding-dropout slot (layer = L). Warmup/cooldown ticks compute on
+garbage lanes with clipped micro indices; their outputs are never
+collected, so their masks are irrelevant.
+
+Under ``param_storage='sharded'`` (ISSUE 11 tentpole) the replicated
+per-leaf stacks are gone: each rank's OWN chunks are all-gathered from
+the 1/N flat bucket shards before the ring (one uniform collective per
+(pass, owner-stage) pair with a static chunk index — every rank
+contributes its shard slice, the owner keeps the result), so per-rank
+full-param residency stays 1/pp of the layers while steady-state
+storage drops to 1/N; the update writes shards back with no gather.
 """
 from __future__ import annotations
 
@@ -49,6 +68,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .fused_scan_step import _RNG_SLOTS
 from .sharded_scan import (
     ShardedFusedScanTrainStep, pack_flat, scatter_flat,
 )
@@ -97,11 +117,10 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                 "round-robin virtual-stage placement needs C % pp == 0")
         if self._num_micro < 1:
             raise ValueError("num_micro must be >= 1")
-        if self._dropout_active:
-            raise ValueError(
-                "dropout inside the pipeline ring is not supported "
-                "(no per-(micro, stage) PRNG offset scheme yet); set "
-                "hidden/attention dropout to 0 or use the dp/mp steps")
+        # dropout: the (dp_rank, micro) pair takes the rank slot of the
+        # per-layer offset scheme — masks distinct per micro-batch and
+        # identical wherever the same (step, layer, rows) recur
+        self._rng_nranks = self._batch_degree * self._num_micro
         if self._aux_active:
             raise ValueError(
                 "MoE blocks under pipeline parallelism are not "
@@ -109,6 +128,11 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                 "per-chunk aux-loss output (and expert all_to_alls "
                 "inside ring ticks are unvalidated) — train MoE models "
                 "on a dp or dp×ep mesh (ShardedFusedScanTrainStep)")
+
+    def _rng_rank(self):
+        # the micro index is added per tick (see the ring body); this
+        # contributes the dp part of the (dp_rank*M + m) slot
+        return super()._rng_rank() * self._num_micro
 
     def _extra_reduction_axes(self, mesh):
         pp_axis = self._pp_axis_arg
@@ -135,6 +159,53 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
             "useful_ticks_per_stage": V * M,
             "bubble_ratio": (pp - 1) / (pp + M - 1),
         }
+
+    def _own_chunks(self, state):
+        """Per-leaf [V, K, ...] stacks of THIS stage's chunks.
+
+        Replicated storage: a jnp.take of the replicated stacks.
+        Sharded storage: for each (pass, owner) pair, all-gather the
+        statically-indexed chunk from the flat bucket shards (uniform
+        over the mesh — every rank contributes its slice) and keep it
+        where this rank IS the owner stage; non-trainable leaves ride
+        the replicated stacks as before."""
+        s = state["s"]
+        K = self._layer_chunk
+        C = self.model.config.num_layers // K
+        pp = self._pp_degree
+        V = C // pp
+        stage = lax.axis_index(self._pp_axis)
+        own_idx = stage + pp * jnp.arange(V)   # round-robin ownership
+        if self._param_storage != "sharded":
+            sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
+                         for a in s["p"])
+            return tuple(jnp.take(a, own_idx, axis=0) for a in sp_c)
+        fp_c = [a.reshape((C, K, -1)) for a in s["fp"]]
+        t_pos = {j: tj for tj, (j, _) in enumerate(self._s_train)}
+        per_v = []
+        for v in range(V):
+            sel = None
+            for owner in range(pp):
+                full = self._gather_stacked_chunk(
+                    fp_c, jnp.int32(pp * v + owner))
+                if sel is None:
+                    sel = tuple(
+                        jnp.where(stage == owner, d, jnp.zeros_like(d))
+                        for d in full)
+                else:
+                    sel = tuple(jnp.where(stage == owner, d, acc)
+                                for d, acc in zip(full, sel))
+            per_v.append(sel)
+        own = []
+        for j in range(len(self._s_params)):
+            if j in self._s_trainable_idx:
+                tj = t_pos[j]
+                own.append(jnp.stack([per_v[v][tj] for v in range(V)]))
+            else:
+                d_c = s["p"][j].reshape((C, K)
+                                        + tuple(s["p"][j].shape[1:]))
+                own.append(jnp.take(d_c, own_idx, axis=0))
+        return tuple(own)
 
     # -- the ring forward/backward (replaces the base backward scan) ----
     def _grads(self, state, ids, labels, t32, ct):
@@ -163,15 +234,21 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
         mb = b // M
         pos = jnp.arange(seq, dtype=ids.dtype)[None, :]
 
-        sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
-                     for a in s["p"])
-        own_idx = stage + pp * jnp.arange(V)   # round-robin ownership
-        own0 = tuple(jnp.take(a, own_idx, axis=0) for a in sp_c)
+        sharded_storage = self._param_storage == "sharded"
+        own0 = self._own_chunks(state)
+        o_p0 = (self._gather_outer_full(o) if sharded_storage
+                else o["p"])
 
-        def one_pass(p_v, xs):
+        def one_pass(p_v, xs, v):
             """One ring pass: every micro-batch through this pass's pp
             stages. xs [M, mb, seq, h]; collected outputs land on stage
-            0 (the ring wraps the last stage back there)."""
+            0 (the ring wraps the last stage back there). ``v`` indexes
+            the pass for the dropout offsets: this stage's chunk is
+            stage + pp*v, and the micro on this stage at tick t entered
+            the ring `stage` ticks ago."""
+            chunk_idx = stage + pp * v
+            rng_base = (self._rng_chunk_base(t32, chunk_idx)
+                        if self._dropout_active else None)
 
             def tick(carry, t):
                 st, outs = carry
@@ -179,7 +256,11 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                 fresh = lax.dynamic_index_in_dim(xs, take, 0,
                                                  keepdims=False)
                 inp = jnp.where(stage == 0, fresh, st)
-                y = chunk_apply(p_v, inp, None)
+                rng0 = None
+                if rng_base is not None:
+                    m = jnp.clip(t - stage, 0, M - 1)
+                    rng0 = rng_base + m * _RNG_SLOTS
+                y = chunk_apply(p_v, inp, rng0)
                 passed = lax.ppermute(y, pp_axis, perm)
                 done = t - (pp - 1)
                 slot = jnp.clip(done, 0, M - 1)
@@ -197,12 +278,17 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
 
         def fwd_loss(own_p, o_p):
             # embedding is pointwise over tokens: embed the full local
-            # batch once, then view as micro-batches
-            x0 = self._embed_fn(o_p, ids, pos)
+            # batch once, then view as micro-batches (the embedding
+            # dropout slot is layer L of the base scheme, micro 0 —
+            # unique, since blocks only use layers < L)
+            x0 = self._embed_fn(
+                o_p, ids, pos,
+                rng_off=(self._rng_base(t32, n_layers)
+                         if self._dropout_active else None))
             xs = x0.reshape((M, mb) + tuple(x0.shape[1:]))
             for v in range(V):
                 p_v = tuple(a[v] for a in own_p)
-                xs = one_pass(p_v, xs)
+                xs = one_pass(p_v, xs, v)
                 # between passes only stage 0's collected buffer is
                 # meaningful — and only stage 0 reads it (fresh inject)
             # replicate the finished hiddens to every pp rank for the
@@ -213,7 +299,7 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
             yb = y.reshape((b,) + tuple(y.shape[2:]))
             return self._head_fn(o_p, yb, labels)
 
-        loss, vjpf = jax.vjp(fwd_loss, own0, o["p"])
+        loss, vjpf = jax.vjp(fwd_loss, own0, o_p0)
         d_own, d_o = vjpf(ct.astype(loss.dtype))
 
         # ---- per-chunk scatter over (dp..., pp): the pp leg of the sum

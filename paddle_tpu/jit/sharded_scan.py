@@ -1,11 +1,20 @@
-"""Sharded fused-scan train step: weight-update sharding INSIDE the scan.
+"""Sharded fused-scan train step: weight-update sharding INSIDE the scan,
+and (ISSUE 11) sharded PARAMETER STORAGE with gather-on-use.
 
 `FusedScanTrainStep` made the 1.3b north star fit one chip by fusing the
 Adam update into a manual per-layer reverse scan. This module is its
 multi-chip form, per Xu et al., "Automatic Cross-Replica Sharding of
-Weight Update in Data-Parallel Training" (PAPERS.md): weights stay
-replicated over the dp/sharding axis, but gradients, moments, masters and
-the update computation are 1/N-sharded per rank —
+Weight Update in Data-Parallel Training" (PAPERS.md): gradients, moments,
+masters and the update computation are 1/N-sharded per rank — and, with
+``param_storage="sharded"`` (the default since ISSUE 11), the weights
+THEMSELVES live as 1/N flat bucket shards between steps, all-gathered on
+use inside the forward scan (double-buffered prefetch), re-gathered by
+the backward recompute, and written back as shards by the update scan —
+no full replicated parameter pytree exists at any point between steps
+(ZeRO-3-style storage on the same ``__scan_shard_*__`` flat layout the
+optimizer state uses). ``param_storage="replicated"`` restores the
+original layout (the bit-parity reference). The replicated-mode
+structure —
 
   backward scan (reverse, per chunk of K layers):
       dp      = vjp(block chunk)(dy)                 (full, dies here)
@@ -108,15 +117,13 @@ def scatter_flat(flat, axes, nranks, quant=""):
     collective per bucket (vs one per leaf), bit-identical to
     comm_bucketer.bucketed_reduce_scatter's per-bucket psum_scatter on
     the same packing for the single-axis case. `quant` routes the
-    compressed scatter leg (single-axis only — the all_to_all wire
-    format is not defined over a flattened product)."""
+    compressed scatter leg — since ISSUE 11 the int8/bf16 all_to_all
+    wire format covers flattened axis tuples too (the chunk split is
+    first-axis-major, matching tuple psum_scatter; see
+    collective.comm_quant_multiaxis_selftest)."""
     if isinstance(axes, (tuple, list)) and len(axes) == 1:
         axes = axes[0]
     if quant:
-        if isinstance(axes, (tuple, list)):
-            raise ValueError(
-                "FLAGS_comm_quant scatter supports a single mesh axis; "
-                "disable comm quant for dp×mp / dp×pp hybrid steps")
         from ..distributed.collective import quantized_psum_scatter_traced
 
         return quantized_psum_scatter_traced(axes, nranks, quant)(flat)
@@ -124,12 +131,96 @@ def scatter_flat(flat, axes, nranks, quant=""):
                             tiled=True)
 
 
-def gather_flat(shard, axes, axis):
+def gather_flat(shard, axes, axis, quant=""):
     """Inverse of `scatter_flat`'s split: tiled all_gather over the same
-    (possibly flattened) axes."""
+    (possibly flattened) axes. `quant` routes the compressed gather leg
+    (collective.quantized_all_gather_traced — the sharded-param-storage
+    gather-on-use wire format, lossy and therefore opt-in via
+    FLAGS_comm_quant like the scatter leg)."""
     if isinstance(axes, (tuple, list)) and len(axes) == 1:
         axes = axes[0]
+    if quant:
+        from ..distributed.collective import quantized_all_gather_traced
+
+        return quantized_all_gather_traced(axes, quant,
+                                           gather_axis=axis)(shard)
     return lax.all_gather(shard, axes, axis=axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# sharded parameter storage (ISSUE 11): params live as 1/N flat shards
+# ---------------------------------------------------------------------------
+# Between steps the ONLY param bytes on the devices are the per-bucket
+# flat shards (the same __scan_shard_*__ layout the optimizer state
+# uses); the full per-leaf arrays the rest of the framework reads
+# (eval, checkpointing, tests) are materialized LAZILY on first access
+# and dropped again after every step. The mechanics: each trainable
+# Parameter of a sharded-storage step has its class swapped to a thin
+# subclass whose `_data` property (shadowing the Tensor slot) gathers
+# its bucket on a stale read and marks the bucket dirty on an external
+# write — so `p._data = ...` (checkpoint restore, test poking, user
+# init) transparently flows back into the shards at the next step.
+
+_STALE = object()          # sentinel living in the Tensor._data slot
+_TENSOR_DATA_SLOT = None   # resolved lazily (framework import order)
+_RAW_DATA = [0]            # >0: passthrough reads/writes (inside a step)
+_LAZY_CLS_CACHE = {}
+
+
+def _data_slot():
+    global _TENSOR_DATA_SLOT
+    if _TENSOR_DATA_SLOT is None:
+        from ..framework.tensor import Tensor
+
+        _TENSOR_DATA_SLOT = Tensor.__dict__["_data"]
+    return _TENSOR_DATA_SLOT
+
+
+class _raw_param_access:
+    """Context: Parameter._data reads/writes bypass the lazy-shard
+    machinery (used around the compiled step call and its trace, where
+    `_bind` shuffles tracers through the live Parameter objects)."""
+
+    def __enter__(self):
+        _RAW_DATA[0] += 1
+
+    def __exit__(self, *exc):
+        _RAW_DATA[0] -= 1
+
+
+def _lazy_param_class(cls):
+    lazy = _LAZY_CLS_CACHE.get(cls)
+    if lazy is not None:
+        return lazy
+    slot = _data_slot()
+
+    def _get(self):
+        d = slot.__get__(self)
+        if d is _STALE and not _RAW_DATA[0]:
+            ref = self.__dict__.get("_shard_ref")
+            if ref is not None:
+                ref[0]._materialize_bucket_params(ref[1], ref[2])
+                d = slot.__get__(self)
+            if d is _STALE:
+                raise RuntimeError(
+                    f"parameter {getattr(self, 'name', '?')} is stored "
+                    "as 1/N shards but its owning sharded-storage step "
+                    "is gone; keep the train step alive or use "
+                    "param_storage='replicated'")
+        return d
+
+    def _set(self, v):
+        slot.__set__(self, v)
+        if not _RAW_DATA[0] and v is not _STALE:
+            ref = self.__dict__.get("_shard_ref")
+            if ref is not None:
+                ref[0]._dirty_param_buckets.add((ref[1], ref[2]))
+
+    lazy = type(f"_ShardStored{cls.__name__}", (cls,),
+                {"_data": property(_get, _set), "__module__": __name__,
+                 "_shard_backed": True})
+    _LAZY_CLS_CACHE[cls] = lazy
+    return lazy
 
 
 def _unwrap_layers(model):
@@ -179,13 +270,22 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
     Under mp the block compute runs head-/column-/row-sliced per rank
     with one psum per row-parallel projection, and the LM head is the
     vocab-parallel sharded fused CE (see _setup_mp / _head_fn).
+
+    ``param_storage="sharded"`` (the default; "replicated" restores the
+    pre-ISSUE-11 layout, FLAGS_param_storage overrides globally) stores
+    the PARAMETERS the same way: 1/N flat bucket shards between steps,
+    gathered on use inside the scans with a double-buffered prefetch
+    slot — bit-parity with replicated storage, param_bytes×(1−1/N)
+    less steady-state HBM per device. Between steps, reads of a
+    shard-stored `p._data` gather lazily (eval/checkpoints just work)
+    and external writes repack at the next step.
     """
 
     def __init__(self, model, optimizer, criterion=None, fused_head=False,
                  compute_dtype=None, layer_chunk=1, scan_unroll=1,
                  mesh=None, axis=None, mp_axis=None, ep_axis=None,
                  group=None, comm_bucket_mb=None, comm_quant=None,
-                 scaler=None, guard_nonfinite=None):
+                 scaler=None, guard_nonfinite=None, param_storage=None):
         model = _unwrap_layers(model)
         super().__init__(model, optimizer, criterion=criterion,
                          fused_head=fused_head,
@@ -316,23 +416,28 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             self._setup_mp()
         if ep_axis is not None:
             self._setup_ep()
-        from_flag = comm_quant is None
         if comm_quant is None:
             comm_quant = _flags.get_flag("FLAGS_comm_quant") or ""
-        if comm_quant and len(self._axes) > 1:
-            if not from_flag:
-                raise ValueError(
-                    "comm_quant int8/bf16 scatter is single-axis; the "
-                    "all_to_all wire format is not defined over the "
-                    "flattened dp×mp/pp product")
-            import warnings
-
-            warnings.warn(
-                "FLAGS_comm_quant is single-axis; disabled for this "
-                f"hybrid step over {self._axes}", RuntimeWarning,
-                stacklevel=2)
-            comm_quant = ""
+        # since ISSUE 11 the int8/bf16 wire format covers flattened axis
+        # tuples (first-axis-major all_to_all split, verified by
+        # comm_quant_multiaxis_selftest) — the PR-8 warn-off/reject for
+        # multi-axis steps is gone
         self._comm_quant = comm_quant
+        # sharded parameter storage (ISSUE 11): params live as 1/N flat
+        # bucket shards (gathered on use inside the scans) instead of
+        # replicated per-leaf stacks; default ON for the sharded steps —
+        # the compiled step is bit-parity with replicated storage
+        if param_storage is None:
+            param_storage = (_flags.get_flag("FLAGS_param_storage")
+                             or "sharded")
+        if param_storage not in ("sharded", "replicated"):
+            raise ValueError(
+                f"param_storage {param_storage!r} (sharded|replicated)")
+        self._param_storage = param_storage
+        self._param_shards = {"s": [], "o": []}
+        self._dirty_param_buckets = set()
+        self._pack_jits = {}       # (grp, bucket idx) -> jitted packer
+        self._gather_jit = None    # shard -> replicated resharder
         from ..distributed.collective import QUANT_SCATTER_BLOCK
         from ..distributed.comm_bucketer import MB, build_buckets
 
@@ -346,6 +451,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         # scatters one chunk at a time); outer leaves by full shape
         self._s_train = [(j, p) for j, p in enumerate(self._s_params)
                          if p.trainable]
+        self._s_trainable_idx = {j for j, _ in self._s_train}
         self._s_assign = build_buckets(
             [(j, tuple(p.shape[1:]), p._data.dtype)
              for j, p in self._s_train],
@@ -354,6 +460,16 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             [(j, tuple(p.shape), p._data.dtype)
              for j, (_, p) in enumerate(self._o_params)],
             bucket_bytes=bucket_bytes, pad_multiple=pad)
+        # master-weight use per bucket, resolved NOW (reads p._data
+        # dtypes) — after shardification the live Parameters may hold
+        # the stale sentinel, and build-time metadata must not trigger
+        # a gather
+        self._bucket_use_mw = {
+            grp: [any(self._opt._use_master(p)
+                      for p in self._bucket_params(grp, b))
+                  for b in assign.buckets]
+            for grp, assign in (("s", self._s_assign),
+                                ("o", self._o_assign))}
 
     def _rng_rank(self):
         r = lax.axis_index(self._axis)
@@ -376,9 +492,9 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         return r
 
     # -- Megatron tensor parallelism over the mp axis --------------------
-    # Storage stays replicated (the weight-update-sharding design:
-    # optimizer state, grads and the update are what shard); COMPUTE is
-    # tensor-parallel: each mp rank binds head-/column-sliced views of
+    # COMPUTE is tensor-parallel (storage is flat-sharded 1/N by default
+    # since ISSUE 11 — the mp slicers below operate on the gathered full
+    # leaves either way): each mp rank binds head-/column-sliced views of
     # qkv+fc1 and row-sliced views of out_proj+fc2 into the block
     # template, and the two row-parallel outputs psum over mp inside the
     # block — the Megatron layout the SPMD rule table
@@ -504,8 +620,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         ]
 
     # -- expert parallelism over the ep axis -----------------------------
-    # Storage stays replicated (same weight-update-sharding design as
-    # mp); COMPUTE is expert-parallel: each ep rank binds the 1/ep slice
+    # COMPUTE is expert-parallel (storage flat-sharded 1/N by default,
+    # like mp above): each ep rank binds the 1/ep slice
     # of every MoE expert stack into the template, and the MoE layer —
     # seeing sliced stacks inside a shard_map that binds the axis —
     # dispatches tokens to expert owners with explicit capacity-padded
@@ -664,8 +780,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         return [src[e.key] for e in bucket.entries]
 
     def _bucket_uses_master(self, grp, bucket):
-        return any(self._opt._use_master(p)
-                   for p in self._bucket_params(grp, bucket))
+        return self._bucket_use_mw[grp][bucket.index]
 
     def _materialize_flat_state(self):
         """Build (or repack) the optimizer state as per-bucket flat
@@ -730,18 +845,151 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             return md
         return jnp.float32 if use_mw else bucket.dtype
 
+    # -- sharded parameter storage (ISSUE 11) ---------------------------
+    def _shard_sharding(self, grp):
+        ax = self._axes if len(self._axes) > 1 else self._axis
+        return NamedSharding(self._mesh,
+                             P(None, ax) if grp == "s" else P(ax))
+
+    def _shard_stored_params(self, grp, bucket):
+        """The live Parameter objects whose storage the (grp, bucket)
+        flat shard owns."""
+        if grp == "s":
+            by_j = dict(self._s_train)
+            return [by_j[e.key] for e in bucket.entries]
+        return [self._o_params[e.key][1] for e in bucket.entries]
+
+    def _pack_param_bucket(self, grp, bucket):
+        """Pack the bucket's params from their CURRENT full `_data` into
+        one flat array sharded 1/N over the reduction axes (the same
+        layout/jit-out_shardings pattern `_materialize_flat_state`
+        uses). Reads materialize stale entries first, so a partial
+        external write (checkpoint restore touching one leaf) composes
+        with shard-resident neighbours. The jitted packer is cached per
+        (grp, bucket) — repack is a steady-state path (every restore /
+        external write), and a fresh jit per call would recompile."""
+        n_layers = self.model.config.num_layers
+        lead = (n_layers,) if grp == "s" else ()
+        params = self._shard_stored_params(grp, bucket)
+        leaves = {e.key: p._data
+                  for e, p in zip(bucket.entries, params)}
+        fn = self._pack_jits.get((grp, bucket.index))
+        if fn is None:
+            fn = jax.jit(
+                lambda lv: pack_flat(lambda k: lv[k], bucket,
+                                     lead=lead),
+                out_shardings=self._shard_sharding(grp))
+            self._pack_jits[(grp, bucket.index)] = fn
+        return fn(leaves)
+
+    def _materialize_param_shards(self):
+        """Flip parameter STORAGE to 1/N flat bucket shards: pack every
+        trainable leaf once, swap the live Parameters to the lazy
+        shard-backed class, and drop the full arrays (the stale
+        sentinel) — from here on no full replicated parameter pytree
+        exists between steps; reads gather on demand, external writes
+        repack at the next step."""
+        if self._param_storage != "sharded" or self._param_shards["s"] \
+                or self._param_shards["o"]:
+            if self._param_storage == "sharded":
+                self._repack_dirty_param_buckets()
+            return
+        slot = _data_slot()
+        for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
+            for bucket in assign.buckets:
+                # NOTE: _pack_param_bucket reads p._data through the
+                # lazy property, so a param still shard-backed by a
+                # PREVIOUS step (rebuild-the-step workflow: new
+                # optimizer, phase-2 fine-tune) materializes its
+                # current values from the old step's shards first —
+                # the takeover below then rebinds it to this step.
+                # (Two steps training one model CONCURRENTLY remains
+                # undefined, exactly as with replicated storage.)
+                self._param_shards[grp].append(
+                    self._pack_param_bucket(grp, bucket))
+                for p in self._shard_stored_params(grp, bucket):
+                    if not getattr(type(p), "_shard_backed", False):
+                        p.__class__ = _lazy_param_class(type(p))
+                    p.__dict__["_shard_ref"] = (self, grp, bucket.index)
+                    slot.__set__(p, _STALE)
+        self._dirty_param_buckets.clear()
+
+    def _materialize_bucket_params(self, grp, bucket_index):
+        """Lazy-read path: gather ONE bucket's flat shard back to a
+        replicated array and fill the full `_data` of every entry that
+        is still stale (an externally written entry keeps its new
+        value). Called by the lazy Parameter's `_data` getter."""
+        bucket = (self._s_assign if grp == "s"
+                  else self._o_assign).buckets[bucket_index]
+        flat = self._param_shards[grp][bucket_index]
+        # one cached resharder for every bucket read: materialization is
+        # a steady-state path (eval / checkpoint save between steps)
+        if self._gather_jit is None:
+            self._gather_jit = jax.jit(
+                lambda v: v,
+                out_shardings=NamedSharding(self._mesh, P()))
+        full = self._gather_jit(flat)
+        slot = _data_slot()
+        n_layers = self.model.config.num_layers
+        for e, p in zip(bucket.entries,
+                        self._shard_stored_params(grp, bucket)):
+            if slot.__get__(p) is not _STALE:
+                continue
+            leaf = full[..., e.offset:e.offset + e.numel]
+            shape = ((n_layers,) + tuple(e.shape) if grp == "s"
+                     else tuple(e.shape))
+            slot.__set__(p, leaf.reshape(shape))
+
+    def _invalidate_param_caches(self):
+        """Post-step: drop any materialized full arrays so the shards
+        stay the only live parameter bytes between steps."""
+        slot = _data_slot()
+        for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
+            for bucket in assign.buckets:
+                for p in self._shard_stored_params(grp, bucket):
+                    slot.__set__(p, _STALE)
+
+    def _repack_dirty_param_buckets(self):
+        """Pre-step: fold external `p._data` writes (checkpoint restore,
+        test poking) back into the authoritative flat shards."""
+        if not self._dirty_param_buckets:
+            return
+        for grp, bi in sorted(self._dirty_param_buckets):
+            assign = self._s_assign if grp == "s" else self._o_assign
+            self._param_shards[grp][bi] = self._pack_param_bucket(
+                grp, assign.buckets[bi])
+        self._dirty_param_buckets.clear()
+        self._invalidate_param_caches()
+
+    def full_params(self):
+        """Materialize every shard-stored parameter's full `_data`
+        (eval/export convenience; the next step drops the copies
+        again). No-op under replicated storage."""
+        if self._param_storage == "sharded":
+            for _, p in self._s_train:
+                _ = p._data
+            for _, p in self._o_params:
+                _ = p._data
+
     def ensure_built(self):
         if self._jitted is not None:
             return
         self._materialize_flat_state()
+        self._materialize_param_shards()
         # canonicalize replicated-state layouts BEFORE the first trace:
         # the step's outputs come back mesh-committed, so an uncommitted
         # single-device param on call 1 would key a SECOND executable on
         # call 2 (the TrainStep._build layout lesson — one extra compile
         # is minutes of axon program load at 1.3b)
         rep = NamedSharding(self._mesh, P())
-        for p in self._s_params + [p for _, p in self._o_params]:
-            p._data = jax.device_put(p._data, rep)
+        shard_stored = (self._s_trainable_idx
+                        if self._param_storage == "sharded" else set())
+        for j, p in enumerate(self._s_params):
+            if j not in shard_stored:
+                p._data = jax.device_put(p._data, rep)
+        if self._param_storage != "sharded":
+            for _, p in self._o_params:
+                p._data = jax.device_put(p._data, rep)
         for b in self._buffers:
             b._data = jax.device_put(b._data, rep)
         self._step_count = jax.device_put(
@@ -758,12 +1006,24 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
     def _extract_state(self):
         opt = self._opt
         self._step_count = opt._step_count   # restore-aware (base class)
-        st = {
-            "s": {"p": [p._data for p in self._s_params]},
-            "o": {"p": [p._data for _, p in self._o_params]},
-            "buf": [b._data for b in self._buffers],
-            "step": jnp.asarray(self._step_count, jnp.int32),
-        }
+        if self._param_storage == "sharded":
+            st = {
+                "s": {"p": [None if j in self._s_trainable_idx
+                            else p._data
+                            for j, p in enumerate(self._s_params)],
+                      "fp": list(self._param_shards["s"])},
+                "o": {"p": [None] * len(self._o_params),
+                      "fp": list(self._param_shards["o"])},
+                "buf": [b._data for b in self._buffers],
+                "step": jnp.asarray(self._step_count, jnp.int32),
+            }
+        else:
+            st = {
+                "s": {"p": [p._data for p in self._s_params]},
+                "o": {"p": [p._data for _, p in self._o_params]},
+                "buf": [b._data for b in self._buffers],
+                "step": jnp.asarray(self._step_count, jnp.int32),
+            }
         for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
             st[grp]["m"] = [opt._accumulators["moment1"]
                             [self._flat_key(grp, b.index)]
@@ -779,10 +1039,21 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
     def _inject_state(self, state):
         opt = self._opt
-        for p, d in zip(self._s_params, state["s"]["p"]):
-            p._data = d
-        for (_, p), d in zip(self._o_params, state["o"]["p"]):
-            p._data = d
+        if self._param_storage == "sharded":
+            self._param_shards["s"] = list(state["s"]["fp"])
+            self._param_shards["o"] = list(state["o"]["fp"])
+            for j, (p, d) in enumerate(zip(self._s_params,
+                                           state["s"]["p"])):
+                if j not in self._s_trainable_idx:
+                    p._data = d
+            # full-param caches are stale now (and their device buffers
+            # must die): the shards are the only live parameter bytes
+            self._invalidate_param_caches()
+        else:
+            for p, d in zip(self._s_params, state["s"]["p"]):
+                p._data = d
+            for (_, p), d in zip(self._o_params, state["o"]["p"]):
+                p._data = d
         for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
             for b in assign.buckets:
                 fkey = self._flat_key(grp, b.index)
@@ -803,12 +1074,23 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
     def _state_specs(self):
         ax = self._axes if len(self._axes) > 1 else self._axis
         rep = P()
-        specs = {
-            "s": {"p": [rep] * len(self._s_params)},
-            "o": {"p": [rep] * len(self._o_params)},
-            "buf": [rep] * len(self._buffers),
-            "step": rep,
-        }
+        if self._param_storage == "sharded":
+            specs = {
+                "s": {"p": [None if j in self._s_trainable_idx else rep
+                            for j in range(len(self._s_params))],
+                      "fp": [P(None, ax)] * len(self._s_assign.buckets)},
+                "o": {"p": [None] * len(self._o_params),
+                      "fp": [P(ax)] * len(self._o_assign.buckets)},
+                "buf": [rep] * len(self._buffers),
+                "step": rep,
+            }
+        else:
+            specs = {
+                "s": {"p": [rep] * len(self._s_params)},
+                "o": {"p": [rep] * len(self._o_params)},
+                "buf": [rep] * len(self._buffers),
+                "step": rep,
+            }
         if self._guard is not None:
             specs["guard"] = {"scale": rep, "good": rep, "bad": rep,
                               "found": rep}
@@ -868,6 +1150,52 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             g32 = g32 * nc_shard
         return jnp.sum(jnp.square(g32))
 
+    # -- gather-on-use plumbing (sharded parameter storage) --------------
+    def _stacked_nontrainable(self, s_state):
+        """[(leaf index j, data)] for the frozen stacked leaves riding
+        `state['s']['p']` beside the shard-stored trainable ones."""
+        return [(j, d) for j, d in enumerate(s_state["p"])
+                if j not in self._s_trainable_idx]
+
+    def _leaves_of(self, trainable, nontrainable):
+        """Compose the full per-chunk leaf list (template order) from
+        the gathered trainable tuple (ordered like `_s_train`) and the
+        scanned non-trainable chunk slices."""
+        lv = [None] * len(self._s_params)
+        for (j, _), d in zip(self._s_train, trainable):
+            lv[j] = d
+        for (j, _), d in nontrainable:
+            lv[j] = d
+        return lv
+
+    def _gather_outer_full(self, o_state):
+        """Gather the outer params' flat shards back to full leaf
+        arrays (ordered like `_o_params`) — once per step, at the top
+        of the traced body; the full set dies with the step."""
+        quant = self._comm_quant
+        full = [None] * len(self._o_params)
+        for bkt in self._o_assign.buckets:
+            fb = gather_flat(o_state["fp"][bkt.index], self._axes,
+                             axis=0, quant=quant)
+            for key, leaf in unpack_flat(fb, bkt).items():
+                full[key] = leaf
+        return full
+
+    def _gather_stacked_chunk(self, fp_c, i):
+        """All-gather chunk ``i``'s params from the [C, K, F/N] flat
+        shard stacks: one (optionally quantized) tiled all_gather per
+        bucket over the flattened reduction axes, unpacked to the
+        per-leaf [K, ...] views the block template binds. Returns a
+        tuple ordered like `_s_train`."""
+        quant = self._comm_quant
+        out = {}
+        for bkt in self._s_assign.buckets:
+            fs = lax.dynamic_index_in_dim(fp_c[bkt.index], i,
+                                          keepdims=False)     # [K, F/N]
+            fb = gather_flat(fs, self._axes, axis=1, quant=quant)
+            out.update(unpack_flat(fb, bkt))                  # [K, F]
+        return tuple(out[j] for j, _ in self._s_train)
+
     def _grads(self, state, ids, labels, t32, ct):
         """Forward + backward producing the SCATTERED gradient shards:
         returns (loss, G, o_gs, sq, fin) where G[bucket] is [C, K, F/N]
@@ -875,7 +1203,13 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         sq the local shard's squared-norm contribution and fin the local
         finiteness fold. Default implementation is the in-scan
         reduce-scatter backward; the pipeline step overrides this with
-        the ring schedule while reusing everything downstream."""
+        the ring schedule while reusing everything downstream. Under
+        ``param_storage='sharded'`` the forward/backward scans gather
+        each chunk's params on use (double-buffered prefetch) instead of
+        reading replicated stacks."""
+        if self._param_storage == "sharded":
+            return self._grads_sharded_storage(state, ids, labels, t32,
+                                               ct)
         from .nonfinite_guard import all_finite
 
         s, o = state["s"], state["o"]
@@ -988,6 +1322,142 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             o_gs.append(gs)
         return loss, G, o_gs, sq, fin
 
+    def _grads_sharded_storage(self, state, ids, labels, t32, ct):
+        """The gather-on-use form of `_grads` (ISSUE 11): params enter
+        as 1/N flat bucket shards. The forward scan carries chunk i's
+        GATHERED params while issuing the gather for chunk i+1 — a
+        double-buffered prefetch slot, so the (independent) all_gather
+        and the block compute land in the same while-body for XLA's
+        latency-hiding scheduler at any scan_unroll (>=2 additionally
+        interleaves adjacent chunks, mirroring the update-scan
+        overlap). The backward scan re-gathers each chunk the same way
+        (reverse direction, same double buffer) for its vjp recompute,
+        so at most TWO chunks' full params are ever live and no full
+        parameter set exists at any point. Outer params gather once at
+        the top and die with the step. Values are bit-identical to the
+        replicated-storage step: the shards hold exactly the bytes the
+        replicated stacks would (pack/gather is concat/slice), unless
+        FLAGS_comm_quant compresses the gather leg (opt-in, lossy)."""
+        from .nonfinite_guard import all_finite
+
+        s, o = state["s"], state["o"]
+        axes, N = self._axes, self._degree
+        K = self._layer_chunk
+        n_layers = self.model.config.num_layers
+        C = n_layers // K
+        quant = self._comm_quant
+        s_assign, o_assign = self._s_assign, self._o_assign
+        clip_norm = self._clip_global
+        guard = self._guard
+        rank = self._flat_rank()
+        chunk_apply = self._chunk_apply
+        b, seq = ids.shape          # LOCAL batch rows
+        pos = jnp.arange(seq, dtype=ids.dtype)[None, :]
+        aux_active = self._aux_active
+        aux_w = self._aux_weight / n_layers
+
+        o_full = self._gather_outer_full(o)
+        fp_c = [a.reshape((C, K, -1)) for a in s["fp"]]
+        nt = self._stacked_nontrainable(s)
+        nt_c = tuple(d.reshape((C, K) + tuple(d.shape[1:]))
+                     for _, d in nt)
+
+        def gather_chunk(i):
+            return self._gather_stacked_chunk(fp_c, i)
+
+        def leaves_of(tr, nt_i):
+            return self._leaves_of(tr, list(zip([j for j, _ in nt],
+                                                nt_i)))
+
+        # ---- forward: double-buffered gather-on-use over the chunks
+        x0 = self._embed_fn(o_full, ids, pos,
+                            rng_off=self._rng_base(t32, n_layers))
+
+        def fwd_body(carry, scanned):
+            h, cur = carry
+            nt_i, i = scanned
+            # prefetch: chunk i+1's gather is data-independent of chunk
+            # i's compute below (the wrap at i=C-1 re-gathers chunk 0 —
+            # one wasted gather per scan, 1/C of the param traffic)
+            nxt = gather_chunk(jnp.remainder(i + 1, C))
+            rng0 = self._rng_chunk_base(t32, i)
+            if aux_active:
+                h2, aux = chunk_apply(leaves_of(cur, nt_i), h, rng0)
+                return (h2, nxt), (h, aux)
+            return (chunk_apply(leaves_of(cur, nt_i), h, rng0),
+                    nxt), h
+
+        (xL, _), ys = lax.scan(
+            fwd_body, (x0, gather_chunk(jnp.int32(0))),
+            (nt_c, jnp.arange(C)), unroll=self._scan_unroll)
+        xs, auxs = ys if aux_active else (ys, None)
+
+        loss, head_vjp = jax.vjp(
+            lambda od, x: self._head_fn(od, x, labels), o_full, xL)
+        d_o_head, dxL = head_vjp(ct.astype(loss.dtype))
+        aux_ct = None
+        if aux_active:
+            loss = loss + jnp.float32(aux_w) * jnp.sum(auxs)
+            aux_ct = jnp.float32(aux_w) * ct.astype(jnp.float32)
+
+        # ---- backward: re-gather each chunk (reverse double buffer)
+        # for the vjp recompute; only the scattered 1/N grad shards,
+        # the norm scalar and the finiteness fold survive an iteration
+        G0 = tuple(jnp.zeros((C, K, bkt.numel // N), bkt.dtype)
+                   for bkt in s_assign.buckets)
+
+        def bwd_body(carry, scanned):
+            dy, sq, fin, G, cur = carry
+            x_i, nt_i, i = scanned
+            prv = gather_chunk(jnp.remainder(i - 1 + C, C))
+            rng0 = self._rng_chunk_base(t32, i)
+            p_i = tuple(leaves_of(cur, nt_i))
+            _, vjp = jax.vjp(
+                lambda pl, xx: chunk_apply(pl, xx, rng0), p_i, x_i)
+            dp, dx = vjp((dy, aux_ct) if aux_active else dy)
+            newG = []
+            for bkt in s_assign.buckets:
+                flat = pack_flat(lambda j: dp[j], bkt, lead=(K,))
+                gs = scatter_flat(flat, axes, N, quant)  # [K, F/N]
+                if clip_norm is not None:
+                    nc = self._shard_of(self._s_hp[bkt.index][3], rank,
+                                        bkt.numel // N)
+                    sq = sq + self._sq_of(gs, nc)
+                if guard is not None:
+                    fin = fin & all_finite([gs])
+                newG.append(lax.dynamic_update_index_in_dim(
+                    G[bkt.index], gs, i, 0))
+            return (dx, sq, fin, tuple(newG), prv), None
+
+        (dx0, sq, fin, G, _), _ = lax.scan(
+            bwd_body,
+            (dxL, jnp.float32(0.0), jnp.bool_(True), G0,
+             gather_chunk(jnp.int32(C - 1))),
+            (xs, nt_c, jnp.arange(C)), reverse=True,
+            unroll=self._scan_unroll)
+
+        # ---- outer grads: same pack + reduce-scatter as replicated
+        _, emb_vjp = jax.vjp(
+            lambda od: self._embed_fn(
+                od, ids, pos,
+                rng_off=self._rng_base(t32, n_layers)), o_full)
+        (d_o_emb,) = emb_vjp(dx0)
+        o_gs = []
+        for bkt in o_assign.buckets:
+            flat = pack_flat(
+                lambda j: (d_o_head[j].astype(jnp.float32)
+                           + d_o_emb[j].astype(jnp.float32)),
+                bkt)
+            gs = scatter_flat(flat, axes, N, quant)      # [F/N]
+            if clip_norm is not None:
+                nc = self._shard_of(self._o_hp[bkt.index][3], rank,
+                                    bkt.numel // N)
+                sq = sq + self._sq_of(gs, nc)
+            if guard is not None:
+                fin = fin & all_finite([gs])
+            o_gs.append(gs)
+        return loss, G, o_gs, sq, fin
+
     def _build(self):
         opt = self._opt
         mesh, N = self._mesh, self._degree
@@ -1050,8 +1520,10 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
                 loss, G, o_gs, sq, fin = self._grads(
                     state, ids, labels, t32, ct)
-                sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
-                             for a in s["p"])
+                sharded_storage = self._param_storage == "sharded"
+                if not sharded_storage:
+                    sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
+                                 for a in s["p"])
 
                 # ---- the fused global-norm clip + cross-rank found_inf:
                 # still ONE scalar all-reduce (a length-2 psum when the
@@ -1075,15 +1547,115 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                             / jnp.maximum(gnorm, 1e-12), 1.0)
 
                 # ---- update scan: sharded Adam on each chunk's grad
-                # shard, then all_gather the updated shard back into the
-                # replicated param stacks. Bucket b's gather is
-                # independent of bucket b+1's math (and, under
-                # scan_unroll>=2, of the next chunk's) — the overlap the
-                # HLO probe checks for.
+                # shard. Replicated storage then all_gathers the updated
+                # shard back into the replicated param stacks (bucket
+                # b's gather is independent of bucket b+1's math — the
+                # overlap the HLO probe checks for); sharded storage
+                # just WRITES the shard back — the gather moved to the
+                # next step's forward (gather-on-use).
                 sM = [m.reshape((C, K, -1)) for m in s["m"]]
                 sV = [v.reshape((C, K, -1)) for v in s["v"]]
                 sMW = [mw.reshape((C, K, -1)) if mw is not None else None
                        for mw in s["mw"]]
+                if sharded_storage:
+                    FP0 = [a.reshape((C, K, -1)) for a in s["fp"]]
+
+                    def upd_body_sharded(carry, i):
+                        FP, M, V, MW = carry
+                        for bkt in s_assign.buckets:
+                            bi = bkt.index
+                            shard_len = bkt.numel // N
+                            wd, l2, lrs, nc = (
+                                shard_of(h, rank, shard_len)
+                                for h in s_hp[bi])
+                            g32 = g_shard_f32(
+                                lax.dynamic_index_in_dim(
+                                    G[bi], i, keepdims=False),
+                                nc, scale, inv_s)
+                            m_i = lax.dynamic_index_in_dim(
+                                M[bi], i, keepdims=False)
+                            v_i = lax.dynamic_index_in_dim(
+                                V[bi], i, keepdims=False)
+                            if MW[bi] is not None:
+                                pv = lax.dynamic_index_in_dim(
+                                    MW[bi], i, keepdims=False)
+                            else:
+                                # fp32-stored params ARE the master, and
+                                # the stored shard IS this rank's slice
+                                pv = lax.dynamic_index_in_dim(
+                                    FP[bi], i, keepdims=False)
+                            out32, mn, vn, _ = adam_shard(
+                                pv, g32, m_i, v_i, lr * lrs, tf, wd, l2)
+                            if found is not None:
+                                # bad step: the stored shard passes
+                                # through bit-identical (no rebuild
+                                # needed — storage IS the shard)
+                                out32 = jnp.where(found, pv, out32)
+                                mn = jnp.where(found, m_i, mn)
+                                vn = jnp.where(found, v_i, vn)
+                            M[bi] = lax.dynamic_update_index_in_dim(
+                                M[bi], mn.astype(M[bi].dtype), i, 0)
+                            V[bi] = lax.dynamic_update_index_in_dim(
+                                V[bi], vn.astype(V[bi].dtype), i, 0)
+                            if MW[bi] is not None:
+                                MW[bi] = lax.dynamic_update_index_in_dim(
+                                    MW[bi], out32, i, 0)
+                            FP[bi] = lax.dynamic_update_index_in_dim(
+                                FP[bi], out32.astype(bkt.dtype), i, 0)
+                        return (FP, M, V, MW), None
+
+                    (FP, sM, sV, sMW), _ = lax.scan(
+                        upd_body_sharded,
+                        (list(FP0), list(sM), list(sV), list(sMW)),
+                        jnp.arange(C), unroll=self._scan_unroll)
+                    new_sp = list(s["p"])
+                    new_s_fp = [a.reshape((n_layers, -1)) for a in FP]
+
+                    # ---- outer update (no scan): shard in, shard out
+                    new_op = list(o["p"])
+                    new_o_fp = []
+                    new_om, new_ov, new_omw = [], [], []
+                    for bkt in o_assign.buckets:
+                        bi = bkt.index
+                        shard_len = bkt.numel // N
+                        wd, l2, lrs, nc = (shard_of(h, rank, shard_len)
+                                           for h in o_hp[bi])
+                        g32 = g_shard_f32(o_gs[bi], nc, scale, inv_s)
+                        m_i, v_i = o["m"][bi], o["v"][bi]
+                        pv = (o["mw"][bi] if o["mw"][bi] is not None
+                              else o["fp"][bi])
+                        out32, mn, vn, _ = adam_shard(
+                            pv, g32, m_i, v_i, lr * lrs, tf, wd, l2)
+                        if found is not None:
+                            out32 = jnp.where(found, pv, out32)
+                            mn = jnp.where(found, m_i, mn)
+                            vn = jnp.where(found, v_i, vn)
+                        new_om.append(mn.astype(m_i.dtype))
+                        new_ov.append(vn.astype(v_i.dtype))
+                        new_omw.append(out32 if o["mw"][bi] is not None
+                                       else None)
+                        new_o_fp.append(out32.astype(bkt.dtype))
+
+                    new_state = {
+                        "s": {"p": new_sp, "fp": new_s_fp,
+                              "m": [m.reshape((n_layers, -1))
+                                    for m in sM],
+                              "v": [v.reshape((n_layers, -1))
+                                    for v in sV],
+                              "mw": [mw.reshape((n_layers, -1))
+                                     if mw is not None else None
+                                     for mw in sMW]},
+                        "o": {"p": new_op, "fp": new_o_fp,
+                              "m": new_om, "v": new_ov, "mw": new_omw},
+                        "buf": state["buf"],
+                        "step": (t if found is None
+                                 else jnp.where(found, state["step"],
+                                                t)),
+                    }
+                    if guard is not None:
+                        new_state["guard"] = guard.update(gst, found)
+                    return lax.psum(loss, axes) * inv_n, new_state
+
                 P_tr0 = tuple(sp_c[j] for j, _ in self._s_train)
 
                 def upd_body(carry, i):
@@ -1231,6 +1803,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         from ..framework.tensor import Tensor
 
         self.ensure_built()
+        self._pre_step()
         state = self._extract_state()
         ids_d = ids._data if isinstance(ids, Tensor) else ids
         lab_d = labels._data if isinstance(labels, Tensor) else labels
@@ -1264,7 +1837,17 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             in_specs=(specs, batch_spec, batch_spec),
             out_specs=(P(), (P(),) * ns, (P(),) * no),
             check_vma=False)
-        return jax.jit(wrapped)(state, ids_d, lab_d)
+        with self._step_guard():
+            return jax.jit(wrapped)(state, ids_d, lab_d)
+
+    def _pre_step(self):
+        if self._param_storage == "sharded":
+            self._repack_dirty_param_buckets()
+
+    def _step_guard(self):
+        if self._param_storage == "sharded":
+            return _raw_param_access()
+        return super()._step_guard()
 
     def __call__(self, ids, labels, segment_ids=None):
         shape = getattr(ids, "shape", None)
@@ -1356,6 +1939,7 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
             step = ShardedFusedScanTrainStep(
                 layers, optimizer, criterion=criterion, mesh=mesh,
                 axis="dp", mp_axis="mp" if cand.mp > 1 else None,
+                ep_axis="ep" if getattr(cand, "ep", 1) > 1 else None,
                 **step_kw)
         else:
             step = FusedScanTrainStep(
@@ -1443,12 +2027,14 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
 # ---------------------------------------------------------------------------
 
 def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1,
-                        mp=1, pp=1, num_micro=2, ep=1):
+                        mp=1, pp=1, num_micro=2, ep=1,
+                        param_storage=None):
     """Lower (not run) the sharded step for a tiny scan GPT on an
     n-device host mesh — the program the overlap checker inspects.
     ``mp``/``pp``/``ep`` > 1 build the hybrid variants (dp×mp Megatron
     sharding / the dp×pp ring pipeline / the dp×ep expert-parallel MoE
-    step) instead of the dp-only step."""
+    step) instead of the dp-only step. ``param_storage`` selects the
+    storage format (None = the step default, i.e. sharded)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.optimizer as popt
@@ -1492,14 +2078,16 @@ def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1,
         step = PipelineScanTrainStep(model, opt, mesh=mesh, axis="dp",
                                      pp_axis="pp", num_micro=num_micro,
                                      scan_unroll=scan_unroll,
-                                     layer_chunk=layer_chunk)
+                                     layer_chunk=layer_chunk,
+                                     param_storage=param_storage)
     else:
         step = ShardedFusedScanTrainStep(
             model, opt, mesh=mesh,
             axis="dp" if (mp > 1 or ep > 1) else "sharding",
             mp_axis="mp" if mp > 1 else None,
             ep_axis="ep" if ep > 1 else None,
-            scan_unroll=scan_unroll, layer_chunk=layer_chunk)
+            scan_unroll=scan_unroll, layer_chunk=layer_chunk,
+            param_storage=param_storage)
     step.ensure_built()
     state = step._extract_state()
     lr = jnp.float32(1e-3)
@@ -1508,4 +2096,5 @@ def build_probe_lowered(n_devices=8, scan_unroll=2, layer_chunk=1,
                       jnp.int32)
     labels = jnp.asarray(rng.integers(0, cfg.vocab_size,
                                       (n_devices, 16)), jnp.int32)
-    return step._jitted.lower(state, lr, ids, labels, None)
+    with step._step_guard():
+        return step._jitted.lower(state, lr, ids, labels, None)

@@ -212,6 +212,106 @@ def hlo_overlap_probe(n_devices=8, scan_unroll=2, mp=1, pp=1, ep=1):
     return verdict
 
 
+def param_storage_probe(n_devices=8, scan_unroll=2, mp=1, pp=1):
+    """ISSUE 11 receipt: compile the probe step under BOTH parameter
+    storage formats and compare compiled-HLO buffer bounds + collective
+    censuses.
+
+    * ``no_full_param_set``: no buffer in the sharded-storage program
+      reaches the model's total trainable element count — a full
+      parameter set is never materialized;
+    * ``no_stacked_param_buffer``: no buffer reaches even ONE stacked
+      [L, ...] leaf's element count (the replicated layout's storage
+      unit) — at most ~a layer chunk's gathered params are live across
+      chunk boundaries;
+    * ``peak_reduced``: the largest buffer in the sharded program is
+      strictly smaller than in the replicated program (the
+      peak-live-bytes proxy the bench records);
+    * every all-gather classifies under the flattened mesh-axes label
+      (the param gather), nothing unclassified.
+    """
+    import jax
+    import numpy as np
+
+    from .sharded_scan import build_probe_lowered
+
+    mod = _load_hlo_overlap()
+    if mp > 1:
+        degrees = {"dp": n_devices // mp, "mp": mp}
+        flat_label = "dp+mp"
+    elif pp > 1:
+        degrees = {"pp": pp, "dp": n_devices // pp}
+        flat_label = "pp+dp"
+    else:
+        degrees = {"sharding": n_devices}
+        flat_label = "sharding"
+
+    # the probe model's parameter accounting (same config as
+    # build_probe_lowered)
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                    num_attention_heads=2, max_position_embeddings=32,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    scan_layers=True)
+    paddle.seed(0)
+    trainable = [(n, p) for n, p in
+                 GPTForCausalLM(cfg).named_parameters() if p.trainable]
+    total_elems = sum(int(np.prod(p.shape)) for _, p in trainable)
+    largest_stacked = max(
+        int(np.prod(p.shape)) for n, p in trainable
+        if "blocks__" in n and p.ndim >= 1
+        and p.shape[0] == cfg.num_layers)
+
+    def shape_scan(text):
+        import re
+
+        worst = 0
+        for m in re.finditer(r"\b(?:f|bf|s|u|pred)[0-9]*\[([0-9,]*)\]",
+                             text):
+            n = 1
+            for d in m.group(1).split(","):
+                if d:
+                    n *= int(d)
+            worst = max(worst, n)
+        return worst
+
+    out = {"probe": {"n_devices": n_devices, "scan_unroll": scan_unroll,
+                     "mp": mp, "pp": pp,
+                     "total_trainable_elems": total_elems,
+                     "largest_stacked_leaf_elems": largest_stacked}}
+    peaks = {}
+    for storage in ("sharded", "replicated"):
+        text = build_probe_lowered(
+            n_devices=n_devices, scan_unroll=scan_unroll, mp=mp, pp=pp,
+            param_storage=storage).compile().as_text()
+        v = mod.analyze(text, axis_degrees=degrees)
+        peaks[storage] = shape_scan(text)
+        out[storage] = {
+            "max_buffer_elems": peaks[storage],
+            "counts": v["counts"],
+            "per_axis_counts": v.get("per_axis_counts", {}),
+            "overlap_ok": v["overlap_ok"],
+        }
+    per_axis = out["sharded"]["per_axis_counts"]
+    gather_clean = all(
+        "all-gather" not in kinds
+        for label, kinds in per_axis.items() if label != flat_label)
+    out["param_gather_all_gathers"] = per_axis.get(flat_label, {}) \
+        .get("all-gather", 0)
+    out["no_full_param_set"] = bool(peaks["sharded"] < total_elems)
+    out["no_stacked_param_buffer"] = bool(
+        peaks["sharded"] < largest_stacked)
+    out["peak_reduced"] = bool(peaks["sharded"] < peaks["replicated"])
+    out["param_storage_ok"] = bool(
+        out["no_full_param_set"] and out["no_stacked_param_buffer"]
+        and out["peak_reduced"] and gather_clean
+        and out["param_gather_all_gathers"] >= 1
+        and "other" not in per_axis)
+    return out
+
+
 def _main():
     out = {"sharded_scan_parity": parity_probe()}
     if "--multichip" in sys.argv:
@@ -227,6 +327,11 @@ def _main():
             except Exception as e:   # a probe failure must not eat the
                 out[key] = {"error":  # baseline overlap verdict
                             f"{type(e).__name__}: {e}"[:300]}
+        try:                         # ISSUE 11 storage receipts
+            out["param_storage"] = param_storage_probe()
+        except Exception as e:
+            out["param_storage"] = {"error":
+                                    f"{type(e).__name__}: {e}"[:300]}
     print(json.dumps(out))
 
 

@@ -1,0 +1,275 @@
+"""Hermetic selftest for SHARDED PARAMETER STORAGE (ISSUE 11).
+
+Run under a cpu-forced env (bench.py's stripped subprocess /
+tools/cpu_env.sh) with an 8-virtual-device host platform:
+
+    python -m paddle_tpu.jit.sharded_storage_selftest
+
+One process, one JSON line. Asserts the ISSUE 11 acceptance triangle:
+
+* **bit-parity**: the sharded-storage step's loss trajectory AND final
+  params match the replicated-storage step on dp8, dp4×mp2 and dp2×pp2
+  host meshes (measured 0.0 — the shards hold exactly the bytes the
+  replicated stacks would; gate 1e-6);
+* **live 1/N shards**: the param flat buckets live as N addressable
+  shards of 1/N each, and the compiled-HLO probe certifies no
+  full-parameter-set (or even single-stacked-leaf-sized) buffer exists
+  in the sharded program while its peak buffer is strictly below the
+  replicated program's;
+* **checkpoint resharding**: a dp8-saved checkpoint restores onto a
+  dp4 step (different mesh shape, different flat pad length) and the
+  resumed trajectory matches an uninterrupted run;
+* **quantized multi-axis legs**: the int8 scatter AND gather wire
+  formats over a flattened (dp, mp) axis tuple hold the comm_quant
+  rel-err bound;
+* **dropout under pp**: the per-(micro, stage) PRNG offset scheme is
+  deterministic, finite, and actually applies masks;
+* **compile counts**: 1 executable per step signature;
+* a host-mesh tok/s A/B (informational on CPU — the structural point
+  is that the sharded program stays within a few percent; chip numbers
+  land via bench --multichip).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+TOL = {
+    "loss_parity": 1e-6,     # sharded vs replicated, same mesh
+    "resume": 5e-4,          # across a dp8 -> dp4 mesh change
+    "quant_rel": 1e-2,
+}
+
+TINY = dict(vocab_size=92, hidden_size=36, num_layers=4,
+            num_attention_heads=2, max_position_embeddings=16,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+def _batch(bs, seq=12, vocab=92, seed=0):
+    import paddle_tpu as paddle
+
+    rng = np.random.default_rng(seed)
+    return (paddle.to_tensor(rng.integers(0, vocab, (bs, seq)),
+                             dtype="int64"),
+            paddle.to_tensor(rng.integers(0, vocab, (bs, seq)),
+                             dtype="int64"))
+
+
+def storage_probe(n_devices=8, steps=4, lr=1e-2, clip_norm=0.05,
+                  seed=0):
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.jit import ShardedFusedScanTrainStep
+    from paddle_tpu.jit.pipeline_step import PipelineScanTrainStep
+    from paddle_tpu.models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    devs = jax.devices("cpu")[:n_devices]
+    if len(devs) < n_devices:
+        return {"check": f"FAIL: {len(devs)} cpu devices < {n_devices}"}
+    ids, labels = _batch(bs=n_devices, vocab=TINY["vocab_size"],
+                         seed=seed)
+
+    def build(kind, storage, nd=n_devices, seed_=seed, cfg_over=None):
+        cfg = GPTConfig(**{**TINY, **(cfg_over or {})},
+                        scan_layers=True)
+        paddle.seed(seed_)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=lr,
+                         parameters=model.parameters(),
+                         grad_clip=nn.ClipGradByGlobalNorm(clip_norm))
+        crit = GPTPretrainingCriterion()
+        if kind == "dp":
+            mesh = Mesh(np.asarray(devs[:nd]), ("sharding",))
+            denv.set_mesh(mesh)
+            step = ShardedFusedScanTrainStep(
+                model, opt, criterion=crit, mesh=mesh, axis="sharding",
+                param_storage=storage)
+        elif kind == "dpmp":
+            mesh = Mesh(np.asarray(devs).reshape(4, 2), ("dp", "mp"))
+            denv.set_mesh(mesh)
+            step = ShardedFusedScanTrainStep(
+                model, opt, criterion=crit, mesh=mesh, axis="dp",
+                mp_axis="mp", param_storage=storage)
+        else:  # dppp
+            mesh = denv.build_mesh({"dp": 2, "pp": 2},
+                                   devices=devs[:4])
+            denv.set_mesh(mesh)
+            step = PipelineScanTrainStep(
+                model, opt, criterion=crit, mesh=mesh, axis="dp",
+                pp_axis="pp", num_micro=2, param_storage=storage)
+        return model, opt, step
+
+    def run(kind, storage, nsteps=steps, cfg_over=None, seed_=seed):
+        model, opt, step = build(kind, storage, cfg_over=cfg_over,
+                                 seed_=seed_)
+        t0 = time.perf_counter()
+        losses = [float(step(ids, labels)) for _ in range(nsteps)]
+        wall = time.perf_counter() - t0
+        return losses, model, step, wall
+
+    out = {"n_devices": n_devices, "steps": steps,
+           "tolerances": TOL}
+
+    # ---- 1. bit-parity sharded vs replicated per mesh family
+    parity_ok = True
+    for kind in ("dp", "dpmp", "dppp"):
+        rep, m_rep, _, _ = run(kind, "replicated")
+        sh, m_sh, st, _ = run(kind, "sharded")
+        ldiff = max(abs(a - b) for a, b in zip(rep, sh))
+        pdiff = max(
+            float(np.max(np.abs(
+                np.asarray(p1._data, np.float32)
+                - np.asarray(p2._data, np.float32))))
+            for (_, p1), (_, p2) in zip(m_rep.named_parameters(),
+                                        m_sh.named_parameters()))
+        compiles = (st._jitted._cache_size()
+                    if hasattr(st._jitted, "_cache_size") else 1)
+        out[f"parity_{kind}"] = {
+            "max_abs_loss_diff": ldiff, "max_abs_param_diff": pdiff,
+            "compile_count": compiles}
+        parity_ok &= (ldiff <= TOL["loss_parity"]
+                      and pdiff <= TOL["loss_parity"]
+                      and compiles == 1)
+
+    # ---- 2. live 1/N shard shapes + the compiled-HLO liveness receipt
+    _, _, st, _ = run("dp", "sharded", nsteps=1)
+    fp = st._param_shards["s"][0]
+    shards_ok = (len(fp.addressable_shards) == n_devices
+                 and fp.addressable_shards[0].data.shape[-1]
+                 * n_devices == fp.shape[-1])
+    out["param_shard_flat_shape"] = list(fp.shape)
+    out["param_shard_local"] = list(
+        fp.addressable_shards[0].data.shape)
+    from .sharded_scan_selftest import param_storage_probe
+
+    hlo_ok = True
+    for cfg_name, kw in (("dp8", {}), ("dp4xmp2", {"mp": 2}),
+                         ("dp4xpp2", {"pp": 2})):
+        hlo = param_storage_probe(n_devices=n_devices, **kw)
+        out[f"hlo_receipt_{cfg_name}"] = {
+            **{k: hlo[k] for k in ("no_full_param_set",
+                                   "no_stacked_param_buffer",
+                                   "peak_reduced",
+                                   "param_gather_all_gathers",
+                                   "param_storage_ok")},
+            "max_buffer_elems": {
+                "sharded": hlo["sharded"]["max_buffer_elems"],
+                "replicated": hlo["replicated"]["max_buffer_elems"]},
+        }
+        hlo_ok &= hlo["param_storage_ok"]
+
+    # ---- 3. checkpoint round-trip onto a DIFFERENT mesh shape
+    from paddle_tpu.distributed.checkpoint.manager import (
+        CheckpointManager,
+    )
+
+    model, opt, step = build("dp", "sharded")
+    straight = [float(step(ids, labels)) for _ in range(4)]
+    model, opt, step = build("dp", "sharded")
+    part1 = [float(step(ids, labels)) for _ in range(2)]
+    tmp = tempfile.mkdtemp(prefix="sharded_storage_ck_")
+    CheckpointManager(tmp, model=model, optimizer=opt).save(1)
+    model2, opt2, step2 = build("dp", "sharded", nd=4, seed_=99)
+    step2.ensure_built()
+    restored = CheckpointManager(tmp, model=model2,
+                                 optimizer=opt2).restore_or_init()
+    part2 = [float(step2(ids, labels)) for _ in range(2)]
+    resume_diff = max(abs(a - b)
+                      for a, b in zip(straight, part1 + part2))
+    out["reshard_restore"] = {
+        "restored_step": restored, "from_devices": n_devices,
+        "to_devices": 4, "max_abs_loss_diff": resume_diff}
+    reshard_ok = restored == 1 and resume_diff <= TOL["resume"]
+
+    # ---- 4. quantized multi-axis scatter + gather legs
+    from jax.sharding import Mesh as _Mesh
+    from paddle_tpu.distributed.collective import (
+        comm_quant_multiaxis_selftest,
+    )
+
+    qmesh = _Mesh(np.asarray(devs).reshape(4, 2), ("dp", "mp"))
+    denv.set_mesh(qmesh)
+    quant = comm_quant_multiaxis_selftest(qformat="int8", mesh=qmesh,
+                                          axes=("dp", "mp"))
+    out["comm_quant_multiaxis"] = quant
+    quant_ok = quant["pass"]
+
+    # ---- 5. dropout under pp: deterministic, finite, masks applied
+    d1, _, _, _ = run("dppp", "sharded",
+                      cfg_over=dict(hidden_dropout_prob=0.1))
+    d2, _, _, _ = run("dppp", "sharded",
+                      cfg_over=dict(hidden_dropout_prob=0.1))
+    base, _, _, _ = run("dppp", "sharded")
+    drop_ok = (d1 == d2 and bool(np.isfinite(d1).all())
+               and d1 != base)
+    out["pp_dropout"] = {"deterministic": d1 == d2,
+                         "distinct_from_p0": d1 != base}
+
+    # ---- 6. host-mesh steady-state step-time A/B (informational on
+    # CPU: the emulated mesh serializes the gathers a real chip's
+    # latency-hiding scheduler overlaps — chip numbers land via bench
+    # --multichip)
+    # a config with a training-realistic compute/param-bytes ratio
+    # (the TINY parity config is all gather, no compute — it would
+    # measure pure collective overhead, which is exactly what real
+    # chips hide); min-of-reps timing de-noises the throttled
+    # container (the input_pipeline selftest's calibration pattern)
+    ab_cfg = dict(TINY, hidden_size=64, num_layers=8,
+                  max_position_embeddings=256)
+    ab_ids, ab_labels = _batch(bs=2 * n_devices, seq=256,
+                               vocab=TINY["vocab_size"], seed=seed)
+
+    def steady(storage, reps=5):
+        cfg = GPTConfig(**{**TINY, **ab_cfg}, scan_layers=True)
+        paddle.seed(seed)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=lr,
+                         parameters=model.parameters())
+        mesh = Mesh(np.asarray(devs), ("sharding",))
+        denv.set_mesh(mesh)
+        step = ShardedFusedScanTrainStep(
+            model, opt, criterion=GPTPretrainingCriterion(),
+            mesh=mesh, axis="sharding", param_storage=storage,
+            scan_unroll=2)
+        float(step(ab_ids, ab_labels))        # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(step(ab_ids, ab_labels))    # loss read = step sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    w_rep = steady("replicated")
+    w_sh = steady("sharded")
+    tokens = int(np.prod(ab_ids.shape))
+    out["host_step_ms"] = {"replicated": round(w_rep * 1e3, 2),
+                           "sharded": round(w_sh * 1e3, 2),
+                           "ratio": round(w_sh / max(w_rep, 1e-9), 3),
+                           "tok_s_replicated": round(tokens / w_rep),
+                           "tok_s_sharded": round(tokens / w_sh)}
+
+    ok = (parity_ok and shards_ok and hlo_ok and reshard_ok
+          and quant_ok and drop_ok)
+    out["check"] = "pass" if ok else (
+        f"FAIL: parity={parity_ok} shards={shards_ok} hlo={hlo_ok} "
+        f"reshard={reshard_ok} quant={quant_ok} dropout={drop_ok}")
+    return out
+
+
+def _main():
+    print(json.dumps({"sharded_storage": storage_probe()}))
+
+
+if __name__ == "__main__":
+    _main()
+    sys.exit(0)

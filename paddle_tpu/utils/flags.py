@@ -96,6 +96,15 @@ define_flag("FLAGS_comm_quant", "",
             "scales on both the scatter and gather legs, ~4x less ICI "
             "bytes) or 'bf16' (~2x); '' (default) keeps full-precision "
             "payloads. Accumulation is fp32 in every mode")
+define_flag("FLAGS_param_storage", "",
+            "parameter storage format of the sharded fused-scan train "
+            "steps: 'sharded' (default when empty — params live as 1/N "
+            "flat bucket shards, gathered on use inside the scans with "
+            "double-buffered prefetch, ~param_bytes/param less "
+            "steady-state HBM per device) or 'replicated' (the pre-"
+            "ISSUE-11 layout: full per-leaf stacks on every device, the "
+            "bit-parity reference). Per-step override: "
+            "ShardedFusedScanTrainStep(param_storage=...)")
 define_flag("FLAGS_splash_attn", True,
             "route training attention (causal/plain, no mask, no "
             "dropout) through the splash Pallas kernel "

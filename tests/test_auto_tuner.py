@@ -69,7 +69,7 @@ class TestAutoTuner:
         c = Candidate(dp=2, mp=2, pp=2, sharding_stage=1, micro_batch=4)
         hc = c.hybrid_configs()
         assert hc == {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
-                      "sep_degree": 1,
+                      "sep_degree": 1, "ep_degree": 1,
                       "sharding_degree": 2}
 
 

@@ -590,6 +590,8 @@ def _poison_wte(model, row=5):
 class _GuardScanMixin:
     def _run_nan_injection(self, step, model, sc, ids, labels,
                            wte_index):
+        path, flat_range = (wte_index if isinstance(wte_index, tuple)
+                            else (wte_index, None))
         for _ in range(2):
             step(ids, labels)
         before = _state_snapshot(step)
@@ -605,10 +607,21 @@ class _GuardScanMixin:
                 continue
             if not isinstance(vb, np.ndarray):
                 continue
-            if name == wte_index:
-                mask = np.ones(vb.shape[0], bool)
-                mask[row] = False
-                assert np.array_equal(vb[mask], va[mask]), name
+            if name == path:
+                if flat_range is None:
+                    mask = np.ones(vb.shape[0], bool)
+                    mask[row] = False
+                    assert np.array_equal(vb[mask], va[mask]), name
+                else:
+                    # sharded param storage: the poisoned wte row lives
+                    # at its flat-bucket offset range inside the o fp
+                    # shard array; everything outside it must pass
+                    # through bit-identical on the bad step
+                    lo, hi = flat_range(row)
+                    mask = np.ones(vb.shape[-1], bool)
+                    mask[lo:hi] = False
+                    assert np.array_equal(vb[..., mask],
+                                          va[..., mask]), name
             else:
                 assert np.array_equal(vb, va, equal_nan=True), \
                     f"{name} changed on a bad step"
@@ -623,10 +636,19 @@ class _GuardScanMixin:
         assert np.isfinite(float(l))
 
     def _wte_state_index(self, step, model):
-        """Path string of the wte weight's leaf in _extract_state."""
+        """Locator of the wte weight's leaf in _extract_state: the
+        plain state path for per-leaf storage, or (fp-bucket path,
+        row -> flat range fn) when the step stores params as 1/N flat
+        bucket shards (ISSUE 11)."""
         wte = model.gpt.wte.weight
         for j, (_, p) in enumerate(step._o_params):
             if p is wte:
+                if getattr(step, "_param_storage", None) == "sharded":
+                    bkt, e = step._o_assign.bucket_of(j)
+                    h = int(wte.shape[1])
+                    return (f"['o']['fp'][{bkt.index}]",
+                            lambda row, off=e.offset, h=h:
+                            (off + row * h, off + (row + 1) * h))
                 return f"['o']['p'][{j}]"
         raise AssertionError("wte not in outer params")
 

@@ -341,8 +341,10 @@ def test_pipeline_rejects_bad_configs():
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     opt = popt.AdamW(learning_rate=1e-2, parameters=model.parameters())
-    with pytest.raises(ValueError, match="dropout"):
-        PipelineScanTrainStep(model, opt, mesh=mesh, num_micro=2)
+    # dropout under pp is LEGAL since ISSUE 11 (per-(micro, stage) PRNG
+    # offsets) — construction must succeed; the determinism/grad tests
+    # live in tests/test_sharded_storage.py
+    PipelineScanTrainStep(model, opt, mesh=mesh, num_micro=2)
     mesh3 = denv.build_mesh({"dp": 2, "pp": 3}, devices=devs[:6])
     denv.set_mesh(mesh3)
     cfg2 = GPTConfig(**TINY, scan_layers=True)

@@ -38,13 +38,18 @@ Standalone:
     python tools/hlo_overlap.py <hlo_text_file> [--assert-overlap]
     python tools/hlo_overlap.py --probe [--assert-overlap]
     python tools/hlo_overlap.py --probe-ep
+    python tools/hlo_overlap.py --probe-param-gather [--mp 2 | --pp 2]
 `--probe` builds the sharded fused-scan train step on the host mesh
 (requires JAX_PLATFORMS=cpu + xla_force_host_platform_device_count, the
 bench.py _run_cpu_probe env) and analyzes its compiled HLO; `--probe-ep`
 builds the dp4×ep2 expert-parallel MoE variant and reports the ep-axis
-all-to-all census. Invoked by `bench.py --multichip` via
-paddle_tpu.jit.sharded_scan_selftest; the verdicts land in
-MULTICHIP_r*.json.
+all-to-all census. `--probe-param-gather` (ISSUE 11) compiles the step
+under BOTH parameter-storage formats, classifies the param-gather
+all-gathers per mesh axis, and checks the sharded-storage liveness
+receipts: no full-parameter-set buffer, no stacked-leaf-sized buffer,
+peak buffer strictly below the replicated program's. Invoked by
+`bench.py --multichip` via paddle_tpu.jit.sharded_scan_selftest; the
+verdicts land in MULTICHIP_r*.json / BENCH_r*.json.
 """
 from __future__ import annotations
 
@@ -310,6 +315,30 @@ def _build_probe_hlo():
 def main(argv):
     do_assert = "--assert-overlap" in argv
     argv = [a for a in argv if a != "--assert-overlap"]
+    if "--probe-param-gather" in argv:
+        # ISSUE 11: sharded-vs-replicated parameter storage receipts —
+        # per-axis param-gather census + compiled-buffer liveness bounds
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from paddle_tpu.jit.sharded_scan_selftest import (
+            param_storage_probe,
+        )
+
+        def flag(name):
+            if name in argv:
+                return int(argv[argv.index(name) + 1])
+            return 1
+
+        verdict = param_storage_probe(mp=flag("--mp"), pp=flag("--pp"))
+        print(json.dumps(verdict))
+        if do_assert and not verdict.get("param_storage_ok"):
+            raise AssertionError(
+                f"param-storage receipt failed: {verdict}")
+        return 0
     if "--probe-ep" in argv:
         # dp4×ep2 MoE probe: per-axis census incl. the ep all-to-alls
         import os
