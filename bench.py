@@ -161,15 +161,49 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
                    rng.integers(0, cfg.vocab_size, (batch, seq),
                                 dtype=np.int64))
 
+    # per-step timeline artifact (ISSUE 12): one JSONL record per
+    # measured step under .bench_live/ — host_ms is the host-loop
+    # dispatch interval (dispatch is async; the aggregate wall time
+    # below is the throughput truth, the timeline shows its shape)
+    from paddle_tpu.observability import JsonlSink, StepTimeline
+
+    os.makedirs(_LIVE_DIR, exist_ok=True)
+    tl_path = os.path.join(_LIVE_DIR, f"timeline_{model_name}.jsonl")
+    open(tl_path, "w").close()          # fresh artifact per run
+    tl = StepTimeline(sinks=[JsonlSink(tl_path)], lane="train")
+
     pf = step.prefetch(host_batches(), depth=2)
     t0 = time.perf_counter()
-    for ids_b, labels_b in pf:
+    t_prev = t0
+    for i, (ids_b, labels_b) in enumerate(pf):
         loss = step(ids_b, labels_b)
+        now = time.perf_counter()
+        tl.record(step=i, host_ms=round((now - t_prev) * 1e3, 3))
+        t_prev = now
     jax.block_until_ready(loss._data)
     dt = time.perf_counter() - t0
+    tl.record(step=steps, wall_s=round(dt, 3),
+              tok_s=round(batch * seq * steps / dt, 1))
+    tl.close()
     pf_stats = pf.get_stats()
 
     tokens_per_sec = batch * seq * steps / dt
+
+    # HLO-derived accounting (ISSUE 12): ask the COMPILER what the step
+    # actually executes — cost-analysis flops (vs the analytic 6N
+    # model) and the per-mesh-axis collective byte census. AOT
+    # lower+compile of the already-compiled program: the persistent
+    # compile cache makes this cheap; a failure must not eat the
+    # measured number.
+    hlo_costs = None
+    if os.environ.get("BENCH_COST_ANALYSIS", "1") == "1":
+        try:
+            t_ca = time.perf_counter()
+            hlo_costs = step.cost_analysis(ids, labels)
+            hlo_costs["lower_compile_s"] = round(
+                time.perf_counter() - t_ca, 1)
+        except Exception as e:
+            hlo_costs = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     # MFU: model flops per token = 6N (fwd+bwd matmuls) + attention
     # 12*L*h*s (QK^T + PV, fwd+bwd, causal ~halves but count full per
@@ -203,12 +237,32 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
     peak = next((v for k, v in peaks.items() if gen.startswith(k)), 197e12)
     mfu = tokens_per_sec * flops_per_token / peak
+    # cost-analysis MFU (ISSUE 12): same tok/s, flops-per-token taken
+    # from compiled.cost_analysis() instead of the analytic 6N model
+    mfu_ca = None
+    if hlo_costs and hlo_costs.get("flops_per_step"):
+        mfu_ca = round(tokens_per_sec * hlo_costs["flops_per_step"]
+                       / (batch * seq) / peak, 4)
+    coll = (hlo_costs or {}).get("collectives") or {}
     return {
         "metric": f"{model_name}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": None,
         "mfu": round(mfu, 4),
+        "mfu_cost_analysis": mfu_ca,
+        "cost_analysis": (None if hlo_costs is None else {
+            "flops_per_step": hlo_costs.get("flops_per_step"),
+            "bytes_accessed_per_step": hlo_costs.get(
+                "bytes_accessed_per_step"),
+            "comm_bytes_per_step": coll.get("total_comm_bytes", 0),
+            "comm_bytes_per_axis": coll.get("per_axis_bytes", {}),
+            "lower_compile_s": hlo_costs.get("lower_compile_s"),
+            "error": hlo_costs.get("error"),
+        }),
+        "timeline": {"path": os.path.relpath(
+            tl_path, os.path.dirname(os.path.abspath(__file__))),
+            "steps": steps},
         "input_pipeline": {
             "input_stall_ms": pf_stats["input_stall_ms"]["mean"],
             "h2d_ms": pf_stats["h2d_ms"]["mean"],
@@ -679,6 +733,20 @@ def run_selftest():
         assert lane.get("check") == "pass", lane
         results["sharded_storage_detail"] = lane
 
+    def observability():
+        # ISSUE 12: unified telemetry — measured registry/sentinel
+        # overhead <= 1% of step time, the retrace sentinel attributes
+        # a deliberately injected dtype flip (naming the leaf) on all
+        # three train-step paths with strict mode raising, timeline
+        # JSONL schema round-trips, Prometheus exposition parses, and
+        # the instrumented steps stay at 1 executable with no host
+        # transfer ops (the PR-4 probe pattern)
+        rec = _run_cpu_probe("paddle_tpu.observability.selftest",
+                             timeout=900)
+        lane = rec.get("observability", {})
+        assert lane.get("check") == "pass", lane
+        results["observability_detail"] = lane
+
     def serving():
         # ISSUE 6: continuous-batching serving tier — Poisson arrivals
         # on a tiny model: per-request token parity vs generate(),
@@ -703,6 +771,7 @@ def run_selftest():
     check("fault_tolerance", fault_tolerance)
     check("input_pipeline", input_pipeline)
     check("serving", serving)
+    check("observability", observability)
     check("training_kernels", training_kernels)
     check("distributed_linalg", distributed_linalg)
     check("moe", moe)
@@ -1151,6 +1220,14 @@ if __name__ == "__main__":
         # min-of-reps step-time A/B — hermetic CPU subprocess
         print(json.dumps(_run_cpu_probe(
             "paddle_tpu.jit.sharded_storage_selftest", timeout=900)))
+    elif "--observability" in sys.argv:
+        # OBSERVABILITY lane (ISSUE 12): registry overhead bound,
+        # retrace-sentinel attribution of an injected dtype flip on all
+        # three train-step paths (strict), timeline JSONL schema
+        # round-trip, Prometheus scrape format, zero added
+        # retraces/host transfers — hermetic CPU subprocess
+        print(json.dumps(_run_cpu_probe(
+            "paddle_tpu.observability.selftest", timeout=900)))
     elif "--training-kernels" in sys.argv:
         # TRAINING-KERNELS lane (ISSUE 7): splash attention + fused CE
         # interpret-mode parity (fwd+bwd, segment masks), scan-step

@@ -106,6 +106,7 @@ from .utils.flags import get_flags, set_flags  # noqa: E402,F401
 from .distributed import DataParallel  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
+from . import observability  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401

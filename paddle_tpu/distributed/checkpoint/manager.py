@@ -166,6 +166,19 @@ class CheckpointManager:
             blocked_s = time.perf_counter() - t0
         self.last_timings.update(
             {"snapshot_s": snap_s, "blocked_s": blocked_s})
+        # unified telemetry (ISSUE 12): save timings land in the
+        # process-global registry (host-side floats, no device reads)
+        try:
+            from ...observability import registry as _obs
+
+            reg = _obs()
+            reg.counter("checkpoint.saves").inc()
+            reg.histogram("checkpoint.snapshot_ms").observe(
+                snap_s * 1e3)
+            reg.histogram("checkpoint.blocked_ms").observe(
+                blocked_s * 1e3)
+        except Exception:
+            pass
 
     def wait(self) -> None:
         """Join any in-flight async save; re-raise its failure."""
@@ -223,6 +236,13 @@ class CheckpointManager:
                 barrier()                  # nobody trusts step_K early
             self.last_saved_step = step
             self.last_timings["io_s"] = time.perf_counter() - t0
+            try:
+                from ...observability import registry as _obs
+
+                _obs().histogram("checkpoint.io_ms").observe(
+                    self.last_timings["io_s"] * 1e3)
+            except Exception:
+                pass
             self._gc()
         finally:
             self._inflight_tmp = None

@@ -193,9 +193,11 @@ class GradBucketer:
             return 0
         sharding = NamedSharding(self._mesh, P(self._axis))
         issued = 0
+        issued_bytes = 0
         for bucket in self.assignment.buckets:
             if not any(k in self._pending for k in bucket.keys):
                 continue
+            issued_bytes += bucket.nbytes
             flat = _flatten_bucket(
                 bucket, lambda k: (self._params[k].grad._data
                                    if self._params[k].grad is not None
@@ -217,6 +219,19 @@ class GradBucketer:
 
             _scatter_back(bucket, flat, write)
         self._pending.clear()
+        # unified telemetry (ISSUE 12): payload bytes per collective
+        # leg. Under trace this runs ONCE (the collectives are baked
+        # into the compiled step), so the per-step budget is published
+        # as a gauge rather than a counter
+        try:
+            from ..observability import registry as _obs
+
+            reg = _obs()
+            reg.counter("comm.bucket_syncs").inc(issued)
+            reg.counter("comm.bucket_sync_bytes").inc(issued_bytes)
+            reg.gauge("comm.bucket_bytes_per_step").set(issued_bytes)
+        except Exception:
+            pass
         return issued
 
 

@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..framework.tensor import Tensor
+from ..observability import registry as _obs_registry
 from ..profiler import RecordEvent
 
 __all__ = ["DevicePrefetcher"]
@@ -257,6 +258,9 @@ class DevicePrefetcher:
                 del self._h2d_ms[: -self._stats_window]
             self._h2d_total += ms
             self._h2d_count += 1
+        # unified telemetry (ISSUE 12): the same sample lands in the
+        # process-global registry so scrapes/timelines see input health
+        _obs_registry().histogram("input.h2d_ms").observe(ms)
 
     def _note_stall(self, ms):
         with self._lock:
@@ -265,6 +269,7 @@ class DevicePrefetcher:
                 del self._stall_ms[: -self._stats_window]
             self._stall_total += ms
             self._stall_count += 1
+        _obs_registry().histogram("input.stall_ms").observe(ms)
 
     def reset_stats(self):
         with self._lock:

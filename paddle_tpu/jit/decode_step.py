@@ -39,6 +39,7 @@ import numpy as np
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
 from ..nn.functional.sampling import sample_logits, sample_logits_per_slot
+from ..observability import RetraceSentinel
 from .train_step import _tree_data, _tree_wrap
 
 __all__ = ["GenerationEngine", "DecodeStep", "PrefillStep",
@@ -75,6 +76,11 @@ class _Step:
     # their meta leaves are already call-to-call consistent, and the
     # pull-down would serialize decode dispatch per token.
     _pin_meta_host = False
+    # sentinel config (ISSUE 12): argument names for attribution, and
+    # the args whose SHAPE legitimately varies (prefill length buckets
+    # — one expected executable per bucket)
+    _arg_names = ()
+    _bucketed_args = ()
 
     def __init__(self, engine, donate_cache):
         self.engine = engine
@@ -85,9 +91,16 @@ class _Step:
                         and not _legacy_jax())
         self._jitted = None
         self.trace_count = 0   # traces when compiled, calls when eager
+        self._sentinel = RetraceSentinel(type(self).__name__,
+                                         bucketed=self._bucketed_args)
 
     def _fn(self, *args):
         raise NotImplementedError
+
+    def retrace_stats(self):
+        """Sentinel receipt: distinct signatures (= expected compiles),
+        cache hits, and attributed unexpected recompiles."""
+        return self._sentinel.stats()
 
     def cache_size(self):
         """Number of compiled executables (jax.jit's cache), -1 when the
@@ -125,6 +138,10 @@ class _Step:
         if self._pin_meta_host:
             args = list(args)
             args[2] = {k: np.asarray(v) for k, v in args[2].items()}
+        # the exact post-pinning call args — a numpy/device mix drift
+        # in the metadata (the PR-6 silent-recompile class) shows up
+        # here as an attributed placement/kind change
+        self._sentinel.observe(tuple(args), names=self._arg_names)
         return self._jitted(*args)
 
     # -- shared step body helpers ---------------------------------------
@@ -176,6 +193,10 @@ class _BindCtx:
 class PrefillStep(_Step):
     """Bucketed prompt pass: write all layers' K/V, sample token 0."""
 
+    _arg_names = ("params", "buffers", "meta", "ids", "lens",
+                  "slot_ids", "key")
+    _bucketed_args = ("ids",)
+
     def _fn(self, params, buffers, meta, ids, lens, slot_ids, key):
         self.trace_count += 1
         eng = self.engine
@@ -208,6 +229,8 @@ class PrefillStep(_Step):
 
 class DecodeStep(_Step):
     """One-token cached decode step — compiled once, donated KV pools."""
+
+    _arg_names = ("params", "buffers", "meta", "tokens", "key")
 
     def _fn(self, params, buffers, meta, tokens, key):
         self.trace_count += 1
@@ -262,6 +285,9 @@ class ChunkPrefillStep(_Step):
     — the host discards it otherwise. Paged cache only."""
 
     _pin_meta_host = True
+    _arg_names = ("params", "buffers", "meta", "ids", "slot_ids",
+                  "start", "lens_new", "seeds")
+    _bucketed_args = ("ids",)
 
     def _fn(self, params, buffers, meta, ids, slot_ids, start, lens_new,
             seeds):
@@ -313,6 +339,7 @@ class ServeDecodeStep(_Step):
     garbage."""
 
     _pin_meta_host = True
+    _arg_names = ("params", "buffers", "meta", "tokens", "seeds")
 
     def _fn(self, params, buffers, meta, tokens, seeds):
         self.trace_count += 1

@@ -48,6 +48,7 @@ from jax import lax
 from ..framework.tensor import Tensor
 from ..framework.autograd import no_grad
 from ..framework import random as _random
+from ..observability import RetraceSentinel
 from ..profiler import RecordEvent
 from .train_step import _commit_uncommitted
 
@@ -248,6 +249,11 @@ class FusedScanTrainStep:
                         "compute_dtype expects fp32-stored params (the "
                         f"param IS the master); got {p._data.dtype}")
         self._jitted = None
+        # retrace sentinel (ISSUE 12): the optional segment-id arg is a
+        # declared presence-varying signature (None and seg each
+        # compile once); anything else that recompiles is attributed
+        self._sentinel = RetraceSentinel(type(self).__name__,
+                                         optional=("segment_ids",))
         self._canon_done = False   # one-time layout canon at first call
         # adopt the optimizer's existing step count: continuing a run
         # that already trained under TrainStep must not reset the Adam
@@ -808,6 +814,35 @@ class FusedScanTrainStep:
             opt._get_accumulator("moment2", p, dtype=opt._moment_dtype)
         self._build()
 
+    # -- telemetry surface ----------------------------------------------
+    def retrace_stats(self):
+        """Sentinel receipt (see TrainStep.retrace_stats)."""
+        return self._sentinel.stats()
+
+    def _cost_axis_degrees(self):
+        """Mesh {axis: degree} for the per-axis comm census (None on a
+        single chip; the sharded subclass reports its mesh)."""
+        return None
+
+    def cost_analysis(self, ids, labels, segment_ids=None):
+        """HLO-derived per-step accounting: ``compiled.cost_analysis``
+        flops/bytes + per-mesh-axis collective byte census, published
+        as ``hlo.*`` registry gauges (ISSUE 12)."""
+        from ..observability.hlo_costs import cost_analysis_of
+
+        ids_d = ids._data if isinstance(ids, Tensor) else ids
+        lab_d = labels._data if isinstance(labels, Tensor) else labels
+        seg_d = (segment_ids._data if isinstance(segment_ids, Tensor)
+                 else segment_ids)
+        self.ensure_built()
+        self._pre_step()
+        state = self._extract_state()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        with self._step_guard():
+            return cost_analysis_of(
+                self._jitted, state, lr, ids_d, lab_d, seg_d,
+                axis_degrees=self._cost_axis_degrees())
+
     def __call__(self, ids, labels, segment_ids=None):
         ids_d = ids._data if isinstance(ids, Tensor) else ids
         lab_d = labels._data if isinstance(labels, Tensor) else labels
@@ -828,6 +863,9 @@ class FusedScanTrainStep:
             self._canon_done = True
         state = self._extract_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        self._sentinel.observe(
+            (state, lr, ids_d, lab_d, seg_d),
+            names=("state", "lr", "ids", "labels", "segment_ids"))
         with RecordEvent("FusedScanTrainStep"), self._step_guard():
             loss, new_state = self._jitted(state, lr, ids_d, lab_d,
                                            seg_d)

@@ -54,6 +54,12 @@ def hybrid_probe(n_devices=8, steps=4, lr=1e-2, clip_norm=0.05, seed=0):
     devs = jax.devices("cpu")[:n_devices]
     if len(devs) < n_devices:
         return {"check": f"FAIL: {len(devs)} cpu devices < {n_devices}"}
+    # ISSUE 12: the retrace sentinel runs STRICT for the whole lane —
+    # any unexpected recompile on any hybrid step path is a hard FAIL,
+    # proving the old hand-written compile-count probes are subsumed
+    from .. import observability as obs
+
+    obs.set_strict_retrace(True)
     crit = GPTPretrainingCriterion()
     rng = np.random.default_rng(seed)
     ids = paddle.to_tensor(
@@ -142,6 +148,15 @@ def hybrid_probe(n_devices=8, steps=4, lr=1e-2, clip_norm=0.05, seed=0):
         "compile_count_per_signature": compiles,
         "pipeline_schedule": bubble,
         "planner_pick": planner_pick,
+        "retrace_sentinel": {
+            "strict": obs.strict_retrace(),
+            "total_unexpected":
+                obs.retrace_summary()["total_unexpected"],
+            "dp4xmp2_signatures":
+                s_mp.retrace_stats()["signatures"],
+            "dp2xpp2_signatures":
+                s_pp.retrace_stats()["signatures"],
+        },
         "tolerances": TOL,
     }
 

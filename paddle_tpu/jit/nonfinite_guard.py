@@ -26,8 +26,52 @@ launched as separate ops.
 """
 from __future__ import annotations
 
+import weakref
+
 import jax
 import jax.numpy as jnp
+
+# telemetry publication (ISSUE 12): the registry gauges read whichever
+# guard wrote back MOST RECENTLY, through a weakref — a process-global
+# surface must not pin a superseded GuardSpec (and its device scalars)
+# alive for the process lifetime
+_live_guard_ref = None
+_gauges_registered = False
+
+
+def _live_guard():
+    return _live_guard_ref() if _live_guard_ref is not None else None
+
+
+def _register_guard_gauges():
+    global _gauges_registered
+    if _gauges_registered:
+        return
+    _gauges_registered = True
+    from ..observability import registry
+
+    reg = registry()
+
+    def scale():
+        g = _live_guard()
+        if g is None:
+            return None
+        return (float(g.scaler._scale) if g.scaler is not None
+                else 1.0)
+
+    def skipped():
+        g = _live_guard()
+        return None if g is None else int(jnp.asarray(g._skipped))
+
+    def found():
+        g = _live_guard()
+        if g is None or g.scaler is None:
+            return None
+        return bool(g.scaler._found_inf)
+
+    reg.gauge("train.loss_scale").set_fn(scale)
+    reg.gauge("train.guard_skipped_steps").set_fn(skipped)
+    reg.gauge("train.guard_last_found_inf").set_fn(found)
 
 
 def all_finite(leaves) -> jax.Array:
@@ -65,6 +109,11 @@ class GuardSpec:
         self.decr_ratio = float(s._decr_ratio) if s else 0.5
         self.incr_every_n = int(s._incr_every_n_steps) if s else 0
         self.decr_every_n = int(s._decr_every_n_nan_or_inf) if s else 1
+        # cumulative skipped-step count: a traced int32 riding the
+        # guard state (the scaler's good/bad counters RESET, so they
+        # cannot answer "how many steps did the guard eat") — stays on
+        # device between steps; read only when telemetry is scraped
+        self._skipped = 0
 
     # -- traced state ----------------------------------------------------
     def init_state(self):
@@ -85,26 +134,40 @@ class GuardSpec:
             "bad": dev(s._bad_steps if s else 0, jnp.int32),
             "found": dev(s._found_inf if s is not None else False,
                          jnp.bool_),
+            "skipped": dev(self._skipped, jnp.int32),
         }
 
     def writeback(self, gst):
         """Mirror the traced guard state back into the scaler as device
-        scalars (read lazily by state_dict/get_loss_scaling)."""
+        scalars (read lazily by state_dict/get_loss_scaling), keep the
+        cumulative skip counter, and publish the lazy telemetry gauges
+        (ISSUE 12: loss scale + guard skips — evaluated only at scrape
+        time, so no per-step host sync is ever added)."""
         if self.scaler is not None:
             self.scaler._scale = gst["scale"]
             self.scaler._good_steps = gst["good"]
             self.scaler._bad_steps = gst["bad"]
             self.scaler._found_inf = gst["found"]
+        if "skipped" in gst:
+            self._skipped = gst["skipped"]
+        global _live_guard_ref
+        try:
+            _live_guard_ref = weakref.ref(self)
+            _register_guard_gauges()
+        except Exception:
+            pass
 
     # -- traced update rule (the eager _update, word for word) ----------
     def update(self, gst, found_inf):
         scale, good, bad = gst["scale"], gst["good"], gst["bad"]
         found = jnp.asarray(found_inf, jnp.bool_)
+        skipped = (gst.get("skipped", jnp.int32(0))
+                   + found.astype(jnp.int32))
         if not self.use_dynamic:
             return {"scale": scale,
                     "good": jnp.where(found, 0, good + 1),
                     "bad": jnp.where(found, bad + 1, 0),
-                    "found": found}
+                    "found": found, "skipped": skipped}
         bad1 = bad + 1
         good1 = good + 1
         dec = bad1 >= self.decr_every_n
@@ -118,4 +181,4 @@ class GuardSpec:
         new_good = jnp.where(found, 0, jnp.where(inc, 0, good1))
         new_bad = jnp.where(found, jnp.where(dec, 0, bad1), 0)
         return {"scale": new_scale, "good": new_good, "bad": new_bad,
-                "found": found}
+                "found": found, "skipped": skipped}

@@ -128,6 +128,16 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                 "per-chunk aux-loss output (and expert all_to_alls "
                 "inside ring ticks are unvalidated) — train MoE models "
                 "on a dp or dp×ep mesh (ShardedFusedScanTrainStep)")
+        # observability (ISSUE 12): the analytic schedule accounting is
+        # static — publish it once so the bubble fraction rides every
+        # registry snapshot / Prometheus scrape
+        from ..observability import registry as _oreg
+
+        stats = self.schedule_stats()
+        reg = _oreg()
+        reg.gauge("pipeline.bubble_fraction").set(stats["bubble_ratio"])
+        reg.gauge("pipeline.num_micro").set(stats["num_micro"])
+        reg.gauge("pipeline.degree").set(stats["pp"])
 
     def _rng_rank(self):
         # the micro index is added per tick (see the ring body); this

@@ -471,6 +471,31 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             for grp, assign in (("s", self._s_assign),
                                 ("o", self._o_assign))}
 
+    def _cost_axis_degrees(self):
+        return {a: int(self._mesh.shape[a])
+                for a in self._mesh.axis_names}
+
+    def _publish_comm_gauges(self):
+        """Static comm-budget gauges (ISSUE 12): global payload bytes
+        per step of the grad reduce-scatter leg (every bucket, every
+        chunk) and — under sharded parameter storage — the param
+        all-gather leg, labeled with the reduction-axis tuple."""
+        from ..observability import registry as _oreg
+
+        s_bytes = sum(b.nbytes for b in self._s_assign.buckets) \
+            * self.model.config.num_layers
+        o_bytes = sum(b.nbytes for b in self._o_assign.buckets)
+        reg = _oreg()
+        axes = "+".join(self._axes)
+        reg.gauge("comm.grad_scatter_bytes_per_step").set(
+            s_bytes + o_bytes)
+        reg.gauge("comm.reduction_axes").set(axes)
+        if self._param_storage == "sharded":
+            # forward gather + backward re-gather + outer gather ≈ 2x
+            # the stacked payload + outer (update writes shards back)
+            reg.gauge("comm.param_gather_bytes_per_step").set(
+                2 * s_bytes + o_bytes)
+
     def _rng_rank(self):
         r = lax.axis_index(self._axis)
         if self._ep_axis is not None:
@@ -1002,6 +1027,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 lambda v: jax.device_put(v, rep),
                 self._guard.init_state()))
         self._build()
+        self._publish_comm_gauges()
 
     def _extract_state(self):
         opt = self._opt
@@ -1093,7 +1119,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             }
         if self._guard is not None:
             specs["guard"] = {"scale": rep, "good": rep, "bad": rep,
-                              "found": rep}
+                              "found": rep, "skipped": rep}
         for grp, assign in (("s", self._s_assign), ("o", self._o_assign)):
             sp = P(None, ax) if grp == "s" else P(ax)
             nb = len(assign.buckets)

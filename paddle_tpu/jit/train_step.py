@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..framework import random as _random
+from ..observability import RetraceSentinel
 from ..profiler import RecordEvent
 
 
@@ -123,6 +124,10 @@ class TrainStep:
         self._buffers = None
         self._jitted = None
         self._step_count = 0
+        # retrace sentinel (ISSUE 12): every dispatch records its
+        # abstract signature; an unexpected executable-cache miss is
+        # attributed to the argument leaf that changed
+        self._sentinel = RetraceSentinel(type(self).__name__)
         # donation is a pure perf lever (aliased state buffers) — on the
         # legacy jaxlib (0.4.x CPU) it CORRUPTS memory under conv-sized
         # programs on a host mesh (NaN losses, then hard aborts in later
@@ -387,6 +392,8 @@ class TrainStep:
             self._build(batch_data)
         state = self._extract_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        self._sentinel.observe((state, lr, batch_data),
+                               names=("state", "lr", "batch"))
         try:
             # comm watchdog (reference comm_task_manager.h:37): the dispatch
             # blocks when the device queue is full behind a dead collective,
@@ -409,6 +416,29 @@ class TrainStep:
         if hasattr(sched, "step"):
             sched.step()
         return Tensor._wrap(loss_data)
+
+    # -- telemetry surface ----------------------------------------------
+    def retrace_stats(self):
+        """The sentinel's receipt: {'signatures', 'calls', 'hits',
+        'unexpected', 'events'} — signatures is the trace/compile count
+        the old hand-written probes asserted on."""
+        return self._sentinel.stats()
+
+    def cost_analysis(self, *batch):
+        """HLO-derived per-step accounting (ISSUE 12): flops and bytes
+        per step from ``compiled.cost_analysis()`` plus the per-axis
+        collective byte census, published as ``hlo.*`` registry gauges.
+        Requires the step to have run (or at least traced) once."""
+        if self._jitted is None:
+            raise RuntimeError(
+                "cost_analysis needs a built step — call the step once "
+                "(or warm it up) first")
+        from ..observability.hlo_costs import cost_analysis_of
+
+        state = self._extract_state()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        return cost_analysis_of(self._jitted, state, lr,
+                                _tree_data(list(batch)))
 
     def _warmup_accumulators(self):
         """Complete the optimizer state pytree before tracing via the

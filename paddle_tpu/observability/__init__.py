@@ -1,0 +1,59 @@
+"""paddle.observability — unified runtime telemetry (ISSUE 12).
+
+One always-on, cheap, exportable telemetry layer across training and
+serving:
+
+- `MetricsRegistry` / `registry()` — process-global counters, gauges
+  and O(1) ring-buffer histograms with p50/p99; Prometheus text via
+  ``registry().expose()``. Every built-in producer (input prefetcher,
+  serving scheduler, non-finite guard, checkpoint manager, comm
+  bucketer, pipeline schedule) publishes here.
+- `StepTimeline` — one structured JSONL record per step through
+  pluggable sinks, mirrored into chrome-trace counter tracks that the
+  `paddle.profiler` export merges.
+- `RetraceSentinel` — wraps every jitted step path; an unexpected
+  recompile becomes one attributed log line naming the argument leaf
+  whose shape/dtype/weak-type/placement changed, and a hard error
+  under `set_strict_retrace(True)` (the selftest lanes).
+- `hlo_costs` — ``compiled.cost_analysis()`` flops/bytes per step and
+  the per-mesh-axis collective byte census, feeding cost-analysis MFU
+  into BENCH records.
+- `FlightRecorder` / `recorder()` — a bounded black box of recent
+  events dumped (with a registry snapshot) on crashes.
+
+Quickstart::
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+
+    tl = obs.StepTimeline(sinks=[obs.JsonlSink("steps.jsonl")])
+    for i, (ids, labels) in enumerate(loader):
+        t0 = time.perf_counter()
+        loss = step(ids, labels)
+        tl.record(step=i, host_ms=(time.perf_counter() - t0) * 1e3)
+    print(obs.registry().expose())        # Prometheus text
+    print(obs.retrace_summary())          # compile/retrace receipt
+"""
+from .flight_recorder import FlightRecorder, install, recorder  # noqa: F401
+from .hlo_costs import (  # noqa: F401
+    cost_analysis_of, load_hlo_overlap, summarize_compiled,
+)
+from .registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, percentile, registry,
+)
+from .sentinel import (  # noqa: F401
+    RetraceError, RetraceSentinel, enabled, retrace_summary,
+    set_strict_retrace, strict_retrace,
+)
+from .timeline import (  # noqa: F401
+    JsonlSink, StepTimeline, drain_chrome_counters, read_jsonl,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "percentile", "StepTimeline", "JsonlSink", "read_jsonl",
+    "drain_chrome_counters", "RetraceSentinel", "RetraceError",
+    "set_strict_retrace", "strict_retrace", "retrace_summary",
+    "enabled", "FlightRecorder", "recorder", "install",
+    "summarize_compiled", "cost_analysis_of", "load_hlo_overlap",
+]

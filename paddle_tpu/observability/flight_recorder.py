@@ -1,0 +1,125 @@
+"""Crash flight recorder: a bounded ring of recent runtime events plus
+a one-call crash dump.
+
+Training and serving both feed it for free (`StepTimeline.record`,
+retrace-sentinel events, `ServingEngine` recovery, checkpoint saves);
+on a crash — an uncaught exception once `install()` ran, or an explicit
+``dump()`` from a recovery path — the ring, the exception, and a full
+metrics-registry snapshot are written to one JSON file under
+``.flight_recorder/`` (override with PADDLE_FLIGHT_DIR). The file is
+what a postmortem needs: the last N steps' telemetry and what the
+counters said at the moment of death, without any always-on log volume.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+__all__ = ["FlightRecorder", "recorder", "install"]
+
+
+class FlightRecorder:
+    def __init__(self, capacity=512):
+        self._lock = threading.Lock()
+        self._events = collections.deque(maxlen=int(capacity))
+        self.last_dump_path = None
+
+    def note(self, kind, **fields):
+        """Append one event (O(1), bounded). Values should be JSON
+        scalars/short lists — this is a black box, not a log."""
+        ev = {"ts": round(time.time(), 6), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            self._events.append(ev)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def dump(self, reason="", exc=None, path=None) -> str:
+        """Write the black box to disk; returns the file path. Never
+        raises (a failing dump must not mask the original crash) —
+        returns None on failure."""
+        try:
+            from .registry import registry
+
+            rec = {
+                "reason": reason,
+                "ts": round(time.time(), 6),
+                "pid": os.getpid(),
+                "events": self.snapshot(),
+            }
+            if exc is not None:
+                rec["exception"] = {
+                    "type": type(exc).__name__,
+                    "message": str(exc)[:2000],
+                    "traceback": "".join(traceback.format_exception(
+                        type(exc), exc, exc.__traceback__))[-8000:],
+                }
+            try:
+                rec["metrics"] = registry().snapshot()
+            except Exception:
+                rec["metrics"] = {}
+            if path is None:
+                root = os.environ.get("PADDLE_FLIGHT_DIR",
+                                      ".flight_recorder")
+                os.makedirs(root, exist_ok=True)
+                path = os.path.join(
+                    root,
+                    f"crash_{os.getpid()}_{int(time.time() * 1e3)}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(rec, f, default=str)
+            os.replace(tmp, path)
+            self.last_dump_path = path
+            return path
+        except Exception:
+            return None
+
+
+_lock = threading.Lock()
+_recorder = None
+_installed = False
+_prev_hook = None
+
+
+def recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def install():
+    """Chain the flight recorder into ``sys.excepthook``: an uncaught
+    exception dumps the black box before the normal traceback prints.
+    Idempotent."""
+    global _installed, _prev_hook
+    with _lock:
+        if _installed:
+            return
+        _prev_hook = sys.excepthook
+        _installed = True
+
+    def hook(exc_type, exc, tb):
+        try:
+            e = exc if isinstance(exc, BaseException) else exc_type(exc)
+            if tb is not None and getattr(e, "__traceback__", None) is None:
+                e = e.with_traceback(tb)
+            recorder().dump(reason="uncaught exception", exc=e)
+        except Exception:
+            pass
+        (_prev_hook or sys.__excepthook__)(exc_type, exc, tb)
+
+    sys.excepthook = hook

@@ -1,0 +1,144 @@
+"""StepTimeline: one structured JSONL record per training/serving step.
+
+Every ``record()`` call emits a flat JSON object through the attached
+sinks — a JSONL file (`JsonlSink`), any callable, and (always) the
+chrome-trace counter-track buffer the `Profiler` export merges, so step
+metrics render as counter lanes under the host/device spans.
+
+Schema: every record carries ``ts`` (unix seconds), ``lane`` (e.g.
+"train"/"serve") and ``step`` (int); all other fields are free-form and
+should be JSON scalars (numeric fields become chrome counter tracks).
+``read_jsonl()`` is the matching loader the schema round-trip selftest
+uses.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+
+from .registry import registry as _registry
+from .sentinel import enabled
+
+__all__ = ["StepTimeline", "JsonlSink", "read_jsonl",
+           "drain_chrome_counters"]
+
+# chrome counter-track buffer (bounded): drained by
+# Profiler._finish_cycle into the exported trace
+_counter_events = collections.deque(maxlen=65536)
+_counter_lock = threading.Lock()
+
+
+def drain_chrome_counters():
+    """Pop all pending chrome-trace counter events ("ph": "C")."""
+    with _counter_lock:
+        out = list(_counter_events)
+        _counter_events.clear()
+    return out
+
+
+class JsonlSink:
+    """Append-a-line-per-record file sink (flushed per record so a
+    crash loses at most the in-flight line)."""
+
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a")
+
+    def __call__(self, record: dict):
+        line = json.dumps(record)
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def read_jsonl(path):
+    """Load a timeline JSONL file back into a list of dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+class StepTimeline:
+    """Per-step structured telemetry emitter.
+
+    Usage::
+
+        tl = StepTimeline(sinks=[JsonlSink(".bench_live/tl.jsonl")])
+        for i, batch in enumerate(loader):
+            t0 = time.perf_counter()
+            loss = step(*batch)
+            tl.record(step=i, host_ms=(time.perf_counter() - t0) * 1e3)
+
+    ``record`` also mirrors numeric fields into registry histograms
+    (``timeline.<lane>.<field>``) and the chrome counter-track buffer.
+    All host-side; never reads a device value.
+    """
+
+    def __init__(self, sinks=(), lane="train", registry=None,
+                 chrome_counters=True):
+        self.lane = lane
+        self.sinks = list(sinks)
+        self._registry = registry if registry is not None else _registry()
+        self._chrome = bool(chrome_counters)
+        self._step_auto = 0
+
+    def add_sink(self, sink):
+        self.sinks.append(sink)
+        return sink
+
+    def record(self, step=None, **fields) -> dict:
+        if not enabled():
+            return {}
+        if step is None:
+            step = self._step_auto
+        self._step_auto = int(step) + 1
+        rec = {"ts": round(time.time(), 6), "lane": self.lane,
+               "step": int(step)}
+        rec.update(fields)
+        for k, v in fields.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            self._registry.histogram(
+                f"timeline.{self.lane}.{k}").observe(v)
+            if self._chrome:
+                # perf_counter timebase: host spans in the Profiler
+                # export use perf_counter_ns/1e3 µs, and the counter
+                # tracks must land on the same axis
+                with _counter_lock:
+                    _counter_events.append({
+                        "name": f"{self.lane}/{k}", "ph": "C",
+                        "ts": time.perf_counter_ns() / 1e3, "pid": 0,
+                        "args": {k: v}})
+        for sink in self.sinks:
+            try:
+                sink(rec)
+            except Exception:
+                pass
+        try:
+            from .flight_recorder import recorder
+
+            recorder().note("step", lane=self.lane, step=int(step))
+        except Exception:
+            pass
+        return rec
+
+    def close(self):
+        for sink in self.sinks:
+            close = getattr(sink, "close", None)
+            if callable(close):
+                close()

@@ -62,6 +62,10 @@ class _ProfileResult:
     events: list = field(default_factory=list)
     steps: list = field(default_factory=list)  # (step_idx, start_ns, end_ns)
     device_trace_dir: Optional[str] = None
+    # chrome counter-track events ("ph": "C") drained from the
+    # observability StepTimeline at cycle end (ISSUE 12): step metrics
+    # render as counter lanes under the host spans
+    counters: list = field(default_factory=list)
 
     def chrome_trace(self) -> dict:
         evts = []
@@ -76,6 +80,7 @@ class _ProfileResult:
                 "name": f"ProfileStep#{idx}", "ph": "X", "cat": "step",
                 "ts": s / 1e3, "dur": (t - s) / 1e3, "pid": 0, "tid": 0,
             })
+        evts.extend(self.counters)
         return {"traceEvents": evts, "displayTimeUnit": "ms"}
 
 
@@ -311,9 +316,27 @@ class Profiler:
             self._step_start_ns = None
 
     def _finish_cycle(self):
+        events = _recorder.drain()
+        steps = list(self._steps)
+        try:
+            from ..observability import drain_chrome_counters
+
+            counters = drain_chrome_counters()
+            # the counter buffer is process-global and may hold a long
+            # backlog recorded before this profiling cycle (a timeline
+            # running with no Profiler active) — keep only events
+            # inside the cycle's host window (counter ts is µs on the
+            # same perf_counter timebase as the span ns timestamps)
+            lo = min([s for _, s, _ in steps]
+                     + [e.start_ns for e in events], default=None)
+            if lo is not None:
+                counters = [c for c in counters if c["ts"] * 1e3 >= lo]
+        except Exception:
+            counters = []
         self._last_result = _ProfileResult(
-            events=_recorder.drain(), steps=list(self._steps),
-            device_trace_dir=self._trace_dir if self._device_on else None)
+            events=events, steps=steps,
+            device_trace_dir=self._trace_dir if self._device_on else None,
+            counters=counters)
         self._steps = []
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -355,10 +378,27 @@ class Profiler:
 
 @contextlib.contextmanager
 def profile_step(name: str = "train_step"):
-    """Tiny convenience: time one span even with no Profiler active."""
+    """Tiny convenience: time one span even with no Profiler active.
+
+    The always-on path is the observability registry — the span lands
+    in the ``profile_step.<name>_ms`` histogram unconditionally
+    (previously the recorder dropped it whenever no Profiler cycle was
+    RECORDing, breaking this docstring's promise — ISSUE 12 satellite);
+    when a Profiler IS recording, the span also joins its host events.
+    """
     t0 = time.perf_counter_ns()
-    yield
-    _recorder.record(name, t0, time.perf_counter_ns())
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter_ns()
+        _recorder.record(name, t0, t1)
+        try:
+            from ..observability import registry
+
+            registry().histogram(
+                f"profile_step.{name}_ms").observe((t1 - t0) / 1e6)
+        except Exception:
+            pass
 
 
 class SortedKeys(enum.Enum):

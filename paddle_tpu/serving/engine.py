@@ -213,6 +213,17 @@ class ServingEngine:
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
 
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of this engine's metrics — the
+        scrape endpoint body (ISSUE 12): counters/gauges plus TTFT and
+        inter-token-latency summaries with p50/p90/p99 quantiles."""
+        return self.metrics.expose()
+
+    def retrace_stats(self) -> dict:
+        """Sentinel receipts for both serving step programs."""
+        return {"decode": self.decode_step.retrace_stats(),
+                "prefill": self.prefill_step.retrace_stats()}
+
     def reset_metrics(self):
         """Fresh counters (e.g. after a compile warmup run) — the bench
         lanes measure steady-state serving, not trace time."""
@@ -358,7 +369,14 @@ class ServingEngine:
 
     def _recover(self):
         """A failed step leaves donated buffers dead — rebuild the cache
-        pristine and requeue every resident request for resume."""
+        pristine and requeue every resident request for resume. The
+        flight recorder keeps the black box of what led here (ISSUE
+        12); the dump itself happens at the raise site/excepthook."""
+        from ..observability import recorder
+
+        recorder().note("serving_recover",
+                        running=len(self.scheduler.running),
+                        waiting=len(self.scheduler.waiting))
         self.scheduler.abort_all()
         self.cache = self._make_cache()
         self.scheduler.cache = self.cache

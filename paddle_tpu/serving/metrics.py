@@ -6,25 +6,36 @@ lane records (queue depth, running/waiting, per-request TTFT and
 inter-token latency percentiles, aggregate tok/s, preemption and
 page-reclaim counters). Everything is host-side and O(1) per event —
 no device sync is ever added for metrics.
+
+Since ISSUE 12 the percentile surface lives on the unified
+``observability`` layer: the latency samples are
+`observability.Histogram` ring buffers (ONE histogram implementation
+process-wide, `percentile` re-exported from there), every counter and
+gauge is registered in a per-engine `MetricsRegistry`, and
+``ServingEngine.metrics_text()`` renders that registry as Prometheus
+text exposition. Each engine gets its OWN registry so concurrent
+engines (tests run several) stay isolated; the engine-wide queue-depth
+/ running gauges are mirrored into the process-global registry too.
 """
 from __future__ import annotations
 
 import time
 
+from ..observability import MetricsRegistry, percentile
+from ..observability import registry as _global_registry
+
 __all__ = ["ServingMetrics", "percentile"]
 
 
-def percentile(values, q):
-    """Nearest-rank percentile (q in [0, 100]) of a list, None if empty."""
-    if not values:
-        return None
-    xs = sorted(values)
-    k = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
-    return xs[k]
-
-
 class ServingMetrics:
-    def __init__(self, clock=time.perf_counter):
+    # int counters kept as plain attributes (the engine increments them
+    # in place); expose() publishes them through lazy gauges
+    _COUNTERS = ("submitted", "admitted", "resumed", "finished",
+                 "preemptions", "evicted_pages", "prefill_chunks",
+                 "decode_steps", "generated_tokens")
+    _GAUGES = ("queue_depth", "running")
+
+    def __init__(self, clock=time.perf_counter, registry=None):
         self.clock = clock
         self.start_time = clock()
         # counters
@@ -40,10 +51,28 @@ class ServingMetrics:
         # gauges (refreshed every engine step)
         self.queue_depth = 0
         self.running = 0
-        # per-request latency samples (appended at finish)
-        self.ttft_s: list[float] = []
-        self.itl_s: list[float] = []      # all inter-token gaps
-        self.request_preemptions: list[int] = []
+        # per-request latency samples (appended at finish) — ONE ring
+        # histogram implementation (observability.Histogram): supports
+        # append/extend like the plain lists these used to be, plus
+        # O(1) observe and lazy p50/p99
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.ttft_s = self.registry.histogram("serving.ttft_s",
+                                              window=4096)
+        self.itl_s = self.registry.histogram("serving.itl_s",
+                                             window=8192)
+        self.request_preemptions = self.registry.histogram(
+            "serving.request_preemptions", window=4096)
+        for name in self._COUNTERS:
+            self.registry.gauge(f"serving.{name}").set_fn(
+                (lambda n: lambda: getattr(self, n))(name))
+        for name in self._GAUGES:
+            self.registry.gauge(f"serving.{name}").set_fn(
+                (lambda n: lambda: getattr(self, n))(name))
+        self.registry.gauge("serving.tok_s").set_fn(
+            lambda: round(self.generated_tokens
+                          / max(self.clock() - self.start_time, 1e-9),
+                          2))
 
     # -- event feeds ------------------------------------------------------
     def on_submit(self):
@@ -64,15 +93,24 @@ class ServingMetrics:
     def on_finish(self, handle):
         self.finished += 1
         if handle.ttft is not None:
-            self.ttft_s.append(handle.ttft)
+            self.ttft_s.observe(handle.ttft)
         self.itl_s.extend(handle.inter_token_latencies)
-        self.request_preemptions.append(handle.preemptions)
+        self.request_preemptions.observe(handle.preemptions)
 
     def observe(self, queue_depth: int, running: int):
         self.queue_depth = queue_depth
         self.running = running
+        # engine-level load gauges mirrored into the process-global
+        # registry (last engine observed wins — the always-on surface)
+        g = _global_registry()
+        g.gauge("serving.queue_depth").set(queue_depth)
+        g.gauge("serving.running").set(running)
 
     # -- surface ----------------------------------------------------------
+    def expose(self) -> str:
+        """Prometheus text exposition of this engine's registry."""
+        return self.registry.expose()
+
     def snapshot(self) -> dict:
         elapsed = max(self.clock() - self.start_time, 1e-9)
         return {
@@ -89,8 +127,8 @@ class ServingMetrics:
             "running": self.running,
             "elapsed_s": round(elapsed, 4),
             "tok_s": round(self.generated_tokens / elapsed, 2),
-            "ttft_p50_s": percentile(self.ttft_s, 50),
-            "ttft_p99_s": percentile(self.ttft_s, 99),
-            "itl_p50_s": percentile(self.itl_s, 50),
-            "itl_p99_s": percentile(self.itl_s, 99),
+            "ttft_p50_s": self.ttft_s.percentile(50),
+            "ttft_p99_s": self.ttft_s.percentile(99),
+            "itl_p50_s": self.itl_s.percentile(50),
+            "itl_p99_s": self.itl_s.percentile(99),
         }

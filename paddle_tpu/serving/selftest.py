@@ -47,6 +47,14 @@ def run_probe():
     from paddle_tpu.serving.traffic import (poisson_traffic,
                                             run_continuous, run_static)
 
+    # ISSUE 12: strict retrace sentinel for the whole serving lane —
+    # the PR-6 silent-recompile class (metadata numpy/device drift)
+    # raises instead of silently recompiling; prefill length buckets
+    # are declared expected, so a clean lane must not trip
+    from paddle_tpu import observability as obs
+
+    obs.set_strict_retrace(True)
+
     m, cfg = _tiny_model()
     rec, fails = {}, []
 
@@ -181,6 +189,10 @@ def run_probe():
     check("serving_preempt_resume", preempt_resume)
     check("serving_bounded_ttft", bounded_ttft)
     check("serving_traffic_ab", traffic_ab)
+    rec["retrace_sentinel"] = {
+        "strict": obs.strict_retrace(),
+        "total_unexpected": obs.retrace_summary()["total_unexpected"],
+    }
     rec["check"] = ("pass" if not fails
                     else "FAIL: " + ", ".join(fails))
     return rec

@@ -1,0 +1,534 @@
+"""Unified runtime telemetry tests (ISSUE 12): MetricsRegistry,
+StepTimeline, RetraceSentinel, flight recorder, and the producer
+integrations (train step, serving metrics, profile_step)."""
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        r = obs.MetricsRegistry()
+        r.counter("c").inc()
+        r.counter("c").inc(2.5)
+        assert r.counter("c").value == 3.5
+        r.gauge("g").set(7)
+        assert r.gauge("g").value == 7
+        h = r.histogram("h", window=4)
+        for v in (1, 2, 3, 4, 5, 6):
+            h.observe(v)
+        # ring keeps the LAST window samples; count/sum cover all
+        assert h.samples() == [3.0, 4.0, 5.0, 6.0]
+        assert h.count == 6 and h.total == 21.0
+        assert h.percentile(50) == 5.0
+        snap = h.snapshot()
+        assert snap["min"] == 1.0 and snap["max"] == 6.0
+        assert snap["p99"] == 6.0
+
+    def test_lazy_gauge_evaluated_at_scrape(self):
+        r = obs.MetricsRegistry()
+        calls = []
+        r.gauge("lazy").set_fn(lambda: calls.append(1) or 42)
+        assert not calls                      # nothing until scraped
+        assert r.gauge("lazy").value == 42
+        assert len(calls) == 1
+
+    def test_type_conflict_raises(self):
+        r = obs.MetricsRegistry()
+        r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")
+
+    def test_reset_prefix(self):
+        r = obs.MetricsRegistry()
+        r.counter("a.n").inc(5)
+        r.counter("b.n").inc(5)
+        r.reset(prefix="a.")
+        assert r.counter("a.n").value == 0
+        assert r.counter("b.n").value == 5
+
+    def test_percentile_nearest_rank(self):
+        assert obs.percentile([], 50) is None
+        assert obs.percentile([3, 1, 2], 50) == 2
+        assert obs.percentile([1, 2, 3, 4], 99) == 4
+
+    def test_global_registry_singleton(self):
+        assert obs.registry() is obs.registry()
+
+    def test_prometheus_exposition_format(self):
+        r = obs.MetricsRegistry()
+        r.counter("serving.finished").inc(3)
+        r.gauge("queue depth!").set(2)        # name gets sanitized
+        h = r.histogram("serving.ttft_s")
+        h.observe(0.5)
+        h.observe(1.5)
+        text = r.expose()
+        assert text.endswith("\n")
+        lines = text.splitlines()
+        assert "# TYPE serving_finished counter" in lines
+        assert "serving_finished 3.0" in lines
+        assert "# TYPE queue_depth_ gauge" in lines
+        assert 'serving_ttft_s{quantile="0.5"}' in text
+        assert "serving_ttft_s_count 2" in lines
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{quantile="[0-9.]+"\})? [^ ]+$')
+        for ln in lines:
+            if ln and not ln.startswith("#"):
+                assert sample.match(ln), ln
+
+
+# ---------------------------------------------------------------------------
+# timeline
+# ---------------------------------------------------------------------------
+
+class TestTimeline:
+    def test_jsonl_roundtrip_and_schema(self, tmp_path):
+        path = str(tmp_path / "tl.jsonl")
+        tl = obs.StepTimeline(sinks=[obs.JsonlSink(path)], lane="train")
+        want = [tl.record(step=i, host_ms=1.0 + i, note="x")
+                for i in range(3)]
+        tl.close()
+        got = obs.read_jsonl(path)
+        assert got == want
+        for r in got:
+            assert set(r) >= {"ts", "lane", "step"}
+            assert r["lane"] == "train"
+
+    def test_auto_step_numbers(self):
+        tl = obs.StepTimeline(lane="t_auto")
+        assert tl.record(host_ms=1)["step"] == 0
+        assert tl.record(host_ms=1)["step"] == 1
+
+    def test_registry_mirror_and_chrome_counters(self):
+        obs.drain_chrome_counters()           # start clean
+        tl = obs.StepTimeline(lane="t_mirror")
+        tl.record(step=0, host_ms=5.0, label="not-numeric")
+        h = obs.registry().get("timeline.t_mirror.host_ms")
+        assert h is not None and h.count >= 1
+        counters = obs.drain_chrome_counters()
+        names = {c["name"] for c in counters}
+        assert "t_mirror/host_ms" in names
+        assert all(c["ph"] == "C" for c in counters)
+        # drained means drained
+        assert obs.drain_chrome_counters() == []
+
+    def test_failing_sink_does_not_break_recording(self):
+        def bad(rec):
+            raise RuntimeError("sink down")
+
+        tl = obs.StepTimeline(sinks=[bad], lane="t_bad")
+        assert tl.record(step=0, host_ms=1.0)["step"] == 0
+
+
+# ---------------------------------------------------------------------------
+# retrace sentinel
+# ---------------------------------------------------------------------------
+
+class TestSentinel:
+    def test_hit_and_signature_counting(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_counts")
+        x = jnp.ones((2, 2))
+        s.observe((x,), names=("x",))
+        s.observe((x,), names=("x",))
+        st = s.stats()
+        assert st["signatures"] == 1 and st["hits"] == 1
+        assert st["unexpected"] == 0
+
+    def test_dtype_flip_attributed(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_flip")
+        s.observe((jnp.ones((2, 2)), jnp.ones((2,), jnp.int32)),
+                  names=("x", "ids"))
+        ev = s.observe((jnp.ones((2, 2)), jnp.ones((2,), jnp.int64)),
+                       names=("x", "ids"))
+        assert ev is not None and not ev["expected"]
+        assert any("ids" in c and "dtype" in c for c in ev["changes"])
+        assert s.stats()["unexpected"] == 1
+
+    def test_shape_change_attributed(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_shape")
+        s.observe((jnp.ones((2, 4)),), names=("x",))
+        ev = s.observe((jnp.ones((2, 8)),), names=("x",))
+        assert any("x" in c and "shape" in c for c in ev["changes"])
+        assert s.stats()["unexpected"] == 1
+
+    def test_bucketed_shape_change_expected(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_bucket", bucketed=("ids",))
+        s.observe((jnp.ones((2, 16), jnp.int32), jnp.float32(0)),
+                  names=("ids", "lr"))
+        ev = s.observe((jnp.ones((2, 32), jnp.int32), jnp.float32(0)),
+                       names=("ids", "lr"))
+        assert ev["expected"]
+        assert s.stats()["unexpected"] == 0
+        # but a DTYPE change on the bucketed arg is still unexpected
+        ev = s.observe((jnp.ones((2, 32), jnp.int64), jnp.float32(0)),
+                       names=("ids", "lr"))
+        assert not ev["expected"]
+
+    def test_optional_presence_expected(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_opt", optional=("seg",))
+        s.observe((jnp.ones((2,)), None), names=("x", "seg"))
+        ev = s.observe((jnp.ones((2,)), jnp.ones((2,), jnp.int32)),
+                       names=("x", "seg"))
+        assert ev["expected"], ev
+        assert s.stats()["unexpected"] == 0
+
+    def test_numpy_vs_device_kind_attributed(self):
+        """The PR-6 silent-recompile class: a host-numpy leaf turning
+        into a device array (or back) is an attributed kind change."""
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_kind")
+        s.observe((np.ones((2,), np.int32),), names=("meta",))
+        ev = s.observe((jnp.ones((2,), jnp.int32),), names=("meta",))
+        assert ev is not None and not ev["expected"]
+        assert any("meta" in c and "kind" in c for c in ev["changes"])
+
+    def test_strict_mode_raises(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_strict", strict=True)
+        s.observe((jnp.ones((2,)),), names=("x",))
+        with pytest.raises(obs.RetraceError, match="x: dtype"):
+            s.observe((jnp.ones((2,), jnp.int32),), names=("x",))
+
+    def test_strict_refused_signature_re_raises(self):
+        """A strict-mode refusal must NOT register the bad signature:
+        a retry with the same drifted args re-detects and re-raises
+        instead of counting as a cache hit and silently compiling."""
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_strict_retry", strict=True)
+        s.observe((jnp.ones((2,)),), names=("x",))
+        for _ in range(2):
+            with pytest.raises(obs.RetraceError):
+                s.observe((jnp.ones((2,), jnp.int32),), names=("x",))
+        st = s.stats()
+        assert st["signatures"] == 1      # bad signature never kept
+        assert st["unexpected"] == 2      # each retry re-detected
+
+    def test_global_strict_toggle(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_gstrict")
+        obs.set_strict_retrace(True)
+        try:
+            s.observe((jnp.ones((2,)),), names=("x",))
+            with pytest.raises(obs.RetraceError):
+                s.observe((jnp.ones((3,)),), names=("x",))
+        finally:
+            obs.set_strict_retrace(False)
+
+    def test_registry_counters_published(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_reg")
+        s.observe((jnp.ones((2,)),))
+        s.observe((jnp.ones((3,)),))
+        g = obs.registry().get("retrace.t_reg.signatures")
+        assert g is not None and g.value == 2
+        c = obs.registry().get("retrace.t_reg.unexpected")
+        assert c is not None and c.value == 1
+
+    def test_retrace_summary_aggregates(self):
+        import jax.numpy as jnp
+
+        s = obs.RetraceSentinel("t_sum")
+        s.observe((jnp.ones((2,)),))
+        summary = obs.retrace_summary()
+        assert "t_sum" in summary["sentinels"]
+        assert summary["sentinels"]["t_sum"]["signatures"] == 1
+
+
+# ---------------------------------------------------------------------------
+# train-step integration + HLO cost accounting
+# ---------------------------------------------------------------------------
+
+class TestTrainStepIntegration:
+    def _build(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.jit import TrainStep
+
+        paddle.seed(0)
+        m = nn.Linear(8, 4)
+        opt = popt.AdamW(learning_rate=1e-3,
+                         parameters=m.parameters())
+        step = TrainStep(m, lambda mm, a, b: ((mm(a) - b) ** 2).mean(),
+                         opt)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 4).astype(np.float32))
+        return step, x, y
+
+    def test_clean_run_one_signature(self):
+        step, x, y = self._build()
+        for _ in range(3):
+            step(x, y)
+        st = step.retrace_stats()
+        assert st["signatures"] == 1
+        assert st["hits"] == 2
+        assert st["unexpected"] == 0
+        if hasattr(step._jitted, "_cache_size"):
+            assert step._jitted._cache_size() == 1
+
+    def test_injected_dtype_flip_names_leaf(self):
+        step, x, y = self._build()
+        step(x, y)
+        y64 = y.astype("float64")
+        step(x, y64)
+        st = step.retrace_stats()
+        assert st["unexpected"] == 1
+        ev = st["events"][-1]
+        assert any("batch[1]" in c and "dtype" in c
+                   for c in ev["changes"]), ev
+
+    def test_cost_analysis_surface(self):
+        step, x, y = self._build()
+        step(x, y)
+        ca = step.cost_analysis(x, y)
+        assert ca["flops_per_step"] and ca["flops_per_step"] > 0
+        assert ca["collectives"] is not None
+        assert ca["collectives"]["total_comm_bytes"] == 0  # one chip
+        # published into the global registry
+        g = obs.registry().get("hlo.flops_per_step")
+        assert g is not None and g.value > 0
+
+    def test_cost_analysis_requires_built_step(self):
+        step, x, y = self._build()
+        with pytest.raises(RuntimeError, match="built"):
+            step.cost_analysis(x, y)
+
+
+class TestDecodeStepSentinel:
+    def test_decode_flip_attributed_and_buckets_expected(self):
+        """The decode/serve `_Step` paths carry the sentinel too: a
+        token-dtype flip is attributed by argument name, while prefill
+        length buckets are declared expected shape families."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.jit.decode_step import (
+            GenerationEngine, _split_state,
+        )
+        from paddle_tpu.jit.train_step import _tree_data
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=96,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        eng = GenerationEngine(m, kind="dense", batch=1, max_len=64)
+        ids = np.arange(1, 9, dtype=np.int64)[None]
+        eng.generate(ids, 4)
+        # longer prompt -> next prefill bucket: expected, not flagged
+        eng.generate(np.arange(1, 20, dtype=np.int64)[None], 4)
+        pst = eng.prefill_step.retrace_stats()
+        assert pst["signatures"] == 2 and pst["unexpected"] == 0, pst
+        dst = eng.decode_step.retrace_stats()
+        assert dst["signatures"] == 1 and dst["unexpected"] == 0, dst
+        # inject a dtype flip straight into the decode program's args
+        buffers, meta = _split_state(
+            "dense", _tree_data(eng.cache.state()))
+        bad_tokens = jnp.zeros((1,), jnp.int64)   # decode feeds int32
+        eng.decode_step(eng._param_data(), buffers, meta, bad_tokens,
+                        jax.random.PRNGKey(0))
+        dst = eng.decode_step.retrace_stats()
+        assert dst["unexpected"] == 1, dst
+        ev = dst["events"][-1]
+        assert any("tokens" in c and "dtype" in c
+                   for c in ev["changes"]), ev
+
+
+# ---------------------------------------------------------------------------
+# producers: serving metrics, profile_step, flight recorder
+# ---------------------------------------------------------------------------
+
+class _Handle:
+    ttft = 0.25
+    inter_token_latencies = [0.01, 0.02]
+    preemptions = 1
+
+
+class TestServingMetrics:
+    def test_percentiles_via_registry_histograms(self):
+        from paddle_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        assert isinstance(m.ttft_s, obs.Histogram)
+        m.on_submit()
+        m.on_finish(_Handle())
+        snap = m.snapshot()
+        assert snap["ttft_p50_s"] == 0.25
+        # nearest-rank p50 of [0.01, 0.02] (round-half-even index 0)
+        assert snap["itl_p50_s"] == 0.01
+        assert snap["finished"] == 1
+
+    def test_metrics_text_scrape_format(self):
+        from paddle_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.on_submit()
+        m.on_finish(_Handle())
+        m.observe(queue_depth=3, running=2)
+        text = m.expose()
+        lines = text.splitlines()
+        assert "# TYPE serving_ttft_s summary" in lines
+        assert 'serving_ttft_s{quantile="0.5"} 0.25' in lines
+        assert "serving_ttft_s_count 1" in lines
+        assert "serving_finished 1" in text
+        assert "serving_queue_depth 3" in text
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{quantile="[0-9.]+"\})? [^ ]+$')
+        for ln in lines:
+            if ln and not ln.startswith("#"):
+                assert sample.match(ln), ln
+
+    def test_engines_isolated(self):
+        from paddle_tpu.serving.metrics import ServingMetrics
+
+        a, b = ServingMetrics(), ServingMetrics()
+        a.on_preempt(pages_reclaimed=4)
+        assert a.preemptions == 1 and b.preemptions == 0
+
+
+class TestProfileStepAlwaysOn:
+    def test_records_without_profiler(self):
+        """Regression (ISSUE 12 satellite): the docstring promises
+        'time one span even with no Profiler active' — the span must
+        land somewhere observable when no Profiler cycle is RECORDing."""
+        from paddle_tpu.profiler import profile_step
+
+        h = obs.registry().histogram("profile_step.orphan_span_ms")
+        before = h.count
+        with profile_step("orphan_span"):
+            pass
+        assert h.count == before + 1
+
+    def test_still_joins_profiler_events_when_recording(self):
+        from paddle_tpu.profiler import Profiler, profile_step
+
+        p = Profiler(on_trace_ready=lambda prof: None)
+        p.start()
+        with profile_step("in_cycle"):
+            pass
+        res = p.stop()
+        assert any(e.name == "in_cycle" for e in res.events)
+
+
+class TestFlightRecorder:
+    def test_note_and_dump(self, tmp_path):
+        rec = obs.FlightRecorder(capacity=4)
+        for i in range(6):
+            rec.note("step", step=i)
+        events = rec.snapshot()
+        assert len(events) == 4               # bounded ring
+        assert events[-1]["step"] == 5
+        path = str(tmp_path / "crash.json")
+        try:
+            raise ValueError("boom")
+        except ValueError as e:
+            out = rec.dump(reason="test", exc=e, path=path)
+        assert out == path and os.path.exists(path)
+        with open(path) as f:
+            data = json.load(f)
+        assert data["reason"] == "test"
+        assert data["exception"]["type"] == "ValueError"
+        assert len(data["events"]) == 4
+        assert "metrics" in data
+
+    def test_global_recorder_singleton(self):
+        assert obs.recorder() is obs.recorder()
+
+
+class TestHloByteCensus:
+    def test_async_start_payload_not_double_counted(self):
+        """An all-reduce-start's tuple result is (aliased operand,
+        output) — the census must count the payload once."""
+        mod = obs.load_hlo_overlap()
+        text = (
+            "HloModule m\n\n"
+            "ENTRY %main (p: f32[1024]) -> f32[1024] {\n"
+            "  %p = f32[1024]{0} parameter(0)\n"
+            "  %ar = (f32[1024]{0}, f32[1024]{0}) all-reduce-start("
+            "f32[1024]{0} %p), replica_groups={{0,1}}\n"
+            "  ROOT %d = f32[1024]{0} all-reduce-done("
+            "(f32[1024]{0}, f32[1024]{0}) %ar)\n"
+            "}\n")
+        v = mod.analyze(text)
+        assert v["counts"] == {"all-reduce": 1}
+        assert v["total_comm_bytes"] == 4096
+
+    def test_sync_tuple_elements_summed(self):
+        """The sync tuple form (all-to-all over several arrays)
+        carries REAL outputs in every element — those do sum."""
+        mod = obs.load_hlo_overlap()
+        text = (
+            "HloModule m\n\n"
+            "ENTRY %main (p: f32[64]) -> f32[64] {\n"
+            "  %p = f32[64]{0} parameter(0)\n"
+            "  %a2a = (f32[64]{0}, f32[64]{0}) all-to-all("
+            "f32[64]{0} %p, f32[64]{0} %p), replica_groups={{0,1}}\n"
+            "  ROOT %r = f32[64]{0} get-tuple-element((f32[64]{0}, "
+            "f32[64]{0}) %a2a), index=0\n"
+            "}\n")
+        v = mod.analyze(text)
+        assert v["total_comm_bytes"] == 2 * 64 * 4
+
+
+class TestGuardGauges:
+    def test_gauges_follow_latest_guard_via_weakref(self):
+        import gc
+
+        from paddle_tpu.jit.nonfinite_guard import GuardSpec
+
+        spec = GuardSpec()
+        spec.writeback(spec.init_state())
+        g = obs.registry().get("train.guard_skipped_steps")
+        assert g is not None and g.value == 0
+        assert obs.registry().gauge("train.loss_scale").value == 1.0
+        del spec
+        gc.collect()
+        # superseded guard is NOT pinned by the registry closure
+        assert g.value is None
+
+
+class TestCheckpointTelemetry:
+    def test_save_timings_published(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        mgr = CheckpointManager(str(tmp_path), model=m)
+        before = obs.registry().counter("checkpoint.saves").value
+        mgr.save(0)
+        assert obs.registry().counter(
+            "checkpoint.saves").value == before + 1
+        assert obs.registry().histogram(
+            "checkpoint.snapshot_ms").count >= 1
+        assert obs.registry().histogram(
+            "checkpoint.io_ms").count >= 1

@@ -36,6 +36,50 @@ class TestScheduler:
         assert p.current_state == ProfilerState.CLOSED
         p.stop()
 
+    def test_tuple_scheduler_from_zero(self):
+        """(0, N) records from the very first step — the tuple path
+        must clamp the closed phase at 0, not go negative."""
+        p = Profiler(scheduler=(0, 2), on_trace_ready=lambda prof: None)
+        p.start()
+        assert p.current_state == ProfilerState.RECORD
+        p.step()
+        assert p.current_state == ProfilerState.RECORD_AND_RETURN
+        p.step()
+        assert p.current_state == ProfilerState.CLOSED
+        p.stop()
+
+    def test_skip_first_with_repeat_exhaustion(self):
+        """skip_first offsets EVERY cycle; after `repeat` cycles the
+        scheduler pins CLOSED forever (no wraparound re-recording)."""
+        sched = make_scheduler(closed=0, ready=0, record=2, repeat=2,
+                               skip_first=3)
+        states = [sched(i) for i in range(9)]
+        assert states == [
+            ProfilerState.CLOSED,            # skip_first 0..2
+            ProfilerState.CLOSED,
+            ProfilerState.CLOSED,
+            ProfilerState.RECORD,            # cycle 1
+            ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.RECORD,            # cycle 2
+            ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.CLOSED,            # repeat exhausted...
+            ProfilerState.CLOSED,            # ...and stays exhausted
+        ]
+        assert sched(1000) == ProfilerState.CLOSED
+
+    def test_record_of_one_is_always_return(self):
+        sched = make_scheduler(closed=1, ready=0, record=1)
+        assert sched(0) == ProfilerState.CLOSED
+        assert sched(1) == ProfilerState.RECORD_AND_RETURN
+        assert sched(2) == ProfilerState.CLOSED   # repeat=0: forever
+        assert sched(3) == ProfilerState.RECORD_AND_RETURN
+
+    def test_invalid_record_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            make_scheduler(closed=1, ready=0, record=0)
+
 
 class TestRecordEvent:
     def test_events_captured_and_summary(self, tmp_path):
@@ -89,3 +133,46 @@ class TestChromeExport:
         p.stop()
         assert len(p.step_times_ms) == 2
         assert all(t > 0 for t in p.step_times_ms)
+
+    def test_counter_tracks_merged_into_export(self, tmp_path):
+        """ISSUE 12: StepTimeline counter tracks land in the chrome
+        trace the Profiler exports — "ph": "C" events alongside the
+        host spans."""
+        from paddle_tpu import observability as obs
+
+        obs.drain_chrome_counters()           # start clean
+        d = str(tmp_path / "trace")
+        p = Profiler(on_trace_ready=export_chrome_tracing(d))
+        p.start()
+        tl = obs.StepTimeline(lane="prof_merge")
+        with RecordEvent("span"):
+            tl.record(step=0, host_ms=3.5, stall_ms=0.1)
+        p.stop()
+        data = load_profiler_result(p._last_export_path)
+        counters = [e for e in data["traceEvents"] if e["ph"] == "C"]
+        names = {c["name"] for c in counters}
+        assert "prof_merge/host_ms" in names
+        assert "prof_merge/stall_ms" in names
+        host = next(c for c in counters
+                    if c["name"] == "prof_merge/host_ms")
+        assert host["args"]["host_ms"] == 3.5
+        # spans AND counters coexist in one trace
+        assert any(e["ph"] == "X" and e["name"] == "span"
+                   for e in data["traceEvents"])
+
+    def test_stale_pre_cycle_counters_not_merged(self):
+        """Counter events recorded BEFORE the profiling cycle (a
+        timeline running with no Profiler active) must not flood the
+        exported trace — only in-window events merge."""
+        from paddle_tpu import observability as obs
+
+        obs.drain_chrome_counters()
+        tl = obs.StepTimeline(lane="stale")
+        tl.record(step=0, v=1.0)              # pre-cycle backlog
+        time.sleep(0.002)
+        p = Profiler(on_trace_ready=lambda prof: None)
+        p.start()
+        with RecordEvent("s"):
+            tl.record(step=1, v=2.0)          # in-cycle
+        res = p.stop()
+        assert [c["args"]["v"] for c in res.counters] == [2.0]

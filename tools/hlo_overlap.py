@@ -27,6 +27,11 @@ Two modes, chosen by what the backend emits:
   about being a proxy; the async numbers land when the same probe runs
   on a real chip.
 
+Every collective also contributes its RESULT-shape payload bytes to a
+per-kind and per-axis byte census (``bytes`` / ``total_comm_bytes`` /
+``per_axis_bytes`` in the verdict) — the comm-bytes-per-step numbers
+ISSUE 12 pipes into BENCH records and the metrics registry.
+
 Per-axis classification covers every COLLECTIVE_KINDS entry — including
 ``all-to-all`` (both the single-operand and the tuple form XLA emits for
 multi-array exchanges), so the MoE expert-parallel dispatch/combine get
@@ -69,6 +74,39 @@ _INSTR_RE = re.compile(
     r"(?P<op>[\w\-]+)\(")
 _COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*->")
 _REF_RE = re.compile(r"%([\w.\-]+)")
+_SHAPE_RE = re.compile(
+    r"(pred|bf16|f16|f32|f64|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|"
+    r"c64|c128)\[([0-9,]*)\]")
+_ITEMSIZE = {"pred": 1, "s4": 0.5, "u4": 0.5, "s8": 1, "u8": 1,
+             "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+             "f32": 4, "s32": 4, "u32": 4,
+             "f64": 8, "s64": 8, "u64": 8, "c64": 8, "c128": 16}
+
+
+def _result_bytes(line, op):
+    """Payload bytes of an instruction's RESULT shape (the text between
+    '=' and the op token; operand shapes inside the parens are excluded
+    by construction). Sync collectives sum the tuple elements (the
+    tuple form of all-to-all/all-reduce carries many REAL output
+    arrays); async ``-start`` ops instead take the LARGEST element —
+    their tuple is (aliased operand, output[, context scalars]), so a
+    sum would double-count the payload."""
+    rhs = line.split("=", 1)[1]
+    cut = rhs.find(op + "(")
+    if cut < 0:
+        return 0
+    sizes = []
+    for dtype, dims in _SHAPE_RE.findall(rhs[:cut]):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * _ITEMSIZE[dtype])
+    if not sizes:
+        return 0
+    if op.endswith("-start"):
+        return int(max(sizes))
+    return int(sum(sizes))
 _GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,{} ]*\})\}")
 _IOTA_GROUPS_RE = re.compile(
     r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
@@ -148,8 +186,10 @@ def expected_axis_groups(axis_degrees):
 
 def parse_computations(text):
     """-> {computation_name: [(instr_name, op, [operand_names],
-    replica_groups)]} in scheduled order (compiled modules print
-    is_scheduled=true)."""
+    replica_groups, result_bytes)]} in scheduled order (compiled
+    modules print is_scheduled=true). result_bytes is only computed for
+    collective ops (everything else reads 0) — it feeds the per-axis
+    comm-bytes census (ISSUE 12)."""
     comps = {}
     cur = None
     for line in text.splitlines():
@@ -172,7 +212,9 @@ def parse_computations(text):
         # not collide with instruction names in practice)
         rhs = line.split("=", 1)[1]
         refs = [r for r in _REF_RE.findall(rhs) if r != name]
-        comps[cur].append((name, op, refs, _parse_groups(line)))
+        nbytes = (_result_bytes(line, op)
+                  if _collective_kind(op) is not None else 0)
+        comps[cur].append((name, op, refs, _parse_groups(line), nbytes))
     return comps
 
 
@@ -197,9 +239,12 @@ def analyze(text, axis_degrees=None):
     async_pairs = []
     sync_colls = []
     counts = {k: 0 for k in COLLECTIVE_KINDS}
+    byte_counts = {k: 0 for k in COLLECTIVE_KINDS}
+    total_bytes = 0
     axis_expected = (expected_axis_groups(axis_degrees)
                      if axis_degrees else None)
     per_axis = {}
+    per_axis_bytes = {}
 
     def classify(groups):
         if axis_expected is None or groups is None:
@@ -212,20 +257,24 @@ def analyze(text, axis_degrees=None):
         return "other"
 
     for cname, instrs in comps.items():
-        for i, (name, op, refs, groups) in enumerate(instrs):
+        for i, (name, op, refs, groups, nbytes) in enumerate(instrs):
             kind = _collective_kind(op)
             if kind is None:
                 continue
             counts[kind] += 1
+            byte_counts[kind] += nbytes
+            total_bytes += nbytes
             label = classify(groups)
             if label is not None:
                 per_axis.setdefault(label, {}).setdefault(kind, 0)
                 per_axis[label][kind] += 1
+                per_axis_bytes[label] = (per_axis_bytes.get(label, 0)
+                                         + nbytes)
             if op.endswith("-start"):
                 # find the matching -done consuming this value
                 done_i = None
                 for j in range(i + 1, len(instrs)):
-                    n2, op2, refs2, _ = instrs[j]
+                    n2, op2, refs2, _, _ = instrs[j]
                     if op2 == kind + "-done" and name in refs2:
                         done_i = j
                         break
@@ -246,7 +295,7 @@ def analyze(text, axis_degrees=None):
             independent_after = 0
             window = 0
             for j in range(i + 1, len(instrs)):
-                n2, op2, refs2, _ = instrs[j]
+                n2, op2, refs2, _, _ = instrs[j]
                 if any(r in dependent for r in refs2):
                     dependent.add(n2)
                     if first_use is None:
@@ -275,7 +324,10 @@ def analyze(text, axis_degrees=None):
     return {
         "mode": "async" if async_pairs else "sync",
         "counts": {k: v for k, v in counts.items() if v},
-        **({"per_axis_counts": per_axis} if axis_expected else {}),
+        "bytes": {k: v for k, v in byte_counts.items() if v},
+        "total_comm_bytes": total_bytes,
+        **({"per_axis_counts": per_axis,
+            "per_axis_bytes": per_axis_bytes} if axis_expected else {}),
         "async_pairs": len(async_pairs),
         "async_pairs_bracketing_compute": n_async_ok,
         "sync_collectives": len(sync_colls),
