@@ -166,6 +166,14 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
     # dispatch interval (dispatch is async; the aggregate wall time
     # below is the throughput truth, the timeline shows its shape)
     from paddle_tpu.observability import JsonlSink, StepTimeline
+    from paddle_tpu.observability.goodput import (
+        goodput_baseline, goodput_breakdown,
+    )
+
+    # snapshot cumulative instruments BEFORE the measured loop so an
+    # earlier run in this process (primary before secondary) cannot
+    # charge its costs to this config's steps
+    gp_base = goodput_baseline()
 
     os.makedirs(_LIVE_DIR, exist_ok=True)
     tl_path = os.path.join(_LIVE_DIR, f"timeline_{model_name}.jsonl")
@@ -188,6 +196,14 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
     pf_stats = pf.get_stats()
 
     tokens_per_sec = batch * seq * steps / dt
+
+    # goodput attribution (ISSUE 13): fold the registry's stall/bubble/
+    # comm gauges into one per-step goodput.* breakdown for the record
+    try:
+        goodput = goodput_breakdown(step_ms=dt / steps * 1e3,
+                                    steps=steps, baseline=gp_base)
+    except Exception as e:
+        goodput = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     # HLO-derived accounting (ISSUE 12): ask the COMPILER what the step
     # actually executes — cost-analysis flops (vs the analytic 6N
@@ -263,6 +279,7 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
         "timeline": {"path": os.path.relpath(
             tl_path, os.path.dirname(os.path.abspath(__file__))),
             "steps": steps},
+        "goodput": goodput,
         "input_pipeline": {
             "input_stall_ms": pf_stats["input_stall_ms"]["mean"],
             "h2d_ms": pf_stats["h2d_ms"]["mean"],
@@ -981,8 +998,34 @@ def _load_live(metric):
     return rec
 
 
+def _load_bench_compare():
+    """tools/bench_compare.py by path (same loader pattern as
+    hlo_costs.load_hlo_overlap)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main():
     _setup_jax()
+
+    # opt-in debug/scrape server for the whole bench process (ISSUE
+    # 13): /metrics /healthz /tracez /flightz on the global registry
+    if os.environ.get("BENCH_DEBUG_PORT"):
+        try:
+            from paddle_tpu.observability import DebugServer
+
+            port = DebugServer(
+                port=int(os.environ["BENCH_DEBUG_PORT"])).start()
+            print(f"[bench] debug server on 127.0.0.1:{port}",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] debug server failed: {e}", file=sys.stderr)
 
     # driver-window reality (measured r5): the axon server-side program
     # LOAD for the 1.3b fused-scan step is 6-19 min in a fresh process —
@@ -1091,6 +1134,25 @@ def main():
                          recompute=False, remat_policy="",
                          offload_masters=False)
         result["secondary"] = sec
+
+    # opt-in round-over-round regression gate (ISSUE 13): BENCH_COMPARE=1
+    # diffs THIS run against the newest recorded BENCH_r*.json with
+    # per-metric tolerances; the verdict table goes to stderr, the
+    # verdict JSON into the record. Never eats the measurement.
+    if os.environ.get("BENCH_COMPARE", "0") == "1":
+        try:
+            bc = _load_bench_compare()
+            verdict = bc.compare_latest(
+                os.path.dirname(os.path.abspath(__file__)),
+                current=result)
+            print(bc.render_table(verdict), file=sys.stderr)
+            if len(verdict.get("rows", [])) > 40:
+                verdict["rows"] = [r for r in verdict["rows"]
+                                   if r["verdict"] != "ok"]
+            result["bench_compare"] = verdict
+        except Exception as e:
+            result["bench_compare"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
 
     print(json.dumps(result))
 

@@ -36,10 +36,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import time
+
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
 from ..nn.functional.sampling import sample_logits, sample_logits_per_slot
 from ..observability import RetraceSentinel
+from ..observability import enabled as _obs_enabled
+from ..observability import registry as _obs_registry
 from .train_step import _tree_data, _tree_wrap
 
 __all__ = ["GenerationEngine", "DecodeStep", "PrefillStep",
@@ -93,6 +97,16 @@ class _Step:
         self.trace_count = 0   # traces when compiled, calls when eager
         self._sentinel = RetraceSentinel(type(self).__name__,
                                          bucketed=self._bucketed_args)
+        # per-call DISPATCH time (enqueue, not device completion —
+        # results stay async) on the PROCESS-GLOBAL registry, keyed by
+        # step class: a whole-process view (concurrent engines share
+        # one histogram, like the global serving.queue_depth mirror) —
+        # per-request timing lives on the engine's trace spans. One
+        # cached histogram object: ~1µs observe, no registry lookup.
+        self._obs_on = _obs_enabled()
+        self._dispatch_hist = (_obs_registry().histogram(
+            f"jit.{type(self).__name__}.dispatch_ms")
+            if self._obs_on else None)
 
     def _fn(self, *args):
         raise NotImplementedError
@@ -142,7 +156,19 @@ class _Step:
         # in the metadata (the PR-6 silent-recompile class) shows up
         # here as an attributed placement/kind change
         self._sentinel.observe(tuple(args), names=self._arg_names)
-        return self._jitted(*args)
+        if self._dispatch_hist is None:
+            return self._jitted(*args)
+        tc0 = self.trace_count
+        t0 = time.perf_counter()
+        out = self._jitted(*args)
+        # a call that TRACED just paid compile time (minutes for big
+        # models) — one such sample would permanently skew a histogram
+        # whose steady-state entries are ~1ms, so only steady-state
+        # dispatches are recorded
+        if self.trace_count == tc0:
+            self._dispatch_hist.observe(
+                (time.perf_counter() - t0) * 1e3)
+        return out
 
     # -- shared step body helpers ---------------------------------------
     def _enter(self, params, buffers, meta):

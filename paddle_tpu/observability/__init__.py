@@ -19,7 +19,20 @@ serving:
   the per-mesh-axis collective byte census, feeding cost-analysis MFU
   into BENCH records.
 - `FlightRecorder` / `recorder()` — a bounded black box of recent
-  events dumped (with a registry snapshot) on crashes.
+  events dumped (with a registry snapshot) on crashes;
+  `install_signal_dump()` adds SIGQUIT hung-process dumps (ring +
+  all-thread stacks, process keeps running).
+- `Tracer` / `Span` (ISSUE 13) — request-scoped causal timelines: a
+  bounded ring of span trees with O(1) begin/end, tail-exemplar
+  retention, orphan detection, chrome-trace export on per-request
+  tracks merged into the profiler export. The serving tier traces
+  every request end to end (`ServingEngine.slow_requests()`).
+- `SLOTracker` — declared objectives ("TTFT p99 <= X ms") with
+  rolling-window burn-rate gauges on the registry.
+- `DebugServer` — stdlib-only loopback HTTP: `/metrics` (Prometheus),
+  `/healthz`, `/tracez`, `/flightz` (opt-in from ServingEngine/bench).
+- `goodput_breakdown` — per-step `goodput.*` step-time attribution
+  folded from the existing stall/bubble/comm gauges (BENCH lanes).
 
 Quickstart::
 
@@ -34,7 +47,12 @@ Quickstart::
     print(obs.registry().expose())        # Prometheus text
     print(obs.retrace_summary())          # compile/retrace receipt
 """
-from .flight_recorder import FlightRecorder, install, recorder  # noqa: F401
+from .debug_server import DebugServer  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder, install, install_signal_dump, recorder,
+    thread_stacks,
+)
+from .goodput import goodput_baseline, goodput_breakdown  # noqa: F401
 from .hlo_costs import (  # noqa: F401
     cost_analysis_of, load_hlo_overlap, summarize_compiled,
 )
@@ -45,9 +63,11 @@ from .sentinel import (  # noqa: F401
     RetraceError, RetraceSentinel, enabled, retrace_summary,
     set_strict_retrace, strict_retrace,
 )
+from .slo import SLO, SLOTracker  # noqa: F401
 from .timeline import (  # noqa: F401
     JsonlSink, StepTimeline, drain_chrome_counters, read_jsonl,
 )
+from .tracing import Span, Tracer, drain_chrome_spans  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
@@ -55,5 +75,8 @@ __all__ = [
     "drain_chrome_counters", "RetraceSentinel", "RetraceError",
     "set_strict_retrace", "strict_retrace", "retrace_summary",
     "enabled", "FlightRecorder", "recorder", "install",
+    "install_signal_dump", "thread_stacks",
     "summarize_compiled", "cost_analysis_of", "load_hlo_overlap",
+    "Span", "Tracer", "drain_chrome_spans", "SLO", "SLOTracker",
+    "DebugServer", "goodput_breakdown", "goodput_baseline",
 ]

@@ -1,0 +1,197 @@
+"""Stdlib-only debug/scrape HTTP server (opt-in).
+
+One tiny ``ThreadingHTTPServer`` on a daemon thread exposing the
+observability surface over loopback:
+
+- ``/metrics``  — Prometheus text exposition (``registry.expose()``,
+  text/plain; version=0.0.4) — point a Prometheus scraper here.
+- ``/healthz``  — liveness JSON (status/pid/uptime).
+- ``/tracez``   — recent completed traces + tail exemplars + open-span
+  / orphan counts as JSON (the request-forensics surface).
+- ``/flightz``  — the flight-recorder event ring as JSON (what the
+  crash dump would contain, inspectable on a LIVE process).
+- ``/<name>``   — any extra provider passed as ``extra={name: fn}``
+  (the serving engine adds ``/sloz`` -> SLO burn-rate snapshot).
+
+Stdlib only by design (DECISIONS §19): the serving tier must not grow
+a web-framework dependency for a debug port, the handler does no
+per-request allocation beyond the response body, and every endpoint
+reads scrape-time lazy state — a scrape pays the cost, the serve loop
+never does. Providers are passed as CALLABLES (or objects) so the
+server survives the engine swapping its registry (`reset_metrics`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+__all__ = ["DebugServer"]
+
+
+def _resolve(v):
+    """Providers may be the object itself or a zero-arg callable
+    returning it (late binding across engine resets)."""
+    if callable(v) and not hasattr(v, "expose"):
+        return v()
+    return v
+
+
+class DebugServer:
+    """Opt-in loopback debug server over one registry/tracer/recorder.
+
+    ``port=0`` binds an ephemeral port (``server.port`` after
+    ``start()``). ``registry``/``tracer``/``recorder`` may each be the
+    object or a zero-arg callable returning it; ``extra`` maps endpoint
+    names to zero-arg callables returning JSON-able objects.
+    """
+
+    def __init__(self, registry=None, tracer=None, recorder=None,
+                 extra=None, host="127.0.0.1", port=0):
+        if registry is None:
+            from .registry import registry as _reg
+            registry = _reg
+        if recorder is None:
+            from .flight_recorder import recorder as _rec
+            recorder = _rec
+        self._registry = registry
+        self._tracer = tracer
+        self._recorder = recorder
+        self._extra = dict(extra or {})
+        self.host = host
+        self._port_req = int(port)
+        self._httpd = None
+        self._thread = None
+        self._t_start = None
+
+    # -- endpoint bodies -------------------------------------------------
+    def _metrics(self):
+        reg = _resolve(self._registry)
+        return reg.expose() if reg is not None else ""
+
+    def _healthz(self):
+        return {"status": "ok", "pid": os.getpid(),
+                "uptime_s": round(time.monotonic() - self._t_start, 3)
+                if self._t_start is not None else None,
+                "time": round(time.time(), 3)}
+
+    def _tracez(self, n=None):
+        tracer = _resolve(self._tracer)
+        if tracer is None:
+            return {"traces": [], "exemplars": [], "open_spans": 0,
+                    "orphans": 0}
+        return {"traces": tracer.traces(n=n),
+                "exemplars": tracer.exemplars(),
+                "open_spans": len(tracer.open_spans()),
+                "orphans": len(tracer.orphans()),
+                "stats": tracer.stats()}
+
+    def _flightz(self):
+        rec = _resolve(self._recorder)
+        if rec is None:
+            return {"events": []}
+        return {"events": rec.snapshot(),
+                "last_dump_path": rec.last_dump_path}
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> int:
+        """Bind + serve on a daemon thread; returns the bound port.
+        Idempotent."""
+        if self._httpd is not None:
+            return self.port
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):        # silence per-request noise
+                pass
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                route = u.path.strip("/")
+                try:
+                    if route == "metrics":
+                        body = server._metrics().encode()
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    elif route == "healthz":
+                        body = json.dumps(server._healthz()).encode()
+                        ctype = "application/json"
+                    elif route == "tracez":
+                        q = parse_qs(u.query)
+                        n = int(q["n"][0]) if "n" in q else None
+                        body = json.dumps(server._tracez(n=n),
+                                          default=str).encode()
+                        ctype = "application/json"
+                    elif route == "flightz":
+                        body = json.dumps(server._flightz(),
+                                          default=str).encode()
+                        ctype = "application/json"
+                    elif route in server._extra:
+                        body = json.dumps(server._extra[route](),
+                                          default=str).encode()
+                        ctype = "application/json"
+                    else:
+                        body = json.dumps({
+                            "error": "not found",
+                            "endpoints": sorted(
+                                ["metrics", "healthz", "tracez",
+                                 "flightz"] + list(server._extra)),
+                        }).encode()
+                        self._reply(404, body, "application/json")
+                        return
+                    self._reply(200, body, ctype)
+                except Exception as e:   # a broken provider must not
+                    body = json.dumps({  # kill the scrape thread
+                        "error": f"{type(e).__name__}: {e}"[:500]
+                    }).encode()
+                    self._reply(500, body, "application/json")
+
+            def _reply(self, code, body, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self._port_req),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self._t_start = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="paddle-debug-server", daemon=True)
+        self._thread.start()
+        return self.port
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self):
+        return (f"http://{self.host}:{self.port}"
+                if self._httpd is not None else None)
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

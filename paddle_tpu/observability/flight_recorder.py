@@ -20,7 +20,22 @@ import threading
 import time
 import traceback
 
-__all__ = ["FlightRecorder", "recorder", "install"]
+__all__ = ["FlightRecorder", "recorder", "install",
+           "install_signal_dump", "thread_stacks"]
+
+
+def thread_stacks() -> dict:
+    """Formatted stack trace of EVERY live thread (via
+    ``sys._current_frames``), keyed ``name(tid)`` — the hung-process
+    forensics payload: what each thread was executing at dump time."""
+    import threading as _threading
+
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        key = f"{names.get(tid, '?')}({tid})"
+        out[key] = "".join(traceback.format_stack(frame))[-8000:]
+    return out
 
 
 class FlightRecorder:
@@ -37,18 +52,36 @@ class FlightRecorder:
         with self._lock:
             self._events.append(ev)
 
-    def snapshot(self):
-        with self._lock:
+    def snapshot(self, blocking=True):
+        """``blocking=False`` is the SIGNAL-HANDLER path: the handler
+        may be running on top of an interrupted frame that already
+        holds this lock (note() is on the step hot path), so it must
+        try-acquire and degrade to an empty list rather than deadlock
+        the thread it interrupted."""
+        if not self._lock.acquire(blocking=blocking):
+            return []
+        try:
             return list(self._events)
+        finally:
+            self._lock.release()
 
     def clear(self):
         with self._lock:
             self._events.clear()
 
-    def dump(self, reason="", exc=None, path=None) -> str:
+    def dump(self, reason="", exc=None, path=None, threads=False,
+             signal_safe=False) -> str:
         """Write the black box to disk; returns the file path. Never
         raises (a failing dump must not mask the original crash) —
-        returns None on failure."""
+        returns None on failure. ``threads=True`` adds every live
+        thread's stack (the SIGQUIT hung-process path).
+
+        ``signal_safe=True`` (the signal handler sets it) avoids every
+        blocking lock acquisition: the interrupted frame underneath the
+        handler may HOLD the recorder's or an instrument's lock, and a
+        blocking acquire would deadlock the process the dump exists to
+        diagnose — the event ring is try-acquired and the registry
+        snapshot (per-instrument locks) is skipped."""
         try:
             from .registry import registry
 
@@ -56,8 +89,13 @@ class FlightRecorder:
                 "reason": reason,
                 "ts": round(time.time(), 6),
                 "pid": os.getpid(),
-                "events": self.snapshot(),
+                "events": self.snapshot(blocking=not signal_safe),
             }
+            if threads:
+                try:
+                    rec["threads"] = thread_stacks()
+                except Exception:
+                    rec["threads"] = {}
             if exc is not None:
                 rec["exception"] = {
                     "type": type(exc).__name__,
@@ -65,10 +103,13 @@ class FlightRecorder:
                     "traceback": "".join(traceback.format_exception(
                         type(exc), exc, exc.__traceback__))[-8000:],
                 }
-            try:
-                rec["metrics"] = registry().snapshot()
-            except Exception:
-                rec["metrics"] = {}
+            if signal_safe:
+                rec["metrics"] = {}     # instrument locks not safe here
+            else:
+                try:
+                    rec["metrics"] = registry().snapshot()
+                except Exception:
+                    rec["metrics"] = {}
             if path is None:
                 root = os.environ.get("PADDLE_FLIGHT_DIR",
                                       ".flight_recorder")
@@ -80,6 +121,18 @@ class FlightRecorder:
             with open(tmp, "w") as f:
                 json.dump(rec, f, default=str)
             os.replace(tmp, path)
+            if threads:
+                # faulthandler's C-level dump alongside (catches
+                # threads wedged in C extensions that
+                # sys._current_frames renders less faithfully)
+                try:
+                    import faulthandler
+
+                    with open(path + ".stacks.txt", "w") as f:
+                        faulthandler.dump_traceback(file=f,
+                                                    all_threads=True)
+                except Exception:
+                    pass
             self.last_dump_path = path
             return path
         except Exception:
@@ -123,3 +176,51 @@ def install():
         (_prev_hook or sys.__excepthook__)(exc_type, exc, tb)
 
     sys.excepthook = hook
+
+
+_signal_prev: dict = {}
+
+
+def install_signal_dump(signum=None):
+    """Hung-process forensics: installing on SIGQUIT (Ctrl-\\; fallback
+    SIGUSR2 where SIGQUIT is absent) makes the signal dump the event
+    ring PLUS every thread's stack trace to the crash-dump path and
+    RETURN — the process keeps running (installing replaces SIGQUIT's
+    default core-dump death), so you can poke a wedged trainer/server
+    from outside without killing it. Any existing Python-level handler
+    is chained after the dump. Idempotent per signal; returns the
+    signal number installed. Main-thread only (signal module rule)."""
+    import signal as _signal
+
+    sig = signum
+    if sig is None:
+        sig = getattr(_signal, "SIGQUIT", None)
+        if sig is None:                       # e.g. Windows
+            sig = getattr(_signal, "SIGUSR2", None)
+    if sig is None:
+        return None
+    with _lock:
+        if sig in _signal_prev:
+            return sig
+    prev = _signal.getsignal(sig)
+
+    def handler(s, frame):
+        # signal_safe: no note() and no blocking lock — the frame this
+        # handler interrupted may hold the very locks a normal dump
+        # takes, and blocking here would wedge the process harder than
+        # whatever prompted the poke
+        recorder().dump(reason=f"signal {s} (hung-process dump)",
+                        threads=True, signal_safe=True)
+        p = _signal_prev.get(s)
+        if callable(p):
+            try:
+                p(s, frame)
+            except Exception:
+                pass
+
+    # install FIRST: signal.signal raises off the main thread, and the
+    # idempotency record must not be poisoned by a failed install
+    _signal.signal(sig, handler)
+    with _lock:
+        _signal_prev[sig] = prev
+    return sig

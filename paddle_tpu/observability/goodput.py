@@ -1,0 +1,153 @@
+"""Training goodput attribution: fold the registry's per-step gauges
+into one step-time breakdown.
+
+Every stall source the runtime already measures publishes its own
+instrument (PR 5/8/12 producers): ``input.stall_ms`` (time the step
+loop waited for data), ``checkpoint.blocked_ms`` (synchronous slice of
+an async save), ``pipeline.bubble_fraction`` (schedule-structural idle
+on pp meshes). ``goodput_breakdown`` reads them, converts each to a
+fraction of the measured step time, and reports
+
+    goodput_frac = 1 - sum(attributed stall fractions)
+
+— the fraction of wall time actually spent stepping the model. What is
+NOT attributable from host-side gauges (overlapped H2D, per-axis
+collective bytes) is reported informationally, never subtracted: the
+breakdown only claims what was measured. Everything is also published
+as ``goodput.*`` gauges so scrapes and the BENCH record carry the same
+numbers.
+"""
+from __future__ import annotations
+
+from .registry import registry as _registry
+
+__all__ = ["goodput_breakdown", "goodput_baseline"]
+
+
+def _hist_mean(reg, name, last=None):
+    h = reg.get(name)
+    if h is None or not getattr(h, "count", 0):
+        return None
+    if last is not None:
+        xs = h.samples()[-int(last):]
+        return sum(xs) / len(xs) if xs else None
+    return h.mean()
+
+
+def _hist_sum_count(reg, name):
+    h = reg.get(name)
+    if h is None or not getattr(h, "count", 0):
+        return 0.0, 0
+    return float(h.total), int(h.count)
+
+
+def _gauge(reg, name):
+    g = reg.get(name)
+    v = g.value if g is not None else None
+    return v if isinstance(v, (int, float)) else None
+
+
+def goodput_baseline(registry=None) -> dict:
+    """Snapshot of the cumulative instruments BEFORE a measured loop.
+    Pass the result to ``goodput_breakdown(baseline=...)`` so a
+    process that ran earlier lanes (a primary bench before the
+    secondary, selftests) does not charge THEIR checkpoint blocking or
+    a stale pipeline gauge to this run's steps.
+
+    The pipeline-bubble gauge is CLEARED here rather than
+    value-compared later: the bubble fraction is schedule-structural
+    (two runs of the same pp config publish the identical float), so
+    only a write that happens inside the measured window — which
+    re-sets the gauge — can be attributed."""
+    reg = registry if registry is not None else _registry()
+    s, n = _hist_sum_count(reg, "checkpoint.blocked_ms")
+    g = reg.get("pipeline.bubble_fraction")
+    if g is not None:
+        g.reset()
+    return {"checkpoint_blocked": (s, n)}
+
+
+def goodput_breakdown(step_ms, steps=None, registry=None,
+                      publish=True, baseline=None) -> dict:
+    """Per-step goodput breakdown against a measured ``step_ms``.
+
+    ``steps`` (the measured-loop length) scopes histogram reads to the
+    most recent window and amortizes whole-run costs (checkpoint
+    blocking) per step. ``baseline`` (from `goodput_baseline`, taken
+    before the loop) subtracts cumulative costs accrued BEFORE the
+    measured window. Returns a JSON-able dict for BENCH records;
+    publishes ``goodput.*`` gauges unless ``publish=False``.
+    """
+    reg = registry if registry is not None else _registry()
+    baseline = baseline or {}
+    step_ms = float(step_ms)
+    out = {"step_ms": round(step_ms, 4)}
+    attributed = {}
+
+    stall = _hist_mean(reg, "input.stall_ms", last=steps)
+    if stall is not None:
+        attributed["input_stall"] = stall
+
+    blocked_sum, blocked_n = _hist_sum_count(reg, "checkpoint.blocked_ms")
+    base_sum, base_n = baseline.get("checkpoint_blocked", (0.0, 0))
+    blocked_sum = max(0.0, blocked_sum - base_sum)
+    blocked_n = max(0, blocked_n - base_n)
+    if blocked_n:
+        # blocking save cost amortized over the measured steps (saves
+        # are sparse; per-save numbers stay in checkpoint.blocked_ms)
+        attributed["checkpoint_block"] = (
+            blocked_sum / steps if steps else blocked_sum / blocked_n)
+
+    # goodput_baseline cleared this gauge, so a value here means a
+    # pipeline schedule published it INSIDE the measured window
+    bubble = _gauge(reg, "pipeline.bubble_fraction")
+    if bubble is not None:
+        attributed["pipeline_bubble"] = bubble * step_ms
+
+    host = _hist_mean(reg, "timeline.train.host_ms", last=steps)
+    if host is not None and host > step_ms:
+        # host loop ran slower than the measured step rate: dispatch /
+        # telemetry / python overhead the device had to wait for
+        attributed["host_overhead"] = host - step_ms
+
+    fracs = {}
+    for k, ms in attributed.items():
+        out[f"{k}_ms"] = round(ms, 4)
+        fracs[k] = min(max(ms / step_ms, 0.0), 1.0) if step_ms else 0.0
+    out["fracs"] = {k: round(v, 5) for k, v in fracs.items()}
+    out["goodput_frac"] = round(
+        max(0.0, 1.0 - sum(fracs.values())), 5)
+
+    # informational (overlapped or byte-denominated: measured, but not
+    # subtractable from step time without a bandwidth model)
+    info = {}
+    h2d = _hist_mean(reg, "input.h2d_ms", last=steps)
+    if h2d is not None:
+        info["h2d_ms_overlapped"] = round(h2d, 4)
+    comm = {}
+    for name in ("comm.grad_scatter_bytes_per_step",
+                 "comm.param_gather_bytes_per_step",
+                 "comm.bucket_bytes_per_step",
+                 "hlo.comm_bytes_per_step"):
+        v = _gauge(reg, name)
+        if v:
+            comm[name.split(".", 1)[1]] = v
+    for name in reg.names(prefix="hlo.comm_bytes_per_step."):
+        v = _gauge(reg, name)
+        if v:
+            comm.setdefault("per_axis", {})[
+                name.rsplit(".", 1)[1]] = v
+    if comm:
+        info["comm_bytes"] = comm
+    if info:
+        out["informational"] = info
+
+    if publish:
+        try:
+            reg.gauge("goodput.goodput_frac").set(out["goodput_frac"])
+            reg.gauge("goodput.step_ms").set(out["step_ms"])
+            for k, v in fracs.items():
+                reg.gauge(f"goodput.{k}_frac").set(round(v, 5))
+        except Exception:
+            pass
+    return out

@@ -24,6 +24,7 @@ Design constraints (tentpole):
 """
 from __future__ import annotations
 
+import math
 import re
 import threading
 
@@ -202,24 +203,39 @@ class Histogram:
 
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 
 def _prom_name(name):
-    n = _NAME_RE.sub("_", name)
-    if n and n[0].isdigit():
+    """Sanitize an instrument name into a VALID Prometheus metric name
+    (`[a-zA-Z_:][a-zA-Z0-9_:]*`): invalid characters become `_`, a
+    leading digit gets a `_` prefix, and an empty/fully-invalid name
+    degrades to `_` rather than an empty (spec-violating) token."""
+    n = _NAME_RE.sub("_", str(name))
+    if not n:
+        n = "_"
+    if n[0].isdigit():
         n = "_" + n
     return n
 
 
 def _prom_value(v):
+    """Render one sample value per the text-format spec: non-finite
+    floats are `+Inf`/`-Inf`/`NaN` (repr()'s `inf`/`nan` are NOT valid
+    exposition tokens)."""
     if v is None:
         return "NaN"
     if isinstance(v, bool):
         return "1" if v else "0"
     try:
-        return repr(float(v))
+        f = float(v)
     except (TypeError, ValueError):
         return "NaN"
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
 
 
 class MetricsRegistry:
@@ -284,11 +300,21 @@ class MetricsRegistry:
         single samples, histograms as summaries (quantile 0.5/0.9/0.99
         + _sum/_count)."""
         lines = []
+        seen = set()
         for name in self.names(prefix):
             inst = self.get(name)
             if inst is None:
                 continue
             pn = _prom_name(name)
+            # two distinct instrument names may sanitize to the same
+            # prom name ("a.b" and "a/b") — duplicate unlabeled samples
+            # violate the format, so later collisions get a suffix
+            if pn in seen:
+                k = 2
+                while f"{pn}_{k}" in seen:
+                    k += 1
+                pn = f"{pn}_{k}"
+            seen.add(pn)
             if isinstance(inst, Counter):
                 lines.append(f"# TYPE {pn} counter")
                 lines.append(f"{pn} {_prom_value(inst.value)}")

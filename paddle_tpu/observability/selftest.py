@@ -23,7 +23,13 @@ JSON line for BENCH_r*.json:
 * **timeline JSONL schema** — records round-trip through the file sink
   byte-exactly, with the required ts/lane/step keys;
 * **Prometheus exposition** — ``registry().expose()`` parses as valid
-  text-format lines with TYPE headers and summary quantiles.
+  text-format lines with TYPE headers and summary quantiles, including
+  sanitized names and spec-conformant non-finite values;
+* **serve-loop tracing overhead (ISSUE 13)** — the per-step work the
+  request-tracing layer adds to a serving engine (span begin/ends for
+  a full slot batch, SLO observes, the exemplar threshold check, the
+  dispatch-time observe) is measured against a representative engine's
+  decode step, with the debug HTTP server live, and must stay <= 1%.
 """
 from __future__ import annotations
 
@@ -222,6 +228,97 @@ def run_probe(n_devices=8):
 
     check("registry_overhead", overhead)
 
+    # -- serving: tracing + SLO + debug server <= 1% of serve loop -----
+    def tracing_serve_overhead():
+        import paddle_tpu as paddle
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.serving import ServingEngine
+
+        # representative (not toy) serving model — the bound is a
+        # RATIO, so the denominator must look like a step a production
+        # engine would run (h256/8L is still ~1000x under a real
+        # serving model; the ratio only gets MORE comfortable there)
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=8,
+                        num_attention_heads=8,
+                        max_position_embeddings=128,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0)
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        slots = 8
+        # decode_burst=4 is the bench-lane serving configuration
+        # (multi-step scheduling); the per-step tracing work is
+        # per-BURST, so this is the ratio production pays
+        eng = ServingEngine(model, max_slots=slots, max_len=96,
+                            page_size=16, chunk_size=32,
+                            decode_burst=4,
+                            slos=[("ttft", "ttft_s", 0.25),
+                                  ("itl", "itl_s", 0.05)])
+        port = eng.start_debug_server()       # live during measurement
+        assert port
+        rng = np.random.default_rng(3)
+        for i in range(slots):
+            eng.submit(rng.integers(1, 256, (24,)), 64, seed=i)
+        # drive until every slot is decode-active, compile included
+        while len(eng.scheduler.decode_slots()) < slots:
+            eng.step()
+        times = []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            eng.step()
+            times.append(time.perf_counter() - t0)
+        step_ms = min(times) * 1e3
+        # drain the async tail of the last dispatch before timing the
+        # host-side tracing work — leftover XLA pool threads contend
+        # for this container's capped cores and would inflate the
+        # numerator ~40x
+        jax.block_until_ready(eng._buffers)
+        time.sleep(0.05)
+        # the instrumentation one steady decode step adds, timed on the
+        # SAME live objects: a decode_burst + stream_deliver span pair
+        # per slot, the retired-flush sweep, the dispatch-time observe,
+        # plus a retire's SLO feeds and exemplar threshold check (an
+        # overestimate — retires are per request, not per step)
+        tracer = eng.tracer
+        roots = [eng.tracer.begin("request", track=f"ov{i}")
+                 for i in range(slots)]
+        reps = 50
+
+        def trial():
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                spans = [tracer.begin("decode_burst", parent=r, slot=i,
+                                      k=1, batch=slots)
+                         for i, r in enumerate(roots)]
+                for sp in spans:
+                    tracer.end(sp)
+                spans = [tracer.begin("stream_deliver", parent=r)
+                         for r in roots]
+                for sp in spans:
+                    tracer.end(sp, tokens=1)
+                eng._flush_retired()
+                eng.decode_step._dispatch_hist.observe(step_ms)
+                eng.slo.observe_metric("ttft_s", 0.01)
+                eng.slo.observe_metric("itl_s", 0.001)
+                eng._exemplar_thresholds()
+            return (time.perf_counter() - t0) / reps * 1e3
+
+        tracing_ms = min(trial() for _ in range(3))
+        for r in roots:
+            tracer.end(r)
+        eng.stop_debug_server()
+        ratio = tracing_ms / step_ms
+        rec["serve_tracing_overhead"] = {
+            "serve_step_ms": round(step_ms, 3),
+            "tracing_ms_per_step": round(tracing_ms, 4),
+            "ratio": round(ratio, 5),
+            "slots": slots,
+        }
+        assert ratio <= 0.01, rec["serve_tracing_overhead"]
+
+    check("tracing_serve_overhead", tracing_serve_overhead)
+
     # -- timeline JSONL schema round-trip ------------------------------
     def timeline_roundtrip():
         import os
@@ -252,24 +349,46 @@ def run_probe(n_devices=8):
 
     # -- Prometheus exposition format ----------------------------------
     def prometheus():
-        text = obs.registry().expose()
-        assert text.endswith("\n")
-        lines = [ln for ln in text.splitlines() if ln]
-        types = [ln for ln in lines if ln.startswith("# TYPE ")]
-        assert types, "no TYPE headers"
         import re
 
         sample = re.compile(
             r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{quantile="[0-9.]+"\})? '
             r"[^ ]+$")
-        for ln in lines:
-            if ln.startswith("#"):
-                continue
-            assert sample.match(ln), f"bad exposition line: {ln!r}"
+        values = re.compile(
+            r"^(NaN|[+-]Inf|[-+]?[0-9.eE+-]+)$")
+
+        def check_text(text):
+            assert text.endswith("\n")
+            lines = [ln for ln in text.splitlines() if ln]
+            assert any(ln.startswith("# TYPE ") for ln in lines), \
+                "no TYPE headers"
+            for ln in lines:
+                if ln.startswith("#"):
+                    continue
+                assert sample.match(ln), f"bad exposition line: {ln!r}"
+                assert values.match(ln.split()[-1]), f"bad value: {ln!r}"
+                assert " inf" not in ln and " nan" not in ln, ln
+            return lines
+
+        lines = check_text(obs.registry().expose())
         # the summary form carries quantiles + sum/count
         assert any('quantile="0.99"' in ln for ln in lines)
         assert any(ln.split()[0].endswith("_count") for ln in lines
                    if not ln.startswith("#"))
+        # adversarial instruments (names that need sanitizing, values
+        # that need the spec's non-finite tokens — ISSUE 13 satellite)
+        # go on a PRIVATE registry: registration is permanent, and the
+        # global scrape must not carry junk series after this lane
+        g = obs.MetricsRegistry()
+        g.counter("ok.counter").inc()
+        g.histogram("ok.hist").observe(1.0)
+        g.gauge("bad name!{} (weird)").set(float("inf"))
+        g.gauge("0leading.digit").set(float("-inf"))
+        g.gauge("nan.gauge").set(float("nan"))
+        lines = check_text(g.expose())
+        assert any(ln.split()[-1] == "+Inf" for ln in lines)
+        assert any(ln.split()[-1] == "-Inf" for ln in lines)
+        assert any(ln.split()[-1] == "NaN" for ln in lines)
 
     check("prometheus_exposition", prometheus)
 

@@ -1,0 +1,200 @@
+"""SLO tracking: declared objectives with rolling-window burn rates.
+
+An SLO here is "at least ``target`` of events keep ``metric`` ≤
+``threshold``" (e.g. "99% of requests get TTFT ≤ 250 ms") evaluated
+over a rolling time window. Each ``observe`` is O(1): the sample
+becomes a (timestamp, ok) pair in a bounded window deque; everything
+derived — good fraction, burn rate, breach flag — is computed lazily at
+scrape time from the samples still inside the window.
+
+**Burn-rate semantics** (the standard SRE definition): the error budget
+is ``1 - target`` (the fraction of events ALLOWED to violate). The burn
+rate is ``bad_fraction / (1 - target)`` over the window — 1.0 means the
+budget is being consumed exactly at the sustainable rate, >1 means the
+objective will be violated if the window's behavior continues, and the
+``breaching`` flag is simply ``good_fraction < target`` (budget already
+overdrawn inside this window). A window with no samples reports burn
+rate 0 and not-breaching (no traffic is not an outage).
+
+Gauges (lazy, scrape-time only) land on the bound registry as
+``slo.<name>.good_fraction`` / ``slo.<name>.burn_rate`` /
+``slo.<name>.breaching`` — so a Prometheus scrape of the serving
+engine's registry carries burn rates next to the latency summaries.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from .registry import registry as _registry
+
+__all__ = ["SLO", "SLOTracker"]
+
+
+class SLO:
+    """One declared objective: ``metric`` ≤ ``threshold`` for at least
+    ``target`` of events over a rolling ``window_s`` window."""
+
+    __slots__ = ("name", "metric", "threshold", "target", "window_s",
+                 "description", "_window", "_lock", "_memo",
+                 "total_observed", "total_bad")
+
+    def __init__(self, name, metric, threshold, target=0.99,
+                 window_s=60.0, description="", max_samples=65536):
+        if not (0.0 < target < 1.0):
+            raise ValueError(f"target must be in (0, 1), got {target}")
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.name = name
+        self.metric = metric
+        self.threshold = float(threshold)
+        self.target = float(target)
+        self.window_s = float(window_s)
+        self.description = description
+        self._window = collections.deque(maxlen=int(max_samples))
+        self._lock = threading.Lock()
+        self._memo = None
+        self.total_observed = 0
+        self.total_bad = 0
+
+    def observe(self, value, now):
+        ok = float(value) <= self.threshold
+        with self._lock:
+            self._window.append((now, ok))
+            self.total_observed += 1
+            if not ok:
+                self.total_bad += 1
+
+    def _prune(self, now):
+        # caller holds the lock
+        lo = now - self.window_s
+        w = self._window
+        while w and w[0][0] < lo:
+            w.popleft()
+
+    def status(self, now) -> dict:
+        # one /metrics scrape evaluates three lazy gauges per SLO,
+        # each needing this dict — memoize keyed on (sample count,
+        # ~now) so a scrape prices the O(window) prune/count ONCE, and
+        # any new observation or time movement invalidates it
+        key = (self.total_observed, round(now, 1))
+        memo = self._memo
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        with self._lock:
+            self._prune(now)
+            n = len(self._window)
+            bad = sum(1 for _, ok in self._window if not ok)
+        good_frac = (n - bad) / n if n else 1.0
+        budget = 1.0 - self.target
+        burn = (bad / n) / budget if n else 0.0
+        st = {
+            "name": self.name, "metric": self.metric,
+            "threshold": self.threshold, "target": self.target,
+            "window_s": self.window_s, "samples": n, "bad": bad,
+            "good_fraction": round(good_frac, 6),
+            "burn_rate": round(burn, 4),
+            "breaching": bool(n and good_frac < self.target),
+            "total_observed": self.total_observed,
+            "total_bad": self.total_bad,
+        }
+        self._memo = (key, st)
+        return st
+
+    def reset(self):
+        with self._lock:
+            self._window.clear()
+            self._memo = None
+            self.total_observed = 0
+            self.total_bad = 0
+
+
+class SLOTracker:
+    """A set of SLOs fed by metric name. The serving engine owns one:
+    ``declare`` at construction, `ServingMetrics.on_finish` feeds
+    ``observe_metric("ttft_s", ...)`` / ``("itl_s", ...)`` per retired
+    request, and the lazy gauges publish burn rates on every scrape."""
+
+    def __init__(self, registry=None, clock=time.monotonic):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._slos: dict = {}            # name -> SLO
+        self._by_metric: dict = {}       # metric -> [SLO]
+        self._registry = registry if registry is not None else _registry()
+
+    def declare(self, name, metric, threshold, target=0.99,
+                window_s=60.0, description="") -> SLO:
+        """Register an objective; re-declaring a name replaces it."""
+        slo = SLO(name, metric, threshold, target=target,
+                  window_s=window_s, description=description)
+        with self._lock:
+            old = self._slos.get(name)
+            if old is not None:
+                self._by_metric[old.metric] = [
+                    s for s in self._by_metric.get(old.metric, [])
+                    if s is not old]
+            self._slos[name] = slo
+            self._by_metric.setdefault(metric, []).append(slo)
+        self._bind_gauges(slo, self._registry)
+        return slo
+
+    def _bind_gauges(self, slo, reg):
+        if reg is None:
+            return
+        base = f"slo.{slo.name}"
+        reg.gauge(f"{base}.good_fraction").set_fn(
+            lambda s=slo: s.status(self.clock())["good_fraction"])
+        reg.gauge(f"{base}.burn_rate").set_fn(
+            lambda s=slo: s.status(self.clock())["burn_rate"])
+        reg.gauge(f"{base}.breaching").set_fn(
+            lambda s=slo: s.status(self.clock())["breaching"])
+
+    def bind_registry(self, reg):
+        """Re-register every SLO's gauges (the engine rebinds after
+        `reset_metrics` swaps its registry)."""
+        self._registry = reg
+        with self._lock:
+            slos = list(self._slos.values())
+        for slo in slos:
+            self._bind_gauges(slo, reg)
+
+    # -- feeds -----------------------------------------------------------
+    def observe(self, name, value):
+        """Feed one sample to the named SLO. O(1)."""
+        slo = self._slos.get(name)
+        if slo is not None:
+            slo.observe(value, self.clock())
+
+    def observe_metric(self, metric, value):
+        """Feed one sample to every SLO declared on ``metric``. O(#slos
+        on that metric) — the producer does not need to know which
+        objectives exist."""
+        for slo in self._by_metric.get(metric, ()):
+            slo.observe(value, self.clock())
+
+    # -- surface ---------------------------------------------------------
+    def names(self):
+        with self._lock:
+            return sorted(self._slos)
+
+    def status(self, name) -> dict:
+        return self._slos[name].status(self.clock())
+
+    def snapshot(self) -> dict:
+        now = self.clock()
+        with self._lock:
+            slos = list(self._slos.values())
+        return {s.name: s.status(now) for s in slos}
+
+    def breaching(self) -> list:
+        """Names of SLOs currently over budget in their window."""
+        return [n for n, st in self.snapshot().items()
+                if st["breaching"]]
+
+    def reset(self):
+        """Clear every window (engine warmup) — declarations stay."""
+        with self._lock:
+            slos = list(self._slos.values())
+        for s in slos:
+            s.reset()

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import collections
 import json
+import os
+import re
 import threading
 import time
 
@@ -40,36 +42,134 @@ def drain_chrome_counters():
 
 class JsonlSink:
     """Append-a-line-per-record file sink (flushed per record so a
-    crash loses at most the in-flight line)."""
+    crash loses at most the in-flight line).
 
-    def __init__(self, path):
+    ``max_bytes`` caps the live file: when the next line would cross
+    the cap, the file rolls over (``timeline.jsonl`` ->
+    ``timeline.jsonl.1``, existing ``.1`` -> ``.2``, ... up to
+    ``backups`` segments, the oldest dropped) — a multi-hour serve or
+    bench run cannot grow the per-step timeline unbounded.
+    `read_jsonl` follows the rotated segments oldest-first."""
+
+    def __init__(self, path, max_bytes=None, backups=3):
         self.path = path
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.backups = max(1, int(backups))
         self._lock = threading.Lock()
+        if self.max_bytes is not None:
+            self._prune_beyond_cap()
         self._f = open(path, "a")
+        try:
+            self._size = os.path.getsize(path)
+        except OSError:
+            self._size = 0
+
+    def _prune_beyond_cap(self):
+        """Remove rotated segments past the current ``backups`` cap —
+        leftovers from an earlier run (or a larger previous cap) would
+        otherwise survive forever and prepend stale records to every
+        `read_jsonl` of this path."""
+        for idx, p in _rotated_segments(self.path):
+            if idx > self.backups:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    def _rotate(self):
+        # caller holds the lock. A failed rename must DEGRADE (keep
+        # appending to the oversized file) — it must never leave the
+        # sink holding a closed handle that turns every later step's
+        # record into an IO error in the hot loop.
+        self._f.flush()
+        self._f.close()
+        try:
+            for i in range(self.backups - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+            self._prune_beyond_cap()
+        except OSError:
+            # degrade ONCE: keep appending uncapped rather than paying
+            # a doomed flush/close/rename/reopen on every later record
+            self.max_bytes = None
+        finally:
+            self._f = open(self.path, "a")
+            try:
+                self._size = os.path.getsize(self.path)
+            except OSError:
+                self._size = 0
 
     def __call__(self, record: dict):
-        line = json.dumps(record)
+        line = json.dumps(record) + "\n"
         with self._lock:
             if self._f is None:
                 return
-            self._f.write(line + "\n")
+            if (self.max_bytes is not None and self._size
+                    and self._size + len(line) > self.max_bytes):
+                self._rotate()
+            self._f.write(line)
             self._f.flush()
+            self._size += len(line)
 
     def close(self):
         with self._lock:
             if self._f is not None:
+                self._f.flush()
                 self._f.close()
                 self._f = None
 
 
-def read_jsonl(path):
-    """Load a timeline JSONL file back into a list of dicts."""
+_ROTATED_RE = re.compile(r"\.(\d+)$")
+
+
+def _rotated_segments(path):
+    """Existing ``path.N`` rotation siblings as [(N, path)], ascending."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    segs = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith(base + "."):
+            m = _ROTATED_RE.search(name[len(base):])
+            if m:
+                segs.append((int(m.group(1)), os.path.join(d, name)))
+    return sorted(segs)
+
+
+def read_jsonl(path, follow_rotated=True):
+    """Load a timeline JSONL file back into a list of dicts. With
+    ``follow_rotated`` (default), rotated segments (``path.N`` ...
+    ``path.1``) are read first — highest index = oldest — so the
+    result is one in-order record stream across rollovers. A rotated
+    sibling that is not valid JSONL (a stray ``path.<digits>`` file)
+    is skipped rather than poisoning the read; the MAIN file still
+    raises on corruption."""
+    paths = [(path, True)]
+    if follow_rotated:
+        paths = [(p, False) for _, p in
+                 sorted(_rotated_segments(path), reverse=True)] \
+            + [(path, True)]
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+    for p, strict in paths:
+        if not os.path.exists(p):
+            continue
+        recs = []
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        recs.append(json.loads(line))
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            if strict:
+                raise
+            continue
+        out.extend(recs)
     return out
 
 

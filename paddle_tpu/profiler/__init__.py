@@ -66,6 +66,10 @@ class _ProfileResult:
     # observability StepTimeline at cycle end (ISSUE 12): step metrics
     # render as counter lanes under the host spans
     counters: list = field(default_factory=list)
+    # chrome request-track span events ("ph": "X"/"M") drained from the
+    # observability Tracer (ISSUE 13): per-request serving timelines
+    # render as their own thread tracks next to the counter lanes
+    request_spans: list = field(default_factory=list)
 
     def chrome_trace(self) -> dict:
         evts = []
@@ -81,6 +85,7 @@ class _ProfileResult:
                 "ts": s / 1e3, "dur": (t - s) / 1e3, "pid": 0, "tid": 0,
             })
         evts.extend(self.counters)
+        evts.extend(self.request_spans)
         return {"traceEvents": evts, "displayTimeUnit": "ms"}
 
 
@@ -318,25 +323,36 @@ class Profiler:
     def _finish_cycle(self):
         events = _recorder.drain()
         steps = list(self._steps)
+        # the chrome buffers are process-global and may hold a long
+        # backlog recorded before this profiling cycle (a timeline or
+        # tracer running with no Profiler active) — keep only events
+        # inside the cycle's host window (buffer ts is µs on the same
+        # perf_counter timebase as the span ns timestamps)
+        lo = min([s for _, s, _ in steps]
+                 + [e.start_ns for e in events], default=None)
         try:
             from ..observability import drain_chrome_counters
 
             counters = drain_chrome_counters()
-            # the counter buffer is process-global and may hold a long
-            # backlog recorded before this profiling cycle (a timeline
-            # running with no Profiler active) — keep only events
-            # inside the cycle's host window (counter ts is µs on the
-            # same perf_counter timebase as the span ns timestamps)
-            lo = min([s for _, s, _ in steps]
-                     + [e.start_ns for e in events], default=None)
             if lo is not None:
                 counters = [c for c in counters if c["ts"] * 1e3 >= lo]
         except Exception:
             counters = []
+        try:
+            from ..observability import drain_chrome_spans
+
+            spans = drain_chrome_spans()
+            # metadata ("ph": "M", no ts) is kept unconditionally
+            if lo is not None:
+                spans = [s for s in spans
+                         if s.get("ph") == "M"
+                         or s.get("ts", 0) * 1e3 >= lo]
+        except Exception:
+            spans = []
         self._last_result = _ProfileResult(
             events=events, steps=steps,
             device_trace_dir=self._trace_dir if self._device_on else None,
-            counters=counters)
+            counters=counters, request_spans=spans)
         self._steps = []
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)

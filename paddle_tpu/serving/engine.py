@@ -22,6 +22,17 @@ The cache's device state threads functionally through the steps with
 the KV pools donated (HBM-neutral steady state); the host bookkeeping
 (page tables, active flags, free lists) is refreshed into the step
 inputs each call — an input refresh, never a retrace.
+
+Tracing (ISSUE 13): every request carries a root span from submit to
+retire with children for queue wait, admission, each chunked prefill
+call (bucket, batch composition, slot, pages held), each decode burst
+(k, batch), preemption/resume (victim reason, pages reclaimed) and
+stream delivery — a retired request's trace is a complete causal
+timeline. Requests whose TTFT or worst inter-token gap lands beyond a
+configurable percentile of the live distribution keep their full span
+tree in the tail-exemplar ring (`slow_requests()`); declared SLOs get
+rolling-window burn-rate gauges; `start_debug_server()` serves
+/metrics /healthz /tracez /sloz /flightz over loopback.
 """
 from __future__ import annotations
 
@@ -33,6 +44,7 @@ from ..inference.kv_cache import PagedKVCache
 from ..jit.decode_step import (ChunkPrefillStep, ServeDecodeStep,
                                _split_state)
 from ..jit.train_step import _tree_data
+from ..observability import SLOTracker, Tracer
 from .metrics import ServingMetrics
 from .request import FinishReason, Request, RequestHandle, RequestState
 from .scheduler import RequestScheduler
@@ -47,7 +59,10 @@ class ServingEngine:
                  decode_burst=1, do_sample=False, top_k=0, top_p=1.0,
                  temperature=1.0, compiled=True, cache_dtype=None,
                  donate=True, admit_watermark="auto",
-                 clock=time.perf_counter):
+                 clock=time.perf_counter,
+                 trace=True, trace_capacity=256, exemplar_capacity=32,
+                 exemplar_quantile=99.0, exemplar_min_samples=32,
+                 slos=(), debug_port=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -90,9 +105,34 @@ class ServingEngine:
                              1 + self.max_slots * self.pages_per_seq)
         self._params = list(model.parameters())
         self.cache = self._make_cache()
-        self.metrics = ServingMetrics(clock=clock)
+        # request-scoped tracing + SLOs (ISSUE 13): per-engine tracer
+        # over the per-engine registry; `slos` declares objectives as
+        # (name, metric, threshold_s[, target[, window_s]]) tuples,
+        # e.g. slos=[("ttft", "ttft_s", 0.25, 0.99, 60.0)]
+        from ..observability import MetricsRegistry
+
+        self.exemplar_quantile = float(exemplar_quantile)
+        self.exemplar_min_samples = int(exemplar_min_samples)
+        reg = MetricsRegistry()
+        self.slo = SLOTracker(registry=reg, clock=clock)
+        for spec in (slos or ()):
+            self.declare_slo(*spec)
+        self.metrics = ServingMetrics(clock=clock, registry=reg,
+                                      slo=self.slo)
+        self.tracer = Tracer(capacity=trace_capacity,
+                             exemplar_capacity=exemplar_capacity,
+                             clock=clock,
+                             registry=self.metrics.registry,
+                             enabled=trace)
+        self._retired_this_call: list = []
+        self._exemplar_thr = (None, None)
+        self._exemplar_refresh_at = 0
+        self._debug_server = None
+        if debug_port is not None:
+            self.start_debug_server(debug_port)
         self.scheduler = RequestScheduler(
-            self.cache, self.metrics, admit_watermark=admit_watermark)
+            self.cache, self.metrics, admit_watermark=admit_watermark,
+            tracer=self.tracer)
         self.prefill_step = ChunkPrefillStep(self, donate_cache=donate)
         self.decode_step = ServeDecodeStep(self, donate_cache=donate)
         bkts, b = [], 8
@@ -145,6 +185,13 @@ class ServingEngine:
         handle = RequestHandle(req, on_token=on_token)
         handle.arrival_seq = rid
         handle.submit_time = self.clock()
+        # root of this request's causal timeline + the first queue wait
+        handle._span = self.tracer.begin(
+            "request", track=f"req{rid}", rid=rid,
+            prompt_len=int(prompt.size),
+            max_new_tokens=int(max_new_tokens), priority=int(priority))
+        handle._span_queue = self.tracer.begin("queue_wait",
+                                               parent=handle._span)
         self.scheduler.enqueue(handle)
         self.metrics.on_submit()
         return handle
@@ -159,6 +206,14 @@ class ServingEngine:
                 # streams (per_slot_keys folds the raw 32-bit value)
                 self._seeds[h.slot] = np.uint32(
                     h.request.seed & 0xFFFFFFFF)
+                self.tracer.end(h._span_queue,
+                                resumed=h.preemptions > 0)
+                h._span_queue = None
+                self.tracer.instant(
+                    "admit", parent=h._span, slot=h.slot,
+                    pages_held=len(
+                        self.cache._slot_pages.get(h.slot, ())),
+                    resumed=h.preemptions > 0)
             worked = False
             for _ in range(self.prefill_chunks_per_step):
                 heads = sched.prefill_heads(self.prefill_batch)
@@ -226,9 +281,19 @@ class ServingEngine:
 
     def reset_metrics(self):
         """Fresh counters (e.g. after a compile warmup run) — the bench
-        lanes measure steady-state serving, not trace time."""
-        self.metrics = ServingMetrics(clock=self.clock)
+        lanes measure steady-state serving, not trace time. Traces and
+        SLO windows clear too (warmup spans are compile noise); SLO
+        declarations and the tracer survive, rebound onto the fresh
+        registry."""
+        self.slo.reset()
+        self.metrics = ServingMetrics(clock=self.clock, slo=self.slo)
         self.scheduler.metrics = self.metrics
+        self.slo.bind_registry(self.metrics.registry)
+        self.tracer.clear()
+        self.tracer.bind_registry(self.metrics.registry)
+        self._exemplar_thr = (None, None)
+        self._exemplar_refresh_at = 0
+        self._retired_this_call.clear()
 
     def warmup(self):
         """Compile every program the serving loop can hit — the decode
@@ -275,6 +340,13 @@ class ServingEngine:
                             h.prefill_pos + self.chunk_size]
                   for h in heads]
         bucket = self._chunk_bucket(max(len(c) for c in chunks))
+        spans = [self.tracer.begin(
+            "prefill_chunk", parent=h._span, slot=h.slot,
+            bucket=bucket, chunk_len=len(c), start=int(h.prefill_pos),
+            batch=len(heads),
+            pages_held=len(self.cache._slot_pages.get(h.slot, ())),
+            resume=h.preemptions > 0)
+            for h, c in zip(heads, chunks)]
         ids = np.zeros((B, bucket), np.int32)
         slot_ids = np.full((B,), self.max_slots, np.int32)
         start = np.zeros((B,), np.int32)
@@ -286,25 +358,39 @@ class ServingEngine:
             start[j] = h.prefill_pos
             lens_new[j] = h.prefill_pos + len(chunk)
             seeds[j] = self._seeds[h.slot]
-        ids_next, _logits, buffers, meta = self.prefill_step(
-            self._param_data(), self._buffers, self._meta(),
-            ids, slot_ids, start, lens_new, seeds)
-        self._commit(buffers, meta)
-        tok = None
-        for j, (h, chunk) in enumerate(zip(heads, chunks)):
-            self.metrics.prefill_chunks += 1
-            h.prefill_pos += len(chunk)
-            if h.prefill_pos < len(h.pending):
-                continue
-            # prompt fully cached: the sampled token is the request's
-            # next real token (its FIRST on a fresh admission -> TTFT)
-            if tok is None:
-                tok = np.asarray(ids_next)
-            self.cache.set_active(h.slot, True)
-            h.state = RequestState.RUNNING
-            token = int(tok[j])
-            self._tokens[h.slot] = token
-            self._emit(h, token)
+        # spans must close even when the compiled call (or a user
+        # on_token callback) raises — a leaked open span would sit in
+        # the tracer's open set forever and break the zero-orphan
+        # invariant after the engine recovers
+        try:
+            ids_next, _logits, buffers, meta = self.prefill_step(
+                self._param_data(), self._buffers, self._meta(),
+                ids, slot_ids, start, lens_new, seeds)
+            self._commit(buffers, meta)
+            for sp in spans:
+                self.tracer.end(sp)
+            tok = None
+            for j, (h, chunk) in enumerate(zip(heads, chunks)):
+                self.metrics.prefill_chunks += 1
+                h.prefill_pos += len(chunk)
+                if h.prefill_pos < len(h.pending):
+                    continue
+                # prompt fully cached: the sampled token is the
+                # request's next real token (its FIRST on a fresh
+                # admission -> TTFT)
+                if tok is None:
+                    tok = np.asarray(ids_next)
+                self.cache.set_active(h.slot, True)
+                h.state = RequestState.RUNNING
+                token = int(tok[j])
+                self._tokens[h.slot] = token
+                self.tracer.instant("stream_deliver", parent=h._span,
+                                    tokens=1, first=True)
+                self._emit(h, token)
+        finally:
+            for sp in spans:
+                self.tracer.end(sp, error=True)
+            self._flush_retired()
 
     def _run_decode(self) -> bool:
         sched = self.scheduler
@@ -337,23 +423,43 @@ class ServingEngine:
                 and sched.running[s].state is RequestState.RUNNING]
         if not live:
             return False
-        out, _logits, buffers, meta = self.decode_step(
-            self._param_data(), self._buffers, self._meta(),
-            self._tokens, self._seeds)
-        self._commit(buffers, meta)
-        # ONE host sync per burst: [k, b] sampled ids (the in-graph
-        # burst re-feeds them without the host round-trip)
-        step_tokens = np.asarray(out)
-        self.metrics.decode_steps += k
-        for tok in step_tokens:
-            for slot in live:
-                handle = sched.running.get(slot)
-                if (handle is None
-                        or handle.state is not RequestState.RUNNING):
-                    continue   # retired earlier in this burst
-                token = int(tok[slot])
-                self._tokens[slot] = token
-                self._emit(handle, token)
+        # spans must close even when the compiled call (or a user
+        # on_token callback) raises — see _run_prefill_chunk
+        dspans = {slot: self.tracer.begin(
+            "decode_burst", parent=sched.running[slot]._span,
+            slot=slot, k=k, batch=len(live)) for slot in live}
+        sspans = {}
+        emitted = dict.fromkeys(live, 0)
+        try:
+            out, _logits, buffers, meta = self.decode_step(
+                self._param_data(), self._buffers, self._meta(),
+                self._tokens, self._seeds)
+            self._commit(buffers, meta)
+            # ONE host sync per burst: [k, b] sampled ids (the
+            # in-graph burst re-feeds them without the host round-trip)
+            step_tokens = np.asarray(out)
+            for sp in dspans.values():   # burst span covers the sync
+                self.tracer.end(sp)
+            sspans = {slot: self.tracer.begin(
+                "stream_deliver", parent=sched.running[slot]._span)
+                for slot in live if sched.running.get(slot) is not None}
+            self.metrics.decode_steps += k
+            for tok in step_tokens:
+                for slot in live:
+                    handle = sched.running.get(slot)
+                    if (handle is None or handle.state
+                            is not RequestState.RUNNING):
+                        continue   # retired earlier in this burst
+                    token = int(tok[slot])
+                    self._tokens[slot] = token
+                    emitted[slot] += 1
+                    self._emit(handle, token)
+        finally:
+            for sp in dspans.values():
+                self.tracer.end(sp, error=True)
+            for slot, sp in sspans.items():
+                self.tracer.end(sp, tokens=emitted[slot])
+            self._flush_retired()
         return True
 
     def _emit(self, handle: RequestHandle, token: int):
@@ -364,8 +470,122 @@ class ServingEngine:
         if (req.eos_token_id is not None
                 and token == req.eos_token_id):
             self.scheduler.retire(handle.slot, FinishReason.EOS, now)
+            self._retired_this_call.append(handle)
         elif len(handle.output_tokens) >= req.max_new_tokens:
             self.scheduler.retire(handle.slot, FinishReason.LENGTH, now)
+            self._retired_this_call.append(handle)
+
+    def _flush_retired(self):
+        """Close the trace of every request retired by the call that
+        just finished (deferred past the stream spans so children never
+        end after their root) and run the tail-exemplar check."""
+        for h in self._retired_this_call:
+            root = h._span
+            if root is None:
+                continue
+            self.tracer.end(h._span_queue)      # defensive: never open
+            h._span_queue = None
+            self.tracer.end(
+                root,
+                finish=(h.finish_reason.value if h.finish_reason
+                        else None),
+                tokens=len(h.output_tokens),
+                preemptions=h.preemptions,
+                ttft_ms=(round(h.ttft * 1e3, 3)
+                         if h.ttft is not None else None))
+            self._maybe_exemplar(h, root)
+            h._span = None
+        self._retired_this_call.clear()
+
+    def _exemplar_thresholds(self):
+        """(ttft_thr, itl_thr) at `exemplar_quantile`, refreshed every
+        few retirements — percentile selection sorts the ring window,
+        which must not run on every retire."""
+        m = self.metrics
+        if m.finished >= self._exemplar_refresh_at:
+            q = self.exemplar_quantile
+            n = self.exemplar_min_samples
+            self._exemplar_thr = (
+                m.ttft_s.percentile(q) if m.ttft_s.count >= n else None,
+                m.itl_s.percentile(q) if m.itl_s.count >= n else None)
+            self._exemplar_refresh_at = m.finished + max(
+                1, self.exemplar_min_samples // 4)
+        return self._exemplar_thr
+
+    def _maybe_exemplar(self, handle: RequestHandle, root):
+        """Tail-latency forensics: keep the full span tree of a request
+        whose TTFT or worst inter-token gap lands beyond the configured
+        percentile of the live distribution (threshold selection needs
+        `exemplar_min_samples` observations first — early traffic must
+        not all read as slow)."""
+        q = self.exemplar_quantile
+        ttft = handle.ttft
+        itls = handle.inter_token_latencies
+        why = []
+        ttft_thr, itl_thr = self._exemplar_thresholds()
+        if ttft is not None and ttft_thr is not None \
+                and ttft > ttft_thr:
+            why.append(f"ttft>p{q:g}")
+        if itls and itl_thr is not None and max(itls) > itl_thr:
+            why.append(f"itl>p{q:g}")
+        if handle.preemptions and why:
+            why.append("preempted")
+        if why:
+            self.tracer.add_exemplar(
+                root, ",".join(why), rid=handle.request.rid,
+                ttft_s=None if ttft is None else round(ttft, 6),
+                max_itl_s=round(max(itls), 6) if itls else None,
+                preemptions=handle.preemptions)
+
+    def slow_requests(self) -> list:
+        """Tail exemplars: full span trees of the slowest requests
+        (TTFT / inter-token outliers past `exemplar_quantile`), oldest
+        first — each entry {reason, rid, ttft_s, max_itl_s, trace}."""
+        return self.tracer.exemplars()
+
+    def request_trace(self, rid):
+        """The completed root Span of request ``rid`` (None if it fell
+        off the trace ring) — the per-request forensics lookup."""
+        return self.tracer.find_trace(f"req{int(rid)}")
+
+    def declare_slo(self, name, metric, threshold_s, target=0.99,
+                    window_s=60.0):
+        """Declare a serving objective, e.g. ("ttft", "ttft_s", 0.25):
+        at least `target` of requests get `metric` <= `threshold_s`
+        over a rolling `window_s` window. Burn-rate/breach gauges land
+        on this engine's registry (`metrics_text()` scrapes them);
+        `slo_status()` returns the live snapshot."""
+        if metric not in ("ttft_s", "itl_s"):
+            raise ValueError(
+                f"unknown SLO metric {metric!r}: the serving engine "
+                "feeds 'ttft_s' and 'itl_s'")
+        return self.slo.declare(name, metric, threshold_s,
+                                target=target, window_s=window_s)
+
+    def slo_status(self) -> dict:
+        return self.slo.snapshot()
+
+    def start_debug_server(self, port=0) -> int:
+        """Opt-in loopback debug/scrape server for THIS engine:
+        /metrics (this engine's registry as Prometheus text, ==
+        `metrics_text()`), /healthz, /tracez (recent traces + tail
+        exemplars), /sloz (burn rates), /flightz (process flight
+        recorder). Returns the bound port."""
+        if self._debug_server is not None:
+            return self._debug_server.port
+        from ..observability import DebugServer
+
+        self._debug_server = DebugServer(
+            registry=lambda: self.metrics.registry,
+            tracer=lambda: self.tracer,
+            extra={"sloz": lambda: self.slo.snapshot()},
+            port=port)
+        return self._debug_server.start()
+
+    def stop_debug_server(self):
+        if self._debug_server is not None:
+            self._debug_server.stop()
+            self._debug_server = None
 
     def _recover(self):
         """A failed step leaves donated buffers dead — rebuild the cache

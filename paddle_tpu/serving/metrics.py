@@ -35,8 +35,12 @@ class ServingMetrics:
                  "decode_steps", "generated_tokens")
     _GAUGES = ("queue_depth", "running")
 
-    def __init__(self, clock=time.perf_counter, registry=None):
+    def __init__(self, clock=time.perf_counter, registry=None,
+                 slo=None):
         self.clock = clock
+        # optional observability.SLOTracker (ISSUE 13): every retired
+        # request's TTFT/ITL samples feed the declared objectives
+        self.slo = slo
         self.start_time = clock()
         # counters
         self.submitted = 0
@@ -92,10 +96,16 @@ class ServingMetrics:
 
     def on_finish(self, handle):
         self.finished += 1
+        itls = handle.inter_token_latencies
         if handle.ttft is not None:
             self.ttft_s.observe(handle.ttft)
-        self.itl_s.extend(handle.inter_token_latencies)
+        self.itl_s.extend(itls)
         self.request_preemptions.observe(handle.preemptions)
+        if self.slo is not None:
+            if handle.ttft is not None:
+                self.slo.observe_metric("ttft_s", handle.ttft)
+            for itl in itls:
+                self.slo.observe_metric("itl_s", itl)
 
     def observe(self, queue_depth: int, running: int):
         self.queue_depth = queue_depth

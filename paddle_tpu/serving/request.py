@@ -73,6 +73,11 @@ class RequestHandle:
         self.pending = np.asarray(request.prompt, np.int32)
         self.preemptions = 0
         self.arrival_seq: int | None = None   # FIFO tie-break, set by engine
+        # tracing (ISSUE 13): the root span of this request's causal
+        # timeline and the currently-open queue-wait child (set by the
+        # engine at submit, re-opened by the scheduler on preemption)
+        self._span = None
+        self._span_queue = None
         # timing
         self.submit_time: float | None = None
         self.first_token_time: float | None = None

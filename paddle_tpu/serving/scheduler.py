@@ -34,12 +34,14 @@ __all__ = ["RequestScheduler"]
 
 
 class RequestScheduler:
-    def __init__(self, cache, metrics, admit_watermark="auto"):
+    def __init__(self, cache, metrics, admit_watermark="auto",
+                 tracer=None):
         self.cache = cache
         self.metrics = metrics
         self.waiting: list[RequestHandle] = []   # kept sorted (see _key)
         self.running: dict[int, RequestHandle] = {}   # slot -> handle
         self.admit_watermark = admit_watermark
+        self.tracer = tracer            # set by the engine (ISSUE 13)
 
     # -- queue ------------------------------------------------------------
     @staticmethod
@@ -112,14 +114,26 @@ class RequestScheduler:
             return None
         return max(cands, key=lambda s: self._key(self.running[s]))
 
-    def preempt(self, slot: int) -> RequestHandle:
+    def preempt(self, slot: int, reason: str = "pool_dry"
+                ) -> RequestHandle:
         """Evict `slot`: pages to the pool, request back to the queue
-        (keeping its arrival rank) for resume-by-re-prefill."""
+        (keeping its arrival rank) for resume-by-re-prefill.
+        ``reason`` lands on the request's trace: "pool_dry" (evicted
+        for a neighbour), "self_sacrifice" (every candidate outranked
+        it), "abort" (engine recovery)."""
         handle = self.running.pop(slot)
         pages = len(self.cache._slot_pages.get(slot, ()))
         self.cache.free(slot)
+        if self.tracer is not None and handle._span is not None:
+            self.tracer.instant("preempt", parent=handle._span,
+                                reason=reason, slot=slot,
+                                pages_reclaimed=pages,
+                                tokens_so_far=len(handle.output_tokens))
         handle._requeue_for_resume()
         self.enqueue(handle)
+        if self.tracer is not None and handle._span is not None:
+            handle._span_queue = self.tracer.begin(
+                "queue_wait", parent=handle._span, resume=True)
         self.metrics.on_preempt(pages_reclaimed=pages)
         return handle
 
@@ -139,9 +153,9 @@ class RequestScheduler:
                 # exists) — growing it by evicting a higher-priority
                 # neighbour would invert the policy, so it sacrifices
                 # itself
-                self.preempt(slot)
+                self.preempt(slot, reason="self_sacrifice")
                 return False
-            self.preempt(victim)
+            self.preempt(victim, reason="pool_dry")
         cache.reserve(slot, need)
         return True
 
@@ -172,4 +186,5 @@ class RequestScheduler:
     def abort_all(self) -> list[RequestHandle]:
         """Recovery path (engine step failure): every resident request
         re-queues for resume; the caller rebuilds the cache."""
-        return [self.preempt(slot) for slot in list(self.running)]
+        return [self.preempt(slot, reason="abort")
+                for slot in list(self.running)]

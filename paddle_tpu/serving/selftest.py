@@ -20,6 +20,14 @@ for BENCH_r*.json:
 * **traffic A/B** — continuous vs static generate-and-wait batching at
   three concurrency levels: p50/p99 TTFT and aggregate tok/s, with
   continuous required to win on tok/s at the highest level.
+* **trace forensics (ISSUE 13)** — under churn with preemptions every
+  retired request's trace is a complete causal timeline (root span
+  with >=1 prefill child and >=1 decode child; preempted-then-resumed
+  requests show preempt + resume-prefill spans), zero orphan spans
+  remain after drain + ``abort_all`` (including an abort taken
+  MID-FLIGHT and then drained), tail exemplars populate under a low
+  quantile, and the retrace sentinel still reports 0 unexpected
+  recompiles with the tracing instrumentation live.
 """
 from __future__ import annotations
 
@@ -185,10 +193,77 @@ def run_probe():
             levels["users8"]["static"]["tok_s"], levels["users8"]
         rec["continuous_wins"] = f"{win}/3"
 
+    # -- trace completeness under churn with preemptions (ISSUE 13) -------
+    def trace_forensics():
+        def churn(eng, n_tok=8):
+            hs = []
+            for i, p in enumerate(prompts):
+                hs.append(eng.submit(p, n_tok, seed=50 + i))
+                eng.step()
+            eng.run(max_steps=5000)
+            return hs
+
+        eng = ServingEngine(m, max_slots=3, max_len=48, page_size=8,
+                            chunk_size=8, num_pages=10, do_sample=True,
+                            exemplar_quantile=50.0,
+                            exemplar_min_samples=4)
+        handles = churn(eng)
+        assert eng.metrics.preemptions >= 1, \
+            "pool never dried — forensics lane not exercising preemption"
+        for h in handles:
+            root = eng.request_trace(h.request.rid)
+            assert root is not None and root.closed, h
+            assert len(root.find("prefill_chunk")) >= 1, h
+            assert len(root.find("decode_burst")) >= 1, h
+            assert root.attrs.get("finish") in ("eos", "length"), root
+            if h.preemptions:
+                pre = root.find("preempt")
+                assert len(pre) == h.preemptions, (h.preemptions, pre)
+                assert any(c.attrs.get("resume")
+                           for c in root.find("prefill_chunk")), h
+                assert len(root.find("queue_wait")) == \
+                    1 + h.preemptions, h
+        # drained: no open spans, no orphans, and abort_all (a no-op
+        # now) leaves it that way
+        eng.scheduler.abort_all()
+        assert not eng.tracer.open_spans(), eng.tracer.open_spans()
+        assert not eng.tracer.orphans(), eng.tracer.orphans()
+        # tail exemplars populated under the low quantile
+        slow = eng.slow_requests()
+        assert slow and all("trace" in s and "reason" in s
+                            for s in slow), slow
+        rec["trace_exemplars"] = len(slow)
+        rec["trace_spans"] = eng.tracer.stats()
+
+        # mid-flight abort: every resident request re-queues with a
+        # preempt(abort) span and an OPEN queue_wait (alive, waiting —
+        # not an orphan); draining closes everything
+        eng2 = ServingEngine(m, max_slots=2, max_len=48, page_size=8,
+                             chunk_size=8, num_pages=9)
+        hs2 = [eng2.submit(p, 6) for p in prompts[:3]]
+        for _ in range(3):
+            eng2.step()
+        aborted = eng2.scheduler.abort_all()
+        assert aborted, "abort_all found nothing resident"
+        assert not eng2.tracer.orphans(), eng2.tracer.orphans()
+        eng2.run(max_steps=5000)
+        assert all(h.done for h in hs2)
+        assert not eng2.tracer.open_spans() and not eng2.tracer.orphans()
+        for h in hs2:
+            root = eng2.request_trace(h.request.rid)
+            assert root is not None and root.closed
+            if any(s.attrs.get("reason") == "abort"
+                   for s in root.find("preempt")):
+                assert any(c.attrs.get("resume")
+                           for c in root.find("prefill_chunk")), h
+        # tracing instrumentation added zero unexpected recompiles
+        assert obs.retrace_summary()["total_unexpected"] == 0
+
     check("serving_churn_parity", churn_parity)
     check("serving_preempt_resume", preempt_resume)
     check("serving_bounded_ttft", bounded_ttft)
     check("serving_traffic_ab", traffic_ab)
+    check("serving_trace_forensics", trace_forensics)
     rec["retrace_sentinel"] = {
         "strict": obs.strict_retrace(),
         "total_unexpected": obs.retrace_summary()["total_unexpected"],

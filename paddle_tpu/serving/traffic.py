@@ -13,6 +13,7 @@ the scheduler buys.
 """
 from __future__ import annotations
 
+import gc
 import time
 from dataclasses import dataclass
 
@@ -69,6 +70,11 @@ def run_continuous(engine, traffic, max_steps=2_000_000):
     (record, handles)."""
     pending = sorted(traffic, key=lambda r: r.arrival_s)
     handles, i, steps = [], 0, 0
+    # measurement hygiene: a pending full collection (the heap of every
+    # engine/trace built earlier in a selftest lane) must not land
+    # INSIDE the measured window — measured: a gen2 pass cost ~170ms
+    # against a ~130ms traffic window on the CPU lane
+    gc.collect()
     t0 = engine.clock()
     while i < len(pending) or engine.scheduler.has_work():
         now = engine.clock() - t0
@@ -115,6 +121,7 @@ def run_static(model, traffic, concurrency, max_len, page_size=16,
             break
         eng.generate(np.ones((concurrency, b), np.int64), 2)
 
+    gc.collect()          # same hygiene as run_continuous's window
     t0 = clock()
     ttfts, useful_tokens = [], 0
     for g0 in range(0, len(reqs), concurrency):

@@ -1,0 +1,193 @@
+"""tools/bench_compare.py regression gate (ISSUE 13 satellite):
+identical records pass, injected regressions are flagged per metric
+with the right direction, sub-floor latency jitter is informational,
+and driver-wrapped BENCH_r*.json records (including front-truncated
+stdout tails) are unwrapped correctly."""
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bc():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _record(tok_s=48000.0, mfu=0.6, ttft_p99=0.010, stall=0.1,
+            goodput=0.97):
+    return {
+        "metric": "gpt3-350m_train_tokens_per_sec_per_chip",
+        "value": tok_s, "unit": "tokens/s", "mfu": mfu,
+        "config": {"batch": 8, "seq": 1024},
+        "goodput": {"goodput_frac": goodput, "step_ms": 100.0},
+        "input_pipeline": {"input_stall_ms": stall},
+        "serving": {"ttft_p50_s": 0.004, "ttft_p99_s": ttft_p99,
+                    "itl_p50_s": 0.002, "tok_s": 900.0},
+        "north_star": {
+            "metric": "gpt3-1.3b_train_tokens_per_sec_per_chip",
+            "value": 12900.0, "mfu": 0.55,
+        },
+    }
+
+
+class TestExtract:
+    def test_metric_families(self, bc):
+        m = bc.extract_metrics(_record())
+        assert m["gpt3-350m_train_tokens_per_sec_per_chip"] == 48000.0
+        assert m["gpt3-350m_train_tokens_per_sec_per_chip.mfu"] == 0.6
+        assert m["gpt3-1.3b_train_tokens_per_sec_per_chip"] == 12900.0
+        assert m["serving.ttft_p99_s"] == 0.010
+        assert m["input_pipeline.input_stall_ms"] == 0.1
+        assert m["goodput.goodput_frac"] == 0.97
+        # config ints are not metrics
+        assert not any(k.startswith("config") for k in m)
+
+    def test_nested_reference_does_not_overwrite(self, bc):
+        rec = _record()
+        rec["r4_unrolled_reference"] = {
+            "metric": "gpt3-350m_train_tokens_per_sec_per_chip",
+            "value": 1.0}
+        m = bc.extract_metrics(rec)
+        assert m["gpt3-350m_train_tokens_per_sec_per_chip"] == 48000.0
+
+
+class TestCompare:
+    def test_identical_records_pass(self, bc):
+        res = bc.compare(_record(), copy.deepcopy(_record()))
+        assert res["status"] == "pass"
+        assert res["compared"] >= 6
+        assert res["regressions"] == []
+        assert all(r["verdict"] in ("ok", "sub_floor")
+                   for r in res["rows"])
+
+    def test_injected_tok_s_regression_flagged(self, bc):
+        res = bc.compare(_record(), _record(tok_s=40000.0))  # -17%
+        assert res["status"] == "regress"
+        assert "gpt3-350m_train_tokens_per_sec_per_chip" in \
+            res["regressions"]
+
+    def test_injected_mfu_and_ttft_regressions(self, bc):
+        res = bc.compare(_record(),
+                         _record(mfu=0.5, ttft_p99=0.030))
+        assert res["status"] == "regress"
+        assert "gpt3-350m_train_tokens_per_sec_per_chip.mfu" in \
+            res["regressions"]
+        assert "serving.ttft_p99_s" in res["regressions"]
+
+    def test_direction_awareness(self, bc):
+        # tok/s UP and ttft DOWN are improvements, never regressions
+        res = bc.compare(_record(),
+                         _record(tok_s=60000.0, ttft_p99=0.005))
+        assert res["status"] == "pass"
+        verd = {r["metric"]: r["verdict"] for r in res["rows"]}
+        assert verd["gpt3-350m_train_tokens_per_sec_per_chip"] \
+            == "improved"
+        assert verd["serving.ttft_p99_s"] == "improved"
+
+    def test_within_tolerance_is_ok(self, bc):
+        res = bc.compare(_record(), _record(tok_s=46500.0))  # -3%
+        assert res["status"] == "pass"
+
+    def test_sub_floor_latency_jitter_ignored(self, bc):
+        # p50 ttft 4ms->... both sides under the 2ms floor? use sub-ms
+        a, b = _record(), _record()
+        a["serving"]["ttft_p50_s"] = 0.0004
+        b["serving"]["ttft_p50_s"] = 0.0015     # +275% but sub-floor
+        res = bc.compare(a, b)
+        row = {r["metric"]: r for r in res["rows"]}[
+            "serving.ttft_p50_s"]
+        assert row["verdict"] == "sub_floor"
+        assert res["status"] == "pass"
+
+    def test_goodput_regression_flagged(self, bc):
+        res = bc.compare(_record(), _record(goodput=0.80))
+        assert "goodput.goodput_frac" in res["regressions"]
+
+    def test_zero_baseline_stays_json_clean(self, bc):
+        # a 0.0 baseline must not produce Infinity (invalid JSON for
+        # the BENCH record) nor a spurious regress verdict
+        res = bc.compare(_record(stall=0.0), _record(stall=0.6))
+        row = {r["metric"]: r for r in res["rows"]}[
+            "input_pipeline.input_stall_ms"]
+        assert row["verdict"] == "new_baseline"
+        assert row["delta_pct"] is None
+        assert res["status"] == "pass"
+        text = json.dumps(res)
+        assert "Infinity" not in text and "NaN" not in text
+        assert "—" in bc.render_table(res)
+
+    def test_no_common_metrics_is_no_data(self, bc):
+        res = bc.compare({"metric": "a", "value": 1.0}, {"x": {}})
+        assert res["status"] == "no_data"
+        assert res["compared"] == 0
+
+    def test_render_table_shape(self, bc):
+        res = bc.compare(_record(), _record(tok_s=40000.0))
+        table = bc.render_table(res)
+        assert "regress" in table and "status: regress" in table
+        assert "gpt3-350m_train_tokens_per_sec_per_chip" in table
+
+
+class TestRecordLoading:
+    def test_raw_result_passthrough(self, bc, tmp_path):
+        p = tmp_path / "r.json"
+        p.write_text(json.dumps(_record()))
+        assert bc.load_record(str(p))["value"] == 48000.0
+
+    def test_driver_wrapper_parsed_field(self, bc, tmp_path):
+        p = tmp_path / "BENCH_r90.json"
+        p.write_text(json.dumps({"n": 90, "rc": 0,
+                                 "parsed": _record(), "tail": ""}))
+        assert bc.load_record(str(p))["value"] == 48000.0
+
+    def test_driver_wrapper_tail_scrape(self, bc, tmp_path):
+        line = json.dumps(_record())
+        tail = "WARNING: noise\n[bench] warmup 3.1s\n" + line + "\n"
+        p = tmp_path / "BENCH_r91.json"
+        p.write_text(json.dumps({"n": 91, "rc": 0, "parsed": None,
+                                 "tail": tail}))
+        assert bc.load_record(str(p))["value"] == 48000.0
+
+    def test_front_truncated_tail_recovers_largest_object(self, bc,
+                                                          tmp_path):
+        line = json.dumps(_record())
+        tail = line[len(line) // 2:] + "\n" + line + "\n"
+        p = tmp_path / "BENCH_r92.json"
+        p.write_text(json.dumps({"n": 92, "rc": 0, "parsed": None,
+                                 "tail": tail}))
+        rec = bc.load_record(str(p))
+        assert rec["value"] == 48000.0 and "serving" in rec
+
+    def test_garbage_returns_none(self, bc, tmp_path):
+        p = tmp_path / "BENCH_r93.json"
+        p.write_text("not json")
+        assert bc.load_record(str(p)) is None
+
+    def test_compare_latest_over_rounds(self, bc, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(_record()))
+        (tmp_path / "BENCH_r02.json").write_text(
+            json.dumps(_record(tok_s=40000.0)))
+        res = bc.compare_latest(str(tmp_path))
+        assert res["status"] == "regress"
+        assert res["baseline"] == "BENCH_r01.json"
+        assert res["candidate"] == "BENCH_r02.json"
+        # in-run gate: current result vs newest record
+        res2 = bc.compare_latest(str(tmp_path),
+                                 current=_record(tok_s=39000.0))
+        assert res2["status"] == "pass"         # vs r02's 40000: -2.5%
+        res3 = bc.compare_latest(str(tmp_path),
+                                 current=_record(tok_s=20000.0))
+        assert res3["status"] == "regress"
+
+    def test_compare_latest_insufficient_history(self, bc, tmp_path):
+        assert bc.compare_latest(str(tmp_path))["status"] == "no_data"
+        assert bc.compare_latest(
+            str(tmp_path), current=_record())["status"] == "no_data"

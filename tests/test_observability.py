@@ -86,6 +86,48 @@ class TestRegistry:
             if ln and not ln.startswith("#"):
                 assert sample.match(ln), ln
 
+    def test_prometheus_conformance_roundtrip(self):
+        """ISSUE 13 satellite: every name registered by the real
+        producers (plus adversarial ones) survives `_prom_name` as a
+        valid, collision-free metric name, and every value renders as
+        a spec-conformant token (incl. +Inf/-Inf/NaN)."""
+        from paddle_tpu.observability.registry import (
+            _PROM_NAME_OK, _prom_name,
+        )
+
+        # the process-global registry holds whatever the producer
+        # modules registered so far this test session — round-trip all
+        # of them, plus names crafted to stress the sanitizer
+        r = obs.MetricsRegistry()
+        for name in obs.registry().names():
+            r.gauge(name).set(1.0)
+        r.gauge("0starts.with.digit").set(float("inf"))
+        r.gauge("").set(float("-inf"))
+        r.gauge("häagen-dazs metrics!").set(float("nan"))
+        r.gauge("a.b").set(1.0)
+        r.gauge("a/b").set(2.0)                 # collides with a.b
+        names = r.names()
+        assert names                            # producers registered
+        text = r.expose()
+        value_re = re.compile(r"^(NaN|[+-]Inf|[-+]?[0-9.eE+-]+)$")
+        seen = set()
+        for ln in text.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            metric, value = ln.rsplit(" ", 1)
+            metric = metric.split("{")[0]
+            assert _PROM_NAME_OK.match(metric), ln
+            assert value_re.match(value), ln
+            assert metric not in seen, f"duplicate sample {metric}"
+            seen.add(metric)
+        # every registered instrument produced exactly one gauge
+        # sample and no two collapsed onto the same exposition name
+        assert len(seen) == len(names)
+        for name in names:
+            assert _PROM_NAME_OK.match(_prom_name(name)), name
+        assert "_2" in text                     # a/b disambiguated
+        assert "+Inf" in text and "-Inf" in text and "NaN" in text
+
 
 # ---------------------------------------------------------------------------
 # timeline

@@ -1,0 +1,283 @@
+"""BENCH round-over-round regression gate (ISSUE 13 satellite).
+
+Diffs two bench records per metric with per-metric-family tolerance
+thresholds and emits a pass/regress verdict table. Handles both raw
+``bench.py`` JSON results and the driver's ``BENCH_r*.json`` wrappers
+({"n", "cmd", "rc", "tail", "parsed"} — the result JSON is recovered
+from ``parsed`` or scraped out of the stdout ``tail``, which may be
+truncated at the FRONT, so extraction looks for the last parseable
+object).
+
+Usage::
+
+    python tools/bench_compare.py                  # two newest BENCH_r*
+    python tools/bench_compare.py OLD.json NEW.json
+    BENCH_COMPARE=1 python bench.py                # in-run gate: the
+        # fresh result is compared against the newest BENCH_r*.json
+        # and the verdict lands in the record ("bench_compare" key)
+
+Exit code: 0 pass / 2 regress / 0 with status "no_data" when fewer
+than two comparable records exist (a missing history must not fail a
+fresh checkout).
+
+Metric families and default tolerances (relative):
+
+    tok_s      -5%   higher is better (tokens/s, images/s)
+    mfu        -5%   higher is better
+    goodput    -5%   higher is better (fraction)
+    ttft      +25%   lower is better  (latency lanes are CPU-noisy)
+    itl       +25%   lower is better
+    stall     +100%  lower is better  (sub-ms noise; abs floor below)
+
+Latency/stall metrics additionally carry an ABSOLUTE floor: when both
+sides sit under it, the row is informational (sub-floor jitter cannot
+regress the gate).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+__all__ = ["load_record", "extract_metrics", "compare",
+           "compare_latest", "render_table", "DEFAULT_TOLERANCES"]
+
+# family -> (relative tolerance, higher_is_better, absolute floor)
+DEFAULT_TOLERANCES = {
+    "tok_s":   (0.05, True, 0.0),
+    "mfu":     (0.05, True, 0.0),
+    "goodput": (0.05, True, 0.0),
+    "ttft":    (0.25, False, 2e-3),     # seconds
+    "itl":     (0.25, False, 1e-3),     # seconds
+    "stall":   (1.00, False, 0.5),      # milliseconds
+}
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def bench_records(root="."):
+    """(round, path) for every BENCH_r*.json under root, ascending."""
+    out = []
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def _scrape_tail(tail):
+    """Last parseable JSON object in a (possibly front-truncated)
+    stdout tail."""
+    dec = json.JSONDecoder()
+    best, best_len = None, 0
+    for m in re.finditer(r'\{"', tail):
+        try:
+            obj, end = dec.raw_decode(tail[m.start():])
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(obj, dict) or not (
+                "metric" in obj or "value" in obj or "selftest" in obj):
+            continue
+        # the OUTERMOST result is wanted, not a nested {"metric": ...}
+        # block — prefer the longest parsed span
+        if end > best_len:
+            best, best_len = obj, end
+    return best
+
+
+def load_record(path):
+    """The bench RESULT dict from either a raw bench.py JSON line or a
+    driver wrapper; None when nothing parseable is inside."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(rec, dict):
+        return None
+    if "metric" in rec or "selftest" in rec:
+        return rec
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict):
+        return parsed
+    tail = rec.get("tail")
+    if isinstance(tail, str):
+        return _scrape_tail(tail)
+    return None
+
+
+def _family(key):
+    k = key.lower()
+    if "goodput_frac" in k:
+        return "goodput"
+    if "ttft" in k:
+        return "ttft"
+    if "itl" in k:
+        return "itl"
+    if "stall" in k:
+        return "stall"
+    if k.endswith("mfu") or "mfu" in k.rsplit(".", 1)[-1]:
+        return "mfu"
+    if ("tok_s" in k or "tokens_per_sec" in k or "images_per_sec" in k
+            or k.endswith("_s_chip") or "speedup" in k):
+        return "tok_s"
+    return None
+
+
+_SKIP_KEYS = {"config", "provenance", "vs_baseline", "vs_round3",
+              "timeline", "recorded_at", "compute_path_hash", "cmd",
+              "tail", "window_note", "bench_compare", "error",
+              "budget_s", "elapsed_s",
+              # pinned historical constant (identical every round —
+              # comparing it only pads the table)
+              "r4_unrolled_reference"}
+
+
+def extract_metrics(rec) -> dict:
+    """Flatten a bench result into {dotted.path: float} for every
+    comparable metric (tok/s, MFU, TTFT/ITL, stall, goodput). The
+    top-level {"metric", "value"} pair keys as the metric's own name so
+    rounds with different primaries still line up per model."""
+    out = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            name = node.get("metric")
+            val = node.get("value")
+            if isinstance(name, str) and isinstance(val, (int, float)) \
+                    and not isinstance(val, bool):
+                # first wins: a nested attachment repeating the name
+                # (an embedded reference block) must not overwrite the
+                # outer live value
+                out.setdefault(name, float(val))
+                if isinstance(node.get("mfu"), (int, float)):
+                    out.setdefault(f"{name}.mfu", float(node["mfu"]))
+            for k, v in node.items():
+                if k in _SKIP_KEYS or k in ("metric", "value", "mfu"):
+                    continue
+                walk(v, f"{path}.{k}" if path else k)
+            return
+        if isinstance(node, (list, tuple)):
+            return                      # no positional metrics
+        if isinstance(node, bool) or not isinstance(node, (int, float)):
+            return
+        if _family(path.rsplit(".", 1)[-1]) is not None:
+            f = float(node)
+            if f == f and abs(f) != float("inf"):
+                out.setdefault(path, f)
+
+    walk(rec, "")
+    return out
+
+
+def compare(old_rec, new_rec, tolerances=None) -> dict:
+    """Per-metric verdicts between two bench results. A row regresses
+    when it moves beyond its family tolerance in the BAD direction
+    (and, for latency families, above the absolute floor)."""
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    old_m = extract_metrics(old_rec or {})
+    new_m = extract_metrics(new_rec or {})
+    rows = []
+    for key in sorted(set(old_m) & set(new_m)):
+        fam = _family(key.rsplit(".", 1)[-1]) or _family(key)
+        if fam is None or fam not in tol:
+            continue
+        rel_tol, higher_better, floor = tol[fam]
+        old, new = old_m[key], new_m[key]
+        # a zero baseline has no relative delta — delta_pct must stay
+        # JSON-clean (json.dumps would emit the non-spec `Infinity`
+        # and corrupt the whole BENCH record for strict parsers)
+        delta = (new - old) / abs(old) if old else None
+        verdict = "ok"
+        if max(abs(old), abs(new)) < floor:
+            verdict = "sub_floor"
+        elif old == 0:
+            # relative tolerances are meaningless against 0 — report,
+            # never regress, on a freshly-appearing metric value
+            verdict = "new_baseline" if new != 0 else "ok"
+        elif higher_better:
+            if new < old * (1 - rel_tol):
+                verdict = "regress"
+            elif new > old * (1 + rel_tol):
+                verdict = "improved"
+        else:
+            if new > old * (1 + rel_tol):
+                verdict = "regress"
+            elif new < old * (1 - rel_tol):
+                verdict = "improved"
+        rows.append({"metric": key, "family": fam, "old": old,
+                     "new": new,
+                     "delta_pct": (None if delta is None
+                                   else round(delta * 100, 2)),
+                     "tol_pct": round(rel_tol * 100, 1),
+                     "verdict": verdict})
+    regressions = [r["metric"] for r in rows if r["verdict"] == "regress"]
+    status = ("no_data" if not rows
+              else "regress" if regressions else "pass")
+    return {"status": status, "compared": len(rows),
+            "regressions": regressions, "rows": rows}
+
+
+def render_table(result) -> str:
+    lines = [f"{'metric':<58}{'old':>12}{'new':>12}{'Δ%':>8}"
+             f"{'tol%':>6}  verdict"]
+    for r in result["rows"]:
+        dp = ("     —" if r["delta_pct"] is None
+              else f"{r['delta_pct']:>8.2f}")
+        lines.append(
+            f"{r['metric'][:58]:<58}{r['old']:>12.4g}{r['new']:>12.4g}"
+            f"{dp}{r['tol_pct']:>6.1f}  "
+            f"{r['verdict']}")
+    lines.append(f"status: {result['status']} "
+                 f"({result['compared']} metrics compared"
+                 + (f", regressed: {', '.join(result['regressions'])}"
+                    if result["regressions"] else "") + ")")
+    return "\n".join(lines)
+
+
+def compare_latest(root=".", current=None, tolerances=None) -> dict:
+    """Gate entry: compare ``current`` (an in-flight bench result)
+    against the newest BENCH_r*.json — or, with no ``current``, the two
+    newest records against each other."""
+    recs = bench_records(root)
+    if current is not None:
+        if not recs:
+            return {"status": "no_data", "compared": 0,
+                    "regressions": [], "rows": [],
+                    "note": "no BENCH_r*.json history to compare against"}
+        n, path = recs[-1]
+        base = load_record(path)
+        res = compare(base, current, tolerances=tolerances)
+        res["baseline"] = os.path.basename(path)
+        return res
+    if len(recs) < 2:
+        return {"status": "no_data", "compared": 0, "regressions": [],
+                "rows": [], "note": "need two BENCH_r*.json records"}
+    (_, old_p), (_, new_p) = recs[-2], recs[-1]
+    res = compare(load_record(old_p), load_record(new_p),
+                  tolerances=tolerances)
+    res["baseline"] = os.path.basename(old_p)
+    res["candidate"] = os.path.basename(new_p)
+    return res
+
+
+def main(argv):
+    if len(argv) == 2:
+        res = compare(load_record(argv[0]), load_record(argv[1]))
+        res["baseline"], res["candidate"] = argv
+    elif len(argv) == 0:
+        res = compare_latest(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) or ".")
+    else:
+        print(__doc__)
+        return 1
+    print(render_table(res), file=sys.stderr)
+    print(json.dumps(res))
+    return 2 if res["status"] == "regress" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
