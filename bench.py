@@ -221,6 +221,23 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
         except Exception as e:
             hlo_costs = {"error": f"{type(e).__name__}: {e}"[:200]}
 
+    # device-memory receipt (ISSUE 14): compiled-step buffer-assignment
+    # peak (AOT — same persistent-cache economics as cost_analysis) +
+    # the live-buffer attribution of what is resident between steps.
+    # Failures must not eat the measured throughput number.
+    mem = None
+    if os.environ.get("BENCH_MEM", "1") == "1":
+        try:
+            from paddle_tpu.observability.memory import (
+                live_buffer_report,
+            )
+
+            prof = step.memory_profile(ids, labels)
+            mem = {"compiled": prof.summary(top_k=4),
+                   "live": live_buffer_report()}
+        except Exception as e:
+            mem = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # MFU: model flops per token = 6N (fwd+bwd matmuls) + attention
     # 12*L*h*s (QK^T + PV, fwd+bwd, causal ~halves but count full per
     # PaLM-appendix convention); peak from the chip generation.
@@ -276,6 +293,7 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
             "lower_compile_s": hlo_costs.get("lower_compile_s"),
             "error": hlo_costs.get("error"),
         }),
+        "mem": mem,
         "timeline": {"path": os.path.relpath(
             tl_path, os.path.dirname(os.path.abspath(__file__))),
             "steps": steps},
@@ -465,7 +483,23 @@ def run_decode_config(model_name=None, prompt_len=None, new_tokens=None,
                 if tag == "fp32":
                     rec[f"{name}_prefill_ttft_ms"] = round(
                         ttft * 1e3, 2)
+                    # compiled decode-step HBM peak (ISSUE 14): the
+                    # AOT buffer-assignment receipt per cache shape
+                    try:
+                        rec[f"{name}_mem"] = eng.memory_profile(
+                            top_k=3).summary(top_k=1)
+                    except Exception as e:
+                        rec[f"{name}_mem"] = {
+                            "error": f"{type(e).__name__}: {e}"[:200]}
         lanes[f"bs{bs}"] = rec
+    # live-buffer attribution (ISSUE 14): params vs KV pools vs
+    # untagged, as resident at the end of the lane
+    try:
+        from paddle_tpu.observability.memory import live_buffer_report
+
+        mem_live = live_buffer_report()
+    except Exception as e:
+        mem_live = {"error": f"{type(e).__name__}: {e}"[:200]}
     return {
         "metric": f"{model_name}_decode_tokens_per_sec_per_chip",
         "unit": "tokens/s",
@@ -474,6 +508,7 @@ def run_decode_config(model_name=None, prompt_len=None, new_tokens=None,
                    "params": sum(int(np.prod(p.shape))
                                  for p in model.parameters())},
         "lanes": lanes,
+        "mem_live": mem_live,
     }
 
 
@@ -764,6 +799,19 @@ def run_selftest():
         assert lane.get("check") == "pass", lane
         results["observability_detail"] = lane
 
+    def memory_observability():
+        # ISSUE 14: device-memory observability — compiled-step
+        # buffer-assignment profiles on the train/decode step paths,
+        # live-buffer attribution summing to jax.live_arrays() totals,
+        # the sharded-vs-replicated param-storage peak delta receipt,
+        # the synthetic-OOM flight-recorder dump, /memz, and the
+        # measured hot-path overhead bound <= 1% of step time
+        rec = _run_cpu_probe("paddle_tpu.observability.memory_selftest",
+                             timeout=900)
+        lane = rec.get("memory_observability", {})
+        assert lane.get("check") == "pass", lane
+        results["memory_observability_detail"] = lane
+
     def serving():
         # ISSUE 6: continuous-batching serving tier — Poisson arrivals
         # on a tiny model: per-request token parity vs generate(),
@@ -789,6 +837,7 @@ def run_selftest():
     check("input_pipeline", input_pipeline)
     check("serving", serving)
     check("observability", observability)
+    check("memory_observability", memory_observability)
     check("training_kernels", training_kernels)
     check("distributed_linalg", distributed_linalg)
     check("moe", moe)
@@ -1282,6 +1331,14 @@ if __name__ == "__main__":
         # min-of-reps step-time A/B — hermetic CPU subprocess
         print(json.dumps(_run_cpu_probe(
             "paddle_tpu.jit.sharded_storage_selftest", timeout=900)))
+    elif "--memory" in sys.argv:
+        # MEMORY lane (ISSUE 14): compiled-step HBM profiles on the
+        # train/decode paths, live-buffer attribution vs
+        # jax.live_arrays() totals, sharded-vs-replicated storage peak
+        # delta, synthetic-OOM forensics dump, /memz, overhead bound —
+        # hermetic CPU subprocess, one JSON line
+        print(json.dumps(_run_cpu_probe(
+            "paddle_tpu.observability.memory_selftest", timeout=900)))
     elif "--observability" in sys.argv:
         # OBSERVABILITY lane (ISSUE 12): registry overhead bound,
         # retrace-sentinel attribution of an injected dtype flip on all

@@ -127,6 +127,14 @@ class DenseKVCache:
         shape = (2, batch, num_heads, max_len, head_dim)
         self.layers = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
         self.pos = jnp.zeros((), jnp.int32)     # tokens already cached
+        # live-buffer attribution (ISSUE 14): the cache claims its
+        # pools at mem.live scrape time (weakly tracked)
+        from ..observability.memory import live_registry
+
+        live_registry().track(self)
+
+    def _mem_owners(self):
+        return {"kv_cache": list(self.layers)}
 
     def layer(self, l):
         return self.layers[l]
@@ -187,6 +195,14 @@ class PagedKVCache:
         self._free_pages = list(range(num_pages - 1, 0, -1))
         self._free_slots = list(range(max_slots - 1, -1, -1))
         self._slot_pages: dict[int, list[int]] = {}
+        # live-buffer attribution (ISSUE 14): the page pools claim
+        # their resident bytes at mem.live scrape time (weakly tracked)
+        from ..observability.memory import live_registry
+
+        live_registry().track(self)
+
+    def _mem_owners(self):
+        return {"kv_pages": list(self.k_layers) + list(self.v_layers)}
 
     # -- host bookkeeping ------------------------------------------------
     def _host(self, name):
@@ -269,6 +285,44 @@ class PagedKVCache:
             page = self._free_pages.pop()
             pt[slot, len(pages)] = page
             pages.append(page)
+
+    def pool_stats(self) -> dict:
+        """Page-pool occupancy/fragmentation snapshot (ISSUE 14
+        satellite) — pure host bookkeeping, O(free + slots), no device
+        sync, safe to call from a debug-server scrape thread while the
+        serve loop mutates the bookkeeping (everything is snapshotted
+        before iteration; a scrape racing a mutation sees one coherent
+        moment, never a changed-size-during-iteration crash).
+        ``fragmentation`` compares the longest CONTIGUOUS run of
+        free page ids against the free count (0.0 = one solid free
+        extent, →1.0 = free pages scattered singly). Contiguity is a
+        locality/diagnostic signal, not an allocation constraint —
+        page tables map pages individually — but a pool that churns
+        toward high fragmentation is a pool whose sequences
+        interleave heavily. Invariant: used + free == total."""
+        free = sorted(list(self._free_pages))     # atomic snapshot
+        slot_items = list(self._slot_pages.items())
+        max_contig = run = 0
+        prev = None
+        for p in free:
+            run = run + 1 if prev is not None and p == prev + 1 else 1
+            max_contig = max(max_contig, run)
+            prev = p
+        used = sum(len(p) for _, p in slot_items)
+        total = self.num_pages - 1            # page 0 is trash
+        return {
+            "total_pages": total,
+            "free_pages": len(free),
+            "used_pages": used,
+            "trash_pages": 1,
+            "page_size": self.page_size,
+            "slot_pages": {int(s): len(p)
+                           for s, p in sorted(slot_items)},
+            "max_contiguous_free": max_contig,
+            "fragmentation": (round(1.0 - max_contig / len(free), 4)
+                              if free else 0.0),
+            "occupancy": round(used / total, 4) if total else 0.0,
+        }
 
     def set_active(self, slot: int, flag: bool):
         """Host toggle for decode participation: the serving tier keeps

@@ -178,6 +178,26 @@ class DevicePrefetcher:
         self._epoch = None
         self._lock = threading.Lock()
         self.reset_stats()
+        # live-buffer attribution (ISSUE 14): staged ring batches claim
+        # their device bytes at mem.live scrape time (weakly tracked)
+        from ..observability.memory import live_registry
+
+        live_registry().track(self)
+
+    def _mem_owners(self):
+        """observability.memory provider: the device arrays currently
+        staged in the ring (a snapshot of the queue — scrape-time only,
+        never on the hot path)."""
+        ep = self._epoch
+        if ep is None:
+            return {"prefetch_ring": []}
+        try:
+            with ep._q.mutex:
+                staged = list(ep._q.queue)
+        except Exception:
+            staged = []
+        return {"prefetch_ring": [b for b in staged
+                                  if b is not _SENTINEL]}
 
     # -- staging ---------------------------------------------------------
     @staticmethod

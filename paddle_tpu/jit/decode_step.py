@@ -137,6 +137,44 @@ class _Step:
         finally:
             self.trace_count = saved
 
+    def memory_profile(self, *args, top_k=8, publish=True):
+        """Compiled-step HBM accounting (ISSUE 14): AOT buffer-
+        assignment stats of this step program for the given example
+        args — with the REAL donation config, so the KV pools show up
+        as alias bytes, not double-counted temps. Traces a fresh jit
+        copy (an AOT analysis must not perturb the live executable
+        cache or the trace_count probe); publishes
+        ``mem.compiled.<step>.*`` gauges."""
+        from ..observability.memory import CompiledMemoryProfile
+
+        saved = self.trace_count
+        try:
+            jitted = jax.jit(
+                self._fn, donate_argnums=(1,) if self._donate else ())
+            prof = CompiledMemoryProfile.from_jitted(jitted, *args,
+                                                     top_k=top_k)
+        finally:
+            self.trace_count = saved
+        if publish:
+            prof.publish(name=type(self).__name__)
+        return prof
+
+    def _dispatch(self, args):
+        """The guarded compiled call: a RESOURCE_EXHAUSTED here dumps
+        compiled + live memory forensics through the flight recorder
+        before re-raising (observability.memory; ISSUE 14)."""
+        try:
+            return self._jitted(*args)
+        except Exception as e:
+            from ..observability import memory as _mem
+
+            if _mem.is_oom_error(e):
+                _mem.dump_oom(
+                    e, step=type(self).__name__,
+                    profile=lambda: self.memory_profile(
+                        *args, publish=False))
+            raise
+
     def __call__(self, *args):
         if not self.engine.compiled:
             # eager: the paged metadata lives as host numpy between
@@ -157,10 +195,10 @@ class _Step:
         # here as an attributed placement/kind change
         self._sentinel.observe(tuple(args), names=self._arg_names)
         if self._dispatch_hist is None:
-            return self._jitted(*args)
+            return self._dispatch(args)
         tc0 = self.trace_count
         t0 = time.perf_counter()
-        out = self._jitted(*args)
+        out = self._dispatch(args)
         # a call that TRACED just paid compile time (minutes for big
         # models) — one such sample would permanently skew a histogram
         # whose steady-state entries are ~1ms, so only steady-state
@@ -442,6 +480,20 @@ class GenerationEngine:
         self.cache = self._make_cache()
         self.prefill_step = PrefillStep(self, donate_cache=donate)
         self.decode_step = DecodeStep(self, donate_cache=donate)
+        # live-buffer attribution (ISSUE 14): a decode-only process has
+        # no train step to claim the model weights (the cache claims
+        # its own pools)
+        from ..observability.memory import live_registry
+
+        live_registry().track(self)
+
+    def _mem_owners(self):
+        # shard-backed params (a sharded-storage train step sharing
+        # this model) are skipped: reading them would GATHER on scrape,
+        # and the owning step already claims the shards
+        return {"params": [p._data for p in self._params
+                           if not getattr(type(p), "_shard_backed",
+                                          False)]}
 
     def _make_cache(self):
         """Fresh cache with this engine's geometry — also the recovery
@@ -461,6 +513,19 @@ class GenerationEngine:
             num_pages=1 + self.batch * pages_per_seq,
             page_size=self._page_size, max_slots=self.batch,
             pages_per_seq=pages_per_seq, dtype=self._cache_dtype)
+
+    # -- memory observability (ISSUE 14) ---------------------------------
+    def memory_profile(self, top_k=8, publish=True):
+        """Compiled decode-step memory profile for THIS engine's
+        geometry (model params + KV pools + metadata at the live
+        shapes) — see `_Step.memory_profile`."""
+        buffers, meta = _split_state(self.kind,
+                                     _tree_data(self.cache.state()))
+        tok = jnp.zeros((self.batch,), jnp.int32)
+        key = jax.random.PRNGKey(0)
+        return self.decode_step.memory_profile(
+            self._param_data(), buffers, meta, tok, key,
+            top_k=top_k, publish=publish)
 
     # -- helpers ---------------------------------------------------------
     def _bucket(self, s):

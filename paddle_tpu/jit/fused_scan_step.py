@@ -813,6 +813,10 @@ class FusedScanTrainStep:
             opt._get_accumulator("moment1", p, dtype=opt._moment_dtype)
             opt._get_accumulator("moment2", p, dtype=opt._moment_dtype)
         self._build()
+        # live-buffer attribution (ISSUE 14): weakly tracked provider
+        from ..observability.memory import live_registry
+
+        live_registry().track(self)
 
     # -- telemetry surface ----------------------------------------------
     def retrace_stats(self):
@@ -843,6 +847,54 @@ class FusedScanTrainStep:
                 self._jitted, state, lr, ids_d, lab_d, seg_d,
                 axis_degrees=self._cost_axis_degrees())
 
+    def memory_profile(self, ids, labels, segment_ids=None, top_k=8,
+                       publish=True):
+        """Compiled-step HBM accounting (ISSUE 14): AOT buffer-
+        assignment stats of THIS step's compiled program — peak /
+        argument / output / temp / alias bytes plus the top-K largest
+        buffers with shapes and op provenance — without executing a
+        step (see TrainStep.memory_profile). Published as
+        ``mem.compiled.<step>.*`` gauges."""
+        from ..observability.memory import CompiledMemoryProfile
+
+        ids_d = ids._data if isinstance(ids, Tensor) else ids
+        lab_d = labels._data if isinstance(labels, Tensor) else labels
+        seg_d = (segment_ids._data if isinstance(segment_ids, Tensor)
+                 else segment_ids)
+        self.ensure_built()
+        self._pre_step()
+        state = self._extract_state()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        with self._step_guard():
+            prof = CompiledMemoryProfile.from_jitted(
+                self._jitted, state, lr, ids_d, lab_d, seg_d,
+                top_k=top_k)
+        if publish:
+            prof.publish(name=type(self).__name__)
+        return prof
+
+    def _opt_state_arrays(self):
+        """Every optimizer-state array this step's update touches
+        (flat moment buckets + master weights) — ONE collection
+        implementation shared by both storage modes' attribution."""
+        opt = self._opt
+        acc = []
+        for store in opt._accumulators.values():
+            acc.extend(store.values())
+        acc.extend(v for v in opt._master_weights.values()
+                   if v is not None)
+        return acc
+
+    def _mem_owners(self):
+        """Live-buffer attribution providers (observability.memory):
+        params, flat optimizer-state buckets, model buffers. The
+        sharded-parameter-storage subclass overrides the param leg so
+        a scrape never gathers a shard."""
+        return {"params": [p._data for p in self._s_params]
+                + [p._data for _, p in self._o_params],
+                "buffers": [b._data for b in self._buffers],
+                "opt_state": self._opt_state_arrays()}
+
     def __call__(self, ids, labels, segment_ids=None):
         ids_d = ids._data if isinstance(ids, Tensor) else ids
         lab_d = labels._data if isinstance(labels, Tensor) else labels
@@ -866,7 +918,13 @@ class FusedScanTrainStep:
         self._sentinel.observe(
             (state, lr, ids_d, lab_d, seg_d),
             names=("state", "lr", "ids", "labels", "segment_ids"))
-        with RecordEvent("FusedScanTrainStep"), self._step_guard():
+        from ..observability.memory import oom_guard as _oom_guard
+
+        with RecordEvent("FusedScanTrainStep"), self._step_guard(), \
+                _oom_guard(
+                    step=type(self).__name__,
+                    profile=lambda: self.memory_profile(
+                        ids_d, lab_d, seg_d, publish=False)):
             loss, new_state = self._jitted(state, lr, ids_d, lab_d,
                                            seg_d)
         self._inject_state(new_state)

@@ -986,6 +986,39 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         self._dirty_param_buckets.clear()
         self._invalidate_param_caches()
 
+    def _mem_owners(self):
+        """Live-buffer attribution (ISSUE 14): under sharded storage
+        the trainable params live as ``__scan_shard_*__`` 1/N flat
+        bucket shards — claimed as ``params.scan_shards`` — and a
+        scrape must NOT materialize them, so shard-backed leaves are
+        read through the raw data slot (stale entries simply are not
+        resident and claim nothing). Replicated storage falls through
+        to the base attribution."""
+        if self._param_storage != "sharded":
+            return super()._mem_owners()
+        owners = {"params.scan_shards":
+                  [a for a in (self._param_shards["s"]
+                               + self._param_shards["o"])
+                   if a is not None],
+                  "buffers": [b._data for b in self._buffers]}
+        slot = _data_slot()
+        live_full = []
+        with _raw_param_access():
+            for grp, assign in (("s", self._s_assign),
+                                ("o", self._o_assign)):
+                for bucket in assign.buckets:
+                    for p in self._shard_stored_params(grp, bucket):
+                        d = slot.__get__(p)
+                        if d is not _STALE and d is not None:
+                            live_full.append(d)
+        # non-shard-stored leaves (non-trainable stacked params) keep
+        # ordinary storage
+        live_full.extend(p._data for j, p in enumerate(self._s_params)
+                         if j not in self._s_trainable_idx)
+        owners["params"] = live_full
+        owners["opt_state"] = self._opt_state_arrays()
+        return owners
+
     def full_params(self):
         """Materialize every shard-stored parameter's full `_data`
         (eval/export convenience; the next step drops the copies
@@ -1028,6 +1061,10 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 self._guard.init_state()))
         self._build()
         self._publish_comm_gauges()
+        # live-buffer attribution (ISSUE 14): weakly tracked provider
+        from ..observability.memory import live_registry
+
+        live_registry().track(self)
 
     def _extract_state(self):
         opt = self._opt

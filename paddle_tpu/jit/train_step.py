@@ -374,6 +374,12 @@ class TrainStep:
 
         donate = (0,) if self._donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
+        # live-buffer attribution (ISSUE 14): params/opt-state/buffers
+        # claim their resident bytes at mem.live scrape time (weakly
+        # tracked — a dropped step stops claiming)
+        from ..observability.memory import live_registry
+
+        live_registry().track(self)
 
     def __call__(self, *batch):
         batch_data = _tree_data(list(batch))
@@ -405,7 +411,19 @@ class TrainStep:
                     comm_watchdog.watch(f"TrainStep#{self._step_count}"):
                 loss_data, new_state = self._jitted(state, lr, batch_data)
             self._step_count += 1
-        except Exception:
+        except Exception as e:
+            # OOM forensics (ISSUE 14): a RESOURCE_EXHAUSTED at the
+            # dispatch boundary dumps the live-buffer attribution + the
+            # step's compiled memory profile through the flight
+            # recorder before propagating (AOT analysis — re-lowering
+            # reads only avals, so consumed donated buffers are fine)
+            from ..observability import memory as _mem
+
+            if _mem.is_oom_error(e):
+                _mem.dump_oom(
+                    e, step=type(self).__name__,
+                    profile=lambda: _mem.CompiledMemoryProfile
+                    .from_jitted(self._jitted, state, lr, batch_data))
             # a tracing error leaves tracers bound in the live objects;
             # restore the concrete state so the model stays usable
             self._inject_state(state)
@@ -439,6 +457,47 @@ class TrainStep:
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         return cost_analysis_of(self._jitted, state, lr,
                                 _tree_data(list(batch)))
+
+    def memory_profile(self, *batch, top_k=8, publish=True):
+        """Compiled-step HBM accounting (ISSUE 14): AOT lower+compile
+        this step for ``batch`` and read the XLA buffer-assignment
+        stats — argument/output/temp/alias bytes, the peak they imply,
+        and the top-K largest buffers with shapes and op provenance —
+        WITHOUT executing anything. Publishes ``mem.compiled.<step>.*``
+        gauges; with the persistent compile cache warm this is cheap.
+        Requires the step to have run (or at least traced) once."""
+        if self._jitted is None:
+            raise RuntimeError(
+                "memory_profile needs a built step — call the step "
+                "once (or warm it up) first")
+        from ..observability.memory import CompiledMemoryProfile
+
+        state = self._extract_state()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        prof = CompiledMemoryProfile.from_jitted(
+            self._jitted, state, lr, _tree_data(list(batch)),
+            top_k=top_k)
+        if publish:
+            prof.publish(name=type(self).__name__)
+        return prof
+
+    def _mem_owners(self):
+        """Live-buffer attribution providers (observability.memory):
+        which resident arrays this step's state accounts for."""
+        if self._params is None:
+            self._resolve_slots()
+        # shard-backed params (owned by a sharded-storage scan step)
+        # are skipped: reading them would gather on scrape
+        owners = {"params": [p._data for p in self._params
+                             if not getattr(type(p), "_shard_backed",
+                                            False)],
+                  "buffers": [b._data for b in self._buffers]}
+        try:
+            owners["opt_state"] = jax.tree_util.tree_leaves(
+                self._opt.opt_state_pytree())
+        except Exception:
+            pass
+        return owners
 
     def _warmup_accumulators(self):
         """Complete the optimizer state pytree before tracing via the

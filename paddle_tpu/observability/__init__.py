@@ -33,6 +33,13 @@ serving:
   `/healthz`, `/tracez`, `/flightz` (opt-in from ServingEngine/bench).
 - `goodput_breakdown` — per-step `goodput.*` step-time attribution
   folded from the existing stall/bubble/comm gauges (BENCH lanes).
+- `memory` (ISSUE 14) — device-memory accounting:
+  `CompiledMemoryProfile` (AOT buffer-assignment stats + top-K
+  buffers of any compiled step, `step.memory_profile()` everywhere,
+  ``mem.compiled.*`` gauges), `live_buffer_report()` (resident bytes
+  attributed to params / scan shards / optimizer state / KV pools /
+  prefetch ring vs untagged, ``mem.live.*`` gauges, `/memz`), and
+  `dump_oom` OOM forensics through the flight recorder.
 
 Quickstart::
 
@@ -55,6 +62,11 @@ from .flight_recorder import (  # noqa: F401
 from .goodput import goodput_baseline, goodput_breakdown  # noqa: F401
 from .hlo_costs import (  # noqa: F401
     cost_analysis_of, load_hlo_overlap, summarize_compiled,
+)
+from .memory import (  # noqa: F401
+    CompiledMemoryProfile, LiveBufferRegistry, dump_oom, is_oom_error,
+    last_oom_report, live_buffer_report, live_registry, memz_payload,
+    oom_guard, parse_hlo_buffers,
 )
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, percentile, registry,
@@ -79,4 +91,7 @@ __all__ = [
     "summarize_compiled", "cost_analysis_of", "load_hlo_overlap",
     "Span", "Tracer", "drain_chrome_spans", "SLO", "SLOTracker",
     "DebugServer", "goodput_breakdown", "goodput_baseline",
+    "CompiledMemoryProfile", "LiveBufferRegistry", "live_registry",
+    "live_buffer_report", "parse_hlo_buffers", "is_oom_error",
+    "dump_oom", "oom_guard", "last_oom_report", "memz_payload",
 ]

@@ -10,8 +10,12 @@ observability surface over loopback:
   / orphan counts as JSON (the request-forensics surface).
 - ``/flightz``  — the flight-recorder event ring as JSON (what the
   crash dump would contain, inspectable on a LIVE process).
+- ``/memz``     — device-memory attribution (ISSUE 14): live-buffer
+  bytes per owner + published ``mem.compiled.*`` step profiles (+
+  page-pool stats when a serving engine provides its own ``memz``).
 - ``/<name>``   — any extra provider passed as ``extra={name: fn}``
-  (the serving engine adds ``/sloz`` -> SLO burn-rate snapshot).
+  (the serving engine adds ``/sloz`` -> SLO burn-rate snapshot and
+  overrides ``/memz`` with its pool-aware payload).
 
 Stdlib only by design (DECISIONS §19): the serving tier must not grow
 a web-framework dependency for a debug port, the handler does no
@@ -61,6 +65,12 @@ class DebugServer:
         self._tracer = tracer
         self._recorder = recorder
         self._extra = dict(extra or {})
+        # /memz default (ISSUE 14): live-buffer attribution over the
+        # global registry unless the caller provides a richer payload
+        if "memz" not in self._extra:
+            from .memory import memz_payload
+
+            self._extra["memz"] = memz_payload
         self.host = host
         self._port_req = int(port)
         self._httpd = None

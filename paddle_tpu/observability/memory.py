@@ -1,0 +1,535 @@
+"""Device-memory observability (ISSUE 14): compiled-step HBM
+accounting, live-buffer attribution, and OOM forensics.
+
+Three legs, one module:
+
+1. **Compiled-step AOT memory profiles** — `CompiledMemoryProfile`
+   generalizes the one-off tools/diag_fused_mem.py probe into a
+   library: lower+compile a step WITHOUT executing it and read the XLA
+   buffer-assignment stats (argument / output / temp / alias bytes and
+   the peak they imply) plus the top-K largest buffers in the optimized
+   per-device HLO, each with its shape, dtype, defining op and
+   `op_name` provenance. Every jitted step path exposes it as
+   ``step.memory_profile()``; results publish as ``mem.compiled.*``
+   gauges. This is the AOT view: what the compiler RESERVES for one
+   step program, per device, independent of what else is resident.
+
+2. **Live-buffer attribution** — a tagging registry over
+   ``jax.live_arrays()``. Producers (train steps, KV caches, the
+   device prefetcher) register themselves (weakly — a dead producer
+   drops out) and expose ``_mem_owners() -> {owner: arrays}``;
+   `live_buffer_report()` walks every live array in the process and
+   attributes its device-resident bytes to the claiming owner — params
+   (replicated vs ``__scan_shard_*__`` 1/N shards), optimizer state,
+   KV page pools, prefetcher ring slots — with the remainder reported
+   as ``untagged``. This is the LIVE view: what is actually resident
+   between steps. Bytes are per-device-resident (a replicated array on
+   an 8-device mesh counts 8x its logical size; a 1/N-sharded array
+   counts 1x), summed over addressable devices.
+
+3. **OOM forensics** — `dump_oom()` catches RESOURCE_EXHAUSTED at the
+   step dispatch boundary (every step class wraps its dispatch) and
+   writes the compiled profile + the live attribution + the top-K
+   buffers through the PR-12 flight recorder before the error
+   re-raises: the postmortem says WHAT was resident and WHAT the step
+   wanted, not just "out of memory".
+
+The AOT and live legs deliberately do not reconcile to one number:
+the compiled profile excludes other steps' state and the live report
+excludes the step's transient temps. Peak HBM on a device ≈
+live(params + opt + caches) + compiled(temp) of whichever program runs
+(DECISIONS.md §20).
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+import weakref
+
+from .registry import registry as _registry
+
+__all__ = [
+    "CompiledMemoryProfile", "parse_hlo_buffers", "device_bytes",
+    "LiveBufferRegistry", "live_registry", "live_buffer_report",
+    "is_oom_error", "dump_oom", "oom_guard", "last_oom_report",
+    "memz_payload",
+]
+
+
+# ---------------------------------------------------------------------------
+# leg 1: compiled-step AOT memory profiles
+# ---------------------------------------------------------------------------
+
+# `%name = f32[8,16]{1,0} dot(...)` / `ROOT %t = (f32[..], s32[..]) tuple(...)`
+_HLO_LINE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<rest>\(?.*)$")
+_SHAPE_TOK_RE = re.compile(
+    r"(?P<dtype>pred|bf16|f8\w*|[fsuc]\d+)\[(?P<dims>[0-9,]*)\]"
+    r"(?:\{[^}]*\})?")
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def _dtype_bytes(dtype):
+    """Byte width of an HLO element type token (pred and f8 count 1)."""
+    if dtype == "pred" or dtype.startswith("f8"):
+        return 1
+    bits = int(re.sub(r"[a-z]", "", dtype) or 8)
+    return max(1, bits // 8)
+
+
+def _shape_bytes(dtype, dims):
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _dtype_bytes(dtype), n
+
+
+def parse_hlo_buffers(text, top_k=8):
+    """Top-K largest result buffers in an optimized HLO module text.
+
+    Each op line defines one result buffer (tuple results — while-loop
+    carries, fusion outputs — count one buffer PER element, which is
+    how buffer assignment sees them). Identical (bytes, shape, op,
+    provenance) entries collapse with a count — a scan carry shows as
+    one row x N, not N rows. Returns dicts sorted largest-first:
+    {"bytes", "elems", "dtype", "shape", "op", "name", "op_name",
+    "count"}."""
+    merged = {}
+    for line in text.splitlines():
+        m = _HLO_LINE_RE.match(line)
+        if m is None or "=" not in line:
+            continue
+        rest = m.group("rest")
+        # the result type is the shape token run at the START of `rest`
+        # (operand shapes live inside the op's parens, further right)
+        pos = 1 if rest.startswith("(") else 0
+        shapes = []
+        while True:
+            sm = _SHAPE_TOK_RE.match(rest, pos)
+            if sm is None:
+                break
+            shapes.append((sm.group("dtype"), sm.group("dims")))
+            pos = sm.end()
+            while pos < len(rest) and rest[pos] in ", )":
+                pos += 1
+        if not shapes:
+            continue
+        op = rest[pos:].split("(", 1)[0].strip().split(" ")[0]
+        pm = _OP_NAME_RE.search(line)
+        op_name = pm.group(1) if pm else None
+        for dtype, dims in shapes:
+            nbytes, elems = _shape_bytes(dtype, dims)
+            key = (nbytes, dtype, dims, op, op_name)
+            ent = merged.get(key)
+            if ent is None:
+                merged[key] = {
+                    "bytes": nbytes, "elems": elems, "dtype": dtype,
+                    "shape": f"[{dims}]", "op": op,
+                    "name": m.group("name"), "op_name": op_name,
+                    "count": 1,
+                }
+            else:
+                ent["count"] += 1
+    out = sorted(merged.values(), key=lambda e: -e["bytes"])
+    return out[:top_k] if top_k is not None else out
+
+
+class CompiledMemoryProfile:
+    """XLA buffer-assignment stats of ONE compiled step program.
+
+    Built via `from_lowered`/`from_compiled` — pure AOT analysis, the
+    program is never executed and no device memory is touched. All
+    byte fields may be None on a backend that hides a stat; `peak_bytes`
+    is argument + output + temp - alias (what the program needs resident
+    at dispatch: aliased/donated state is counted once)."""
+
+    def __init__(self):
+        self.argument_bytes = None
+        self.output_bytes = None
+        self.temp_bytes = None
+        self.alias_bytes = None
+        self.generated_code_bytes = None
+        self.peak_bytes = None
+        self.peak_source = None    # "reported" (jaxlib) | "derived"
+        self.largest_buffer_bytes = None
+        self.top_buffers = []
+        self.errors = {}
+
+    # -- constructors ----------------------------------------------------
+    @classmethod
+    def from_compiled(cls, compiled, top_k=8):
+        prof = cls()
+        try:
+            ma = compiled.memory_analysis()
+            for field, attr in (
+                    ("argument_bytes", "argument_size_in_bytes"),
+                    ("output_bytes", "output_size_in_bytes"),
+                    ("temp_bytes", "temp_size_in_bytes"),
+                    ("alias_bytes", "alias_size_in_bytes"),
+                    ("generated_code_bytes",
+                     "generated_code_size_in_bytes")):
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    setattr(prof, field, int(v))
+            # newer jaxlibs report the scheduled peak directly; older
+            # ones imply it (the diag_fused_mem formula)
+            peak = getattr(ma, "peak_memory_in_bytes", None)
+            if peak:
+                # the scheduled peak — generally BELOW the arg+out+temp
+                # sum (temps are not all live at once)
+                prof.peak_bytes = int(peak)
+                prof.peak_source = "reported"
+            elif None not in (prof.argument_bytes, prof.output_bytes,
+                              prof.temp_bytes):
+                prof.peak_bytes = (prof.argument_bytes
+                                   + prof.output_bytes
+                                   + prof.temp_bytes
+                                   - (prof.alias_bytes or 0))
+                prof.peak_source = "derived"
+        except Exception as e:
+            prof.errors["memory_analysis"] = (
+                f"{type(e).__name__}: {e}"[:200])
+        try:
+            prof.top_buffers = parse_hlo_buffers(compiled.as_text(),
+                                                 top_k=top_k)
+            if prof.top_buffers:
+                prof.largest_buffer_bytes = prof.top_buffers[0]["bytes"]
+        except Exception as e:
+            prof.errors["hlo_buffers"] = f"{type(e).__name__}: {e}"[:200]
+        return prof
+
+    @classmethod
+    def from_lowered(cls, lowered, top_k=8):
+        return cls.from_compiled(lowered.compile(), top_k=top_k)
+
+    @classmethod
+    def from_jitted(cls, jitted, *args, top_k=8, **kw):
+        """AOT lower+compile `jitted` for `args` and profile — with the
+        persistent compile cache warm this is cheap (the step already
+        compiled the same program)."""
+        return cls.from_lowered(jitted.lower(*args, **kw), top_k=top_k)
+
+    # -- surfaces --------------------------------------------------------
+    def summary(self, top_k=None) -> dict:
+        out = {
+            "peak_bytes": self.peak_bytes,
+            "peak_source": self.peak_source,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "largest_buffer_bytes": self.largest_buffer_bytes,
+            "top_buffers": (self.top_buffers if top_k is None
+                            else self.top_buffers[:top_k]),
+        }
+        if self.errors:
+            out["errors"] = dict(self.errors)
+        return out
+
+    def publish(self, name="step", registry=None):
+        """``mem.compiled.<name>.*`` gauges (plain values — profiling
+        already paid the cost; a scrape just reads)."""
+        reg = registry if registry is not None else _registry()
+        for field in ("peak_bytes", "argument_bytes", "output_bytes",
+                      "temp_bytes", "alias_bytes",
+                      "largest_buffer_bytes"):
+            v = getattr(self, field)
+            if v is not None:
+                reg.gauge(f"mem.compiled.{name}.{field}").set(v)
+        return self
+
+    def render(self) -> str:
+        """Human table (the diag_fused_mem.py CLI surface)."""
+        G = 1 << 30
+        lines = []
+        for field in ("argument_bytes", "output_bytes", "temp_bytes",
+                      "alias_bytes"):
+            v = getattr(self, field)
+            if v is not None:
+                lines.append(f"  {field.replace('_bytes', '_size'):<16}"
+                             f"{v / G:.2f} G")
+        if self.peak_bytes is not None:
+            lines.append(f"  peak (arg+out+temp-alias) "
+                         f"{self.peak_bytes / G:.2f} G")
+        if self.top_buffers:
+            lines.append("  top buffers:")
+            for b in self.top_buffers:
+                prov = b["op_name"] or b["name"]
+                lines.append(
+                    f"    {b['bytes'] / G:8.3f} G  {b['dtype']}"
+                    f"{b['shape']} x{b['count']}  {b['op']}  {prov}")
+        for k, v in self.errors.items():
+            lines.append(f"  [{k} unavailable: {v}]")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# leg 2: live-buffer attribution
+# ---------------------------------------------------------------------------
+
+def device_bytes(arr) -> int:
+    """Device-RESIDENT bytes of one jax array: the sum over its
+    addressable shards, so replication counts fully (a replicated array
+    on an 8-device mesh costs 8x its logical bytes of device memory)
+    and a 1/N-sharded array counts its logical bytes once."""
+    try:
+        shards = arr.addressable_shards
+        if shards:
+            return int(sum(s.data.nbytes for s in shards))
+    except Exception:
+        pass
+    try:
+        return int(arr.nbytes)
+    except Exception:
+        return 0
+
+
+def _flatten_arrays(x, out):
+    import jax
+
+    if isinstance(x, jax.Array):
+        out.append(x)
+    elif isinstance(x, (list, tuple)):
+        for v in x:
+            _flatten_arrays(v, out)
+    elif isinstance(x, dict):
+        for v in x.values():
+            _flatten_arrays(v, out)
+    elif hasattr(x, "_data"):          # Tensor/Parameter
+        _flatten_arrays(x._data, out)
+
+
+class LiveBufferRegistry:
+    """Weakly tracked producers, each exposing ``_mem_owners() ->
+    {owner_name: arrays}`` (arrays may be nested lists/dicts/Tensors).
+    `report()` attributes every ``jax.live_arrays()`` entry to the
+    first claiming owner, in registration order; unclaimed bytes are
+    ``untagged``. Tracking is free on the hot path — providers are only
+    called at scrape time, and a garbage-collected producer simply
+    drops out of the walk."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._tracked = {}     # seq -> weakref
+
+    def track(self, obj):
+        """Idempotent per object; returns obj for chaining."""
+        with self._lock:
+            for ref in self._tracked.values():
+                if ref() is obj:
+                    return obj
+            self._seq += 1
+            seq = self._seq
+            self._tracked[seq] = weakref.ref(
+                obj, lambda _r, s=seq: self._tracked.pop(s, None))
+        return obj
+
+    def untrack(self, obj):
+        with self._lock:
+            dead = [s for s, r in self._tracked.items()
+                    if r() is obj or r() is None]
+            for s in dead:
+                self._tracked.pop(s, None)
+
+    def producers(self):
+        with self._lock:
+            refs = sorted(self._tracked.items())
+        return [o for _, r in refs if (o := r()) is not None]
+
+    def clear(self):
+        with self._lock:
+            self._tracked.clear()
+
+    def report(self, publish=False, registry=None, prefix="mem.live"
+               ) -> dict:
+        """{"total_bytes", "owners": {name: bytes}, "untagged_bytes",
+        "counts": {name: n_buffers}, "buffers"} over every live array
+        in the process. With ``publish``, ``mem.live.<owner>`` gauges
+        land on the registry."""
+        import jax
+
+        id2owner = {}
+        for obj in self.producers():
+            try:
+                owners = obj._mem_owners()
+            except Exception:
+                continue
+            for owner, arrays in owners.items():
+                leaves = []
+                _flatten_arrays(arrays, leaves)
+                for leaf in leaves:
+                    id2owner.setdefault(id(leaf), owner)
+        owners_b, counts = {}, {}
+        total = untagged = untagged_n = 0
+        n = 0
+        for arr in jax.live_arrays():
+            b = device_bytes(arr)
+            total += b
+            n += 1
+            owner = id2owner.get(id(arr))
+            if owner is None:
+                untagged += b
+                untagged_n += 1
+            else:
+                owners_b[owner] = owners_b.get(owner, 0) + b
+                counts[owner] = counts.get(owner, 0) + 1
+        rep = {"total_bytes": total, "buffers": n,
+               "owners": dict(sorted(owners_b.items(),
+                                     key=lambda kv: -kv[1])),
+               "counts": counts,
+               "untagged_bytes": untagged,
+               "untagged_buffers": untagged_n}
+        if publish:
+            reg = registry if registry is not None else _registry()
+            reg.gauge(f"{prefix}.total_bytes").set(total)
+            reg.gauge(f"{prefix}.untagged_bytes").set(untagged)
+            for owner, b in owners_b.items():
+                reg.gauge(f"{prefix}.{owner}").set(b)
+            # an owner that vanished since the last walk (engine torn
+            # down, cache freed) must not keep its last value on the
+            # scrape surface — phantom bytes would break the
+            # owners+untagged==total invariant the report guarantees
+            for name in reg.names(prefix=f"{prefix}."):
+                owner = name[len(prefix) + 1:]
+                if owner not in owners_b and owner not in (
+                        "total_bytes", "untagged_bytes"):
+                    reg.gauge(name).set(0)
+        return rep
+
+
+_live_lock = threading.Lock()
+_live_registry = None
+
+
+def live_registry() -> LiveBufferRegistry:
+    global _live_registry
+    if _live_registry is None:
+        with _live_lock:
+            if _live_registry is None:
+                _live_registry = LiveBufferRegistry()
+    return _live_registry
+
+
+def live_buffer_report(publish=True, registry=None) -> dict:
+    """Module-level convenience: the global registry's attribution walk
+    (publishes ``mem.live.*`` gauges by default — this IS the scrape)."""
+    return live_registry().report(publish=publish, registry=registry)
+
+
+# ---------------------------------------------------------------------------
+# leg 3: OOM forensics
+# ---------------------------------------------------------------------------
+
+_OOM_RE = re.compile(
+    r"RESOURCE[ _]EXHAUSTED|[Rr]esource exhausted|[Oo]ut of memory|"
+    r"\bOOM\b|failed to allocate")
+
+_last_oom_report = None
+
+
+def is_oom_error(exc) -> bool:
+    """A device allocation failure (XLA RESOURCE_EXHAUSTED flavor) —
+    matched on the message, so the synthetic-injection tests and every
+    jaxlib's exception class all route the same way."""
+    return isinstance(exc, Exception) and bool(_OOM_RE.search(str(exc)))
+
+
+def last_oom_report():
+    """The most recent dump_oom payload (None if never) — the test /
+    postmortem lookup that does not need to re-read the flight file."""
+    return _last_oom_report
+
+
+def dump_oom(exc, step="", profile=None, context=None) -> dict:
+    """The forensics a RESOURCE_EXHAUSTED deserves, taken at the raise
+    site BEFORE the error propagates: the live-buffer attribution (what
+    was resident), the step's compiled memory profile (what the program
+    wanted — a `CompiledMemoryProfile`, a summary dict, or a zero-arg
+    thunk computing one; thunk failures are recorded, never raised),
+    and the top-K buffers, all pushed through the PR-12 flight recorder
+    (one `oom` ring event + a crash dump file). Never raises; returns
+    the payload."""
+    global _last_oom_report
+    from .flight_recorder import recorder
+
+    payload = {"step": step, "error": f"{type(exc).__name__}: "
+                                      f"{exc}"[:500]}
+    try:
+        payload["live"] = live_buffer_report(publish=False)
+    except Exception as e:
+        payload["live"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    prof = profile
+    try:
+        if callable(prof) and not isinstance(prof,
+                                             CompiledMemoryProfile):
+            prof = prof()
+        if isinstance(prof, CompiledMemoryProfile):
+            prof = prof.summary()
+        if isinstance(prof, dict):
+            payload["compiled"] = prof
+    except Exception as e:
+        payload["compiled"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if context:
+        payload["context"] = dict(context)
+    try:
+        _registry().counter("mem.oom.count").inc()
+    except Exception:
+        pass
+    rec = recorder()
+    try:
+        top = (payload.get("compiled") or {}).get("top_buffers") or []
+        rec.note("oom", step=step,
+                 error=payload["error"][:200],
+                 live_total_bytes=(payload.get("live") or {}).get(
+                     "total_bytes"),
+                 live_owners=(payload.get("live") or {}).get("owners"),
+                 compiled_peak_bytes=(payload.get("compiled") or {}
+                                      ).get("peak_bytes"),
+                 top_buffers=[f"{b['bytes']}B {b['dtype']}{b['shape']} "
+                              f"{b['op_name'] or b['op']}"
+                              for b in top[:5]])
+        payload["dump_path"] = rec.dump(
+            reason=f"RESOURCE_EXHAUSTED in {step or 'step dispatch'}",
+            exc=exc)
+    except Exception:
+        payload["dump_path"] = None
+    _last_oom_report = payload
+    return payload
+
+
+@contextlib.contextmanager
+def oom_guard(step="", profile=None, context=None):
+    """Wrap a compiled-step dispatch: a RESOURCE_EXHAUSTED escaping the
+    body dumps forensics (see `dump_oom`) and re-raises; every other
+    outcome is untouched. Zero cost when nothing raises."""
+    try:
+        yield
+    except Exception as e:
+        if is_oom_error(e):
+            dump_oom(e, step=step, profile=profile, context=context)
+        raise
+
+
+# ---------------------------------------------------------------------------
+# /memz payload (debug_server wires this as a default endpoint)
+# ---------------------------------------------------------------------------
+
+def memz_payload(registry=None) -> dict:
+    """The /memz debug-server body: live attribution + every published
+    ``mem.compiled.*`` gauge + the last OOM dump (if any)."""
+    reg = registry if registry is not None else _registry()
+    out = {"live": live_buffer_report(publish=True, registry=reg)}
+    compiled = {}
+    for name in reg.names(prefix="mem.compiled."):
+        g = reg.get(name)
+        if g is not None:
+            compiled[name[len("mem.compiled."):]] = g.value
+    out["compiled"] = compiled
+    if _last_oom_report is not None:
+        out["last_oom"] = {k: v for k, v in _last_oom_report.items()
+                           if k != "live"}
+    return out

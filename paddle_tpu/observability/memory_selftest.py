@@ -1,0 +1,301 @@
+"""Hermetic device-memory observability selftest (ISSUE 14 lane).
+
+Run as ``python -m paddle_tpu.observability.memory_selftest`` in a
+clean JAX_PLATFORMS=cpu subprocess with 8 virtual host devices
+(bench.py run_selftest wires it; ``python bench.py --memory`` is the
+CLI) and prints ONE JSON line for BENCH_r*.json:
+
+* **compiled profiles** — `step.memory_profile()` on the fused-scan,
+  eager and decode step paths returns consistent buffer-assignment
+  stats (peak == argument + output + temp - alias, top-K buffers with
+  provenance, ``mem.compiled.*`` gauges), and profiling adds ZERO
+  executables/retraces to the live step;
+* **live attribution** — tagged owners (params, optimizer state, KV
+  pools) + untagged residue sum EXACTLY to the `jax.live_arrays()`
+  total, and the params owner matches the model's known byte count;
+* **sharded-vs-replicated receipt** — the PR-11 param-storage A/B
+  measured through the ONE profile implementation: the sharded-storage
+  probe program's largest buffer and peak are strictly below the
+  replicated ones (the measured numbers land in the record — the
+  receipt PERF.md cites);
+* **OOM forensics** — a synthetic RESOURCE_EXHAUSTED at the dispatch
+  boundary produces a flight-recorder dump holding the live
+  attribution + the compiled profile + top-K buffers, re-raises the
+  original error, and leaves the step usable at one executable;
+* **/memz** — the debug-server endpoint returns the attribution as
+  JSON;
+* **overhead** — the per-step work this layer adds to the dispatch hot
+  path (the OOM-guard context) is measured at <= 1% of a
+  representative step's time; the scrape cost (a full
+  live_buffer_report walk) is recorded for context (scrapes are
+  off-path by design).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+TINY = dict(vocab_size=96, hidden_size=32, num_layers=4,
+            num_attention_heads=2, max_position_embeddings=16,
+            hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+def run_probe(n_devices=8):
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu import observability as obs
+    from paddle_tpu.jit import FusedScanTrainStep, TrainStep
+    from paddle_tpu.models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    devs = jax.devices("cpu")
+    if len(devs) < n_devices:
+        return {"memory_observability":
+                {"check": f"FAIL: {len(devs)} cpu devices"}}
+    obs.set_strict_retrace(True)
+    rec, fails = {}, []
+
+    def check(name, fn):
+        try:
+            fn()
+            rec[name] = "pass"
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            rec[name] = f"FAIL: {type(e).__name__}: {e}"[:300]
+            fails.append(name)
+
+    crit = GPTPretrainingCriterion()
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, TINY["vocab_size"], (8, 16)),
+                           dtype="int64")
+    labels = paddle.to_tensor(
+        rng.integers(0, TINY["vocab_size"], (8, 16)), dtype="int64")
+
+    cfg = GPTConfig(**TINY, scan_layers=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = popt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    fstep = FusedScanTrainStep(model, opt, criterion=crit)
+    fstep(ids, labels)
+
+    # -- compiled profiles: consistency + zero added retraces ----------
+    def compiled_profiles():
+        prof = fstep.memory_profile(ids, labels)
+        s = prof.summary()
+        assert s["peak_bytes"] and s["peak_bytes"] > 0, s
+        # the arg+out+temp-alias identity holds only for the DERIVED
+        # peak; a jaxlib-reported scheduled peak is <= that sum
+        if s["peak_source"] == "derived":
+            assert s["peak_bytes"] == (s["argument_bytes"]
+                                       + s["output_bytes"]
+                                       + s["temp_bytes"]
+                                       - (s["alias_bytes"] or 0)), s
+        else:
+            assert s["peak_bytes"] <= (s["argument_bytes"]
+                                       + s["output_bytes"]
+                                       + s["temp_bytes"]), s
+        assert prof.top_buffers, "no buffers parsed"
+        assert all(b["bytes"] >= prof.top_buffers[-1]["bytes"]
+                   for b in prof.top_buffers), prof.top_buffers
+        assert any(b["op_name"] or b["name"]
+                   for b in prof.top_buffers), prof.top_buffers
+        g = obs.registry().get(
+            "mem.compiled.FusedScanTrainStep.peak_bytes")
+        assert g is not None and g.value == s["peak_bytes"]
+        rec["fused_profile"] = {k: s[k] for k in
+                               ("peak_bytes", "argument_bytes",
+                                "temp_bytes", "alias_bytes")}
+        # eager path
+        cfg2 = GPTConfig(**TINY, scan_layers=False)
+        paddle.seed(0)
+        m2 = GPTForCausalLM(cfg2)
+        opt2 = popt.AdamW(learning_rate=1e-3,
+                          parameters=m2.parameters())
+        tstep = TrainStep(m2, lambda m, a, b: crit(m(a), b), opt2)
+        tstep(ids, labels)
+        p2 = tstep.memory_profile(ids, labels)
+        assert p2.peak_bytes and p2.top_buffers, p2.summary()
+        rec["eager_peak_bytes"] = p2.peak_bytes
+        # decode path (paged engine)
+        m2.eval()
+        from paddle_tpu.jit.decode_step import GenerationEngine
+
+        eng = GenerationEngine(m2, kind="paged", batch=2, max_len=16)
+        eng.generate(np.ones((2, 4), np.int64), 2)
+        p3 = eng.memory_profile()
+        assert p3.peak_bytes and p3.top_buffers, p3.summary()
+        rec["decode_peak_bytes"] = p3.peak_bytes
+        # profiling is AOT: the live steps hold ONE executable and the
+        # sentinel saw nothing unexpected
+        fstep(ids, labels)
+        tstep(ids, labels)
+        assert fstep.retrace_stats()["signatures"] == 1
+        assert fstep.retrace_stats()["unexpected"] == 0
+        if hasattr(fstep._jitted, "_cache_size"):
+            assert fstep._jitted._cache_size() == 1
+        assert eng.decode_step.trace_count == 1
+
+    check("compiled_profiles", compiled_profiles)
+
+    # -- live attribution sums to jax.live_arrays() totals -------------
+    def live_attribution():
+        rep = obs.live_buffer_report()
+        tagged = sum(rep["owners"].values())
+        assert tagged + rep["untagged_bytes"] == rep["total_bytes"], rep
+        n_param_bytes = sum(
+            int(np.prod(p.shape)) * 4 for p in model.parameters())
+        assert rep["owners"].get("params", 0) >= n_param_bytes, (
+            rep["owners"], n_param_bytes)
+        assert rep["owners"].get("opt_state", 0) >= 2 * n_param_bytes, \
+            rep["owners"]
+        assert rep["owners"].get("kv_pages", 0) > 0, rep["owners"]
+        rec["live"] = {"total_bytes": rep["total_bytes"],
+                       "owners": rep["owners"],
+                       "untagged_bytes": rep["untagged_bytes"]}
+        # gauges landed
+        assert obs.registry().get("mem.live.total_bytes").value == \
+            rep["total_bytes"]
+
+    check("live_attribution", live_attribution)
+
+    # -- sharded vs replicated param storage: the measured receipt -----
+    def storage_delta():
+        from paddle_tpu.jit.sharded_scan import build_probe_lowered
+        from paddle_tpu.observability.memory import (
+            CompiledMemoryProfile,
+        )
+
+        pr = {}
+        for storage in ("replicated", "sharded"):
+            lowered = build_probe_lowered(n_devices=n_devices,
+                                          param_storage=storage)
+            pr[storage] = CompiledMemoryProfile.from_lowered(lowered)
+        s, r = pr["sharded"], pr["replicated"]
+        assert s.largest_buffer_bytes < r.largest_buffer_bytes, (
+            s.largest_buffer_bytes, r.largest_buffer_bytes)
+        assert s.peak_bytes < r.peak_bytes, (s.peak_bytes, r.peak_bytes)
+        rec["storage_receipt"] = {
+            "replicated": {"peak_bytes": r.peak_bytes,
+                           "largest_buffer_bytes":
+                           r.largest_buffer_bytes},
+            "sharded": {"peak_bytes": s.peak_bytes,
+                        "largest_buffer_bytes": s.largest_buffer_bytes},
+            "peak_delta_bytes": r.peak_bytes - s.peak_bytes,
+            "largest_ratio": round(s.largest_buffer_bytes
+                                   / r.largest_buffer_bytes, 4),
+        }
+
+    check("sharded_vs_replicated_receipt", storage_delta)
+
+    # -- OOM forensics: synthetic RESOURCE_EXHAUSTED -------------------
+    def oom_forensics():
+        class Boom:
+            """Dispatch raises like a real allocator failure; AOT
+            lowering still works (the forensics path re-lowers)."""
+
+            def __init__(self, orig):
+                self.orig = orig
+
+            def __call__(self, *a, **k):
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying "
+                    "to allocate 17179869184 bytes")
+
+            def lower(self, *a, **k):
+                return self.orig.lower(*a, **k)
+
+        orig = fstep._jitted
+        fstep._jitted = Boom(orig)
+        try:
+            try:
+                fstep(ids, labels)
+                raise AssertionError("synthetic OOM not raised")
+            except RuntimeError as e:
+                assert "RESOURCE_EXHAUSTED" in str(e)
+        finally:
+            fstep._jitted = orig
+        dump = obs.last_oom_report()
+        assert dump is not None and dump["step"] == \
+            "FusedScanTrainStep", dump
+        assert dump["live"]["total_bytes"] > 0, dump
+        assert dump["compiled"]["peak_bytes"] > 0, dump
+        assert dump["compiled"]["top_buffers"], dump
+        path = dump["dump_path"]
+        assert path and os.path.exists(path), path
+        with open(path) as f:
+            disk = json.load(f)
+        assert any(ev.get("kind") == "oom" and ev.get("top_buffers")
+                   for ev in disk["events"]), disk["events"][-3:]
+        # the step survives the OOM path at one executable
+        fstep(ids, labels)
+        if hasattr(fstep._jitted, "_cache_size"):
+            assert fstep._jitted._cache_size() == 1
+        rec["oom_dump"] = {"path": os.path.basename(path),
+                           "compiled_peak_bytes":
+                           dump["compiled"]["peak_bytes"]}
+
+    check("oom_forensics", oom_forensics)
+
+    # -- /memz endpoint -------------------------------------------------
+    def memz_endpoint():
+        import urllib.request
+
+        with obs.DebugServer(port=0) as srv:
+            body = json.load(urllib.request.urlopen(
+                f"{srv.url}/memz", timeout=5))
+        assert body["live"]["total_bytes"] > 0, body
+        assert any("peak_bytes" in k for k in body["compiled"]), body
+        assert "last_oom" in body, list(body)
+
+    check("memz_endpoint", memz_endpoint)
+
+    # -- hot-path overhead <= 1% of step time --------------------------
+    def overhead():
+        from paddle_tpu.observability.memory import oom_guard
+
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            loss = fstep(ids, labels)
+            jax.block_until_ready(loss._data)
+            times.append(time.perf_counter() - t0)
+        step_ms = min(times) * 1e3
+        # the per-dispatch work ISSUE 14 added to the hot path is ONE
+        # context manager around the compiled call — time it directly
+        reps = 200
+        thunk = lambda: None                      # noqa: E731
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            with oom_guard(step="overhead", profile=thunk):
+                pass
+        guard_ms = (time.perf_counter() - t0) / reps * 1e3
+        # scrape cost, for context (off the hot path by design)
+        t0 = time.perf_counter()
+        obs.live_buffer_report(publish=False)
+        scrape_ms = (time.perf_counter() - t0) * 1e3
+        ratio = guard_ms / step_ms
+        rec["overhead_measured"] = {
+            "step_ms": round(step_ms, 3),
+            "oom_guard_ms_per_step": round(guard_ms, 5),
+            "ratio": round(ratio, 6),
+            "live_scrape_ms": round(scrape_ms, 3)}
+        assert ratio <= 0.01, rec["overhead_measured"]
+
+    check("overhead", overhead)
+
+    summary = obs.retrace_summary()
+    rec["retrace_summary"] = {
+        "total_unexpected": summary["total_unexpected"],
+        "strict": obs.strict_retrace(),
+    }
+    rec["check"] = ("pass" if not fails
+                    else "FAIL: " + ", ".join(fails))
+    return {"memory_observability": rec}
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_probe()))

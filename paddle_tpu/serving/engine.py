@@ -32,7 +32,7 @@ timeline. Requests whose TTFT or worst inter-token gap lands beyond a
 configurable percentile of the live distribution keep their full span
 tree in the tail-exemplar ring (`slow_requests()`); declared SLOs get
 rolling-window burn-rate gauges; `start_debug_server()` serves
-/metrics /healthz /tracez /sloz /flightz over loopback.
+/metrics /healthz /tracez /sloz /flightz /memz over loopback.
 """
 from __future__ import annotations
 
@@ -105,6 +105,11 @@ class ServingEngine:
                              1 + self.max_slots * self.pages_per_seq)
         self._params = list(model.parameters())
         self.cache = self._make_cache()
+        # live-buffer attribution (ISSUE 14): a serving-only process
+        # has no train step to claim the model weights
+        from ..observability.memory import live_registry
+
+        live_registry().track(self)
         # request-scoped tracing + SLOs (ISSUE 13): per-engine tracer
         # over the per-engine registry; `slos` declares objectives as
         # (name, metric, threshold_s[, target[, window_s]]) tuples,
@@ -119,6 +124,7 @@ class ServingEngine:
             self.declare_slo(*spec)
         self.metrics = ServingMetrics(clock=clock, registry=reg,
                                       slo=self.slo)
+        self._register_mem_gauges()
         self.tracer = Tracer(capacity=trace_capacity,
                              exemplar_capacity=exemplar_capacity,
                              clock=clock,
@@ -289,6 +295,7 @@ class ServingEngine:
         self.metrics = ServingMetrics(clock=self.clock, slo=self.slo)
         self.scheduler.metrics = self.metrics
         self.slo.bind_registry(self.metrics.registry)
+        self._register_mem_gauges()
         self.tracer.clear()
         self.tracer.bind_registry(self.metrics.registry)
         self._exemplar_thr = (None, None)
@@ -565,12 +572,69 @@ class ServingEngine:
     def slo_status(self) -> dict:
         return self.slo.snapshot()
 
+    # -- memory observability (ISSUE 14) ----------------------------------
+    def _mem_owners(self):
+        # shard-backed params (a sharded-storage train step sharing
+        # this model) are skipped: reading them would GATHER on scrape,
+        # and the owning step already claims the shards
+        return {"params": [p._data for p in self._params
+                           if not getattr(type(p), "_shard_backed",
+                                          False)]}
+
+    def _pool_stats_cached(self, ttl_s=0.2):
+        """One `pool_stats()` walk shared by the four gauges of a
+        single registry scrape (the walk sorts the free list — paying
+        it per gauge would quadruple scrape cost for identical data).
+        The tiny TTL only coalesces gauges read back-to-back; the
+        serve loop never reads it. Wall-clock TTL on purpose — the
+        injectable `self.clock` may be frozen in tests."""
+        now = time.monotonic()
+        cached = self._pool_stats_memo
+        if cached is None or now - cached[0] > ttl_s:
+            cached = (now, self.cache.pool_stats())
+            self._pool_stats_memo = cached
+        return cached[1]
+
+    def _register_mem_gauges(self):
+        """Page-pool occupancy/fragmentation as LAZY gauges on this
+        engine's registry: a scrape pays the O(pool) walk (once — see
+        `_pool_stats_cached`), the serve loop never does. Bound
+        through ``self`` so `_recover`'s cache swap stays covered."""
+        self._pool_stats_memo = None
+        reg = self.metrics.registry
+        reg.gauge("serving.kv.free_pages").set_fn(
+            lambda: self.cache.free_page_count)
+        for stat in ("used_pages", "occupancy", "fragmentation",
+                     "max_contiguous_free"):
+            reg.gauge(f"serving.kv.{stat}").set_fn(
+                (lambda s: lambda: self._pool_stats_cached()[s])(stat))
+
+    def memory_profile(self, top_k=8, publish=True):
+        """Compiled serve-decode-step memory profile at this engine's
+        live geometry (params + KV pools + host metadata) — the AOT
+        buffer-assignment view of what one decode burst reserves. See
+        `_Step.memory_profile`."""
+        return self.decode_step.memory_profile(
+            self._param_data(), self._buffers, self._meta(),
+            self._tokens, self._seeds, top_k=top_k, publish=publish)
+
+    def memz(self) -> dict:
+        """The /memz debug-endpoint body for this engine: process-wide
+        live-buffer attribution + published compiled profiles + THIS
+        engine's page-pool stats."""
+        from ..observability.memory import memz_payload
+
+        out = memz_payload()
+        out["pool"] = self.cache.pool_stats()
+        return out
+
     def start_debug_server(self, port=0) -> int:
         """Opt-in loopback debug/scrape server for THIS engine:
         /metrics (this engine's registry as Prometheus text, ==
         `metrics_text()`), /healthz, /tracez (recent traces + tail
         exemplars), /sloz (burn rates), /flightz (process flight
-        recorder). Returns the bound port."""
+        recorder), /memz (live-buffer attribution + page-pool stats).
+        Returns the bound port."""
         if self._debug_server is not None:
             return self._debug_server.port
         from ..observability import DebugServer
@@ -578,7 +642,8 @@ class ServingEngine:
         self._debug_server = DebugServer(
             registry=lambda: self.metrics.registry,
             tracer=lambda: self.tracer,
-            extra={"sloz": lambda: self.slo.snapshot()},
+            extra={"sloz": lambda: self.slo.snapshot(),
+                   "memz": self.memz},
             port=port)
         return self._debug_server.start()
 

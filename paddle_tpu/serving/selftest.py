@@ -20,6 +20,10 @@ for BENCH_r*.json:
 * **traffic A/B** — continuous vs static generate-and-wait batching at
   three concurrency levels: p50/p99 TTFT and aggregate tok/s, with
   continuous required to win on tok/s at the highest level.
+* **pool hygiene (ISSUE 14)** — `PagedKVCache.pool_stats()` leak
+  assertions on the churn and preemption lanes: after drain the pool
+  reads fully free (used 0, per-slot counts empty, fragmentation 0.0,
+  used+free == total).
 * **trace forensics (ISSUE 13)** — under churn with preemptions every
   retired request's trace is a complete causal timeline (root span
   with >=1 prefill child and >=1 decode child; preempted-then-resumed
@@ -99,6 +103,17 @@ def run_probe():
         leaks = eng.leak_check()
         assert leaks["free_pages"] == leaks["total_pages"], leaks
         assert leaks["free_slots"] == leaks["total_slots"], leaks
+        # pool_stats leak assertions (ISSUE 14): after drain the pool
+        # must read fully free, unfragmented, with zero per-slot pages
+        # — and the used+free==total invariant must have held
+        ps = eng.cache.pool_stats()
+        assert ps["used_pages"] == 0 and ps["slot_pages"] == {}, ps
+        assert ps["free_pages"] == ps["total_pages"], ps
+        assert ps["used_pages"] + ps["free_pages"] == \
+            ps["total_pages"], ps
+        assert ps["fragmentation"] == 0.0 and \
+            ps["max_contiguous_free"] == ps["free_pages"], ps
+        rec["pool_stats_after_drain"] = ps
         cc = eng.compile_counts()
         assert cc["decode_traces"] == 1, cc
         assert cc["prefill_traces"] <= len(cc["chunk_buckets"]), cc
@@ -123,6 +138,10 @@ def run_probe():
         tight_eng, tight = serve(9)    # 8 usable pages -> pool dries up
         assert tight_eng.metrics.preemptions >= 1, \
             "pool never dried — selftest is not exercising preemption"
+        # the preemption-churned pool must also drain leak-free
+        ps = tight_eng.cache.pool_stats()
+        assert ps["used_pages"] == 0 and ps["slot_pages"] == {}, ps
+        assert ps["free_pages"] == ps["total_pages"], ps
         assert full_eng.metrics.preemptions == 0
         for a, b in zip(full, tight):
             assert a.output_tokens == b.output_tokens, \
@@ -337,8 +356,21 @@ def run_bench():
                 cont["tok_s"] / max(stat["tok_s"], 1e-9), 3),
             "retrace_free": cont["compile"]["decode_traces"] == 1,
         }
+        mem_eng = eng
     agg = {side: round(v[0] / max(v[1], 1e-9), 1)
            for side, v in tot.items()}
+    # per-lane device-memory receipt (ISSUE 14): the compiled
+    # serve-decode-step peak at the highest concurrency level + the
+    # live-buffer attribution (params vs KV pools vs untagged) + the
+    # drained pool stats — failures must not eat the serving numbers
+    try:
+        from paddle_tpu.observability.memory import live_buffer_report
+
+        mem = {"compiled": mem_eng.memory_profile(top_k=4).summary(),
+               "live": live_buffer_report(),
+               "pool": mem_eng.cache.pool_stats()}
+    except Exception as e:
+        mem = {"error": f"{type(e).__name__}: {e}"[:200]}
     return {
         "metric": "serving_continuous_vs_static",
         "config": {"model": model_name, "levels": list(levels),
@@ -351,6 +383,7 @@ def run_bench():
         "aggregate_speedup": round(
             agg["continuous"] / max(agg["static"], 1e-9), 3),
         "lanes": lanes,
+        "mem": mem,
     }
 
 

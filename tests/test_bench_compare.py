@@ -22,13 +22,18 @@ def bc():
 
 
 def _record(tok_s=48000.0, mfu=0.6, ttft_p99=0.010, stall=0.1,
-            goodput=0.97):
+            goodput=0.97, peak_bytes=8 * 1024**3):
     return {
         "metric": "gpt3-350m_train_tokens_per_sec_per_chip",
         "value": tok_s, "unit": "tokens/s", "mfu": mfu,
         "config": {"batch": 8, "seq": 1024},
         "goodput": {"goodput_frac": goodput, "step_ms": 100.0},
         "input_pipeline": {"input_stall_ms": stall},
+        "mem": {"compiled": {"peak_bytes": peak_bytes,
+                             "argument_bytes": peak_bytes // 2,
+                             "temp_bytes": peak_bytes // 3},
+                "live": {"total_bytes": peak_bytes,
+                         "owners": {"params": peak_bytes // 4}}},
         "serving": {"ttft_p50_s": 0.004, "ttft_p99_s": ttft_p99,
                     "itl_p50_s": 0.002, "tok_s": 900.0},
         "north_star": {
@@ -49,6 +54,21 @@ class TestExtract:
         assert m["goodput.goodput_frac"] == 0.97
         # config ints are not metrics
         assert not any(k.startswith("config") for k in m)
+
+    def test_mem_family_detection(self, bc):
+        # ISSUE 14: peak-bytes keys join the `mem` family; the other
+        # byte fields (argument/temp/live owners) stay un-gated —
+        # argument bytes moving is not itself a regression, peak is
+        m = bc.extract_metrics(_record())
+        assert m["mem.compiled.peak_bytes"] == 8 * 1024**3
+        assert bc._family("peak_bytes") == "mem"
+        assert bc._family("dense_mem.peak_bytes") == "mem"
+        assert bc._family("argument_bytes") is None
+        assert "mem.compiled.argument_bytes" not in m
+        assert "mem.live.owners.params" not in m
+        assert "mem" in bc.DEFAULT_TOLERANCES
+        tol, higher_better, floor = bc.DEFAULT_TOLERANCES["mem"]
+        assert not higher_better and tol == 0.05 and floor > 0
 
     def test_nested_reference_does_not_overwrite(self, bc):
         rec = _record()
@@ -110,6 +130,35 @@ class TestCompare:
     def test_goodput_regression_flagged(self, bc):
         res = bc.compare(_record(), _record(goodput=0.80))
         assert "goodput.goodput_frac" in res["regressions"]
+
+    def test_injected_peak_memory_regression_fails_gate(self, bc):
+        # ISSUE 14 acceptance: +10% compiled-step peak regresses like
+        # a tok/s drop does
+        res = bc.compare(_record(),
+                         _record(peak_bytes=int(8 * 1024**3 * 1.10)))
+        assert res["status"] == "regress"
+        assert "mem.compiled.peak_bytes" in res["regressions"]
+
+    def test_peak_memory_direction_and_tolerance(self, bc):
+        # shrinking peak is an improvement; +3% is within tolerance
+        res = bc.compare(_record(),
+                         _record(peak_bytes=int(8 * 1024**3 * 0.80)))
+        verd = {r["metric"]: r["verdict"] for r in res["rows"]}
+        assert verd["mem.compiled.peak_bytes"] == "improved"
+        assert res["status"] == "pass"
+        res = bc.compare(_record(),
+                         _record(peak_bytes=int(8 * 1024**3 * 1.03)))
+        assert res["status"] == "pass"
+
+    def test_sub_floor_peak_is_informational(self, bc):
+        # toy-model selftest peaks (a few MB) must not gate even on a
+        # large relative move
+        res = bc.compare(_record(peak_bytes=2 * 1024**2),
+                         _record(peak_bytes=3 * 1024**2))   # +50%
+        row = {r["metric"]: r for r in res["rows"]}[
+            "mem.compiled.peak_bytes"]
+        assert row["verdict"] == "sub_floor"
+        assert res["status"] == "pass"
 
     def test_zero_baseline_stays_json_clean(self, bc):
         # a 0.0 baseline must not produce Infinity (invalid JSON for

@@ -28,10 +28,15 @@ Metric families and default tolerances (relative):
     ttft      +25%   lower is better  (latency lanes are CPU-noisy)
     itl       +25%   lower is better
     stall     +100%  lower is better  (sub-ms noise; abs floor below)
+    mem        +5%   lower is better  (compiled-step peak bytes —
+                     growth fails the gate like a tok/s regression,
+                     ISSUE 14; AOT buffer-assignment numbers are
+                     deterministic, so 5% is generous)
 
-Latency/stall metrics additionally carry an ABSOLUTE floor: when both
-sides sit under it, the row is informational (sub-floor jitter cannot
-regress the gate).
+Latency/stall/mem metrics additionally carry an ABSOLUTE floor: when
+both sides sit under it, the row is informational (sub-floor jitter
+cannot regress the gate — for mem, toy-model selftest peaks of a few
+MB must not gate while the flagship GB-scale peaks do).
 """
 from __future__ import annotations
 
@@ -52,6 +57,7 @@ DEFAULT_TOLERANCES = {
     "ttft":    (0.25, False, 2e-3),     # seconds
     "itl":     (0.25, False, 1e-3),     # seconds
     "stall":   (1.00, False, 0.5),      # milliseconds
+    "mem":     (0.05, False, 32 * 1024 * 1024),   # bytes (peak)
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -110,6 +116,8 @@ def load_record(path):
 
 def _family(key):
     k = key.lower()
+    if "peak_bytes" in k:
+        return "mem"
     if "goodput_frac" in k:
         return "goodput"
     if "ttft" in k:

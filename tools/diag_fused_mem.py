@@ -1,10 +1,17 @@
-"""AOT memory diagnosis of the fused-scan 1.3b step: lower+compile the
+"""AOT memory diagnosis of a fused-scan train step: lower+compile the
 program and print the XLA buffer-assignment stats (argument/output/temp/
-alias sizes) WITHOUT executing — the way to see whether donation aliased
-the state through the scan carries and where the peak lives, without
-paying an on-chip OOM each probe.
+alias sizes, the peak they imply, and the top-K largest buffers with
+HLO op provenance) WITHOUT executing — the way to see whether donation
+aliased the state through the scan carries and where the peak lives,
+without paying an on-chip OOM each probe.
+
+Since ISSUE 14 this is a thin CLI over
+``paddle_tpu.observability.memory.CompiledMemoryProfile`` — the ONE
+buffer-assignment-parsing implementation, the same one
+``step.memory_profile()`` and the bench ``mem`` records use.
 
 Usage: python tools/diag_fused_mem.py [model] [batch]
+Env:   SEQ=1024 FP32_STORE=1 FUSED_HEAD=0 LAYER_CHUNK=1 TOP_K=8
 """
 import os
 import sys
@@ -16,10 +23,9 @@ def main():
     model_name = sys.argv[1] if len(sys.argv) > 1 else "gpt3-1.3b"
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
     seq = int(os.environ.get("SEQ", "1024"))
+    top_k = int(os.environ.get("TOP_K", "8"))
 
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     cache = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_cache")
@@ -49,24 +55,14 @@ def main():
         compute_dtype=compute_dtype,
         layer_chunk=int(os.environ.get("LAYER_CHUNK", "1")))
     step.ensure_built()
-    state = step._extract_state()
-    lr = jnp.asarray(1e-4, jnp.float32)
-    ids = jnp.asarray(np.zeros((batch, seq), np.int32))
-    labels = jnp.asarray(np.zeros((batch, seq), np.int32))
-    lowered = step._jitted.lower(state, lr, ids, labels)
-    compiled = lowered.compile()
-    ma = compiled.memory_analysis()
-    G = 1 << 30
+
+    import numpy as np
+
+    ids = paddle.to_tensor(np.zeros((batch, seq), np.int32))
+    labels = paddle.to_tensor(np.zeros((batch, seq), np.int32))
+    prof = step.memory_profile(ids, labels, top_k=top_k, publish=False)
     print(f"model={model_name} batch={batch} seq={seq}")
-    try:
-        print(f"  argument_size   {ma.argument_size_in_bytes / G:.2f} G")
-        print(f"  output_size     {ma.output_size_in_bytes / G:.2f} G")
-        print(f"  temp_size       {ma.temp_size_in_bytes / G:.2f} G")
-        print(f"  alias_size      {ma.alias_size_in_bytes / G:.2f} G")
-        print(f"  peak (arg+out+temp-alias) "
-              f"{(ma.argument_size_in_bytes + ma.output_size_in_bytes + ma.temp_size_in_bytes - ma.alias_size_in_bytes) / G:.2f} G")
-    except AttributeError:
-        print(" ", ma)
+    print(prof.render())
 
 
 if __name__ == "__main__":
