@@ -212,6 +212,15 @@ class Model:
                     result = self.train_batch(inputs, labels, update=update,
                                               sync=sync)
                     logs = self._result_to_logs(result)
+                    if sync:
+                        # training-numerics surfacing (ISSUE 15): at the
+                        # log boundary (where the loss readback already
+                        # syncs) fold in loss scale, guard skip count and
+                        # the global grad norm from the LAZY registry
+                        # gauges — evaluating them here is the one
+                        # permitted deferred readback, so no extra
+                        # per-step host sync is added
+                        logs.update(self._telemetry_logs())
                     cbk_list.on_train_batch_end(step, logs)
                     global_step += 1
                     if num_iters is not None and global_step >= num_iters:
@@ -233,6 +242,35 @@ class Model:
                 self.input_pipeline_stats = prefetcher.get_stats()
                 prefetcher.close()
         return self
+
+    def _telemetry_logs(self):
+        """Log-boundary telemetry: the ``train.*``/``numerics.*`` lazy
+        gauges published by the compiled steps' guard and numerics
+        monitor (plus the eager GradScaler's scale when no compiled
+        guard has published). Only present keys are surfaced — a run
+        without a scaler or monitor logs exactly what it always did."""
+        out = {}
+        try:
+            from ..observability import registry
+
+            reg = registry()
+            for key, label in (("train.loss_scale", "loss_scale"),
+                               ("train.guard_skipped_steps",
+                                "guard_skips"),
+                               ("numerics.global_grad_norm",
+                                "grad_norm")):
+                g = reg.get(key)
+                v = g.value if g is not None else None
+                if v is not None:
+                    out[label] = float(v)
+        except Exception:
+            return {}
+        if "loss_scale" not in out and self._scaler is not None:
+            try:
+                out["loss_scale"] = float(self._scaler._scale)
+            except Exception:
+                pass
+        return out
 
     def _sync_logs(self, logs):
         """Force any deferred (Tensor) loss values in `logs` to host

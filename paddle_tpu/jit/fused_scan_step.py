@@ -66,6 +66,24 @@ def _key(p):
     return p.name or str(id(p))
 
 
+def _act_stats(in_fin, h_out):
+    """Per-chunk activation health (ISSUE 15): ([sum(out²), count,
+    origin], out_finite) where origin = input finite AND output
+    non-finite — the forward provenance of a NaN (the chunk whose math
+    broke, not the chunks its output then poisoned). ONE pass over the
+    chunk output: finiteness is derived from the fp32 square-sum
+    (NaN/inf propagate through it; the only false positive is a
+    legitimately finite activation beyond ~1.8e19 whose square
+    overflows fp32 — training is numerically dead long before that),
+    and the input flag is the previous chunk's output flag threaded
+    through the scan carry rather than a second pass."""
+    o32 = h_out.astype(jnp.float32)
+    sq = jnp.sum(jnp.square(o32))
+    out_fin = jnp.isfinite(sq)
+    return jnp.stack([sq, jnp.float32(o32.size),
+                      (in_fin & ~out_fin).astype(jnp.float32)]), out_fin
+
+
 def _donate_argnums():
     """State donation is a pure perf lever — forced off on the legacy
     jaxlib (0.4.x CPU corrupts donated buffers under scan-sized
@@ -107,7 +125,7 @@ class FusedScanTrainStep:
 
     def __init__(self, model, optimizer, criterion=None, fused_head=False,
                  compute_dtype=None, layer_chunk=1, scan_unroll=1,
-                 scaler=None, guard_nonfinite=None):
+                 scaler=None, guard_nonfinite=None, numerics=None):
         from ..models.gpt import GPTStackedBlocks, GPTPretrainingCriterion
         from ..optimizer import Adam
         from .nonfinite_guard import GuardSpec
@@ -248,6 +266,25 @@ class FusedScanTrainStep:
                     raise ValueError(
                         "compute_dtype expects fp32-stored params (the "
                         f"param IS the master); got {p._data.dtype}")
+        # training-numerics observatory (ISSUE 15): per-layer-chunk
+        # grad/param/update/activation stats ride the scans as one
+        # fixed-shape [chunks+1, k] block (the trailing row is the
+        # outer embed/ln_f/head group), consumed lazily by the monitor
+        # — default ON (FLAGS_numerics_monitor; DECISIONS §21)
+        from ..observability.numerics import (
+            NumericsMonitor, monitor_enabled,
+        )
+
+        self._numerics = None
+        if (bool(numerics) if numerics is not None
+                else monitor_enabled()):
+            K0 = self._layer_chunk
+            C0 = n_layers // K0
+            labels = [(f"chunk{c}(layer {c * K0})" if K0 == 1 else
+                       f"chunk{c}(layers {c * K0}-{(c + 1) * K0 - 1})")
+                      for c in range(C0)] + ["outer"]
+            self._numerics = NumericsMonitor(
+                type(self).__name__, C0 + 1, row_labels=labels)
         self._jitted = None
         # retrace sentinel (ISSUE 12): the optional segment-id arg is a
         # declared presence-varying signature (None and seg each
@@ -490,6 +527,7 @@ class FusedScanTrainStep:
         cv = self._clip_value
         guard = self._guard
         scaling = guard is not None and guard.scaling
+        nm = self._numerics is not None
         aux_active = self._aux_active
         # per-chunk aux cotangent: total loss adds
         # (moe_aux_weight / L) * sum(per-layer aux)
@@ -554,18 +592,29 @@ class FusedScanTrainStep:
 
                 C = sp_c[0].shape[0]
 
-                def fwd_body(h, scanned):
+                def fwd_body(carry, scanned):
+                    h, h_fin = carry if nm else (carry, None)
                     p_chunk, i = scanned
                     rng0 = self._rng_chunk_base(t32, i)
                     if aux_active:
                         h2, aux = chunk_apply(p_chunk, h, rng0)
-                        return h2, (h, aux)
-                    return chunk_apply(p_chunk, h, rng0), h
+                    else:
+                        h2, aux = chunk_apply(p_chunk, h, rng0), None
+                    ys = {"x": h}
+                    if aux_active:
+                        ys["aux"] = aux
+                    if not nm:
+                        return h2, ys
+                    ys["act"], out_fin = _act_stats(h_fin, h2)
+                    return (h2, out_fin), ys
 
-                xL, ys = lax.scan(
-                    fwd_body, x0, (sp_c, jnp.arange(C)),
+                fwd0 = ((x0, jnp.isfinite(x0).all()) if nm else x0)
+                fwd_c, ys = lax.scan(
+                    fwd_body, fwd0, (sp_c, jnp.arange(C)),
                     unroll=self._scan_unroll)
-                xs, auxs = ys if aux_active else (ys, None)
+                xL = fwd_c[0] if nm else fwd_c
+                xs, auxs = ys["x"], ys.get("aux")
+                act_cols = ys.get("act")           # [C, 3] when nm
 
                 # ---- head (+ its whole vjp: small params, one buffer)
                 loss, head_vjp = jax.vjp(
@@ -592,6 +641,7 @@ class FusedScanTrainStep:
                 scale = None
                 d_o_emb = None
                 found = None
+                grad_rows = None       # [C, 3] (sq, bad, origin) — nm
                 if self._clip_global is not None or guard is not None:
                     from .nonfinite_guard import all_finite
 
@@ -608,22 +658,49 @@ class FusedScanTrainStep:
                             lambda pl, xx: chunk_apply(pl, xx, rng0),
                             p_i, x_i)
                         dp, dx = vjp((dy, aux_ct) if aux_active else dy)
+                        c_fin = None
                         if guard is not None:
-                            fin = fin & all_finite(
+                            # the guard's fold stays an EXACT isfinite
+                            # (its skip decision must not inherit the
+                            # square-sum overflow caveat)
+                            c_fin = all_finite(
                                 [dp[j] for j in range(n_leaves)
                                  if self._s_params[j].trainable])
-                        if want_norm:
-                            for j in range(n_leaves):
-                                p = self._s_params[j]
-                                if not p.trainable or not getattr(
-                                        p, "need_clip", True):
-                                    continue
-                                sq = sq + jnp.sum(jnp.square(
-                                    dp[j].astype(jnp.float32)))
-                        return (dx, sq, fin), None
+                            fin = fin & c_fin
+                        # the clip carry and the monitor's per-chunk
+                        # grad sq-norm share one set of per-leaf
+                        # reductions (ISSUE 15 dedup: the monitor
+                        # reads the clip's terms when clipping is on,
+                        # computes them only when off)
+                        c_sq = jnp.float32(0.0)
+                        for j in range(n_leaves):
+                            p = self._s_params[j]
+                            if not p.trainable:
+                                continue
+                            clipped = want_norm and getattr(
+                                p, "need_clip", True)
+                            if not (clipped or nm):
+                                continue
+                            s_j = jnp.sum(jnp.square(
+                                dp[j].astype(jnp.float32)))
+                            if clipped:
+                                sq = sq + s_j
+                            if nm:
+                                c_sq = c_sq + s_j
+                        row = None
+                        if nm:
+                            # without a guard the finite flag derives
+                            # from the sq-norm (NaN/inf propagate) —
+                            # no extra pass over the grads
+                            if c_fin is None:
+                                c_fin = jnp.isfinite(c_sq)
+                            row = jnp.stack([
+                                c_sq, (~c_fin).astype(jnp.float32),
+                                jnp.float32(0.0)])
+                        return (dx, sq, fin), row
 
                     P0 = sp_c
-                    (dx0, sq, fin), _ = lax.scan(
+                    (dx0, sq, fin), grad_rows = lax.scan(
                         norm_body,
                         (dxL, jnp.float32(0.0), jnp.bool_(True)),
                         (xs, jnp.arange(C)), reverse=True,
@@ -665,6 +742,26 @@ class FusedScanTrainStep:
                     _, vjp = jax.vjp(
                         lambda pl, xx: chunk_apply(pl, xx, rng0), p_i, x_i)
                     dp, dx = vjp((dy, aux_ct) if aux_active else dy)
+                    ys_b = {}
+                    p_sq = u_sq = None
+                    if nm:
+                        p_sq = jnp.float32(0.0)
+                        u_sq = jnp.float32(0.0)
+                        if grad_rows is None:
+                            # no clip/guard pre-pass ran: the monitor's
+                            # grad stats come from THIS backward's dp
+                            # (finiteness derives from the sq-norm)
+                            c_sq = jnp.float32(0.0)
+                            for j in range(n_leaves):
+                                if not self._s_params[j].trainable:
+                                    continue
+                                c_sq = c_sq + jnp.sum(jnp.square(
+                                    dp[j].astype(jnp.float32)))
+                            ys_b["g"] = jnp.stack([
+                                c_sq,
+                                (~jnp.isfinite(c_sq))
+                                .astype(jnp.float32),
+                                jnp.float32(0.0)])
                     nP, nM, nV, nMW = [], [], [], []
                     for j in range(n_leaves):
                         if not self._s_params[j].trainable:
@@ -693,6 +790,14 @@ class FusedScanTrainStep:
                         out, mn, vn, _ = adam(
                             pv, g32, m_j, v_j,
                             lr * lrs, tf, jnp.float32(wd), l2)
+                        if nm:
+                            pv32 = pv.astype(jnp.float32)
+                            d_upd = out.astype(jnp.float32) - pv32
+                            if found is not None:
+                                d_upd = jnp.where(
+                                    found, jnp.zeros_like(d_upd), d_upd)
+                            p_sq = p_sq + jnp.sum(jnp.square(pv32))
+                            u_sq = u_sq + jnp.sum(jnp.square(d_upd))
                         out_p = out.astype(P[j].dtype)
                         mn_c = mn.astype(M[j].dtype)
                         vn_c = vn.astype(V[j].dtype)
@@ -713,11 +818,13 @@ class FusedScanTrainStep:
                         nMW.append(lax.dynamic_update_index_in_dim(
                             MW[j], out, i, 0)
                             if MW[j] is not None else None)
+                    if nm:
+                        ys_b["pu"] = jnp.stack([p_sq, u_sq])
                     return (dx, tuple(nP), tuple(nM), tuple(nV),
-                            tuple(nMW)), None
+                            tuple(nMW)), ys_b
 
                 carry0 = (dxL, sp_c, sm_c, sv_c, smw_c)
-                (dx0, nP, nM, nV, nMW), _ = lax.scan(
+                (dx0, nP, nM, nV, nMW), bwd_ys = lax.scan(
                     bwd_body, carry0, (xs, jnp.arange(C)), reverse=True,
                     unroll=self._scan_unroll)
                 # back to the [L, ...] stacked layout
@@ -737,10 +844,18 @@ class FusedScanTrainStep:
                         o["p"])
                     (d_o_emb,) = emb_vjp(dx0)
                 new_o = {"p": [], "m": [], "v": [], "mw": []}
+                if nm:
+                    o_g_sq = jnp.float32(0.0)
+                    o_p_sq = jnp.float32(0.0)
+                    o_u_sq = jnp.float32(0.0)
                 for j in range(len(o["p"])):
                     wd, l2, lrs = o_hyp[j]
                     g32 = (d_o_head[j].astype(jnp.float32)
                            + d_o_emb[j].astype(jnp.float32))
+                    if nm:
+                        # raw (still loss-scaled) grads — the inv_s²
+                        # unscale is applied once at assembly below
+                        o_g_sq = o_g_sq + jnp.sum(jnp.square(g32))
                     if inv_s is not None:
                         g32 = g32 * inv_s
                     g32 = scaled(clip_g32(g32, self._o_params[j][1]),
@@ -750,6 +865,14 @@ class FusedScanTrainStep:
                     out, mn, vn, _ = adam(pv, g32, o["m"][j], o["v"][j],
                                           lr * lrs, tf, jnp.float32(wd),
                                           l2)
+                    if nm:
+                        pv32 = pv.astype(jnp.float32)
+                        d_upd = out.astype(jnp.float32) - pv32
+                        if found is not None:
+                            d_upd = jnp.where(
+                                found, jnp.zeros_like(d_upd), d_upd)
+                        o_p_sq = o_p_sq + jnp.sum(jnp.square(pv32))
+                        o_u_sq = o_u_sq + jnp.sum(jnp.square(d_upd))
                     out_p = out.astype(o["p"][j].dtype)
                     mn_c = mn.astype(o["m"][j].dtype)
                     vn_c = vn.astype(o["v"][j].dtype)
@@ -775,7 +898,32 @@ class FusedScanTrainStep:
                 }
                 if guard is not None:
                     new_state["guard"] = guard.update(gst, found)
-                return loss, new_state
+                if not nm:
+                    return loss, new_state
+                # ---- the [C+1, NFIELDS] numerics block (ISSUE 15):
+                # grad rows come from the clip/guard pre-pass when it
+                # ran (shared reductions), else from the update
+                # backward; act rows rode the forward scan's ys
+                from ..observability import numerics as _num
+
+                g_cols = (grad_rows if grad_rows is not None
+                          else bwd_ys["g"])            # [C, 3]
+                g_sq, g_bad, g_orig = (g_cols[:, 0], g_cols[:, 1],
+                                       g_cols[:, 2])
+                og_sq = o_g_sq
+                if inv_s is not None:
+                    s2 = inv_s * inv_s       # grads carried the scale
+                    g_sq = g_sq * s2
+                    og_sq = og_sq * s2
+                stats = _num.assemble_stats(
+                    g_sq, bwd_ys["pu"][:, 0], bwd_ys["pu"][:, 1],
+                    act_cols[:, 0], act_cols[:, 1], g_bad,
+                    act_cols[:, 2], g_orig,
+                    outer=_num.outer_row(
+                        og_sq, o_p_sq, o_u_sq,
+                        (~jnp.isfinite(o_g_sq))
+                        .astype(jnp.float32)))
+                return loss, new_state, stats
             finally:
                 seg_ctx.__exit__(None, None, None)
                 self._bind(self._buffers, saved_buf)
@@ -925,8 +1073,14 @@ class FusedScanTrainStep:
                     step=type(self).__name__,
                     profile=lambda: self.memory_profile(
                         ids_d, lab_d, seg_d, publish=False)):
-            loss, new_state = self._jitted(state, lr, ids_d, lab_d,
-                                           seg_d)
+            out = self._jitted(state, lr, ids_d, lab_d, seg_d)
+        if self._numerics is not None:
+            loss, new_state, nstats = out
+            # deferred: the device block is enqueued, never read here —
+            # the readback happens at the next gauge/endpoint flush
+            self._numerics.on_step(nstats)
+        else:
+            loss, new_state = out
         self._inject_state(new_state)
         sched = getattr(self._opt, "_learning_rate", None)
         if hasattr(sched, "step"):

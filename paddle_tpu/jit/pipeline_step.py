@@ -232,6 +232,7 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
         s_assign, o_assign = self._s_assign, self._o_assign
         clip_norm = self._clip_global
         guard = self._guard
+        nm = self._numerics is not None
         rank = self._flat_rank()
         chunk_apply = self._chunk_apply
         pp_axis = self._pp_axis
@@ -249,19 +250,28 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
         o_p0 = (self._gather_outer_full(o) if sharded_storage
                 else o["p"])
 
-        def one_pass(p_v, xs, v):
+        def one_pass(p_v, xs, xs_fin, v, acc):
             """One ring pass: every micro-batch through this pass's pp
             stages. xs [M, mb, seq, h]; collected outputs land on stage
             0 (the ring wraps the last stage back there). ``v`` indexes
             the pass for the dropout offsets: this stage's chunk is
             stage + pp*v, and the micro on this stage at tick t entered
-            the ring `stage` ticks ago."""
+            the ring `stage` ticks ago. ``acc`` threads the per-chunk
+            activation-stats accumulators ([C] each, or None): a valid
+            tick's output charges the LOGICAL chunk id stage + pp*v —
+            the virtual-stage placement mapped back to layer ids
+            (ISSUE 15); warmup/cooldown garbage lanes are masked out.
+            ``xs_fin`` [M] carries each waiting micro's finiteness flag
+            (fp32 0/1): output flags derive from the square-sum and
+            ppermute alongside the activations, so health costs no
+            per-tick isfinite pass (the fused/sharded one-pass design,
+            carried around the ring)."""
             chunk_idx = stage + pp * v
             rng_base = (self._rng_chunk_base(t32, chunk_idx)
                         if self._dropout_active else None)
 
             def tick(carry, t):
-                st, outs = carry
+                st, st_fin, outs, outs_fin, a = carry
                 take = jnp.clip(t, 0, M - 1)
                 fresh = lax.dynamic_index_in_dim(xs, take, 0,
                                                  keepdims=False)
@@ -271,6 +281,34 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                     m = jnp.clip(t - stage, 0, M - 1)
                     rng0 = rng_base + m * _RNG_SLOTS
                 y = chunk_apply(p_v, inp, rng0)
+                passed_fin = None
+                if a is not None:
+                    # stats never feed the loss: stop_gradient keeps
+                    # the ring's vjp structure untouched. Output
+                    # finiteness derives from the fp32 square-sum
+                    # (one pass; see fused_scan_step._act_stats); the
+                    # INPUT flag rode the ring with the activation
+                    y_s = lax.stop_gradient(y)
+                    valid = (t >= stage) & (t - stage <= M - 1)
+                    vf = valid.astype(jnp.float32)
+                    oh = (jnp.arange(C) == chunk_idx).astype(
+                        jnp.float32) * vf
+                    y_sq = jnp.sum(jnp.square(
+                        y_s.astype(jnp.float32)))
+                    y_fin = jnp.isfinite(y_sq)
+                    in_fin = jnp.where(
+                        stage == 0,
+                        lax.dynamic_index_in_dim(xs_fin, take, 0,
+                                                 keepdims=False),
+                        st_fin)
+                    origin = (in_fin > 0.5) & ~y_fin
+                    # selection, not oh*y_sq: 0 × NaN would smear a
+                    # broken chunk's NaN over every other row
+                    a = (a[0] + jnp.where(oh > 0, oh * y_sq, 0.0),
+                         a[1] + oh * jnp.float32(y_s.size),
+                         a[2] + oh * origin.astype(jnp.float32))
+                    passed_fin = lax.ppermute(
+                        y_fin.astype(jnp.float32), pp_axis, perm)
                 passed = lax.ppermute(y, pp_axis, perm)
                 done = t - (pp - 1)
                 slot = jnp.clip(done, 0, M - 1)
@@ -279,12 +317,21 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                     lambda o_: lax.dynamic_update_index_in_dim(
                         o_, passed, slot, 0),
                     lambda o_: o_, outs)
-                return (passed, outs), None
+                if a is not None:
+                    outs_fin = lax.cond(
+                        done >= 0,
+                        lambda o_: lax.dynamic_update_index_in_dim(
+                            o_, passed_fin, slot, 0),
+                        lambda o_: o_, outs_fin)
+                return (passed, passed_fin, outs, outs_fin, a), None
 
-            (_, outs), _ = lax.scan(
-                tick, (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+            fin0 = (jnp.float32(1.0) if nm else None)
+            outs_fin0 = (jnp.ones((M,), jnp.float32) if nm else None)
+            (_, _, outs, outs_fin, acc), _ = lax.scan(
+                tick, (jnp.zeros_like(xs[0]), fin0, jnp.zeros_like(xs),
+                       outs_fin0, acc),
                 jnp.arange(pp + M - 1))
-            return outs
+            return outs, outs_fin, acc
 
         def fwd_loss(own_p, o_p):
             # embedding is pointwise over tokens: embed the full local
@@ -296,9 +343,16 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                 rng_off=(self._rng_base(t32, n_layers)
                          if self._dropout_active else None))
             xs = x0.reshape((M, mb) + tuple(x0.shape[1:]))
+            acc = ((jnp.zeros((C,), jnp.float32),) * 3 if nm else None)
+            # per-micro finiteness of the embedded batch: the ONE
+            # explicit isfinite pass (chunk outputs derive theirs from
+            # the square-sums around the ring)
+            xs_fin = (jnp.isfinite(lax.stop_gradient(x0))
+                      .reshape(M, -1).all(axis=1).astype(jnp.float32)
+                      if nm else None)
             for v in range(V):
                 p_v = tuple(a[v] for a in own_p)
-                xs = one_pass(p_v, xs, v)
+                xs, xs_fin, acc = one_pass(p_v, xs, xs_fin, v, acc)
                 # between passes only stage 0's collected buffer is
                 # meaningful — and only stage 0 reads it (fresh inject)
             # replicate the finished hiddens to every pp rank for the
@@ -307,9 +361,10 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
             y = lax.psum(jnp.where(stage == 0, xs, jnp.zeros_like(xs)),
                          pp_axis)
             yb = y.reshape((b,) + tuple(y.shape[2:]))
-            return self._head_fn(o_p, yb, labels)
+            return self._head_fn(o_p, yb, labels), acc
 
-        loss, vjpf = jax.vjp(fwd_loss, own0, o_p0)
+        loss, vjpf, act_acc = jax.vjp(fwd_loss, own0, o_p0,
+                                      has_aux=True)
         d_own, d_o = vjpf(ct.astype(loss.dtype))
 
         # ---- per-chunk scatter over (dp..., pp): the pp leg of the sum
@@ -318,6 +373,8 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
         # and only the 1/N flat shards survive this loop
         sq = jnp.float32(0.0)
         fin = jnp.bool_(True)
+        c_sq = [jnp.float32(0.0)] * C
+        c_fin = [jnp.bool_(True)] * C
         G = []
         for bkt in s_assign.buckets:
             rows = []
@@ -328,12 +385,22 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
                 contrib = jnp.where(stage == owner, flat,
                                     jnp.zeros_like(flat))
                 gs = scatter_flat(contrib, axes, N, quant)   # [K, F/N]
-                if clip_norm is not None:
+                # clip carry + per-chunk monitor row share one shard
+                # reduction (ISSUE 15 dedup, as in the base step)
+                if clip_norm is not None or nm:
                     nc = self._shard_of(self._s_hp[bkt.index][3], rank,
                                         bkt.numel // N)
-                    sq = sq + self._sq_of(gs, nc)
+                    ct_b, mt_b = self._clip_monitor_sq(
+                        gs, nc, clip_norm is not None, nm)
+                    if ct_b is not None:
+                        sq = sq + ct_b
+                    if nm:
+                        c_sq[c] = c_sq[c] + mt_b
                 if guard is not None:
-                    fin = fin & all_finite([gs])
+                    # exact isfinite for the guard's skip decision
+                    b_fin = all_finite([gs])
+                    c_fin[c] = c_fin[c] & b_fin
+                    fin = fin & b_fin
                 rows.append(gs)
             G.append(jnp.stack(rows))                        # [C, K, F/N]
         G = tuple(G)
@@ -342,15 +409,44 @@ class PipelineScanTrainStep(ShardedFusedScanTrainStep):
         # cotangents live on every rank — the ×pp factor is uniform,
         # see the module docstring)
         o_gs = []
+        o_sq = jnp.float32(0.0)
+        o_fin = jnp.bool_(True)
         for bkt in o_assign.buckets:
             flat = pack_flat(
                 lambda j: d_o[j].astype(jnp.float32), bkt)
             gs = scatter_flat(flat, axes, N, quant)          # [F/N]
-            if clip_norm is not None:
+            if clip_norm is not None or nm:
                 nc = self._shard_of(self._o_hp[bkt.index][3], rank,
                                     bkt.numel // N)
-                sq = sq + self._sq_of(gs, nc)
+                ct_b, mt_b = self._clip_monitor_sq(
+                    gs, nc, clip_norm is not None, nm)
+                if ct_b is not None:
+                    sq = sq + ct_b
+                if nm:
+                    o_sq = o_sq + mt_b
             if guard is not None:
-                fin = fin & all_finite([gs])
+                b_fin = all_finite([gs])
+                o_fin = o_fin & b_fin
+                fin = fin & b_fin
             o_gs.append(gs)
-        return loss, G, o_gs, sq, fin
+        nrows = None
+        if nm:
+            if guard is None:
+                # finiteness derives from the sq-norms (no extra pass)
+                c_fin = [jnp.isfinite(c_sq[c]) for c in range(C)]
+                o_fin = jnp.isfinite(o_sq)
+            # the backward-origin column stays zero here (the whole-
+            # ring vjp has no per-chunk incoming cotangent to compare
+            # against) — provenance relies on the activation origin
+            # (forward) and the per-chunk grad finite flags
+            nrows = {
+                "grad": jnp.stack(
+                    [jnp.stack([c_sq[c],
+                                (~c_fin[c]).astype(jnp.float32),
+                                jnp.float32(0.0)])
+                     for c in range(C)]),
+                "act": jnp.stack(act_acc, axis=1),      # [C, 3]
+                "outer": jnp.stack([
+                    o_sq, (~o_fin).astype(jnp.float32)]),
+            }
+        return loss, G, o_gs, sq, fin, nrows

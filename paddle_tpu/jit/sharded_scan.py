@@ -285,13 +285,15 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                  compute_dtype=None, layer_chunk=1, scan_unroll=1,
                  mesh=None, axis=None, mp_axis=None, ep_axis=None,
                  group=None, comm_bucket_mb=None, comm_quant=None,
-                 scaler=None, guard_nonfinite=None, param_storage=None):
+                 scaler=None, guard_nonfinite=None, param_storage=None,
+                 numerics=None):
         model = _unwrap_layers(model)
         super().__init__(model, optimizer, criterion=criterion,
                          fused_head=fused_head,
                          compute_dtype=compute_dtype,
                          layer_chunk=layer_chunk, scan_unroll=scan_unroll,
-                         scaler=scaler, guard_nonfinite=guard_nonfinite)
+                         scaler=scaler, guard_nonfinite=guard_nonfinite,
+                         numerics=numerics)
         from ..distributed import env as denv
 
         if group is not None:
@@ -1213,6 +1215,23 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             g32 = g32 * nc_shard
         return jnp.sum(jnp.square(g32))
 
+    def _clip_monitor_sq(self, gs, nc, clip_on, mon_on):
+        """ONE shard reduction feeding BOTH the clip's norm carry and
+        the monitor's grad sq-norm row (ISSUE 15 dedup — the single
+        implementation behind every grads path, replicated / sharded-
+        storage / pipeline). Returns ``(clip_term, monitor_term)``:
+        ``clip_term`` is None with clipping off; ``monitor_term`` is
+        None with the monitor off, reads the clip's sum when both are
+        on, and only a need_clip mask (``nc``) forces a second,
+        differently-masked sum — the monitor's row must be the
+        UNMASKED norm."""
+        s_b = self._sq_of(gs, nc if clip_on else None)
+        mon = None
+        if mon_on:
+            mon = (s_b if nc is None or not clip_on
+                   else self._sq_of(gs, None))
+        return (s_b if clip_on else None), mon
+
     # -- gather-on-use plumbing (sharded parameter storage) --------------
     def _stacked_nontrainable(self, s_state):
         """[(leaf index j, data)] for the frozen stacked leaves riding
@@ -1273,6 +1292,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         if self._param_storage == "sharded":
             return self._grads_sharded_storage(state, ids, labels, t32,
                                                ct)
+        from .fused_scan_step import _act_stats
         from .nonfinite_guard import all_finite
 
         s, o = state["s"], state["o"]
@@ -1284,6 +1304,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         s_assign, o_assign = self._s_assign, self._o_assign
         clip_norm = self._clip_global
         guard = self._guard
+        nm = self._numerics is not None
         rank = self._flat_rank()
         chunk_apply = self._chunk_apply
         b, seq = ids.shape          # LOCAL batch rows
@@ -1298,17 +1319,28 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         sp_c = tuple(a.reshape((C, K) + tuple(a.shape[1:]))
                      for a in s["p"])
 
-        def fwd_body(h, scanned):
+        def fwd_body(carry, scanned):
+            h, h_fin = carry if nm else (carry, None)
             p_chunk, i = scanned
             rng0 = self._rng_chunk_base(t32, i)
             if aux_active:
                 h2, aux = chunk_apply(p_chunk, h, rng0)
-                return h2, (h, aux)
-            return chunk_apply(p_chunk, h, rng0), h
+            else:
+                h2, aux = chunk_apply(p_chunk, h, rng0), None
+            ys = {"x": h}
+            if aux_active:
+                ys["aux"] = aux
+            if not nm:
+                return h2, ys
+            ys["act"], out_fin = _act_stats(h_fin, h2)  # local rows:
+            return (h2, out_fin), ys          # rank partials sum at host
 
-        xL, ys = lax.scan(fwd_body, x0, (sp_c, jnp.arange(C)),
-                          unroll=self._scan_unroll)
-        xs, auxs = ys if aux_active else (ys, None)
+        fwd0 = ((x0, jnp.isfinite(x0).all()) if nm else x0)
+        fwd_c, ys = lax.scan(fwd_body, fwd0, (sp_c, jnp.arange(C)),
+                             unroll=self._scan_unroll)
+        xL = fwd_c[0] if nm else fwd_c
+        xs, auxs = ys["x"], ys.get("aux")
+        act_cols = ys.get("act")
 
         loss, head_vjp = jax.vjp(
             lambda od, x: self._head_fn(od, x, labels),
@@ -1344,20 +1376,41 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 p_i, x_i)
             dp, dx = vjp((dy, aux_ct) if aux_active else dy)
             newG = []
+            c_sq = jnp.float32(0.0)
+            c_fin = jnp.bool_(True)
             for bkt in s_assign.buckets:
                 flat = pack_flat(lambda j: dp[j], bkt, lead=(K,))
                 gs = scatter_flat(flat, axes, N, quant)  # [K,F/N]
-                if clip_norm is not None:
+                # one set of shard reductions feeds BOTH the clip's
+                # norm carry and the monitor's per-chunk row (ISSUE 15
+                # dedup); only a need_clip mask forces a second,
+                # differently-masked sum
+                if clip_norm is not None or nm:
                     nc = self._shard_of(self._s_hp[bkt.index][3], rank,
                                         bkt.numel // N)
-                    sq = sq + self._sq_of(gs, nc)
+                    ct_b, mt_b = self._clip_monitor_sq(
+                        gs, nc, clip_norm is not None, nm)
+                    if ct_b is not None:
+                        sq = sq + ct_b
+                    if nm:
+                        c_sq = c_sq + mt_b
                 if guard is not None:
-                    fin = fin & all_finite([gs])
+                    # exact isfinite for the guard's skip decision
+                    b_fin = all_finite([gs])
+                    c_fin = c_fin & b_fin
+                    fin = fin & b_fin
                 newG.append(lax.dynamic_update_index_in_dim(
                     G[bkt.index], gs, i, 0))
-            return (dx, sq, fin, tuple(newG)), None
+            row = None
+            if nm:
+                if guard is None:
+                    c_fin = jnp.isfinite(c_sq)   # no extra grad pass
+                row = jnp.stack([
+                    c_sq, (~c_fin).astype(jnp.float32),
+                    jnp.float32(0.0)])
+            return (dx, sq, fin, tuple(newG)), row
 
-        (dx0, sq, fin, G), _ = lax.scan(
+        (dx0, sq, fin, G), grad_cols = lax.scan(
             bwd_body,
             (dxL, jnp.float32(0.0), jnp.bool_(True), G0),
             (xs, jnp.arange(C)), reverse=True,
@@ -1370,20 +1423,36 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 rng_off=self._rng_base(t32, n_layers)), o["p"])
         (d_o_emb,) = emb_vjp(dx0)
         o_gs = []
+        o_sq = jnp.float32(0.0)
+        o_fin = jnp.bool_(True)
         for bkt in o_assign.buckets:
             flat = pack_flat(
                 lambda j: (d_o_head[j].astype(jnp.float32)
                            + d_o_emb[j].astype(jnp.float32)),
                 bkt)
             gs = scatter_flat(flat, axes, N, quant)      # [F/N]
-            if clip_norm is not None:
+            if clip_norm is not None or nm:
                 nc = self._shard_of(self._o_hp[bkt.index][3], rank,
                                     bkt.numel // N)
-                sq = sq + self._sq_of(gs, nc)
+                ct_b, mt_b = self._clip_monitor_sq(
+                    gs, nc, clip_norm is not None, nm)
+                if ct_b is not None:
+                    sq = sq + ct_b
+                if nm:
+                    o_sq = o_sq + mt_b
             if guard is not None:
-                fin = fin & all_finite([gs])
+                b_fin = all_finite([gs])
+                o_fin = o_fin & b_fin
+                fin = fin & b_fin
             o_gs.append(gs)
-        return loss, G, o_gs, sq, fin
+        nrows = None
+        if nm:
+            if guard is None:
+                o_fin = jnp.isfinite(o_sq)       # no extra grad pass
+            nrows = {"grad": grad_cols, "act": act_cols,
+                     "outer": jnp.stack([
+                         o_sq, (~o_fin).astype(jnp.float32)])}
+        return loss, G, o_gs, sq, fin, nrows
 
     def _grads_sharded_storage(self, state, ids, labels, t32, ct):
         """The gather-on-use form of `_grads` (ISSUE 11): params enter
@@ -1401,6 +1470,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         replicated-storage step: the shards hold exactly the bytes the
         replicated stacks would (pack/gather is concat/slice), unless
         FLAGS_comm_quant compresses the gather leg (opt-in, lossy)."""
+        from .fused_scan_step import _act_stats
         from .nonfinite_guard import all_finite
 
         s, o = state["s"], state["o"]
@@ -1412,6 +1482,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         s_assign, o_assign = self._s_assign, self._o_assign
         clip_norm = self._clip_global
         guard = self._guard
+        nm = self._numerics is not None
         rank = self._flat_rank()
         chunk_apply = self._chunk_apply
         b, seq = ids.shape          # LOCAL batch rows
@@ -1437,7 +1508,10 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                             rng_off=self._rng_base(t32, n_layers))
 
         def fwd_body(carry, scanned):
-            h, cur = carry
+            if nm:
+                h, cur, h_fin = carry
+            else:
+                (h, cur), h_fin = carry, None
             nt_i, i = scanned
             # prefetch: chunk i+1's gather is data-independent of chunk
             # i's compute below (the wrap at i=C-1 re-gathers chunk 0 —
@@ -1446,14 +1520,25 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             rng0 = self._rng_chunk_base(t32, i)
             if aux_active:
                 h2, aux = chunk_apply(leaves_of(cur, nt_i), h, rng0)
-                return (h2, nxt), (h, aux)
-            return (chunk_apply(leaves_of(cur, nt_i), h, rng0),
-                    nxt), h
+            else:
+                h2 = chunk_apply(leaves_of(cur, nt_i), h, rng0)
+                aux = None
+            ys = {"x": h}
+            if aux_active:
+                ys["aux"] = aux
+            if not nm:
+                return (h2, nxt), ys
+            ys["act"], out_fin = _act_stats(h_fin, h2)
+            return (h2, nxt, out_fin), ys
 
-        (xL, _), ys = lax.scan(
-            fwd_body, (x0, gather_chunk(jnp.int32(0))),
+        g0 = gather_chunk(jnp.int32(0))
+        fwd0 = ((x0, g0, jnp.isfinite(x0).all()) if nm else (x0, g0))
+        fwd_c, ys = lax.scan(
+            fwd_body, fwd0,
             (nt_c, jnp.arange(C)), unroll=self._scan_unroll)
-        xs, auxs = ys if aux_active else (ys, None)
+        xL = fwd_c[0]
+        xs, auxs = ys["x"], ys.get("aux")
+        act_cols = ys.get("act")
 
         loss, head_vjp = jax.vjp(
             lambda od, x: self._head_fn(od, x, labels), o_full, xL)
@@ -1479,20 +1564,39 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 lambda pl, xx: chunk_apply(pl, xx, rng0), p_i, x_i)
             dp, dx = vjp((dy, aux_ct) if aux_active else dy)
             newG = []
+            c_sq = jnp.float32(0.0)
+            c_fin = jnp.bool_(True)
             for bkt in s_assign.buckets:
                 flat = pack_flat(lambda j: dp[j], bkt, lead=(K,))
                 gs = scatter_flat(flat, axes, N, quant)  # [K, F/N]
-                if clip_norm is not None:
+                # clip carry + monitor row share one shard reduction
+                # (ISSUE 15 dedup; see the replicated _grads)
+                if clip_norm is not None or nm:
                     nc = self._shard_of(self._s_hp[bkt.index][3], rank,
                                         bkt.numel // N)
-                    sq = sq + self._sq_of(gs, nc)
+                    ct_b, mt_b = self._clip_monitor_sq(
+                        gs, nc, clip_norm is not None, nm)
+                    if ct_b is not None:
+                        sq = sq + ct_b
+                    if nm:
+                        c_sq = c_sq + mt_b
                 if guard is not None:
-                    fin = fin & all_finite([gs])
+                    # exact isfinite for the guard's skip decision
+                    b_fin = all_finite([gs])
+                    c_fin = c_fin & b_fin
+                    fin = fin & b_fin
                 newG.append(lax.dynamic_update_index_in_dim(
                     G[bkt.index], gs, i, 0))
-            return (dx, sq, fin, tuple(newG), prv), None
+            row = None
+            if nm:
+                if guard is None:
+                    c_fin = jnp.isfinite(c_sq)   # no extra grad pass
+                row = jnp.stack([
+                    c_sq, (~c_fin).astype(jnp.float32),
+                    jnp.float32(0.0)])
+            return (dx, sq, fin, tuple(newG), prv), row
 
-        (dx0, sq, fin, G, _), _ = lax.scan(
+        (dx0, sq, fin, G, _), grad_cols = lax.scan(
             bwd_body,
             (dxL, jnp.float32(0.0), jnp.bool_(True), G0,
              gather_chunk(jnp.int32(C - 1))),
@@ -1506,20 +1610,36 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 rng_off=self._rng_base(t32, n_layers)), o_full)
         (d_o_emb,) = emb_vjp(dx0)
         o_gs = []
+        o_sq = jnp.float32(0.0)
+        o_fin = jnp.bool_(True)
         for bkt in o_assign.buckets:
             flat = pack_flat(
                 lambda j: (d_o_head[j].astype(jnp.float32)
                            + d_o_emb[j].astype(jnp.float32)),
                 bkt)
             gs = scatter_flat(flat, axes, N, quant)      # [F/N]
-            if clip_norm is not None:
+            if clip_norm is not None or nm:
                 nc = self._shard_of(self._o_hp[bkt.index][3], rank,
                                     bkt.numel // N)
-                sq = sq + self._sq_of(gs, nc)
+                ct_b, mt_b = self._clip_monitor_sq(
+                    gs, nc, clip_norm is not None, nm)
+                if ct_b is not None:
+                    sq = sq + ct_b
+                if nm:
+                    o_sq = o_sq + mt_b
             if guard is not None:
-                fin = fin & all_finite([gs])
+                b_fin = all_finite([gs])
+                o_fin = o_fin & b_fin
+                fin = fin & b_fin
             o_gs.append(gs)
-        return loss, G, o_gs, sq, fin
+        nrows = None
+        if nm:
+            if guard is None:
+                o_fin = jnp.isfinite(o_sq)       # no extra grad pass
+            nrows = {"grad": grad_cols, "act": act_cols,
+                     "outer": jnp.stack([
+                         o_sq, (~o_fin).astype(jnp.float32)])}
+        return loss, G, o_gs, sq, fin, nrows
 
     def _build(self):
         opt = self._opt
@@ -1537,6 +1657,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         clip_norm = self._clip_global
         guard = self._guard
         scaling = guard is not None and guard.scaling
+        nm = self._numerics is not None
         shard_of = self._shard_of
 
         def g_shard_f32(gs, nc_shard, scale, inv_s=None):
@@ -1564,6 +1685,27 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
         from ..nn.functional.flash_attention import attention_segments
 
+        def _assemble_stats(nrows, pu_cols, o_p_sq, o_u_sq, inv_s):
+            """The [1, C+1, NFIELDS] per-rank numerics partial
+            (ISSUE 15): the leading length-1 axis carries the
+            reduction-axis out_spec, so the mesh STACKS rank partials
+            (no collective) and the host fold sums them."""
+            from ..observability import numerics as _num
+
+            g_cols, act, og = nrows["grad"], nrows["act"], nrows["outer"]
+            g_sq, og_sq = g_cols[:, 0], og[0]
+            if inv_s is not None:
+                s2 = inv_s * inv_s    # shard grads carried the scale
+                g_sq = g_sq * s2
+                og_sq = og_sq * s2
+            # sums are per-rank partials: every sq/count/flag field
+            # folds by addition at readback time
+            return _num.assemble_stats(
+                g_sq, pu_cols[:, 0], pu_cols[:, 1], act[:, 0],
+                act[:, 1], g_cols[:, 1], act[:, 2], g_cols[:, 2],
+                outer=_num.outer_row(og_sq, o_p_sq, o_u_sq,
+                                     og[1]))[None]
+
         def step_fn(state, lr, ids, labels, seg=None):
             s, o = state["s"], state["o"]
             saved_buf = self._bind(self._buffers, state["buf"])
@@ -1581,7 +1723,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 ct = (gst["scale"] if scaling
                       else jnp.ones((), jnp.float32))
 
-                loss, G, o_gs, sq, fin = self._grads(
+                loss, G, o_gs, sq, fin, nrows = self._grads(
                     state, ids, labels, t32, ct)
                 sharded_storage = self._param_storage == "sharded"
                 if not sharded_storage:
@@ -1625,6 +1767,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
 
                     def upd_body_sharded(carry, i):
                         FP, M, V, MW = carry
+                        p_sq = u_sq = jnp.float32(0.0)
                         for bkt in s_assign.buckets:
                             bi = bkt.index
                             shard_len = bkt.numel // N
@@ -1656,6 +1799,11 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                                 out32 = jnp.where(found, pv, out32)
                                 mn = jnp.where(found, m_i, mn)
                                 vn = jnp.where(found, v_i, vn)
+                            if nm:
+                                pv32 = pv.astype(jnp.float32)
+                                p_sq = p_sq + jnp.sum(jnp.square(pv32))
+                                u_sq = u_sq + jnp.sum(jnp.square(
+                                    out32.astype(jnp.float32) - pv32))
                             M[bi] = lax.dynamic_update_index_in_dim(
                                 M[bi], mn.astype(M[bi].dtype), i, 0)
                             V[bi] = lax.dynamic_update_index_in_dim(
@@ -1665,9 +1813,10 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                                     MW[bi], out32, i, 0)
                             FP[bi] = lax.dynamic_update_index_in_dim(
                                 FP[bi], out32.astype(bkt.dtype), i, 0)
-                        return (FP, M, V, MW), None
+                        return (FP, M, V, MW), (
+                            jnp.stack([p_sq, u_sq]) if nm else {})
 
-                    (FP, sM, sV, sMW), _ = lax.scan(
+                    (FP, sM, sV, sMW), pu_cols = lax.scan(
                         upd_body_sharded,
                         (list(FP0), list(sM), list(sV), list(sMW)),
                         jnp.arange(C), unroll=self._scan_unroll)
@@ -1678,6 +1827,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                     new_op = list(o["p"])
                     new_o_fp = []
                     new_om, new_ov, new_omw = [], [], []
+                    o_p_sq = o_u_sq = jnp.float32(0.0)
                     for bkt in o_assign.buckets:
                         bi = bkt.index
                         shard_len = bkt.numel // N
@@ -1693,6 +1843,11 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                             out32 = jnp.where(found, pv, out32)
                             mn = jnp.where(found, m_i, mn)
                             vn = jnp.where(found, v_i, vn)
+                        if nm:
+                            pv32 = pv.astype(jnp.float32)
+                            o_p_sq = o_p_sq + jnp.sum(jnp.square(pv32))
+                            o_u_sq = o_u_sq + jnp.sum(jnp.square(
+                                out32.astype(jnp.float32) - pv32))
                         new_om.append(mn.astype(m_i.dtype))
                         new_ov.append(vn.astype(v_i.dtype))
                         new_omw.append(out32 if o["mw"][bi] is not None
@@ -1717,12 +1872,17 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                     }
                     if guard is not None:
                         new_state["guard"] = guard.update(gst, found)
-                    return lax.psum(loss, axes) * inv_n, new_state
+                    loss_out = lax.psum(loss, axes) * inv_n
+                    if not nm:
+                        return loss_out, new_state
+                    return loss_out, new_state, _assemble_stats(
+                        nrows, pu_cols, o_p_sq, o_u_sq, inv_s)
 
                 P_tr0 = tuple(sp_c[j] for j, _ in self._s_train)
 
                 def upd_body(carry, i):
                     P_tr, M, V, MW = carry
+                    p_sq = u_sq = jnp.float32(0.0)
                     for bkt in s_assign.buckets:
                         bi = bkt.index
                         shard_len = bkt.numel // N
@@ -1761,6 +1921,11 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                             out32 = jnp.where(found, pv, out32)
                             mn = jnp.where(found, m_i, mn)
                             vn = jnp.where(found, v_i, vn)
+                        if nm:
+                            pv32 = pv.astype(jnp.float32)
+                            p_sq = p_sq + jnp.sum(jnp.square(pv32))
+                            u_sq = u_sq + jnp.sum(jnp.square(
+                                out32.astype(jnp.float32) - pv32))
                         M[bi] = lax.dynamic_update_index_in_dim(
                             M[bi], mn.astype(M[bi].dtype), i, 0)
                         V[bi] = lax.dynamic_update_index_in_dim(
@@ -1778,9 +1943,10 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                                     P_tr[tj],
                                     leaf.astype(P_tr[tj].dtype), i, 0),
                             ) + P_tr[tj + 1:]
-                    return (P_tr, M, V, MW), None
+                    return (P_tr, M, V, MW), (
+                        jnp.stack([p_sq, u_sq]) if nm else {})
 
-                (P_tr, sM, sV, sMW), _ = lax.scan(
+                (P_tr, sM, sV, sMW), pu_cols = lax.scan(
                     upd_body, (P_tr0, list(sM), list(sV), list(sMW)),
                     jnp.arange(C), unroll=self._scan_unroll)
 
@@ -1792,6 +1958,7 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 # ---- outer update (no scan)
                 new_op = list(o["p"])
                 new_om, new_ov, new_omw = [], [], []
+                o_p_sq = o_u_sq = jnp.float32(0.0)
                 for bkt in o_assign.buckets:
                     bi = bkt.index
                     shard_len = bkt.numel // N
@@ -1811,6 +1978,11 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                         out32 = jnp.where(found, pv, out32)
                         mn = jnp.where(found, m_i, mn)
                         vn = jnp.where(found, v_i, vn)
+                    if nm:
+                        pv32 = pv.astype(jnp.float32)
+                        o_p_sq = o_p_sq + jnp.sum(jnp.square(pv32))
+                        o_u_sq = o_u_sq + jnp.sum(jnp.square(
+                            out32.astype(jnp.float32) - pv32))
                     new_om.append(mn.astype(m_i.dtype))
                     new_ov.append(vn.astype(v_i.dtype))
                     new_omw.append(out32 if o["mw"][bi] is not None
@@ -1839,7 +2011,11 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 # loss identical across mp/pp ranks -> the axis-tuple
                 # psum over-counts by exactly the replication factor the
                 # inv_n (= 1/(dp*mp)) divides back out: a dp-mean
-                return lax.psum(loss, axes) * inv_n, new_state
+                loss_out = lax.psum(loss, axes) * inv_n
+                if not nm:
+                    return loss_out, new_state
+                return loss_out, new_state, _assemble_stats(
+                    nrows, pu_cols, o_p_sq, o_u_sq, inv_s)
             finally:
                 seg_ctx.__exit__(None, None, None)
                 self._bind(self._buffers, saved_buf)
@@ -1847,12 +2023,18 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
         specs = self._state_specs()
         batch_spec = P(self._batch_axes if len(self._batch_axes) > 1
                        else self._axis, None)
+        # numerics partials stack over the FLATTENED reduction axes
+        # (ISSUE 15: stats never psum — the host fold sums rank
+        # partials, so the monitor adds zero collectives)
+        stats_ax = self._axes if len(self._axes) > 1 else self._axis
+        out_specs = ((P(), specs) if not nm
+                     else (P(), specs, P(stats_ax)))
         # the trailing batch_spec covers the optional segment-id arg —
         # a None there is an empty pytree, so the spec binds no leaves
         wrapped = jax.shard_map(
             step_fn, mesh=mesh,
             in_specs=(specs, P(), batch_spec, batch_spec, batch_spec),
-            out_specs=(P(), specs), check_vma=False)
+            out_specs=out_specs, check_vma=False)
         self._jitted = jax.jit(wrapped,
                                donate_argnums=_donate_argnums())
 
@@ -1881,8 +2063,8 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             try:
                 t32 = state["step"].astype(jnp.int32) + 1
                 ct = jnp.ones((), jnp.float32)
-                loss, G, o_gs, _, _ = self._grads(state, ids, labels,
-                                                  t32, ct)
+                loss, G, o_gs, _, _, _ = self._grads(state, ids,
+                                                     labels, t32, ct)
                 Gf = tuple(
                     gather_flat(g.astype(jnp.float32) * inv, axes,
                                 axis=g.ndim - 1) for g in G)
@@ -2009,7 +2191,8 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
                 layers, optimizer, criterion=criterion,
                 **{k: v for k, v in step_kw.items()
                    if k in ("fused_head", "compute_dtype",
-                            "layer_chunk", "scan_unroll")})
+                            "layer_chunk", "scan_unroll",
+                            "numerics")})
         step.layout_decision = decision
         return step
 
@@ -2076,7 +2259,8 @@ def select_train_step(model, optimizer, criterion=None, mesh=None,
                                      if k in ("fused_head",
                                               "compute_dtype",
                                               "layer_chunk",
-                                              "scan_unroll")})
+                                              "scan_unroll",
+                                              "numerics")})
     from .train_step import TrainStep
 
     if criterion is not None:

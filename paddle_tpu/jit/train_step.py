@@ -104,7 +104,7 @@ class TrainStep:
 
     def __init__(self, model, loss_fn, optimizer, donate=True,
                  accumulate_steps=1, accum_steps=None, scaler=None,
-                 guard_nonfinite=None):
+                 guard_nonfinite=None, numerics=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer             # outer (may be a wrapper)
@@ -124,6 +124,13 @@ class TrainStep:
         self._buffers = None
         self._jitted = None
         self._step_count = 0
+        # training-numerics observatory (ISSUE 15): the generic tape
+        # path has no layer chunks, so each trainable PARAMETER is its
+        # own stats row (grad/param sq-norm, update ratio, finite flag;
+        # no scanned activations). Monitor built lazily in _build once
+        # the param set is resolved.
+        self._numerics_opt = numerics
+        self._numerics = None
         # retrace sentinel (ISSUE 12): every dispatch records its
         # abstract signature; an unexpected executable-cache miss is
         # attributed to the argument leaf that changed
@@ -220,6 +227,17 @@ class TrainStep:
         self._resolve_slots()
         opt = self.optimizer        # outer wrapper drives the step
         inner = self._opt           # state owner gets the lr patch
+        from ..observability.numerics import (
+            NumericsMonitor, monitor_enabled,
+        )
+
+        if (bool(self._numerics_opt) if self._numerics_opt is not None
+                else monitor_enabled()) and self._params:
+            self._numerics = NumericsMonitor(
+                type(self).__name__, len(self._params),
+                row_labels=[p.name or f"param{i}"
+                            for i, p in enumerate(self._params)])
+        nm = self._numerics is not None
 
         # pin state OUTPUT layouts to the input layouts: without this,
         # GSPMD may choose a different sharding for an updated param than
@@ -349,6 +367,12 @@ class TrainStep:
                         g = p.grad._data
                         p.grad._data = (g.astype(jnp.float32)
                                         * inv).astype(g.dtype)
+            # numerics rows read the (unscaled) tape grads — captured
+            # before opt.step()/clear_grad consumes them
+            nm_grads = None
+            if nm:
+                nm_grads = [p.grad._data if p.grad is not None else None
+                            for p in self._params]
             # freeze lr at the traced scalar for this step (declared
             # protocol: Optimizer.get_lr honors _lr_override)
             with inner.lr_frozen(lr):
@@ -370,7 +394,33 @@ class TrainStep:
                 old = {k: v for k, v in state.items() if k != "guard"}
                 new_state = gate(found, core, old)
                 new_state["guard"] = guard.update(gst, found)
-            return loss._data, new_state
+            if not nm:
+                return loss._data, new_state
+            # ---- per-parameter numerics rows (ISSUE 15): grads were
+            # unscaled above, updates read the GATED new params (zero
+            # on a guard-skipped step); no scanned activations here
+            rows = []
+            f32 = jnp.float32
+            for i in range(len(self._params)):
+                g = nm_grads[i]
+                old_p = state["params"][i].astype(f32)
+                new_p = new_state["params"][i].astype(f32)
+                if g is not None and jnp.issubdtype(g.dtype,
+                                                    jnp.floating):
+                    g32 = g.astype(f32)
+                    g_sq = jnp.sum(jnp.square(g32))
+                    # finiteness DERIVES from the square-sum like the
+                    # scan paths (DECISIONS §21) — no second O(params)
+                    # pass; the guard keeps its own exact fold
+                    g_bad = (~jnp.isfinite(g_sq)).astype(f32)
+                else:
+                    g_sq = f32(0.0)
+                    g_bad = f32(0.0)
+                rows.append(jnp.stack([
+                    g_sq, jnp.sum(jnp.square(old_p)),
+                    jnp.sum(jnp.square(new_p - old_p)),
+                    f32(0.0), f32(0.0), g_bad, f32(0.0), f32(0.0)]))
+            return loss._data, new_state, jnp.stack(rows)
 
         donate = (0,) if self._donate else ()
         self._jitted = jax.jit(step_fn, donate_argnums=donate)
@@ -409,7 +459,12 @@ class TrainStep:
 
             with RecordEvent("TrainStep"), \
                     comm_watchdog.watch(f"TrainStep#{self._step_count}"):
-                loss_data, new_state = self._jitted(state, lr, batch_data)
+                out = self._jitted(state, lr, batch_data)
+            if self._numerics is not None:
+                loss_data, new_state, nstats = out
+                self._numerics.on_step(nstats)   # deferred readback
+            else:
+                loss_data, new_state = out
             self._step_count += 1
         except Exception as e:
             # OOM forensics (ISSUE 14): a RESOURCE_EXHAUSTED at the

@@ -33,6 +33,14 @@ serving:
   `/healthz`, `/tracez`, `/flightz` (opt-in from ServingEngine/bench).
 - `goodput_breakdown` — per-step `goodput.*` step-time attribution
   folded from the existing stall/bubble/comm gauges (BENCH lanes).
+- `numerics` (ISSUE 15) — in-graph training-numerics observatory:
+  per-layer-chunk grad/update/activation health computed INSIDE the
+  compiled step scans ([chunks, k] stats block, one deferred readback
+  per logging boundary, zero added collectives), NaN provenance
+  through the flight recorder (``nan_provenance`` events,
+  ``numerics.first_bad_chunk``), an EWMA spike detector
+  (``numerics.anomaly.count``), ``numerics.*`` lazy gauges and the
+  `/numericsz` endpoint.
 - `memory` (ISSUE 14) — device-memory accounting:
   `CompiledMemoryProfile` (AOT buffer-assignment stats + top-K
   buffers of any compiled step, `step.memory_profile()` everywhere,
@@ -68,6 +76,9 @@ from .memory import (  # noqa: F401
     last_oom_report, live_buffer_report, live_registry, memz_payload,
     oom_guard, parse_hlo_buffers,
 )
+from .numerics import (  # noqa: F401
+    NumericsMonitor, chunk_of_layer, monitor_enabled, numericsz_payload,
+)
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, percentile, registry,
 )
@@ -94,4 +105,6 @@ __all__ = [
     "CompiledMemoryProfile", "LiveBufferRegistry", "live_registry",
     "live_buffer_report", "parse_hlo_buffers", "is_oom_error",
     "dump_oom", "oom_guard", "last_oom_report", "memz_payload",
+    "NumericsMonitor", "monitor_enabled", "numericsz_payload",
+    "chunk_of_layer",
 ]

@@ -13,6 +13,10 @@ observability surface over loopback:
 - ``/memz``     — device-memory attribution (ISSUE 14): live-buffer
   bytes per owner + published ``mem.compiled.*`` step profiles (+
   page-pool stats when a serving engine provides its own ``memz``).
+- ``/numericsz`` — training-numerics health (ISSUE 15): every live
+  NumericsMonitor's per-layer-chunk grad/update/activation table,
+  NaN provenance and anomaly ring (the scrape performs the monitors'
+  deferred readback).
 - ``/<name>``   — any extra provider passed as ``extra={name: fn}``
   (the serving engine adds ``/sloz`` -> SLO burn-rate snapshot and
   overrides ``/memz`` with its pool-aware payload).
@@ -71,6 +75,12 @@ class DebugServer:
             from .memory import memz_payload
 
             self._extra["memz"] = memz_payload
+        # /numericsz default (ISSUE 15): every live NumericsMonitor's
+        # per-chunk health table + provenance + anomaly ring
+        if "numericsz" not in self._extra:
+            from .numerics import numericsz_payload
+
+            self._extra["numericsz"] = numericsz_payload
         self.host = host
         self._port_req = int(port)
         self._httpd = None

@@ -105,6 +105,15 @@ define_flag("FLAGS_param_storage", "",
             "ISSUE-11 layout: full per-leaf stacks on every device, the "
             "bit-parity reference). Per-step override: "
             "ShardedFusedScanTrainStep(param_storage=...)")
+define_flag("FLAGS_numerics_monitor", True,
+            "in-graph training-numerics observatory (ISSUE 15): every "
+            "compiled train step emits a fixed-shape per-layer-chunk "
+            "stats block (grad/param sq-norms, update ratio, "
+            "activation RMS, finite flags) consumed lazily by "
+            "observability.numerics.NumericsMonitor — zero added "
+            "collectives, one deferred host readback per logging "
+            "boundary. Off removes the stats from the compiled "
+            "programs entirely. Per-step override: numerics=True/False")
 define_flag("FLAGS_splash_attn", True,
             "route training attention (causal/plain, no mask, no "
             "dropout) through the splash Pallas kernel "

@@ -240,3 +240,84 @@ class TestRecordLoading:
         assert bc.compare_latest(str(tmp_path))["status"] == "no_data"
         assert bc.compare_latest(
             str(tmp_path), current=_record())["status"] == "no_data"
+
+
+class TestNumericsFamily:
+    """ISSUE 15 satellite: the `numerics` metric family — finite_frac
+    is an ABSOLUTE gate (must stay 1.0, both directions tested) and
+    grad-norm drift is informational only (never gates, either
+    direction)."""
+
+    @staticmethod
+    def _nrec(finite=1.0, grad_norm=2.5):
+        rec = _record()
+        rec["numerics"] = {"finite_frac": finite,
+                           "global_grad_norm": grad_norm}
+        return rec
+
+    def _row(self, res, suffix):
+        rows = [r for r in res["rows"] if r["metric"].endswith(suffix)]
+        assert rows, res["rows"]
+        return rows[0]
+
+    def test_families_detected(self, bc):
+        m = bc.extract_metrics(self._nrec())
+        assert m["numerics.finite_frac"] == 1.0
+        assert m["numerics.global_grad_norm"] == 2.5
+
+    def test_finite_stays_one_passes(self, bc):
+        res = bc.compare(self._nrec(), self._nrec())
+        assert res["status"] == "pass"
+        assert self._row(res, "finite_frac")["verdict"] == "ok"
+
+    def test_finite_drop_regresses(self, bc):
+        # direction 1: 1.0 -> 0.98 fails the gate absolutely
+        res = bc.compare(self._nrec(), self._nrec(finite=0.98))
+        assert res["status"] == "regress"
+        assert "numerics.finite_frac" in res["regressions"]
+
+    def test_finite_below_one_regresses_even_if_baseline_was_bad(
+            self, bc):
+        # absolute, not relative: a 0.9 -> 0.95 "improvement" still
+        # fails — the gate is finite_frac == 1.0, not "no worse"
+        res = bc.compare(self._nrec(finite=0.9),
+                         self._nrec(finite=0.95))
+        assert res["status"] == "regress"
+
+    def test_finite_recovery_is_improved(self, bc):
+        # direction 2: 0.9 -> 1.0 recovers and passes
+        res = bc.compare(self._nrec(finite=0.9), self._nrec())
+        assert self._row(res, "finite_frac")["verdict"] == "improved"
+        assert "numerics.finite_frac" not in res["regressions"]
+
+    def test_grad_norm_drift_never_gates(self, bc):
+        # both directions: large drift is reported as info, not a
+        # regression
+        for new in (0.1, 250.0):
+            res = bc.compare(self._nrec(),
+                             self._nrec(grad_norm=new))
+            row = self._row(res, "global_grad_norm")
+            assert row["verdict"] == "info"
+            assert "numerics.global_grad_norm" not in \
+                res["regressions"]
+            assert res["status"] == "pass"
+
+    def test_missing_finite_frac_regresses(self, bc):
+        # the absolute gate must not vanish silently: baseline had
+        # finite_frac, the candidate's monitor errored and dropped it
+        bad = self._nrec()
+        bad["numerics"] = {"error": "monitor exploded"}
+        res = bc.compare(self._nrec(), bad)
+        assert res["status"] == "regress"
+        assert "numerics.finite_frac" in res["regressions"]
+        row = self._row(res, "finite_frac")
+        assert row["new"] is None and "missing" in row["note"]
+        bc.render_table(res)        # None new must render
+
+    def test_other_families_may_vanish(self, bc):
+        # only the absolute gate pins presence; a lane dropping a
+        # latency metric is not a regression
+        new = self._nrec()
+        del new["serving"]
+        res = bc.compare(self._nrec(), new)
+        assert "serving.ttft_p99_s" not in res["regressions"]

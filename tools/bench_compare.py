@@ -32,6 +32,14 @@ Metric families and default tolerances (relative):
                      growth fails the gate like a tok/s regression,
                      ISSUE 14; AOT buffer-assignment numbers are
                      deterministic, so 5% is generous)
+    finite     ABSOLUTE: any finite_frac below 1.0 regresses — a
+                     training run that produced even one non-finite
+                     step is broken regardless of the previous round
+                     (ISSUE 15)
+    gradnorm   INFORMATIONAL ONLY: grad-norm drift rows render with an
+                     "info" verdict and NEVER gate — norms legitimately
+                     move with model/config/step-count changes
+                     (ISSUE 15)
 
 Latency/stall/mem metrics additionally carry an ABSOLUTE floor: when
 both sides sit under it, the row is informational (sub-floor jitter
@@ -58,6 +66,12 @@ DEFAULT_TOLERANCES = {
     "itl":     (0.25, False, 1e-3),     # seconds
     "stall":   (1.00, False, 0.5),      # milliseconds
     "mem":     (0.05, False, 32 * 1024 * 1024),   # bytes (peak)
+    # numerics family (ISSUE 15): finite_frac is an ABSOLUTE gate
+    # (must stay 1.0), grad-norm drift is informational-only — both
+    # special-cased in compare(), the tuples here only register the
+    # families
+    "finite":  (0.0, True, 0.0),
+    "gradnorm": (0.0, True, 0.0),
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -116,6 +130,10 @@ def load_record(path):
 
 def _family(key):
     k = key.lower()
+    if "finite_frac" in k:
+        return "finite"
+    if "grad_norm" in k:
+        return "gradnorm"
     if "peak_bytes" in k:
         return "mem"
     if "goodput_frac" in k:
@@ -200,7 +218,15 @@ def compare(old_rec, new_rec, tolerances=None) -> dict:
         # and corrupt the whole BENCH record for strict parsers)
         delta = (new - old) / abs(old) if old else None
         verdict = "ok"
-        if max(abs(old), abs(new)) < floor:
+        if fam == "finite":
+            # absolute: 1.0 means every step was finite; anything less
+            # regresses no matter what the previous round recorded
+            verdict = ("regress" if new < 1.0
+                       else ("improved" if old < 1.0 else "ok"))
+        elif fam == "gradnorm":
+            # drift is reported, never gated
+            verdict = "info"
+        elif max(abs(old), abs(new)) < floor:
             verdict = "sub_floor"
         elif old == 0:
             # relative tolerances are meaningless against 0 — report,
@@ -222,6 +248,20 @@ def compare(old_rec, new_rec, tolerances=None) -> dict:
                                    else round(delta * 100, 2)),
                      "tol_pct": round(rel_tol * 100, 1),
                      "verdict": verdict})
+    # an ABSOLUTE gate must not degrade to "pass" by vanishing: a
+    # finite_frac the baseline recorded but the candidate lacks (the
+    # monitor errored, or never folded a step) is itself a regression
+    # — exactly the broken-monitor case the gate exists to catch.
+    # Other families legitimately come and go with lane configs.
+    for key in sorted(set(old_m) - set(new_m)):
+        fam = _family(key.rsplit(".", 1)[-1]) or _family(key)
+        if fam == "finite":
+            rows.append({"metric": key, "family": fam,
+                         "old": old_m[key], "new": None,
+                         "delta_pct": None, "tol_pct": 0.0,
+                         "verdict": "regress",
+                         "note": "absolute gate metric missing from "
+                                 "candidate record"})
     regressions = [r["metric"] for r in rows if r["verdict"] == "regress"]
     status = ("no_data" if not rows
               else "regress" if regressions else "pass")
@@ -235,8 +275,10 @@ def render_table(result) -> str:
     for r in result["rows"]:
         dp = ("     —" if r["delta_pct"] is None
               else f"{r['delta_pct']:>8.2f}")
+        new = ("           —" if r["new"] is None
+               else f"{r['new']:>12.4g}")
         lines.append(
-            f"{r['metric'][:58]:<58}{r['old']:>12.4g}{r['new']:>12.4g}"
+            f"{r['metric'][:58]:<58}{r['old']:>12.4g}{new}"
             f"{dp}{r['tol_pct']:>6.1f}  "
             f"{r['verdict']}")
     lines.append(f"status: {result['status']} "
