@@ -345,10 +345,7 @@ def _quantized_sum_traced(axes, nranks, qformat):
         """Symmetric int8 per-block: x [..., c] -> (q int8 [..., c/b, b],
         scales fp32 [..., c/b])."""
         blocks = x.reshape(x.shape[:-1] + (x.shape[-1] // b, b))
-        sc = jnp.maximum(jnp.max(jnp.abs(blocks), axis=-1), 1e-30) / 127.0
-        q = jnp.clip(jnp.round(blocks / sc[..., None]),
-                     -127, 127).astype(jnp.int8)
-        return q, sc
+        return quantize_symmetric_q8(blocks)
 
     def traced(s):
         orig_shape, orig_dtype = s.shape, s.dtype
@@ -395,6 +392,28 @@ def _quantized_sum_traced(axes, nranks, qformat):
 QUANT_SCATTER_BLOCK = 32      # int8 scaling-block, same as _quantized_sum
 
 
+def quantize_symmetric_q8(x, axis=-1):
+    """Symmetric int8 quantization along `axis` — THE wire/storage
+    format of the comm stack (EQuARX per-block scales, PAPERS.md) and,
+    since ISSUE 16, of the int8 paged KV pools (inference/kv_cache.py):
+    one fp32 scale per `axis`-row, payload = round(x / scale) clipped to
+    [-127, 127]. Returns (q int8, scales fp32 with `axis` removed); the
+    1e-30 floor keeps all-zero rows from dividing by zero."""
+    sc = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis),
+                     1e-30) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32)
+                           / jnp.expand_dims(sc, axis)),
+                 -127, 127).astype(jnp.int8)
+    return q, sc
+
+
+def dequantize_q8(q, scales, axis=-1, dtype=jnp.float32):
+    """Inverse of `quantize_symmetric_q8`: q * scale broadcast along
+    `axis` (scales has `axis` removed)."""
+    return (q.astype(jnp.float32)
+            * jnp.expand_dims(scales, axis)).astype(dtype)
+
+
 def quantized_psum_scatter_traced(axis, nranks, qformat):
     """The SCATTER LEG of the compressed all-reduce above, as a traced
     psum_scatter replacement for use INSIDE shard_map (the sharded
@@ -433,10 +452,7 @@ def quantized_psum_scatter_traced(axis, nranks, qformat):
                     "scaling block; pad the flat layout to "
                     "nranks*QUANT_SCATTER_BLOCK")
             blocks = chunks.reshape(lead + (n, c // b, b))
-            sc = jnp.maximum(jnp.max(jnp.abs(blocks), axis=-1),
-                             1e-30) / 127.0
-            q = jnp.clip(jnp.round(blocks / sc[..., None]),
-                         -127, 127).astype(jnp.int8)
+            q, sc = quantize_symmetric_q8(blocks)
             recv = jax.lax.all_to_all(q, axis, split_axis=split_ax,
                                       concat_axis=split_ax)
             src_sc = jax.lax.all_to_all(sc, axis, split_axis=split_ax,
@@ -489,10 +505,7 @@ def quantized_all_gather_traced(axis, qformat, gather_axis=-1):
                     "int8 scaling block; pad the flat layout to "
                     "nranks*QUANT_SCATTER_BLOCK")
             blocks = x.astype(jnp.float32).reshape(lead + (c // b, b))
-            sc = jnp.maximum(jnp.max(jnp.abs(blocks), axis=-1),
-                             1e-30) / 127.0
-            q = jnp.clip(jnp.round(blocks / sc[..., None]),
-                         -127, 127).astype(jnp.int8)
+            q, sc = quantize_symmetric_q8(blocks)
             gq = jax.lax.all_gather(q, axis, axis=len(lead), tiled=True)
             gsc = jax.lax.all_gather(sc, axis, axis=len(lead),
                                      tiled=True)

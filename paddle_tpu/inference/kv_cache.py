@@ -26,6 +26,16 @@ ordinary input refresh, not a retrace.
 Page 0 of every pool is the **trash page**: ragged writes of padding /
 inactive-slot tokens are routed there so scatters stay static-shape with
 no masking branches. It is never mapped in any page table.
+
+``PagedKVCache(..., quant="int8")`` stores the pools as int8 with one
+fp32 symmetric scale per cached row (``k_scales``/``v_scales``:
+``[num_kv_heads, num_pages, page_size]``) — the comm stack's
+`quantize_symmetric_q8` wire format (distributed/collective.py), at
+block = head_dim. KV HBM halves (scales add 1/head_dim), so the same
+memory holds ~2x the pages; dequant fuses into the paged-attention
+gather (ops/pallas/paged_attention.py). The ``*_q8`` write helpers
+quantize each incoming row and scatter payload + scale with the same
+flat-index trick as their fp twins.
 """
 from __future__ import annotations
 
@@ -34,7 +44,9 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["DenseKVCache", "PagedKVCache", "paged_write_decode",
-           "paged_write_prefill", "dense_write_prefill"]
+           "paged_write_prefill", "dense_write_prefill",
+           "paged_write_decode_q8", "paged_write_prefill_q8",
+           "dense_write_chunk"]
 
 
 # ---------------------------------------------------------------------------
@@ -50,6 +62,29 @@ def dense_write_prefill(cache_l, k_new, v_new):
                      jnp.swapaxes(v_new, 1, 2)]).astype(cache_l.dtype)
     z = jnp.int32(0)
     return jax.lax.dynamic_update_slice(cache_l, upd, (z, z, z, z, z))
+
+
+def dense_write_chunk(cache_l, start, valid_len, k_new, v_new):
+    """Multi-token ragged write into one layer's dense cache: token t of
+    row i lands at position start[i] + t; positions >= valid_len[i] (or
+    past max_len) are dropped. The dense-cache face of the verify write
+    (spec decode scores k+1 tokens per slot whose accepted prefix varies
+    per slot — the over-written tail is masked by valid_len on the next
+    read and overwritten by the next dispatch).
+
+    cache_l: [2, b, nh, max_len, d]; k_new/v_new: [b, t, nh, d];
+    start/valid_len: [b] int32."""
+    _, b, nh, max_len, d = cache_l.shape
+    t = k_new.shape[1]
+    pos = start[:, None].astype(jnp.int32) \
+        + jnp.arange(t, dtype=jnp.int32)[None, :]           # [b, t]
+    ok = pos < jnp.minimum(valid_len[:, None], max_len)
+    pos = jnp.where(ok, pos, max_len)       # out of range -> dropped
+    bidx = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None],
+                            pos.shape)
+    upd = jnp.stack([k_new, v_new]).astype(cache_l.dtype)   # [2,b,t,nh,d]
+    upd = jnp.moveaxis(upd, 0, 2)                           # [b,t,2,nh,d]
+    return cache_l.at[:, bidx, :, pos].set(upd, mode="drop")
 
 
 def _page_flat_index(page_tables, pos, page_size):
@@ -106,6 +141,66 @@ def paged_write_prefill(k_pages, v_pages, page_tables, slot_ids,
         return view.reshape(pool.shape)
 
     return wr(k_pages, k_new), wr(v_pages, v_new)
+
+
+def paged_write_decode_q8(k_pages, v_pages, k_scales, v_scales,
+                          page_tables, seq_lens, active, k_new, v_new):
+    """`paged_write_decode` for int8 pools: each incoming [d] row is
+    symmetric-int8 quantized (comm-stack format, one fp32 scale per
+    row) and payload + scale scatter to the same flat pool index.
+
+    k_scales/v_scales: [kvh, num_pages, page_size] fp32. Returns
+    (k_pages, v_pages, k_scales, v_scales) updated."""
+    from ..distributed.collective import quantize_symmetric_q8
+
+    kvh, num_pages, page_size, d = k_pages.shape
+    flat = _page_flat_index(page_tables, seq_lens[:, None],
+                            page_size)[:, 0]                # [b]
+    flat = jnp.where(active, flat, seq_lens % page_size)    # page 0 trash
+
+    def wr(pool, spool, upd):
+        q, sc = quantize_symmetric_q8(upd)      # [b, kvh, d], [b, kvh]
+        view = pool.reshape(kvh, num_pages * page_size, d)
+        view = view.at[:, flat].set(jnp.moveaxis(q, 1, 0))
+        sview = spool.reshape(kvh, num_pages * page_size)
+        sview = sview.at[:, flat].set(
+            jnp.moveaxis(sc, 1, 0).astype(spool.dtype))
+        return view.reshape(pool.shape), sview.reshape(spool.shape)
+
+    k2, ks2 = wr(k_pages, k_scales, k_new)
+    v2, vs2 = wr(v_pages, v_scales, v_new)
+    return k2, v2, ks2, vs2
+
+
+def paged_write_prefill_q8(k_pages, v_pages, k_scales, v_scales,
+                           page_tables, slot_ids, seq_lens_new,
+                           k_new, v_new, start=None):
+    """`paged_write_prefill` for int8 pools (see `paged_write_decode_q8`
+    for the scale layout). k_new/v_new: [b, s, kvh, d] fp."""
+    from ..distributed.collective import quantize_symmetric_q8
+
+    kvh, num_pages, page_size, d = k_pages.shape
+    b, s = k_new.shape[:2]
+    t = jnp.arange(s, dtype=jnp.int32)[None, :]             # [1, s]
+    pos = t if start is None else start[:, None] + t        # [b, s]
+    flat = _page_flat_index(page_tables[slot_ids], pos, page_size)
+    valid = pos < seq_lens_new[:, None]
+    flat = jnp.where(valid, flat, pos % page_size).reshape(-1)
+
+    def wr(pool, spool, upd):
+        q, sc = quantize_symmetric_q8(upd)   # [b,s,kvh,d], [b,s,kvh]
+        view = pool.reshape(kvh, num_pages * page_size, d)
+        view = view.at[:, flat].set(
+            jnp.moveaxis(q, 2, 0).reshape(kvh, b * s, d))
+        sview = spool.reshape(kvh, num_pages * page_size)
+        sview = sview.at[:, flat].set(
+            jnp.moveaxis(sc, 2, 0).reshape(kvh, b * s)
+            .astype(spool.dtype))
+        return view.reshape(pool.shape), sview.reshape(spool.shape)
+
+    k2, ks2 = wr(k_pages, k_scales, k_new)
+    v2, vs2 = wr(v_pages, v_scales, v_new)
+    return k2, v2, ks2, vs2
 
 
 # ---------------------------------------------------------------------------
@@ -165,9 +260,11 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_kv_heads, head_dim, num_pages,
                  page_size, max_slots, pages_per_seq,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, quant=None):
         if num_pages < 2:
             raise ValueError("need >= 2 pages (page 0 is the trash page)")
+        if quant not in (None, "int8"):
+            raise ValueError(f"unknown KV quant mode {quant!r}")
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
@@ -175,11 +272,21 @@ class PagedKVCache:
         self.page_size = page_size
         self.max_slots = max_slots
         self.pages_per_seq = pages_per_seq
+        self.quant = quant
+        self.dtype = jnp.dtype(jnp.int8 if quant == "int8" else dtype)
         shape = (num_kv_heads, num_pages, page_size, head_dim)
-        self.k_layers = [jnp.zeros(shape, dtype)
+        self.k_layers = [jnp.zeros(shape, self.dtype)
                          for _ in range(num_layers)]
-        self.v_layers = [jnp.zeros(shape, dtype)
+        self.v_layers = [jnp.zeros(shape, self.dtype)
                          for _ in range(num_layers)]
+        if quant == "int8":
+            # one fp32 scale per cached row (block = head_dim); scale
+            # pools thread/donate through the step alongside the payload
+            sshape = (num_kv_heads, num_pages, page_size)
+            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
         # host-mutated metadata lives as NUMPY between steps: the slot
         # bookkeeping (allocate/reserve/free/set_active) runs every
         # scheduler iteration, and a jnp `.at[].set` per call would be
@@ -201,8 +308,15 @@ class PagedKVCache:
 
         live_registry().track(self)
 
+    @property
+    def quantized(self):
+        return self.quant is not None
+
     def _mem_owners(self):
-        return {"kv_pages": list(self.k_layers) + list(self.v_layers)}
+        bufs = list(self.k_layers) + list(self.v_layers)
+        if self.quantized:
+            bufs += list(self.k_scales) + list(self.v_scales)
+        return {"kv_pages": bufs}
 
     # -- host bookkeeping ------------------------------------------------
     def _host(self, name):
@@ -256,7 +370,15 @@ class PagedKVCache:
             raise RuntimeError("no free cache slots (batch full)")
         self._check_reservable(self.pages_needed(prompt_len), 0,
                                prompt_len)
-        slot = self._free_slots.pop()
+        # lowest free slot, NOT stack order: the generation engines
+        # free-all/reallocate between calls and every compiled step
+        # indexes the batch as row i == slot i — a LIFO pop hands the
+        # slots back permuted after the first reuse, silently crossing
+        # rows between sequences (and blowing the spec loop's host/
+        # device seq_lens bookkeeping apart). O(max_slots) on a small
+        # host list, once per admission.
+        slot = min(self._free_slots)
+        self._free_slots.remove(slot)
         self._slot_pages[slot] = []
         self._host("seq_lens")[slot] = 0
         self._host("active")[slot] = True
@@ -310,7 +432,18 @@ class PagedKVCache:
             prev = p
         used = sum(len(p) for _, p in slot_items)
         total = self.num_pages - 1            # page 0 is trash
+        # capacity receipt (ISSUE 16): bytes per cached token across all
+        # layers, K+V; int8 pools pay 1 byte + 4/head_dim for the scale
+        # instead of itemsize — the "≈2x slots at equal HBM" math the
+        # bench records
+        per_tok = self.num_layers * 2 * self.num_kv_heads * (
+            self.head_dim * self.dtype.itemsize
+            + (4 if self.quantized else 0))
         return {
+            "kv_dtype": str(self.dtype),
+            "bytes_per_token": per_tok,
+            "page_bytes": per_tok * self.page_size,
+            "pool_bytes": per_tok * self.page_size * self.num_pages,
             "total_pages": total,
             "free_pages": len(free),
             "used_pages": used,
@@ -342,10 +475,14 @@ class PagedKVCache:
 
     # -- device state ------------------------------------------------------
     def state(self):
-        return {"k_layers": list(self.k_layers),
-                "v_layers": list(self.v_layers),
-                "page_tables": self.page_tables,
-                "seq_lens": self.seq_lens, "active": self.active}
+        out = {"k_layers": list(self.k_layers),
+               "v_layers": list(self.v_layers),
+               "page_tables": self.page_tables,
+               "seq_lens": self.seq_lens, "active": self.active}
+        if self.quantized:
+            out["k_scales"] = list(self.k_scales)
+            out["v_scales"] = list(self.v_scales)
+        return out
 
     def load_state(self, state):
         self.k_layers = list(state["k_layers"])
@@ -353,3 +490,6 @@ class PagedKVCache:
         self.page_tables = state["page_tables"]
         self.seq_lens = state["seq_lens"]
         self.active = state["active"]
+        if self.quantized:
+            self.k_scales = list(state["k_scales"])
+            self.v_scales = list(state["v_scales"])

@@ -40,19 +40,27 @@ import time
 
 from ..framework.autograd import no_grad
 from ..framework.tensor import Tensor
-from ..nn.functional.sampling import sample_logits, sample_logits_per_slot
+from ..nn.functional.sampling import (
+    sample_logits, sample_logits_per_slot, spec_accept_greedy,
+    spec_accept_sampled, spec_draft_keys, truncated_probs,
+)
 from ..observability import RetraceSentinel
 from ..observability import enabled as _obs_enabled
 from ..observability import registry as _obs_registry
 from .train_step import _tree_data, _tree_wrap
 
 __all__ = ["GenerationEngine", "DecodeStep", "PrefillStep",
-           "ChunkPrefillStep", "ServeDecodeStep",
-           "DEFAULT_PREFILL_BUCKETS"]
+           "ChunkPrefillStep", "ServeDecodeStep", "SpecDecodeStep",
+           "ServeSpecDecodeStep", "DEFAULT_PREFILL_BUCKETS"]
 
 DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
 
-_BUFFER_KEYS = {"dense": ("layers",), "paged": ("k_layers", "v_layers")}
+# per cache kind, the state keys that are DONATED pool buffers (the
+# rest is metadata); presence-filtered, so the int8 scale pools ride
+# in the donated set exactly when the cache is quantized
+_BUFFER_KEYS = {"dense": ("layers",),
+                "paged": ("k_layers", "v_layers",
+                          "k_scales", "v_scales")}
 
 
 def _legacy_jax():
@@ -61,7 +69,7 @@ def _legacy_jax():
 
 
 def _split_state(kind, state):
-    buf_keys = _BUFFER_KEYS[kind]
+    buf_keys = [k for k in _BUFFER_KEYS[kind] if k in state]
     return ({k: state[k] for k in buf_keys},
             {k: v for k, v in state.items() if k not in buf_keys})
 
@@ -209,16 +217,37 @@ class _Step:
         return out
 
     # -- shared step body helpers ---------------------------------------
-    def _enter(self, params, buffers, meta):
+    def _enter(self, params, buffers, meta, dparams=None):
+        """Bind traced params + cache state into the live model(s).
+
+        When the engine carries a DRAFT model (speculative decoding)
+        and the caller threads `dparams`, the draft's params and KV
+        pools (nested under ``buffers["draft"]``) are bound too; the
+        draft cache has no metadata of its own — its positions/tables
+        are re-derived from the TARGET's metadata every step."""
         eng = self.engine
         for p, d in zip(eng._params, params):
             p._data = d
-        eng.cache.load_state(_tree_wrap({**buffers, **meta}))
+        tgt = {k: v for k, v in buffers.items() if k != "draft"}
+        eng.cache.load_state(_tree_wrap({**tgt, **meta}))
+        self._draft_bound = (dparams is not None
+                             and eng.draft_model is not None)
+        if self._draft_bound:
+            for p, d in zip(eng._draft_params, dparams):
+                p._data = d
+            eng.draft_cache.load_state(
+                _tree_wrap({**buffers["draft"], **meta}))
 
     def _exit_state(self):
         """Read back + split the cache state produced by the step."""
-        return _split_state(self.engine.kind,
-                            _tree_data(self.engine.cache.state()))
+        eng = self.engine
+        buffers, meta = _split_state(eng.kind,
+                                     _tree_data(eng.cache.state()))
+        if getattr(self, "_draft_bound", False):
+            dbuf, _ = _split_state(
+                eng.kind, _tree_data(eng.draft_cache.state()))
+            buffers["draft"] = dbuf
+        return buffers, meta
 
     def _sample(self, logits, key):
         eng = self.engine
@@ -244,6 +273,11 @@ class _BindCtx:
         eng = self.engine
         self._saved_params = [p._data for p in eng._params]
         self._saved_cache = eng.cache.state()
+        if getattr(eng, "draft_model", None) is not None:
+            self._saved_dparams = [p._data for p in eng._draft_params]
+            self._saved_dcache = eng.draft_cache.state()
+        else:
+            self._saved_dparams = None
         return self
 
     def __exit__(self, *exc):
@@ -251,6 +285,10 @@ class _BindCtx:
         for p, d in zip(eng._params, self._saved_params):
             p._data = d
         eng.cache.load_state(self._saved_cache)
+        if self._saved_dparams is not None:
+            for p, d in zip(eng._draft_params, self._saved_dparams):
+                p._data = d
+            eng.draft_cache.load_state(self._saved_dcache)
         return False
 
 
@@ -258,14 +296,15 @@ class PrefillStep(_Step):
     """Bucketed prompt pass: write all layers' K/V, sample token 0."""
 
     _arg_names = ("params", "buffers", "meta", "ids", "lens",
-                  "slot_ids", "key")
+                  "slot_ids", "key", "dparams")
     _bucketed_args = ("ids",)
 
-    def _fn(self, params, buffers, meta, ids, lens, slot_ids, key):
+    def _fn(self, params, buffers, meta, ids, lens, slot_ids, key,
+            dparams=None):
         self.trace_count += 1
         eng = self.engine
         with no_grad(), _BindCtx(eng):
-            self._enter(params, buffers, meta)
+            self._enter(params, buffers, meta, dparams=dparams)
             cache = eng.cache
             b = ids.shape[0]
             lens_b = jnp.broadcast_to(lens.reshape(-1), (b,)) \
@@ -274,6 +313,13 @@ class PrefillStep(_Step):
                 Tensor._wrap(ids), cache,
                 seq_lens=Tensor._wrap(lens_b),
                 slot_ids=Tensor._wrap(slot_ids))
+            if self._draft_bound:
+                # prime the DRAFT cache over the same prompt/slots so
+                # the first spec dispatch attends a complete context
+                eng.draft_model.gpt.prefill(
+                    Tensor._wrap(ids), eng.draft_cache,
+                    seq_lens=Tensor._wrap(lens_b),
+                    slot_ids=Tensor._wrap(slot_ids))
             # last VALID position per row (traced -> bucket-stable)
             last = jnp.take_along_axis(
                 hidden._data, (lens_b - 1)[:, None, None]
@@ -350,19 +396,27 @@ class ChunkPrefillStep(_Step):
 
     _pin_meta_host = True
     _arg_names = ("params", "buffers", "meta", "ids", "slot_ids",
-                  "start", "lens_new", "seeds")
+                  "start", "lens_new", "seeds", "dparams")
     _bucketed_args = ("ids",)
 
     def _fn(self, params, buffers, meta, ids, slot_ids, start, lens_new,
-            seeds):
+            seeds, dparams=None):
         self.trace_count += 1
         eng = self.engine
         with no_grad(), _BindCtx(eng):
-            self._enter(params, buffers, meta)
+            self._enter(params, buffers, meta, dparams=dparams)
             cache = eng.cache
             hidden = eng.model.gpt.prefill_chunk(
                 Tensor._wrap(ids), cache, Tensor._wrap(slot_ids),
                 Tensor._wrap(start), Tensor._wrap(lens_new))
+            if self._draft_bound:
+                # mirror the chunk into the draft cache (same slots,
+                # same positions) so spec decode starts with a fully
+                # prefilled draft context
+                eng.draft_model.gpt.prefill_chunk(
+                    Tensor._wrap(ids), eng.draft_cache,
+                    Tensor._wrap(slot_ids), Tensor._wrap(start),
+                    Tensor._wrap(lens_new))
             # last VALID chunk position per row (traced, bucket-stable)
             last = jnp.take_along_axis(
                 hidden._data,
@@ -436,6 +490,153 @@ class ServeDecodeStep(_Step):
         return jnp.stack(toks), logits, new_buffers, new_meta
 
 
+class SpecDecodeStep(_Step):
+    """Speculative decoding inside ONE compiled program (ISSUE 16):
+    the draft model proposes k tokens per slot, the target scores all
+    k+1 positions in a single multi-token paged-attention call (the
+    chunk-prefill machinery doubling as the verifier), and accept/
+    rollback is traced slot bookkeeping — so one dispatch + one host
+    sync yields BETWEEN 1 and k+1 tokens per slot at one target
+    forward's cost.
+
+    Structure of one dispatch, per slot, with pre-dispatch context
+    length sl0 and incoming token t0 (sampled last dispatch, not yet
+    cached — the same "last token is uncached" contract as the plain
+    decode step):
+
+    1. DRAFT: k+1 single-token decode iterations over the draft's own
+       KV cache (same page tables / slot geometry as the target,
+       draft-sized pools). Iteration j writes the j-th context token's
+       K/V at sl0+j and proposes d_{j+1}; the final iteration only
+       writes d_k's K/V — without it a full accept would leave a hole
+       at sl0+k and the NEXT dispatch's draft would attend a torn
+       context. Greedy engines take argmax; sampling engines draw from
+       `truncated_probs` on the per-slot tag-3 stream
+       (`spec_draft_keys`), recording q for the acceptance test.
+    2. VERIFY: the target runs `prefill_chunk` over [t0, d_1..d_k] —
+       ONE ragged multi-token attention call that also writes the
+       target K/V for all k+1 rows (rows at/past the per-slot cap are
+       trash-routed, so acceptance can never outrun reserved pages).
+    3. ACCEPT/ROLLBACK: `spec_accept_greedy` (longest argmax-matching
+       prefix — bit-identical to plain greedy decode) or
+       `spec_accept_sampled` (rejection sampling with the residual
+       correction — exactly target-distributed for ANY draft). The KV
+       "rewind" on rejection is pure bookkeeping: seq_lens comes back
+       as sl0 + accepted + 1 wait-free; stale rows beyond it are
+       masked by every later attention and overwritten by the next
+       dispatch's writes before they are ever read.
+
+    Returns (tokens [b, k+1], counts [b], logits [b, k+1, vocab],
+    buffers, meta): tokens[:counts] are the emitted tokens (accepted
+    proposals then the correction/bonus token), counts is the per-slot
+    yield (0 for slots whose cap is already met), logits row t is the
+    target distribution the t-th emitted token came from. The host
+    never learns WHY a token was emitted — only how many; variable
+    yield is the whole scheduler-visible surface. All shapes are
+    fixed by (batch, k), so steady state stays one executable."""
+
+    _arg_names = ("params", "buffers", "meta", "dparams", "tokens",
+                  "seeds", "caps")
+
+    def _fn(self, params, buffers, meta, dparams, tokens, seeds, caps):
+        self.trace_count += 1
+        eng = self.engine
+        kk = eng.spec_k
+        with no_grad(), _BindCtx(eng):
+            self._enter(params, buffers, meta, dparams=dparams)
+            cache, dcache = eng.cache, eng.draft_cache
+            b = tokens.shape[0]
+            caps = jnp.minimum(jnp.asarray(caps).astype(jnp.int32),
+                               eng.max_len)
+            if eng.kind == "paged":
+                sl0 = _data_of(cache.seq_lens).astype(jnp.int32)
+                act = _data_of(cache.active)
+                limit = cache.pages_per_seq * cache.page_size
+            else:
+                sl0 = jnp.broadcast_to(
+                    jnp.reshape(_data_of(cache.pos), (-1,)),
+                    (b,)).astype(jnp.int32)
+                act = jnp.ones((b,), bool)
+                limit = dcache.max_len
+            greedy = not eng.do_sample
+            dmpe = eng.draft_model.config.max_position_embeddings
+            cur = jnp.reshape(tokens, (b,)).astype(jnp.int32)
+            prop, qprobs = [], []
+            for j in range(kk + 1):
+                dsl = sl0 + j
+                # overflow guard: near the window end the draft runs
+                # ahead of the target's reserved pages — deactivate
+                # those rows so their writes trash-route instead of
+                # clamping into the slot's last real page
+                ok = act & (dsl < limit)
+                if eng.kind == "paged":
+                    dcache.seq_lens = Tensor._wrap(dsl)
+                    dcache.active = Tensor._wrap(ok)
+                else:
+                    dcache.pos = Tensor._wrap(dsl)
+                pos_ids = jnp.minimum(dsl, dmpe - 1)[:, None]
+                hidden = eng.draft_model.gpt.decode_step(
+                    Tensor._wrap(cur[:, None]), dcache,
+                    Tensor._wrap(pos_ids))
+                if j == kk:
+                    break      # write-only iteration: d_k's K/V
+                logits = eng.draft_model.head(hidden)._data[:, 0]
+                if greedy:
+                    nxt = jnp.argmax(logits.astype(jnp.float32),
+                                     axis=-1).astype(jnp.int32)
+                else:
+                    q = truncated_probs(logits, eng.temperature,
+                                        eng.top_k, eng.top_p)
+                    lq = jnp.where(q > 0,
+                                   jnp.log(jnp.maximum(q, 1e-38)),
+                                   -jnp.inf)
+                    keys = spec_draft_keys(seeds, sl0, j)
+                    nxt = jax.vmap(jax.random.categorical)(
+                        keys, lq).astype(jnp.int32)
+                    qprobs.append(q)
+                prop.append(nxt)
+                cur = nxt
+            proposed = jnp.stack(prop, axis=1)               # [b, k]
+            ver = jnp.concatenate(
+                [jnp.reshape(tokens, (b, 1)).astype(jnp.int32),
+                 proposed], axis=1)                          # [b, k+1]
+            hidden = eng.model.gpt.prefill_chunk(
+                Tensor._wrap(ver), cache,
+                Tensor._wrap(jnp.arange(b, dtype=jnp.int32)),
+                Tensor._wrap(sl0), Tensor._wrap(caps))
+            logits_all = eng.model.head(hidden)._data   # [b, k+1, v]
+            if greedy:
+                a, nxt_tok = spec_accept_greedy(logits_all, proposed)
+            else:
+                tgt_p = truncated_probs(logits_all, eng.temperature,
+                                        eng.top_k, eng.top_p)
+                a, nxt_tok = spec_accept_sampled(
+                    tgt_p, jnp.stack(qprobs, axis=1), proposed,
+                    seeds, sl0)
+            new_sl = jnp.where(act,
+                               jnp.minimum(sl0 + 1 + a, caps), sl0)
+            counts = (new_sl - sl0).astype(jnp.int32)
+            toks = jnp.concatenate(
+                [proposed, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            toks = toks.at[jnp.arange(b), a].set(nxt_tok)
+            if eng.kind == "paged":
+                cache.seq_lens = Tensor._wrap(new_sl)
+            else:
+                cache.pos = Tensor._wrap(new_sl)
+            new_buffers, new_meta = self._exit_state()
+        return toks, counts, logits_all, new_buffers, new_meta
+
+
+class ServeSpecDecodeStep(SpecDecodeStep):
+    """SpecDecodeStep under the serving loop's metadata contract: the
+    continuous-batching bookkeeping rewrites page tables / active
+    flags between calls, so every meta leaf is pinned to host numpy
+    for one stable executable signature (see _Step._pin_meta_host).
+    The scheduler sees only the variable per-slot token yield."""
+
+    _pin_meta_host = True
+
+
 class GenerationEngine:
     """Prefill + decode orchestration over one (model, cache) pair.
 
@@ -448,7 +649,8 @@ class GenerationEngine:
     def __init__(self, model, kind="dense", batch=1, max_len=128,
                  do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
                  compiled=True, cache_dtype=None, page_size=16,
-                 prefill_buckets=DEFAULT_PREFILL_BUCKETS, donate=True):
+                 prefill_buckets=DEFAULT_PREFILL_BUCKETS, donate=True,
+                 draft_model=None, spec_k=4, kv_quant=None):
         cfg = model.config
         model.gpt._check_decodable()
         if max_len > cfg.max_position_embeddings:
@@ -475,9 +677,33 @@ class GenerationEngine:
         self._params = list(model.parameters())
         if kind not in ("dense", "paged"):
             raise ValueError(f"unknown cache kind {kind!r}")
+        if kv_quant is not None and kind != "paged":
+            raise ValueError(
+                "kv_quant needs the paged cache (use_cache='paged')")
         self._cache_dtype = cache_dtype or jnp.float32
         self._page_size = page_size
+        self.kv_quant = kv_quant
+        # speculative decoding (ISSUE 16): a small draft model turns
+        # the decode loop into draft-k/verify-once dispatches
+        self.draft_model = draft_model
+        self.spec_k = int(spec_k)
         self.cache = self._make_cache()
+        if draft_model is not None:
+            draft_model.gpt._check_decodable()
+            if draft_model.config.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "draft model vocab_size "
+                    f"{draft_model.config.vocab_size} != target "
+                    f"{cfg.vocab_size} (proposals must be target ids)")
+            if self.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            self._draft_params = list(draft_model.parameters())
+            self.draft_cache = self._make_draft_cache()
+            self.spec_step = SpecDecodeStep(self, donate_cache=donate)
+        else:
+            self._draft_params = []
+            self.draft_cache = None
+            self.spec_step = None
         self.prefill_step = PrefillStep(self, donate_cache=donate)
         self.decode_step = DecodeStep(self, donate_cache=donate)
         # live-buffer attribution (ISSUE 14): a decode-only process has
@@ -512,7 +738,34 @@ class GenerationEngine:
             cfg.num_layers, nh, hd,
             num_pages=1 + self.batch * pages_per_seq,
             page_size=self._page_size, max_slots=self.batch,
-            pages_per_seq=pages_per_seq, dtype=self._cache_dtype)
+            pages_per_seq=pages_per_seq, dtype=self._cache_dtype,
+            quant=self.kv_quant)
+
+    def _make_draft_cache(self):
+        """Draft-model KV cache with the TARGET's slot/page geometry
+        (shared page tables, draft-sized pools). The dense variant is
+        oversized by spec_k+1 rows — the draft runs that far ahead of
+        the target at the window end; the paged variant trash-routes
+        its overrun instead (SpecDecodeStep's overflow guard). The
+        draft stays un-quantized: its pools are small, and a noisy
+        draft only costs accept rate while a noisy TARGET costs output
+        quality."""
+        from ..inference.kv_cache import DenseKVCache, PagedKVCache
+
+        dcfg = self.draft_model.config
+        nh = dcfg.num_attention_heads
+        hd = dcfg.hidden_size // nh
+        if self.kind == "dense":
+            return DenseKVCache(dcfg.num_layers, self.batch,
+                                self.max_len + self.spec_k + 1, nh, hd,
+                                dtype=self._cache_dtype)
+        return PagedKVCache(
+            dcfg.num_layers, nh, hd,
+            num_pages=self.cache.num_pages,
+            page_size=self.cache.page_size,
+            max_slots=self.cache.max_slots,
+            pages_per_seq=self.cache.pages_per_seq,
+            dtype=self._cache_dtype)
 
     # -- memory observability (ISSUE 14) ---------------------------------
     def memory_profile(self, top_k=8, publish=True):
@@ -538,6 +791,9 @@ class GenerationEngine:
 
     def _param_data(self):
         return [p._data for p in self._params]
+
+    def _draft_param_data(self):
+        return [p._data for p in self._draft_params]
 
     def generate(self, input_ids, max_new_tokens, seq_lens=None,
                  eos_token_id=None, seed=None, return_logits=False):
@@ -587,37 +843,56 @@ class GenerationEngine:
             key = jax.random.PRNGKey(int(seed))
         buffers, meta = _split_state(self.kind,
                                      _tree_data(cache.state()))
+        dp = self._draft_param_data()
+        if self.draft_cache is not None:
+            dbuf, _ = _split_state(self.kind,
+                                   _tree_data(self.draft_cache.state()))
+            buffers["draft"] = dbuf
         try:
             tok, logits, buffers, meta, key = self.prefill_step(
                 self._param_data(), buffers, meta, jnp.asarray(ids),
-                lens_in, slot_arr, key)
-            toks, logit_steps = [tok], [logits]
-            cur = lens.copy()
-            for _ in range(int(max_new_tokens) - 1):
-                if self.kind == "paged":
-                    # grow page tables on demand (host bookkeeping;
-                    # the device table is just a refreshed input, not
-                    # a retrace)
-                    for j, slot in enumerate(slots):
-                        cache.reserve(slot, int(cur[j]) + 1)
-                    meta["page_tables"] = cache.page_tables
-                tok, logits, buffers, meta, key = self.decode_step(
-                    self._param_data(), buffers, meta, tok, key)
-                toks.append(tok)
-                if return_logits:
-                    logit_steps.append(logits)
-                cur += 1
+                lens_in, slot_arr, key, dp)
+            if self.draft_model is not None:
+                out, logit_rows = self._spec_loop(
+                    tok, logits, buffers, meta, dp, lens, slots,
+                    int(max_new_tokens), key, return_logits)
+                buffers, meta = self._spec_tail
+            else:
+                toks, logit_steps = [tok], [logits]
+                cur = lens.copy()
+                for _ in range(int(max_new_tokens) - 1):
+                    if self.kind == "paged":
+                        # grow page tables on demand (host
+                        # bookkeeping; the device table is just a
+                        # refreshed input, not a retrace)
+                        for j, slot in enumerate(slots):
+                            cache.reserve(slot, int(cur[j]) + 1)
+                        meta["page_tables"] = cache.page_tables
+                    tok, logits, buffers, meta, key = self.decode_step(
+                        self._param_data(), buffers, meta, tok, key)
+                    toks.append(tok)
+                    if return_logits:
+                        logit_steps.append(logits)
+                    cur += 1
+                out = np.stack([np.asarray(t) for t in toks], axis=1)
+                logit_rows = ([np.asarray(lg, np.float32)
+                               for lg in logit_steps]
+                              if return_logits else None)
+            dbuf = buffers.pop("draft", None)
             cache.load_state({**buffers, **meta})
+            if dbuf is not None:
+                self.draft_cache.load_state({**dbuf, **meta})
         except BaseException:
             # the steps DONATE the KV buffers, and the model keeps this
             # engine cached — an abort mid-loop would leave the cache
             # pointing at consumed buffers, so rebuild it pristine
             self.cache = self._make_cache()
+            if self.draft_model is not None:
+                self.draft_cache = self._make_draft_cache()
             raise
         if self.kind == "paged":
             for slot in slots:
                 cache.free(slot)
-        out = np.stack([np.asarray(t) for t in toks], axis=1)
         if eos_token_id is not None:
             done = np.zeros((b,), bool)
             for t in range(out.shape[1]):
@@ -625,8 +900,72 @@ class GenerationEngine:
                 done |= out[:, t] == eos_token_id
         out_t = Tensor._wrap(jnp.asarray(out.astype(np.int32)))
         if return_logits:
-            logits_arr = np.stack(
-                [np.asarray(lg, np.float32) for lg in logit_steps],
-                axis=1)
+            if self.draft_model is not None:
+                logits_arr = np.stack(
+                    [np.stack(rows, axis=0) for rows in logit_rows],
+                    axis=0)
+            else:
+                logits_arr = np.stack(logit_rows, axis=1)
             return out_t, Tensor._wrap(jnp.asarray(logits_arr))
         return out_t
+
+    def _spec_loop(self, tok, logits, buffers, meta, dp, lens, slots,
+                   mnt, key, return_logits):
+        """Host side of speculative generation: dispatch SpecDecodeStep
+        until every row has `mnt` tokens, consuming the VARIABLE
+        per-slot yield (1..spec_k+1 accepted-or-corrected tokens per
+        dispatch; finished rows yield 0 via caps). Returns (out
+        [b, mnt] np.int32, per-row logits lists); leaves the final
+        (buffers, meta) in self._spec_tail for the caller."""
+        cache = self.cache
+        b = len(slots)
+        tok_h = np.asarray(tok).astype(np.int32).reshape(b)
+        outs = [[int(tok_h[i])] for i in range(b)]
+        la0 = np.asarray(logits, np.float32)
+        lrows = ([[la0[i]] for i in range(b)] if return_logits
+                 else None)
+        if self.do_sample:
+            # per-slot streams for the spec accept/correct draws,
+            # derived from the same key that seeded the prefill sample
+            seeds = np.asarray(jax.random.randint(
+                key, (b,), 0, np.iinfo(np.int32).max), np.uint32)
+        else:
+            seeds = np.zeros((b,), np.uint32)
+        cur_tok = tok_h.copy()
+        # invariant: cached context length = prompt + emitted - 1 (the
+        # latest emitted token is never cached — it is the next
+        # dispatch's verify row 0)
+        sl_host = lens.astype(np.int64).copy()
+        if self.kind == "dense":
+            # pos must enter the step as a [b] vector from dispatch 1
+            # (the step returns it as one — a scalar->vector flip
+            # mid-loop would retrace)
+            meta["pos"] = jnp.broadcast_to(
+                jnp.reshape(jnp.asarray(meta["pos"], jnp.int32),
+                            (-1,)), (b,))
+        while min(len(o) for o in outs) < mnt:
+            rem = np.array([mnt - len(o) for o in outs], np.int64)
+            ahead = np.maximum(np.minimum(self.spec_k + 1, rem), 0)
+            caps = (sl_host + ahead).astype(np.int32)
+            if self.kind == "paged":
+                for j, slot in enumerate(slots):
+                    cache.reserve(slot, int(caps[j]))
+                meta["page_tables"] = cache.page_tables
+            toks_o, counts, logits_all, buffers, meta = self.spec_step(
+                self._param_data(), buffers, meta, dp,
+                np.asarray(cur_tok, np.int32), seeds, caps)
+            counts_h = np.asarray(counts)
+            toks_h = np.asarray(toks_o)
+            la = (np.asarray(logits_all, np.float32)
+                  if return_logits else None)
+            for i in range(b):
+                c = int(counts_h[i])
+                for t in range(c):
+                    outs[i].append(int(toks_h[i, t]))
+                    if return_logits:
+                        lrows[i].append(la[i, t])
+                if c:
+                    cur_tok[i] = toks_h[i, c - 1]
+                sl_host[i] += c
+        self._spec_tail = (buffers, meta)
+        return np.stack([np.asarray(o, np.int32) for o in outs]), lrows

@@ -144,6 +144,17 @@ class GPTAttention(nn.Layer):
             cache.set_layer(layer_idx, nary(
                 _kv.dense_write_prefill, [cache.layer(layer_idx), k, v],
                 "dense_prefill_write"))
+        elif getattr(cache, "quantized", False):
+            new_k, new_v, new_ks, new_vs = nary(
+                _kv.paged_write_prefill_q8,
+                [cache.k_layers[layer_idx], cache.v_layers[layer_idx],
+                 cache.k_scales[layer_idx], cache.v_scales[layer_idx],
+                 cache.page_tables, slot_ids, seq_lens, k, v],
+                "paged_prefill_write_q8")
+            cache.k_layers[layer_idx] = new_k
+            cache.v_layers[layer_idx] = new_v
+            cache.k_scales[layer_idx] = new_ks
+            cache.v_scales[layer_idx] = new_vs
         else:
             new_k, new_v = nary(
                 _kv.paged_write_prefill,
@@ -185,85 +196,133 @@ class GPTAttention(nn.Layer):
             [b, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [b, nh, hd]
 
-        def step(qq, kk, vv, kp, vp, pt, sl, act):
-            kp2, vp2 = _kv.paged_write_decode(kp, vp, pt, sl, act,
-                                              kk, vv)
-            lens = jnp.where(act, sl + 1, 0)
-            o = paged_attention(qq, kp2, vp2, pt, lens)
-            return o, kp2, vp2
+        if getattr(cache, "quantized", False):
+            def step_q8(qq, kk, vv, kp, vp, ksc, vsc, pt, sl, act):
+                kp2, vp2, ks2, vs2 = _kv.paged_write_decode_q8(
+                    kp, vp, ksc, vsc, pt, sl, act, kk, vv)
+                lens = jnp.where(act, sl + 1, 0)
+                o = paged_attention(qq, kp2, vp2, pt, lens,
+                                    k_scales=ks2, v_scales=vs2)
+                return o, kp2, vp2, ks2, vs2
 
-        out, new_k, new_v = nary(
-            step, [q, k, v, cache.k_layers[layer_idx],
-                   cache.v_layers[layer_idx], cache.page_tables,
-                   cache.seq_lens, cache.active],
-            "paged_decode_attention")
+            out, new_k, new_v, new_ks, new_vs = nary(
+                step_q8, [q, k, v, cache.k_layers[layer_idx],
+                          cache.v_layers[layer_idx],
+                          cache.k_scales[layer_idx],
+                          cache.v_scales[layer_idx],
+                          cache.page_tables, cache.seq_lens,
+                          cache.active],
+                "paged_decode_attention_q8")
+            cache.k_scales[layer_idx] = new_ks
+            cache.v_scales[layer_idx] = new_vs
+        else:
+            def step(qq, kk, vv, kp, vp, pt, sl, act):
+                kp2, vp2 = _kv.paged_write_decode(kp, vp, pt, sl, act,
+                                                  kk, vv)
+                lens = jnp.where(act, sl + 1, 0)
+                o = paged_attention(qq, kp2, vp2, pt, lens)
+                return o, kp2, vp2
+
+            out, new_k, new_v = nary(
+                step, [q, k, v, cache.k_layers[layer_idx],
+                       cache.v_layers[layer_idx], cache.page_tables,
+                       cache.seq_lens, cache.active],
+                "paged_decode_attention")
         cache.k_layers[layer_idx] = new_k
         cache.v_layers[layer_idx] = new_v
         return self.out_proj(out.reshape([b, 1, h]))
 
     def forward_prefill_chunk(self, x, cache, layer_idx, slot_ids,
                               start, seq_lens_new):
-        """One bounded chunk of a long prompt (serving tier, paged
-        cache only): write the chunk's K/V at logical positions
-        [start, start+c) of each slot, then attend the chunk's queries
-        over the slot's FULL paged context so far (earlier chunks +
-        this one, causal within the chunk).
+        """One bounded multi-token window per slot: write the window's
+        K/V at logical positions [start, start+c) of each slot, then
+        attend the window's queries over the slot's FULL cached context
+        so far (earlier tokens + this window, causal within it).
 
-        x: [b, c, h] chunk hiddens (right-padded to the chunk bucket);
-        start/seq_lens_new: [b] int32 — chunk offset and the total
-        cached length after this chunk (= start + true chunk length);
-        padded positions land on the trash page and padded queries'
-        outputs are discarded by the caller. The context gather is
-        static-shape ([pages_per_seq * page_size]) so every chunk in a
-        bucket shares one compiled program.
+        Two callers share this shape (ISSUE 16): the serving tier's
+        chunked prompt prefill, and the spec-decode VERIFY pass (c =
+        k+1 draft positions scored in one dispatch — the multi-token
+        ragged attention lives in ops/pallas/paged_attention.py as
+        `paged_attention_chunk`, Pallas kernel on TPU / XLA gather
+        elsewhere).
+
+        x: [b, c, h] window hiddens (right-padded to the bucket);
+        start/seq_lens_new: [b] int32 — window offset and the total
+        cached length after this window; positions past seq_lens_new
+        land on the trash page (paged) or are dropped (dense) and their
+        queries' outputs are discarded by the caller. The context
+        gather is static-shape so every window in a bucket shares one
+        compiled program.
         """
         import jax
         import jax.numpy as jnp
 
         from ..inference import kv_cache as _kv
         from ..ops._dispatch import nary
+        from ..ops.pallas.paged_attention import paged_attention_chunk
 
         b, c, h = x.shape
         nh, hd = self.num_heads, self.head_dim
         qkv = self.qkv(x).reshape([b, c, 3, nh, hd])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-        def step(qq, kk, vv, kp, vp, pt, sid, st, ln):
-            kp2, vp2 = _kv.paged_write_prefill(kp, vp, pt, sid, ln,
-                                               kk, vv, start=st)
-            kvh, num_pages, page_size, d = kp2.shape
-            grp = nh // kvh
-            rows = pt[sid]                       # [b, pages_per_seq]
-            L = rows.shape[1] * page_size
+        if cache.kind == "dense":
+            # dense verify path: ragged multi-token scatter + masked
+            # attention over the aligned cache
+            def dstep(qq, kk, vv, cl, st, ln):
+                cl2 = _kv.dense_write_chunk(cl, st, ln, kk, vv)
+                ctx_k, ctx_v = cl2[0], cl2[1]    # [b, nh, max_len, d]
+                L = ctx_k.shape[2]
+                s = jnp.einsum("bcnd,bnld->bncl",
+                               qq.astype(jnp.float32),
+                               ctx_k.astype(jnp.float32)) / (hd ** 0.5)
+                jpos = jnp.arange(L, dtype=jnp.int32)
+                ipos = st[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
+                mask = jpos[None, None, :] <= ipos[:, :, None]
+                s = jnp.where(mask[:, None], s, -jnp.inf)
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bncl,bnld->bncd", p,
+                               ctx_v.astype(jnp.float32))
+                return jnp.moveaxis(o, 1, 2).astype(qq.dtype), cl2
 
-            def densify(pool):
-                g = jnp.take(pool, rows, axis=1)     # [kvh, b, pp, ps, d]
-                return jnp.moveaxis(g, 1, 0).reshape(b, kvh, L, d)
+            out, new_l = nary(
+                dstep, [q, k, v, cache.layer(layer_idx), start,
+                        seq_lens_new],
+                "dense_prefill_chunk")
+            cache.set_layer(layer_idx, new_l)
+        elif getattr(cache, "quantized", False):
+            def qstep(qq, kk, vv, kp, vp, ksc, vsc, pt, sid, st, ln):
+                kp2, vp2, ks2, vs2 = _kv.paged_write_prefill_q8(
+                    kp, vp, ksc, vsc, pt, sid, ln, kk, vv, start=st)
+                o = paged_attention_chunk(qq, kp2, vp2, pt[sid], st,
+                                          k_scales=ks2, v_scales=vs2)
+                return o, kp2, vp2, ks2, vs2
 
-            ctx_k, ctx_v = densify(kp2), densify(vp2)
-            qg = jnp.moveaxis(qq, 1, 2).reshape(b, kvh, grp, c, d)
-            s = jnp.einsum("bhgcd,bhld->bhgcl",
-                           qg.astype(jnp.float32),
-                           ctx_k.astype(jnp.float32)) / (d ** 0.5)
-            # query i (abs pos st+i) sees ctx positions j <= st+i; the
-            # rest of the gathered window is stale/unwritten pool data
-            jpos = jnp.arange(L, dtype=jnp.int32)
-            ipos = st[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
-            mask = jpos[None, None, :] <= ipos[:, :, None]  # [b, c, L]
-            s = jnp.where(mask[:, None, None], s, -jnp.inf)
-            p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhgcl,bhld->bhgcd", p,
-                           ctx_v.astype(jnp.float32))
-            o = jnp.moveaxis(o.reshape(b, nh, c, d), 1, 2)
-            return o.astype(qq.dtype), kp2, vp2
+            out, new_k, new_v, new_ks, new_vs = nary(
+                qstep, [q, k, v, cache.k_layers[layer_idx],
+                        cache.v_layers[layer_idx],
+                        cache.k_scales[layer_idx],
+                        cache.v_scales[layer_idx], cache.page_tables,
+                        slot_ids, start, seq_lens_new],
+                "paged_prefill_chunk_q8")
+            cache.k_layers[layer_idx] = new_k
+            cache.v_layers[layer_idx] = new_v
+            cache.k_scales[layer_idx] = new_ks
+            cache.v_scales[layer_idx] = new_vs
+        else:
+            def step(qq, kk, vv, kp, vp, pt, sid, st, ln):
+                kp2, vp2 = _kv.paged_write_prefill(kp, vp, pt, sid, ln,
+                                                   kk, vv, start=st)
+                o = paged_attention_chunk(qq, kp2, vp2, pt[sid], st)
+                return o, kp2, vp2
 
-        out, new_k, new_v = nary(
-            step, [q, k, v, cache.k_layers[layer_idx],
-                   cache.v_layers[layer_idx], cache.page_tables,
-                   slot_ids, start, seq_lens_new],
-            "paged_prefill_chunk")
-        cache.k_layers[layer_idx] = new_k
-        cache.v_layers[layer_idx] = new_v
+            out, new_k, new_v = nary(
+                step, [q, k, v, cache.k_layers[layer_idx],
+                       cache.v_layers[layer_idx], cache.page_tables,
+                       slot_ids, start, seq_lens_new],
+                "paged_prefill_chunk")
+            cache.k_layers[layer_idx] = new_k
+            cache.v_layers[layer_idx] = new_v
         return self.out_proj(out.reshape([b, c, h]))
 
     def forward(self, x):
@@ -619,15 +678,18 @@ class GPTModel(nn.Layer):
 
     def prefill_chunk(self, input_ids, cache, slot_ids, start,
                       seq_lens_new):
-        """Chunked prompt pass (serving tier, paged cache): process one
-        bounded chunk of each slot's prompt at logical positions
-        [start, start+c), attending over the context cached so far.
+        """Multi-token cached pass: process one bounded window of each
+        slot's tokens at logical positions [start, start+c), attending
+        over the context cached so far. Serves both chunked prompt
+        prefill (serving tier) and the spec-decode verify pass (c =
+        k+1 draft positions, ISSUE 16); works over paged AND dense
+        caches (slot_ids is ignored for dense).
 
-        input_ids: [b, c] chunk tokens right-padded to the chunk
-        bucket; start/seq_lens_new: [b] int32 Tensors. Returns the
-        chunk hiddens [b, c, hidden] (caller gathers the last valid
-        position for the prefill-complete logits). The caller owns
-        advancing cache.seq_lens to seq_lens_new."""
+        input_ids: [b, c] window tokens right-padded to the bucket;
+        start/seq_lens_new: [b] int32 Tensors. Returns the window
+        hiddens [b, c, hidden] (caller gathers the last valid
+        position). The caller owns advancing cache.seq_lens to
+        seq_lens_new."""
         self._check_decodable()
         b, c = input_ids.shape
         pos = start.unsqueeze(1) + C.arange(0, c, dtype="int32") \

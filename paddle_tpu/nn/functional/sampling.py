@@ -15,15 +15,34 @@ import jax.numpy as jnp
 from ...ops._dispatch import ensure_tensor, nary, unary
 
 __all__ = ["sample_logits", "sample_logits_per_slot", "per_slot_keys",
-           "greedy_sample", "top_k_top_p_sampling"]
+           "greedy_sample", "top_k_top_p_sampling", "truncated_probs",
+           "spec_accept_greedy", "spec_accept_sampled",
+           "spec_draft_keys"]
 
 
 def _truncate_logits(lf, temperature, top_k, top_p):
     """Temperature + top-k + top-p truncation over fp32 logits [..., v]
-    (shared by the single-key and per-slot samplers)."""
+    (shared by the single-key and per-slot samplers, and — via
+    `truncated_probs` — by the speculative acceptance correction).
+
+    Tie-break rule: truncation is THRESHOLD-based, not count-based.
+    top-k keeps every logit >= the k-th largest VALUE, so ties at the
+    boundary all survive (more than k tokens can remain); ``top_k >=
+    vocab`` keeps everything (the threshold is the global min).
+    top-p keeps every token whose exclusive prefix mass (the mass of
+    strictly-greater-probability tokens, ties ordered by the
+    descending sort) is < p — the boundary token that crosses p is
+    kept, and tokens TIED with the boundary token's logit also
+    survive (the cut compares against the smallest kept logit value).
+    `p` landing exactly on a cumulative-probability edge keeps the
+    prefix summing to exactly p (`before < p` is strict), never an
+    empty set (the top token's exclusive prefix mass is 0 < p)."""
     lf = lf / float(temperature)
     if top_k and top_k > 0:
-        kth = jax.lax.top_k(lf, int(top_k))[0][..., -1:]
+        # clamp: lax.top_k rejects k > vocab, and k == vocab already
+        # keeps everything (the threshold is the global min)
+        kk = min(int(top_k), lf.shape[-1])
+        kth = jax.lax.top_k(lf, kk)[0][..., -1:]
         lf = jnp.where(lf < kth, -jnp.inf, lf)
     if top_p < 1.0:
         sort = jnp.sort(lf, axis=-1)[..., ::-1]              # descending
@@ -90,6 +109,108 @@ def sample_logits_per_slot(logits, seeds, positions, temperature=1.0,
     return jax.vmap(
         lambda k, l: jax.random.categorical(k, l)
     )(keys, lf).astype(jnp.int32)
+
+
+def truncated_probs(logits, temperature=1.0, top_k=0, top_p=1.0):
+    """fp32 probabilities after the SAME temperature/top-k/top-p
+    truncation `sample_logits` applies before its categorical draw.
+
+    The speculative-decoding contract hangs off this (ISSUE 16): the
+    acceptance test compares target and draft probabilities under
+    IDENTICAL truncation, so accepted-or-corrected tokens are
+    distributed exactly as a plain truncated sample from the target."""
+    lf = _truncate_logits(logits.astype(jnp.float32), temperature,
+                          top_k, top_p)
+    return jax.nn.softmax(lf, axis=-1)
+
+
+def spec_draft_keys(seeds, positions, j):
+    """Per-slot PRNG keys for the j-th proposed draft token of one
+    spec-decode dispatch: fold_in(fold_in(per_slot_key, 3), j). Tag 3
+    separates the draft-proposal stream from the acceptance streams
+    (tags 1/2 in `spec_accept_sampled`) hanging off the same
+    (seed, context-length) base key."""
+    base = per_slot_keys(seeds, positions)
+    return jax.vmap(
+        lambda k: jax.random.fold_in(jax.random.fold_in(k, 3), j)
+    )(base)
+
+
+def spec_accept_greedy(tgt_logits, proposed):
+    """Greedy accept/rollback: `proposed` [b, k] draft tokens vs the
+    target's argmax over `tgt_logits` [b, k+1, vocab] (the verify
+    logits — row j scored the context extended with proposed[:, :j]).
+
+    Returns (accepted [b] int32, next_token [b] int32): accepted = the
+    longest matching prefix length a (0..k), next_token = the target's
+    argmax at position a — i.e. the correction token on a mismatch, the
+    bonus token on a full accept. Bit-identical to plain greedy decode
+    by construction: every emitted token is a target argmax over
+    exactly the context plain decode would have."""
+    tgt = jnp.argmax(tgt_logits.astype(jnp.float32),
+                     axis=-1).astype(jnp.int32)            # [b, k+1]
+    match = (proposed == tgt[:, :-1]).astype(jnp.int32)
+    a = jnp.cumprod(match, axis=1).sum(axis=1) \
+        .astype(jnp.int32)                                 # [b]
+    nxt = jnp.take_along_axis(tgt, a[:, None], axis=1)[:, 0]
+    return a, nxt
+
+
+def spec_accept_sampled(tgt_probs, drf_probs, proposed, seeds,
+                        positions):
+    """Lossless rejection-sampling acceptance (speculative decoding).
+
+    tgt_probs: [b, k+1, vocab] target `truncated_probs` at the k+1
+    verify positions; drf_probs: [b, k, vocab] draft `truncated_probs`
+    the proposals were drawn from (SAME truncation params); proposed:
+    [b, k] draft tokens; seeds/positions: per-slot RNG identity
+    (positions = the pre-dispatch context length, so each dispatch of
+    a slot folds a fresh base key).
+
+    Token j is accepted iff u_j * q(d_j) <= p(d_j) (u_j uniform on the
+    tag-1 stream); on the first rejection at index a the replacement is
+    drawn from normalize(max(p_a - q_a, 0)) (tag-2 stream), and a full
+    accept draws the bonus token from p_k — the standard argument makes
+    every emitted token exactly target-distributed regardless of draft
+    quality. Returns (accepted [b], next_token [b]).
+
+    Note the stream shape: plain decode keys every token by its own
+    (seed, position); spec decode keys a whole dispatch by (seed,
+    start-position). Both are deterministic per request and
+    target-distributed, but the sampled token SEQUENCES differ — the
+    losslessness guarantee is distributional, not bit-replay (greedy
+    is bit-identical; see `spec_accept_greedy`)."""
+    b, k1, _ = tgt_probs.shape
+    k = k1 - 1
+    base = per_slot_keys(seeds, positions)
+    u_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, 1))(base)
+    r_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, 2))(base)
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(u_keys)
+    p_sel = jnp.take_along_axis(tgt_probs[:, :k], proposed[..., None],
+                                axis=-1)[..., 0]           # [b, k]
+    q_sel = jnp.take_along_axis(drf_probs, proposed[..., None],
+                                axis=-1)[..., 0]
+    # p > 0 guard: a proposal outside the target's truncated support is
+    # always rejected, even if the draft's support was wider
+    acc = (u * q_sel <= p_sel) & (p_sel > 0)
+    a = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1) \
+        .astype(jnp.int32)
+    p_row = jnp.take_along_axis(tgt_probs, a[:, None, None],
+                                axis=1)[:, 0]              # [b, vocab]
+    q_row = jnp.take_along_axis(drf_probs,
+                                jnp.minimum(a, k - 1)[:, None, None],
+                                axis=1)[:, 0]
+    q_row = jnp.where((a < k)[:, None], q_row, 0.0)  # full accept: p_k
+    res = jnp.maximum(p_row - q_row, 0.0)
+    norm = jnp.sum(res, axis=-1, keepdims=True)
+    # all-zero residual (target ⊂ draft and every residual clipped):
+    # fall back to the target row itself — still target-distributed
+    res = jnp.where(norm > 0, res / norm, p_row)
+    lr = jnp.where(res > 0, jnp.log(jnp.maximum(res, 1e-38)), -jnp.inf)
+    nxt = jax.vmap(
+        lambda kk, l: jax.random.categorical(kk, l)
+    )(r_keys, lr).astype(jnp.int32)
+    return a, nxt
 
 
 def greedy_sample(logits, name=None):

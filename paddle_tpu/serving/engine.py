@@ -42,7 +42,7 @@ import numpy as np
 
 from ..inference.kv_cache import PagedKVCache
 from ..jit.decode_step import (ChunkPrefillStep, ServeDecodeStep,
-                               _split_state)
+                               ServeSpecDecodeStep, _split_state)
 from ..jit.train_step import _tree_data
 from ..observability import SLOTracker, Tracer
 from .metrics import ServingMetrics
@@ -58,6 +58,7 @@ class ServingEngine:
                  prefill_chunks_per_step=1, prefill_batch=4,
                  decode_burst=1, do_sample=False, top_k=0, top_p=1.0,
                  temperature=1.0, compiled=True, cache_dtype=None,
+                 kv_quant=None, draft_model=None, spec_k=4,
                  donate=True, admit_watermark="auto",
                  clock=time.perf_counter,
                  trace=True, trace_capacity=256, exemplar_capacity=32,
@@ -104,7 +105,30 @@ class ServingEngine:
         self.num_pages = int(num_pages or
                              1 + self.max_slots * self.pages_per_seq)
         self._params = list(model.parameters())
+        # int8 paged KV (ISSUE 16): ~2x the resident tokens per page of
+        # HBM (per-row scales, dequant fused into the attention gather)
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"unknown KV quant mode {kv_quant!r}")
+        self.kv_quant = kv_quant
+        # speculative decoding (ISSUE 16): the decode program becomes
+        # draft-k-propose / verify-once with variable per-slot yield
+        self.draft_model = draft_model
+        self.spec_k = int(spec_k)
         self.cache = self._make_cache()
+        if draft_model is not None:
+            draft_model.gpt._check_decodable()
+            if draft_model.config.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "draft model vocab_size "
+                    f"{draft_model.config.vocab_size} != target "
+                    f"{cfg.vocab_size} (proposals must be target ids)")
+            if self.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            self._draft_params = list(draft_model.parameters())
+            self.draft_cache = self._make_draft_cache()
+        else:
+            self._draft_params = []
+            self.draft_cache = None
         # live-buffer attribution (ISSUE 14): a serving-only process
         # has no train step to claim the model weights
         from ..observability.memory import live_registry
@@ -139,8 +163,15 @@ class ServingEngine:
         self.scheduler = RequestScheduler(
             self.cache, self.metrics, admit_watermark=admit_watermark,
             tracer=self.tracer)
+        # the "auto" admission watermark provisions free pages for one
+        # dispatch's worth of growth per live slot
+        self.scheduler.token_lookahead = (
+            self.spec_k + 1 if draft_model is not None
+            else self.decode_burst)
         self.prefill_step = ChunkPrefillStep(self, donate_cache=donate)
         self.decode_step = ServeDecodeStep(self, donate_cache=donate)
+        self.spec_step = (ServeSpecDecodeStep(self, donate_cache=donate)
+                          if draft_model is not None else None)
         bkts, b = [], 8
         while b < self.chunk_size:
             bkts.append(b)
@@ -148,6 +179,9 @@ class ServingEngine:
         self.chunk_buckets = tuple(bkts) + (self.chunk_size,)
         self._buffers, _ = _split_state(
             "paged", _tree_data(self.cache.state()))
+        if self.draft_cache is not None:
+            self._buffers["draft"], _ = _split_state(
+                "paged", _tree_data(self.draft_cache.state()))
         # per-slot host mirrors refreshed every step (plain input data)
         self._tokens = np.zeros((self.max_slots,), np.int32)
         self._seeds = np.zeros((self.max_slots,), np.uint32)
@@ -158,6 +192,19 @@ class ServingEngine:
         nh = cfg.num_attention_heads
         return PagedKVCache(
             cfg.num_layers, nh, cfg.hidden_size // nh,
+            num_pages=self.num_pages, page_size=self.page_size,
+            max_slots=self.max_slots, pages_per_seq=self.pages_per_seq,
+            dtype=self._cache_dtype, quant=self.kv_quant)
+
+    def _make_draft_cache(self):
+        """Draft-model pools over the TARGET's slot/page geometry (page
+        tables are shared; only the pools differ). Un-quantized: the
+        draft's pools are small and a noisy draft only costs accept
+        rate."""
+        dcfg = self.draft_model.config
+        nh = dcfg.num_attention_heads
+        return PagedKVCache(
+            dcfg.num_layers, nh, dcfg.hidden_size // nh,
             num_pages=self.num_pages, page_size=self.page_size,
             max_slots=self.max_slots, pages_per_seq=self.pages_per_seq,
             dtype=self._cache_dtype)
@@ -263,9 +310,14 @@ class ServingEngine:
         """Retrace probe surface: decode must stay at ONE trace across
         arbitrary admit/preempt/retire churn; prefill at most one trace
         per chunk bucket."""
+        # under speculative decoding the decode program IS the spec
+        # step — report it under the same keys so retrace probes keep
+        # asserting "one decode trace" unchanged
+        dstep = self.spec_step if self.spec_step is not None \
+            else self.decode_step
         return {
-            "decode_traces": self.decode_step.trace_count,
-            "decode_executables": self.decode_step.cache_size(),
+            "decode_traces": dstep.trace_count,
+            "decode_executables": dstep.cache_size(),
             "prefill_traces": self.prefill_step.trace_count,
             "prefill_executables": self.prefill_step.cache_size(),
             "chunk_buckets": list(self.chunk_buckets),
@@ -281,9 +333,12 @@ class ServingEngine:
         return self.metrics.expose()
 
     def retrace_stats(self) -> dict:
-        """Sentinel receipts for both serving step programs."""
-        return {"decode": self.decode_step.retrace_stats(),
-                "prefill": self.prefill_step.retrace_stats()}
+        """Sentinel receipts for the serving step programs."""
+        out = {"decode": self.decode_step.retrace_stats(),
+               "prefill": self.prefill_step.retrace_stats()}
+        if self.spec_step is not None:
+            out["spec"] = self.spec_step.retrace_stats()
+        return out
 
     def reset_metrics(self):
         """Fresh counters (e.g. after a compile warmup run) — the bench
@@ -317,6 +372,9 @@ class ServingEngine:
     # -- step mechanics ---------------------------------------------------
     def _param_data(self):
         return [p._data for p in self._params]
+
+    def _draft_param_data(self):
+        return [p._data for p in self._draft_params]
 
     def _meta(self):
         c = self.cache
@@ -372,7 +430,8 @@ class ServingEngine:
         try:
             ids_next, _logits, buffers, meta = self.prefill_step(
                 self._param_data(), self._buffers, self._meta(),
-                ids, slot_ids, start, lens_new, seeds)
+                ids, slot_ids, start, lens_new, seeds,
+                self._draft_param_data())
             self._commit(buffers, meta)
             for sp in spans:
                 self.tracer.end(sp)
@@ -400,6 +459,8 @@ class ServingEngine:
             self._flush_retired()
 
     def _run_decode(self) -> bool:
+        if self.draft_model is not None:
+            return self._run_spec_decode()
         sched = self.scheduler
         # highest priority first so page pressure lands on the lowest
         order = sorted(sched.decode_slots(),
@@ -460,6 +521,100 @@ class ServingEngine:
                     token = int(tok[slot])
                     self._tokens[slot] = token
                     emitted[slot] += 1
+                    self._emit(handle, token)
+        finally:
+            for sp in dspans.values():
+                self.tracer.end(sp, error=True)
+            for slot, sp in sspans.items():
+                self.tracer.end(sp, tokens=emitted[slot])
+            self._flush_retired()
+        return True
+
+    def _run_spec_decode(self) -> bool:
+        """Speculative decode dispatch (ISSUE 16): one compiled
+        ServeSpecDecodeStep call yields a VARIABLE 1..spec_k+1 tokens
+        per running slot — the draft proposes, the target verifies all
+        positions in one multi-token attention call, acceptance is
+        traced bookkeeping. The scheduler sees only the yield: page
+        lookahead covers the worst case (k+1 tokens, capped per slot
+        by the request's remaining budget and the engine window), and
+        each slot's `caps` bound keeps acceptance from outrunning its
+        reserved pages. Spec health lands on the metrics registry
+        (serving.spec.accept_rate / .tokens_per_dispatch) and on the
+        per-request decode_burst spans (proposed vs accepted)."""
+        sched = self.scheduler
+        order = sorted(sched.decode_slots(),
+                       key=lambda s: sched._key(sched.running[s]))
+        kk = self.spec_k
+        live, ahead = [], {}
+        for slot in order:
+            h = sched.running.get(slot)
+            if h is None or h.state is not RequestState.RUNNING:
+                continue   # preempted as a victim earlier in this loop
+            remaining = h.request.max_new_tokens - len(h.output_tokens)
+            a = max(1, min(kk + 1, remaining,
+                           self.max_len - sched._context_len(h)))
+            if sched.ensure_token_capacity(slot, lookahead=a):
+                live.append(slot)
+                ahead[slot] = a
+        live = [s for s in live
+                if sched.running.get(s) is not None
+                and sched.running[s].state is RequestState.RUNNING]
+        if not live:
+            return False
+        # per-slot acceptance cap = context + approved lookahead; non-
+        # participating slots cap at their current length (zero yield)
+        caps = np.array(self.cache._host("seq_lens"), np.int32)
+        for slot in live:
+            caps[slot] = (sched._context_len(sched.running[slot])
+                          + ahead[slot])
+        dspans = {slot: self.tracer.begin(
+            "decode_burst", parent=sched.running[slot]._span,
+            slot=slot, k=kk + 1, batch=len(live), spec=True)
+            for slot in live}
+        sspans = {}
+        emitted = dict.fromkeys(live, 0)
+        accepted = dict.fromkeys(live, 0)
+        try:
+            out, counts, _logits, buffers, meta = self.spec_step(
+                self._param_data(), self._buffers, self._meta(),
+                self._draft_param_data(), self._tokens, self._seeds,
+                caps)
+            self._commit(buffers, meta)
+            # ONE host sync for the whole dispatch: tokens + yields
+            toks = np.asarray(out)
+            counts_h = np.asarray(counts)
+            self.metrics.decode_steps += 1
+            # `proposed` counts only cap-USABLE proposals (ahead-1, not
+            # spec_k): a request's last dispatch may have room for one
+            # more token, and charging the full k would read as
+            # rejection — the accept-rate gauge must measure draft
+            # quality, not end-of-request clamping
+            usable = {slot: max(ahead[slot] - 1, 0) for slot in live}
+            for slot in live:
+                c = int(counts_h[slot])
+                self.metrics.spec_dispatches += 1
+                self.metrics.spec_proposed += usable[slot]
+                accepted[slot] = max(c - 1, 0)
+                self.metrics.spec_accepted += accepted[slot]
+            # span-attributed yield: the burst span covers the sync
+            for slot, sp in dspans.items():
+                self.tracer.end(sp, proposed=usable[slot],
+                                accepted=accepted[slot],
+                                yielded=int(counts_h[slot]))
+            sspans = {slot: self.tracer.begin(
+                "stream_deliver", parent=sched.running[slot]._span)
+                for slot in live if sched.running.get(slot) is not None}
+            for slot in live:
+                handle = sched.running.get(slot)
+                for t in range(int(counts_h[slot])):
+                    if (handle is None or handle.state
+                            is not RequestState.RUNNING):
+                        break   # retired earlier in this dispatch
+                    token = int(toks[slot, t])
+                    self._tokens[slot] = token
+                    emitted[slot] += 1
+                    self.metrics.spec_emitted += 1
                     self._emit(handle, token)
         finally:
             for sp in dspans.values():
@@ -614,6 +769,12 @@ class ServingEngine:
         live geometry (params + KV pools + host metadata) — the AOT
         buffer-assignment view of what one decode burst reserves. See
         `_Step.memory_profile`."""
+        if self.spec_step is not None:
+            caps = np.asarray(self.cache._host("seq_lens"), np.int32)
+            return self.spec_step.memory_profile(
+                self._param_data(), self._buffers, self._meta(),
+                self._draft_param_data(), self._tokens, self._seeds,
+                caps, top_k=top_k, publish=publish)
         return self.decode_step.memory_profile(
             self._param_data(), self._buffers, self._meta(),
             self._tokens, self._seeds, top_k=top_k, publish=publish)
@@ -667,6 +828,10 @@ class ServingEngine:
         self.scheduler.cache = self.cache
         self._buffers, _ = _split_state(
             "paged", _tree_data(self.cache.state()))
+        if self.draft_model is not None:
+            self.draft_cache = self._make_draft_cache()
+            self._buffers["draft"], _ = _split_state(
+                "paged", _tree_data(self.draft_cache.state()))
 
     # -- introspection ----------------------------------------------------
     def leak_check(self) -> dict:

@@ -32,7 +32,9 @@ class ServingMetrics:
     # in place); expose() publishes them through lazy gauges
     _COUNTERS = ("submitted", "admitted", "resumed", "finished",
                  "preemptions", "evicted_pages", "prefill_chunks",
-                 "decode_steps", "generated_tokens")
+                 "decode_steps", "generated_tokens",
+                 "spec_dispatches", "spec_proposed", "spec_accepted",
+                 "spec_emitted")
     _GAUGES = ("queue_depth", "running")
 
     def __init__(self, clock=time.perf_counter, registry=None,
@@ -52,6 +54,14 @@ class ServingMetrics:
         self.prefill_chunks = 0
         self.decode_steps = 0
         self.generated_tokens = 0
+        # speculative decoding (ISSUE 16): per-slot-dispatch accounting
+        # — proposed counts draft tokens scored, accepted the ones that
+        # survived verification, emitted every token the spec path
+        # delivered (accepted + the correction/bonus token)
+        self.spec_dispatches = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
         # gauges (refreshed every engine step)
         self.queue_depth = 0
         self.running = 0
@@ -77,6 +87,14 @@ class ServingMetrics:
             lambda: round(self.generated_tokens
                           / max(self.clock() - self.start_time, 1e-9),
                           2))
+        # the two speculative-decoding health gauges (ISSUE 16): how
+        # good the draft is, and what each target dispatch yields
+        self.registry.gauge("serving.spec.accept_rate").set_fn(
+            lambda: round(self.spec_accepted
+                          / max(self.spec_proposed, 1), 4))
+        self.registry.gauge("serving.spec.tokens_per_dispatch").set_fn(
+            lambda: round(self.spec_emitted
+                          / max(self.spec_dispatches, 1), 4))
 
     # -- event feeds ------------------------------------------------------
     def on_submit(self):
@@ -133,6 +151,14 @@ class ServingMetrics:
             "prefill_chunks": self.prefill_chunks,
             "decode_steps": self.decode_steps,
             "generated_tokens": self.generated_tokens,
+            "spec_dispatches": self.spec_dispatches,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_emitted": self.spec_emitted,
+            "spec_accept_rate": round(
+                self.spec_accepted / max(self.spec_proposed, 1), 4),
+            "spec_tokens_per_dispatch": round(
+                self.spec_emitted / max(self.spec_dispatches, 1), 4),
             "queue_depth": self.queue_depth,
             "running": self.running,
             "elapsed_s": round(elapsed, 4),

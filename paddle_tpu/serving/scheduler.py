@@ -42,6 +42,10 @@ class RequestScheduler:
         self.running: dict[int, RequestHandle] = {}   # slot -> handle
         self.admit_watermark = admit_watermark
         self.tracer = tracer            # set by the engine (ISSUE 13)
+        # tokens one decode dispatch may append per slot (the engine
+        # sets it: decode_burst, or spec_k+1 under speculative
+        # decoding) — the "auto" admission watermark scales with it
+        self.token_lookahead = 1
 
     # -- queue ------------------------------------------------------------
     @staticmethod
@@ -73,7 +77,14 @@ class RequestScheduler:
     # -- admission --------------------------------------------------------
     def _watermark(self) -> int:
         if self.admit_watermark == "auto":
-            return len(self.decode_slots())
+            # one dispatch can grow each decode-active sequence by
+            # `token_lookahead` tokens — keep enough free pages that
+            # every live slot can take its next dispatch without an
+            # instant preemption (== the old one-page-per-slot rule
+            # whenever the lookahead fits a page, i.e. plain decode)
+            per_slot = -(-max(1, int(self.token_lookahead))
+                         // self.cache.page_size)
+            return len(self.decode_slots()) * per_slot
         return int(self.admit_watermark)
 
     def admit(self) -> list[RequestHandle]:

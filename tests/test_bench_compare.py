@@ -321,3 +321,71 @@ class TestNumericsFamily:
         del new["serving"]
         res = bc.compare(self._nrec(), new)
         assert "serving.ttft_p99_s" not in res["regressions"]
+
+
+class TestSpecFamily:
+    """ISSUE 16 satellite: the `spec.*` metric family —
+    tokens_per_dispatch gates as a LOWER bound (higher is better, 5%
+    tolerance), accept_rate is informational only, and the spec
+    tokens/s/user speedup rides the existing tok_s gate."""
+
+    @staticmethod
+    def _srec(tpd=4.7, accept=1.0, speedup=1.9):
+        rec = _record()
+        rec["spec"] = {
+            "plain": {"tok_s_user": 1600.0},
+            "spec": {"tok_s_user": 1600.0 * speedup,
+                     "accept_rate": accept,
+                     "tokens_per_dispatch": tpd},
+            "tok_s_user_speedup": speedup,
+        }
+        return rec
+
+    def _row(self, res, suffix):
+        rows = [r for r in res["rows"] if r["metric"].endswith(suffix)]
+        assert rows, res["rows"]
+        return rows[0]
+
+    def test_families_detected(self, bc):
+        m = bc.extract_metrics(self._srec())
+        assert m["spec.spec.tokens_per_dispatch"] == 4.7
+        assert m["spec.spec.accept_rate"] == 1.0
+        assert m["spec.tok_s_user_speedup"] == 1.9
+        assert bc._family("tokens_per_dispatch") == "spec_yield"
+        assert bc._family("accept_rate") == "spec_accept"
+
+    def test_identical_records_pass(self, bc):
+        res = bc.compare(self._srec(), self._srec())
+        assert res["status"] == "pass"
+        assert self._row(res, "tokens_per_dispatch")["verdict"] == "ok"
+
+    def test_tokens_per_dispatch_drop_regresses(self, bc):
+        # the structural yield gate: 4.7 -> 3.0 is a spec regression
+        res = bc.compare(self._srec(), self._srec(tpd=3.0))
+        assert res["status"] == "regress"
+        assert "spec.spec.tokens_per_dispatch" in res["regressions"]
+
+    def test_tokens_per_dispatch_is_lower_bound_only(self, bc):
+        # direction-aware: a RISE in yield is an improvement, not a
+        # regression (higher is better)
+        res = bc.compare(self._srec(tpd=3.0), self._srec(tpd=4.7))
+        row = self._row(res, "tokens_per_dispatch")
+        assert row["verdict"] == "improved"
+        assert res["status"] == "pass"
+
+    def test_accept_rate_never_gates(self, bc):
+        # both directions: accept rate belongs to the draft/model
+        # pair — info rows, never regressions
+        for new in (0.3, 1.0):
+            res = bc.compare(self._srec(accept=0.8),
+                             self._srec(accept=new))
+            row = self._row(res, "accept_rate")
+            assert row["verdict"] == "info"
+            assert "spec.spec.accept_rate" not in res["regressions"]
+
+    def test_speedup_rides_tok_s_gate(self, bc):
+        # the serve-lane A/B speedup carries "speedup" -> tok_s family
+        # (higher is better): halving it fails the gate
+        res = bc.compare(self._srec(speedup=1.9),
+                         self._srec(speedup=0.9))
+        assert "spec.tok_s_user_speedup" in res["regressions"]

@@ -1,0 +1,392 @@
+"""Speculative decoding + int8 paged KV tests (ISSUE 16).
+
+The losslessness contract, checked at every layer:
+
+* greedy spec output is BIT-IDENTICAL to plain greedy decode on the
+  dense, paged and int8-paged caches — for any draft, including a
+  deliberately-mismatched random one;
+* sampled spec output is DISTRIBUTION-equal to the target: a
+  Monte-Carlo check of `spec_accept_sampled` against the analytic
+  target distribution, plus fixed-seed token histograms engine-vs-
+  engine on all three cache kinds;
+* the KV "rewind" after rejection is pure bookkeeping: pool_stats
+  invariants hold across heavy rejection churn and slots drain clean;
+* int8-KV spec logits match fp spec logits within the documented
+  tolerance;
+* the retrace sentinel stays strict-clean while accept counts vary
+  call to call (variable yield must be data, never a shape).
+
+Plus the sampling-boundary satellites: top-p exactly on a cumulative-
+probability edge and top-k >= vocab.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.decode_step import GenerationEngine
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+
+def tiny_model(seed=0, **over):
+    paddle.seed(seed)
+    kw = dict(vocab_size=97, hidden_size=32, num_layers=2,
+              num_attention_heads=4, max_position_embeddings=96,
+              hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    kw.update(over)
+    m = GPTForCausalLM(GPTConfig(**kw))
+    m.eval()
+    return m
+
+
+def tiny_draft(seed=7, **over):
+    """An INDEPENDENT small draft — different widths, different random
+    weights. Losslessness must not depend on draft quality."""
+    over.setdefault("hidden_size", 16)
+    over.setdefault("num_layers", 1)
+    over.setdefault("num_attention_heads", 2)
+    return tiny_model(seed=seed, **over)
+
+
+KINDS = [("dense", None), ("paged", None), ("paged", "int8")]
+
+
+def _mk_engine(model, kind, quant, draft=None, k=3, **kw):
+    extra = {} if quant is None else {"kv_quant": quant}
+    if draft is not None:
+        extra.update(draft_model=draft, spec_k=k)
+    return GenerationEngine(model, kind=kind, batch=2, max_len=64,
+                            **extra, **kw)
+
+
+class TestGreedyParity:
+    @pytest.mark.parametrize("kind,quant", KINDS)
+    def test_bit_identical_to_plain_decode(self, kind, quant):
+        tgt, drf = tiny_model(), tiny_draft()
+        ids = np.random.default_rng(0).integers(0, 97, (2, 11))
+        ref = _mk_engine(tgt, kind, quant).generate(ids, 17).numpy()
+        eng = _mk_engine(tgt, kind, quant, draft=drf)
+        out = eng.generate(ids, 17).numpy()
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+    def test_logits_rows_are_the_emitted_tokens_distributions(self):
+        # greedy: emitted token t must be argmax of returned logits
+        # row t — i.e. logits stay aligned through accept/rollback
+        tgt, drf = tiny_model(), tiny_draft()
+        ids = np.random.default_rng(1).integers(0, 97, (2, 9))
+        eng = _mk_engine(tgt, "paged", None, draft=drf)
+        out, lg = eng.generate(ids, 11, return_logits=True)
+        out, lg = np.asarray(out.numpy()), np.asarray(lg.numpy())
+        assert lg.shape == (2, 11, 97)
+        np.testing.assert_array_equal(out, lg.argmax(-1))
+
+    def test_strong_draft_accepts_everything(self):
+        # draft == target: every proposal must be accepted, so the
+        # whole generation takes ceil((mnt-1)/(k+1)) spec dispatches
+        tgt = tiny_model()
+        eng = _mk_engine(tgt, "paged", None, draft=tgt, k=3)
+        ids = np.random.default_rng(2).integers(0, 97, (2, 7))
+        ref = _mk_engine(tgt, "paged", None).generate(ids, 13).numpy()
+        out = eng.generate(ids, 13).numpy()
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+        d = eng.spec_step._sentinel.stats()["calls"]
+        assert d == -(-(13 - 1) // (3 + 1))   # 3 dispatches, not 12
+
+
+class TestRetraceSentinel:
+    def test_variable_accept_counts_one_executable(self):
+        tgt, drf = tiny_model(), tiny_draft()
+        eng = _mk_engine(tgt, "paged", "int8", draft=drf)
+        rng = np.random.default_rng(3)
+        for mnt in (5, 9, 16):
+            eng.generate(rng.integers(0, 97, (2, 8)), mnt)
+        assert eng.spec_step.trace_count == 1
+        st = eng.spec_step.retrace_stats()
+        assert st["unexpected"] == 0, st
+        assert st["signatures"] == 1, st
+
+
+class TestSampledDistribution:
+    def test_rejection_sampling_matches_target_analytically(self):
+        # Monte-Carlo over seeds: the (accept | correct) output of
+        # spec_accept_sampled must be distributed as the TARGET row,
+        # for a draft that disagrees with it substantially. The
+        # function is batched, so all n trials run as ONE call with
+        # the trial index as the batch dimension (each row gets its
+        # own seed, i.e. its own independent RNG stream).
+        from paddle_tpu.nn.functional.sampling import (
+            spec_accept_sampled, truncated_probs)
+
+        v, k, n = 7, 2, 4000
+        rng = np.random.default_rng(0)
+        p1 = truncated_probs(jnp.asarray(
+            rng.standard_normal((1, k + 1, v)), jnp.float32))
+        q1 = truncated_probs(jnp.asarray(
+            rng.standard_normal((1, k, v)), jnp.float32))
+        p = jnp.broadcast_to(p1, (n, k + 1, v))
+        q = jnp.broadcast_to(q1, (n, k, v))
+        seeds = jnp.arange(n, dtype=jnp.uint32)
+
+        # draw each trial's proposals from q with per-trial streams,
+        # then accept/correct — exactly what the spec step does
+        def draw(j):
+            keys = jax.vmap(jax.random.PRNGKey)(seeds * 7 + 11 + j)
+            return jax.vmap(jax.random.categorical)(
+                keys, jnp.broadcast_to(jnp.log(q1[0, j]), (n, v)))
+
+        prop = jnp.stack([draw(j) for j in range(k)], 1) \
+            .astype(jnp.int32)
+        a, nxt = spec_accept_sampled(p, q, prop, seeds,
+                                     jnp.zeros((n,), jnp.uint32))
+        # the FIRST emitted token per trial: prop[:,0] if a>0 else
+        # the correction — must be ~ p[0]
+        first = np.asarray(jnp.where(a > 0, prop[:, 0], nxt))
+        emp = np.bincount(first, minlength=v) / n
+        ref = np.asarray(p1[0, 0])
+        tv = 0.5 * np.abs(emp - ref).sum()
+        assert tv < 0.05, (tv, emp, ref)
+
+    @pytest.mark.parametrize("kind,quant", KINDS)
+    def test_engine_token_histograms_match_plain(self, kind, quant):
+        # fixed-seed histograms: the first spec-emitted token (position
+        # 1) over many seeds vs the plain sampled engine's. Same
+        # PrefillStep stream means token 0 is identical, so position 1
+        # compares like-for-like conditionals.
+        tgt = tiny_model(vocab_size=13)
+        drf = tiny_draft(vocab_size=13)
+        ids = np.random.default_rng(4).integers(0, 13, (2, 6))
+        skw = dict(do_sample=True, temperature=0.9, top_k=8, top_p=0.9)
+        plain = _mk_engine(tgt, kind, quant, **skw)
+        spec = _mk_engine(tgt, kind, quant, draft=drf, **skw)
+        n = 150
+        hp = np.zeros((13,), np.int64)
+        hs = np.zeros((13,), np.int64)
+        for s in range(n):
+            p = np.asarray(plain.generate(ids, 2, seed=s).numpy())
+            sp = np.asarray(spec.generate(ids, 2, seed=s).numpy())
+            np.testing.assert_array_equal(p[:, 0], sp[:, 0])
+            hp += np.bincount(p[:, 1], minlength=13)
+            hs += np.bincount(sp[:, 1], minlength=13)
+        tv = 0.5 * np.abs(hp / hp.sum() - hs / hs.sum()).sum()
+        assert tv < 0.12, (tv, hp, hs)
+
+
+class TestKVRewindInvariants:
+    def test_pool_stats_stable_across_rejection_churn(self):
+        tgt, drf = tiny_model(), tiny_draft()
+        eng = _mk_engine(tgt, "paged", "int8", draft=drf)
+        ids = np.random.default_rng(5).integers(0, 97, (2, 9))
+        base = eng.cache.pool_stats()
+        assert base["kv_dtype"] == "int8"
+        for _ in range(3):
+            eng.generate(ids, 14)
+            st = eng.cache.pool_stats()
+            # every page back in the pool, none leaked to rollbacks
+            assert st["used_pages"] + st["free_pages"] \
+                == st["total_pages"]
+            assert st["used_pages"] == 0
+            assert st["free_pages"] == base["free_pages"]
+        # draft pool drains too (shared page-table geometry)
+        dst = eng.draft_cache.pool_stats()
+        assert dst["used_pages"] + dst["free_pages"] \
+            == dst["total_pages"]
+
+    def test_failed_generate_rebuilds_both_caches(self):
+        tgt, drf = tiny_model(), tiny_draft()
+        eng = _mk_engine(tgt, "paged", None, draft=drf)
+        ids = np.random.default_rng(6).integers(0, 97, (2, 8))
+        c0, d0 = eng.cache, eng.draft_cache
+        with pytest.raises(ValueError):
+            eng.generate(ids, 1000)   # exceeds max_len
+        # donated-buffer recovery replaces only on mid-loop failure;
+        # the capacity check fires before any dispatch
+        assert eng.cache is c0 and eng.draft_cache is d0
+        out = eng.generate(ids, 9)
+        assert np.asarray(out.numpy()).shape == (2, 9)
+
+
+class TestEngineReuse:
+    """Engine reuse must be deterministic: every compiled step indexes
+    the batch as row i == slot i, so the free-all/reallocate cycle at
+    the top of each generate() call has to hand slots back in identity
+    order. A LIFO free list permuted them on the SECOND call, silently
+    crossing rows between sequences (and driving the spec loop's host
+    seq_lens bookkeeping past the page budget)."""
+
+    def test_allocate_lowest_free_slot_any_free_order(self):
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+        cache = PagedKVCache(1, 1, 8, 9, 4, 3, 2)
+        assert [cache.allocate(4) for _ in range(3)] == [0, 1, 2]
+        for order in ([0, 1, 2], [2, 1, 0], [1, 2, 0]):
+            for s in order:
+                cache.free(s)
+            assert [cache.allocate(4) for _ in range(3)] == [0, 1, 2]
+
+    @pytest.mark.parametrize("quant", [None, "int8"])
+    def test_repeat_generate_bit_identical(self, quant):
+        tgt, drf = tiny_model(), tiny_draft()
+        eng = _mk_engine(tgt, "paged", quant, draft=drf)
+        ids = np.random.default_rng(21).integers(0, 97, (2, 9))
+        # ragged lengths: the row<->slot crossing only shows up when
+        # the sequences are distinguishable
+        reps = [np.asarray(eng.generate(ids, 12,
+                                        seq_lens=[9, 6]).numpy())
+                for _ in range(3)]
+        assert (reps[0] == reps[1]).all() and (reps[0] == reps[2]).all()
+
+
+class TestInt8SpecLogits:
+    def test_int8_spec_logits_close_to_fp(self):
+        tgt, drf = tiny_model(), tiny_draft()
+        ids = np.random.default_rng(7).integers(0, 97, (2, 9))
+        _, lf = _mk_engine(tgt, "paged", None, draft=drf).generate(
+            ids, 9, return_logits=True)
+        _, lq = _mk_engine(tgt, "paged", "int8", draft=drf).generate(
+            ids, 9, return_logits=True)
+        diff = np.abs(np.asarray(lf.numpy()) - np.asarray(lq.numpy()))
+        # documented int8-KV tolerance for this tiny config: per-row
+        # symmetric scales keep decode logits within a few 1e-2
+        assert float(diff.max()) < 5e-2, float(diff.max())
+
+
+class TestServingSpec:
+    def _engines(self, **kw):
+        from paddle_tpu.serving.engine import ServingEngine
+
+        tgt = tiny_model(max_position_embeddings=256)
+        return ServingEngine(tgt, max_slots=4, max_len=96,
+                             page_size=16, chunk_size=16, **kw), tgt
+
+    def test_greedy_parity_and_spec_metrics(self):
+        drf = tiny_draft(max_position_embeddings=256)
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, 97, (n,)) for n in (5, 11, 23, 8)]
+        ref_eng, tgt = self._engines()
+        hs = [ref_eng.submit(p, 12) for p in prompts]
+        ref_eng.run()
+        ref = [list(h.output_tokens) for h in hs]
+        for quant in (None, "int8"):
+            eng, _ = self._engines(draft_model=drf, spec_k=3,
+                                   kv_quant=quant)
+            hs = [eng.submit(p, 12) for p in prompts]
+            eng.run()
+            assert [list(h.output_tokens) for h in hs] == ref
+            snap = eng.metrics_snapshot()
+            assert snap["spec_dispatches"] > 0
+            assert snap["spec_emitted"] >= snap["spec_dispatches"]
+            assert 0.0 <= snap["spec_accept_rate"] <= 1.0
+            assert snap["spec_tokens_per_dispatch"] >= 1.0
+            # spec gauges are scraped on /metrics (names are
+            # prometheus-sanitized: dots become underscores)
+            txt = eng.metrics_text()
+            assert "serving_spec_accept_rate" in txt
+            assert "serving_spec_tokens_per_dispatch" in txt
+            # one decode executable across variable accept counts
+            assert eng.compile_counts()["decode_traces"] == 1
+            assert eng.retrace_stats()["spec"]["unexpected"] == 0
+            lk = eng.leak_check()
+            assert lk["free_pages"] == lk["total_pages"]
+
+    def test_decode_span_carries_yield_attribution(self):
+        drf = tiny_draft(max_position_embeddings=256)
+        eng, _ = self._engines(draft_model=drf, spec_k=3)
+        h = eng.submit(np.arange(1, 9, dtype=np.int32), 8)
+        eng.run()
+        trace = eng.request_trace(h.request.rid)
+        stack, bursts = [trace], []
+        while stack:
+            s = stack.pop()
+            stack.extend(s.children)
+            if s.name == "decode_burst":
+                bursts.append(s)
+        assert bursts, "no decode_burst spans on the request trace"
+        for sp in bursts:
+            assert sp.attrs.get("spec") is True
+            # proposed = cap-usable proposals (< spec_k on the tail
+            # dispatch of a request), accepted never exceeds it
+            assert 0 <= sp.attrs.get("proposed") <= 3
+            assert 0 <= sp.attrs.get("accepted") \
+                <= sp.attrs.get("proposed")
+            assert 1 <= sp.attrs.get("yielded") \
+                <= sp.attrs.get("proposed") + 1
+
+    def test_sampled_serving_deterministic_per_seed(self):
+        drf = tiny_draft(max_position_embeddings=256)
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, 97, (n,)) for n in (6, 14)]
+
+        def run():
+            eng, _ = self._engines(draft_model=drf, spec_k=2,
+                                   do_sample=True, temperature=0.8,
+                                   top_k=16)
+            hs = [eng.submit(p, 10, seed=50 + i)
+                  for i, p in enumerate(prompts)]
+            eng.run()
+            return [list(h.output_tokens) for h in hs]
+
+        assert run() == run()
+
+
+class TestSamplingBoundaries:
+    """Satellite: truncation tie-break regression tests."""
+
+    def test_top_p_exactly_on_cumulative_edge(self):
+        from paddle_tpu.nn.functional.sampling import truncated_probs
+
+        # probs 0.5/0.25/0.125/0.125; p=0.75 lands exactly on the edge
+        # after two tokens -> `before < p` keeps exactly those two
+        logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.125, 0.125]]))
+        probs = np.asarray(truncated_probs(logits, top_p=0.75))
+        np.testing.assert_allclose(
+            probs[0], [2 / 3, 1 / 3, 0.0, 0.0], atol=1e-6)
+        # and a p just past the edge admits the boundary token(s) —
+        # ties at the boundary logit BOTH survive (threshold cut)
+        probs = np.asarray(truncated_probs(logits, top_p=0.76))
+        assert probs[0, 2] > 0 and probs[0, 3] > 0
+
+    def test_top_p_never_empty(self):
+        from paddle_tpu.nn.functional.sampling import truncated_probs
+
+        logits = jnp.asarray([[3.0, 0.0, -1.0]])
+        probs = np.asarray(truncated_probs(logits, top_p=1e-9))
+        # the top token's exclusive prefix mass is 0 < p: always kept
+        np.testing.assert_allclose(probs[0], [1.0, 0.0, 0.0],
+                                   atol=1e-6)
+
+    def test_top_k_at_least_vocab_keeps_everything(self):
+        from paddle_tpu.nn.functional.sampling import truncated_probs
+
+        logits = jnp.asarray([[0.3, -0.7, 1.1, 0.0]])
+        ref = np.asarray(truncated_probs(logits))
+        for k in (4, 5, 100):
+            got = np.asarray(truncated_probs(logits, top_k=k))
+            np.testing.assert_allclose(got, ref, atol=1e-7)
+
+    def test_top_k_boundary_ties_survive(self):
+        from paddle_tpu.nn.functional.sampling import truncated_probs
+
+        # k=2 with a tie at the 2nd value: the threshold cut keeps
+        # BOTH tied tokens (documented tie-break rule)
+        logits = jnp.asarray([[2.0, 1.0, 1.0, 0.0]])
+        probs = np.asarray(truncated_probs(logits, top_k=2))
+        assert probs[0, 1] > 0 and probs[0, 2] > 0
+        assert probs[0, 3] == 0
+
+
+class TestSpecValidation:
+    def test_dense_kv_quant_rejected(self):
+        with pytest.raises(ValueError, match="paged"):
+            GenerationEngine(tiny_model(), kind="dense", max_len=64,
+                             kv_quant="int8")
+
+    def test_vocab_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="vocab"):
+            GenerationEngine(tiny_model(), kind="paged", max_len=64,
+                             draft_model=tiny_draft(vocab_size=31))
+
+    def test_spec_k_floor(self):
+        with pytest.raises(ValueError, match="spec_k"):
+            GenerationEngine(tiny_model(), kind="paged", max_len=64,
+                             draft_model=tiny_draft(), spec_k=0)

@@ -40,6 +40,12 @@ Metric families and default tolerances (relative):
                      "info" verdict and NEVER gate — norms legitimately
                      move with model/config/step-count changes
                      (ISSUE 15)
+    spec_yield -5%   higher is better (speculative tokens-per-dispatch:
+                     the structural yield of the spec step, gated as a
+                     lower bound like a throughput metric — ISSUE 16)
+    spec_accept INFORMATIONAL ONLY: accept rate is a property of the
+                     draft/model pair and legitimately moves with
+                     config changes (ISSUE 16)
 
 Latency/stall/mem metrics additionally carry an ABSOLUTE floor: when
 both sides sit under it, the row is informational (sub-floor jitter
@@ -72,6 +78,13 @@ DEFAULT_TOLERANCES = {
     # families
     "finite":  (0.0, True, 0.0),
     "gradnorm": (0.0, True, 0.0),
+    # speculative decoding (ISSUE 16): tokens-per-dispatch is the
+    # structural yield of the spec step (deterministic at a fixed
+    # draft/model pair) — a drop means accepted spans shrank, gate it
+    # like a throughput metric. Accept rate is a property of the
+    # draft/model PAIR, legitimately moves with config — report only.
+    "spec_yield": (0.05, True, 0.0),
+    "spec_accept": (0.0, True, 0.0),
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -134,6 +147,10 @@ def _family(key):
         return "finite"
     if "grad_norm" in k:
         return "gradnorm"
+    if "tokens_per_dispatch" in k:
+        return "spec_yield"
+    if "accept_rate" in k:
+        return "spec_accept"
     if "peak_bytes" in k:
         return "mem"
     if "goodput_frac" in k:
@@ -223,8 +240,9 @@ def compare(old_rec, new_rec, tolerances=None) -> dict:
             # regresses no matter what the previous round recorded
             verdict = ("regress" if new < 1.0
                        else ("improved" if old < 1.0 else "ok"))
-        elif fam == "gradnorm":
-            # drift is reported, never gated
+        elif fam in ("gradnorm", "spec_accept"):
+            # drift is reported, never gated (accept rate moves with
+            # the draft/model pair, grad norms with model/config)
             verdict = "info"
         elif max(abs(old), abs(new)) < floor:
             verdict = "sub_floor"
