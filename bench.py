@@ -146,8 +146,9 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
     tw = time.perf_counter()
     loss = step(ids, labels)
     _ = float(loss)
+    cold_start_ms = round((time.perf_counter() - tw) * 1e3, 1)
     print(f"[bench] {model_name} fused_scan={fused_scan} warmup "
-          f"{time.perf_counter() - tw:.1f}s", file=sys.stderr)
+          f"{cold_start_ms / 1e3:.1f}s", file=sys.stderr)
 
     # measured loop feeds through the device prefetcher (ISSUE 5): each
     # step's batch is a REAL host->device transfer, staged on a background
@@ -302,6 +303,9 @@ def run_config(model_name, batch, seq, steps, recompute, remat_policy,
         "vs_baseline": None,
         "mfu": round(mfu, 4),
         "mfu_cost_analysis": mfu_ca,
+        # trace+compile(or deserialize)-to-first-step wall (ISSUE 17):
+        # the cold-start metric bench_compare gates round-over-round
+        "cold_start_ms": cold_start_ms,
         "cost_analysis": (None if hlo_costs is None else {
             "flops_per_step": hlo_costs.get("flops_per_step"),
             "bytes_accessed_per_step": hlo_costs.get(
@@ -488,7 +492,10 @@ def run_decode_config(model_name=None, prompt_len=None, new_tokens=None,
                 eng = GenerationEngine(
                     m, kind=kind, batch=bs,
                     max_len=prompt_len + new_tokens)
+                t_cold = time.perf_counter()
                 eng.generate(ids, 2)             # compile both steps
+                cold_ms = round(
+                    (time.perf_counter() - t_cold) * 1e3, 1)
                 t0 = time.perf_counter()
                 eng.generate(ids, 1)
                 ttft = time.perf_counter() - t0  # prefill + 1 sample
@@ -502,6 +509,9 @@ def run_decode_config(model_name=None, prompt_len=None, new_tokens=None,
                 if tag == "fp32":
                     rec[f"{name}_prefill_ttft_ms"] = round(
                         ttft * 1e3, 2)
+                    # compile(or cache-deserialize)-to-first-tokens
+                    # (ISSUE 17): both step programs built here
+                    rec[f"{name}_cold_start_ms"] = cold_ms
                     # compiled decode-step HBM peak (ISSUE 14): the
                     # AOT buffer-assignment receipt per cache shape
                     try:
@@ -873,7 +883,19 @@ def run_selftest():
         assert rec.get("check") == "pass", rec
         results["spec_decode_detail"] = rec
 
+    def cold_start():
+        # ISSUE 17: persistent AOT executable cache — hermetic
+        # process-pair A/B on one shared cache dir: cold child compiles
+        # + serializes, warm child deserializes. Gates warm first step
+        # <= 0.5x cold, zero warm misses, bit-identical train losses /
+        # params / decode tokens, strict-clean retrace sentinel.
+        rec = _run_cpu_probe("paddle_tpu.jit.cold_start_selftest",
+                             n_devices=1, timeout=900)
+        assert rec.get("check") == "pass", rec
+        results["cold_start_detail"] = rec
+
     check("pallas_flash_single_block_s512", lambda: flash(512))
+    check("cold_start", cold_start)
     check("pallas_flash_tiled_s2048", lambda: flash(2048))
     check("int8_weight_only_matmul", int8_matmul)
     check("master_offload_parity_pinned_host", offload_parity)
@@ -1047,30 +1069,30 @@ def _compute_path_hash():
     global _PATH_HASH_CACHE
     if _PATH_HASH_CACHE is not None:
         return _PATH_HASH_CACHE
-    import hashlib
+    # the ONE hashing recipe (ISSUE 17): the compile cache's fingerprint
+    # helpers — same sha256 framing as the executable store keys and the
+    # planner's calib hash, distinct prefixes per scheme
+    from paddle_tpu.jit.compile_cache import file_fingerprint, fingerprint
 
-    h = hashlib.sha256()
     try:
-        h.update(_lowered_step_text().encode())
-        _PATH_HASH_CACHE = "hlo:" + h.hexdigest()[:16]
+        _PATH_HASH_CACHE = fingerprint(_lowered_step_text(),
+                                       prefix="hlo")
         return _PATH_HASH_CACHE
     except Exception as e:
         print(f"[bench] HLO fingerprint unavailable "
               f"({type(e).__name__}: {e}); falling back to source hash",
               file=sys.stderr)
     root = os.path.dirname(os.path.abspath(__file__))
-    for rel in ("paddle_tpu/jit/train_step.py",
-                "paddle_tpu/jit/fused_scan_step.py",
-                "paddle_tpu/jit/sharded_scan.py",
-                "paddle_tpu/models/gpt.py",
-                "paddle_tpu/ops/pallas/flash_attention.py",
-                "paddle_tpu/optimizer/__init__.py"):
-        p = os.path.join(root, rel)
-        if not os.path.exists(p):
-            return None            # renamed file -> record reads stale
-        with open(p, "rb") as f:
-            h.update(f.read())
-    _PATH_HASH_CACHE = "src:" + h.hexdigest()[:16]   # don't re-trace
+    paths = [os.path.join(root, rel)
+             for rel in ("paddle_tpu/jit/train_step.py",
+                         "paddle_tpu/jit/fused_scan_step.py",
+                         "paddle_tpu/jit/sharded_scan.py",
+                         "paddle_tpu/models/gpt.py",
+                         "paddle_tpu/ops/pallas/flash_attention.py",
+                         "paddle_tpu/optimizer/__init__.py")]
+    if not all(os.path.exists(p) for p in paths):
+        return None                # renamed file -> record reads stale
+    _PATH_HASH_CACHE = file_fingerprint(paths)       # don't re-trace
     return _PATH_HASH_CACHE
 
 
@@ -1440,6 +1462,15 @@ if __name__ == "__main__":
             {"training_kernels":
              _run_cpu_probe("paddle_tpu.ops.pallas.training_selftest",
                             n_devices=1, timeout=900)}))
+    elif "--cold-start" in sys.argv:
+        # COLD-START lane (ISSUE 17): hermetic process-pair A/B on one
+        # shared compile-cache dir — cold child compiles+serializes,
+        # warm child deserializes; gates warm <= 0.5x cold first step,
+        # zero warm misses, bit-identical outputs, strict sentinel
+        print(json.dumps({
+            "cold_start": _run_cpu_probe(
+                "paddle_tpu.jit.cold_start_selftest",
+                n_devices=1, timeout=900)}))
     elif "--selftest" in sys.argv:
         _setup_jax()
         print(json.dumps({"selftest": run_selftest()}))

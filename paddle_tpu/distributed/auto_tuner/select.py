@@ -27,8 +27,6 @@ toolchains.
 """
 from __future__ import annotations
 
-import hashlib
-import inspect
 import json
 import os
 
@@ -40,6 +38,7 @@ from .tuner import (
 )
 
 __all__ = ["pick_layout", "calibrate_backend_cached", "spec_of_model",
+           "record_measured_step", "measured_steps", "layout_name",
            "LAYOUT_ENV"]
 
 LAYOUT_ENV = "PADDLE_HYBRID_LAYOUT"
@@ -52,16 +51,17 @@ def _repo_root():
 
 def _calib_hash():
     """Invalidation hash: the calibration + cost-model code and the jax
-    version. A change to either re-measures instead of reusing."""
+    version. A change to either re-measures instead of reusing. Built
+    on the shared fingerprint helper (ISSUE 17) — one hashing recipe
+    across bench's compile-path hash, the sweep gate and this."""
     import jax
 
+    from ...jit.compile_cache import source_fingerprint
     from . import tuner as _tuner
 
-    h = hashlib.sha256()
-    h.update(inspect.getsource(_tuner.calibrate_backend).encode())
-    h.update(inspect.getsource(_tuner.estimate_step_ms).encode())
-    h.update(jax.__version__.encode())
-    return h.hexdigest()[:16]
+    return source_fingerprint(_tuner.calibrate_backend,
+                              _tuner.estimate_step_ms,
+                              extra=(jax.__version__,), prefix=None)
 
 
 def calibrate_backend_cached(devices=None, cache_dir=None, refresh=False):
@@ -102,6 +102,79 @@ def calibrate_backend_cached(devices=None, cache_dir=None, refresh=False):
     except OSError:
         pass                       # cache is an optimization, not truth
     return constants
+
+
+def _measured_path(platform, n_devices, cache_dir=None):
+    if cache_dir is None:
+        cache_dir = os.path.join(_repo_root(), ".bench_live")
+    return os.path.join(cache_dir,
+                        f"measured_steps_{platform}_{n_devices}.json")
+
+
+def layout_name(cand) -> str:
+    """Canonical layout key shared by the ranking table and the
+    measured-step store: ``dp4xmp2xpp1m1``."""
+    return (f"dp{cand.dp}xmp{cand.mp}xpp{cand.pp}"
+            f"m{cand.micro_batch}")
+
+
+def record_measured_step(layout, step_ms, n_devices, platform=None,
+                         cache_dir=None):
+    """Feed one MEASURED per-step wall time back to the planner
+    (ISSUE 17 closed loop): bench lanes and training loops call this so
+    `pick_layout` can re-rank from live timelines instead of static
+    calibration. ``layout`` is a `Candidate` or a `layout_name` string.
+    Records are keyed like the backend-calib cache ((platform, n)) and
+    carry the calib hash, so stale-toolchain measurements never mix
+    with fresh estimates."""
+    import jax
+
+    if platform is None:
+        devs = jax.devices()
+        platform = devs[0].platform if devs else "none"
+    name = layout if isinstance(layout, str) else layout_name(layout)
+    path = _measured_path(platform, int(n_devices), cache_dir)
+    recs = {}
+    try:
+        with open(path) as f:
+            recs = json.load(f)
+    except (OSError, ValueError):
+        pass
+    import time as _time
+
+    recs[name] = {"step_ms": float(step_ms),
+                  "calib_hash": _calib_hash(),
+                  "updated": _time.time()}
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(recs, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass                        # measurements are advisory
+    return recs[name]
+
+
+def measured_steps(n_devices, platform=None, cache_dir=None) -> dict:
+    """{layout_name: step_ms} of code-current measured records for this
+    (platform, device count) — entries from a different calib-hash
+    epoch are dropped (the estimates they would re-rank against were
+    produced by different model code)."""
+    import jax
+
+    if platform is None:
+        devs = jax.devices()
+        platform = devs[0].platform if devs else "none"
+    path = _measured_path(platform, int(n_devices), cache_dir)
+    try:
+        with open(path) as f:
+            recs = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    want = _calib_hash()
+    return {k: float(v["step_ms"]) for k, v in recs.items()
+            if isinstance(v, dict) and v.get("calib_hash") == want}
 
 
 def spec_of_model(config, global_batch, seq_len=None, params=None):
@@ -181,8 +254,24 @@ def _sweep_knobs(spec):
     return best
 
 
+def _rank_corr(xs, ys):
+    """Spearman rank correlation of two equal-length sequences (n >= 2);
+    ties broken by position — enough for the small top-k tables here."""
+    def ranks(vals):
+        order = sorted(range(len(vals)), key=lambda i: vals[i])
+        r = [0] * len(vals)
+        for rank, i in enumerate(order):
+            r[i] = rank
+        return r
+
+    rx, ry = ranks(xs), ranks(ys)
+    n = len(xs)
+    d2 = sum((a - b) ** 2 for a, b in zip(rx, ry))
+    return 1.0 - 6.0 * d2 / (n * (n * n - 1))
+
+
 def pick_layout(spec, n_devices, hbm_gb=16.0, backend=None,
-                max_micro=32, env=None, top_k=5):
+                max_micro=32, env=None, top_k=5, measured=None):
     """Choose a runnable hybrid layout for `spec` on `n_devices` chips.
 
     Returns a dict: ``candidate`` (the winning `Candidate`),
@@ -191,6 +280,16 @@ def pick_layout(spec, n_devices, hbm_gb=16.0, backend=None,
     ("planner" or "env"), and ``ranking`` (the top-k (name, est_ms)
     table the decision came from). Raises if nothing feasible survives
     pruning (including a forced env layout that fails the rules).
+
+    Measured re-ranking (ISSUE 17): where `record_measured_step` has a
+    code-current timeline for a candidate, the MEASURED step time
+    replaces the calibrated estimate in the sort — live data beats the
+    cost model. ``measured`` overrides the on-disk store ({name:
+    step_ms}; pass ``{}`` to disable). When >= 2 candidates have both
+    numbers, the decision carries ``rho_divergence`` (1 - Spearman of
+    estimated-vs-measured order) and flags divergence > 0.5 to the
+    flight recorder — the signal that the §14 calibration has drifted
+    from reality and needs a re-run.
     """
     env_map = os.environ if env is None else env
     forced = env_map.get(LAYOUT_ENV, "").strip()
@@ -258,8 +357,36 @@ def pick_layout(spec, n_devices, hbm_gb=16.0, backend=None,
     for c in live:
         c.estimated_mem_gb = estimate_memory_gb(spec, c)
         c.estimated_step_ms = estimate_step_ms(spec, c, backend=backend)
-    live.sort(key=lambda c: (c.estimated_step_ms,
+    if measured is None:
+        measured = measured_steps(n_devices)
+    meas = {layout_name(c): measured[layout_name(c)]
+            for c in live if layout_name(c) in measured}
+
+    def effective_ms(c):
+        return meas.get(layout_name(c), c.estimated_step_ms)
+
+    live.sort(key=lambda c: (effective_ms(c),
                              c.mp + c.pp))  # tie-break: simpler layout
-    ranking = [(f"dp{c.dp}xmp{c.mp}xpp{c.pp}m{c.micro_batch}",
-                round(c.estimated_step_ms, 3)) for c in live[:top_k]]
-    return finish(live[0], "planner", ranking)
+    ranking = [(layout_name(c), round(effective_ms(c), 3))
+               for c in live[:top_k]]
+    dec = finish(live[0], "planner", ranking)
+    dec["measured"] = dict(meas)
+    rho_div = 0.0
+    if len(meas) >= 2:
+        both = [c for c in live if layout_name(c) in meas]
+        rho = _rank_corr([c.estimated_step_ms for c in both],
+                         [meas[layout_name(c)] for c in both])
+        rho_div = max(0.0, 1.0 - rho)
+    dec["rho_divergence"] = round(rho_div, 4)
+    try:
+        from ...observability import recorder, registry
+
+        registry().gauge("planner.rho_divergence").set(rho_div)
+        if rho_div > 0.5:
+            recorder().note(
+                "planner_rho_divergence", divergence=round(rho_div, 4),
+                measured=len(meas), winner=ranking[0][0] if ranking
+                else None)
+    except Exception:
+        pass                 # observability must never break selection
+    return dec

@@ -23,11 +23,16 @@ from .fused_scan_step import FusedScanTrainStep
 from .sharded_scan import ShardedFusedScanTrainStep, select_train_step
 from .pipeline_step import PipelineScanTrainStep
 from .decode_step import DecodeStep, GenerationEngine, PrefillStep
+from .compile_cache import (
+    CompileCache, cached_jit, active_cache, set_cache_dir, cache_enabled,
+)
 
 __all__ = ["to_static", "TrainStep", "FusedScanTrainStep",
            "ShardedFusedScanTrainStep", "PipelineScanTrainStep",
            "select_train_step",
            "GenerationEngine", "DecodeStep", "PrefillStep",
+           "CompileCache", "cached_jit", "active_cache",
+           "set_cache_dir", "cache_enabled",
            "not_to_static", "ignore_module", "save", "load",
            "enable_to_static", "set_code_level", "set_verbosity"]
 

@@ -1,0 +1,164 @@
+"""Hermetic cold-start A/B probe (ISSUE 17): compile vs deserialize.
+
+Run as ``python -m paddle_tpu.jit.cold_start_selftest`` in a clean
+JAX_PLATFORMS=cpu subprocess (bench.py --selftest / --cold-start wires
+this through the usual env-strip recipe) and prints ONE JSON line.
+
+The probe spawns a PROCESS PAIR sharing one fresh compile-cache
+directory — persistence claims need process death between write and
+read, in-process "warm" numbers only measure jax's own caches:
+
+- COLD child: empty cache. Builds the selftest GPT fused-scan train
+  step + the paged decode engine, pays trace+COMPILE on first dispatch,
+  serializes into the cache.
+- WARM child: same code, same seeds, same cache dir. First dispatch
+  trace+DESERIALIZES.
+
+Gates (all land in the BENCH record):
+
+- warm first train step <= ``ratio_gate`` x cold (default 0.5: the
+  headline claim — at selftest scale compile is only ~2x the shared
+  trace+lower cost, so passing here means real models, where compile
+  dominates, do far better);
+- warm served every program from the cache (>= 1 disk hit, 0 misses);
+- BIT-IDENTICAL cold vs warm: train losses over 2 steps, the updated
+  parameter checksum, and the greedy paged-decode token stream;
+- retrace sentinel strict-clean in both children (no unexpected
+  recompiles under the cache).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_CHILD = "--child"
+
+
+def _workload(cache_dir):
+    """One process's life: enable the cache, build + run the train and
+    decode paths, report timings/outputs/cache traffic."""
+    from .compile_cache import set_cache_dir
+
+    cache = set_cache_dir(cache_dir)
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as popt
+    from ..models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+    from .fused_scan_step import FusedScanTrainStep
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=4,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    scan_layers=True)
+    model = GPTForCausalLM(cfg)
+    opt = popt.AdamW(learning_rate=1e-3,
+                     parameters=model.parameters())
+    step = FusedScanTrainStep(model, opt,
+                              criterion=GPTPretrainingCriterion())
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(1, cfg.vocab_size, (2, 64)),
+                           dtype="int64")
+
+    t0 = time.perf_counter()
+    loss0 = float(step(ids, ids))
+    first_train_ms = (time.perf_counter() - t0) * 1e3
+    loss1 = float(step(ids, ids))
+    psum = float(np.sum([np.asarray(p._data, np.float64).sum()
+                         for p in model.parameters()]))
+
+    # serve decode path (the jit/decode_step _Step programs)
+    paddle.seed(1)
+    dcfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_attention_heads=4, max_position_embeddings=64,
+                     hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    dm = GPTForCausalLM(dcfg)
+    dm.eval()
+    drng = np.random.default_rng(1)
+    prompt = paddle.to_tensor(drng.integers(1, 64, (2, 8)),
+                              dtype="int64")
+    t0 = time.perf_counter()
+    out = dm.generate(prompt, max_new_tokens=6, use_cache="paged")
+    first_decode_ms = (time.perf_counter() - t0) * 1e3
+    tokens = np.asarray(out._data).tolist()
+
+    st = cache.stats() if cache is not None else {}
+    return {
+        "first_train_step_ms": round(first_train_ms, 1),
+        "first_decode_ms": round(first_decode_ms, 1),
+        "loss0": repr(loss0), "loss1": repr(loss1),
+        "param_sum": repr(psum),
+        "decode_tokens": tokens,
+        "cache_hits": st.get("hits"), "cache_misses": st.get("misses"),
+        "cache_entries": st.get("entries"),
+        "train_sentinel": step.retrace_stats(),
+    }
+
+
+def run_probe(ratio_gate=0.5, timeout=600):
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="paddle_tpu_cold_start_")
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_COMPILE_CACHE", None)  # _workload sets its own
+    runs = {}
+    for phase in ("cold", "warm"):
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.jit.cold_start_selftest",
+             _CHILD, cache_dir],
+            env=env, capture_output=True, text=True, timeout=timeout)
+        line = next((ln for ln in r.stdout.splitlines()
+                     if ln.startswith("{")), None)
+        if r.returncode != 0 or line is None:
+            return {"check": f"FAIL: {phase} child rc={r.returncode}: "
+                             f"{r.stderr[-300:]}"}
+        runs[phase] = json.loads(line)
+    cold, warm = runs["cold"], runs["warm"]
+
+    ratio = warm["first_train_step_ms"] / max(
+        cold["first_train_step_ms"], 1e-9)
+    identical = all(cold[k] == warm[k] for k in
+                    ("loss0", "loss1", "param_sum", "decode_tokens"))
+    clean = (cold["train_sentinel"]["unexpected"] == 0
+             and warm["train_sentinel"]["unexpected"] == 0)
+    fails = []
+    if ratio > ratio_gate:
+        fails.append(f"warm/cold ratio {ratio:.3f} > {ratio_gate}")
+    if not (cold["cache_misses"] and warm["cache_hits"]):
+        fails.append("cache traffic wrong way (cold must miss, warm "
+                     "must hit)")
+    if warm["cache_misses"]:
+        fails.append(f"warm process MISSED {warm['cache_misses']} "
+                     "programs (unstable cache key)")
+    if not identical:
+        fails.append("cold vs warm outputs not bit-identical")
+    if not clean:
+        fails.append("retrace sentinel unexpected != 0")
+    return {
+        "cold_first_train_step_ms": cold["first_train_step_ms"],
+        "warm_first_train_step_ms": warm["first_train_step_ms"],
+        "warm_over_cold_ratio": round(ratio, 4),
+        "ratio_gate": ratio_gate,
+        "cold_first_decode_ms": cold["first_decode_ms"],
+        "warm_first_decode_ms": warm["first_decode_ms"],
+        "cached_programs": warm["cache_hits"],
+        "warm_misses": warm["cache_misses"],
+        "bit_identical": identical,
+        "sentinel_clean": clean,
+        "check": "pass" if not fails else "FAIL: " + "; ".join(fails),
+    }
+
+
+if __name__ == "__main__":
+    if _CHILD in sys.argv:
+        print(json.dumps(_workload(sys.argv[sys.argv.index(_CHILD) + 1])))
+    else:
+        print(json.dumps(run_probe()))

@@ -192,9 +192,16 @@ class _Step:
             args[2] = {k: jnp.asarray(v) for k, v in args[2].items()}
             return self._fn(*args)
         if self._jitted is None:
-            self._jitted = jax.jit(
+            # persistent AOT cache (ISSUE 17): with
+            # PADDLE_TPU_COMPILE_CACHE set, a warm replica's first
+            # token deserializes the step executable; unset, this is
+            # plain jax.jit
+            from .compile_cache import cached_jit
+
+            self._jitted = cached_jit(
                 self._fn,
-                donate_argnums=(1,) if self._donate else ())
+                donate_argnums=(1,) if self._donate else (),
+                label=type(self).__name__)
         if self._pin_meta_host:
             args = list(args)
             args[2] = {k: np.asarray(v) for k, v in args[2].items()}

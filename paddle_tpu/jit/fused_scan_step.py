@@ -928,8 +928,11 @@ class FusedScanTrainStep:
                 seg_ctx.__exit__(None, None, None)
                 self._bind(self._buffers, saved_buf)
 
-        self._jitted = jax.jit(step_fn,
-                               donate_argnums=_donate_argnums())
+        from .compile_cache import cached_jit
+
+        self._jitted = cached_jit(step_fn,
+                                  donate_argnums=_donate_argnums(),
+                                  label=type(self).__name__)
 
     def _pre_step(self):
         """Hook: runs at the top of __call__, before state extraction.

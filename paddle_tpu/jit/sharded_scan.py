@@ -2035,8 +2035,11 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
             step_fn, mesh=mesh,
             in_specs=(specs, P(), batch_spec, batch_spec, batch_spec),
             out_specs=out_specs, check_vma=False)
-        self._jitted = jax.jit(wrapped,
-                               donate_argnums=_donate_argnums())
+        from .compile_cache import cached_jit
+
+        self._jitted = cached_jit(wrapped,
+                                  donate_argnums=_donate_argnums(),
+                                  label=type(self).__name__)
 
     def grads_probe(self, ids, labels):
         """Test/debug surface: run ONLY the grads pass and return

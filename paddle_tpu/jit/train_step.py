@@ -423,7 +423,14 @@ class TrainStep:
             return loss._data, new_state, jnp.stack(rows)
 
         donate = (0,) if self._donate else ()
-        self._jitted = jax.jit(step_fn, donate_argnums=donate)
+        # persistent AOT executable cache (ISSUE 17): with
+        # PADDLE_TPU_COMPILE_CACHE set, a warm process deserializes the
+        # previously compiled step instead of retracing+recompiling;
+        # unset, this IS jax.jit
+        from .compile_cache import cached_jit
+
+        self._jitted = cached_jit(step_fn, donate_argnums=donate,
+                                  label=type(self).__name__)
         # live-buffer attribution (ISSUE 14): params/opt-state/buffers
         # claim their resident bytes at mem.live scrape time (weakly
         # tracked — a dropped step stops claiming)

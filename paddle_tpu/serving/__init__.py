@@ -16,11 +16,16 @@ sequence ever waits for another's tail (ROADMAP item 1).
   preemption counters.
 * ``traffic`` — synthetic Poisson traffic + the static generate-and-wait
   baseline for the bench A/B (bench.py --serve).
+* ``OnlineTuner`` — opt-in closed loop (ISSUE 17) nudging admission
+  watermark / prefill aggressiveness / decode burst from live SLO-burn
+  and queue-depth gauges; bounded, hysteretic, flight-recorded.
 """
 from .engine import ServingEngine
 from .metrics import ServingMetrics, percentile
 from .request import Request, RequestHandle, RequestState
 from .scheduler import RequestScheduler
+from .tuner import OnlineTuner, TunerLimits
 
 __all__ = ["ServingEngine", "RequestScheduler", "ServingMetrics",
-           "Request", "RequestHandle", "RequestState", "percentile"]
+           "Request", "RequestHandle", "RequestState", "percentile",
+           "OnlineTuner", "TunerLimits"]

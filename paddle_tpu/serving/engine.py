@@ -63,7 +63,7 @@ class ServingEngine:
                  clock=time.perf_counter,
                  trace=True, trace_capacity=256, exemplar_capacity=32,
                  exemplar_quantile=99.0, exemplar_min_samples=32,
-                 slos=(), debug_port=None):
+                 slos=(), debug_port=None, tuner=False, tuner_kw=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -168,6 +168,7 @@ class ServingEngine:
         self.scheduler.token_lookahead = (
             self.spec_k + 1 if draft_model is not None
             else self.decode_burst)
+        self._donate_cache = bool(donate)
         self.prefill_step = ChunkPrefillStep(self, donate_cache=donate)
         self.decode_step = ServeDecodeStep(self, donate_cache=donate)
         self.spec_step = (ServeSpecDecodeStep(self, donate_cache=donate)
@@ -177,6 +178,16 @@ class ServingEngine:
             bkts.append(b)
             b *= 2
         self.chunk_buckets = tuple(bkts) + (self.chunk_size,)
+        # closed-loop knob tuner (ISSUE 17): OFF by default — without
+        # one, step() runs the exact PR-16 path. `tuner=True` builds an
+        # OnlineTuner with defaults; pass an instance for full control.
+        self.last_warmup_ms = None
+        if tuner is True:
+            from .tuner import OnlineTuner
+
+            self.tuner = OnlineTuner(self, **(tuner_kw or {}))
+        else:
+            self.tuner = tuner or None
         self._buffers, _ = _split_state(
             "paged", _tree_data(self.cache.state()))
         if self.draft_cache is not None:
@@ -280,6 +291,11 @@ class ServingEngine:
             self._recover()
             raise
         self.metrics.observe(len(sched.waiting), len(sched.running))
+        if self.tuner is not None:
+            # the safe boundary: no compiled call is in flight here, so
+            # even a retrace-triggering knob (decode burst) can rebuild
+            # its step object cleanly
+            self.tuner.on_step()
         return worked
 
     def run(self, max_steps=1_000_000):
@@ -358,15 +374,68 @@ class ServingEngine:
         self._retired_this_call.clear()
 
     def warmup(self):
-        """Compile every program the serving loop can hit — the decode
+        """Build every program the serving loop can hit — the decode
         step and one prefill program per chunk bucket — then reset the
         counters, so a measured window never eats a trace. Buckets warm
-        one at a time (a joint batch would only compile the largest)."""
+        one at a time (a joint batch would only compile the largest).
+
+        With the persistent compile cache active (ISSUE 17,
+        ``PADDLE_TPU_COMPILE_CACHE``) this is a BULK CACHE-LOAD: every
+        program a previous process compiled deserializes in
+        milliseconds, so warmup time IS the replica's cold start.
+        `last_warmup_ms` and `warmup_report` record the receipt."""
+        from ..observability import registry as _greg
+
+        reg = _greg()
+        h0 = reg.counter("jit.cache.hit").value
+        m0 = reg.counter("jit.cache.miss").value
+        t0 = time.perf_counter()
         for b in self.chunk_buckets:
             plen = max(1, min(b, self.max_len - 2))
             self.submit(np.ones((plen,), np.int32), 2)
             self.run()
+        self.last_warmup_ms = (time.perf_counter() - t0) * 1e3
+        self._warmup_report = {
+            "warmup_ms": round(self.last_warmup_ms, 3),
+            "programs": len(self.chunk_buckets) + 1,
+            "cache_hits": reg.counter("jit.cache.hit").value - h0,
+            "cache_misses": reg.counter("jit.cache.miss").value - m0,
+        }
         self.reset_metrics()
+        return self
+
+    @property
+    def warmup_report(self) -> dict:
+        """Cold-start receipt of the last `warmup()`: wall time, program
+        count, and how many executables came from the persistent cache
+        (hits) vs fresh compiles (misses)."""
+        return dict(getattr(self, "_warmup_report", {}) or {})
+
+    def set_decode_burst(self, k):
+        """Change the decode burst at a SAFE BOUNDARY (between engine
+        steps). The burst is unrolled inside the compiled decode step,
+        so this rebuilds the step object — a fresh program and a fresh
+        retrace sentinel (the new program's first trace is a first
+        signature, never an unexpected recompile; strict mode stays
+        clean). With the persistent compile cache warm, a previously
+        seen burst deserializes instead of recompiling. No-op under
+        speculative decoding (spec_k owns the decode program shape)."""
+        k = max(1, int(k))
+        if k == self.decode_burst:
+            return self
+        if self.spec_step is not None:
+            raise ValueError("decode_burst is unused under speculative "
+                             "decoding (spec_k owns the decode "
+                             "program); tune spec_k at construction")
+        old = self.decode_burst
+        self.decode_burst = k
+        self.decode_step = ServeDecodeStep(
+            self, donate_cache=self._donate_cache)
+        self.scheduler.token_lookahead = k
+        from ..observability import recorder
+
+        recorder().note("decode_burst_rebuild", engine_from=old,
+                        engine_to=k)
         return self
 
     # -- step mechanics ---------------------------------------------------

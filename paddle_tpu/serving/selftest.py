@@ -154,6 +154,9 @@ def run_probe():
     def bounded_ttft():
         eng = ServingEngine(m, max_slots=4, max_len=128, page_size=8,
                             chunk_size=8).warmup()
+        # serve-lane cold start (ISSUE 17): warmup wall + how many of
+        # the compiled programs came from the persistent cache
+        rec["cold_start"] = eng.warmup_report
         t0 = time.perf_counter()
         eng.submit(prompts[1], 4)
         eng.run(max_steps=400)
@@ -278,11 +281,52 @@ def run_probe():
         # tracing instrumentation added zero unexpected recompiles
         assert obs.retrace_summary()["total_unexpected"] == 0
 
+    # -- closed-loop tuner + persistent cache, strict sentinel (ISSUE 17)
+    def tuner_closed_loop():
+        import tempfile
+
+        from paddle_tpu.jit.compile_cache import set_cache_dir
+
+        set_cache_dir(tempfile.mkdtemp(prefix="serve_cold_start_"))
+        try:
+            eng = ServingEngine(
+                m, max_slots=3, max_len=64, page_size=8, chunk_size=8,
+                tuner=True,
+                tuner_kw={"interval": 4, "hysteresis": 2,
+                          "cooldown": 1}).warmup()
+            hs = [eng.submit(p, 6 + (i % 3) * 3)
+                  for i, p in enumerate(prompts)]
+            eng.run(max_steps=5000)
+            # token parity vs plain generate holds THROUGH tuner moves
+            # (every knob is schedule-shaping, never numerics-shaping)
+            for h in hs:
+                ref = m.generate(
+                    np.asarray(h.request.prompt)[None],
+                    max_new_tokens=h.request.max_new_tokens,
+                    use_cache="paged")
+                assert np.asarray(ref._data)[0].tolist() == \
+                    h.output_tokens, f"rid {h.request.rid} diverged"
+            # every decision is a single bounded step on a known knob
+            for d in eng.tuner.decisions:
+                assert d["knob"] in ("admit_watermark",
+                                     "prefill_chunks_per_step",
+                                     "chunk_size", "decode_burst"), d
+                if d["knob"] != "chunk_size":
+                    assert abs(d["to"] - d["from"]) == 1, d
+            leaks = eng.leak_check()
+            assert leaks["free_pages"] == leaks["total_pages"], leaks
+            rec["tuner"] = {"evaluations": eng.tuner.evaluations,
+                            "moves": len(eng.tuner.decisions),
+                            "cold_start": eng.warmup_report}
+        finally:
+            set_cache_dir(None)
+
     check("serving_churn_parity", churn_parity)
     check("serving_preempt_resume", preempt_resume)
     check("serving_bounded_ttft", bounded_ttft)
     check("serving_traffic_ab", traffic_ab)
     check("serving_trace_forensics", trace_forensics)
+    check("serving_tuner_closed_loop", tuner_closed_loop)
     rec["retrace_sentinel"] = {
         "strict": obs.strict_retrace(),
         "total_unexpected": obs.retrace_summary()["total_unexpected"],

@@ -1,0 +1,258 @@
+"""Online knob tuner: the serving engine's closed loop (ISSUE 17).
+
+PRs 12–13 gave the engine eyes — SLO burn-rate gauges, queue-depth
+gauges, per-request latency rings. This module is the hands: a small
+hysteretic controller that nudges three engine knobs from those live
+measurements:
+
+- ``admit_watermark`` (host-only): free-page headroom held back before
+  admitting. Lowered when the queue is deep and the pool has slack
+  (admit more aggressively), raised when preemption churn shows
+  admission outran capacity.
+- ``prefill_chunks_per_step`` / ``chunk_size`` (host-only): prefill
+  aggressiveness per loop iteration. Raised under TTFT burn, lowered
+  under ITL burn. The chunk cap only moves along the engine's
+  ALREADY-COMPILED bucket ladder, so a move never traces.
+- ``decode_burst`` (RETRACE-TRIGGERING: the burst is unrolled inside
+  the compiled decode step). Moves happen only at a safe boundary —
+  between engine steps, by REBUILDING the decode step object with a
+  fresh retrace sentinel (`ServingEngine.set_decode_burst`), so the
+  sentinel stays strict-clean: the new program's first trace is a
+  first signature, not an unexpected recompile. With the persistent
+  compile cache warm, a revisited burst value deserializes instead of
+  recompiling.
+
+Actuation policy (DECISIONS.md §23): every knob moves ONE bounded step
+at a time; a move requires ``hysteresis`` consecutive intervals
+agreeing on the signal; after any move the controller holds for
+``cooldown`` intervals. The tuner is OFF by default — an engine
+without a tuner executes exactly the PR-16 code path. Every decision
+lands on the flight recorder (``tuner_move`` events), the
+``tuner.<knob>`` gauges, and the ``decisions`` list.
+"""
+from __future__ import annotations
+
+__all__ = ["OnlineTuner", "TunerLimits"]
+
+
+class TunerLimits:
+    """Bounds for every tunable knob. Defaults derive from the engine's
+    construction-time values (the tuner may never exceed what the
+    operator provisioned — e.g. the chunk ladder only has buckets up
+    to the constructed chunk_size)."""
+
+    def __init__(self, engine, max_decode_burst=8,
+                 max_prefill_chunks=4, max_watermark=None):
+        self.min_decode_burst = 1
+        self.max_decode_burst = int(max_decode_burst)
+        self.min_prefill_chunks = 1
+        self.max_prefill_chunks = int(max_prefill_chunks)
+        self.chunk_ladder = tuple(engine.chunk_buckets)
+        self.min_watermark = 0
+        self.max_watermark = (int(max_watermark) if max_watermark
+                              is not None else 2 * engine.max_slots)
+
+
+class OnlineTuner:
+    """One controller bound to one engine. The engine calls
+    ``on_step()`` once per `ServingEngine.step`; every ``interval``
+    steps the tuner reads the gauges and maybe moves ONE knob."""
+
+    def __init__(self, engine, interval=32, hysteresis=3, cooldown=4,
+                 burn_high=1.0, burn_low=0.25, queue_high=None,
+                 limits=None, tune_decode_burst=True):
+        self.engine = engine
+        self.interval = max(1, int(interval))
+        self.hysteresis = max(1, int(hysteresis))
+        self.cooldown = max(0, int(cooldown))
+        self.burn_high = float(burn_high)
+        self.burn_low = float(burn_low)
+        # queue deeper than this = admission-bound (default: one full
+        # slot generation waiting)
+        self.queue_high = (int(queue_high) if queue_high is not None
+                           else max(2, engine.max_slots))
+        self.limits = limits or TunerLimits(engine)
+        self.tune_decode_burst = bool(tune_decode_burst)
+        self._steps = 0
+        self._streak = {}          # signal name -> consecutive count
+        self._hold = 0             # cooldown countdown
+        self._last_preemptions = 0
+        self.decisions = []        # every move, newest last
+        self.evaluations = 0
+        reg = engine.metrics.registry
+        self._bind_gauges(reg)
+
+    def _bind_gauges(self, reg):
+        reg.gauge("tuner.decode_burst").set_fn(
+            lambda: self.engine.decode_burst)
+        reg.gauge("tuner.prefill_chunks_per_step").set_fn(
+            lambda: self.engine.prefill_chunks_per_step)
+        reg.gauge("tuner.chunk_size").set_fn(
+            lambda: self.engine.chunk_size)
+        reg.gauge("tuner.admit_watermark").set_fn(
+            lambda: self.engine.scheduler._watermark())
+        reg.gauge("tuner.moves").set_fn(lambda: len(self.decisions))
+
+    # -- signal collection -----------------------------------------------
+    def _signals(self):
+        eng = self.engine
+        burns = {"ttft": 0.0, "itl": 0.0}
+        for st in eng.slo.snapshot().values():
+            m = st.get("metric", "")
+            if m == "ttft_s":
+                burns["ttft"] = max(burns["ttft"], st["burn_rate"])
+            elif m == "itl_s":
+                burns["itl"] = max(burns["itl"], st["burn_rate"])
+        new_preempt = eng.metrics.preemptions - self._last_preemptions
+        self._last_preemptions = eng.metrics.preemptions
+        return {
+            "queue_depth": eng.metrics.queue_depth,
+            "free_pages": eng.cache.free_page_count,
+            "ttft_burn": burns["ttft"],
+            "itl_burn": burns["itl"],
+            "preemptions_delta": new_preempt,
+        }
+
+    def _bump(self, name):
+        """Consecutive-interval streak for one signal; competing
+        signals reset each other so the controller cannot oscillate
+        between two half-built streaks."""
+        for k in list(self._streak):
+            if k != name:
+                self._streak[k] = 0
+        self._streak[name] = self._streak.get(name, 0) + 1
+        return self._streak[name]
+
+    # -- the engine-facing hook ------------------------------------------
+    def on_step(self):
+        self._steps += 1
+        if self._steps % self.interval:
+            return None
+        return self.evaluate()
+
+    def evaluate(self):
+        """One control decision from the live signals. Returns the move
+        record (also appended to ``decisions``) or None."""
+        self.evaluations += 1
+        if self._hold > 0:
+            self._hold -= 1
+            return None
+        sig = self._signals()
+        move = self._decide(sig)
+        if move is None:
+            return None
+        knob, new, reason = move
+        old = self._apply(knob, new)
+        if old is None or old == new:
+            return None
+        record = {"knob": knob, "from": old, "to": new,
+                  "reason": reason, "signals": sig,
+                  "step": self._steps}
+        self.decisions.append(record)
+        del self.decisions[:-256]
+        self._streak.clear()
+        self._hold = self.cooldown
+        try:
+            from ..observability import recorder
+
+            recorder().note("tuner_move", **{
+                k: v for k, v in record.items() if k != "signals"})
+        except Exception:
+            pass
+        return record
+
+    # -- policy -----------------------------------------------------------
+    def _decide(self, sig):
+        eng, lim = self.engine, self.limits
+        # 1. admission churn: preemptions inside the interval mean the
+        # watermark let admissions outrun page capacity — back off
+        if sig["preemptions_delta"] > 0:
+            if self._bump("churn") >= self.hysteresis:
+                wm = eng.scheduler._watermark()
+                if wm < lim.max_watermark:
+                    return ("admit_watermark", wm + 1,
+                            "preemption churn: hold more free pages")
+            return None
+        # 2. TTFT pressure: prefill/admission-bound
+        if sig["ttft_burn"] > self.burn_high \
+                or sig["queue_depth"] > self.queue_high:
+            if self._bump("ttft") >= self.hysteresis:
+                pc = eng.prefill_chunks_per_step
+                if pc < lim.max_prefill_chunks:
+                    return ("prefill_chunks_per_step", pc + 1,
+                            "ttft burn/queue depth: more prefill per "
+                            "step")
+                nxt = self._ladder_next(eng.chunk_size, up=True)
+                if nxt is not None:
+                    return ("chunk_size", nxt,
+                            "ttft burn: larger prefill chunks")
+                wm = eng.scheduler._watermark()
+                if wm > lim.min_watermark and sig["free_pages"] > 0:
+                    return ("admit_watermark", wm - 1,
+                            "queue depth with pool slack: admit "
+                            "sooner")
+            return None
+        # 3. ITL pressure: decode-bound — coarser bursts amortize the
+        # per-dispatch host cost (retrace-triggering; safe-boundary
+        # rebuild, cheap under a warm compile cache)
+        if sig["itl_burn"] > self.burn_high:
+            if self._bump("itl") >= self.hysteresis:
+                pc = eng.prefill_chunks_per_step
+                if pc > lim.min_prefill_chunks:
+                    return ("prefill_chunks_per_step", pc - 1,
+                            "itl burn: fewer prefill chunks per step")
+                k = eng.decode_burst
+                if (self.tune_decode_burst and eng.spec_step is None
+                        and k < lim.max_decode_burst):
+                    return ("decode_burst", k + 1,
+                            "itl burn: amortize decode dispatch")
+            return None
+        # 4. calm: drift the burst back down so streaming/admission
+        # granularity recovers when the load does
+        if sig["ttft_burn"] < self.burn_low \
+                and sig["itl_burn"] < self.burn_low \
+                and sig["queue_depth"] == 0:
+            if self._bump("calm") >= self.hysteresis:
+                k = eng.decode_burst
+                if (self.tune_decode_burst and eng.spec_step is None
+                        and k > lim.min_decode_burst):
+                    return ("decode_burst", k - 1,
+                            "calm: finer streaming granularity")
+            return None
+        self._streak.clear()
+        return None
+
+    def _ladder_next(self, cur, up):
+        """Neighbouring chunk bucket on the engine's compiled ladder
+        (never leaves it — a value off the ladder would compile a new
+        prefill program mid-serve)."""
+        ladder = self.limits.chunk_ladder
+        try:
+            i = ladder.index(cur)
+        except ValueError:
+            return None
+        j = i + (1 if up else -1)
+        if 0 <= j < len(ladder):
+            return ladder[j]
+        return None
+
+    # -- actuation ---------------------------------------------------------
+    def _apply(self, knob, value):
+        eng = self.engine
+        if knob == "admit_watermark":
+            old = eng.scheduler._watermark()
+            eng.scheduler.admit_watermark = int(value)
+            return old
+        if knob == "prefill_chunks_per_step":
+            old = eng.prefill_chunks_per_step
+            eng.prefill_chunks_per_step = int(value)
+            return old
+        if knob == "chunk_size":
+            old = eng.chunk_size
+            eng.chunk_size = int(value)
+            return old
+        if knob == "decode_burst":
+            old = eng.decode_burst
+            eng.set_decode_burst(int(value))   # safe-boundary rebuild
+            return old
+        raise ValueError(f"unknown knob {knob!r}")

@@ -389,3 +389,66 @@ class TestSpecFamily:
         res = bc.compare(self._srec(speedup=1.9),
                          self._srec(speedup=0.9))
         assert "spec.tok_s_user_speedup" in res["regressions"]
+
+
+class TestColdStartFamily:
+    """ISSUE 17 satellite: the `cold_start` metric family —
+    compile-or-deserialize-to-first-step wall (ms) gates as an UPPER
+    bound (lower is better, 30% tolerance, 250ms absolute floor), so
+    losing the persistent-cache win round-over-round fails the gate
+    while toy-program jitter stays informational."""
+
+    @staticmethod
+    def _crec(cold=7200.0, decode_cold=1900.0, warmup=1800.0):
+        rec = _record()
+        rec["cold_start_ms"] = cold
+        rec["decode"] = {"lanes": {"bs1": {
+            "paged_cold_start_ms": decode_cold}}}
+        rec["serving"] = dict(rec["serving"],
+                              cold_start={"warmup_ms": warmup})
+        return rec
+
+    @staticmethod
+    def _row(res, suffix):
+        return next(r for r in res["rows"]
+                    if r["metric"].endswith(suffix))
+
+    def test_family_detected(self, bc):
+        m = bc.extract_metrics(self._crec())
+        assert m["cold_start_ms"] == 7200.0
+        assert m["decode.lanes.bs1.paged_cold_start_ms"] == 1900.0
+        assert m["serving.cold_start.warmup_ms"] == 1800.0
+        assert bc._family("cold_start_ms") == "cold_start"
+        assert bc._family("warmup_ms") == "cold_start"
+
+    def test_regression_flagged(self, bc):
+        # losing the warm-deserialize win (e.g. a key instability that
+        # turns every warm start into a recompile) fails the gate
+        res = bc.compare(self._crec(cold=1500.0),
+                         self._crec(cold=7200.0))
+        assert res["status"] == "regress"
+        assert "cold_start_ms" in res["regressions"]
+
+    def test_direction_and_tolerance(self, bc):
+        # faster cold start improves; +20% is inside the 30% band
+        res = bc.compare(self._crec(), self._crec(cold=1500.0))
+        assert self._row(res, "cold_start_ms")["verdict"] == "improved"
+        assert res["status"] == "pass"
+        res = bc.compare(self._crec(), self._crec(cold=7200.0 * 1.2))
+        assert res["status"] == "pass"
+
+    def test_sub_floor_is_informational(self, bc):
+        # tiny programs (sub-250ms builds) never gate on jitter
+        res = bc.compare(self._crec(cold=80.0, decode_cold=60.0,
+                                    warmup=90.0),
+                         self._crec(cold=200.0, decode_cold=140.0,
+                                    warmup=220.0))
+        assert self._row(res, "cold_start_ms")["verdict"] == "sub_floor"
+        assert res["status"] == "pass"
+
+    def test_decode_and_serve_lanes_gate(self, bc):
+        res = bc.compare(self._crec(), self._crec(decode_cold=4000.0,
+                                                  warmup=9000.0))
+        assert "decode.lanes.bs1.paged_cold_start_ms" \
+            in res["regressions"]
+        assert "serving.cold_start.warmup_ms" in res["regressions"]

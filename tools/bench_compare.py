@@ -46,6 +46,10 @@ Metric families and default tolerances (relative):
     spec_accept INFORMATIONAL ONLY: accept rate is a property of the
                      draft/model pair and legitimately moves with
                      config changes (ISSUE 16)
+    cold_start +30%  lower is better (trace+compile-or-deserialize to
+                     first step/token, milliseconds — the persistent
+                     AOT executable cache's headline metric, ISSUE 17;
+                     250ms absolute floor absorbs toy-model jitter)
 
 Latency/stall/mem metrics additionally carry an ABSOLUTE floor: when
 both sides sit under it, the row is informational (sub-floor jitter
@@ -85,6 +89,10 @@ DEFAULT_TOLERANCES = {
     # draft/model PAIR, legitimately moves with config — report only.
     "spec_yield": (0.05, True, 0.0),
     "spec_accept": (0.0, True, 0.0),
+    # cold start (ISSUE 17): compile-or-deserialize to first step, ms.
+    # Wide relative band (compile wall is scheduler-noisy) + an
+    # absolute floor so toy selftest programs never gate
+    "cold_start": (0.30, False, 250.0),
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -153,6 +161,9 @@ def _family(key):
         return "spec_accept"
     if "peak_bytes" in k:
         return "mem"
+    if ("cold_start" in k or "warmup_ms" in k
+            or "first_train_step_ms" in k or "first_decode_ms" in k):
+        return "cold_start"
     if "goodput_frac" in k:
         return "goodput"
     if "ttft" in k:
