@@ -883,6 +883,21 @@ def run_selftest():
         assert rec.get("check") == "pass", rec
         results["spec_decode_detail"] = rec
 
+    def fleet():
+        # ISSUE 18: disaggregated multi-replica serving fleet — token
+        # parity across the prefill->decode KV page hand-off and
+        # through host-ring evict/re-onload (sampled streams
+        # bit-identical to one engine), 2-replica threaded scaling
+        # >= 1.7x under emulated device occupancy, disaggregated chat
+        # ITL p99 strictly better than unified under a prefill burst,
+        # SLO-burn autoscale down/up with cold-start receipts, zero
+        # page/slot/span leaks on every replica (live and retired),
+        # strict-clean retrace sentinel fleet-wide
+        rec = _run_cpu_probe("paddle_tpu.serving.fleet_selftest",
+                             n_devices=1, timeout=900)
+        assert rec.get("check") == "pass", rec
+        results["fleet_detail"] = rec
+
     def cold_start():
         # ISSUE 17: persistent AOT executable cache — hermetic
         # process-pair A/B on one shared cache dir: cold child compiles
@@ -906,6 +921,7 @@ def run_selftest():
     check("fault_tolerance", fault_tolerance)
     check("input_pipeline", input_pipeline)
     check("serving", serving)
+    check("fleet", fleet)
     check("spec_decode", spec_decode)
     check("observability", observability)
     check("numerics", numerics)
@@ -1395,6 +1411,16 @@ if __name__ == "__main__":
                 "paddle_tpu.inference.spec_decode_selftest",
                 extra_args=("--bench",), n_devices=1, timeout=900)
         print(json.dumps(rec))
+    elif "--fleet" in sys.argv:
+        # FLEET lane (ISSUE 18): multi-replica serving — aggregate
+        # fleet tok/s + merged-sample TTFT percentiles at 1/2/4
+        # threaded replicas, the emulated-occupancy scaling ratio, the
+        # disaggregation chat-ITL A/B, and one autoscale spawn with
+        # its cold-start receipt. Hermetic CPU subprocess;
+        # BENCH_FLEET_USERS / BENCH_FLEET_REQS_PER_USER tune the load
+        print(json.dumps({"fleet": _run_cpu_probe(
+            "paddle_tpu.serving.fleet_selftest",
+            extra_args=("--bench",), n_devices=1, timeout=900)}))
     elif "--spec" in sys.argv:
         # SPEC-DECODE lane (ISSUE 16): correctness probe + serve A/B
         # (tokens/s/user plain vs speculative vs speculative+int8-KV,

@@ -74,6 +74,28 @@ def _split_state(kind, state):
             {k: v for k, v in state.items() if k not in buf_keys})
 
 
+def refresh_serving_buffers(engine):
+    """Import-slot safe boundary (ISSUE 18): re-split the cache state
+    into the serving engine's threaded buffer dict after an
+    out-of-band pool mutation (``PagedKVCache.import_slot`` — KV
+    hand-off adoption or host-ring re-onload).
+
+    Must run between engine steps, never inside one: the engine
+    threads ``_buffers`` through each compiled call and commits the
+    step's outputs back, so a pool rewritten behind its back would be
+    silently overwritten by the next commit. ``.at[].set`` returns
+    arrays with the donor pools' avals and placement, and the metadata
+    stays host numpy, so the refreshed dispatch reuses the resident
+    executable — the retrace sentinel stays strict-clean across
+    imports by construction.
+    """
+    buffers, _ = _split_state("paged", _tree_data(engine.cache.state()))
+    old = engine._buffers
+    if isinstance(old, dict) and "draft" in old:
+        buffers["draft"] = old["draft"]
+    engine._buffers = buffers
+
+
 class _Step:
     """Shared machinery: trace counting, jit/eager dispatch, donation."""
 

@@ -80,7 +80,8 @@ from .numerics import (  # noqa: F401
     NumericsMonitor, chunk_of_layer, monitor_enabled, numericsz_payload,
 )
 from .registry import (  # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry, percentile, registry,
+    Counter, Gauge, Histogram, MetricsRegistry, merge_histograms,
+    percentile, registry,
 )
 from .sentinel import (  # noqa: F401
     RetraceError, RetraceSentinel, enabled, retrace_summary,
@@ -94,7 +95,7 @@ from .tracing import Span, Tracer, drain_chrome_spans  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
-    "percentile", "StepTimeline", "JsonlSink", "read_jsonl",
+    "percentile", "merge_histograms", "StepTimeline", "JsonlSink", "read_jsonl",
     "drain_chrome_counters", "RetraceSentinel", "RetraceError",
     "set_strict_retrace", "strict_retrace", "retrace_summary",
     "enabled", "FlightRecorder", "recorder", "install",

@@ -29,7 +29,7 @@ import re
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "registry", "percentile"]
+           "registry", "percentile", "merge_histograms"]
 
 
 def percentile(values, q):
@@ -200,6 +200,36 @@ class Histogram:
             "p50": percentile(xs, 50),
             "p99": percentile(xs, 99),
         }
+
+
+def merge_histograms(hists, name="merged", window=None):
+    """Fleet-correct percentile aggregation (ISSUE 18): one Histogram
+    holding the UNION of the inputs' ring windows, so a fleet p99 is
+    the p99 of merged samples. Averaging per-replica p99s is wrong the
+    moment replicas are skewed — one slow replica's tail divided by N
+    disappears — and quantiles don't compose any other way without the
+    raw samples, which the rings keep.
+
+    The merged window defaults to the sum of the input windows so no
+    input sample ages out during the merge. Lifetime count/sum/min/max
+    fold ALL samples each input ever observed, not just the windows,
+    so ``snapshot()["count"]`` stays the true fleet event count.
+    """
+    hists = list(hists)
+    if window is None:
+        window = max(1, sum(h.window for h in hists))
+    out = Histogram(name, window=int(window))
+    for h in hists:
+        out.extend(h.samples())
+    with out._lock:
+        counts = [h.count for h in hists]
+        out._count = sum(counts)
+        out._sum = sum(h.total for h in hists)
+        mins = [h._min for h in hists if h._min is not None]
+        maxs = [h._max for h in hists if h._max is not None]
+        out._min = min(mins) if mins else None
+        out._max = max(maxs) if maxs else None
+    return out
 
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")

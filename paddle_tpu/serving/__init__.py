@@ -19,13 +19,21 @@ sequence ever waits for another's tail (ROADMAP item 1).
 * ``OnlineTuner`` — opt-in closed loop (ISSUE 17) nudging admission
   watermark / prefill aggressiveness / decode burst from live SLO-burn
   and queue-depth gauges; bounded, hysteretic, flight-recorded.
+* ``FleetRouter`` — the multi-replica tier (ISSUE 18): session-affinity
+  + power-of-two-choices routing over N engine replicas,
+  prefill/decode disaggregation with KV page hand-off, host-memory KV
+  eviction (``HostKVRing``), and SLO-burn autoscaling
+  (``SLOBurnAutoscaler``).
 """
 from .engine import ServingEngine
+from .fleet import FleetRouter, HostKVRing, SLOBurnAutoscaler
 from .metrics import ServingMetrics, percentile
 from .request import Request, RequestHandle, RequestState
+from .router import ReplicaRouter
 from .scheduler import RequestScheduler
 from .tuner import OnlineTuner, TunerLimits
 
 __all__ = ["ServingEngine", "RequestScheduler", "ServingMetrics",
            "Request", "RequestHandle", "RequestState", "percentile",
-           "OnlineTuner", "TunerLimits"]
+           "OnlineTuner", "TunerLimits", "FleetRouter", "HostKVRing",
+           "SLOBurnAutoscaler", "ReplicaRouter"]

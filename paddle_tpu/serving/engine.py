@@ -42,7 +42,8 @@ import numpy as np
 
 from ..inference.kv_cache import PagedKVCache
 from ..jit.decode_step import (ChunkPrefillStep, ServeDecodeStep,
-                               ServeSpecDecodeStep, _split_state)
+                               ServeSpecDecodeStep, _split_state,
+                               refresh_serving_buffers)
 from ..jit.train_step import _tree_data
 from ..observability import SLOTracker, Tracer
 from .metrics import ServingMetrics
@@ -63,7 +64,8 @@ class ServingEngine:
                  clock=time.perf_counter,
                  trace=True, trace_capacity=256, exemplar_capacity=32,
                  exemplar_quantile=99.0, exemplar_min_samples=32,
-                 slos=(), debug_port=None, tuner=False, tuner_kw=None):
+                 slos=(), debug_port=None, tuner=False, tuner_kw=None,
+                 prefill_only=False, host_kv_ring=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -163,6 +165,12 @@ class ServingEngine:
         self.scheduler = RequestScheduler(
             self.cache, self.metrics, admit_watermark=admit_watermark,
             tracer=self.tracer)
+        # fleet roles (ISSUE 18): a prefill-only replica runs chunked
+        # prefill and stops — its finished sequences are exported to a
+        # decode replica via the KV hand-off; a host KV ring turns
+        # preemption into evict-to-host with onload-on-readmit
+        self.prefill_only = bool(prefill_only)
+        self.scheduler.host_ring = host_kv_ring
         # the "auto" admission watermark provisions free pages for one
         # dispatch's worth of growth per live slot
         self.scheduler.token_lookahead = (
@@ -222,10 +230,15 @@ class ServingEngine:
 
     # -- client surface ---------------------------------------------------
     def submit(self, prompt, max_new_tokens, priority=0,
-               eos_token_id=None, seed=None, on_token=None
+               eos_token_id=None, seed=None, on_token=None, rid=None
                ) -> RequestHandle:
         """Queue a request; returns a streaming handle immediately.
         Tokens arrive as the engine steps (`step()`/`run()`/`stream()`).
+
+        ``rid`` (optional) overrides the engine-local request id: the
+        fleet assigns GLOBALLY unique rids so one request's trace legs
+        stitch across replicas (prefill leg, decode leg, onload) by the
+        same ``req<rid>`` track name.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -241,8 +254,12 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {self.cache.pages_needed(total)} pages "
                 f"but the pool only has {self.num_pages - 1}")
-        rid = self._rid
-        self._rid += 1
+        if rid is None:
+            rid = self._rid
+            self._rid += 1
+        else:
+            rid = int(rid)
+            self._rid = max(self._rid, rid + 1)
         req = Request(rid, prompt, int(max_new_tokens),
                       priority=int(priority), eos_token_id=eos_token_id,
                       seed=int(seed) if seed is not None else rid)
@@ -265,6 +282,7 @@ class ServingEngine:
         decode for all running sequences. Returns False when idle."""
         sched = self.scheduler
         try:
+            onloaded = False
             for h in sched.admit():
                 # full-width uint32: distinct seeds stay distinct
                 # streams (per_slot_keys folds the raw 32-bit value)
@@ -273,11 +291,24 @@ class ServingEngine:
                 self.tracer.end(h._span_queue,
                                 resumed=h.preemptions > 0)
                 h._span_queue = None
+                if (h.state is RequestState.RUNNING
+                        and h._onload_token is not None):
+                    # host-ring re-onload: the imported slot rejoins
+                    # decode directly; its last sampled token travelled
+                    # with the pages
+                    self._tokens[h.slot] = int(h._onload_token)
+                    h._onload_token = None
+                    onloaded = True
                 self.tracer.instant(
                     "admit", parent=h._span, slot=h.slot,
                     pages_held=len(
                         self.cache._slot_pages.get(h.slot, ())),
-                    resumed=h.preemptions > 0)
+                    resumed=h.preemptions > 0,
+                    onload=h.state is RequestState.RUNNING)
+            if onloaded:
+                # import_slot rewrote pool pages out-of-band — re-split
+                # at the safe boundary before the next compiled call
+                refresh_serving_buffers(self)
             worked = False
             for _ in range(self.prefill_chunks_per_step):
                 heads = sched.prefill_heads(self.prefill_batch)
@@ -285,7 +316,7 @@ class ServingEngine:
                     break
                 self._run_prefill_chunk(heads)
                 worked = True
-            if sched.decode_slots():
+            if not self.prefill_only and sched.decode_slots():
                 worked |= self._run_decode()
         except BaseException:
             self._recover()
@@ -321,6 +352,64 @@ class ServingEngine:
                 raise RuntimeError("request is not resident and the "
                                    "engine is idle")
             self.step()
+
+    # -- prefill/decode disaggregation (ISSUE 18) -------------------------
+    def export_handoff(self, slot: int):
+        """Detach a freshly-prefilled sequence for adoption by a decode
+        replica: copies its KV pages out, frees the slot, and closes
+        this engine's leg of the request trace. Returns
+        ``(handle, blob, last_token)`` — the not-yet-cached last sample
+        travels with the pages, exactly like an eviction."""
+        handle = self.scheduler.running.pop(slot)
+        blob = self.cache.export_slot(slot)
+        last_token = int(handle.output_tokens[-1])
+        self.cache.free(slot)
+        handle.slot = None
+        if handle._span is not None:
+            self.tracer.instant("kv_handoff_export", parent=handle._span,
+                                slot=slot, pages=blob["pages"],
+                                bytes=blob["nbytes"])
+            self.tracer.end(handle._span, handoff=True,
+                            tokens=len(handle.output_tokens))
+            handle._span = None
+        return handle, blob, last_token
+
+    def can_adopt(self, blob: dict) -> bool:
+        """Would ``adopt_handoff`` land without instantly starving the
+        resident decode set? Same watermark rule as admission."""
+        seq_len = int(blob["seq_len"])
+        if not self.cache.can_allocate(seq_len):
+            return False
+        left = self.cache.free_page_count - int(blob["pages"])
+        return left >= self.scheduler._watermark()
+
+    def adopt_handoff(self, handle: RequestHandle, blob: dict,
+                      last_token: int, refresh: bool = True) -> int:
+        """Land a prefill replica's exported sequence: import the pages,
+        join the decode set, open this engine's leg of the trace (same
+        ``req<rid>`` track — the fleet stitches the legs by rid).
+        ``refresh=False`` lets a caller adopting a BATCH defer the
+        buffer resync and pay it once (it must call
+        ``refresh_serving_buffers`` itself before the next step)."""
+        slot = self.cache.import_slot(blob, active=True)
+        if refresh:
+            refresh_serving_buffers(self)
+        rid = handle.request.rid
+        handle.slot = slot
+        handle.state = RequestState.RUNNING
+        self.scheduler.running[slot] = handle
+        self._tokens[slot] = int(last_token)
+        self._seeds[slot] = np.uint32(handle.request.seed & 0xFFFFFFFF)
+        handle._span = self.tracer.begin(
+            "request", track=f"req{rid}", rid=rid, phase="decode",
+            handoff=True, prompt_len=len(handle.request.prompt),
+            max_new_tokens=handle.request.max_new_tokens,
+            priority=handle.request.priority)
+        self.tracer.instant("kv_handoff_import", parent=handle._span,
+                            slot=slot, pages=blob["pages"],
+                            bytes=blob["nbytes"])
+        self.metrics.on_admit(resumed=False)
+        return slot
 
     def compile_counts(self) -> dict:
         """Retrace probe surface: decode must stay at ONE trace across
@@ -390,14 +479,19 @@ class ServingEngine:
         h0 = reg.counter("jit.cache.hit").value
         m0 = reg.counter("jit.cache.miss").value
         t0 = time.perf_counter()
+        # a prefill-only replica never decodes: warm just the chunk
+        # buckets (1-token requests finish at prefill), skipping the
+        # decode program entirely
+        new_tokens = 1 if self.prefill_only else 2
         for b in self.chunk_buckets:
             plen = max(1, min(b, self.max_len - 2))
-            self.submit(np.ones((plen,), np.int32), 2)
+            self.submit(np.ones((plen,), np.int32), new_tokens)
             self.run()
         self.last_warmup_ms = (time.perf_counter() - t0) * 1e3
         self._warmup_report = {
             "warmup_ms": round(self.last_warmup_ms, 3),
-            "programs": len(self.chunk_buckets) + 1,
+            "programs": len(self.chunk_buckets) + (
+                0 if self.prefill_only else 1),
             "cache_hits": reg.counter("jit.cache.hit").value - h0,
             "cache_misses": reg.counter("jit.cache.miss").value - m0,
         }

@@ -1,0 +1,752 @@
+"""Disaggregated multi-replica serving fleet (ISSUE 18).
+
+One ``FleetRouter`` fronts N ``ServingEngine`` replicas — each with
+its own registry, tracer, scheduler and KV pool — and owns four
+policies the single engine cannot express:
+
+* **Routing** (`router.ReplicaRouter`): sessions stick to a replica by
+  rendezvous hashing (add/remove remaps only ~1/N sessions);
+  sessionless requests go power-of-two-choices on live queue depth.
+* **Prefill/decode disaggregation**: dedicated prefill replicas
+  (``prefill_only=True`` engines) run chunked prefill and nothing
+  else; every sequence that finishes prefill is harvested —
+  ``export_handoff`` on the prefill side, ``adopt_handoff`` on a
+  decode replica — so a prefill burst lands on prefill hardware and
+  never lumps whole chunk batches into decode replicas' inter-token
+  gaps. The first token is emitted by the prefill leg (TTFT is paid
+  where the work is); the decode leg continues the stream
+  bit-identically (same pages, same per-request seed, same programs).
+* **KV eviction to host memory** (`HostKVRing`): decode replicas with
+  a ring park preemption victims' pages host-side instead of
+  discarding them; re-admission imports the pages back (a ``kv_onload``
+  span on the victim's trace) instead of re-prefilling. The ring is
+  byte-capped and drops oldest-first — a dropped blob silently falls
+  back to the pre-fleet resume-by-re-prefill path.
+* **SLO-burn autoscaling** (`SLOBurnAutoscaler`): the decode set
+  grows when the worst per-replica SLO burn rate stays hot and shrinks
+  when it stays cold — burn rate, not raw QPS, so an over-provisioned
+  fleet under heavy-but-meeting-SLO load does NOT flap. Spawned
+  replicas record cold-start-to-first-token; with the persistent
+  compile cache warm that spin-up is a deserialize.
+
+Threading model: one thread per replica (``threaded=True``) or a
+cooperative round-robin ``step()``/``run()`` loop (deterministic —
+the parity lanes use it). Locks are strictly one-at-a-time: replica
+loops hold only their own lock; hand-off dispatch enqueues under the
+target's lock AFTER releasing the source's; the autoscaler pauses the
+whole fleet (ordered acquisition) only around a spawn's warmup so a
+fresh trace never races a live dispatch.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from ..jit.decode_step import refresh_serving_buffers
+from ..observability import merge_histograms
+from ..observability import registry as _global_registry
+from .engine import ServingEngine
+from .request import RequestState
+from .router import ReplicaRouter
+
+__all__ = ["FleetRouter", "HostKVRing", "SLOBurnAutoscaler"]
+
+# host ring default size, MB (0 = off) — overridable per fleet
+_RING_FLAG = "PADDLE_TPU_KV_HOST_RING_MB"
+
+
+class HostKVRing:
+    """Byte-capped host-memory parking lot for evicted KV blobs,
+    keyed by rid. LRU-by-insertion: when a put overflows the cap the
+    oldest entries drop (their requests fall back to re-prefill).
+    Thread-safe — decode replicas share one ring, so fleet-wide host
+    memory spent on parked sessions stays bounded by ONE number."""
+
+    def __init__(self, capacity_mb: float = 64.0):
+        self.capacity_bytes = max(0, int(float(capacity_mb) * (1 << 20)))
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()  # rid -> (blob, tok)
+        self.bytes = 0
+        self.puts = 0
+        self.takes = 0
+        self.drops = 0
+
+    def put(self, rid: int, blob: dict, last_token: int):
+        with self._lock:
+            old = self._entries.pop(rid, None)
+            if old is not None:
+                self.bytes -= old[0]["nbytes"]
+            self._entries[rid] = (blob, int(last_token))
+            self.bytes += blob["nbytes"]
+            self.puts += 1
+            while self.bytes > self.capacity_bytes and self._entries:
+                _, (dropped, _tok) = self._entries.popitem(last=False)
+                self.bytes -= dropped["nbytes"]
+                self.drops += 1
+
+    def peek(self, rid: int):
+        with self._lock:
+            return self._entries.get(rid)
+
+    def take(self, rid: int):
+        with self._lock:
+            entry = self._entries.pop(rid, None)
+            if entry is not None:
+                self.bytes -= entry[0]["nbytes"]
+                self.takes += 1
+            return entry
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self.bytes,
+                    "capacity_bytes": self.capacity_bytes,
+                    "puts": self.puts, "takes": self.takes,
+                    "drops": self.drops}
+
+
+class _Replica:
+    """One engine + its thread/lock/hand-off inbox."""
+
+    def __init__(self, name: str, role: str, engine):
+        self.name = name
+        self.role = role                    # "decode" | "prefill"
+        self.engine = engine
+        self.lock = threading.RLock()
+        self.thread = None
+        self.stop = False
+        self.draining = False
+        self.error = None
+        self.pending_imports: deque = deque()  # (handle, blob, token)
+        self.spawn_report = None
+
+    @property
+    def load(self) -> int:
+        s = self.engine.scheduler
+        return (len(s.waiting) + len(s.running)
+                + len(self.pending_imports))
+
+
+class FleetRouter:
+    def __init__(self, model=None, model_factory=None,
+                 decode_replicas=1, prefill_replicas=0, engine_kw=None,
+                 threaded=False, seed=0, host_ring_mb=None,
+                 autoscale=None, engine_cls=ServingEngine,
+                 clock=time.perf_counter):
+        if model is None and model_factory is None:
+            raise ValueError("pass a model or a model_factory")
+        # a shared model is safe because replicas only ever BIND the
+        # same param objects (identical references); a model_factory
+        # gives each replica its own instance instead
+        self._model_factory = (model_factory if model_factory is not None
+                               else (lambda: model))
+        self.engine_cls = engine_cls
+        self.engine_kw = dict(engine_kw or {})
+        self.threaded = bool(threaded)
+        self.clock = clock
+        if host_ring_mb is None:
+            host_ring_mb = float(os.environ.get(_RING_FLAG, "0") or 0)
+        self.host_ring = (HostKVRing(host_ring_mb)
+                          if host_ring_mb and host_ring_mb > 0 else None)
+        self.router = ReplicaRouter(seed=seed)          # decode set
+        self.prefill_router = ReplicaRouter(seed=seed + 1)
+        self._replicas: list[_Replica] = []
+        self._retired: list[_Replica] = []
+        self._by_name: dict[str, _Replica] = {}
+        self._spawned = {"decode": 0, "prefill": 0}
+        self._requests: dict[int, dict] = {}    # rid -> routing entry
+        self._rid = 0
+        self._submit_lock = threading.Lock()
+        # exported-but-not-yet-enqueued hand-offs: counted so has_work
+        # (and therefore drain) can never observe "idle" while a
+        # sequence is in flight between a prefill replica's harvest and
+        # its decode replica's inbox
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        # adoptions per replica loop pass: one by default, so a wave of
+        # hand-offs smears its import cost across many inter-token gaps
+        # instead of landing the whole batch inside one (the thing the
+        # disaggregation exists to prevent)
+        self.adopt_batch = 1
+        # threaded mode: a prefill replica sleeps this long after every
+        # worked step. Prefill is the throughput role and decode the
+        # latency role — without the yield the prefill thread convoys
+        # the GIL through back-to-back chunk batches and decode's
+        # inter-token gaps eat SEVERAL chunks instead of at most one
+        # (measured 12ms vs 5ms p99 on the CPU lane)
+        self.prefill_yield_s = 2e-4
+        self._started = False
+        self.events: list[dict] = []    # spawn/drain/autoscale log
+        for _ in range(int(prefill_replicas)):
+            self._add_replica(self._spawn_replica("prefill", warm=False))
+        for _ in range(int(decode_replicas)):
+            self._add_replica(self._spawn_replica("decode", warm=False))
+        self.autoscaler = None
+        if autoscale is not None:
+            if isinstance(autoscale, SLOBurnAutoscaler):
+                self.autoscaler = autoscale
+            else:
+                self.autoscaler = SLOBurnAutoscaler(
+                    self, **(autoscale if isinstance(autoscale, dict)
+                             else {}))
+        self._bind_gauges()
+
+    # -- construction -----------------------------------------------------
+    def _spawn_replica(self, role: str, warm: bool) -> _Replica:
+        idx = self._spawned[role]
+        self._spawned[role] += 1
+        name = f"{'p' if role == 'prefill' else 'd'}{idx}"
+        t0 = self.clock()
+        kw = dict(self.engine_kw)
+        kw.setdefault("clock", self.clock)
+        eng = self.engine_cls(
+            self._model_factory(), prefill_only=(role == "prefill"),
+            host_kv_ring=(self.host_ring if role == "decode" else None),
+            **kw)
+        r = _Replica(name, role, eng)
+        if warm:
+            # cold-start-to-first-token receipt: a tiny probe through
+            # the fresh engine times the first prefill+decode programs
+            # (compiles, or deserializes from the persistent cache),
+            # then warmup covers the remaining chunk buckets
+            probe = eng.submit(np.ones((4,), np.int32),
+                               1 if role == "prefill" else 2)
+            eng.run()
+            first_ms = (probe.first_token_time - t0) * 1e3
+            eng.warmup()
+            if self._migration_enabled():
+                self._warm_migration(eng)
+            r.spawn_report = {
+                "cold_start_to_first_token_ms": round(first_ms, 3),
+                "spawn_ms": round((self.clock() - t0) * 1e3, 3),
+                **eng.warmup_report,
+            }
+        return r
+
+    def _add_replica(self, r: _Replica):
+        self._replicas.append(r)
+        self._by_name[r.name] = r
+        (self.router if r.role == "decode"
+         else self.prefill_router).add(r.name)
+        if self.threaded and self._started:
+            self._start_thread(r)
+
+    def _bind_gauges(self):
+        g = _global_registry()
+        g.gauge("fleet.replicas").set_fn(
+            lambda: len(self._replicas))
+        g.gauge("fleet.decode_replicas").set_fn(
+            lambda: len(self.decode_replicas()))
+        g.gauge("fleet.queue_depth").set_fn(
+            lambda: sum(r.load for r in list(self._replicas)))
+        g.gauge("fleet.host_ring_bytes").set_fn(
+            lambda: self.host_ring.bytes if self.host_ring else 0)
+        g.gauge("fleet.host_ring_entries").set_fn(
+            lambda: len(self.host_ring) if self.host_ring else 0)
+
+    # -- replica views ----------------------------------------------------
+    def decode_replicas(self) -> list[_Replica]:
+        return [r for r in self._replicas
+                if r.role == "decode" and not r.draining]
+
+    def prefill_replicas(self) -> list[_Replica]:
+        return [r for r in self._replicas
+                if r.role == "prefill" and not r.draining]
+
+    def replica(self, name: str) -> _Replica:
+        return self._by_name[name]
+
+    def _load_of(self, name: str) -> int:
+        r = self._by_name.get(name)
+        return r.load if r is not None else 1 << 30
+
+    # -- client surface ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens, priority=0,
+               eos_token_id=None, seed=None, session=None,
+               on_token=None):
+        """Route one request into the fleet; returns its handle. The
+        fleet rid is globally unique (trace legs stitch by it) and
+        doubles as the default sampling seed — a request's token
+        stream depends only on (prompt, seed), never on which replica
+        serves it."""
+        with self._submit_lock:
+            rid = self._rid
+            self._rid += 1
+        if seed is None:
+            seed = rid
+        dname = self.router.pick(self._load_of, session=session)
+        entry = {"decode": dname, "session": session}
+        if self.prefill_replicas():
+            entry["prefill"] = self.prefill_router.pick(self._load_of)
+            target = self._by_name[entry["prefill"]]
+        else:
+            target = self._by_name[dname]
+        with target.lock:
+            handle = target.engine.submit(
+                prompt, max_new_tokens, priority=priority,
+                eos_token_id=eos_token_id, seed=seed,
+                on_token=on_token, rid=rid)
+        entry["handle"] = handle
+        self._requests[rid] = entry
+        return handle
+
+    # -- hand-off ---------------------------------------------------------
+    def _harvest_locked(self, r: _Replica) -> list:
+        """Export every sequence that finished prefill on a prefill
+        replica (caller holds r.lock). Requests that FINISHED on the
+        prefill leg (max_new_tokens == 1) retire there and are never
+        exported."""
+        out = []
+        eng = r.engine
+        cands = [slot for slot in sorted(eng.scheduler.running)
+                 if eng.scheduler.running[slot].state
+                 is RequestState.RUNNING
+                 and not eng.scheduler.running[slot].done]
+        if not cands:
+            return out
+        # count BEFORE exporting: export_handoff pops the handle from
+        # the scheduler, so from that instant until dispatch the
+        # in-flight counter is the only thing keeping has_work() true
+        with self._inflight_lock:
+            self._inflight += len(cands)
+        done = 0
+        try:
+            for slot in cands:
+                out.append(eng.export_handoff(slot))
+                done += 1
+        finally:
+            if done < len(cands):
+                with self._inflight_lock:
+                    self._inflight -= len(cands) - done
+        return out
+
+    def _dispatch_handoff(self, item):
+        """Enqueue an exported sequence on its decode replica's inbox
+        (no other lock held). A draining/retired target re-routes."""
+        handle, blob, _tok = item
+        rid = handle.request.rid
+        try:
+            entry = self._requests.get(rid, {})
+            r = self._by_name.get(entry.get("decode"))
+            if r is None or r.draining or r.role != "decode":
+                entry["decode"] = self.router.pick(
+                    self._load_of, session=entry.get("session"))
+                r = self._by_name[entry["decode"]]
+            with r.lock:
+                r.pending_imports.append(item)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _drain_imports_locked(self, r: _Replica) -> bool:
+        moved = False
+        adopted = 0
+        while r.pending_imports and adopted < self.adopt_batch:
+            handle, blob, tok = r.pending_imports[0]
+            if not r.engine.can_adopt(blob):
+                break
+            # adopt FIRST, pop after: the item must stay visible in the
+            # inbox while the import runs, or has_work() (lockless, the
+            # drain poll) sees an idle fleet mid-adoption and returns
+            # with the sequence in limbo
+            r.engine.adopt_handoff(handle, blob, tok, refresh=False)
+            r.pending_imports.popleft()
+            moved = True
+            adopted += 1
+        if moved:
+            # one buffer resync for the whole adopted batch
+            refresh_serving_buffers(r.engine)
+        return moved
+
+    # -- cooperative loop -------------------------------------------------
+    def step(self) -> bool:
+        """One round-robin pass over every replica (deterministic —
+        single-threaded mode). Returns False when the fleet is idle."""
+        worked = False
+        exported = []
+        for r in list(self._replicas):
+            with r.lock:
+                worked |= self._drain_imports_locked(r)
+                if r.engine.scheduler.has_work():
+                    worked |= bool(r.engine.step())
+                if r.role == "prefill":
+                    exported.extend(self._harvest_locked(r))
+        for item in exported:
+            self._dispatch_handoff(item)
+            worked = True
+        if self.autoscaler is not None:
+            self.autoscaler.tick()
+        self._finalize_drained()
+        return worked
+
+    def has_work(self) -> bool:
+        return (self._inflight > 0
+                or any(r.engine.scheduler.has_work() or r.pending_imports
+                       for r in list(self._replicas)))
+
+    def run(self, max_steps=2_000_000) -> dict:
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain in {max_steps} steps")
+        return self.metrics_snapshot()
+
+    def warmup(self):
+        """Serial warmup of every replica (all tracing up front — the
+        threaded loops then only ever dispatch resident programs)."""
+        migrate = self._migration_enabled()
+        for r in list(self._replicas):
+            with r.lock:
+                r.engine.warmup()
+                if migrate:
+                    self._warm_migration(r.engine)
+        return self
+
+    def _migration_enabled(self) -> bool:
+        return (self.host_ring is not None
+                or any(r.role == "prefill" for r in self._replicas)
+                or self._spawned["prefill"] > 0)
+
+    @staticmethod
+    def _warm_migration(eng):
+        """Compile the bucketed export/import executables up front: one
+        export gather + one import scatter per migration bucket. The
+        page-index shape is bucketed (kv_cache.migration_bucket), so
+        this covers EVERY shape a live hand-off, eviction or onload can
+        dispatch — without it, the first migration mid-stream pays an
+        op-by-op XLA compile inside somebody's inter-token gap (~250ms
+        measured on the CPU lane)."""
+        cache = eng.cache
+        for w in cache.migration_buckets():
+            # largest allocatable page count that still rounds up to
+            # this bucket: a bucket reachable by live sequences (e.g. a
+            # 28-page max_len slot in the 32 bucket) is warmed even when
+            # a full-width allocation exceeds the engine's max_len
+            lo = w // 2
+            n = next((n for n in range(w, lo, -1)
+                      if cache.can_allocate((n - 1) * cache.page_size
+                                            + 1)), None)
+            if n is None:
+                continue
+            seq_len = (n - 1) * cache.page_size + 1
+            slot = cache.allocate(seq_len)
+            cache._host("seq_lens")[slot] = seq_len
+            blob = cache.export_slot(slot)
+            cache.free(slot)
+            cache.free(cache.import_slot(blob))
+        # the imports rebound the pool arrays — resync the engine's
+        # buffer dict at this safe boundary
+        refresh_serving_buffers(eng)
+
+    # -- threaded loop ----------------------------------------------------
+    def start(self):
+        self._started = True
+        if self.threaded:
+            for r in list(self._replicas):
+                self._start_thread(r)
+        return self
+
+    def _start_thread(self, r: _Replica):
+        if r.thread is not None:
+            return
+        r.stop = False
+        r.thread = threading.Thread(target=self._replica_loop,
+                                    args=(r,), daemon=True,
+                                    name=f"fleet-{r.name}")
+        r.thread.start()
+
+    def _replica_loop(self, r: _Replica):
+        while not r.stop:
+            worked = False
+            exported = ()
+            try:
+                with r.lock:
+                    worked |= self._drain_imports_locked(r)
+                    if r.engine.scheduler.has_work():
+                        worked |= bool(r.engine.step())
+                    if r.role == "prefill":
+                        exported = self._harvest_locked(r)
+            except BaseException as e:    # surfaced by drain()/stop()
+                r.error = e
+                return
+            for item in exported:
+                self._dispatch_handoff(item)
+                worked = True
+            if not worked:
+                time.sleep(5e-4)
+            elif r.role == "prefill" and self.prefill_yield_s:
+                time.sleep(self.prefill_yield_s)
+
+    def drain(self, timeout_s=300.0, poll_s=0.002) -> dict:
+        """Block until every submitted request finished (threaded
+        mode), then return the fleet snapshot."""
+        deadline = self.clock() + float(timeout_s)
+        while self.has_work():
+            self._raise_replica_errors()
+            if self.autoscaler is not None:
+                self.autoscaler.tick()
+            self._finalize_drained()
+            if self.clock() > deadline:
+                raise RuntimeError(
+                    f"fleet did not drain within {timeout_s}s: "
+                    f"{ {r.name: r.load for r in self._replicas} }")
+            time.sleep(poll_s)
+        self._raise_replica_errors()
+        # quiesce before the snapshot: has_work() can go false while a
+        # replica thread is still INSIDE the step() that retired the
+        # last request (counters/handle flags not yet published —
+        # observed as a 47/48 finished reading); every step runs under
+        # the replica lock, so taking each lock once guarantees the
+        # final step completed before we read
+        for r in list(self._replicas):
+            with r.lock:
+                pass
+        self._finalize_drained()
+        return self.metrics_snapshot()
+
+    def _raise_replica_errors(self):
+        for r in list(self._replicas):
+            if r.error is not None:
+                raise RuntimeError(
+                    f"replica {r.name} failed") from r.error
+
+    def stop(self):
+        for r in list(self._replicas):
+            r.stop = True
+        for r in list(self._replicas):
+            if r.thread is not None:
+                r.thread.join(timeout=30)
+                r.thread = None
+        self._started = False
+        self._finalize_drained()
+
+    def _paused(self):
+        """Ordered acquisition of every replica lock — quiesces all
+        dispatch so a spawn's warmup traces alone. Returns the lock
+        list; caller releases in reverse."""
+        locks = [r.lock for r in list(self._replicas)]
+        for lk in locks:
+            lk.acquire()
+        return locks
+
+    # -- elasticity -------------------------------------------------------
+    def scale_up(self, reason="manual", burn=None) -> _Replica:
+        """Spawn, warm and enlist one decode replica. Fleet-paused for
+        the warmup in threaded mode (fresh traces never race live
+        dispatches); the cold-start receipt lands in the event log."""
+        locks = self._paused() if self.threaded else []
+        try:
+            r = self._spawn_replica("decode", warm=True)
+            self._add_replica(r)
+        finally:
+            for lk in reversed(locks):
+                lk.release()
+        self.events.append({"action": "scale_up", "replica": r.name,
+                            "reason": reason, "burn": burn,
+                            "decode_replicas": len(
+                                self.decode_replicas()),
+                            **(r.spawn_report or {})})
+        return r
+
+    def scale_down(self, name=None, reason="manual", burn=None):
+        """Mark one decode replica draining: routers stop sending it
+        work (rendezvous remaps only its ~1/N sessions), resident
+        requests finish in place, and the drained replica retires with
+        its leak receipt in the event log."""
+        cands = self.decode_replicas()
+        if len(cands) <= 1:
+            raise RuntimeError("cannot scale below one decode replica")
+        if name is None:
+            # least loaded, newest first: the cheapest drain
+            r = min(reversed(cands), key=lambda c: c.load)
+        else:
+            r = self._by_name[name]
+        r.draining = True
+        self.router.remove(r.name)
+        self.events.append({"action": "scale_down", "replica": r.name,
+                            "reason": reason, "burn": burn,
+                            "decode_replicas": len(
+                                self.decode_replicas())})
+        return r
+
+    def _finalize_drained(self):
+        for r in [x for x in self._replicas if x.draining]:
+            with r.lock:
+                busy = (r.engine.scheduler.has_work()
+                        or r.pending_imports)
+            if busy:
+                continue
+            r.stop = True
+            if r.thread is not None and \
+                    r.thread is not threading.current_thread():
+                r.thread.join(timeout=30)
+                r.thread = None
+            self._replicas.remove(r)
+            self._retired.append(r)
+            self._by_name.pop(r.name, None)
+            self.events.append({
+                "action": "retired", "replica": r.name,
+                "leak_check": r.engine.leak_check(),
+                "open_spans": len(r.engine.tracer.open_spans()),
+            })
+
+    # -- observability ----------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        """Fleet-level rollup: per-replica snapshots plus MERGED-sample
+        percentiles (a fleet p99 is the p99 of the union of samples —
+        never an average of per-replica p99s)."""
+        reps = list(self._replicas) + list(self._retired)
+        per = {r.name: r.engine.metrics_snapshot() for r in reps}
+        ttft = merge_histograms(
+            [r.engine.metrics.ttft_s for r in reps], name="fleet.ttft_s")
+        itl = merge_histograms(
+            [r.engine.metrics.itl_s for r in reps], name="fleet.itl_s")
+        out = {
+            "replicas": per,
+            "decode_replicas": len(self.decode_replicas()),
+            "prefill_replicas": len(self.prefill_replicas()),
+            "retired_replicas": len(self._retired),
+            "fleet_ttft_p50_s": ttft.percentile(50),
+            "fleet_ttft_p99_s": ttft.percentile(99),
+            "fleet_itl_p50_s": itl.percentile(50),
+            "fleet_itl_p99_s": itl.percentile(99),
+            "events": list(self.events),
+        }
+        for key in ("submitted", "finished", "generated_tokens",
+                    "preemptions", "kv_evictions", "kv_onloads",
+                    "prefill_chunks", "decode_steps"):
+            out[f"fleet_{key}"] = sum(p.get(key, 0)
+                                      for p in per.values())
+        if self.host_ring is not None:
+            out["host_ring"] = self.host_ring.stats()
+        return out
+
+    def request_trace(self, rid: int) -> list:
+        """Every replica's completed leg of one request, stitched by
+        the shared ``req<rid>`` track and ordered by start time —
+        disaggregated requests show a prefill leg (closed with
+        ``handoff=True``) followed by a decode leg."""
+        legs = []
+        for r in list(self._replicas) + list(self._retired):
+            root = r.engine.tracer.find_trace(f"req{rid}")
+            if root is not None:
+                legs.append({"replica": r.name, "role": r.role,
+                             "root": root})
+        legs.sort(key=lambda leg: leg["root"].t0)
+        return legs
+
+    def leak_check(self) -> dict:
+        """Fleet-wide invariant surface: pool conservation and span
+        hygiene on EVERY replica (live and retired) plus the host
+        ring. After a drain, ``clean`` must be True: all pages/slots
+        free, no open or orphaned spans, ring empty."""
+        out = {"replicas": {}, "clean": True}
+        for r in list(self._replicas) + list(self._retired):
+            leaks = r.engine.leak_check()
+            stats = r.engine.cache.pool_stats()
+            rep = {
+                **leaks,
+                "pool_conserved": (stats["used_pages"]
+                                   + stats["free_pages"]
+                                   == stats["total_pages"]),
+                "open_spans": len(r.engine.tracer.open_spans()),
+                "orphan_spans": len(r.engine.tracer.orphans()),
+                "pending_imports": len(r.pending_imports),
+            }
+            rep["clean"] = (
+                leaks["free_pages"] == leaks["total_pages"]
+                and leaks["free_slots"] == leaks["total_slots"]
+                and leaks["resident_slot_pages"] == 0
+                and rep["pool_conserved"] and rep["open_spans"] == 0
+                and rep["orphan_spans"] == 0
+                and rep["pending_imports"] == 0)
+            out["replicas"][r.name] = rep
+            out["clean"] = out["clean"] and rep["clean"]
+        if self.host_ring is not None:
+            ring = self.host_ring.stats()
+            out["host_ring"] = ring
+            out["clean"] = (out["clean"] and ring["entries"] == 0
+                            and ring["bytes"] == 0)
+        return out
+
+    def retrace_stats(self) -> dict:
+        return {r.name: r.engine.retrace_stats()
+                for r in list(self._replicas) + list(self._retired)}
+
+
+class SLOBurnAutoscaler:
+    """Decode-set elasticity from SLO burn rate (ISSUE 18).
+
+    ``tick()`` samples the WORST burn rate across decode replicas'
+    declared SLOs (the fleet's engines carry the ISSUE-13 rolling
+    windows). A streak of ``hysteresis`` hot evaluations
+    (burn >= burn_up) grows the set; a streak of cold ones
+    (burn <= burn_down) shrinks it; anything between resets both
+    streaks. After any action the controller holds for ``cooldown_s``.
+    Burn rate — violations spent against the error budget — is the
+    actuation signal precisely because raw QPS lies in both
+    directions: high QPS with met SLOs needs no replica, and low QPS
+    with a pathological workload (one giant prompt) still burns."""
+
+    def __init__(self, fleet, min_decode=1, max_decode=4, burn_up=1.0,
+                 burn_down=0.25, hysteresis=2, cooldown_s=0.5,
+                 interval_s=0.05):
+        self.fleet = fleet
+        self.min_decode = max(1, int(min_decode))
+        self.max_decode = int(max_decode)
+        self.burn_up = float(burn_up)
+        self.burn_down = float(burn_down)
+        self.hysteresis = max(1, int(hysteresis))
+        self.cooldown_s = float(cooldown_s)
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._next_eval = None
+        self._hold_until = None
+        self._up_streak = 0
+        self._down_streak = 0
+        self.evaluations = 0
+
+    def burn(self) -> float:
+        worst = 0.0
+        for r in self.fleet.decode_replicas():
+            for st in r.engine.slo.snapshot().values():
+                worst = max(worst, float(st.get("burn_rate", 0.0)))
+        return worst
+
+    def tick(self):
+        with self._lock:
+            now = self.fleet.clock()
+            if self._next_eval is not None and now < self._next_eval:
+                return
+            self._next_eval = now + self.interval_s
+            self.evaluations += 1
+            if self._hold_until is not None and now < self._hold_until:
+                return
+            b = self.burn()
+            n = len(self.fleet.decode_replicas())
+            if b >= self.burn_up and n < self.max_decode:
+                self._up_streak += 1
+                self._down_streak = 0
+                if self._up_streak >= self.hysteresis:
+                    self._up_streak = self._down_streak = 0
+                    self._hold_until = now + self.cooldown_s
+                    self.fleet.scale_up(reason="slo_burn", burn=b)
+            elif b <= self.burn_down and n > self.min_decode:
+                self._down_streak += 1
+                self._up_streak = 0
+                if self._down_streak >= self.hysteresis:
+                    self._up_streak = self._down_streak = 0
+                    self._hold_until = now + self.cooldown_s
+                    self.fleet.scale_down(reason="slo_burn", burn=b)
+            else:
+                self._up_streak = self._down_streak = 0

@@ -1,0 +1,542 @@
+"""Hermetic fleet selftest: disaggregated multi-replica serving proven
+on a tiny model.
+
+Run as ``python -m paddle_tpu.serving.fleet_selftest`` in a clean
+JAX_PLATFORMS=cpu subprocess (bench.py run_selftest wires it through
+the same env-strip recipe as the other lanes) and prints ONE JSON line
+for BENCH_r*.json:
+
+* **parity across hand-off** — the same seeded workload through a
+  1-prefill + 1-decode disaggregated fleet produces bit-identical token
+  streams to one engine serving it alone: the KV page hand-off
+  (export_slot -> import_slot) moves live state without touching
+  numerics, the decode replica runs zero prefill chunks, and the
+  stitched request trace shows a prefill leg then a decode leg.
+* **evict/re-onload parity** — a page-starved decode replica backed by
+  a host-memory KV ring keeps sampled outputs bit-identical to a fully
+  provisioned engine while evicting and transparently re-onloading KV;
+  a too-small ring degrades to re-prefill fallback with parity intact.
+* **replica scaling** — at saturating load, 2 threaded decode replicas
+  sustain >= 1.7x one replica's aggregate tok/s. The tiny model's
+  ~1 ms step is pure host Python on this 1-core CPU lane, so each
+  engine step carries an emulated device occupancy (a GIL-releasing
+  sleep calibrated at 15x the measured warmed step wall) — the shape
+  of a real accelerator, where the host thread waits on the device and
+  replicas overlap.
+* **disaggregated ITL under prefill burst** — long-prompt arrivals land
+  mid-stream on interactive chats; with the same emulated occupancy on
+  both sides, the unified engine's chat inter-token gaps absorb the
+  prefill occupancy while the disaggregated fleet's decode replica
+  never runs a chunk: chat ITL p99 strictly better, token parity and
+  zero leaks throughout.
+* **autoscale churn** — SLO-burn autoscaler scales the decode set down
+  when idle (draining the victim, zero leaks on the retired replica)
+  and back up under a burst with an impossible TTFT objective; every
+  spawn event carries a cold-start-to-first-token receipt.
+
+This lane must NOT enable the disk compile cache: XLA:CPU (jaxlib
+0.4.36) cannot deserialize an executable in the same process that
+serialized it.
+"""
+from __future__ import annotations
+
+import gc
+import json
+import time
+
+
+def _tiny_model(max_pos=192):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_attention_heads=4,
+                    max_position_embeddings=max_pos,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _occupied_engine_cls(step_occupancy_s=0.0, prefill_occupancy_s=0.0,
+                         decode_occupancy_s=0.0):
+    """ServingEngine with emulated device occupancy: GIL-releasing
+    sleeps standing in for the device-busy wall a real accelerator
+    charges per step. On this 1-core CPU lane the tiny model's step is
+    pure host Python (threads cannot overlap it), so the scaling and
+    disaggregation lanes measure the fleet MACHINERY against the
+    occupancy shape real hardware has, not CPU matmul throughput."""
+    from paddle_tpu.serving import ServingEngine
+
+    class _OccupiedEngine(ServingEngine):
+        _step_occupancy_s = step_occupancy_s
+        _prefill_occupancy_s = prefill_occupancy_s
+        _decode_occupancy_s = decode_occupancy_s
+
+        def step(self):
+            worked = super().step()
+            if worked and self._step_occupancy_s:
+                time.sleep(self._step_occupancy_s)
+            return worked
+
+        def _run_prefill_chunk(self, heads):
+            out = super()._run_prefill_chunk(heads)
+            if self._prefill_occupancy_s:
+                time.sleep(self._prefill_occupancy_s)
+            return out
+
+        def _run_decode(self):
+            out = super()._run_decode()
+            if out and self._decode_occupancy_s:
+                time.sleep(self._decode_occupancy_s)
+            return out
+
+    return _OccupiedEngine
+
+
+def run_probe():
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    from paddle_tpu.serving.metrics import percentile
+    from paddle_tpu.serving.traffic import poisson_traffic, run_fleet
+
+    obs.set_strict_retrace(True)
+
+    m, cfg = _tiny_model()
+    rec, fails = {}, []
+
+    def check(name, fn):
+        try:
+            fn()
+            rec[name] = "pass"
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            rec[name] = f"FAIL: {type(e).__name__}: {e}"[:300]
+            fails.append(name)
+
+    # -- token parity across the prefill->decode hand-off -----------------
+    def parity_handoff():
+        kw = dict(max_slots=4, max_len=96, page_size=8, chunk_size=16,
+                  prefill_batch=2)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, 64, (int(rng.integers(4, 30)),))
+                   .astype(np.int32) for _ in range(6)]
+        budgets = [int(rng.integers(4, 12)) for _ in range(6)]
+
+        eng = ServingEngine(m, **kw)
+        hs = [eng.submit(p, b, seed=100 + i)
+              for i, (p, b) in enumerate(zip(prompts, budgets))]
+        eng.run()
+        ref = [list(h.output_tokens) for h in hs]
+
+        fleet = FleetRouter(model=m, decode_replicas=1,
+                            prefill_replicas=1, engine_kw=kw)
+        fhs = [fleet.submit(p, b, seed=100 + i)
+               for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet.run()
+        got = [list(h.output_tokens) for h in fhs]
+        assert got == ref, "hand-off changed a token stream"
+        lk = fleet.leak_check()
+        assert lk["clean"], lk
+        snap = fleet.metrics_snapshot()
+        # the decode replica never ran a prefill chunk: the split is
+        # real, not two unified engines behind a router
+        assert snap["replicas"]["d0"]["prefill_chunks"] == 0, snap
+        assert snap["replicas"]["p0"]["prefill_chunks"] > 0, snap
+        # stitched trace: prefill leg (ends in hand-off) then decode leg
+        legs = fleet.request_trace(fhs[0].request.rid)
+        assert [leg["role"] for leg in legs] == ["prefill", "decode"], \
+            [(leg["replica"], leg["role"]) for leg in legs]
+        rec["handoff_detail"] = {
+            "finished": snap["fleet_finished"],
+            "p0_prefill_chunks":
+                snap["replicas"]["p0"]["prefill_chunks"],
+            "d0_prefill_chunks":
+                snap["replicas"]["d0"]["prefill_chunks"],
+        }
+
+    # -- evict to host ring -> transparent re-onload, bit-parity ----------
+    def evict_onload():
+        full_kw = dict(max_slots=8, max_len=96, page_size=8,
+                       chunk_size=16, do_sample=True, temperature=0.9,
+                       top_k=8)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 64, (int(rng.integers(10, 40)),))
+                   .astype(np.int32) for _ in range(8)]
+        budgets = [int(rng.integers(8, 24)) for _ in range(8)]
+
+        eng = ServingEngine(m, **full_kw)
+        hs = [eng.submit(p, b, seed=500 + i)
+              for i, (p, b) in enumerate(zip(prompts, budgets))]
+        eng.run()
+        ref = [list(h.output_tokens) for h in hs]
+
+        # page-starved decode replica + 8 MB host ring: preemptions
+        # must spill KV to pinned host memory and re-onload on resume
+        tight_kw = dict(full_kw, num_pages=1 + 3 * (96 // 8))
+        fleet = FleetRouter(model=m, decode_replicas=1,
+                            engine_kw=tight_kw, host_ring_mb=8.0)
+        fhs = [fleet.submit(p, b, seed=500 + i)
+               for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet.run()
+        assert [list(h.output_tokens) for h in fhs] == ref, \
+            "evict/re-onload changed a sampled stream"
+        snap = fleet.metrics_snapshot()
+        d0 = snap["replicas"]["d0"]
+        assert d0["kv_evictions"] > 0 and d0["kv_onloads"] > 0, d0
+        lk = fleet.leak_check()
+        assert lk["clean"], lk
+
+        # ring too small to hold a victim: drop -> re-prefill fallback,
+        # parity still holds (the ring is a latency optimization, never
+        # a correctness dependency)
+        fleet2 = FleetRouter(model=m, decode_replicas=1,
+                             engine_kw=tight_kw, host_ring_mb=0.01)
+        fhs2 = [fleet2.submit(p, b, seed=500 + i)
+                for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet2.run()
+        assert [list(h.output_tokens) for h in fhs2] == ref, \
+            "ring-drop fallback changed a sampled stream"
+        snap2 = fleet2.metrics_snapshot()
+        assert snap2["host_ring"]["drops"] > 0, snap2["host_ring"]
+        lk2 = fleet2.leak_check()
+        assert lk2["clean"], lk2
+        rec["evict_detail"] = {
+            "evictions": d0["kv_evictions"],
+            "onloads": d0["kv_onloads"],
+            "preemptions": d0["preemptions"],
+            "ring": snap["host_ring"],
+            "tiny_ring_drops": snap2["host_ring"]["drops"],
+        }
+
+    # -- threaded replica scaling at saturating load ----------------------
+    def scaling():
+        kw = dict(max_slots=4, max_len=64, page_size=8, chunk_size=16)
+        # calibrate: warmed single-engine step wall sets the emulated
+        # device occupancy (15x, floor 15 ms) so replica overlap — not
+        # host Python — dominates the measured window
+        eng = ServingEngine(m, **kw)
+        eng.warmup()
+        for i in range(4):
+            eng.submit(np.ones((16,), np.int32) + i, 8, seed=i)
+        walls = []
+        while eng.scheduler.has_work():
+            t0 = time.perf_counter()
+            eng.step()
+            walls.append(time.perf_counter() - t0)
+        occ = max(0.015, 15 * float(np.median(walls)))
+        cls = _occupied_engine_cls(step_occupancy_s=occ)
+
+        def run(n_replicas):
+            fleet = FleetRouter(model=m, decode_replicas=n_replicas,
+                                engine_kw=kw, threaded=True, seed=7,
+                                engine_cls=cls)
+            fleet.warmup()
+            fleet.start()
+            traffic = poisson_traffic(
+                32, rate_rps=1e9, vocab_size=cfg.vocab_size,
+                prompt_lens=(8, 24), out_lens=(12, 24), seed=11)
+            r, hs = run_fleet(fleet, traffic)
+            fleet.stop()
+            assert all(h.done for h in hs)
+            lk = fleet.leak_check()
+            assert lk["clean"], lk
+            return r
+
+        r1, r2 = run(1), run(2)
+        ratio = r2["fleet_tok_s"] / max(r1["fleet_tok_s"], 1e-9)
+        # both replicas actually served (P2C spread the load)
+        per = [r["finished"] for r in r2["replicas"].values()]
+        assert min(per) >= 8, per
+        rec["scaling_detail"] = {
+            "occupancy_ms": round(occ * 1e3, 2),
+            "tok_s_1": r1["fleet_tok_s"], "tok_s_2": r2["fleet_tok_s"],
+            "ratio": round(ratio, 3), "finished_per_replica": per,
+        }
+        assert ratio >= 1.7, rec["scaling_detail"]
+
+    # -- disaggregation beats unified on chat ITL under prefill burst -----
+    def disagg_itl():
+        md, cfgd = _tiny_model(max_pos=256)
+        kw = dict(max_slots=8, max_len=224, page_size=8, chunk_size=16)
+        cls = _occupied_engine_cls(prefill_occupancy_s=0.006,
+                                   decode_occupancy_s=0.002)
+        rng = np.random.default_rng(3)
+        chat = [(rng.integers(1, 64, (8,)).astype(np.int32), 120)
+                for _ in range(4)]
+        burst = [(rng.integers(1, 64, (192,)).astype(np.int32), 4)
+                 for _ in range(6)]
+
+        def run(prefill_replicas):
+            fleet = FleetRouter(model=md, decode_replicas=1,
+                                prefill_replicas=prefill_replicas,
+                                engine_kw=kw, threaded=True, seed=7,
+                                engine_cls=cls)
+            fleet.warmup()
+            gc.collect()
+            gc.disable()
+            try:
+                fleet.start()
+                chat_hs = [fleet.submit(p, n, seed=i)
+                           for i, (p, n) in enumerate(chat)]
+                time.sleep(0.03)
+                burst_hs = [fleet.submit(p, n, seed=100 + i)
+                            for i, (p, n) in enumerate(burst)]
+                fleet.drain()
+                fleet.stop()
+            finally:
+                gc.enable()
+            assert all(h.done for h in chat_hs + burst_hs)
+            lk = fleet.leak_check()
+            assert lk["clean"], lk
+            gaps = []
+            for h in chat_hs:
+                ts = h._token_times
+                gaps.extend(float(b - a) for a, b in zip(ts, ts[1:]))
+            return percentile(gaps, 99), percentile(gaps, 50)
+
+        # best of 2: one OS scheduling hiccup on the shared core can
+        # poison a single p99; a genuine regression fails both attempts
+        attempts = []
+        for attempt in range(2):
+            d99, d50 = run(1)
+            u99, u50 = run(0)
+            attempts.append({"disagg_p99_ms": round(d99 * 1e3, 2),
+                             "unified_p99_ms": round(u99 * 1e3, 2),
+                             "disagg_p50_ms": round(d50 * 1e3, 2),
+                             "unified_p50_ms": round(u50 * 1e3, 2)})
+            if d99 < u99:
+                break
+        rec["disagg_detail"] = {"attempts": attempts}
+        assert d99 < u99, rec["disagg_detail"]
+
+    # -- SLO-burn autoscaler: down when idle, up under burn ---------------
+    def autoscale_churn():
+        kw = dict(max_slots=4, max_len=64, page_size=8, chunk_size=16,
+                  slos=[("ttft", "ttft_s", 1e-4, 0.99, 60.0)])
+        fleet = FleetRouter(
+            model=m, decode_replicas=2, engine_kw=kw,
+            autoscale=dict(min_decode=1, max_decode=3, burn_up=1.0,
+                           burn_down=0.25, hysteresis=2,
+                           cooldown_s=0.0, interval_s=0.0))
+        fleet.warmup()
+        for _ in range(6):
+            fleet.step()
+        assert len(fleet.decode_replicas()) == 1, \
+            [e["action"] for e in fleet.events]
+
+        rng = np.random.default_rng(5)
+        hs = [fleet.submit(rng.integers(1, 64, (24,)).astype(np.int32),
+                           8, seed=i) for i in range(12)]
+        fleet.run()
+        assert all(h.done for h in hs)
+        ups = [e for e in fleet.events if e["action"] == "scale_up"]
+        assert ups, [e["action"] for e in fleet.events]
+        receipt = ups[0]
+        assert receipt.get("cold_start_to_first_token_ms", 0) > 0, \
+            receipt
+        lk = fleet.leak_check()   # includes the retired replica
+        assert lk["clean"], lk
+        snap = fleet.metrics_snapshot()
+        assert snap["retired_replicas"] >= 1, snap["retired_replicas"]
+        rec["autoscale_detail"] = {
+            "events": [e["action"] for e in fleet.events],
+            "spawn_receipt": {
+                k: receipt.get(k)
+                for k in ("replica", "cold_start_to_first_token_ms",
+                          "spawn_ms", "cache_hits", "cache_misses")},
+            "retired_replicas": snap["retired_replicas"],
+        }
+
+    check("fleet_parity_handoff", parity_handoff)
+    check("fleet_evict_onload", evict_onload)
+    check("fleet_scaling", scaling)
+    check("fleet_disagg_itl", disagg_itl)
+    check("fleet_autoscale_churn", autoscale_churn)
+    rec["retrace_sentinel"] = {
+        "strict": obs.strict_retrace(),
+        "total_unexpected": obs.retrace_summary()["total_unexpected"],
+    }
+    rec["check"] = ("pass" if not fails
+                    else "FAIL: " + ", ".join(fails))
+    return rec
+
+
+def run_bench():
+    """bench.py --fleet lane: aggregate fleet tok/s + MERGED-sample
+    fleet TTFT percentiles at 1/2/4 threaded replicas under the same
+    Poisson workload, the emulated-occupancy scaling ratio, the
+    disaggregation chat-ITL A/B, and one autoscale spawn with its
+    cold-start receipt. Tiny model by default (the lane measures the
+    fleet tier — routing, hand-off, scaling — not matmuls); override
+    with BENCH_FLEET_USERS / BENCH_FLEET_REQS_PER_USER."""
+    import os
+
+    import numpy as np
+
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    from paddle_tpu.serving.traffic import poisson_traffic, run_fleet
+
+    m, cfg = _tiny_model()
+    users = int(os.environ.get("BENCH_FLEET_USERS", "8"))
+    n_per = int(os.environ.get("BENCH_FLEET_REQS_PER_USER", "6"))
+    kw = dict(max_slots=users, max_len=160, page_size=8,
+              chunk_size=16)
+
+    # real-compute replica sweep: honest numbers for THIS host — on a
+    # 1-core CPU threaded replicas serialize on the GIL-bound step, so
+    # flat tok/s across replica counts is the expected reading here;
+    # the scaling block below carries the accelerator-shaped ratio
+    lanes = {}
+    for n in (1, 2, 4):
+        fleet = FleetRouter(model=m, decode_replicas=n, engine_kw=kw,
+                            threaded=True, seed=7)
+        fleet.warmup()
+        fleet.start()
+        traffic = poisson_traffic(
+            n_per * users, rate_rps=200.0 * n,
+            vocab_size=cfg.vocab_size, prompt_lens=(8, 48),
+            out_lens=(8, 64), seed=7 + n, sessions=users)
+        try:
+            r, hs = run_fleet(fleet, traffic)
+        finally:
+            fleet.stop()
+        lanes[f"replicas{n}"] = {
+            "fleet_tok_s": r["fleet_tok_s"],
+            "fleet_ttft_p50_s": r["fleet_ttft_p50_s"],
+            "fleet_ttft_p99_s": r["fleet_ttft_p99_s"],
+            "fleet_itl_p99_s": r["fleet_itl_p99_s"],
+            "finished": r["fleet_finished"],
+            "per_replica_finished":
+                {k: v["finished"] for k, v in r["replicas"].items()},
+        }
+
+    probe = {}
+
+    def grab(name, fn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001
+            probe[name] = f"FAIL: {type(e).__name__}: {e}"[:200]
+
+    def scaling_block():
+        eng = ServingEngine(m, **dict(kw, max_slots=4, max_len=64))
+        eng.warmup()
+        for i in range(4):
+            eng.submit(np.ones((16,), np.int32) + i, 8, seed=i)
+        walls = []
+        while eng.scheduler.has_work():
+            t0 = time.perf_counter()
+            eng.step()
+            walls.append(time.perf_counter() - t0)
+        occ = max(0.015, 15 * float(np.median(walls)))
+        cls = _occupied_engine_cls(step_occupancy_s=occ)
+        out = {}
+        for n in (1, 2):
+            fleet = FleetRouter(model=m,
+                                engine_kw=dict(kw, max_slots=4,
+                                               max_len=64),
+                                decode_replicas=n, threaded=True,
+                                seed=7, engine_cls=cls)
+            fleet.warmup()
+            fleet.start()
+            traffic = poisson_traffic(
+                32, rate_rps=1e9, vocab_size=cfg.vocab_size,
+                prompt_lens=(8, 24), out_lens=(12, 24), seed=11)
+            r, _ = run_fleet(fleet, traffic)
+            fleet.stop()
+            out[f"tok_s_{n}"] = r["fleet_tok_s"]
+        out["occupancy_ms"] = round(occ * 1e3, 2)
+        out["ratio"] = round(out["tok_s_2"] / max(out["tok_s_1"],
+                                                  1e-9), 3)
+        probe["emulated_scaling"] = out
+
+    def disagg_block():
+        md, _ = _tiny_model(max_pos=256)
+        dkw = dict(max_slots=8, max_len=224, page_size=8,
+                   chunk_size=16)
+        cls = _occupied_engine_cls(prefill_occupancy_s=0.006,
+                                   decode_occupancy_s=0.002)
+        from paddle_tpu.serving.metrics import percentile
+        rng = np.random.default_rng(3)
+        chat = [(rng.integers(1, 64, (8,)).astype(np.int32), 120)
+                for _ in range(4)]
+        burst = [(rng.integers(1, 64, (192,)).astype(np.int32), 4)
+                 for _ in range(6)]
+        out = {}
+        for label, n_prefill in (("disagg", 1), ("unified", 0)):
+            fleet = FleetRouter(model=md, decode_replicas=1,
+                                prefill_replicas=n_prefill,
+                                engine_kw=dkw, threaded=True, seed=7,
+                                engine_cls=cls)
+            fleet.warmup()
+            gc.collect()
+            gc.disable()
+            try:
+                fleet.start()
+                chat_hs = [fleet.submit(p, n, seed=i)
+                           for i, (p, n) in enumerate(chat)]
+                time.sleep(0.03)
+                for i, (p, n) in enumerate(burst):
+                    fleet.submit(p, n, seed=100 + i)
+                fleet.drain()
+                fleet.stop()
+            finally:
+                gc.enable()
+            gaps = []
+            for h in chat_hs:
+                ts = h._token_times
+                gaps.extend(float(b - a) for a, b in zip(ts, ts[1:]))
+            out[label] = {
+                "chat_itl_p50_ms":
+                    round(percentile(gaps, 50) * 1e3, 3),
+                "chat_itl_p99_ms":
+                    round(percentile(gaps, 99) * 1e3, 3),
+            }
+        probe["disagg_ab"] = out
+
+    def autoscale_block():
+        akw = dict(kw, max_slots=4, max_len=64,
+                   slos=[("ttft", "ttft_s", 1e-4, 0.99, 60.0)])
+        fleet = FleetRouter(
+            model=m, decode_replicas=1, engine_kw=akw,
+            autoscale=dict(min_decode=1, max_decode=2, burn_up=1.0,
+                           burn_down=0.25, hysteresis=2,
+                           cooldown_s=0.0, interval_s=0.0))
+        fleet.warmup()
+        rng = np.random.default_rng(5)
+        for i in range(12):
+            fleet.submit(rng.integers(1, 64, (24,)).astype(np.int32),
+                         8, seed=i)
+        fleet.run()
+        ups = [e for e in fleet.events if e["action"] == "scale_up"]
+        probe["autoscale_events"] = [e["action"] for e in fleet.events]
+        if ups:
+            probe["spawn_cold_start"] = {
+                k: ups[0].get(k)
+                for k in ("replica", "cold_start_to_first_token_ms",
+                          "spawn_ms", "cache_hits", "cache_misses")}
+
+    grab("emulated_scaling", scaling_block)
+    grab("disagg_ab", disagg_block)
+    grab("autoscale_events", autoscale_block)
+    return {
+        "metric": "fleet_multi_replica_serving",
+        "config": {"model": "tiny", "users": users,
+                   "reqs_per_user": n_per,
+                   "params": sum(int(np.prod(p.shape))
+                                 for p in m.parameters())},
+        "lanes": lanes,
+        **probe,
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--bench" in sys.argv:
+        print(json.dumps(run_bench()))
+    else:
+        print(json.dumps(run_probe()))

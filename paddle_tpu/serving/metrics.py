@@ -34,7 +34,7 @@ class ServingMetrics:
                  "preemptions", "evicted_pages", "prefill_chunks",
                  "decode_steps", "generated_tokens",
                  "spec_dispatches", "spec_proposed", "spec_accepted",
-                 "spec_emitted")
+                 "spec_emitted", "kv_evictions", "kv_onloads")
     _GAUGES = ("queue_depth", "running")
 
     def __init__(self, clock=time.perf_counter, registry=None,
@@ -62,6 +62,11 @@ class ServingMetrics:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_emitted = 0
+        # host-ring KV migration (ISSUE 18): evictions parked a victim's
+        # pages in host memory instead of discarding them; onloads
+        # brought them back without a re-prefill
+        self.kv_evictions = 0
+        self.kv_onloads = 0
         # gauges (refreshed every engine step)
         self.queue_depth = 0
         self.running = 0
@@ -159,6 +164,8 @@ class ServingMetrics:
                 self.spec_accepted / max(self.spec_proposed, 1), 4),
             "spec_tokens_per_dispatch": round(
                 self.spec_emitted / max(self.spec_dispatches, 1), 4),
+            "kv_evictions": self.kv_evictions,
+            "kv_onloads": self.kv_onloads,
             "queue_depth": self.queue_depth,
             "running": self.running,
             "elapsed_s": round(elapsed, 4),

@@ -84,6 +84,10 @@ class RequestHandle:
         self.finish_time: float | None = None
         self._token_times: list[float] = []
         self._stream_cursor = 0
+        # host-ring re-onload (ISSUE 18): the last sampled token that
+        # travelled with the evicted KV pages — the engine reloads it
+        # into its per-slot token vector when the import lands
+        self._onload_token: int | None = None
 
     # -- client surface ---------------------------------------------------
     @property

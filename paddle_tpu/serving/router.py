@@ -1,0 +1,76 @@
+"""Fleet routing policy: rendezvous session affinity with a
+power-of-two-choices fallback (ISSUE 18).
+
+Pure host-side decision logic, no engine imports — the fleet feeds it
+live load readings and it answers "which replica". Two policies,
+composed:
+
+* **Session affinity** — rendezvous (highest-random-weight) hashing:
+  every (session, replica) pair gets a deterministic 64-bit score from
+  ``blake2b``; the session goes to the highest-scoring live replica.
+  Unlike modulo hashing, adding or removing one replica only remaps
+  the ~1/N sessions whose winner changed — every other session keeps
+  its replica, which is exactly the property KV-affinity wants (a
+  remapped session merely loses prefix-cache locality, it is never
+  wrong).
+* **Power of two choices** — for sessionless traffic, sample two
+  distinct replicas and take the less loaded. Classic result: the
+  expected max queue drops from Θ(log n / log log n) under random
+  placement to Θ(log log n), at the cost of TWO load reads instead of
+  a global scan. The sampler is seeded, so a replayed workload makes
+  identical picks.
+
+Draining replicas are excluded from both policies by the fleet simply
+removing them from the candidate list (the rendezvous property makes
+that removal minimally disruptive).
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = ["ReplicaRouter", "rendezvous_score"]
+
+
+def rendezvous_score(session: str, replica: str) -> int:
+    """Deterministic 64-bit HRW weight for one (session, replica)
+    pair — stable across processes and runs (hashlib, not ``hash()``,
+    which is salted per process)."""
+    h = hashlib.blake2b(f"{session}|{replica}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class ReplicaRouter:
+    def __init__(self, replicas=(), seed: int = 0):
+        self._replicas: list[str] = list(replicas)
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def replicas(self) -> tuple:
+        return tuple(self._replicas)
+
+    def add(self, name: str):
+        if name not in self._replicas:
+            self._replicas.append(name)
+
+    def remove(self, name: str):
+        if name in self._replicas:
+            self._replicas.remove(name)
+
+    def pick(self, load_fn, session: str | None = None) -> str:
+        """Route one request. ``load_fn(name)`` returns the replica's
+        live queue depth (waiting + running + pending imports); it is
+        only consulted on the P2C path — affinity deliberately ignores
+        load so a session's KV locality survives bursts."""
+        names = self._replicas
+        if not names:
+            raise RuntimeError("no live replicas to route to")
+        if session is not None:
+            return max(names,
+                       key=lambda r: rendezvous_score(session, r))
+        if len(names) == 1:
+            return names[0]
+        i, j = self._rng.choice(len(names), size=2, replace=False)
+        a, b = names[int(i)], names[int(j)]
+        return a if load_fn(a) <= load_fn(b) else b

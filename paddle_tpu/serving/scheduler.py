@@ -46,6 +46,11 @@ class RequestScheduler:
         # sets it: decode_burst, or spec_k+1 under speculative
         # decoding) — the "auto" admission watermark scales with it
         self.token_lookahead = 1
+        # optional HostKVRing (ISSUE 18): preemption victims park their
+        # KV pages in host memory instead of discarding them, and
+        # re-admission imports the parked pages back (no re-prefill).
+        # None = exact pre-fleet behaviour.
+        self.host_ring = None
 
     # -- queue ------------------------------------------------------------
     @staticmethod
@@ -95,17 +100,33 @@ class RequestScheduler:
         admitted = []
         while self.waiting:
             head = self.waiting[0]
-            need_len = len(head.pending)
-            if not cache.can_allocate(need_len):
+            # re-onload probe: a preempted head whose pages are parked
+            # in the host ring imports them instead of re-prefilling.
+            # take() claims the blob atomically (the ring is shared
+            # across replicas — a peek could lose it to a concurrent
+            # overflow drop); a failed capacity check parks it back.
+            parked = (self.host_ring.take(head.request.rid)
+                      if self.host_ring is not None and head.preemptions
+                      else None)
+            need_len = (int(parked[0]["seq_len"]) if parked is not None
+                        else len(head.pending))
+            fits = cache.can_allocate(need_len)
+            if fits and (admitted or self.decode_slots()):
+                # an admission that would leave fewer free pages than
+                # one per decode-active sequence invites instant
+                # preemption churn — hold the head until a retirement
+                # frees pages
+                left = (cache.free_page_count
+                        - cache.pages_needed(need_len))
+                fits = left >= self._watermark()
+            if not fits:
+                if parked is not None:
+                    self.host_ring.put(head.request.rid, *parked)
                 break
-            # an admission that would leave fewer free pages than one
-            # per decode-active sequence invites instant preemption
-            # churn — hold the head until a retirement frees pages
-            left = cache.free_page_count - cache.pages_needed(need_len)
-            if admitted or self.decode_slots():
-                if left < self._watermark():
-                    break
             self.waiting.pop(0)
+            if parked is not None:
+                admitted.append(self._onload(head, parked))
+                continue
             slot = cache.allocate(need_len)
             cache.set_active(slot, False)   # decode joins after prefill
             head.slot = slot
@@ -116,6 +137,33 @@ class RequestScheduler:
             admitted.append(head)
         return admitted
 
+    def _onload(self, head: RequestHandle, parked) -> RequestHandle:
+        """Bring an evicted request's KV back from the host ring: the
+        resume skips re-prefill entirely and rejoins decode where it
+        left off. The import cost lands on the request's trace as a
+        ``kv_onload`` span — the victim pays for its own migration,
+        charged inside its queue-to-first-new-token gap."""
+        blob, last_token = parked
+        span = (self.tracer.begin("kv_onload", parent=head._span,
+                                  pages=blob["pages"],
+                                  bytes=blob["nbytes"])
+                if self.tracer is not None and head._span is not None
+                else None)
+        slot = self.cache.import_slot(blob, active=True)
+        if span is not None:
+            self.tracer.end(span, slot=slot)
+        head.slot = slot
+        head.state = RequestState.RUNNING
+        # the last sampled token was exported alongside the pages: the
+        # next decode step writes it at position seq_len, exactly as if
+        # the eviction never happened (the engine reloads it into its
+        # per-slot token vector and refreshes its buffer dict)
+        head._onload_token = int(last_token)
+        self.running[slot] = head
+        self.metrics.kv_onloads += 1
+        self.metrics.on_admit(resumed=True)
+        return head
+
     # -- preemption -------------------------------------------------------
     def _victim(self, protect: int) -> int | None:
         """Most victim-eligible decode-active slot other than `protect`
@@ -123,6 +171,18 @@ class RequestScheduler:
         cands = [s for s in self.decode_slots() if s != protect]
         if not cands:
             return None
+        if self.host_ring is not None:
+            # LRU-of-idle (ISSUE 18): with a host ring behind the pool,
+            # eviction is a migration, not a kill — so pick the session
+            # whose stream has been quiet longest (its KV is the
+            # coldest and it is the most likely to tolerate the onload
+            # round-trip), tie-broken by the usual policy key
+            def idle_key(s):
+                h = self.running[s]
+                last = (h._token_times[-1] if h._token_times
+                        else h.submit_time) or 0.0
+                return (-last, self._key(h))
+            return max(cands, key=idle_key)
         return max(cands, key=lambda s: self._key(self.running[s]))
 
     def preempt(self, slot: int, reason: str = "pool_dry"
@@ -134,11 +194,25 @@ class RequestScheduler:
         it), "abort" (engine recovery)."""
         handle = self.running.pop(slot)
         pages = len(self.cache._slot_pages.get(slot, ()))
+        evicted_to_host = False
+        if (self.host_ring is not None and reason != "abort"
+                and handle.state is RequestState.RUNNING
+                and handle.output_tokens):
+            # park the victim's pages + its not-yet-cached last sample
+            # in host memory; re-admission imports them back. If the
+            # ring later drops the blob under byte pressure, the
+            # handle's pending prompt below is the re-prefill fallback.
+            self.host_ring.put(handle.request.rid,
+                               self.cache.export_slot(slot),
+                               handle.output_tokens[-1])
+            self.metrics.kv_evictions += 1
+            evicted_to_host = True
         self.cache.free(slot)
         if self.tracer is not None and handle._span is not None:
             self.tracer.instant("preempt", parent=handle._span,
                                 reason=reason, slot=slot,
                                 pages_reclaimed=pages,
+                                evicted_to_host=evicted_to_host,
                                 tokens_so_far=len(handle.output_tokens))
         handle._requeue_for_resume()
         self.enqueue(handle)

@@ -22,7 +22,7 @@ import numpy as np
 from .metrics import percentile
 
 __all__ = ["TrafficRequest", "poisson_traffic", "run_continuous",
-           "run_static"]
+           "run_fleet", "run_static"]
 
 
 @dataclass
@@ -31,6 +31,12 @@ class TrafficRequest:
     prompt: np.ndarray
     max_new_tokens: int
     priority: int = 0
+    # replica-stable identity (ISSUE 18): the seed pins the request's
+    # sampling stream no matter which replica serves it, so the SAME
+    # workload replays bit-identically against 1 vs N replicas; the
+    # session key drives fleet affinity routing
+    seed: int | None = None
+    session: str | None = None
 
 
 def _mixed_len(rng, bounds, long_frac):
@@ -47,19 +53,35 @@ def _mixed_len(rng, bounds, long_frac):
 
 
 def poisson_traffic(n, rate_rps, vocab_size, prompt_lens=(8, 48),
-                    out_lens=(8, 32), long_frac=0.25, seed=0):
+                    out_lens=(8, 32), long_frac=0.25, seed=0,
+                    sessions=0):
     """`n` requests with exponential inter-arrival times (Poisson
     process at `rate_rps`) and short/long mixtures over both prompt
     lengths and output budgets (`long_frac` of each draws from the
-    upper half of its range)."""
+    upper half of its range).
+
+    Every request carries a deterministic per-request seed drawn from
+    a SEPARATE generator stream (so the arrival/length draws existing
+    lanes were tuned on are byte-identical to before): request i gets
+    the same seed whether the workload is replayed against one engine
+    or an N-replica fleet — the determinism the fleet A/B parity
+    lanes stand on. ``sessions > 0`` additionally tags each request
+    with one of that many session keys for affinity routing.
+    """
     rng = np.random.default_rng(seed)
+    id_rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed) & 0x7FFFFFFF, 0xF1EE7]))
     t, out = 0.0, []
     for _ in range(n):
         t += float(rng.exponential(1.0 / rate_rps))
         plen = _mixed_len(rng, prompt_lens, long_frac)
         prompt = rng.integers(1, vocab_size, (plen,)).astype(np.int32)
+        rseed = int(id_rng.integers(0, 2**31 - 1))
+        sid = (f"s{int(id_rng.integers(0, sessions))}"
+               if sessions else None)
         out.append(TrafficRequest(
-            t, prompt, _mixed_len(rng, out_lens, long_frac)))
+            t, prompt, _mixed_len(rng, out_lens, long_frac),
+            seed=rseed, session=sid))
     return out
 
 
@@ -81,7 +103,8 @@ def run_continuous(engine, traffic, max_steps=2_000_000):
         while i < len(pending) and pending[i].arrival_s <= now:
             r = pending[i]
             handles.append(engine.submit(
-                r.prompt, r.max_new_tokens, priority=r.priority))
+                r.prompt, r.max_new_tokens, priority=r.priority,
+                seed=r.seed))
             i += 1
         if engine.scheduler.has_work():
             engine.step()
@@ -96,6 +119,53 @@ def run_continuous(engine, traffic, max_steps=2_000_000):
     rec["elapsed_s"] = round(elapsed, 4)
     rec["tok_s"] = round(rec["generated_tokens"] / max(elapsed, 1e-9), 2)
     rec["compile"] = engine.compile_counts()
+    return rec, handles
+
+
+def run_fleet(fleet, traffic, max_steps=2_000_000, timeout_s=300.0):
+    """Serve `traffic` through a FleetRouter with real-time Poisson
+    arrivals — `run_continuous` for fleets, same gc pre-window hygiene
+    so a pending gen2 collection never lands inside the measured
+    window. In threaded mode replicas serve while this thread paces
+    arrivals; in cooperative mode fleet steps interleave with
+    submission. Returns (record, handles) where the record is the
+    fleet snapshot plus the aggregate ``fleet_tok_s`` over the window.
+    """
+    pending = sorted(traffic, key=lambda r: r.arrival_s)
+    handles, i = [], 0
+    threaded = fleet.threaded and fleet._started
+    gc.collect()
+    t0 = fleet.clock()
+    steps = 0
+    while i < len(pending) or fleet.has_work():
+        now = fleet.clock() - t0
+        while i < len(pending) and pending[i].arrival_s <= now:
+            r = pending[i]
+            handles.append(fleet.submit(
+                r.prompt, r.max_new_tokens, priority=r.priority,
+                seed=r.seed, session=r.session))
+            i += 1
+        if threaded:
+            if i < len(pending):
+                time.sleep(min(0.002,
+                               max(0.0, pending[i].arrival_s - now)))
+            else:
+                rec = fleet.drain(timeout_s=timeout_s)
+                break
+        elif fleet.has_work():
+            fleet.step()
+        elif i < len(pending):
+            time.sleep(min(0.002,
+                           max(0.0, pending[i].arrival_s - now)))
+        steps += 1
+        if steps >= max_steps:
+            raise RuntimeError("fleet traffic run did not drain")
+    else:
+        rec = fleet.metrics_snapshot()
+    elapsed = fleet.clock() - t0
+    rec["elapsed_s"] = round(elapsed, 4)
+    rec["fleet_tok_s"] = round(
+        rec["fleet_generated_tokens"] / max(elapsed, 1e-9), 2)
     return rec, handles
 
 

@@ -93,6 +93,11 @@ DEFAULT_TOLERANCES = {
     # Wide relative band (compile wall is scheduler-noisy) + an
     # absolute floor so toy selftest programs never gate
     "cold_start": (0.30, False, 250.0),
+    # serving fleet (ISSUE 18): aggregate multi-replica tok/s gates
+    # like any throughput; fleet TTFT percentiles are merged-sample
+    # (union of replica windows), latency band + floor as ttft
+    "fleet_tok_s": (0.05, True, 0.0),
+    "fleet_ttft": (0.25, False, 2e-3),   # seconds
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -151,6 +156,12 @@ def load_record(path):
 
 def _family(key):
     k = key.lower()
+    # fleet rollups match BEFORE the generic tok_s/ttft families so
+    # the multi-replica lanes carry their own tolerance rows
+    if "fleet_tok_s" in k:
+        return "fleet_tok_s"
+    if "fleet_ttft" in k:
+        return "fleet_ttft"
     if "finite_frac" in k:
         return "finite"
     if "grad_norm" in k:
