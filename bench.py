@@ -898,6 +898,33 @@ def run_selftest():
         assert rec.get("check") == "pass", rec
         results["fleet_detail"] = rec
 
+    def chaos():
+        # ISSUE 19: chaos-hardened self-healing fleet — scripted,
+        # seeded fault injection end to end: replica kill mid-decode
+        # and mid-hand-off with BIT-identical token streams after
+        # re-dispatch (exactly-once), lease/ack losing zero pages,
+        # corrupt blobs rejected pre-allocation, ring drops under
+        # eviction, per-request deadlines, bounded in-place recovery,
+        # brown-out shedding, stuck-replica watchdog with lockless
+        # harvest, hung joins recorded; plus dp8 -> dp4 IN-PROCESS
+        # elastic training resume within TOL["resume"]. MTTR recorded
+        # for both tiers.
+        rec = _run_cpu_probe("paddle_tpu.observability.chaos_selftest",
+                             n_devices=1, timeout=900)
+        assert rec.get("check") == "pass", rec
+        results["chaos_detail"] = rec
+        if rec.get("mttr_ms") is not None:
+            results["chaos_mttr_ms"] = rec["mttr_ms"]
+        if rec.get("mttr_stuck_ms") is not None:
+            results["chaos_mttr_stuck_ms"] = rec["mttr_stuck_ms"]
+        el = _run_cpu_probe("paddle_tpu.observability.chaos_selftest",
+                            extra_args=("--elastic",), n_devices=8,
+                            timeout=900)
+        assert el.get("check") == "pass", el
+        results["chaos_elastic_detail"] = el
+        if el.get("mttr_train_ms") is not None:
+            results["chaos_mttr_train_ms"] = el["mttr_train_ms"]
+
     def cold_start():
         # ISSUE 17: persistent AOT executable cache — hermetic
         # process-pair A/B on one shared cache dir: cold child compiles
@@ -930,6 +957,7 @@ def run_selftest():
     check("distributed_linalg", distributed_linalg)
     check("moe", moe)
     check("sharded_storage", sharded_storage)
+    check("chaos", chaos)
     return results
 
 
@@ -1497,6 +1525,19 @@ if __name__ == "__main__":
             "cold_start": _run_cpu_probe(
                 "paddle_tpu.jit.cold_start_selftest",
                 n_devices=1, timeout=900)}))
+    elif "--chaos" in sys.argv:
+        # CHAOS lane (ISSUE 19): scripted deterministic fault injection
+        # against the self-healing fleet (kill/corrupt/stuck/hung/
+        # brown-out, exactly-once re-dispatch parity, MTTR) plus the
+        # dp8 -> dp4 in-process elastic training resume — two hermetic
+        # CPU subprocesses
+        print(json.dumps({
+            "chaos": _run_cpu_probe(
+                "paddle_tpu.observability.chaos_selftest",
+                n_devices=1, timeout=900),
+            "chaos_elastic": _run_cpu_probe(
+                "paddle_tpu.observability.chaos_selftest",
+                extra_args=("--elastic",), n_devices=8, timeout=900)}))
     elif "--selftest" in sys.argv:
         _setup_jax()
         print(json.dumps({"selftest": run_selftest()}))

@@ -64,14 +64,18 @@ def victim_main(root: str):
 
 def run_kill_lane(trials: int = 8, seed: int = 0):
     """SIGKILL the victim at randomized points; every restore must land
-    on a committed, checksum-verified step with intact payloads."""
+    on a committed, checksum-verified step with intact payloads. The
+    kill schedule comes from the shared FaultInjector (ISSUE 19) —
+    same seeded stream the ad-hoc rng used, every kill logged."""
     import shutil
     import tempfile
 
+    from ...observability import faults
     from .load_state_dict import verify_checkpoint
     from .manager import CheckpointManager
 
-    rng = np.random.default_rng(seed)
+    inj = faults.install(seed)
+    inj.arm("proc.sigkill", every=1, times=trials)
     mid_save_hits = 0
     for trial in range(trials):
         root = tempfile.mkdtemp(prefix="ftkill_")
@@ -86,7 +90,8 @@ def run_kill_lane(trials: int = 8, seed: int = 0):
             # moment inside the save cadence
             first = child.stdout.readline()
             assert first.startswith("committed"), first
-            time.sleep(float(rng.uniform(0.0, 0.25)))
+            time.sleep(inj.uniform(0.0, 0.25))
+            faults.fire("proc.sigkill", trial=trial, pid=child.pid)
             child.send_signal(signal.SIGKILL)
             child.wait()
             committed = [int(ln.split()[1])
@@ -119,21 +124,29 @@ def run_kill_lane(trials: int = 8, seed: int = 0):
                         f"restore of step {got}")
         finally:
             shutil.rmtree(root, ignore_errors=True)
-    return {"trials": trials, "mid_save_kills": mid_save_hits}
+    faults.reset()
+    return {"trials": trials, "mid_save_kills": mid_save_hits,
+            "injected_kills": inj.hits.get("proc.sigkill", 0)}
 
 
-def run_flip_lane():
+def run_flip_lane(seed: int = 0):
     """One flipped byte in a chunk file -> manifest catches it, restore
-    falls back to the previous committed step."""
-    import glob
+    falls back to the previous committed step. The flip comes through
+    the manager's armed ``ckpt.chunk.flip`` fault point (ISSUE 19) —
+    one injection implementation, not an ad-hoc byte poke."""
     import shutil
     import tempfile
 
+    from ...observability import faults
     from .load_state_dict import verify_checkpoint
     from .manager import CheckpointManager
     from .utils import CheckpointError
 
     root = tempfile.mkdtemp(prefix="ftflip_")
+    inj = faults.install(seed)
+    # the manager probes the point once per save: fire on the SECOND
+    # save, so step_0 stays intact as the fallback target
+    inj.arm("ckpt.chunk.flip", at=2)
     try:
         extra = _victim_state(0)
         mgr = CheckpointManager(root, extra_state=extra)
@@ -141,10 +154,7 @@ def run_flip_lane():
             extra.clear()
             extra.update(_victim_state(step))
             mgr.save(step)
-        chunk = glob.glob(os.path.join(root, "step_1", "*_0.distcp"))[0]
-        raw = bytearray(open(chunk, "rb").read())
-        raw[len(raw) // 2] ^= 0x01
-        open(chunk, "wb").write(bytes(raw))
+        assert inj.hits.get("ckpt.chunk.flip", 0) >= 2, inj.hits
         try:
             verify_checkpoint(os.path.join(root, "step_1"))
             return {"detected": False}
@@ -158,6 +168,7 @@ def run_flip_lane():
                                  _victim_state(0)["w0"]))
         return {"detected": True, "fell_back_to": got, "ok": bool(ok)}
     finally:
+        faults.reset()
         shutil.rmtree(root, ignore_errors=True)
 
 

@@ -223,6 +223,11 @@ class CheckpointManager:
                 shutil.rmtree(tmp, ignore_errors=True)
             save_state_dict(snapshot, tmp,
                             coordinator_rank=self._coordinator)
+            # fault point (ISSUE 19): flip one byte of a written chunk
+            # BEFORE the commit rename — the checksum verify on restore
+            # must reject the chunk and fall back to the previous
+            # committed step, exactly like real silent media corruption
+            self._maybe_flip_chunk(tmp, step)
             final = self._step_dir(step)
             if jax.process_count() <= 1 or \
                     jax.process_index() == self._coordinator:
@@ -246,6 +251,30 @@ class CheckpointManager:
             self._gc()
         finally:
             self._inflight_tmp = None
+
+    def _maybe_flip_chunk(self, tmp: str, step: int):
+        """``ckpt.chunk.flip`` fault point: when armed, XOR one byte in
+        the middle of one written ``.distcp`` chunk (chunk chosen by
+        the injector's seeded RNG) before the atomic commit. No-op
+        unless a FaultInjector is installed with this point armed."""
+        from ...observability import faults
+
+        if not faults.should_fire("ckpt.chunk.flip", step=step):
+            return
+        inj = faults.active()
+        chunks = sorted(
+            os.path.join(tmp, n) for n in os.listdir(tmp)
+            if n.endswith(".distcp"))
+        if not chunks:
+            return
+        path = chunks[inj.pick_index(len(chunks))]
+        with open(path, "rb") as f:
+            raw = bytearray(f.read())
+        if not raw:
+            return
+        raw[len(raw) // 2] ^= 0x01
+        with open(path, "wb") as f:
+            f.write(raw)
 
     # -- retention ------------------------------------------------------
     def _gc(self):

@@ -39,14 +39,31 @@ flat-index trick as their fp twins.
 """
 from __future__ import annotations
 
+import zlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["DenseKVCache", "PagedKVCache", "paged_write_decode",
-           "paged_write_prefill", "dense_write_prefill",
-           "paged_write_decode_q8", "paged_write_prefill_q8",
-           "dense_write_chunk"]
+__all__ = ["DenseKVCache", "PagedKVCache", "blob_checksum",
+           "paged_write_decode", "paged_write_prefill",
+           "dense_write_prefill", "paged_write_decode_q8",
+           "paged_write_prefill_q8", "dense_write_chunk"]
+
+
+def blob_checksum(blob: dict) -> int:
+    """CRC32 over an export blob's payload arrays, in wire order.
+
+    ``export_slot`` stamps it as ``blob["crc32"]``; ``import_slot``
+    re-derives and compares BEFORE allocating, so a blob corrupted in
+    flight (host ring, cross-replica hand-off, future cross-host
+    transport) is rejected while the destination pools are still
+    untouched."""
+    crc = 0
+    for key in ("k", "v", "k_scales", "v_scales"):
+        for a in blob.get(key, ()):
+            crc = zlib.crc32(np.ascontiguousarray(a).data, crc)
+    return crc & 0xFFFFFFFF
 
 
 # ---------------------------------------------------------------------------
@@ -577,6 +594,7 @@ class PagedKVCache:
         blob["nbytes"] = sum(
             a.nbytes for key in ("k", "v", "k_scales", "v_scales")
             for a in blob.get(key, ()))
+        blob["crc32"] = blob_checksum(blob)
         return blob
 
     def import_slot(self, blob: dict, active: bool = False) -> int:
@@ -616,6 +634,10 @@ class PagedKVCache:
                     raise ValueError(
                         f"blob {key!r} page block {tuple(a.shape)} != "
                         f"{want}")
+        if "crc32" in blob and blob_checksum(blob) != blob["crc32"]:
+            raise ValueError(
+                f"blob payload corrupt: crc32 {blob_checksum(blob):#x} "
+                f"!= stamped {blob['crc32']:#x}")
         slot = self.allocate(seq_len)
         if n:
             # scatter at the bucket width: real pages first, padding

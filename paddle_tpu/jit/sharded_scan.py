@@ -2104,6 +2104,13 @@ class ShardedFusedScanTrainStep(FusedScanTrainStep):
                 f"global batch {shape[0]} is not divisible by the "
                 f"batch-axis degree {self._batch_degree} "
                 f"(axes {self._batch_axes})")
+        # host-side fault points (ISSUE 19): a scripted straggler /
+        # crash fires BEFORE the compiled step dispatches, so an
+        # injected failure never leaves donated buffers half-consumed
+        from ..observability import faults
+
+        faults.maybe_delay("train.step.straggler")
+        faults.maybe_raise("train.step.crash")
         return super().__call__(ids, labels, segment_ids=segment_ids)
 
 

@@ -22,6 +22,13 @@ serving:
   events dumped (with a registry snapshot) on crashes;
   `install_signal_dump()` adds SIGQUIT hung-process dumps (ring +
   all-thread stacks, process keeps running).
+- `faults` (ISSUE 19) — process-global seeded-deterministic fault
+  injection: named fault points across the stack (replica crash/stuck,
+  KV hand-off corruption, host-ring drop, checkpoint chunk flip,
+  stragglers), scriptable one-shot/probabilistic/scheduled triggers,
+  every firing logged to the flight recorder and counted on the
+  registry. The substrate behind the chaos selftest lane and the
+  fleet's self-healing rehearsals.
 - `Tracer` / `Span` (ISSUE 13) — request-scoped causal timelines: a
   bounded ring of span trees with O(1) begin/end, tail-exemplar
   retention, orphan detection, chrome-trace export on per-request
@@ -62,7 +69,9 @@ Quickstart::
     print(obs.registry().expose())        # Prometheus text
     print(obs.retrace_summary())          # compile/retrace receipt
 """
+from . import faults  # noqa: F401
 from .debug_server import DebugServer  # noqa: F401
+from .faults import FaultError, FaultInjector  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder, install, install_signal_dump, recorder,
     thread_stacks,
@@ -107,5 +116,5 @@ __all__ = [
     "live_buffer_report", "parse_hlo_buffers", "is_oom_error",
     "dump_oom", "oom_guard", "last_oom_report", "memz_payload",
     "NumericsMonitor", "monitor_enabled", "numericsz_payload",
-    "chunk_of_layer",
+    "chunk_of_layer", "faults", "FaultError", "FaultInjector",
 ]

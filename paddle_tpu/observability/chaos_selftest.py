@@ -1,0 +1,671 @@
+"""Hermetic chaos selftest: scripted faults against the self-healing
+fleet (ISSUE 19).
+
+Run under a cpu-forced env (bench.py run_selftest wires it through the
+same env-strip recipe as the other lanes) and prints ONE JSON line for
+BENCH_r*.json:
+
+    python -m paddle_tpu.observability.chaos_selftest [--elastic]
+
+Every lane drives the SAME deterministic FaultInjector the production
+code probes (``observability.faults``), so each failure is scripted,
+seeded and logged — no sleeps-and-hope chaos:
+
+* **kill mid-decode** — a decode replica raises on its 4th working
+  step; the watchdog quarantines it and re-dispatches every in-flight
+  request to the survivor. Greedy token streams must be BIT-identical
+  to a fault-free single engine: replayed context travels via
+  ``pending`` (never re-emitted) and the per-request RNG depends only
+  on (seed, position), so exactly-once delivery is a parity assert,
+  not a heuristic. MTTR = death -> first post-death token.
+* **kill mid-hand-off** — (a) the adopter dies on the very step it
+  adopted a prefilled sequence; (b) the adopter dies with the hand-off
+  still in its inbox, between export and import. Lease/ack makes both
+  lossless: the exporter retains pages until the adopter acks, so
+  ``leased_count`` must come back to 0 with zero lost pages.
+* **corrupt blob rejected pre-alloc** — a flipped byte in the hand-off
+  payload fails crc32 BEFORE allocation; leased -> the exporter
+  re-exports (relet), unleased -> resume-by-re-prefill. Parity both
+  ways.
+* **ring drop under evict** — host-KV-ring puts dropped every 2nd
+  time while a page-starved replica evicts under sampling load;
+  re-prefill fallback keeps sampled streams bit-identical.
+* **deadline** — per-request ``deadline_s``: queue expiry, resident
+  expiry under injected slow steps (pages freed), and fleet
+  pass-through, all finishing ``deadline_exceeded``.
+* **recover-retry** — ``recover_retries=2`` absorbs an injected step
+  fault in place (parity), ``recover_retries=0`` escalates.
+* **brown-out** — with a dead replica below the healthy-capacity
+  watermark, sub-floor-priority admissions are shed at submit
+  (``FinishReason.SHED``) while priority work still lands.
+* **stuck watchdog** (threaded) — a replica wedges 0.8 s inside a
+  step; heartbeat staleness takes it HEALTHY -> SUSPECT -> DEAD, the
+  harvest runs LOCKLESS (the wedged thread owns the lock), and the
+  engine fence keeps the thread from emitting stale tokens when it
+  unsticks. Parity again.
+* **hung join** — a wedged thread that outlives ``join_timeout_s`` is
+  RECORDED by ``stop()`` (``hung_replicas``, counter, event) instead
+  of silently ignored; ``strict=True`` raises.
+
+``--elastic`` runs the training lane on 8 host devices: a dp8
+ShardedFusedScanTrainStep crashes via ``train.step.crash``, resumes
+IN PROCESS onto a dp4 mesh from the last checkpoint, and the resumed
+loss trajectory must match the uninterrupted run within
+TOL["resume"]; MTTR (crash -> first post-restore step) is recorded.
+
+This lane must NOT enable the disk compile cache: XLA:CPU (jaxlib
+0.4.36) cannot deserialize an executable in the same process that
+serialized it.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+TOL = {"resume": 5e-4}
+
+
+def _tiny_model(max_pos=192):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_attention_heads=4,
+                    max_position_embeddings=max_pos,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _pin_sessions(target, others, n):
+    """First n session ids whose rendezvous hash lands on ``target``
+    against every name in ``others`` — deterministic request pinning
+    so a scripted kill is guaranteed to hit loaded prey."""
+    from paddle_tpu.serving.router import rendezvous_score
+
+    out, i = [], 0
+    while len(out) < n:
+        s = f"chaos{i}"
+        i += 1
+        if all(rendezvous_score(s, target) > rendezvous_score(s, o)
+               for o in others):
+            out.append(s)
+    return out
+
+
+def _mttr_ms(fleet, recovery):
+    """Worst-case mean-time-to-recovery for one quarantine event: for
+    every re-dispatched request, the gap from replica death to its
+    FIRST post-death token (``delivered`` tokens existed at death, so
+    ``_token_times[delivered]`` is the first one a survivor emitted).
+    Both sides share the fleet clock (perf_counter)."""
+    vals = []
+    for req in recovery["requests"]:
+        entry = fleet._requests.get(req["rid"]) or {}
+        h = entry.get("handle")
+        if h is None:
+            continue
+        d = req["delivered"]
+        if d < len(h._token_times):
+            vals.append((h._token_times[d] - recovery["t_dead"]) * 1e3)
+    return round(max(vals), 3) if vals else None
+
+
+def run_probe():
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import faults
+    from paddle_tpu.observability.faults import FaultError
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    from paddle_tpu.serving.request import FinishReason, RequestState
+
+    obs.set_strict_retrace(True)
+
+    m, cfg = _tiny_model()
+    rec, fails = {}, []
+
+    def check(name, fn):
+        try:
+            fn()
+            rec[name] = "pass"
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            rec[name] = f"FAIL: {type(e).__name__}: {e}"[:300]
+            fails.append(name)
+        finally:
+            faults.reset()
+
+    KW = dict(max_slots=4, max_len=96, page_size=8, chunk_size=16,
+              prefill_batch=2)
+
+    def workload(rng_seed, n, lo=4, hi=30, blo=4, bhi=12):
+        rng = np.random.default_rng(rng_seed)
+        prompts = [rng.integers(1, 64, (int(rng.integers(lo, hi)),))
+                   .astype(np.int32) for _ in range(n)]
+        budgets = [int(rng.integers(blo, bhi)) for _ in range(n)]
+        return prompts, budgets
+
+    def engine_clean(eng):
+        lk = eng.leak_check()
+        assert (lk["free_pages"] == lk["total_pages"]
+                and lk["free_slots"] == lk["total_slots"]
+                and lk["resident_slot_pages"] == 0
+                and lk["leased_slots"] == 0), lk
+
+    def reference(kw, prompts, budgets, seed0):
+        """Fault-free single-engine truth for the same (prompt, seed)
+        workload — the parity target every chaos lane must hit."""
+        eng = ServingEngine(m, **kw)
+        hs = [eng.submit(p, b, seed=seed0 + i)
+              for i, (p, b) in enumerate(zip(prompts, budgets))]
+        eng.run()
+        engine_clean(eng)
+        return [list(h.output_tokens) for h in hs]
+
+    # -- kill a decode replica mid-stream ---------------------------------
+    def kill_mid_decode():
+        prompts, budgets = workload(7, 6)
+        ref = reference(KW, prompts, budgets, 100)
+        inj = faults.install(0)
+        inj.arm("serving.step.raise", at=4, match={"engine": "d0"},
+                message="chaos: kill d0 mid-decode")
+        fleet = FleetRouter(model=m, decode_replicas=2, engine_kw=KW,
+                            seed=7, watchdog={})
+        fhs = [fleet.submit(p, b, seed=100 + i)
+               for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet.run()
+        got = [list(h.output_tokens) for h in fhs]
+        assert got == ref, "replica kill changed a token stream"
+        assert all(h.done for h in fhs)
+        recs = fleet.recoveries
+        assert len(recs) == 1 and recs[0]["replica"] == "d0" \
+            and recs[0]["cause"] == "error", recs
+        assert recs[0]["safe_harvest"] is True, recs
+        # genuinely mid-stream: at least one victim had already
+        # streamed tokens when the replica died
+        assert any(q["delivered"] > 0 for q in recs[0]["requests"]), \
+            recs
+        snap = fleet.metrics_snapshot()
+        assert snap["quarantined_replicas"] == ["d0"], snap
+        lk = fleet.leak_check()
+        assert lk["clean"], lk
+        mttr = _mttr_ms(fleet, recs[0])
+        assert mttr is not None and mttr > 0, recs
+        rec["kill_decode_detail"] = {
+            "redispatched": recs[0]["redispatched"],
+            "delivered_at_death":
+                [q["delivered"] for q in recs[0]["requests"]],
+            "mttr_ms": mttr,
+        }
+        rec["mttr_ms"] = mttr
+
+    # -- kill the adopter around the hand-off window ----------------------
+    def kill_mid_handoff():
+        prompts, budgets = workload(11, 4)
+        ref = reference(KW, prompts, budgets, 200)
+        sessions = _pin_sessions("d0", ["d1"], 4)
+
+        # (a) the adopter dies on the very step it adopted
+        inj = faults.install(1)
+        inj.arm("serving.step.raise", at=1, match={"engine": "d0"},
+                message="chaos: kill d0 on its first post-adopt step")
+        fleet = FleetRouter(model=m, decode_replicas=2,
+                            prefill_replicas=1, engine_kw=KW, seed=7,
+                            watchdog={})
+        fhs = [fleet.submit(p, b, seed=200 + i, session=sessions[i])
+               for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet.run()
+        assert [list(h.output_tokens) for h in fhs] == ref, \
+            "post-adopt kill changed a token stream"
+        assert fleet.recoveries \
+            and fleet.recoveries[0]["replica"] == "d0"
+        assert fleet._by_name["p0"].engine.leased_count == 0
+        lk = fleet.leak_check()
+        assert lk["clean"], lk
+        faults.reset()
+
+        # (b) the adopter dies BETWEEN export and import: the hand-off
+        # is still in its inbox. The lease keeps the exporter's pages
+        # alive, so the item just moves to the survivor's inbox.
+        fleet2 = FleetRouter(model=m, decode_replicas=2,
+                             prefill_replicas=1, engine_kw=KW, seed=7,
+                             watchdog={})
+        fhs2 = [fleet2.submit(p, b, seed=200 + i, session=sessions[i])
+                for i, (p, b) in enumerate(zip(prompts, budgets))]
+        d0 = fleet2._by_name["d0"]
+        for _ in range(20_000):
+            if d0.pending_imports:
+                break
+            fleet2.step()
+        assert d0.pending_imports, "hand-off never reached d0's inbox"
+        d0.error = RuntimeError(
+            "chaos: adopter died between export and import")
+        assert fleet2._watchdog_tick()
+        fleet2.run()
+        assert [list(h.output_tokens) for h in fhs2] == ref, \
+            "inbox-kill changed a token stream"
+        recs = fleet2.recoveries
+        assert recs and any(q.get("handoff")
+                            for q in recs[0]["requests"]), recs
+        assert fleet2._by_name["p0"].engine.leased_count == 0
+        lk2 = fleet2.leak_check()
+        assert lk2["clean"], lk2
+        rec["kill_handoff_detail"] = {
+            "post_adopt_redispatched":
+                fleet.recoveries[0]["redispatched"],
+            "inbox_items_moved":
+                sum(1 for q in recs[0]["requests"]
+                    if q.get("handoff")),
+        }
+
+    # -- corrupt hand-off payload rejected before allocation --------------
+    def corrupt_handoff():
+        from paddle_tpu.observability import recorder
+
+        prompts, budgets = workload(13, 3)
+        ref = reference(KW, prompts, budgets, 300)
+
+        # leased: crc reject -> relet (exporter re-exports the pages)
+        inj = faults.install(2)
+        inj.arm("kv.handoff.corrupt")
+        fleet = FleetRouter(model=m, decode_replicas=1,
+                            prefill_replicas=1, engine_kw=KW, seed=7,
+                            watchdog={})
+        fhs = [fleet.submit(p, b, seed=300 + i)
+               for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet.run()
+        assert [list(h.output_tokens) for h in fhs] == ref, \
+            "corrupt-blob relet changed a token stream"
+        assert sum(1 for e in inj.log
+                   if e["point"] == "kv.handoff.corrupt") == 1, inj.log
+        evs = [e["kind"] for e in recorder().snapshot()]
+        assert "fleet_handoff_corrupt" in evs
+        assert fleet._by_name["p0"].engine.leased_count == 0
+        lk = fleet.leak_check()
+        assert lk["clean"], lk
+        faults.reset()
+
+        # unleased: pages were freed at export — resume-by-re-prefill
+        inj = faults.install(3)
+        inj.arm("kv.handoff.corrupt")
+        fleet2 = FleetRouter(model=m, decode_replicas=1,
+                             prefill_replicas=1, engine_kw=KW, seed=7,
+                             watchdog={}, handoff_lease=False)
+        fhs2 = [fleet2.submit(p, b, seed=300 + i)
+                for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet2.run()
+        assert [list(h.output_tokens) for h in fhs2] == ref, \
+            "corrupt-blob re-prefill fallback changed a token stream"
+        assert sum(1 for e in inj.log
+                   if e["point"] == "kv.handoff.corrupt") == 1, inj.log
+        lk2 = fleet2.leak_check()
+        assert lk2["clean"], lk2
+        rec["corrupt_detail"] = {"leased_relet": True,
+                                 "unleased_reprefill": True}
+
+    # -- host-ring drops under eviction pressure --------------------------
+    def ring_drop_under_evict():
+        full_kw = dict(max_slots=8, max_len=96, page_size=8,
+                       chunk_size=16, do_sample=True, temperature=0.9,
+                       top_k=8)
+        prompts, budgets = workload(3, 8, lo=10, hi=40, blo=8, bhi=24)
+        ref = reference(full_kw, prompts, budgets, 500)
+
+        tight_kw = dict(full_kw, num_pages=1 + 3 * (96 // 8))
+        inj = faults.install(4)
+        inj.arm("kv.ring.drop", every=2, times=None)
+        fleet = FleetRouter(model=m, decode_replicas=1,
+                            engine_kw=tight_kw, host_ring_mb=8.0,
+                            seed=7)
+        fhs = [fleet.submit(p, b, seed=500 + i)
+               for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet.run()
+        assert [list(h.output_tokens) for h in fhs] == ref, \
+            "ring drops changed a sampled stream"
+        snap = fleet.metrics_snapshot()
+        dropped = sum(1 for e in inj.log
+                      if e["point"] == "kv.ring.drop")
+        assert snap["host_ring"]["drops"] >= 1, snap["host_ring"]
+        assert dropped >= 1, inj.summary()
+        lk = fleet.leak_check()
+        assert lk["clean"], lk
+        rec["ring_drop_detail"] = {
+            "injected_drops": dropped,
+            "ring": snap["host_ring"],
+        }
+
+    # -- per-request wall deadlines ---------------------------------------
+    def deadline():
+        rng = np.random.default_rng(5)
+        p1 = rng.integers(1, 64, (8,)).astype(np.int32)
+        p2 = rng.integers(1, 64, (8,)).astype(np.int32)
+
+        # queue expiry: deadline already passed at the first sweep
+        eng = ServingEngine(m, **KW)
+        h_dead = eng.submit(p1, 8, seed=1, deadline_s=0.0)
+        h_ok = eng.submit(p2, 6, seed=2)
+        eng.run()
+        assert h_dead.done and h_dead.finish_reason \
+            is FinishReason.DEADLINE_EXCEEDED, h_dead.finish_reason
+        assert len(h_dead.output_tokens) == 0
+        assert h_ok.done and len(h_ok.output_tokens) == 6 \
+            and h_ok.finish_reason is not FinishReason.DEADLINE_EXCEEDED
+        engine_clean(eng)
+
+        # resident expiry: injected slow steps walk a running request
+        # past its deadline -> retired mid-stream, pages freed
+        inj = faults.install(5)
+        inj.arm("serving.step.stuck", delay_s=0.03, every=1,
+                times=None)
+        eng2 = ServingEngine(m, **KW)
+        h2 = eng2.submit(p1, 64, seed=3, deadline_s=0.12)
+        eng2.run()
+        assert h2.done and h2.finish_reason \
+            is FinishReason.DEADLINE_EXCEEDED, h2.finish_reason
+        assert len(h2.output_tokens) < 64
+        engine_clean(eng2)
+        faults.reset()
+
+        # fleet pass-through
+        fleet = FleetRouter(model=m, decode_replicas=1, engine_kw=KW,
+                            seed=7)
+        fh = fleet.submit(p1, 8, seed=4, deadline_s=0.0)
+        fleet.run()
+        assert fh.done and fh.finish_reason \
+            is FinishReason.DEADLINE_EXCEEDED, fh.finish_reason
+        lkf = fleet.leak_check()
+        assert lkf["clean"], lkf
+        rec["deadline_detail"] = {
+            "queue_expired_tokens": len(h_dead.output_tokens),
+            "resident_expired_tokens": len(h2.output_tokens),
+        }
+
+    # -- bounded in-place recovery retries --------------------------------
+    def recover_retry():
+        prompts, budgets = workload(17, 4)
+        ref = reference(KW, prompts, budgets, 400)
+        inj = faults.install(6)
+        inj.arm("serving.step.raise", at=3)
+        eng = ServingEngine(m, **KW, recover_retries=2,
+                            recover_backoff_s=0.0)
+        hs = [eng.submit(p, b, seed=400 + i)
+              for i, (p, b) in enumerate(zip(prompts, budgets))]
+        eng.run()
+        assert [list(h.output_tokens) for h in hs] == ref, \
+            "in-place recovery changed a token stream"
+        assert sum(1 for e in inj.log
+                   if e["point"] == "serving.step.raise") == 1, inj.log
+        engine_clean(eng)
+        faults.reset()
+
+        # retries exhausted (0): the first fault escalates
+        inj = faults.install(7)
+        inj.arm("serving.step.raise", at=1)
+        eng2 = ServingEngine(m, **KW)
+        eng2.submit(prompts[0], 4, seed=1)
+        raised = False
+        try:
+            eng2.run()
+        except FaultError:
+            raised = True
+        assert raised, "recover_retries=0 must escalate"
+        rec["recover_detail"] = {"absorbed": 1, "escalated": True}
+
+    # -- brown-out sheds low-priority admissions below watermark ----------
+    def brownout():
+        prompts, budgets = workload(19, 6)
+        inj = faults.install(8)
+        inj.arm("serving.step.raise", at=2, match={"engine": "d0"},
+                message="chaos: kill d0 to trip the brown-out")
+        fleet = FleetRouter(
+            model=m, decode_replicas=2, engine_kw=KW, seed=7,
+            watchdog={},
+            brownout=dict(watermark=0.75, priority_floor=1))
+        hs = [fleet.submit(p, b, seed=600 + i, priority=1)
+              for i, (p, b) in enumerate(zip(prompts, budgets))]
+        for _ in range(20_000):
+            if fleet.recoveries:
+                break
+            fleet.step()
+        assert fleet.recoveries, "kill never tripped"
+        assert fleet._brownout_active()
+        shed = fleet.submit(prompts[0], 4, seed=999, priority=0)
+        assert shed.done and shed.state is RequestState.FAILED \
+            and shed.finish_reason is FinishReason.SHED, \
+            (shed.state, shed.finish_reason)
+        assert len(shed.output_tokens) == 0
+        kept = fleet.submit(prompts[0], 4, seed=998, priority=1)
+        fleet.run()
+        assert kept.done and len(kept.output_tokens) == 4 \
+            and kept.finish_reason is not FinishReason.SHED
+        assert all(h.done for h in hs)
+        lk = fleet.leak_check()
+        assert lk["clean"], lk
+        rec["brownout_detail"] = {
+            "healthy": len(fleet.decode_replicas()),
+            "nominal": fleet._nominal_decode,
+            "shed": shed.finish_reason.value,
+        }
+
+    # -- threaded: wedged step -> SUSPECT -> DEAD -> lockless harvest -----
+    def stuck_watchdog():
+        prompts, budgets = workload(23, 6, lo=4, hi=12, blo=6, bhi=10)
+        ref = reference(KW, prompts, budgets, 700)
+        sessions = _pin_sessions("d0", ["d1"], 3)
+        fleet = FleetRouter(
+            model=m, decode_replicas=2, engine_kw=KW, seed=7,
+            threaded=True,
+            watchdog=dict(suspect_after_s=0.08, dead_after_s=0.25))
+        fleet.warmup()
+        # arm AFTER warmup: warmup drives step() through the same
+        # fault points and would eat the trigger
+        inj = faults.install(9)
+        inj.arm("serving.step.stuck", at=2, match={"engine": "d0"},
+                delay_s=0.8)
+        fleet.start()
+        fhs = [fleet.submit(p, b, seed=700 + i,
+                            session=(sessions[i] if i < 3 else None))
+               for i, (p, b) in enumerate(zip(prompts, budgets))]
+        fleet.drain(timeout_s=60.0)
+        out = fleet.stop()
+        assert out["hung_replicas"] == [], out   # 0.8s wedge < 30s join
+        got = [list(h.output_tokens) for h in fhs]
+        assert got == ref, "stuck-replica recovery changed a stream"
+        recs = fleet.recoveries
+        assert recs and recs[0]["replica"] == "d0" \
+            and recs[0]["cause"] == "stuck", recs
+        assert recs[0]["safe_harvest"] is False, recs
+        lk = fleet.leak_check()
+        assert lk["clean"], lk
+        # the wedged engine is exempt (its receipt is unreadable while
+        # the thread owns it), but it must be SURFACED as quarantined
+        q = lk["replicas"]["d0"]
+        assert q.get("quarantined") and q["clean"] is None, q
+        mttr = _mttr_ms(fleet, recs[0])
+        assert mttr is not None, recs
+        rec["stuck_detail"] = {
+            "mttr_ms": mttr,
+            "redispatched": recs[0]["redispatched"],
+        }
+        rec["mttr_stuck_ms"] = mttr
+
+    # -- hung thread recorded (never silently ignored) at stop() ----------
+    def hung_join():
+        fleet = FleetRouter(model=m, decode_replicas=2, engine_kw=KW,
+                            seed=7, threaded=True, join_timeout_s=0.05)
+        fleet.warmup()
+        inj = faults.install(10)
+        inj.arm("serving.step.stuck", at=1, match={"engine": "d0"},
+                delay_s=1.0)
+        fleet.start()
+        sessions = _pin_sessions("d0", ["d1"], 1)
+        try:
+            fleet.submit(np.ones((8,), np.int32), 4, seed=1,
+                         session=sessions[0])
+            time.sleep(0.3)          # let d0 enter the wedge
+            out = fleet.stop()
+            assert out["hung_replicas"] == ["d0"], out
+            assert any(e["action"] == "replica_hung"
+                       for e in fleet.events), fleet.events
+            snap = fleet.metrics_snapshot()
+            assert snap["hung_replicas"] == ["d0"], snap
+            raised = False
+            try:
+                fleet.stop(strict=True)
+            except RuntimeError:
+                raised = True
+            assert raised, "strict stop must raise on a hung replica"
+            rec["hung_detail"] = {"hung": out["hung_replicas"]}
+        finally:
+            # tidy: the wedge is 1 s — join for real so no thread
+            # outlives the lane
+            for r in (list(fleet._replicas) + list(fleet._retired)
+                      + list(fleet._quarantined)):
+                if r.thread is not None:
+                    r.thread.join(5.0)
+
+    check("chaos_kill_mid_decode", kill_mid_decode)
+    check("chaos_kill_mid_handoff", kill_mid_handoff)
+    check("chaos_corrupt_handoff", corrupt_handoff)
+    check("chaos_ring_drop_under_evict", ring_drop_under_evict)
+    check("chaos_deadline", deadline)
+    check("chaos_recover_retry", recover_retry)
+    check("chaos_brownout", brownout)
+    check("chaos_stuck_watchdog", stuck_watchdog)
+    check("chaos_hung_join", hung_join)
+    rec["retrace_sentinel"] = {
+        "strict": obs.strict_retrace(),
+        "total_unexpected": obs.retrace_summary()["total_unexpected"],
+    }
+    rec["check"] = ("pass" if not fails
+                    else "FAIL: " + ", ".join(fails))
+    return rec
+
+
+def run_elastic(n_devices=8):
+    """Training lane: dp8 crash -> IN-PROCESS elastic resume onto dp4.
+    The crash is an armed ``train.step.crash`` (fires BEFORE the
+    compiled step dispatches, so no donated buffer is half-consumed);
+    resume restores the last checkpoint onto a 4-device mesh (the
+    ``__scan_shard_*__`` pad-reshard path) and the continued loss
+    trajectory must match the uninterrupted dp8 run within
+    TOL["resume"]."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.checkpoint.manager import (
+        CheckpointManager,
+    )
+    from paddle_tpu.jit import ShardedFusedScanTrainStep
+    from paddle_tpu.models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+    from paddle_tpu.observability import faults
+    from paddle_tpu.observability.faults import FaultError
+
+    out = {"metric": "chaos_elastic_resume", "from_devices": n_devices,
+           "to_devices": 4, "tolerance": TOL["resume"]}
+    devs = jax.devices("cpu")[:n_devices]
+    if len(devs) < n_devices:
+        out["check"] = f"FAIL: {len(devs)} cpu devices < {n_devices}"
+        return out
+
+    TINY = dict(vocab_size=92, hidden_size=36, num_layers=4,
+                num_attention_heads=2, max_position_embeddings=16,
+                hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 92, (n_devices, 12)),
+                           dtype="int64")
+    labels = paddle.to_tensor(rng.integers(0, 92, (n_devices, 12)),
+                              dtype="int64")
+
+    def build(nd, seed_=0):
+        cfg = GPTConfig(**TINY, scan_layers=True)
+        paddle.seed(seed_)
+        model = GPTForCausalLM(cfg)
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters(),
+                         grad_clip=nn.ClipGradByGlobalNorm(0.05))
+        mesh = Mesh(np.asarray(devs[:nd]), ("sharding",))
+        denv.set_mesh(mesh)
+        step = ShardedFusedScanTrainStep(
+            model, opt, criterion=GPTPretrainingCriterion(),
+            mesh=mesh, axis="sharding", param_storage="sharded")
+        return model, opt, step
+
+    tmp = tempfile.mkdtemp(prefix="chaos_elastic_")
+    try:
+        # uninterrupted dp8 truth
+        _, _, step = build(n_devices)
+        straight = [float(step(ids, labels)) for _ in range(6)]
+
+        # crashed run: 3 steps, checkpoint, then the armed crash
+        model, opt, step = build(n_devices)
+        part1 = [float(step(ids, labels)) for _ in range(3)]
+        CheckpointManager(tmp, model=model, optimizer=opt).save(2)
+        inj = faults.install(0)
+        inj.arm("train.step.crash",
+                message="chaos: dp8 trainer crash")
+        crashed = False
+        try:
+            step(ids, labels)
+        except FaultError:
+            crashed = True
+        t_crash = time.perf_counter()
+        faults.reset()
+        assert crashed, "armed train.step.crash never fired"
+
+        # in-process elastic resume: HALF the mesh, fresh everything
+        model2, opt2, step2 = build(4, seed_=99)
+        step2.ensure_built()
+        restored = CheckpointManager(tmp, model=model2,
+                                     optimizer=opt2).restore_or_init()
+        part2 = [float(step2(ids, labels))]
+        t_recovered = time.perf_counter()
+        part2 += [float(step2(ids, labels)) for _ in range(2)]
+        drift = max(abs(a - b)
+                    for a, b in zip(straight, part1 + part2))
+        out.update({
+            "restored_step": restored,
+            "straight": straight, "resumed": part1 + part2,
+            "resume_drift": drift,
+            "mttr_train_ms": round((t_recovered - t_crash) * 1e3, 1),
+            "injected": inj.summary()["hits"],
+        })
+        ok = restored == 2 and drift <= TOL["resume"]
+        out["check"] = ("pass" if ok
+                        else f"FAIL: restored={restored} "
+                             f"drift={drift}")
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        out["check"] = f"FAIL: {type(e).__name__}: {e}"[:400]
+    finally:
+        faults.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def main(argv):
+    if "--elastic" in argv:
+        print(json.dumps(run_elastic()))
+        return
+    rec = {"metric": "chaos_selftest"}
+    try:
+        rec.update(run_probe())
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        rec["check"] = f"FAIL: {type(e).__name__}: {e}"[:400]
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

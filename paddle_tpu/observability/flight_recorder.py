@@ -110,6 +110,18 @@ class FlightRecorder:
                     rec["metrics"] = registry().snapshot()
                 except Exception:
                     rec["metrics"] = {}
+                try:
+                    # ISSUE 19: if a fault injector is live, its firing
+                    # log belongs in the black box — "what did we
+                    # inject" is the first question a chaos-run crash
+                    # dump has to answer
+                    from . import faults as _faults
+
+                    inj = _faults.active()
+                    if inj is not None:
+                        rec["faults"] = inj.summary()
+                except Exception:
+                    pass
             if path is None:
                 root = os.environ.get("PADDLE_FLIGHT_DIR",
                                       ".flight_recorder")

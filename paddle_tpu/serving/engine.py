@@ -45,7 +45,7 @@ from ..jit.decode_step import (ChunkPrefillStep, ServeDecodeStep,
                                ServeSpecDecodeStep, _split_state,
                                refresh_serving_buffers)
 from ..jit.train_step import _tree_data
-from ..observability import SLOTracker, Tracer
+from ..observability import SLOTracker, Tracer, faults
 from .metrics import ServingMetrics
 from .request import FinishReason, Request, RequestHandle, RequestState
 from .scheduler import RequestScheduler
@@ -65,7 +65,8 @@ class ServingEngine:
                  trace=True, trace_capacity=256, exemplar_capacity=32,
                  exemplar_quantile=99.0, exemplar_min_samples=32,
                  slos=(), debug_port=None, tuner=False, tuner_kw=None,
-                 prefill_only=False, host_kv_ring=None):
+                 prefill_only=False, host_kv_ring=None,
+                 recover_retries=0, recover_backoff_s=0.05):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -205,6 +206,30 @@ class ServingEngine:
         self._tokens = np.zeros((self.max_slots,), np.int32)
         self._seeds = np.zeros((self.max_slots,), np.uint32)
         self._rid = 0
+        # self-healing (ISSUE 19): up to `recover_retries` CONSECUTIVE
+        # step failures are absorbed in place (recover + exponential
+        # backoff) before escalating to the caller — the fleet watchdog
+        # turns the escalation into replica-dead. 0 = raise through on
+        # the first failure (the pre-chaos behaviour).
+        self.recover_retries = int(recover_retries)
+        self.recover_backoff_s = float(recover_backoff_s)
+        self._recover_streak = 0
+        # set (GIL-atomically, from the fleet watchdog) when this
+        # engine is quarantined while a step is still wedged in flight:
+        # the next statement the unstuck step reaches bails out instead
+        # of emitting tokens for handles a survivor now owns
+        self._fenced = False
+        # fleet-assigned replica name, threaded into fault-point
+        # context so a chaos script can target one replica by name
+        self.name = None
+        # open hand-off leases (ISSUE 19): lease_id -> (slot, rid).
+        # A leased export keeps its pages allocated here until the
+        # adopter acks, so a decode replica dying between export and
+        # import loses nothing — the blob is re-exportable.
+        self._leased: dict[int, tuple] = {}
+        self._lease_seq = 0
+        # deadline sweep runs only once a deadline request exists
+        self._has_deadlines = False
 
     def _make_cache(self):
         cfg = self.model.config
@@ -230,8 +255,8 @@ class ServingEngine:
 
     # -- client surface ---------------------------------------------------
     def submit(self, prompt, max_new_tokens, priority=0,
-               eos_token_id=None, seed=None, on_token=None, rid=None
-               ) -> RequestHandle:
+               eos_token_id=None, seed=None, on_token=None, rid=None,
+               deadline_s=None) -> RequestHandle:
         """Queue a request; returns a streaming handle immediately.
         Tokens arrive as the engine steps (`step()`/`run()`/`stream()`).
 
@@ -239,6 +264,11 @@ class ServingEngine:
         fleet assigns GLOBALLY unique rids so one request's trace legs
         stitch across replicas (prefill leg, decode leg, onload) by the
         same ``req<rid>`` track name.
+
+        ``deadline_s`` (optional) is a wall budget from submit: a
+        request still unfinished when it expires retires with finish
+        reason ``deadline_exceeded`` (pages freed, span annotated) at
+        the next step — a wedged replica cannot hold a client forever.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -262,15 +292,21 @@ class ServingEngine:
             self._rid = max(self._rid, rid + 1)
         req = Request(rid, prompt, int(max_new_tokens),
                       priority=int(priority), eos_token_id=eos_token_id,
-                      seed=int(seed) if seed is not None else rid)
+                      seed=int(seed) if seed is not None else rid,
+                      deadline_s=(float(deadline_s)
+                                  if deadline_s is not None else None))
         handle = RequestHandle(req, on_token=on_token)
         handle.arrival_seq = rid
         handle.submit_time = self.clock()
+        if req.deadline_s is not None:
+            handle.deadline = handle.submit_time + req.deadline_s
+            self._has_deadlines = True
         # root of this request's causal timeline + the first queue wait
         handle._span = self.tracer.begin(
             "request", track=f"req{rid}", rid=rid,
             prompt_len=int(prompt.size),
-            max_new_tokens=int(max_new_tokens), priority=int(priority))
+            max_new_tokens=int(max_new_tokens), priority=int(priority),
+            deadline_s=req.deadline_s)
         handle._span_queue = self.tracer.begin("queue_wait",
                                                parent=handle._span)
         self.scheduler.enqueue(handle)
@@ -281,7 +317,22 @@ class ServingEngine:
         """One scheduler iteration: admit, <=N prefill chunks, one
         decode for all running sequences. Returns False when idle."""
         sched = self.scheduler
+        worked = False
         try:
+            faults.maybe_delay("serving.step.stuck", engine=self.name)
+            faults.maybe_raise("serving.step.raise", engine=self.name)
+            if self._fenced:
+                # quarantined while a step was wedged: the fleet has
+                # already re-dispatched every resident handle to a
+                # survivor, so when this thread unsticks it must not
+                # touch handle state again. Drop the local roster and
+                # go idle; pages/slots leak inside this quarantined
+                # engine by design (leak_check exempts it).
+                sched.running.clear()
+                sched.waiting.clear()
+                return False
+            if self._has_deadlines:
+                self._expire_deadlines()
             onloaded = False
             for h in sched.admit():
                 # full-width uint32: distinct seeds stay distinct
@@ -309,7 +360,6 @@ class ServingEngine:
                 # import_slot rewrote pool pages out-of-band — re-split
                 # at the safe boundary before the next compiled call
                 refresh_serving_buffers(self)
-            worked = False
             for _ in range(self.prefill_chunks_per_step):
                 heads = sched.prefill_heads(self.prefill_batch)
                 if not heads:
@@ -318,9 +368,12 @@ class ServingEngine:
                 worked = True
             if not self.prefill_only and sched.decode_slots():
                 worked |= self._run_decode()
-        except BaseException:
-            self._recover()
-            raise
+            self._recover_streak = 0
+        except BaseException as e:
+            self._recover(exc=e)
+            if not self._retry_after_recover(e):
+                raise
+            worked = True
         self.metrics.observe(len(sched.waiting), len(sched.running))
         if self.tuner is not None:
             # the safe boundary: no compiled call is in flight here, so
@@ -353,26 +406,98 @@ class ServingEngine:
                                    "engine is idle")
             self.step()
 
+    # -- deadlines (ISSUE 19) ---------------------------------------------
+    def _expire_deadlines(self):
+        """Retire every request whose wall deadline has passed: waiting
+        handles finish straight from the queue, resident ones through
+        the normal retire path (pages freed immediately). Runs at the
+        top of each step, so a request can overrun its deadline by at
+        most one dispatch."""
+        now = self.clock()
+        sched = self.scheduler
+        for h in [h for h in sched.waiting
+                  if h.deadline is not None and now > h.deadline]:
+            sched.waiting.remove(h)
+            h.state = RequestState.FINISHED
+            h.finish_reason = FinishReason.DEADLINE_EXCEEDED
+            h.finish_time = now
+            self.metrics.on_finish(h)
+            self._retired_this_call.append(h)
+        for slot, h in [(s, h) for s, h in sched.running.items()
+                        if h.deadline is not None and now > h.deadline]:
+            self.tracer.instant("deadline_exceeded", parent=h._span,
+                                slot=slot,
+                                tokens=len(h.output_tokens))
+            sched.retire(slot, FinishReason.DEADLINE_EXCEEDED, now)
+            self._retired_this_call.append(h)
+        if self._retired_this_call:
+            from ..observability import registry as _greg
+
+            _greg().counter("serving.deadline_exceeded").inc(
+                len(self._retired_this_call))
+            self._flush_retired()
+
     # -- prefill/decode disaggregation (ISSUE 18) -------------------------
-    def export_handoff(self, slot: int):
+    def export_handoff(self, slot: int, lease: bool = False):
         """Detach a freshly-prefilled sequence for adoption by a decode
-        replica: copies its KV pages out, frees the slot, and closes
-        this engine's leg of the request trace. Returns
-        ``(handle, blob, last_token)`` — the not-yet-cached last sample
-        travels with the pages, exactly like an eviction."""
+        replica: copies its KV pages out and closes this engine's leg
+        of the request trace. Returns ``(handle, blob, last_token)`` —
+        the not-yet-cached last sample travels with the pages, exactly
+        like an eviction.
+
+        ``lease=True`` (ISSUE 19) makes the hand-off a transaction:
+        the slot's pages stay allocated HERE (inactive) under an open
+        lease — stamped into the blob as ``blob["lease_id"]`` — until
+        the adopter acks via :meth:`ack_handoff`, so an adopter dying
+        between export and import loses nothing:
+        :meth:`reexport_handoff` re-materializes the blob from the
+        retained pages. ``lease=False`` frees the slot immediately
+        (the pre-chaos fire-and-forget hand-off)."""
         handle = self.scheduler.running.pop(slot)
         blob = self.cache.export_slot(slot)
         last_token = int(handle.output_tokens[-1])
-        self.cache.free(slot)
+        lease_id = None
+        if lease:
+            lease_id = self._lease_seq
+            self._lease_seq += 1
+            self.cache.set_active(slot, False)
+            self._leased[lease_id] = (slot, handle.request.rid)
+            blob["lease_id"] = lease_id
+        else:
+            self.cache.free(slot)
         handle.slot = None
         if handle._span is not None:
             self.tracer.instant("kv_handoff_export", parent=handle._span,
                                 slot=slot, pages=blob["pages"],
-                                bytes=blob["nbytes"])
+                                bytes=blob["nbytes"], lease=lease_id)
             self.tracer.end(handle._span, handoff=True,
                             tokens=len(handle.output_tokens))
             handle._span = None
         return handle, blob, last_token
+
+    def ack_handoff(self, lease_id: int) -> bool:
+        """Adopter confirmed the import landed: release the leased
+        slot's retained pages. Idempotent (a re-delivered ack after a
+        re-export/recovery is a no-op)."""
+        ent = self._leased.pop(lease_id, None)
+        if ent is None:
+            return False
+        slot, _rid = ent
+        self.cache.free(slot)
+        return True
+
+    def reexport_handoff(self, lease_id: int):
+        """Re-materialize a still-leased hand-off blob from the
+        retained pages (the first copy was corrupted in flight, or its
+        adopter died holding it). The lease stays open until an ack."""
+        slot, _rid = self._leased[lease_id]
+        blob = self.cache.export_slot(slot)
+        blob["lease_id"] = lease_id
+        return blob
+
+    @property
+    def leased_count(self) -> int:
+        return len(self._leased)
 
     def can_adopt(self, blob: dict) -> bool:
         """Would ``adopt_handoff`` land without instantly starving the
@@ -410,6 +535,30 @@ class ServingEngine:
                             bytes=blob["nbytes"])
         self.metrics.on_admit(resumed=False)
         return slot
+
+    def resubmit(self, handle: RequestHandle) -> RequestHandle:
+        """Adopt an in-flight handle harvested from a dead replica
+        (fleet re-dispatch, ISSUE 19): the request resumes by
+        re-prefill on THIS engine. Tokens already streamed to the
+        client replay through ``pending`` — they are never re-pushed —
+        and the per-request (seed, context-position) RNG stream
+        reproduces the continuation bit-exactly, so the client's
+        delivery stays exactly-once. The caller must have requeued the
+        handle (``_requeue_for_resume``) and bumped its epoch fence."""
+        rid = handle.request.rid
+        self._rid = max(self._rid, rid + 1)
+        if handle.deadline is not None:
+            self._has_deadlines = True
+        handle._span = self.tracer.begin(
+            "request", track=f"req{rid}", rid=rid, phase="redispatch",
+            delivered=len(handle.output_tokens),
+            prompt_len=len(handle.request.prompt),
+            max_new_tokens=handle.request.max_new_tokens,
+            priority=handle.request.priority)
+        handle._span_queue = self.tracer.begin(
+            "queue_wait", parent=handle._span, redispatch=True)
+        self.scheduler.enqueue(handle)
+        return handle
 
     def compile_counts(self) -> dict:
         """Retrace probe surface: decode must stay at ONE trace across
@@ -564,6 +713,11 @@ class ServingEngine:
         """
         B = self.prefill_batch
         heads = heads[:B]
+        # epoch fence (ISSUE 19): if the fleet re-dispatches a handle
+        # off this replica while this call is in flight (wedged thread
+        # later unsticking), its results must be discarded — advancing
+        # prefill_pos or emitting here would race the survivor
+        epochs = [h._epoch for h in heads]
         chunks = [h.pending[h.prefill_pos:
                             h.prefill_pos + self.chunk_size]
                   for h in heads]
@@ -600,6 +754,8 @@ class ServingEngine:
                 self.tracer.end(sp)
             tok = None
             for j, (h, chunk) in enumerate(zip(heads, chunks)):
+                if h._epoch != epochs[j]:
+                    continue   # harvested mid-call: stale result
                 self.metrics.prefill_chunks += 1
                 h.prefill_pos += len(chunk)
                 if h.prefill_pos < len(h.pending):
@@ -654,6 +810,9 @@ class ServingEngine:
                 and sched.running[s].state is RequestState.RUNNING]
         if not live:
             return False
+        faults.maybe_delay("serving.decode.straggler", engine=self.name)
+        # epoch fence (ISSUE 19): see _run_prefill_chunk
+        epochs = {s: sched.running[s]._epoch for s in live}
         # spans must close even when the compiled call (or a user
         # on_token callback) raises — see _run_prefill_chunk
         dspans = {slot: self.tracer.begin(
@@ -679,7 +838,8 @@ class ServingEngine:
                 for slot in live:
                     handle = sched.running.get(slot)
                     if (handle is None or handle.state
-                            is not RequestState.RUNNING):
+                            is not RequestState.RUNNING
+                            or handle._epoch != epochs[slot]):
                         continue   # retired earlier in this burst
                     token = int(tok[slot])
                     self._tokens[slot] = token
@@ -725,6 +885,9 @@ class ServingEngine:
                 and sched.running[s].state is RequestState.RUNNING]
         if not live:
             return False
+        faults.maybe_delay("serving.decode.straggler", engine=self.name)
+        # epoch fence (ISSUE 19): see _run_prefill_chunk
+        epochs = {s: sched.running[s]._epoch for s in live}
         # per-slot acceptance cap = context + approved lookahead; non-
         # participating slots cap at their current length (zero yield)
         caps = np.array(self.cache._host("seq_lens"), np.int32)
@@ -772,7 +935,8 @@ class ServingEngine:
                 handle = sched.running.get(slot)
                 for t in range(int(counts_h[slot])):
                     if (handle is None or handle.state
-                            is not RequestState.RUNNING):
+                            is not RequestState.RUNNING
+                            or handle._epoch != epochs[slot]):
                         break   # retired earlier in this dispatch
                     token = int(toks[slot, t])
                     self._tokens[slot] = token
@@ -976,7 +1140,7 @@ class ServingEngine:
             self._debug_server.stop()
             self._debug_server = None
 
-    def _recover(self):
+    def _recover(self, exc=None):
         """A failed step leaves donated buffers dead — rebuild the cache
         pristine and requeue every resident request for resume. The
         flight recorder keeps the black box of what led here (ISSUE
@@ -985,8 +1149,15 @@ class ServingEngine:
 
         recorder().note("serving_recover",
                         running=len(self.scheduler.running),
-                        waiting=len(self.scheduler.waiting))
+                        waiting=len(self.scheduler.waiting),
+                        leases_dropped=len(self._leased),
+                        error=repr(exc) if exc is not None else None)
         self.scheduler.abort_all()
+        # open hand-off leases die with the pools; adopters that
+        # already hold the blob are unaffected (the blob is
+        # self-contained), ones that come back for a re-export fall
+        # back to resume-by-re-prefill
+        self._leased.clear()
         self.cache = self._make_cache()
         self.scheduler.cache = self.cache
         self._buffers, _ = _split_state(
@@ -995,6 +1166,29 @@ class ServingEngine:
             self.draft_cache = self._make_draft_cache()
             self._buffers["draft"], _ = _split_state(
                 "paged", _tree_data(self.draft_cache.state()))
+
+    def _retry_after_recover(self, exc) -> bool:
+        """Bounded-retry policy after a failed step (ISSUE 19): absorb
+        up to `recover_retries` consecutive failures with exponential
+        backoff — `_recover` already requeued every resident request,
+        so the next step resumes them — then escalate by re-raising;
+        under a fleet, the watchdog turns that into replica-dead."""
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            return False
+        self._recover_streak += 1
+        if (self.recover_retries <= 0
+                or self._recover_streak > self.recover_retries):
+            return False
+        delay = self.recover_backoff_s * 2 ** (self._recover_streak - 1)
+        from ..observability import recorder
+
+        recorder().note("serving_recover_retry",
+                        engine=self.name, attempt=self._recover_streak,
+                        retries=self.recover_retries,
+                        backoff_s=round(delay, 4), error=repr(exc))
+        if delay > 0:
+            time.sleep(delay)
+        return True
 
     # -- introspection ----------------------------------------------------
     def leak_check(self) -> dict:
@@ -1007,4 +1201,5 @@ class ServingEngine:
             "free_slots": c.free_slot_count,
             "total_slots": self.max_slots,
             "resident_slot_pages": len(c._slot_pages),
+            "leased_slots": len(self._leased),
         }

@@ -29,6 +29,20 @@ policies the single engine cannot express:
   replicas record cold-start-to-first-token; with the persistent
   compile cache warm that spin-up is a deserialize.
 
+Self-healing (ISSUE 19): an optional per-replica watchdog walks
+HEALTHY -> SUSPECT -> DEAD from heartbeat/progress staleness, replica
+errors and dead threads; a DEAD replica is quarantined (removed from
+every router, retained for inspection) and its in-flight requests are
+re-dispatched to survivors with exactly-once token delivery — resume
+re-prefills prompt + already-delivered tokens, the deterministic
+per-request RNG regenerates the identical continuation, and an epoch
+fence on every handle stops a wedged thread that later unsticks from
+emitting duplicates. KV hand-offs become lease/ack transactions (the
+exporter retains pages until the adopter acks, so an adopter death
+between export and import loses nothing), and a circuit-breaker
+brown-out sheds lowest-priority admissions while healthy decode
+capacity sits below a watermark of the intended fleet size.
+
 Threading model: one thread per replica (``threaded=True``) or a
 cooperative round-robin ``step()``/``run()`` loop (deterministic —
 the parity lanes use it). Locks are strictly one-at-a-time: replica
@@ -47,10 +61,11 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from ..jit.decode_step import refresh_serving_buffers
-from ..observability import merge_histograms
+from ..observability import faults, merge_histograms
 from ..observability import registry as _global_registry
+from ..observability import recorder as _recorder
 from .engine import ServingEngine
-from .request import RequestState
+from .request import FinishReason, Request, RequestHandle, RequestState
 from .router import ReplicaRouter
 
 __all__ = ["FleetRouter", "HostKVRing", "SLOBurnAutoscaler"]
@@ -76,6 +91,13 @@ class HostKVRing:
         self.drops = 0
 
     def put(self, rid: int, blob: dict, last_token: int):
+        if faults.should_fire("kv.ring.drop", rid=rid):
+            # injected drop: blob discarded before insertion — the
+            # request silently falls back to resume-by-re-prefill,
+            # exactly like a capacity drop
+            with self._lock:
+                self.drops += 1
+            return
         with self._lock:
             old = self._entries.pop(rid, None)
             if old is not None:
@@ -126,6 +148,14 @@ class _Replica:
         self.error = None
         self.pending_imports: deque = deque()  # (handle, blob, token)
         self.spawn_report = None
+        # self-healing state (ISSUE 19)
+        self.health = "healthy"             # healthy | suspect | dead
+        self.heartbeat = None               # clock() at last loop top
+        self.progress = 0                   # worked-step counter
+        self.suspect_since = None
+        self.cause = None                   # why quarantined
+        self.harvest_safe = None            # lock taken during harvest?
+        self.pending_acks: deque = deque()  # lease ids awaiting release
 
     @property
     def load(self) -> int:
@@ -139,7 +169,8 @@ class FleetRouter:
                  decode_replicas=1, prefill_replicas=0, engine_kw=None,
                  threaded=False, seed=0, host_ring_mb=None,
                  autoscale=None, engine_cls=ServingEngine,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, watchdog=None, brownout=None,
+                 handoff_lease=True, join_timeout_s=30.0):
         if model is None and model_factory is None:
             raise ValueError("pass a model or a model_factory")
         # a shared model is safe because replicas only ever BIND the
@@ -184,6 +215,34 @@ class FleetRouter:
         self.prefill_yield_s = 2e-4
         self._started = False
         self.events: list[dict] = []    # spawn/drain/autoscale log
+        # self-healing config (ISSUE 19). watchdog=None keeps the
+        # pre-fleet behavior exactly: replica errors propagate out of
+        # step()/drain() instead of quarantining.
+        self.join_timeout_s = float(join_timeout_s)
+        self._hung: list[str] = []          # replicas whose join timed out
+        self._quarantined: list[_Replica] = []
+        if watchdog is not None:
+            wd = dict(suspect_after_s=0.5, dead_after_s=2.0)
+            wd.update(watchdog if isinstance(watchdog, dict) else {})
+            self.watchdog = wd
+        else:
+            self.watchdog = None
+        if brownout is not None:
+            bo = dict(watermark=0.75, priority_floor=1)
+            bo.update(brownout if isinstance(brownout, dict) else {})
+            self.brownout = bo
+        else:
+            self.brownout = None
+        self.handoff_lease = bool(handoff_lease)
+        self.recoveries: list[dict] = []    # one record per quarantine
+        # leases whose adopter died mid-import: (exporter, lease_id,
+        # handle, tok) tuples waiting for a re-export from the
+        # exporter's retained pages
+        self._relets: deque = deque()
+        # intended decode-set size: brown-out sheds against THIS, so a
+        # quarantine (unlike a deliberate scale_down) counts as lost
+        # capacity
+        self._nominal_decode = 0
         for _ in range(int(prefill_replicas)):
             self._add_replica(self._spawn_replica("prefill", warm=False))
         for _ in range(int(decode_replicas)):
@@ -210,6 +269,7 @@ class FleetRouter:
             self._model_factory(), prefill_only=(role == "prefill"),
             host_kv_ring=(self.host_ring if role == "decode" else None),
             **kw)
+        eng.name = name
         r = _Replica(name, role, eng)
         if warm:
             # cold-start-to-first-token receipt: a tiny probe through
@@ -235,6 +295,9 @@ class FleetRouter:
         self._by_name[r.name] = r
         (self.router if r.role == "decode"
          else self.prefill_router).add(r.name)
+        if r.role == "decode":
+            self._nominal_decode = max(self._nominal_decode,
+                                       len(self.decode_replicas()))
         if self.threaded and self._started:
             self._start_thread(r)
 
@@ -270,7 +333,7 @@ class FleetRouter:
     # -- client surface ---------------------------------------------------
     def submit(self, prompt, max_new_tokens, priority=0,
                eos_token_id=None, seed=None, session=None,
-               on_token=None):
+               on_token=None, deadline_s=None):
         """Route one request into the fleet; returns its handle. The
         fleet rid is globally unique (trace legs stitch by it) and
         doubles as the default sampling seed — a request's token
@@ -281,6 +344,26 @@ class FleetRouter:
             self._rid += 1
         if seed is None:
             seed = rid
+        if (self.brownout is not None and self._brownout_active()
+                and priority < self.brownout["priority_floor"]):
+            # circuit-breaker brown-out: healthy decode capacity is
+            # below the watermark, so low-priority admissions are shed
+            # at the door — never routed, never holding pages
+            req = Request(rid=rid,
+                          prompt=np.asarray(prompt, np.int32),
+                          max_new_tokens=int(max_new_tokens),
+                          priority=priority, eos_token_id=eos_token_id,
+                          seed=seed, deadline_s=deadline_s)
+            handle = RequestHandle(req, on_token=on_token)
+            handle.submit_time = handle.finish_time = self.clock()
+            handle.state = RequestState.FAILED
+            handle.finish_reason = FinishReason.SHED
+            _global_registry().counter("fleet.brownout.shed").inc()
+            _recorder().note("fleet_brownout_shed", rid=rid,
+                             priority=priority,
+                             healthy=len(self.decode_replicas()),
+                             nominal=self._nominal_decode)
+            return handle
         dname = self.router.pick(self._load_of, session=session)
         entry = {"decode": dname, "session": session}
         if self.prefill_replicas():
@@ -292,10 +375,16 @@ class FleetRouter:
             handle = target.engine.submit(
                 prompt, max_new_tokens, priority=priority,
                 eos_token_id=eos_token_id, seed=seed,
-                on_token=on_token, rid=rid)
+                on_token=on_token, rid=rid, deadline_s=deadline_s)
         entry["handle"] = handle
+        entry["at"] = target.name       # which replica holds it NOW
         self._requests[rid] = entry
         return handle
+
+    def _brownout_active(self) -> bool:
+        nominal = max(self._nominal_decode, 1)
+        return (len(self.decode_replicas())
+                < nominal * self.brownout["watermark"])
 
     # -- hand-off ---------------------------------------------------------
     def _harvest_locked(self, r: _Replica) -> list:
@@ -319,7 +408,18 @@ class FleetRouter:
         done = 0
         try:
             for slot in cands:
-                out.append(eng.export_handoff(slot))
+                item = eng.export_handoff(slot,
+                                          lease=self.handoff_lease)
+                if self.handoff_lease:
+                    # lease metadata rides in the blob so a harvested
+                    # item can always find its exporter
+                    item[1]["lease_from"] = r.name
+                # fault point: flip one payload byte in transit — the
+                # adopter's crc32 check must reject it BEFORE any
+                # allocation
+                faults.corrupt_blob("kv.handoff.corrupt", item[1],
+                                    rid=item[0].request.rid)
+                out.append(item)
                 done += 1
         finally:
             if done < len(cands):
@@ -341,6 +441,7 @@ class FleetRouter:
                 r = self._by_name[entry["decode"]]
             with r.lock:
                 r.pending_imports.append(item)
+            entry["at"] = r.name
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
@@ -348,6 +449,7 @@ class FleetRouter:
     def _drain_imports_locked(self, r: _Replica) -> bool:
         moved = False
         adopted = 0
+        refresh = False
         while r.pending_imports and adopted < self.adopt_batch:
             handle, blob, tok = r.pending_imports[0]
             if not r.engine.can_adopt(blob):
@@ -356,14 +458,63 @@ class FleetRouter:
             # inbox while the import runs, or has_work() (lockless, the
             # drain poll) sees an idle fleet mid-adoption and returns
             # with the sequence in limbo
-            r.engine.adopt_handoff(handle, blob, tok, refresh=False)
+            try:
+                r.engine.adopt_handoff(handle, blob, tok, refresh=False)
+            except ValueError:
+                # corrupt payload rejected pre-allocation (crc32).
+                # Leased: the exporter still holds the pages — ask it
+                # to re-export. Unleased: the pages are gone, fall back
+                # to resume-by-re-prefill on this replica. Hand off to
+                # the next owner FIRST, pop after — same reason as the
+                # adopt path: has_work() must never see the sequence
+                # in limbo.
+                _global_registry().counter("fleet.handoff.corrupt").inc()
+                _recorder().note("fleet_handoff_corrupt",
+                                 rid=handle.request.rid,
+                                 lease=blob.get("lease_id"),
+                                 leased=blob.get("lease_id") is not None)
+                if (blob.get("lease_id") is not None
+                        and blob.get("lease_from") in self._by_name):
+                    self._relets.append((blob["lease_from"],
+                                         blob["lease_id"], handle, tok))
+                else:
+                    handle._requeue_for_resume()
+                    r.engine.resubmit(handle)
+                r.pending_imports.popleft()
+                moved = True
+                adopted += 1
+                continue
             r.pending_imports.popleft()
+            if blob.get("lease_id") is not None:
+                # exactly-once page release: the pages only die at the
+                # exporter once the adopter owns its own copy
+                self._queue_ack(blob.get("lease_from"), blob["lease_id"])
             moved = True
+            refresh = True
             adopted += 1
-        if moved:
+        if refresh:
             # one buffer resync for the whole adopted batch
             refresh_serving_buffers(r.engine)
         return moved
+
+    def _queue_ack(self, exporter_name, lease_id):
+        """Enqueue a lease release on the exporter's ack inbox (deque
+        append is GIL-atomic — no exporter lock taken here; the
+        exporter drains under its OWN lock). A vanished exporter's
+        lease died with its pools in _recover — drop the ack."""
+        p = self._by_name.get(exporter_name)
+        if p is not None:
+            p.pending_acks.append(lease_id)
+
+    def _drain_acks_locked(self, r: _Replica) -> bool:
+        worked = False
+        while r.pending_acks:
+            try:
+                lease_id = r.pending_acks.popleft()
+            except IndexError:
+                break
+            worked |= bool(r.engine.ack_handoff(lease_id))
+        return worked
 
     # -- cooperative loop -------------------------------------------------
     def step(self) -> bool:
@@ -372,23 +523,37 @@ class FleetRouter:
         worked = False
         exported = []
         for r in list(self._replicas):
-            with r.lock:
-                worked |= self._drain_imports_locked(r)
-                if r.engine.scheduler.has_work():
-                    worked |= bool(r.engine.step())
-                if r.role == "prefill":
-                    exported.extend(self._harvest_locked(r))
+            r.heartbeat = self.clock()
+            try:
+                with r.lock:
+                    worked |= self._drain_acks_locked(r)
+                    worked |= self._drain_imports_locked(r)
+                    if r.engine.scheduler.has_work():
+                        worked |= bool(r.engine.step())
+                    if r.role == "prefill":
+                        exported.extend(self._harvest_locked(r))
+            except BaseException as e:
+                # with a watchdog the fault is contained per-replica:
+                # record it and let the tick below quarantine +
+                # re-dispatch. Without one, fail loudly (old behavior).
+                if self.watchdog is None:
+                    raise
+                r.error = e
         for item in exported:
             self._dispatch_handoff(item)
             worked = True
+        worked |= self._service_relets()
+        if self.watchdog is not None:
+            worked |= self._watchdog_tick()
         if self.autoscaler is not None:
             self.autoscaler.tick()
         self._finalize_drained()
         return worked
 
     def has_work(self) -> bool:
-        return (self._inflight > 0
+        return (self._inflight > 0 or bool(self._relets)
                 or any(r.engine.scheduler.has_work() or r.pending_imports
+                       or r.pending_acks or r.engine.leased_count
                        for r in list(self._replicas)))
 
     def run(self, max_steps=2_000_000) -> dict:
@@ -469,8 +634,10 @@ class FleetRouter:
         while not r.stop:
             worked = False
             exported = ()
+            r.heartbeat = self.clock()   # watchdog staleness anchor
             try:
                 with r.lock:
+                    worked |= self._drain_acks_locked(r)
                     worked |= self._drain_imports_locked(r)
                     if r.engine.scheduler.has_work():
                         worked |= bool(r.engine.step())
@@ -482,17 +649,307 @@ class FleetRouter:
             for item in exported:
                 self._dispatch_handoff(item)
                 worked = True
+            if worked:
+                r.progress += 1
             if not worked:
                 time.sleep(5e-4)
             elif r.role == "prefill" and self.prefill_yield_s:
                 time.sleep(self.prefill_yield_s)
+
+    # -- self-healing (ISSUE 19) ------------------------------------------
+    def _watchdog_tick(self) -> bool:
+        """One health pass: HEALTHY -> SUSPECT -> DEAD per replica.
+        Death has three causes — ``error`` (the replica loop surfaced
+        an exception), ``thread_exit`` (the thread died without one),
+        ``stuck`` (threaded mode: a busy replica whose heartbeat went
+        stale — a wedged step). A dead replica is quarantined and its
+        in-flight requests re-dispatched to survivors. Returns True
+        when any replica changed state."""
+        if self.watchdog is None:
+            return False
+        acted = False
+        now = self.clock()
+        for r in list(self._replicas):
+            if r.error is not None:
+                acted |= self._quarantine(r, "error")
+                continue
+            if (self.threaded and self._started and r.thread is not None
+                    and not r.thread.is_alive() and not r.stop):
+                acted |= self._quarantine(r, "thread_exit")
+                continue
+            # heartbeat staleness is only meaningful when a dedicated
+            # thread owns the loop; in cooperative mode a stuck step
+            # blocks the caller itself
+            if not (self.threaded and self._started
+                    and r.heartbeat is not None):
+                continue
+            busy = bool(r.engine.scheduler.has_work()
+                        or r.pending_imports or r.pending_acks)
+            if not busy:
+                # idle replicas still heartbeat, but never alarm
+                if r.health == "suspect":
+                    r.health = "healthy"
+                    r.suspect_since = None
+                continue
+            age = now - r.heartbeat
+            if age >= self.watchdog["dead_after_s"]:
+                acted |= self._quarantine(r, "stuck")
+            elif age >= self.watchdog["suspect_after_s"]:
+                if r.health != "suspect":
+                    r.health = "suspect"
+                    r.suspect_since = now
+                    _global_registry().counter(
+                        "fleet.replica.suspect").inc()
+                    _recorder().note("fleet_replica_suspect",
+                                     replica=r.name,
+                                     heartbeat_age_s=round(age, 4))
+                    acted = True
+            elif r.health == "suspect":
+                r.health = "healthy"
+                r.suspect_since = None
+        return acted
+
+    def _quarantine(self, r: _Replica, cause: str) -> bool:
+        """Remove one dead replica from every routing surface, harvest
+        its in-flight requests and re-dispatch them to survivors. The
+        replica object is retained in ``_quarantined`` so traces,
+        metrics and the leak receipt stay inspectable."""
+        if r.health == "dead":
+            return False
+        t_dead = self.clock()
+        r.health = "dead"
+        r.cause = cause
+        r.stop = True
+        _global_registry().counter("fleet.replica.dead").inc()
+        _recorder().note("fleet_replica_dead", replica=r.name,
+                         cause=cause,
+                         error=(repr(r.error) if r.error is not None
+                                else None))
+        self.router.remove(r.name)
+        self.prefill_router.remove(r.name)
+        if r in self._replicas:
+            self._replicas.remove(r)
+        self._by_name.pop(r.name, None)
+        self._quarantined.append(r)
+        self.events.append({"action": "replica_dead",
+                            "replica": r.name, "cause": cause})
+        # the lock is only safe to take when nothing can be holding it
+        # forever: the loop surfaced an error and returned, there never
+        # was a thread (cooperative), or the thread is gone
+        safe = (r.error is not None or r.thread is None
+                or not r.thread.is_alive())
+        r.harvest_safe = bool(safe)
+        handles, items = self._harvest_dead(r, safe)
+        reqs = [{"rid": h.request.rid,
+                 "delivered": len(h.output_tokens)} for h in handles]
+        reqs += [{"rid": it[0].request.rid,
+                  "delivered": len(it[0].output_tokens),
+                  "handoff": True} for it in items]
+        n = self._redispatch(handles, items, dead=r.name)
+        self.recoveries.append({
+            "replica": r.name, "cause": cause, "t_dead": t_dead,
+            "safe_harvest": bool(safe), "redispatched": n,
+            "requests": reqs})
+        return True
+
+    def _harvest_dead(self, r: _Replica, safe: bool):
+        """Collect every live request off a dead replica. Safe mode
+        (lock taken): drain the scheduler directly, close the dead
+        leg's spans, then ``_recover`` the engine so its leak receipt
+        reads clean. Stuck mode (wedged thread may hold the lock
+        forever): lockless — handles come from the fleet's own routing
+        table, inbox items via GIL-atomic popleft, and the dead
+        tracer's spans are abandoned (the wedged thread still owns
+        them)."""
+        # a handle parked in the relet queue is owned by the FLEET
+        # right now (its routing entry still names the dead adopter);
+        # _service_relets will re-route it — sweeping it here too
+        # would dispatch it twice
+        relet_ids = {id(t[2]) for t in list(self._relets)}
+        if safe:
+            with r.lock:
+                items = list(r.pending_imports)
+                r.pending_imports.clear()
+                sched = r.engine.scheduler
+                handles = (list(sched.running.values())
+                           + list(sched.waiting))
+                sched.waiting.clear()
+                sched.running.clear()
+                # routing-table sweep: a handle the replica died
+                # HOLDING outside its scheduler (mid-export limbo —
+                # export_handoff pops before dispatch) is still ours
+                # to save
+                known = ({id(h) for h in handles}
+                         | {id(it[0]) for it in items} | relet_ids)
+                for entry in list(self._requests.values()):
+                    h = entry.get("handle")
+                    if (h is not None and not h.done
+                            and entry.get("at") == r.name
+                            and id(h) not in known):
+                        handles.append(h)
+                for h in handles:
+                    h._epoch += 1
+                    h.slot = None
+                    if h._span_queue is not None:
+                        r.engine.tracer.end(h._span_queue,
+                                            dead_replica=True)
+                        h._span_queue = None
+                    if h._span is not None:
+                        r.engine.tracer.end(h._span, dead_replica=True,
+                                            finish="replica_dead")
+                        h._span = None
+                # rebuild the dead engine pristine: open leases die
+                # with the pools, pages/slots all return, so the
+                # quarantined replica's leak receipt reads CLEAN
+                r.engine._recover(exc=r.error)
+        else:
+            # fence FIRST (GIL-atomic attribute set): if the wedged
+            # step ever unsticks, the next statement it reaches bails
+            # out instead of emitting tokens for handles a survivor
+            # now owns
+            r.engine._fenced = True
+            items = []
+            while True:
+                try:
+                    items.append(r.pending_imports.popleft())
+                except IndexError:
+                    break
+            item_ids = {id(it[0]) for it in items} | relet_ids
+            handles = []
+            for entry in list(self._requests.values()):
+                h = entry.get("handle")
+                if (h is not None and not h.done
+                        and entry.get("at") == r.name
+                        and id(h) not in item_ids):
+                    handles.append(h)
+            for h in handles:
+                h._epoch += 1
+                h._span = None
+                h._span_queue = None
+        live = []
+        for h in handles:
+            if h.done:
+                continue
+            if (h.state is not RequestState.WAITING
+                    or h.slot is not None or h.prefill_pos):
+                h._requeue_for_resume()
+            live.append(h)
+        for it in items:
+            # epoch fence for the inbox items too: a wedged thread that
+            # later unsticks must never act on them
+            it[0]._epoch += 1
+        return live, items
+
+    def _redispatch(self, handles, items, dead=None) -> int:
+        """Exactly-once re-dispatch: every harvested handle resumes by
+        re-prefill on a survivor (``pending`` = prompt + everything
+        already delivered, so replayed context is never re-emitted and
+        the deterministic per-request RNG regenerates the identical
+        continuation); harvested hand-off items keep their pages and
+        just move inboxes."""
+        n = 0
+        for h in handles:
+            if h.done:
+                continue
+            rid = h.request.rid
+            entry = self._requests.setdefault(rid, {"handle": h})
+            try:
+                if (dead is not None and entry.get("prefill") == dead
+                        and len(self.prefill_router)):
+                    entry["prefill"] = self.prefill_router.pick(
+                        self._load_of)
+                    target = self._by_name[entry["prefill"]]
+                else:
+                    entry["decode"] = self.router.pick(
+                        self._load_of, session=entry.get("session"))
+                    target = self._by_name[entry["decode"]]
+            except (RuntimeError, KeyError):
+                h.state = RequestState.FAILED
+                h.finish_reason = FinishReason.ABORTED
+                h.finish_time = self.clock()
+                _recorder().note("fleet_redispatch_failed", rid=rid)
+                continue
+            with target.lock:
+                target.engine.resubmit(h)
+            entry["at"] = target.name
+            n += 1
+            _global_registry().counter("fleet.redispatched").inc()
+            _recorder().note("fleet_redispatch", rid=rid,
+                             to=target.name,
+                             replayed=len(h.output_tokens))
+        for item in items:
+            h, blob, tok = item
+            rid = h.request.rid
+            entry = self._requests.setdefault(rid, {"handle": h})
+            try:
+                entry["decode"] = self.router.pick(
+                    self._load_of, session=entry.get("session"))
+                target = self._by_name[entry["decode"]]
+            except (RuntimeError, KeyError):
+                if blob.get("lease_id") is not None:
+                    self._queue_ack(blob.get("lease_from"),
+                                    blob["lease_id"])
+                h.state = RequestState.FAILED
+                h.finish_reason = FinishReason.ABORTED
+                h.finish_time = self.clock()
+                _recorder().note("fleet_redispatch_failed", rid=rid,
+                                 handoff=True)
+                continue
+            with target.lock:
+                target.pending_imports.append(item)
+            entry["at"] = target.name
+            n += 1
+            _global_registry().counter("fleet.redispatched").inc()
+            _recorder().note("fleet_redispatch", rid=rid,
+                             to=target.name, handoff=True)
+        return n
+
+    def _service_relets(self) -> bool:
+        """Re-export leased pages whose first copy was lost in transit
+        (corrupt blob, adopter died between export and import). The
+        exporter retained the pages precisely for this; if the exporter
+        itself is gone, fall back to resume-by-re-prefill."""
+        worked = False
+        while True:
+            try:
+                pname, lease_id, handle, tok = self._relets.popleft()
+            except IndexError:
+                break
+            p = self._by_name.get(pname)
+            blob = None
+            if p is not None:
+                with p.lock:
+                    try:
+                        blob = p.engine.reexport_handoff(lease_id)
+                    except KeyError:
+                        blob = None
+            if blob is None:
+                _recorder().note("fleet_relet_lost", lease=lease_id,
+                                 rid=handle.request.rid,
+                                 exporter=pname)
+                handle._requeue_for_resume()
+                self._redispatch([handle], [])
+            else:
+                blob["lease_from"] = pname
+                _global_registry().counter("fleet.handoff.relet").inc()
+                with self._inflight_lock:
+                    self._inflight += 1
+                self._dispatch_handoff((handle, blob, tok))
+            worked = True
+        return worked
 
     def drain(self, timeout_s=300.0, poll_s=0.002) -> dict:
         """Block until every submitted request finished (threaded
         mode), then return the fleet snapshot."""
         deadline = self.clock() + float(timeout_s)
         while self.has_work():
+            # the watchdog tick runs BEFORE the error scan: with a
+            # watchdog, a failed replica is quarantined (requests
+            # re-dispatched) instead of failing the drain; without
+            # one the tick no-ops and errors raise as before
+            self._watchdog_tick()
             self._raise_replica_errors()
+            self._service_relets()
             if self.autoscaler is not None:
                 self.autoscaler.tick()
             self._finalize_drained()
@@ -501,6 +958,7 @@ class FleetRouter:
                     f"fleet did not drain within {timeout_s}s: "
                     f"{ {r.name: r.load for r in self._replicas} }")
             time.sleep(poll_s)
+        self._watchdog_tick()
         self._raise_replica_errors()
         # quiesce before the snapshot: has_work() can go false while a
         # replica thread is still INSIDE the step() that retired the
@@ -520,15 +978,43 @@ class FleetRouter:
                 raise RuntimeError(
                     f"replica {r.name} failed") from r.error
 
-    def stop(self):
-        for r in list(self._replicas):
+    def stop(self, strict: bool = False) -> dict:
+        """Stop every replica thread. A thread that fails to join
+        within ``join_timeout_s`` is RECORDED (``fleet.replica.hung``
+        counter, flight-recorder event, event log) instead of silently
+        ignored; ``strict=True`` escalates to a raise."""
+        for r in list(self._replicas) + list(self._quarantined):
             r.stop = True
-        for r in list(self._replicas):
-            if r.thread is not None:
-                r.thread.join(timeout=30)
-                r.thread = None
+        for r in list(self._replicas) + list(self._quarantined):
+            self._join_or_record(r)
         self._started = False
         self._finalize_drained()
+        hung = list(self._hung)
+        if strict and hung:
+            raise RuntimeError(
+                f"replica thread(s) failed to join within "
+                f"{self.join_timeout_s}s: {hung}")
+        return {"hung_replicas": hung}
+
+    def _join_or_record(self, r: _Replica) -> bool:
+        """Join one replica thread with the configured timeout; a hung
+        join is surfaced, never swallowed. True = thread is gone."""
+        t = r.thread
+        if t is None or t is threading.current_thread():
+            return True
+        t.join(timeout=self.join_timeout_s)
+        if t.is_alive():
+            if r.name not in self._hung:
+                self._hung.append(r.name)
+                _global_registry().counter("fleet.replica.hung").inc()
+                _recorder().note("fleet_replica_hung", replica=r.name,
+                                 timeout_s=self.join_timeout_s)
+                self.events.append({"action": "replica_hung",
+                                    "replica": r.name,
+                                    "timeout_s": self.join_timeout_s})
+            return False
+        r.thread = None
+        return True
 
     def _paused(self):
         """Ordered acquisition of every replica lock — quiesces all
@@ -573,6 +1059,9 @@ class FleetRouter:
             r = self._by_name[name]
         r.draining = True
         self.router.remove(r.name)
+        # a DELIBERATE shrink lowers the brown-out baseline — only
+        # unplanned capacity loss (quarantine) should trip shedding
+        self._nominal_decode = max(1, len(self.decode_replicas()))
         self.events.append({"action": "scale_down", "replica": r.name,
                             "reason": reason, "burn": burn,
                             "decode_replicas": len(
@@ -583,14 +1072,15 @@ class FleetRouter:
         for r in [x for x in self._replicas if x.draining]:
             with r.lock:
                 busy = (r.engine.scheduler.has_work()
-                        or r.pending_imports)
+                        or r.pending_imports or r.pending_acks
+                        or r.engine.leased_count)
             if busy:
                 continue
             r.stop = True
-            if r.thread is not None and \
-                    r.thread is not threading.current_thread():
-                r.thread.join(timeout=30)
-                r.thread = None
+            if not self._join_or_record(r):
+                # hung drain: the replica is NOT silently retired — it
+                # stays visible (and recorded) until the thread exits
+                continue
             self._replicas.remove(r)
             self._retired.append(r)
             self._by_name.pop(r.name, None)
@@ -605,7 +1095,8 @@ class FleetRouter:
         """Fleet-level rollup: per-replica snapshots plus MERGED-sample
         percentiles (a fleet p99 is the p99 of the union of samples —
         never an average of per-replica p99s)."""
-        reps = list(self._replicas) + list(self._retired)
+        reps = (list(self._replicas) + list(self._retired)
+                + list(self._quarantined))
         per = {r.name: r.engine.metrics_snapshot() for r in reps}
         ttft = merge_histograms(
             [r.engine.metrics.ttft_s for r in reps], name="fleet.ttft_s")
@@ -616,6 +1107,9 @@ class FleetRouter:
             "decode_replicas": len(self.decode_replicas()),
             "prefill_replicas": len(self.prefill_replicas()),
             "retired_replicas": len(self._retired),
+            "quarantined_replicas": [x.name for x in self._quarantined],
+            "hung_replicas": list(self._hung),
+            "recoveries": list(self.recoveries),
             "fleet_ttft_p50_s": ttft.percentile(50),
             "fleet_ttft_p99_s": ttft.percentile(99),
             "fleet_itl_p50_s": itl.percentile(50),
@@ -637,7 +1131,8 @@ class FleetRouter:
         disaggregated requests show a prefill leg (closed with
         ``handoff=True``) followed by a decode leg."""
         legs = []
-        for r in list(self._replicas) + list(self._retired):
+        for r in (list(self._replicas) + list(self._retired)
+                  + list(self._quarantined)):
             root = r.engine.tracer.find_trace(f"req{rid}")
             if root is not None:
                 legs.append({"replica": r.name, "role": r.role,
@@ -672,6 +1167,39 @@ class FleetRouter:
                 and rep["pending_imports"] == 0)
             out["replicas"][r.name] = rep
             out["clean"] = out["clean"] and rep["clean"]
+        for r in list(self._quarantined):
+            leaks = r.engine.leak_check()
+            stats = r.engine.cache.pool_stats()
+            rep = {
+                **leaks,
+                "quarantined": True,
+                "cause": r.cause,
+                "safe_harvest": r.harvest_safe,
+                "pool_conserved": (stats["used_pages"]
+                                   + stats["free_pages"]
+                                   == stats["total_pages"]),
+                "open_spans": len(r.engine.tracer.open_spans()),
+                "orphan_spans": len(r.engine.tracer.orphans()),
+                "pending_imports": len(r.pending_imports),
+            }
+            if r.harvest_safe:
+                # safe harvest ran _recover: the quarantined replica
+                # must be as clean as a retired one
+                rep["clean"] = (
+                    leaks["free_pages"] == leaks["total_pages"]
+                    and leaks["free_slots"] == leaks["total_slots"]
+                    and leaks["resident_slot_pages"] == 0
+                    and leaks.get("leased_slots", 0) == 0
+                    and rep["pool_conserved"] and rep["open_spans"] == 0
+                    and rep["orphan_spans"] == 0
+                    and rep["pending_imports"] == 0)
+                out["clean"] = out["clean"] and rep["clean"]
+            else:
+                # a wedged thread may still hold resources: reported,
+                # but exempt from the fleet-wide clean fold (nothing it
+                # holds is reachable by live traffic)
+                rep["clean"] = None
+            out["replicas"][r.name] = rep
         if self.host_ring is not None:
             ring = self.host_ring.stats()
             out["host_ring"] = ring
@@ -681,7 +1209,8 @@ class FleetRouter:
 
     def retrace_stats(self) -> dict:
         return {r.name: r.engine.retrace_stats()
-                for r in list(self._replicas) + list(self._retired)}
+                for r in (list(self._replicas) + list(self._retired)
+                          + list(self._quarantined))}
 
 
 class SLOBurnAutoscaler:

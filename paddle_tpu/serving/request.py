@@ -40,6 +40,8 @@ class FinishReason(enum.Enum):
     EOS = "eos"
     LENGTH = "length"        # max_new_tokens reached
     ABORTED = "aborted"
+    DEADLINE_EXCEEDED = "deadline_exceeded"
+    SHED = "shed"            # brown-out: rejected at admission
 
 
 @dataclass
@@ -50,6 +52,7 @@ class Request:
     priority: int = 0                   # higher = preempted later
     eos_token_id: int | None = None
     seed: int | None = None             # defaults to rid (engine)
+    deadline_s: float | None = None     # wall budget from submit
 
 
 class RequestHandle:
@@ -88,6 +91,15 @@ class RequestHandle:
         # travelled with the evicted KV pages — the engine reloads it
         # into its per-slot token vector when the import lands
         self._onload_token: int | None = None
+        # absolute wall deadline, set by the engine at submit from
+        # request.deadline_s (ISSUE 19)
+        self.deadline: float | None = None
+        # re-dispatch fence (ISSUE 19): the fleet bumps this when it
+        # harvests the handle off a dead/stuck replica. Engine dispatch
+        # paths snapshot it and discard results computed under a stale
+        # epoch, so a wedged thread that later unsticks can never emit
+        # a duplicate token or clobber the survivor's scheduling state.
+        self._epoch = 0
 
     # -- client surface ---------------------------------------------------
     @property

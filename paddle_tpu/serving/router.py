@@ -58,6 +58,12 @@ class ReplicaRouter:
         if name in self._replicas:
             self._replicas.remove(name)
 
+    def __len__(self) -> int:
+        return len(self._replicas)
+
+    def __contains__(self, name) -> bool:
+        return name in self._replicas
+
     def pick(self, load_fn, session: str | None = None) -> str:
         """Route one request. ``load_fn(name)`` returns the replica's
         live queue depth (waiting + running + pending imports); it is

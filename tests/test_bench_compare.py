@@ -452,3 +452,62 @@ class TestColdStartFamily:
         assert "decode.lanes.bs1.paged_cold_start_ms" \
             in res["regressions"]
         assert "serving.cold_start.warmup_ms" in res["regressions"]
+
+
+class TestMttrFamily:
+    """ISSUE 19 satellite: the `mttr` metric family — chaos-lane
+    mean-time-to-recovery (ms) gates as an UPPER bound (lower is
+    better, 50% band, 250ms absolute floor): a multi-x blowup in the
+    re-dispatch path fails the gate while sub-floor scheduler jitter
+    stays informational."""
+
+    @staticmethod
+    def _mrec(kill=718.0, stuck=4.0, train=4100.0):
+        rec = _record()
+        rec["chaos_mttr_ms"] = kill
+        rec["chaos_mttr_stuck_ms"] = stuck
+        rec["chaos_mttr_train_ms"] = train
+        return rec
+
+    @staticmethod
+    def _row(res, suffix):
+        return next(r for r in res["rows"]
+                    if r["metric"].endswith(suffix))
+
+    def test_family_detected(self, bc):
+        m = bc.extract_metrics(self._mrec())
+        assert m["chaos_mttr_ms"] == 718.0
+        assert m["chaos_mttr_train_ms"] == 4100.0
+        assert bc._family("chaos_mttr_ms") == "mttr"
+        assert bc._family("chaos_mttr_stuck_ms") == "mttr"
+        assert bc._family("chaos_mttr_train_ms") == "mttr"
+        tol, higher_better, floor = bc.DEFAULT_TOLERANCES["mttr"]
+        assert not higher_better and floor == 250.0
+
+    def test_recovery_blowup_regresses(self, bc):
+        res = bc.compare(self._mrec(kill=718.0),
+                         self._mrec(kill=2500.0))
+        assert res["status"] == "regress"
+        assert "chaos_mttr_ms" in res["regressions"]
+
+    def test_direction_and_band(self, bc):
+        # faster recovery improves; +40% stays inside the 50% band
+        res = bc.compare(self._mrec(), self._mrec(kill=300.0))
+        assert self._row(res, "chaos_mttr_ms")["verdict"] == "improved"
+        assert res["status"] == "pass"
+        res = bc.compare(self._mrec(), self._mrec(kill=718.0 * 1.4))
+        assert res["status"] == "pass"
+
+    def test_sub_floor_is_informational(self, bc):
+        # stuck-detect MTTR is single-digit ms on the CPU lane: a 10x
+        # wobble is still far under the 250ms floor and never gates
+        res = bc.compare(self._mrec(stuck=4.0),
+                         self._mrec(stuck=40.0))
+        assert self._row(res,
+                         "chaos_mttr_stuck_ms")["verdict"] == "sub_floor"
+        assert res["status"] == "pass"
+
+    def test_train_mttr_gates(self, bc):
+        res = bc.compare(self._mrec(train=4100.0),
+                         self._mrec(train=9000.0))
+        assert "chaos_mttr_train_ms" in res["regressions"]

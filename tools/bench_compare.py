@@ -98,6 +98,13 @@ DEFAULT_TOLERANCES = {
     # (union of replica windows), latency band + floor as ttft
     "fleet_tok_s": (0.05, True, 0.0),
     "fleet_ttft": (0.25, False, 2e-3),   # seconds
+    # self-healing fleet (ISSUE 19): mean-time-to-recovery in ms
+    # (replica death -> first post-death token; trainer crash ->
+    # first post-restore step). Wide band + absolute floor: recovery
+    # wall on the CPU selftest is re-prefill/compile dominated and
+    # scheduler-noisy, but a multi-x blowup past the floor means the
+    # re-dispatch path itself regressed
+    "mttr":    (0.50, False, 250.0),     # milliseconds
 }
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -162,6 +169,8 @@ def _family(key):
         return "fleet_tok_s"
     if "fleet_ttft" in k:
         return "fleet_ttft"
+    if "mttr" in k:
+        return "mttr"
     if "finite_frac" in k:
         return "finite"
     if "grad_norm" in k:
