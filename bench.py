@@ -871,13 +871,15 @@ def run_selftest():
         results["serving_detail"] = rec
 
     def spec_decode():
-        # ISSUE 16: speculative decoding is LOSSLESS (greedy spec ==
-        # plain decode bit-identically on paged + int8-paged KV with a
-        # mismatched weak draft), the strong-draft dispatch arithmetic
-        # holds (accept 1.0 => ceil((n-1)/(k+1)) dispatches), the
-        # retrace sentinel stays strict-clean across variable accept
-        # counts, serving parity + zero leaked pages, and the int8
-        # pool-capacity receipt (~2x slots at equal HBM vs bf16)
+        # ISSUES 16/20: speculative decoding is LOSSLESS (greedy spec
+        # == plain decode bit-identically on paged + int8 + int4 KV
+        # with a mismatched weak draft; self-draft heads likewise with
+        # zero draft params/pools), the strong-draft dispatch
+        # arithmetic holds (accept 1.0 => ceil((n-1)/(k+1))
+        # dispatches), the retrace sentinel stays strict-clean across
+        # variable accept counts, serving parity + zero leaked pages,
+        # and the pool-capacity receipts (int8 ~2x bf16; int4 >= 1.8x
+        # int8, >= 3.5x bf16 at equal HBM)
         rec = _run_cpu_probe("paddle_tpu.inference.spec_decode_selftest",
                              n_devices=1, timeout=900)
         assert rec.get("check") == "pass", rec
@@ -1450,10 +1452,11 @@ if __name__ == "__main__":
             "paddle_tpu.serving.fleet_selftest",
             extra_args=("--bench",), n_devices=1, timeout=900)}))
     elif "--spec" in sys.argv:
-        # SPEC-DECODE lane (ISSUE 16): correctness probe + serve A/B
-        # (tokens/s/user plain vs speculative vs speculative+int8-KV,
-        # accept-rate/tokens-per-dispatch gauges, int8 pool receipt) —
-        # hermetic CPU subprocess, one JSON line
+        # SPEC-DECODE lane (ISSUES 16/20): correctness probe + serve
+        # A/B (tokens/s/user plain vs speculative vs spec+int8-KV vs
+        # spec+int4-KV, plus the self-draft A/B at constructed accept
+        # 1.0, accept-rate/tokens-per-dispatch gauges, int8/int4 pool
+        # receipts) — hermetic CPU subprocess, one JSON line
         print(json.dumps({
             "spec_probe": _run_cpu_probe(
                 "paddle_tpu.inference.spec_decode_selftest",

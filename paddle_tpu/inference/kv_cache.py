@@ -36,6 +36,14 @@ memory holds ~2x the pages; dequant fuses into the paged-attention
 gather (ops/pallas/paged_attention.py). The ``*_q8`` write helpers
 quantize each incoming row and scatter payload + scale with the same
 flat-index trick as their fp twins.
+
+``quant="int4"`` (ISSUE 20) halves the payload again: two values per
+byte in ``[num_kv_heads, num_pages, page_size, head_dim // 2]`` uint8
+pools (nn/quant ``pack_q4`` nibble format — high nibble = even lane,
+offset-binary +8), same per-row fp32 scale pools as int8 (scale =
+max|row|/7). ``head_dim`` must be even. Dequant is again fused into
+the paged-attention gather as a nibble unpack; the ``*_q4`` writers
+mirror the ``*_q8`` ones with a pack step before the scatter.
 """
 from __future__ import annotations
 
@@ -48,7 +56,8 @@ import numpy as np
 __all__ = ["DenseKVCache", "PagedKVCache", "blob_checksum",
            "paged_write_decode", "paged_write_prefill",
            "dense_write_prefill", "paged_write_decode_q8",
-           "paged_write_prefill_q8", "dense_write_chunk"]
+           "paged_write_prefill_q8", "paged_write_decode_q4",
+           "paged_write_prefill_q4", "dense_write_chunk"]
 
 
 def blob_checksum(blob: dict) -> int:
@@ -220,6 +229,69 @@ def paged_write_prefill_q8(k_pages, v_pages, k_scales, v_scales,
     return k2, v2, ks2, vs2
 
 
+def paged_write_decode_q4(k_pages, v_pages, k_scales, v_scales,
+                          page_tables, seq_lens, active, k_new, v_new):
+    """`paged_write_decode` for int4 pools: each incoming [d] row is
+    symmetric-int4 quantized (one fp32 scale per row, max|x|/7) and
+    nibble-PACKED to [d//2] uint8 before the scatter.
+
+    k_pages/v_pages: [kvh, num_pages, page_size, d//2] uint8;
+    k_scales/v_scales: [kvh, num_pages, page_size] fp32. Returns
+    (k_pages, v_pages, k_scales, v_scales) updated."""
+    from ..nn.quant import pack_q4, quantize_symmetric_q4
+
+    kvh, num_pages, page_size, dp = k_pages.shape   # dp == head_dim // 2
+    flat = _page_flat_index(page_tables, seq_lens[:, None],
+                            page_size)[:, 0]                # [b]
+    flat = jnp.where(active, flat, seq_lens % page_size)    # page 0 trash
+
+    def wr(pool, spool, upd):
+        q, sc = quantize_symmetric_q4(upd)      # [b, kvh, d], [b, kvh]
+        p = pack_q4(q)                          # [b, kvh, d//2]
+        view = pool.reshape(kvh, num_pages * page_size, dp)
+        view = view.at[:, flat].set(jnp.moveaxis(p, 1, 0))
+        sview = spool.reshape(kvh, num_pages * page_size)
+        sview = sview.at[:, flat].set(
+            jnp.moveaxis(sc, 1, 0).astype(spool.dtype))
+        return view.reshape(pool.shape), sview.reshape(spool.shape)
+
+    k2, ks2 = wr(k_pages, k_scales, k_new)
+    v2, vs2 = wr(v_pages, v_scales, v_new)
+    return k2, v2, ks2, vs2
+
+
+def paged_write_prefill_q4(k_pages, v_pages, k_scales, v_scales,
+                           page_tables, slot_ids, seq_lens_new,
+                           k_new, v_new, start=None):
+    """`paged_write_prefill` for int4 pools (see `paged_write_decode_q4`
+    for the packed layout). k_new/v_new: [b, s, kvh, d] fp."""
+    from ..nn.quant import pack_q4, quantize_symmetric_q4
+
+    kvh, num_pages, page_size, dp = k_pages.shape   # dp == head_dim // 2
+    b, s = k_new.shape[:2]
+    t = jnp.arange(s, dtype=jnp.int32)[None, :]             # [1, s]
+    pos = t if start is None else start[:, None] + t        # [b, s]
+    flat = _page_flat_index(page_tables[slot_ids], pos, page_size)
+    valid = pos < seq_lens_new[:, None]
+    flat = jnp.where(valid, flat, pos % page_size).reshape(-1)
+
+    def wr(pool, spool, upd):
+        q, sc = quantize_symmetric_q4(upd)   # [b,s,kvh,d], [b,s,kvh]
+        p = pack_q4(q)                       # [b,s,kvh,d//2]
+        view = pool.reshape(kvh, num_pages * page_size, dp)
+        view = view.at[:, flat].set(
+            jnp.moveaxis(p, 2, 0).reshape(kvh, b * s, dp))
+        sview = spool.reshape(kvh, num_pages * page_size)
+        sview = sview.at[:, flat].set(
+            jnp.moveaxis(sc, 2, 0).reshape(kvh, b * s)
+            .astype(spool.dtype))
+        return view.reshape(pool.shape), sview.reshape(spool.shape)
+
+    k2, ks2 = wr(k_pages, k_scales, k_new)
+    v2, vs2 = wr(v_pages, v_scales, v_new)
+    return k2, v2, ks2, vs2
+
+
 # ---------------------------------------------------------------------------
 # cache objects: device state + host bookkeeping
 # ---------------------------------------------------------------------------
@@ -280,8 +352,12 @@ class PagedKVCache:
                  dtype=jnp.float32, quant=None):
         if num_pages < 2:
             raise ValueError("need >= 2 pages (page 0 is the trash page)")
-        if quant not in (None, "int8"):
+        if quant not in (None, "int8", "int4"):
             raise ValueError(f"unknown KV quant mode {quant!r}")
+        if quant == "int4" and head_dim % 2:
+            raise ValueError(
+                f"int4 KV packs two values per byte along head_dim: "
+                f"head_dim must be even, got {head_dim}")
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
@@ -290,13 +366,18 @@ class PagedKVCache:
         self.max_slots = max_slots
         self.pages_per_seq = pages_per_seq
         self.quant = quant
-        self.dtype = jnp.dtype(jnp.int8 if quant == "int8" else dtype)
-        shape = (num_kv_heads, num_pages, page_size, head_dim)
+        self.dtype = jnp.dtype({"int8": jnp.int8, "int4": jnp.uint8}
+                               .get(quant, dtype))
+        # int4 pools are nibble-PACKED: the last pool dim is head_dim//2
+        # bytes holding head_dim values (pack_q4 layout). Everything
+        # downstream that touches raw pool shapes reads `pool_head_dim`.
+        self.pool_head_dim = head_dim // 2 if quant == "int4" else head_dim
+        shape = (num_kv_heads, num_pages, page_size, self.pool_head_dim)
         self.k_layers = [jnp.zeros(shape, self.dtype)
                          for _ in range(num_layers)]
         self.v_layers = [jnp.zeros(shape, self.dtype)
                          for _ in range(num_layers)]
-        if quant == "int8":
+        if quant is not None:
             # one fp32 scale per cached row (block = head_dim); scale
             # pools thread/donate through the step alongside the payload
             sshape = (num_kv_heads, num_pages, page_size)
@@ -449,16 +530,20 @@ class PagedKVCache:
             prev = p
         used = sum(len(p) for _, p in slot_items)
         total = self.num_pages - 1            # page 0 is trash
-        # capacity receipt (ISSUE 16): bytes per cached token across all
-        # layers, K+V; int8 pools pay 1 byte + 4/head_dim for the scale
-        # instead of itemsize — the "≈2x slots at equal HBM" math the
-        # bench records
+        # capacity receipt (ISSUE 16/20): bytes per cached token across
+        # all layers, K+V, counting the fp32 scale pools honestly —
+        # int8 pays head_dim + 4 bytes, int4 head_dim//2 + 4 (packed) —
+        # the "Nx slots at equal HBM" math the bench records
         per_tok = self.num_layers * 2 * self.num_kv_heads * (
-            self.head_dim * self.dtype.itemsize
+            self.pool_head_dim * self.dtype.itemsize
             + (4 if self.quantized else 0))
+        # same geometry held in bf16 pools, the capacity baseline
+        bf16_per_tok = self.num_layers * 2 * self.num_kv_heads \
+            * self.head_dim * 2
         return {
-            "kv_dtype": str(self.dtype),
+            "kv_dtype": (self.quant or str(self.dtype)),
             "bytes_per_token": per_tok,
+            "effective_slots_vs_bf16": round(bf16_per_tok / per_tok, 4),
             "page_bytes": per_tok * self.page_size,
             "pool_bytes": per_tok * self.page_size * self.num_pages,
             "total_pages": total,
@@ -575,7 +660,13 @@ class PagedKVCache:
 
         def take(block):
             lo = block * L
-            return [a[:, :n] for a in host[lo:lo + L]]
+            # materialize the slice: `a[:, :n]` is a VIEW whose base is
+            # the full bucket-width gather — keeping views alive pins up
+            # to ~2x the bytes the blob claims (`nbytes` counts the
+            # view's logical size), so a byte-capped HostKVRing would
+            # silently hold more host memory than its budget charges
+            return [np.ascontiguousarray(a[:, :n])
+                    for a in host[lo:lo + L]]
 
         blob = {
             "geometry": (self.num_layers, self.num_kv_heads,
@@ -624,7 +715,8 @@ class PagedKVCache:
             raise ValueError(
                 f"blob covers {n} pages but seq_len {seq_len} needs "
                 f"{self.pages_needed(seq_len)}")
-        want = (self.num_kv_heads, n, self.page_size, self.head_dim)
+        # int4 blobs carry the PACKED payload (head_dim//2 bytes/row)
+        want = (self.num_kv_heads, n, self.page_size, self.pool_head_dim)
         for key in ("k", "v"):
             if len(blob[key]) != self.num_layers:
                 raise ValueError(f"blob {key!r} has {len(blob[key])} "

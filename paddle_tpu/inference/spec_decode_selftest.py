@@ -6,18 +6,22 @@ same env-strip recipe as the other hermetic lanes) and prints ONE JSON
 line. Two modes:
 
 * default — correctness lanes for the BENCH selftest block:
-  greedy spec == plain decode bit-identically on paged AND int8-paged
-  KV (with a deliberately-mismatched weak draft — losslessness must
-  not depend on draft quality), strong-draft dispatch-count arithmetic
-  (accept rate 1.0 => ceil((n-1)/(k+1)) target dispatches), retrace
-  sentinel strict-clean across variable accept counts, serving parity
-  + zero leaked pages, and the int8 pool-capacity receipt
-  (slots-at-equal-HBM vs fp16/fp32 pools from pool_stats()).
+  greedy spec == plain decode bit-identically on paged, int8-paged AND
+  int4-paged KV (with a deliberately-mismatched weak draft —
+  losslessness must not depend on draft quality), strong-draft
+  dispatch-count arithmetic (accept rate 1.0 => ceil((n-1)/(k+1))
+  target dispatches), SELF-draft parity with zero draft params / zero
+  draft pools (ISSUE 20), retrace sentinel strict-clean across
+  variable accept counts, serving parity + zero leaked pages, and the
+  pool-capacity receipts (int8 slots-at-equal-HBM vs bf16/fp32, int4
+  >= 1.8x int8 and >= 3.5x bf16 from pool_stats()).
 * ``--bench`` — the serve-lane A/B the ISSUE acceptance names: same
   traffic through a plain ServingEngine and a speculative one (strong
   draft built by construction, below), recording tokens/s/user for
   both, the speedup, the measured accept rate / tokens-per-dispatch
-  gauges, and the int8-KV occupancy receipt.
+  gauges, the int8/int4 occupancy receipts, and the SELF-spec A/B
+  (draft_model="self" vs its own plain baseline at constructed accept
+  rate 1.0 — acceptance bar >= 1.3x tokens/s/user).
 
 The STRONG draft is built by construction, not training: the target's
 tail block is zeroed into a residual passthrough (attn.out_proj and
@@ -26,6 +30,14 @@ embeddings, block 0 and final LayerNorm computes the IDENTICAL logit
 function. Greedy acceptance is then exactly 1.0 — the A/B measures the
 dispatch-amortisation win at a known accept rate instead of smuggling
 in a lucky draft.
+
+The SELF-draft accept-1.0 construction is blunter: a model with ALL
+parameters zero emits logits == 0 at every position (embeddings zero
+-> hidden zero; LayerNorm with zero gain -> zero; zero-init draft
+heads pass hidden through), so every argmax — base head, draft heads,
+verify rows — is token 0 and greedy acceptance is exactly 1.0. The
+self-spec A/B then measures pure dispatch amortisation: one target
+forward + k head matmuls per k+1 tokens, no second model anywhere.
 """
 from __future__ import annotations
 
@@ -68,6 +80,21 @@ def strong_pair(**over):
     return tgt, drf
 
 
+def zero_self_target(spec_k=4, **over):
+    """A self-speculative target with greedy accept rate exactly 1.0
+    by construction: every parameter zeroed, so base logits, draft-
+    head logits and verify logits are all identically 0 and every
+    argmax is token 0 (see module docstring)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    tgt = _tiny(seed=0, num_draft_heads=spec_k, **over)
+    for _name, p in tgt.state_dict().items():
+        p.set_value(paddle.to_tensor(np.zeros(p.shape, np.float32)))
+    return tgt
+
+
 def run_probe():
     import numpy as np
 
@@ -82,15 +109,15 @@ def run_probe():
     ids = rng.integers(1, 97, (2, 11))
 
     # 1. losslessness with a weak (mismatched) draft: bit-identical
-    #    greedy tokens on paged and int8-paged KV
-    for quant in (None, "int8"):
+    #    greedy tokens on paged, int8-paged and int4-paged KV
+    for quant in (None, "int8", "int4"):
         ref = GenerationEngine(tgt, kind="paged", batch=2, max_len=64,
                                kv_quant=quant).generate(ids, 17)
         eng = GenerationEngine(tgt, kind="paged", batch=2, max_len=64,
                                kv_quant=quant, draft_model=weak,
                                spec_k=3)
         out = eng.generate(ids, 17)
-        tag = "int8" if quant else "fp"
+        tag = quant or "fp"
         rec[f"greedy_parity_{tag}"] = bool(
             (np.asarray(ref.numpy()) == np.asarray(out.numpy())).all())
         # 2. retrace sentinel: variable accept counts stay data
@@ -98,6 +125,22 @@ def run_probe():
         st = eng.spec_step.retrace_stats()
         rec[f"spec_retraces_unexpected_{tag}"] = int(st["unexpected"])
         rec[f"spec_executables_{tag}"] = int(eng.spec_step.trace_count)
+
+    # 2b. SELF-draft (ISSUE 20): the target's own draft heads propose —
+    #     bit-identical greedy on int4 pools, ZERO draft params, ZERO
+    #     draft pools, still one executable
+    stgt4 = _tiny(seed=0, num_draft_heads=3)
+    ref4 = GenerationEngine(stgt4, kind="paged", batch=2, max_len=64,
+                            kv_quant="int4").generate(ids, 17)
+    eng4 = GenerationEngine(stgt4, kind="paged", batch=2, max_len=64,
+                            kv_quant="int4", draft_model="self",
+                            spec_k=3)
+    out4 = eng4.generate(ids, 17)
+    rec["self_spec_parity_int4"] = bool(
+        (np.asarray(ref4.numpy()) == np.asarray(out4.numpy())).all())
+    rec["self_spec_draft_params"] = len(eng4._draft_params)
+    rec["self_spec_draft_pools"] = 0 if eng4.draft_cache is None else 1
+    rec["self_spec_executables"] = int(eng4.spec_step.trace_count)
 
     # 3. strong draft: accept rate 1.0 by construction => exactly
     #    ceil((n-1)/(k+1)) target dispatches for n new tokens
@@ -167,18 +210,35 @@ def run_probe():
     rec["kv_bytes_per_token_bf16"] = bpt(jnp.bfloat16, None)
     rec["kv_bytes_per_token_fp32"] = bpt(jnp.float32, None)
     rec["kv_bytes_per_token_int8"] = bpt(jnp.int8, "int8")
+    rec["kv_bytes_per_token_int4"] = bpt(jnp.uint8, "int4")
     rec["int8_slots_ratio_vs_bf16"] = round(
         rec["kv_bytes_per_token_bf16"]
         / rec["kv_bytes_per_token_int8"], 3)
     rec["int8_slots_ratio_vs_fp32"] = round(
         rec["kv_bytes_per_token_fp32"]
         / rec["kv_bytes_per_token_int8"], 3)
+    # int4 receipts (ISSUE 20): nibble packing halves the payload but
+    # keeps the 4-byte per-row scale, so the honest ratios at serving
+    # head dims (>= 56) are >= 1.8x int8 and >= 3.5x bf16
+    rec["int4_slots_ratio_vs_int8"] = round(
+        rec["kv_bytes_per_token_int8"]
+        / rec["kv_bytes_per_token_int4"], 3)
+    rec["int4_slots_ratio_vs_bf16"] = round(
+        rec["kv_bytes_per_token_bf16"]
+        / rec["kv_bytes_per_token_int4"], 3)
 
     ok = (rec["greedy_parity_fp"] and rec["greedy_parity_int8"]
+          and rec["greedy_parity_int4"]
           and rec["spec_retraces_unexpected_fp"] == 0
           and rec["spec_retraces_unexpected_int8"] == 0
+          and rec["spec_retraces_unexpected_int4"] == 0
           and rec["spec_executables_fp"] == 1
           and rec["spec_executables_int8"] == 1
+          and rec["spec_executables_int4"] == 1
+          and rec["self_spec_parity_int4"]
+          and rec["self_spec_draft_params"] == 0
+          and rec["self_spec_draft_pools"] == 0
+          and rec["self_spec_executables"] == 1
           and rec["strong_draft_parity"]
           and disp == rec["strong_draft_dispatches_expected"]
           and rec["serving_parity"]
@@ -186,7 +246,9 @@ def run_probe():
           and rec["serving_accept_rate"] == 1.0
           and rec["serving_spec_retraces_unexpected"] == 0
           and rec["serving_pages_leaked"] == 0
-          and rec["int8_slots_ratio_vs_bf16"] >= 1.8)
+          and rec["int8_slots_ratio_vs_bf16"] >= 1.8
+          and rec["int4_slots_ratio_vs_int8"] >= 1.8
+          and rec["int4_slots_ratio_vs_bf16"] >= 3.5)
     rec["check"] = "pass" if ok else "FAIL: spec decode probe"
     return rec
 
@@ -194,7 +256,9 @@ def run_probe():
 def run_bench(users=4, new_tokens=48, spec_k=4):
     """Serve-lane A/B at accept rate 1.0 (strong draft by
     construction): tokens/s/user plain vs speculative vs
-    speculative+int8-KV, plus the int8 occupancy receipt."""
+    speculative+int8-KV vs speculative+int4-KV, plus the SELF-spec
+    A/B (draft_model="self" against its own plain baseline) and the
+    quantized-pool occupancy receipts."""
     import numpy as np
 
     from paddle_tpu.serving.engine import ServingEngine
@@ -204,8 +268,9 @@ def run_bench(users=4, new_tokens=48, spec_k=4):
     prompts = [rng.integers(1, 97, (m,))
                for m in rng.integers(8, 33, users)]
 
-    def lane(**kw):
-        eng = ServingEngine(tgt, max_slots=users, max_len=128,
+    def lane(model=None, **kw):
+        eng = ServingEngine(model if model is not None else tgt,
+                            max_slots=users, max_len=128,
                             page_size=16, chunk_size=32, **kw)
         for p in prompts:                       # warmup: compile steps
             eng.submit(p, new_tokens)
@@ -239,15 +304,31 @@ def run_bench(users=4, new_tokens=48, spec_k=4):
         "spec": lane(draft_model=drf, spec_k=spec_k),
         "spec_int8": lane(draft_model=drf, spec_k=spec_k,
                           kv_quant="int8"),
+        "spec_int4": lane(draft_model=drf, spec_k=spec_k,
+                          kv_quant="int4"),
     }
     rec["tok_s_user_speedup"] = round(
         rec["spec"]["tok_s_user"]
         / max(rec["plain"]["tok_s_user"], 1e-9), 3)
-    # the acceptance bar: >= 1.5x tokens/s/user at the measured accept
-    # rate (1.0 here — the draft IS the target's logit function)
+    # SELF-spec A/B (ISSUE 20): the zero-parameter construction gives
+    # accept rate exactly 1.0; compared against its OWN plain baseline
+    # (same zeroed model) so the ratio is pure dispatch amortisation
+    ztgt = zero_self_target(spec_k=spec_k)
+    rec["self_plain"] = lane(model=ztgt)
+    rec["self_spec"] = lane(model=ztgt, draft_model="self",
+                            spec_k=spec_k, kv_quant="int4")
+    rec["self_spec_tok_s_user_speedup"] = round(
+        rec["self_spec"]["tok_s_user"]
+        / max(rec["self_plain"]["tok_s_user"], 1e-9), 3)
+    # the acceptance bars: >= 1.5x tokens/s/user with a separate draft,
+    # >= 1.3x with the self-draft heads (one extra target-forward per
+    # dispatch replaces the whole draft model), both at accept 1.0
     rec["check"] = ("pass" if rec["tok_s_user_speedup"] >= 1.5
                     and rec["spec"]["accept_rate"] == 1.0
-                    else "FAIL: spec serve A/B under 1.5x")
+                    and rec["self_spec_tok_s_user_speedup"] >= 1.3
+                    and rec["self_spec"]["accept_rate"] == 1.0
+                    else "FAIL: spec serve A/B under 1.5x "
+                    "(or self-spec under 1.3x)")
     return rec
 
 

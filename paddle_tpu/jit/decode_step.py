@@ -51,7 +51,8 @@ from .train_step import _tree_data, _tree_wrap
 
 __all__ = ["GenerationEngine", "DecodeStep", "PrefillStep",
            "ChunkPrefillStep", "ServeDecodeStep", "SpecDecodeStep",
-           "ServeSpecDecodeStep", "DEFAULT_PREFILL_BUCKETS"]
+           "ServeSpecDecodeStep", "SelfDraftProposer",
+           "DEFAULT_PREFILL_BUCKETS"]
 
 DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
 
@@ -61,6 +62,41 @@ DEFAULT_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048)
 _BUFFER_KEYS = {"dense": ("layers",),
                 "paged": ("k_layers", "v_layers",
                           "k_scales", "v_scales")}
+
+
+class SelfDraftProposer:
+    """Draft-checkpoint-free proposer (self-speculative decoding,
+    ISSUE 20): the TARGET model's own draft heads
+    (``GPTConfig.num_draft_heads``) propose the k tokens from one
+    target forward, so speculative decoding needs no second checkpoint
+    and no draft KV pools. Engines accept ``draft_model="self"`` as
+    sugar for wrapping their target model in this adapter.
+
+    The adapter exists so the spec machinery keeps ONE seam: it quacks
+    like a draft model (``.gpt``, ``.config``) but owns no parameters
+    (the heads already ride the target's parameter list) and no cache
+    (``is_self_draft`` makes the engines skip draft pools and draft
+    param threading entirely)."""
+
+    is_self_draft = True
+
+    def __init__(self, model):
+        if getattr(model, "draft_heads", None) is None:
+            raise ValueError(
+                "draft_model='self' needs a target built with "
+                "GPTConfig.num_draft_heads > 0")
+        self.model = model
+
+    @property
+    def gpt(self):
+        return self.model.gpt
+
+    @property
+    def config(self):
+        return self.model.config
+
+    def parameters(self):
+        return []
 
 
 def _legacy_jax():
@@ -253,14 +289,17 @@ class _Step:
         and the caller threads `dparams`, the draft's params and KV
         pools (nested under ``buffers["draft"]``) are bound too; the
         draft cache has no metadata of its own — its positions/tables
-        are re-derived from the TARGET's metadata every step."""
+        are re-derived from the TARGET's metadata every step. A
+        SELF-draft engine has no draft cache or params at all (the
+        heads ride the target), so nothing extra binds."""
         eng = self.engine
         for p, d in zip(eng._params, params):
             p._data = d
         tgt = {k: v for k, v in buffers.items() if k != "draft"}
         eng.cache.load_state(_tree_wrap({**tgt, **meta}))
         self._draft_bound = (dparams is not None
-                             and eng.draft_model is not None)
+                             and eng.draft_model is not None
+                             and eng.draft_cache is not None)
         if self._draft_bound:
             for p, d in zip(eng._draft_params, dparams):
                 p._data = d
@@ -302,7 +341,7 @@ class _BindCtx:
         eng = self.engine
         self._saved_params = [p._data for p in eng._params]
         self._saved_cache = eng.cache.state()
-        if getattr(eng, "draft_model", None) is not None:
+        if getattr(eng, "draft_cache", None) is not None:
             self._saved_dparams = [p._data for p in eng._draft_params]
             self._saved_dcache = eng.draft_cache.state()
         else:
@@ -562,7 +601,12 @@ class SpecDecodeStep(_Step):
     target distribution the t-th emitted token came from. The host
     never learns WHY a token was emitted — only how many; variable
     yield is the whole scheduler-visible surface. All shapes are
-    fixed by (batch, k), so steady state stays one executable."""
+    fixed by (batch, k), so steady state stays one executable.
+
+    SELF-draft engines (``draft_model="self"``, ISSUE 20) replace
+    step 1 with one TARGET decode step on t0 plus the target's k
+    draft heads applied to h(t0) — same verify/accept machinery, no
+    second checkpoint, no draft KV pools, still one executable."""
 
     _arg_names = ("params", "buffers", "meta", "dparams", "tokens",
                   "seeds", "caps")
@@ -586,45 +630,84 @@ class SpecDecodeStep(_Step):
                     jnp.reshape(_data_of(cache.pos), (-1,)),
                     (b,)).astype(jnp.int32)
                 act = jnp.ones((b,), bool)
-                limit = dcache.max_len
+                limit = (dcache.max_len if dcache is not None
+                         else cache.max_len)
             greedy = not eng.do_sample
             dmpe = eng.draft_model.config.max_position_embeddings
             cur = jnp.reshape(tokens, (b,)).astype(jnp.int32)
             prop, qprobs = [], []
-            for j in range(kk + 1):
-                dsl = sl0 + j
-                # overflow guard: near the window end the draft runs
-                # ahead of the target's reserved pages — deactivate
-                # those rows so their writes trash-route instead of
-                # clamping into the slot's last real page
-                ok = act & (dsl < limit)
+            if getattr(eng.draft_model, "is_self_draft", False):
+                # SELF-DRAFT propose (ISSUE 20): ONE target decode
+                # step on the incoming token t0 yields h(t0); the k
+                # draft heads then propose positions sl0+1..sl0+k from
+                # h(t0) in one shot (head j looks j+1 ahead — not
+                # sequential). The step writes t0's K/V at sl0 into
+                # the TARGET cache; the verify chunk rewrites the same
+                # bytes (the KV quantizers are deterministic, so the
+                # double write is idempotent). No second model runs
+                # and no draft pools exist.
+                ok = act & (sl0 < jnp.minimum(caps, limit))
                 if eng.kind == "paged":
-                    dcache.seq_lens = Tensor._wrap(dsl)
-                    dcache.active = Tensor._wrap(ok)
-                else:
-                    dcache.pos = Tensor._wrap(dsl)
-                pos_ids = jnp.minimum(dsl, dmpe - 1)[:, None]
-                hidden = eng.draft_model.gpt.decode_step(
-                    Tensor._wrap(cur[:, None]), dcache,
-                    Tensor._wrap(pos_ids))
-                if j == kk:
-                    break      # write-only iteration: d_k's K/V
-                logits = eng.draft_model.head(hidden)._data[:, 0]
-                if greedy:
-                    nxt = jnp.argmax(logits.astype(jnp.float32),
-                                     axis=-1).astype(jnp.int32)
-                else:
-                    q = truncated_probs(logits, eng.temperature,
-                                        eng.top_k, eng.top_p)
-                    lq = jnp.where(q > 0,
-                                   jnp.log(jnp.maximum(q, 1e-38)),
-                                   -jnp.inf)
-                    keys = spec_draft_keys(seeds, sl0, j)
-                    nxt = jax.vmap(jax.random.categorical)(
-                        keys, lq).astype(jnp.int32)
-                    qprobs.append(q)
-                prop.append(nxt)
-                cur = nxt
+                    cache.active = Tensor._wrap(ok)
+                pos0 = jnp.minimum(sl0, dmpe - 1)[:, None]
+                hidden = eng.model.gpt.decode_step(
+                    Tensor._wrap(cur[:, None]), cache,
+                    Tensor._wrap(pos0))
+                if eng.kind == "paged":
+                    cache.active = Tensor._wrap(act)
+                heads = eng.model.draft_logits(hidden)._data[:, 0]
+                for j in range(kk):           # [b, num_heads, vocab]
+                    logits = heads[:, j]
+                    if greedy:
+                        nxt = jnp.argmax(logits.astype(jnp.float32),
+                                         axis=-1).astype(jnp.int32)
+                    else:
+                        q = truncated_probs(logits, eng.temperature,
+                                            eng.top_k, eng.top_p)
+                        lq = jnp.where(q > 0,
+                                       jnp.log(jnp.maximum(q, 1e-38)),
+                                       -jnp.inf)
+                        keys = spec_draft_keys(seeds, sl0, j)
+                        nxt = jax.vmap(jax.random.categorical)(
+                            keys, lq).astype(jnp.int32)
+                        qprobs.append(q)
+                    prop.append(nxt)
+            else:
+                for j in range(kk + 1):
+                    dsl = sl0 + j
+                    # overflow guard: near the window end the draft
+                    # runs ahead of the target's reserved pages —
+                    # deactivate those rows so their writes
+                    # trash-route instead of clamping into the slot's
+                    # last real page
+                    ok = act & (dsl < limit)
+                    if eng.kind == "paged":
+                        dcache.seq_lens = Tensor._wrap(dsl)
+                        dcache.active = Tensor._wrap(ok)
+                    else:
+                        dcache.pos = Tensor._wrap(dsl)
+                    pos_ids = jnp.minimum(dsl, dmpe - 1)[:, None]
+                    hidden = eng.draft_model.gpt.decode_step(
+                        Tensor._wrap(cur[:, None]), dcache,
+                        Tensor._wrap(pos_ids))
+                    if j == kk:
+                        break   # write-only iteration: d_k's K/V
+                    logits = eng.draft_model.head(hidden)._data[:, 0]
+                    if greedy:
+                        nxt = jnp.argmax(logits.astype(jnp.float32),
+                                         axis=-1).astype(jnp.int32)
+                    else:
+                        q = truncated_probs(logits, eng.temperature,
+                                            eng.top_k, eng.top_p)
+                        lq = jnp.where(q > 0,
+                                       jnp.log(jnp.maximum(q, 1e-38)),
+                                       -jnp.inf)
+                        keys = spec_draft_keys(seeds, sl0, j)
+                        nxt = jax.vmap(jax.random.categorical)(
+                            keys, lq).astype(jnp.int32)
+                        qprobs.append(q)
+                    prop.append(nxt)
+                    cur = nxt
             proposed = jnp.stack(prop, axis=1)               # [b, k]
             ver = jnp.concatenate(
                 [jnp.reshape(tokens, (b, 1)).astype(jnp.int32),
@@ -713,21 +796,39 @@ class GenerationEngine:
         self._page_size = page_size
         self.kv_quant = kv_quant
         # speculative decoding (ISSUE 16): a small draft model turns
-        # the decode loop into draft-k/verify-once dispatches
+        # the decode loop into draft-k/verify-once dispatches.
+        # draft_model="self" (ISSUE 20) resolves to the target's own
+        # draft heads — no second checkpoint, no draft KV pools.
+        if isinstance(draft_model, str):
+            if draft_model != "self":
+                raise ValueError(
+                    f"unknown draft_model {draft_model!r} (the only "
+                    "string form is 'self')")
+            draft_model = SelfDraftProposer(model)
         self.draft_model = draft_model
         self.spec_k = int(spec_k)
         self.cache = self._make_cache()
         if draft_model is not None:
-            draft_model.gpt._check_decodable()
-            if draft_model.config.vocab_size != cfg.vocab_size:
-                raise ValueError(
-                    "draft model vocab_size "
-                    f"{draft_model.config.vocab_size} != target "
-                    f"{cfg.vocab_size} (proposals must be target ids)")
+            self_draft = getattr(draft_model, "is_self_draft", False)
+            if self_draft:
+                if self.spec_k > cfg.num_draft_heads:
+                    raise ValueError(
+                        f"spec_k={self.spec_k} exceeds the target's "
+                        f"num_draft_heads={cfg.num_draft_heads}")
+            else:
+                draft_model.gpt._check_decodable()
+                if draft_model.config.vocab_size != cfg.vocab_size:
+                    raise ValueError(
+                        "draft model vocab_size "
+                        f"{draft_model.config.vocab_size} != target "
+                        f"{cfg.vocab_size} (proposals must be target "
+                        "ids)")
             if self.spec_k < 1:
                 raise ValueError("spec_k must be >= 1")
-            self._draft_params = list(draft_model.parameters())
-            self.draft_cache = self._make_draft_cache()
+            self._draft_params = ([] if self_draft
+                                  else list(draft_model.parameters()))
+            self.draft_cache = (None if self_draft
+                                else self._make_draft_cache())
             self.spec_step = SpecDecodeStep(self, donate_cache=donate)
         else:
             self._draft_params = []
@@ -916,7 +1017,7 @@ class GenerationEngine:
             # engine cached — an abort mid-loop would leave the cache
             # pointing at consumed buffers, so rebuild it pristine
             self.cache = self._make_cache()
-            if self.draft_model is not None:
+            if self.draft_cache is not None:
                 self.draft_cache = self._make_draft_cache()
             raise
         if self.kind == "paged":

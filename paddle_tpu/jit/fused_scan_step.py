@@ -438,21 +438,31 @@ class FusedScanTrainStep:
                                self._cc(o_datas))
             try:
                 h = m.gpt.ln_f(Tensor._wrap(xL))
+                yT = Tensor._wrap(labels)
+                if m.lm_head is None:
+                    w, t_y = m.gpt.wte.weight, True
+                else:
+                    w, t_y = m.lm_head.weight, False
                 if self._fused_head:
                     from ..models.gpt import fused_lm_loss
 
-                    if m.lm_head is None:
-                        w, t_y = m.gpt.wte.weight, True
-                    else:
-                        w, t_y = m.lm_head.weight, False
-                    return fused_lm_loss(h, w, t_y,
-                                         Tensor._wrap(labels))._data
-                if m.lm_head is None:
-                    logits = ops.matmul(h, m.gpt.wte.weight,
-                                        transpose_y=True)
+                    loss = fused_lm_loss(h, w, t_y, yT)
                 else:
-                    logits = m.lm_head(h)
-                return self._crit(logits, Tensor._wrap(labels))._data
+                    if m.lm_head is None:
+                        logits = ops.matmul(h, m.gpt.wte.weight,
+                                            transpose_y=True)
+                    else:
+                        logits = m.lm_head(h)
+                    loss = self._crit(logits, yT)
+                if getattr(m, "draft_heads", None) is not None:
+                    # self-spec draft heads (ISSUE 20): same aux CE the
+                    # eager loss() adds — heads are outer params, so
+                    # their grads ride the o-param cotangents
+                    from ..models.gpt import draft_head_loss
+
+                    loss = loss + m.config.draft_head_loss_weight \
+                        * draft_head_loss(m, h, w, t_y, yT)
+                return loss._data
             finally:
                 self._bind([p for _, p in self._o_params], saved)
 

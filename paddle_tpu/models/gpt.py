@@ -66,6 +66,16 @@ class GPTConfig:
     moe_capacity_factor: float = 2.0
     moe_gate: str = "gshard"        # "gshard" (top-2) | "switch" (top-1)
     moe_aux_weight: float = 1e-2
+    # self-speculative draft heads (ISSUE 20): k Medusa-style heads off
+    # the final hidden state — head j predicts the token j+2 positions
+    # ahead (the base LM head predicts position +1), sharing the LM
+    # head projection. Serving proposes k tokens per dispatch from the
+    # TARGET's own forward (draft_model="self"), so speculation needs
+    # no second checkpoint and no draft KV pools. Heads train as an
+    # auxiliary CE on shifted targets (weight below); zero-init makes
+    # an untrained head start as the base head (identity residual).
+    num_draft_heads: int = 0
+    draft_head_loss_weight: float = 0.1
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -145,12 +155,15 @@ class GPTAttention(nn.Layer):
                 _kv.dense_write_prefill, [cache.layer(layer_idx), k, v],
                 "dense_prefill_write"))
         elif getattr(cache, "quantized", False):
+            q4 = cache.quant == "int4"
             new_k, new_v, new_ks, new_vs = nary(
-                _kv.paged_write_prefill_q8,
+                _kv.paged_write_prefill_q4 if q4
+                else _kv.paged_write_prefill_q8,
                 [cache.k_layers[layer_idx], cache.v_layers[layer_idx],
                  cache.k_scales[layer_idx], cache.v_scales[layer_idx],
                  cache.page_tables, slot_ids, seq_lens, k, v],
-                "paged_prefill_write_q8")
+                "paged_prefill_write_q4" if q4
+                else "paged_prefill_write_q8")
             cache.k_layers[layer_idx] = new_k
             cache.v_layers[layer_idx] = new_v
             cache.k_scales[layer_idx] = new_ks
@@ -197,8 +210,12 @@ class GPTAttention(nn.Layer):
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [b, nh, hd]
 
         if getattr(cache, "quantized", False):
-            def step_q8(qq, kk, vv, kp, vp, ksc, vsc, pt, sl, act):
-                kp2, vp2, ks2, vs2 = _kv.paged_write_decode_q8(
+            q4 = cache.quant == "int4"
+            wfn = (_kv.paged_write_decode_q4 if q4
+                   else _kv.paged_write_decode_q8)
+
+            def step_q(qq, kk, vv, kp, vp, ksc, vsc, pt, sl, act):
+                kp2, vp2, ks2, vs2 = wfn(
                     kp, vp, ksc, vsc, pt, sl, act, kk, vv)
                 lens = jnp.where(act, sl + 1, 0)
                 o = paged_attention(qq, kp2, vp2, pt, lens,
@@ -206,13 +223,14 @@ class GPTAttention(nn.Layer):
                 return o, kp2, vp2, ks2, vs2
 
             out, new_k, new_v, new_ks, new_vs = nary(
-                step_q8, [q, k, v, cache.k_layers[layer_idx],
-                          cache.v_layers[layer_idx],
-                          cache.k_scales[layer_idx],
-                          cache.v_scales[layer_idx],
-                          cache.page_tables, cache.seq_lens,
-                          cache.active],
-                "paged_decode_attention_q8")
+                step_q, [q, k, v, cache.k_layers[layer_idx],
+                         cache.v_layers[layer_idx],
+                         cache.k_scales[layer_idx],
+                         cache.v_scales[layer_idx],
+                         cache.page_tables, cache.seq_lens,
+                         cache.active],
+                "paged_decode_attention_q4" if q4
+                else "paged_decode_attention_q8")
             cache.k_scales[layer_idx] = new_ks
             cache.v_scales[layer_idx] = new_vs
         else:
@@ -291,8 +309,12 @@ class GPTAttention(nn.Layer):
                 "dense_prefill_chunk")
             cache.set_layer(layer_idx, new_l)
         elif getattr(cache, "quantized", False):
+            q4 = cache.quant == "int4"
+            wfn = (_kv.paged_write_prefill_q4 if q4
+                   else _kv.paged_write_prefill_q8)
+
             def qstep(qq, kk, vv, kp, vp, ksc, vsc, pt, sid, st, ln):
-                kp2, vp2, ks2, vs2 = _kv.paged_write_prefill_q8(
+                kp2, vp2, ks2, vs2 = wfn(
                     kp, vp, ksc, vsc, pt, sid, ln, kk, vv, start=st)
                 o = paged_attention_chunk(qq, kp2, vp2, pt[sid], st,
                                           k_scales=ks2, v_scales=vs2)
@@ -304,7 +326,8 @@ class GPTAttention(nn.Layer):
                         cache.k_scales[layer_idx],
                         cache.v_scales[layer_idx], cache.page_tables,
                         slot_ids, start, seq_lens_new],
-                "paged_prefill_chunk_q8")
+                "paged_prefill_chunk_q4" if q4
+                else "paged_prefill_chunk_q8")
             cache.k_layers[layer_idx] = new_k
             cache.v_layers[layer_idx] = new_v
             cache.k_scales[layer_idx] = new_ks
@@ -716,6 +739,21 @@ class GPTForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
+        if config.num_draft_heads:
+            import jax.numpy as jnp
+
+            # one residual block per head: logits_j = LM(h + silu(W_j h))
+            # — hidden^2 params each, logits through the SHARED LM head.
+            # Zero-init so an untrained head IS the base head: the
+            # residual vanishes and proposals start sane, the aux-CE
+            # gradient is nonzero (silu'(0) = 1/2) so training moves it.
+            self.draft_heads = nn.LayerList([
+                nn.Linear(config.hidden_size, config.hidden_size)
+                for _ in range(config.num_draft_heads)])
+            for p in self.draft_heads.parameters():
+                p._data = jnp.zeros_like(p._data)
+        else:
+            self.draft_heads = None
 
     def forward(self, input_ids, position_ids=None, segment_ids=None):
         return self.head(self.gpt(input_ids, position_ids,
@@ -729,6 +767,23 @@ class GPTForCausalLM(nn.Layer):
             return ops.matmul(hidden, self.gpt.wte.weight,
                               transpose_y=True)
         return self.lm_head(hidden)
+
+    def draft_hidden(self, hidden, j):
+        """Draft head j's residual block over hiddens [..., hidden]:
+        ``h + silu(W_j h)``. Feed the result through :meth:`head` for
+        the head's logits — kept separate so the compiled spec step can
+        batch the k head outputs through ONE shared LM-head matmul."""
+        return hidden + F.silu(self.draft_heads[j](hidden))
+
+    def draft_logits(self, hidden):
+        """All k draft heads' logits off one final hidden state:
+        [..., hidden] -> [..., k, vocab] (head j at index j predicts
+        the token j+2 positions ahead of the hidden's position)."""
+        from .. import ops
+
+        cat = ops.stack([self.draft_hidden(hidden, j)
+                         for j in range(len(self.draft_heads))], axis=-2)
+        return self.head(cat)
 
     def generate(self, input_ids, max_new_tokens=20, seq_lens=None,
                  use_cache="dense", do_sample=False, top_k=0, top_p=1.0,
@@ -821,7 +876,30 @@ class GPTForCausalLM(nn.Layer):
         aux = self.gpt.moe_aux()
         if aux is not None:
             loss = loss + self.config.moe_aux_weight * aux
+        if self.draft_heads is not None:
+            loss = loss + self.config.draft_head_loss_weight \
+                * draft_head_loss(self, hidden, w, t_y, labels,
+                                  loss_mask)
         return loss
+
+
+def draft_head_loss(model, hidden, weight, transpose_y, labels,
+                    loss_mask=None):
+    """Auxiliary CE of the self-speculative draft heads (ISSUE 20):
+    head j at position i predicts ``labels[i + j + 1]`` (the base LM
+    head predicts ``labels[i]``), through the SAME fused LM-head path
+    as the base loss. Mean over heads, so the weight knob is
+    independent of k. Used by `GPTForCausalLM.loss` and the fused-scan
+    train step's head function — pass the final (ln_f'd) hiddens."""
+    total = None
+    k = len(model.draft_heads)
+    for j in range(k):
+        hj = model.draft_hidden(hidden[:, :-(j + 1)], j)
+        lj = labels[:, j + 1:]
+        mj = loss_mask[:, j + 1:] if loss_mask is not None else None
+        lj_loss = fused_lm_loss(hj, weight, transpose_y, lj, mj)
+        total = lj_loss if total is None else total + lj_loss
+    return total / k
 
 
 def fused_lm_loss(hidden, weight, transpose_y, labels, loss_mask=None):

@@ -33,6 +33,7 @@ __all__ = [
     "FakeQuantWeightLSQPlus", "FakeQuantActLSQPlus",
     "QuantizedLinear", "QuantStub", "Stub",
     "WeightOnlyLinear", "quantize_for_decode",
+    "quantize_symmetric_q4", "pack_q4", "unpack_q4",
 ]
 
 
@@ -43,6 +44,49 @@ def _qmax(bits):
 def _ste(x, q):
     """Straight-through estimator: forward q, backward identity."""
     return x + jax.lax.stop_gradient(q - x)
+
+
+# ---------------------------------------------------------------------------
+# int4 nibble format — THE storage format of the int4 paged KV pools
+# (ISSUE 20, inference/kv_cache.py). Plain jnp functions (no Tensor
+# wrapping) so the compiled decode/prefill steps call them directly.
+# ---------------------------------------------------------------------------
+
+def quantize_symmetric_q4(x, axis=-1):
+    """Symmetric int4 quantization along ``axis``: one fp32 scale per
+    row (max|x|/7, floored at 1e-30 so all-zero rows stay finite),
+    payload = round(x/scale) clipped to [-7, 7] as UNPACKED int8.
+    Returns ``(q int8, scales fp32 with axis removed)`` — pair with
+    :func:`pack_q4` for the two-values-per-byte pool layout."""
+    sc = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis),
+                     1e-30) / 7.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32)
+                           / jnp.expand_dims(sc, axis)),
+                 -7, 7).astype(jnp.int8)
+    return q, sc
+
+
+def pack_q4(q):
+    """Pack int4 values (int-typed, in [-7, 7]) pairwise along the LAST
+    axis into uint8: even lane -> high nibble, odd lane -> low nibble,
+    offset-binary (+8, so nibbles land in [1, 15] and the byte is never
+    0 for a live value pair unless both lanes are -8, which the
+    quantizer never emits). Last dim must be even."""
+    if q.shape[-1] % 2:
+        raise ValueError(
+            f"pack_q4 needs an even last dim, got {q.shape[-1]}")
+    v = q.astype(jnp.int32) + 8
+    return ((v[..., 0::2] << 4) | v[..., 1::2]).astype(jnp.uint8)
+
+
+def unpack_q4(p):
+    """Inverse of :func:`pack_q4`: uint8 ``[..., d//2]`` -> int32
+    ``[..., d]`` values in [-8, 7] (high nibble first)."""
+    v = p.astype(jnp.int32)
+    hi = (v >> 4) - 8
+    lo = (v & 0xF) - 8
+    return jnp.stack([hi, lo], axis=-1).reshape(
+        *p.shape[:-1], p.shape[-1] * 2)
 
 
 # ---------------------------------------------------------------------------

@@ -50,6 +50,17 @@ Two extensions since ISSUE 16:
   context (causal within the chunk). One call scores a whole
   speculative-decoding verify window (or one chunk of a long prompt —
   the serving chunk-prefill shape) instead of c decode dispatches.
+
+And since ISSUE 20:
+
+* **int4 pools** — uint8 pages packing TWO values per byte
+  (``[..., head_dim // 2]``, nn/quant ``pack_q4`` nibble format: high
+  nibble = even lane, offset-binary +8) with the same per-row fp32
+  scale layout as int8. The quant mode is inferred from the pool
+  dtype (``int8`` -> int8, ``uint8`` -> int4) whenever scales are
+  passed; dequant fuses into the gather as a nibble unpack
+  (``(v >> 4) - 8`` / ``(v & 0xF) - 8``) ahead of the scale multiply,
+  in the kernels and the XLA fallbacks alike.
 """
 from __future__ import annotations
 
@@ -84,15 +95,37 @@ def supports(num_heads, num_kv_heads, head_dim, page_size) -> bool:
 # XLA gather fallback
 # ---------------------------------------------------------------------------
 
+def _quant_mode(pages, scales):
+    """None / "int8" / "int4", inferred from the pool dtype (scales
+    present means a quantized pool; uint8 is the packed-nibble form)."""
+    if scales is None:
+        return None
+    return "int4" if pages.dtype == jnp.dtype(jnp.uint8) else "int8"
+
+
+def _unpack_nib(p):
+    """uint8 [..., d//2] -> int32 [..., d] nibble values in [-8, 7]
+    (pack_q4 layout: high nibble first, offset-binary +8). Inlined here
+    — the kernels run it on register-resident page blocks."""
+    v = p.astype(jnp.int32)
+    hi = (v >> 4) - 8
+    lo = (v & 0xF) - 8
+    return jnp.stack([hi, lo], axis=-1).reshape(
+        *p.shape[:-1], p.shape[-1] * 2)
+
+
 def _densify(pages, page_tables, scales=None):
     """Gather a [b, kvh, pp*ps, d] dense view of each slot's pages;
-    int8 pools dequantize right here (fused into the gather's consumer
-    — per-row fp32 scale, comm-stack symmetric format)."""
+    quantized pools dequantize right here (fused into the gather's
+    consumer — per-row fp32 scale, comm-stack symmetric format; int4
+    additionally nibble-unpacks the packed payload)."""
     kvh, _, page_size, d = pages.shape
     b, pp = page_tables.shape
     g = jnp.take(pages, page_tables, axis=1)        # [kvh, b, pp, ps, d]
     g = jnp.moveaxis(g, 0, 1).reshape(b, kvh, pp * page_size, d)
     if scales is not None:
+        if _quant_mode(pages, scales) == "int4":
+            g = _unpack_nib(g)                      # [..., 2*d] values
         s = jnp.take(scales, page_tables, axis=1)   # [kvh, b, pp, ps]
         s = jnp.moveaxis(s, 0, 1).reshape(b, kvh, pp * page_size)
         g = g.astype(jnp.float32) * s[..., None]
@@ -172,13 +205,15 @@ def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
-def _decode_kernel_q8(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
-                      vs_ref, o_ref, acc_ref, m_ref, l_ref, *, scale,
-                      page_size):
-    """`_decode_kernel` over int8 pools: per-row fp32 scales ride along
-    as (ps, 1) blocks picked by the same page-table index map, and
-    dequant is a register-resident row broadcast fused ahead of the
-    dots — the pool never exists in fp anywhere."""
+def _decode_kernel_q(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
+                     vs_ref, o_ref, acc_ref, m_ref, l_ref, *, scale,
+                     page_size, quant):
+    """`_decode_kernel` over quantized pools: per-row fp32 scales ride
+    along as (ps, 1) blocks picked by the same page-table index map,
+    and dequant is a register-resident row broadcast fused ahead of the
+    dots — the pool never exists in fp anywhere. ``quant="int4"`` adds
+    a nibble unpack of the packed (ps, d//2) uint8 block before the
+    scale multiply."""
     b = pl.program_id(0)
     p = pl.program_id(2)
     num_p = pl.num_programs(2)
@@ -193,8 +228,11 @@ def _decode_kernel_q8(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
     @pl.when(p * page_size < sl)
     def _step():
         q = q_ref[0, 0]                                  # [grp, d]
-        k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]   # [ps, d]
-        v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+        kq, vq = k_ref[0, 0], v_ref[0, 0]                # [ps, d(/2)]
+        if quant == "int4":
+            kq, vq = _unpack_nib(kq), _unpack_nib(vq)    # [ps, d]
+        k = kq.astype(jnp.float32) * ks_ref[0, 0]        # [ps, d]
+        v = vq.astype(jnp.float32) * vs_ref[0, 0]
         s = jax.lax.dot_general(
             q.astype(jnp.float32), k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [grp, ps]
@@ -222,16 +260,19 @@ def _decode_kernel_q8(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
-def _page_specs(pp, page_size, d, quantized):
+def _page_specs(pp, page_size, d, quant):
     """BlockSpecs for (k_pages, v_pages[, k_scales, v_scales]) — every
-    block picked by the scalar-prefetched flat page table."""
+    block picked by the scalar-prefetched flat page table. int4 pools
+    DMA the PACKED (ps, d//2) uint8 block; the kernel unpacks in
+    registers."""
 
     def page(bb, h, p, pt, sl):
         return (h, pt[bb * pp + p], 0, 0)
 
-    specs = [pl.BlockSpec((1, 1, page_size, d), page),
-             pl.BlockSpec((1, 1, page_size, d), page)]
-    if quantized:
+    dp = d // 2 if quant == "int4" else d
+    specs = [pl.BlockSpec((1, 1, page_size, dp), page),
+             pl.BlockSpec((1, 1, page_size, dp), page)]
+    if quant is not None:
         specs += [pl.BlockSpec((1, 1, page_size, 1), page),
                   pl.BlockSpec((1, 1, page_size, 1), page)]
     return specs
@@ -246,7 +287,7 @@ def _paged_attention_pallas(q, k_pages, v_pages, page_tables, seq_lens,
     pp = page_tables.shape[1]
     qg = q.reshape(b, kvh, grp, d)
     flat_pt = page_tables.reshape(-1).astype(jnp.int32)
-    quantized = k_scales is not None
+    quant = _quant_mode(k_pages, k_scales)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # page table + seq_lens
@@ -254,7 +295,7 @@ def _paged_attention_pallas(q, k_pages, v_pages, page_tables, seq_lens,
         in_specs=[
             pl.BlockSpec((1, 1, grp, d),
                          lambda bb, h, p, pt, sl: (bb, h, 0, 0)),
-            *_page_specs(pp, page_size, d, quantized),
+            *_page_specs(pp, page_size, d, quant),
         ],
         out_specs=pl.BlockSpec((1, 1, grp, d),
                                lambda bb, h, p, pt, sl: (bb, h, 0, 0)),
@@ -264,10 +305,12 @@ def _paged_attention_pallas(q, k_pages, v_pages, page_tables, seq_lens,
             pltpu.VMEM((grp, _LANES), jnp.float32),
         ],
     )
-    kernel = _decode_kernel_q8 if quantized else _decode_kernel
-    extra = ((k_scales.reshape(kvh, num_pages, page_size, 1),
-              v_scales.reshape(kvh, num_pages, page_size, 1))
-             if quantized else ())
+    if quant is not None:
+        kernel = functools.partial(_decode_kernel_q, quant=quant)
+        extra = (k_scales.reshape(kvh, num_pages, page_size, 1),
+                 v_scales.reshape(kvh, num_pages, page_size, 1))
+    else:
+        kernel, extra = _decode_kernel, ()
     out = pl.pallas_call(
         functools.partial(kernel, scale=scale, page_size=page_size),
         grid_spec=grid_spec,
@@ -287,10 +330,15 @@ def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
     (`supports`), the XLA gather fallback otherwise. `interpret=True`
     forces the kernel in interpret mode (hermetic CPU testing);
     `use_kernel` overrides the routing outright. Passing
-    `k_scales`/`v_scales` selects the int8-pool path (fused dequant).
+    `k_scales`/`v_scales` selects the quantized-pool path (fused
+    dequant; int8 or — for uint8 packed pools — int4 nibble unpack).
     """
     b, nh, d = q.shape
     kvh, _, page_size, _ = k_pages.shape
+    if _quant_mode(k_pages, k_scales) == "int4" and d % 2:
+        raise ValueError(
+            f"int4 paged attention needs an even head_dim (two values "
+            f"per byte), got head_dim={d}")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     ok = supports(nh, kvh, d, page_size)
@@ -350,13 +398,15 @@ def paged_attention_chunk_xla(q, k_pages, v_pages, page_tables, start,
 
 
 def _chunk_kernel(pt_ref, st_ref, q_ref, k_ref, v_ref, *rest, scale,
-                  page_size, chunk, quantized):
+                  page_size, chunk, quant):
     """Ragged multi-token kernel: like `_decode_kernel` but the q block
     carries grp*c rows (row r = head-group g*c + chunk index i) and the
     causal mask compares each row's absolute position start+i against
     the page's key positions. Pages fully above start+c-1 are skipped,
-    so verify cost tracks each slot's own context length."""
-    if quantized:
+    so verify cost tracks each slot's own context length. ``quant`` is
+    None / "int8" / "int4" (int4 nibble-unpacks the packed block before
+    the scale multiply)."""
+    if quant is not None:
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
@@ -374,9 +424,12 @@ def _chunk_kernel(pt_ref, st_ref, q_ref, k_ref, v_ref, *rest, scale,
     @pl.when(p * page_size < st + chunk)
     def _step():
         q = q_ref[0, 0]                                  # [grp*c, d]
-        if quantized:
-            k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
-            v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+        if quant is not None:
+            kq, vq = k_ref[0, 0], v_ref[0, 0]            # [ps, d(/2)]
+            if quant == "int4":
+                kq, vq = _unpack_nib(kq), _unpack_nib(vq)
+            k = kq.astype(jnp.float32) * ks_ref[0, 0]
+            v = vq.astype(jnp.float32) * vs_ref[0, 0]
         else:
             k = k_ref[0, 0]
             v = v_ref[0, 0]
@@ -422,7 +475,7 @@ def _paged_attention_chunk_pallas(q, k_pages, v_pages, page_tables,
     # [b, c, nh, d] -> [b, kvh, grp*c, d], row r = g*c + i
     qg = jnp.moveaxis(q, 1, 2).reshape(b, kvh, rows, d)
     flat_pt = page_tables.reshape(-1).astype(jnp.int32)
-    quantized = k_scales is not None
+    quant = _quant_mode(k_pages, k_scales)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # page table + start offsets
@@ -430,7 +483,7 @@ def _paged_attention_chunk_pallas(q, k_pages, v_pages, page_tables,
         in_specs=[
             pl.BlockSpec((1, 1, rows, d),
                          lambda bb, h, p, pt, st: (bb, h, 0, 0)),
-            *_page_specs(pp, page_size, d, quantized),
+            *_page_specs(pp, page_size, d, quant),
         ],
         out_specs=pl.BlockSpec((1, 1, rows, d),
                                lambda bb, h, p, pt, st: (bb, h, 0, 0)),
@@ -442,11 +495,11 @@ def _paged_attention_chunk_pallas(q, k_pages, v_pages, page_tables,
     )
     extra = ((k_scales.reshape(kvh, num_pages, page_size, 1),
               v_scales.reshape(kvh, num_pages, page_size, 1))
-             if quantized else ())
+             if quant is not None else ())
     out = pl.pallas_call(
         functools.partial(_chunk_kernel, scale=scale,
                           page_size=page_size, chunk=c,
-                          quantized=quantized),
+                          quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, rows, d), q.dtype),
         interpret=interpret,
@@ -463,6 +516,10 @@ def paged_attention_chunk(q, k_pages, v_pages, page_tables, start,
     as `paged_attention`."""
     b, c, nh, d = q.shape
     kvh, _, page_size, _ = k_pages.shape
+    if _quant_mode(k_pages, k_scales) == "int4" and d % 2:
+        raise ValueError(
+            f"int4 paged attention needs an even head_dim (two values "
+            f"per byte), got head_dim={d}")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     ok = supports(nh, kvh, d, page_size)

@@ -41,9 +41,9 @@ import time
 import numpy as np
 
 from ..inference.kv_cache import PagedKVCache
-from ..jit.decode_step import (ChunkPrefillStep, ServeDecodeStep,
-                               ServeSpecDecodeStep, _split_state,
-                               refresh_serving_buffers)
+from ..jit.decode_step import (ChunkPrefillStep, SelfDraftProposer,
+                               ServeDecodeStep, ServeSpecDecodeStep,
+                               _split_state, refresh_serving_buffers)
 from ..jit.train_step import _tree_data
 from ..observability import SLOTracker, Tracer, faults
 from .metrics import ServingMetrics
@@ -108,27 +108,46 @@ class ServingEngine:
         self.num_pages = int(num_pages or
                              1 + self.max_slots * self.pages_per_seq)
         self._params = list(model.parameters())
-        # int8 paged KV (ISSUE 16): ~2x the resident tokens per page of
-        # HBM (per-row scales, dequant fused into the attention gather)
-        if kv_quant not in (None, "int8"):
+        # int8/int4 paged KV (ISSUES 16/20): ~2x / ~4x the resident
+        # tokens per page of HBM (per-row scales, dequant fused into
+        # the attention gather; int4 packs two values per byte)
+        if kv_quant not in (None, "int8", "int4"):
             raise ValueError(f"unknown KV quant mode {kv_quant!r}")
         self.kv_quant = kv_quant
         # speculative decoding (ISSUE 16): the decode program becomes
-        # draft-k-propose / verify-once with variable per-slot yield
+        # draft-k-propose / verify-once with variable per-slot yield.
+        # draft_model="self" (ISSUE 20) resolves to the target's own
+        # draft heads — no second checkpoint, no draft KV pools.
+        if isinstance(draft_model, str):
+            if draft_model != "self":
+                raise ValueError(
+                    f"unknown draft_model {draft_model!r} (the only "
+                    "string form is 'self')")
+            draft_model = SelfDraftProposer(model)
         self.draft_model = draft_model
         self.spec_k = int(spec_k)
         self.cache = self._make_cache()
         if draft_model is not None:
-            draft_model.gpt._check_decodable()
-            if draft_model.config.vocab_size != cfg.vocab_size:
-                raise ValueError(
-                    "draft model vocab_size "
-                    f"{draft_model.config.vocab_size} != target "
-                    f"{cfg.vocab_size} (proposals must be target ids)")
+            self_draft = getattr(draft_model, "is_self_draft", False)
+            if self_draft:
+                if self.spec_k > cfg.num_draft_heads:
+                    raise ValueError(
+                        f"spec_k={self.spec_k} exceeds the target's "
+                        f"num_draft_heads={cfg.num_draft_heads}")
+            else:
+                draft_model.gpt._check_decodable()
+                if draft_model.config.vocab_size != cfg.vocab_size:
+                    raise ValueError(
+                        "draft model vocab_size "
+                        f"{draft_model.config.vocab_size} != target "
+                        f"{cfg.vocab_size} (proposals must be target "
+                        "ids)")
             if self.spec_k < 1:
                 raise ValueError("spec_k must be >= 1")
-            self._draft_params = list(draft_model.parameters())
-            self.draft_cache = self._make_draft_cache()
+            self._draft_params = ([] if self_draft
+                                  else list(draft_model.parameters()))
+            self.draft_cache = (None if self_draft
+                                else self._make_draft_cache())
         else:
             self._draft_params = []
             self.draft_cache = None
@@ -1162,7 +1181,7 @@ class ServingEngine:
         self.scheduler.cache = self.cache
         self._buffers, _ = _split_state(
             "paged", _tree_data(self.cache.state()))
-        if self.draft_model is not None:
+        if self.draft_cache is not None:
             self.draft_cache = self._make_draft_cache()
             self._buffers["draft"], _ = _split_state(
                 "paged", _tree_data(self.draft_cache.state()))

@@ -1121,6 +1121,17 @@ class FleetRouter:
                     "prefill_chunks", "decode_steps"):
             out[f"fleet_{key}"] = sum(p.get(key, 0)
                                       for p in per.values())
+        # per-replica KV-pool rollup (ISSUE 20): quant level, true
+        # packed bytes/token and the capacity multiple vs bf16 — the
+        # capacity story a fleet operator sizes replicas by
+        pools = {}
+        for r in reps:
+            ps = r.engine.cache.pool_stats()
+            pools[r.name] = {k: ps[k] for k in
+                             ("kv_dtype", "bytes_per_token",
+                              "effective_slots_vs_bf16", "occupancy",
+                              "free_pages", "total_pages") if k in ps}
+        out["replica_pools"] = pools
         if self.host_ring is not None:
             out["host_ring"] = self.host_ring.stats()
         return out

@@ -1,0 +1,324 @@
+"""int4 paged KV + self-speculative draft heads (ISSUE 20).
+
+The two halves of the capacity/latency story, checked at every layer:
+
+* int4 KV: nibble pack/unpack is exact, the Pallas gather-fused dequant
+  matches the XLA fallback on decode AND chunk paths, pool_stats'
+  capacity receipt is the honest packed-bytes math (>=1.8x int8,
+  >=3.5x bf16 at serving head dims), export/import round-trips
+  bit-exactly INCLUDING the fp32 scale pools, and the host KV ring
+  charges exactly the bytes it holds at every quant level;
+* self-speculative decoding: ``draft_model="self"`` runs spec decoding
+  off the target's own draft heads — greedy output BIT-IDENTICAL to
+  plain decode on fp/int8/int4 pools, zero draft params, zero draft KV
+  pools, one decode executable; the heads ride the checkpoint and the
+  training loss, and zero-init makes an untrained head the base head.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.kv_cache import PagedKVCache
+from paddle_tpu.jit.decode_step import GenerationEngine, SelfDraftProposer
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.nn.quant import pack_q4, quantize_symmetric_q4, unpack_q4
+
+
+def tiny_model(seed=0, **over):
+    paddle.seed(seed)
+    kw = dict(vocab_size=97, hidden_size=32, num_layers=2,
+              num_attention_heads=4, max_position_embeddings=96,
+              hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    kw.update(over)
+    m = GPTForCausalLM(GPTConfig(**kw))
+    m.eval()
+    return m
+
+
+def _mk_cache(quant, head_dim=16, layers=1, kvh=2, pages=13, ps=8,
+              slots=3, pps=4):
+    return PagedKVCache(num_layers=layers, num_kv_heads=kvh,
+                        head_dim=head_dim, num_pages=pages,
+                        page_size=ps, max_slots=slots,
+                        pages_per_seq=pps, quant=quant)
+
+
+class TestNibblePack:
+    def test_pack_unpack_roundtrip_exact(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(3, 5, 8).astype(np.float32))
+        q, sc = quantize_symmetric_q4(x)
+        u = np.asarray(unpack_q4(pack_q4(q)))
+        np.testing.assert_array_equal(u, np.asarray(q))
+        assert u.min() >= -8 and u.max() <= 7
+        # dequant error bounded by half a quant step per row
+        deq = u * np.asarray(sc)[..., None]
+        step = np.asarray(sc)[..., None]
+        assert (np.abs(deq - np.asarray(x)) <= 0.5 * step + 1e-6).all()
+
+    def test_pack_rejects_odd_last_dim(self):
+        with pytest.raises(ValueError, match="even"):
+            pack_q4(jnp.zeros((2, 7), jnp.int8))
+
+
+class TestInt4Capacity:
+    """The capacity receipt: honest packed bytes per token, counting
+    the fp32 scales — the "Nx slots at equal HBM" math of the bench."""
+
+    def test_pool_bytes_and_slot_ratios(self):
+        # serving-shaped head_dim: 64. int8 = d+4 B/row, int4 = d/2+4.
+        stats = {q: _mk_cache(q, head_dim=64).pool_stats()
+                 for q in (None, "int8", "int4")}
+        assert stats["int4"]["kv_dtype"] == "int4"
+        i8, i4 = (stats[q]["bytes_per_token"] for q in ("int8", "int4"))
+        assert i8 / i4 >= 1.8
+        assert stats["int4"]["effective_slots_vs_bf16"] >= 3.5
+        assert stats["int8"]["effective_slots_vs_bf16"] >= 1.8
+        # exact packed math: L * 2 * kvh * (d/2 + 4)
+        assert i4 == 1 * 2 * 2 * (32 + 4)
+        # fp pools report their real dtype, no scale surcharge
+        assert stats[None]["bytes_per_token"] == 1 * 2 * 2 * 64 * 4
+
+    def test_odd_head_dim_rejected(self):
+        with pytest.raises(ValueError, match="even"):
+            _mk_cache("int4", head_dim=15)
+
+
+class TestInt4KernelParity:
+    """Gather-fused nibble dequant in the Pallas kernels (interpret
+    mode on CPU) vs the XLA fallbacks — same pools, same scales."""
+
+    def _pools(self):
+        from paddle_tpu.inference.kv_cache import (paged_write_decode_q4,
+                                                   paged_write_prefill_q4)
+
+        rng = np.random.RandomState(1)
+        cache = _mk_cache("int4")
+        lens = [13, 7, 20]
+        for n in lens:
+            cache.allocate(n)
+        pt = jnp.asarray(cache.page_tables)
+        b, kvh, d = len(lens), 2, 16
+        kp, vp, ks, vs = paged_write_prefill_q4(
+            cache.k_layers[0], cache.v_layers[0], cache.k_scales[0],
+            cache.v_scales[0], pt, jnp.arange(b),
+            jnp.asarray(lens, jnp.int32),
+            jnp.asarray(rng.randn(b, max(lens), kvh, d), jnp.float32),
+            jnp.asarray(rng.randn(b, max(lens), kvh, d), jnp.float32))
+        kp, vp, ks, vs = paged_write_decode_q4(
+            kp, vp, ks, vs, pt, jnp.asarray(lens, jnp.int32),
+            jnp.asarray([True, True, False]),
+            jnp.asarray(rng.randn(b, kvh, d), jnp.float32),
+            jnp.asarray(rng.randn(b, kvh, d), jnp.float32))
+        return rng, pt, jnp.asarray(lens, jnp.int32), kp, vp, ks, vs
+
+    def test_decode_and_chunk_kernels_match_xla(self):
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_attention, paged_attention_chunk,
+            paged_attention_chunk_xla, paged_attention_xla)
+
+        rng, pt, seq, kp, vp, ks, vs = self._pools()
+        assert kp.dtype == jnp.uint8 and kp.shape[-1] == 8  # packed
+        q = jnp.asarray(rng.randn(3, 4, 16), jnp.float32)
+        ref = paged_attention_xla(q, kp, vp, pt, seq,
+                                  k_scales=ks, v_scales=vs)
+        ker = paged_attention(q, kp, vp, pt, seq, k_scales=ks,
+                              v_scales=vs, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(ker),
+                                   atol=1e-5)
+        qc = jnp.asarray(rng.randn(3, 3, 4, 16), jnp.float32)
+        start = jnp.asarray([5, 2, 8], jnp.int32)
+        ref = paged_attention_chunk_xla(qc, kp, vp, pt, start,
+                                        k_scales=ks, v_scales=vs)
+        ker = paged_attention_chunk(qc, kp, vp, pt, start, k_scales=ks,
+                                    v_scales=vs, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(ker),
+                                   atol=1e-5)
+
+    def test_attention_rejects_odd_head_dim_int4_pools(self):
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+
+        # uint8 pools with an ODD query head_dim cannot be nibble
+        # pools — reject instead of silently misinterpreting
+        with pytest.raises(ValueError, match="even"):
+            paged_attention(
+                jnp.zeros((1, 2, 15), jnp.float32),
+                jnp.zeros((1, 4, 8, 7), jnp.uint8),
+                jnp.zeros((1, 4, 8, 7), jnp.uint8),
+                jnp.zeros((1, 2), jnp.int32),
+                jnp.zeros((1,), jnp.int32),
+                k_scales=jnp.zeros((1, 4, 8), jnp.float32),
+                v_scales=jnp.zeros((1, 4, 8), jnp.float32))
+
+
+class TestExportImportAndHostRing:
+    """KV hand-off + host-ring parking at every quant level: the blob
+    is bit-exact across caches (scales included) and the ring's byte
+    ledger equals the bytes actually held (satellite: the nbytes
+    accounting bug hid behind numpy views of the bucket-width bases)."""
+
+    def _filled(self, quant):
+        from paddle_tpu.inference.kv_cache import (paged_write_prefill,
+                                                   paged_write_prefill_q4,
+                                                   paged_write_prefill_q8)
+
+        rng = np.random.RandomState(3)
+        cache = _mk_cache(quant)
+        lens = [13, 7]
+        for n in lens:
+            cache.allocate(n)
+        pt = jnp.asarray(cache.page_tables)
+        kn = jnp.asarray(rng.randn(2, max(lens), 2, 16), jnp.float32)
+        vn = jnp.asarray(rng.randn(2, max(lens), 2, 16), jnp.float32)
+        args = (pt, jnp.arange(2), jnp.asarray(lens, jnp.int32), kn, vn)
+        if quant == "int4":
+            out = paged_write_prefill_q4(
+                cache.k_layers[0], cache.v_layers[0],
+                cache.k_scales[0], cache.v_scales[0], *args)
+            (cache.k_layers[0], cache.v_layers[0],
+             cache.k_scales[0], cache.v_scales[0]) = out
+        elif quant == "int8":
+            out = paged_write_prefill_q8(
+                cache.k_layers[0], cache.v_layers[0],
+                cache.k_scales[0], cache.v_scales[0], *args)
+            (cache.k_layers[0], cache.v_layers[0],
+             cache.k_scales[0], cache.v_scales[0]) = out
+        else:
+            cache.k_layers[0], cache.v_layers[0] = paged_write_prefill(
+                cache.k_layers[0], cache.v_layers[0], *args)
+        cache._host("seq_lens")[:2] = lens
+        return cache
+
+    @pytest.mark.parametrize("quant", [None, "int8", "int4"])
+    def test_blob_bit_parity_and_ring_bytes(self, quant):
+        from paddle_tpu.serving.fleet import HostKVRing
+
+        cache = self._filled(quant)
+        blob = cache.export_slot(0)
+        # nbytes must be the TRUE held bytes: every array contiguous
+        # (no view silently pinning the full bucket-width base)
+        keys = ["k", "v"] + (["k_scales", "v_scales"] if quant else [])
+        held = sum(a.nbytes for key in keys for a in blob[key])
+        assert blob["nbytes"] == held > 0
+        for key in keys:
+            for a in blob[key]:
+                assert a.base is None or a.base.nbytes == a.nbytes
+        # forced evict (put) + onload (take): ledger == held bytes,
+        # and drains to zero
+        ring = HostKVRing(capacity_mb=1.0)
+        ring.put(1, blob, last_token=5)
+        assert ring.stats()["bytes"] == held
+        got, _tok = ring.take(1)
+        assert ring.stats()["bytes"] == 0
+        # adoption round-trip is bit-exact, scales included
+        dst = _mk_cache(quant)
+        slot = dst.import_slot(got, active=True)
+        blob2 = dst.export_slot(slot)
+        assert blob2["crc32"] == blob["crc32"]
+        for key in keys:
+            for a, b in zip(blob[key], blob2[key]):
+                np.testing.assert_array_equal(a, b)
+
+    def test_quant_ratio_shows_in_blob_bytes(self):
+        sizes = {q: self._filled(q).export_slot(0)["nbytes"]
+                 for q in (None, "int8", "int4")}
+        assert sizes[None] > sizes["int8"] > sizes["int4"]
+
+
+class TestSelfSpecDecoding:
+    def test_greedy_bit_identical_no_draft_state(self):
+        m = tiny_model(num_draft_heads=3)
+        ids = np.random.default_rng(11).integers(0, 97, (2, 9))
+        for quant in (None, "int4"):
+            kw = {} if quant is None else {"kv_quant": quant}
+            ref = GenerationEngine(m, kind="paged", batch=2, max_len=64,
+                                   **kw).generate(ids, 13).numpy()
+            eng = GenerationEngine(m, kind="paged", batch=2, max_len=64,
+                                   draft_model="self", spec_k=3, **kw)
+            # the whole point: NO extra checkpoint, NO draft pools
+            assert isinstance(eng.draft_model, SelfDraftProposer)
+            assert eng._draft_params == []
+            assert eng.draft_cache is None
+            out = eng.generate(ids, 13).numpy()
+            np.testing.assert_array_equal(np.asarray(ref),
+                                          np.asarray(out))
+            # one executable across variable accept counts
+            assert eng.spec_step.trace_count == 1
+            assert eng.spec_step.retrace_stats()["unexpected"] == 0
+            if quant == "int4":
+                # engine reuse stays deterministic on packed pools
+                reps = [np.asarray(eng.generate(ids, 13,
+                                                seq_lens=[9, 6]).numpy())
+                        for _ in range(2)]
+                np.testing.assert_array_equal(reps[0], reps[1])
+
+    def test_validation_guards(self):
+        with pytest.raises(ValueError, match="num_draft_heads"):
+            GenerationEngine(tiny_model(), kind="paged", max_len=64,
+                             draft_model="self")
+        with pytest.raises(ValueError, match="num_draft_heads"):
+            GenerationEngine(tiny_model(num_draft_heads=2), kind="paged",
+                             max_len=64, draft_model="self", spec_k=3)
+        with pytest.raises(ValueError, match="self"):
+            GenerationEngine(tiny_model(), kind="paged", max_len=64,
+                             draft_model="typo")
+
+
+class TestDraftHeads:
+    def test_zero_init_head_is_base_head(self):
+        # silu(0) = 0: the residual vanishes, so every untrained head's
+        # logits equal the base LM head's — proposals start sane
+        m = tiny_model(num_draft_heads=2)
+        h = paddle.randn([2, 3, 32])
+        base = m.head(h).numpy()
+        drafts = m.draft_logits(h).numpy()
+        for j in range(2):
+            np.testing.assert_allclose(np.asarray(drafts)[:, :, j],
+                                       np.asarray(base), atol=1e-6)
+
+    def test_loss_trains_heads_not_only_base(self):
+        m = tiny_model(num_draft_heads=2)
+        rng = np.random.default_rng(13)
+        ids = paddle.to_tensor(rng.integers(0, 97, (2, 12)), "int64")
+        lbl = paddle.to_tensor(rng.integers(0, 97, (2, 12)), "int64")
+        loss = m.loss(ids, lbl)
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        gnorms = [float(np.abs(np.asarray(p.grad.numpy())).max())
+                  for p in m.draft_heads.parameters()]
+        # zero-init weights still get gradient (silu'(0) = 1/2 keeps
+        # the residual branch alive); biases move first
+        assert max(gnorms) > 0
+
+    def test_heads_ride_the_checkpoint(self):
+        m = tiny_model(num_draft_heads=2)
+        # make the heads non-trivial so the round-trip is observable
+        for p in m.draft_heads.parameters():
+            p._data = jnp.full_like(p._data, 0.01)
+        m2 = tiny_model(seed=5, num_draft_heads=2)
+        m2.set_state_dict(m.state_dict())
+        h = paddle.randn([1, 2, 32])
+        np.testing.assert_array_equal(
+            np.asarray(m.draft_logits(h).numpy()),
+            np.asarray(m2.draft_logits(h).numpy()))
+
+
+class TestFleetPoolRollup:
+    def test_metrics_snapshot_reports_replica_pools(self):
+        from paddle_tpu.serving import FleetRouter
+
+        m = tiny_model(max_position_embeddings=256)
+        fleet = FleetRouter(model=m, decode_replicas=1,
+                            engine_kw=dict(max_slots=2, max_len=32,
+                                           page_size=8, chunk_size=16,
+                                           kv_quant="int4"))
+        snap = fleet.metrics_snapshot()
+        pools = snap["replica_pools"]
+        assert len(pools) == 1
+        st = next(iter(pools.values()))
+        assert st["kv_dtype"] == "int4"
+        assert st["effective_slots_vs_bf16"] > 1.0
+        assert {"bytes_per_token", "free_pages",
+                "total_pages"} <= set(st)

@@ -98,6 +98,11 @@ DEFAULT_TOLERANCES = {
     # (union of replica windows), latency band + floor as ttft
     "fleet_tok_s": (0.05, True, 0.0),
     "fleet_ttft": (0.25, False, 2e-3),   # seconds
+    # KV capacity (ISSUE 20): resident-slots-at-equal-HBM ratios from
+    # pool_stats' packed-bytes math (int8 vs bf16, int4 vs int8/bf16).
+    # Deterministic geometry arithmetic at a fixed config — any drop
+    # means the packing itself regressed, so gate tight, higher-better
+    "kv_capacity": (0.05, True, 0.0),
     # self-healing fleet (ISSUE 19): mean-time-to-recovery in ms
     # (replica death -> first post-death token; trainer crash ->
     # first post-restore step). Wide band + absolute floor: recovery
@@ -175,6 +180,8 @@ def _family(key):
         return "finite"
     if "grad_norm" in k:
         return "gradnorm"
+    if "slots_ratio" in k or "kv_capacity" in k:
+        return "kv_capacity"
     if "tokens_per_dispatch" in k:
         return "spec_yield"
     if "accept_rate" in k:
